@@ -1,0 +1,78 @@
+"""Benchmark: Parrot FedAvg ResNet-56 / CIFAR-10, 100 clients / 10 per round
+(the BASELINE.json north-star config) on the available accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+vs_baseline: the reference publishes no numbers (BASELINE.md); the recorded
+H100-NCCL anchor used by the driver is wall-clock to target accuracy.  Until
+a measured reference anchor exists we report rounds/sec against a NOMINAL
+anchor of 1.0 round/sec for this config (documented placeholder), so the
+ratio tracks our own progress across rounds.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+NOMINAL_BASELINE_ROUNDS_PER_SEC = 1.0
+
+
+def main() -> None:
+    import fedml_tpu
+    from fedml_tpu.runner import FedMLRunner
+
+    args = fedml_tpu.init(fedml_tpu.Config(
+        dataset="cifar10",
+        model="resnet56",
+        backend="parrot",
+        client_num_in_total=100,
+        client_num_per_round=10,
+        comm_round=8,            # 1 warmup/compile + 7 measured
+        epochs=1,
+        batch_size=32,
+        learning_rate=0.05,
+        data_scale=0.2,          # synthetic-fallback CIFAR size control
+        frequency_of_the_test=100,  # eval only at the end
+        enable_tracking=False,
+        compute_dtype="bfloat16",
+    ))
+    device = fedml_tpu.device.get_device(args)
+    dataset = fedml_tpu.data.load(args)
+    bundle = fedml_tpu.model.create(args, dataset[-1])
+    runner = FedMLRunner(args, device, dataset, bundle)
+    api = runner.runner
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = jax.random.PRNGKey(0)
+    # warmup (compile)
+    ids = jnp.asarray(api._client_sampling(0))
+    gv, st, _ = api.round_step(api.global_vars, api.server_state, ids, rng)
+    jax.block_until_ready(gv)
+
+    n_rounds = 7
+    t0 = time.time()
+    for r in range(1, n_rounds + 1):
+        ids = jnp.asarray(api._client_sampling(r))
+        rng, sub = jax.random.split(rng)
+        gv, st, _ = api.round_step(gv, st, ids, sub)
+    jax.block_until_ready(gv)
+    dt = time.time() - t0
+    rounds_per_sec = n_rounds / dt
+
+    print(json.dumps({
+        "metric": "parrot_fedavg_resnet56_cifar10_rounds_per_sec",
+        "value": round(rounds_per_sec, 4),
+        "unit": "rounds/sec (100 clients, 10/round, bs32, 1 local epoch)",
+        "vs_baseline": round(rounds_per_sec / NOMINAL_BASELINE_ROUNDS_PER_SEC,
+                             4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

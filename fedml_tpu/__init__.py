@@ -1,0 +1,100 @@
+"""fedml_tpu — a TPU-native federated learning framework.
+
+A from-scratch reimplementation of the capabilities of FedML
+(reference surveyed in SURVEY.md) designed for JAX/XLA on TPU: one engine,
+pytree params, jit-compiled local updates, mesh-axis parallelism
+(clients/data/model/seq/expert), and a message-driven control plane for real
+network boundaries.
+
+Entry contract parity (reference `python/fedml/__init__.py:64-168`,
+`launch_simulation.py:9-29`): the 5-step dance
+
+    args = fedml_tpu.init()
+    device = fedml_tpu.device.get_device(args)
+    dataset = fedml_tpu.data.load(args)
+    model = fedml_tpu.model.create(args, output_dim)
+    FedMLRunner(args, device, dataset, model).run()
+
+plus the one-liner ``fedml_tpu.run_simulation()``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from . import constants
+from .arguments import Config, load_arguments
+from .constants import __version__
+from .core import mlops
+from .core.dp.fedml_differential_privacy import FedMLDifferentialPrivacy
+from .core.security.fedml_attacker import FedMLAttacker
+from .core.security.fedml_defender import FedMLDefender
+from .runner import FedMLRunner
+
+# namespace sub-APIs mirroring the reference (`fedml.device/.data/.model`)
+from .data import data_loader as _data_loader
+from .ml.engine import mesh as device  # noqa: F401  (fedml_tpu.device)
+from .models import model_hub as model  # noqa: F401  (fedml_tpu.model)
+
+
+class _DataNS:
+    load = staticmethod(_data_loader.load)
+
+
+data = _DataNS()
+
+
+def init(args: Optional[Config] = None, argv: Optional[list] = None,
+         **overrides: Any) -> Config:
+    """Load config, seed all RNGs, init observability + security singletons
+    (reference `__init__.py:64-168`)."""
+    if args is None:
+        args = load_arguments(argv=argv, extra=overrides or None)
+    elif overrides:
+        args.update(overrides)
+
+    seed = int(getattr(args, "random_seed", 0) or 0)
+    random.seed(seed)
+    np.random.seed(seed)
+    os.environ.setdefault("PYTHONHASHSEED", str(seed))
+
+    logging.basicConfig(
+        level=getattr(logging, str(getattr(args, "log_level", "INFO")).upper(),
+                      logging.INFO),
+        format="[fedml_tpu %(levelname)s %(asctime)s] %(message)s")
+
+    mlops.init(args)
+    FedMLAttacker.get_instance().init(args)
+    FedMLDefender.get_instance().init(args)
+    FedMLDifferentialPrivacy.get_instance().init(args)
+    return args
+
+
+def run_simulation(backend: str = constants.SIMULATION_BACKEND_SP,
+                   args: Optional[Config] = None,
+                   client_trainer: Any = None,
+                   server_aggregator: Any = None) -> Dict[str, Any]:
+    """One-liner simulation entry (reference `launch_simulation.py:9-29`)."""
+    if args is None:
+        args = init()
+        args.backend = backend
+    else:
+        args = init(args)
+        args.backend = getattr(args, "backend", backend) or backend
+    dev = device.get_device(args)
+    dataset = data.load(args)
+    bundle = model.create(args, dataset[-1])
+    runner = FedMLRunner(args, dev, dataset, bundle,
+                         client_trainer, server_aggregator)
+    return runner.run()
+
+
+__all__ = [
+    "__version__", "init", "run_simulation", "FedMLRunner", "Config",
+    "load_arguments", "device", "data", "model", "mlops", "constants",
+]

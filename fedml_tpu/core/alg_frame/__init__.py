@@ -1,0 +1,5 @@
+from .client_trainer import ClientTrainer
+from .context import Context, Params
+from .server_aggregator import ServerAggregator
+
+__all__ = ["ClientTrainer", "ServerAggregator", "Context", "Params"]

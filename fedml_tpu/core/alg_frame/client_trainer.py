@@ -1,0 +1,73 @@
+"""ClientTrainer — the user-overridable local-training contract.
+
+Capability parity: reference `core/alg_frame/client_trainer.py:8-85` (abstract
+get/set params + train, lifecycle hooks for FHE/LDP, poisoning via
+update_dataset).
+
+TPU-first redesign: params are JAX pytrees (never state dicts); ``train`` is
+expected to delegate to a jit-compiled functional step so the same trainer
+works host-driven (SP, cross-silo) and under vmap (Parrot).  Hooks operate on
+pytrees so DP noise / masks are pure jnp ops.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Optional, Tuple
+
+from ..dp.fedml_differential_privacy import FedMLDifferentialPrivacy
+from ..security.fedml_attacker import FedMLAttacker
+
+
+class ClientTrainer(abc.ABC):
+    """Abstract local trainer owned by one (logical) client."""
+
+    def __init__(self, model: Any, args: Any) -> None:
+        self.model = model            # flax Module (apply fn container)
+        self.params: Any = None       # current pytree
+        self.id = 0
+        self.args = args
+        self.local_train_dataset = None
+        self.local_test_dataset = None
+        self.local_sample_number = 0
+        self.rng_seed = int(getattr(args, "random_seed", 0) or 0)
+
+    def set_id(self, trainer_id: int) -> None:
+        self.id = trainer_id
+
+    # -- dataset plumbing (reference :36-43 applies data poisoning) ---------
+    def update_dataset(self, local_train_dataset, local_test_dataset,
+                       local_sample_number) -> None:
+        attacker = FedMLAttacker.get_instance()
+        if attacker.is_data_poisoning_attack() and attacker.is_to_poison_data():
+            local_train_dataset = attacker.poison_data(local_train_dataset)
+        self.local_train_dataset = local_train_dataset
+        self.local_test_dataset = local_test_dataset
+        self.local_sample_number = local_sample_number
+
+    # -- params exchange ----------------------------------------------------
+    def get_model_params(self) -> Any:
+        return self.params
+
+    def set_model_params(self, model_parameters: Any) -> None:
+        self.params = model_parameters
+
+    # -- lifecycle hooks (reference :59-82) ---------------------------------
+    def on_before_local_training(self, train_data=None, device=None,
+                                 args=None) -> None:
+        """Hook before local SGD (reference: FHE decrypt)."""
+
+    def on_after_local_training(self, train_data=None, device=None,
+                                args=None) -> None:
+        """Hook after local SGD: local-DP noise on the update."""
+        dp = FedMLDifferentialPrivacy.get_instance()
+        if dp.is_local_dp_enabled():
+            self.set_model_params(dp.add_local_noise(self.get_model_params()))
+
+    # -- the actual work ----------------------------------------------------
+    @abc.abstractmethod
+    def train(self, train_data, device=None, args=None) -> Any:
+        """Run local epochs; updates ``self.params``; returns aux metrics."""
+
+    def test(self, test_data, device=None, args=None) -> Optional[dict]:
+        return None

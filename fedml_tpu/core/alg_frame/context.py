@@ -1,0 +1,5 @@
+"""Context re-export (reference `core/alg_frame/context.py`)."""
+
+from .params import Context, Params
+
+__all__ = ["Context", "Params"]

@@ -1,0 +1,63 @@
+"""Params / Context — the algorithm-frame data plumbing.
+
+Capability parity: reference `core/alg_frame/params.py:1` (kwargs bag) and
+`core/alg_frame/context.py:19` (process-wide singleton blackboard used by the
+contribution-assessment hooks).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+class Params:
+    """A kwargs bag passed between flow executors / hooks.
+
+    In the TPU build model payloads inside a Params are JAX pytrees, never
+    framework-specific state dicts.
+    """
+
+    def __init__(self, **kwargs: Any) -> None:
+        self.__dict__.update(kwargs)
+
+    def add(self, name: str, value: Any) -> "Params":
+        self.__dict__[name] = value
+        return self
+
+    def get(self, name: str, default: Any = None) -> Any:
+        return self.__dict__.get(name, default)
+
+    def remove(self, name: str) -> None:
+        self.__dict__.pop(name, None)
+
+    def keys(self):
+        return self.__dict__.keys()
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.__dict__
+
+
+class Context(Params):
+    """Process-wide singleton blackboard (reference `context.py:19`).
+
+    Used to pass side-band data (e.g. per-client models for Shapley
+    contribution assessment) without widening the aggregate() signature.
+    """
+
+    _instance: "Context" = None
+
+    KEY_TEST_DATA = "test_data"
+    KEY_METRICS_ON_LAST_ROUND = "metrics_on_last_round"
+    KEY_METRICS_ON_AGGREGATED_MODEL = "metrics_on_aggregated_model"
+    KEY_CLIENT_MODEL_LIST = "client_model_list"
+    KEY_CLIENT_ID_LIST_IN_THIS_ROUND = "client_id_list_in_this_round"
+    KEY_CLIENT_NUM_PER_ROUND = "client_num_per_round"
+
+    def __new__(cls) -> "Context":
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._instance = None

@@ -1,0 +1,123 @@
+"""ServerAggregator — the server-side aggregation contract with hooks.
+
+Capability parity: reference `core/alg_frame/server_aggregator.py:14-141`
+(on_before_aggregation: global-DP clip + model-poisoning injection + defense
+filtering; aggregate: defense-wrapped or plain agg operator;
+on_after_aggregation: central-DP noise; assess_contribution via Context).
+
+TPU-first: client updates arrive as a list of ``(n_samples, pytree)``; all
+hook math is pure jnp tree ops so the whole pipeline can also run stacked
+(leading client axis) inside one jit on the Parrot path.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, List, Tuple
+
+from ...ml.aggregator.agg_operator import FedMLAggOperator
+from ..contribution.contribution_assessor_manager import ContributionAssessorManager
+from ..dp.fedml_differential_privacy import FedMLDifferentialPrivacy
+from ..security.fedml_attacker import FedMLAttacker
+from ..security.fedml_defender import FedMLDefender
+from .context import Context
+
+
+class ServerAggregator(abc.ABC):
+    """Abstract server aggregator (user-overridable)."""
+
+    def __init__(self, model: Any, args: Any) -> None:
+        self.model = model
+        self.params: Any = None
+        self.id = 0
+        self.args = args
+        self.contribution_assessor_mgr = ContributionAssessorManager(args)
+        self.final_contribution_assigned_by_shapley = {}
+
+    def set_id(self, aggregator_id: int) -> None:
+        self.id = aggregator_id
+
+    def get_model_params(self) -> Any:
+        return self.params
+
+    def set_model_params(self, model_parameters: Any) -> None:
+        self.params = model_parameters
+
+    # -- hooks (reference :44-103) ------------------------------------------
+    def on_before_aggregation(
+        self, raw_client_model_or_grad_list: List[Tuple[float, Any]]
+    ) -> List[Tuple[float, Any]]:
+        if FedMLDifferentialPrivacy.get_instance().is_global_dp_enabled():
+            raw_client_model_or_grad_list = FedMLDifferentialPrivacy.get_instance(
+            ).global_clip(raw_client_model_or_grad_list)
+        attacker = FedMLAttacker.get_instance()
+        if attacker.is_model_attack():
+            raw_client_model_or_grad_list = attacker.attack_model(
+                raw_client_grad_list=raw_client_model_or_grad_list,
+                extra_auxiliary_info=self.get_model_params(),
+            )
+        defender = FedMLDefender.get_instance()
+        if defender.is_defense_enabled():
+            raw_client_model_or_grad_list = defender.defend_before_aggregation(
+                raw_client_grad_list=raw_client_model_or_grad_list,
+                extra_auxiliary_info=self.get_model_params(),
+            )
+        return raw_client_model_or_grad_list
+
+    def aggregate(self, raw_client_model_or_grad_list: List[Tuple[float, Any]]) -> Any:
+        defender = FedMLDefender.get_instance()
+        if defender.is_defense_enabled():
+            return defender.defend_on_aggregation(
+                raw_client_grad_list=raw_client_model_or_grad_list,
+                base_aggregation_func=FedMLAggOperator.agg,
+                extra_auxiliary_info=self.get_model_params(),
+            )
+        return FedMLAggOperator.agg(self.args, raw_client_model_or_grad_list)
+
+    def on_after_aggregation(self, aggregated_model_or_grad: Any) -> Any:
+        dp = FedMLDifferentialPrivacy.get_instance()
+        if dp.is_central_dp_enabled():
+            aggregated_model_or_grad = dp.add_global_noise(aggregated_model_or_grad)
+        defender = FedMLDefender.get_instance()
+        if defender.is_defense_enabled():
+            aggregated_model_or_grad = defender.defend_after_aggregation(
+                aggregated_model_or_grad)
+        return aggregated_model_or_grad
+
+    # -- contribution assessment (reference :105-134) -----------------------
+    def assess_contribution(self) -> None:
+        if self.contribution_assessor_mgr is None:
+            return
+        ctx = Context()
+        client_ids = ctx.get(Context.KEY_CLIENT_ID_LIST_IN_THIS_ROUND)
+        client_models = ctx.get(Context.KEY_CLIENT_MODEL_LIST)
+        metrics_last = ctx.get(Context.KEY_METRICS_ON_LAST_ROUND)
+        metrics_agg = ctx.get(Context.KEY_METRICS_ON_AGGREGATED_MODEL)
+        if client_ids is None or client_models is None:
+            return
+        self.contribution_assessor_mgr.run(
+            client_num_per_round=len(client_ids),
+            client_index_for_this_round=client_ids,
+            aggregation_func=FedMLAggOperator.agg,
+            local_weights_from_clients=client_models,
+            acc_on_last_round=(metrics_last or {}).get("test_acc", 0.0),
+            acc_on_aggregated_model=(metrics_agg or {}).get("test_acc", 0.0),
+            val_dataloader=ctx.get(Context.KEY_TEST_DATA),
+            validation_func=self.test_with_params,
+            device=None,
+        )
+        self.final_contribution_assigned_by_shapley = (
+            self.contribution_assessor_mgr.get_final_contribution_assignment())
+
+    def test_with_params(self, params: Any, test_data) -> Any:
+        """Evaluate a specific param pytree (used by contribution subsets)."""
+        old = self.get_model_params()
+        self.set_model_params(params)
+        try:
+            return self.test(test_data, None, self.args)
+        finally:
+            self.set_model_params(old)
+
+    @abc.abstractmethod
+    def test(self, test_data, device=None, args=None) -> Any:
+        """Evaluate ``self.params`` on test data; returns metrics dict."""

@@ -1,0 +1,7 @@
+from .contribution_assessor_manager import (
+    ContributionAssessorManager,
+    GTGShapley,
+    LeaveOneOut,
+)
+
+__all__ = ["ContributionAssessorManager", "LeaveOneOut", "GTGShapley"]

@@ -1,0 +1,108 @@
+"""Contribution assessment — leave-one-out and GTG-Shapley.
+
+Capability parity: reference `core/contribution/` (LOO `leave_one_out.py`,
+GTG-Shapley `gtg_shapley_value.py`, `ContributionAssessorManager`), fed by the
+Context blackboard from `server_aggregator.py:105-134`.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class ContributionAssessorManager:
+    def __init__(self, args: Any) -> None:
+        self.args = args
+        self.assessor = None
+        name = getattr(args, "contribution_alg", None)
+        if name:
+            name = str(name).lower()
+            if name in ("loo", "leave_one_out"):
+                self.assessor = LeaveOneOut()
+            elif name in ("gtg", "shapley", "gtg_shapley"):
+                self.assessor = GTGShapley(
+                    eps=float(getattr(args, "shapley_eps", 0.001)),
+                    max_perms=int(getattr(args, "shapley_max_perms", 10)),
+                    seed=int(getattr(args, "random_seed", 0) or 0),
+                )
+        self._final: Dict[int, float] = {}
+
+    def run(self, client_num_per_round, client_index_for_this_round,
+            aggregation_func, local_weights_from_clients,
+            acc_on_last_round, acc_on_aggregated_model,
+            val_dataloader, validation_func, device=None) -> None:
+        if self.assessor is None:
+            return
+        contrib = self.assessor.run(
+            self.args, client_index_for_this_round, aggregation_func,
+            local_weights_from_clients, acc_on_last_round,
+            acc_on_aggregated_model, val_dataloader, validation_func)
+        for cid, v in contrib.items():
+            self._final[cid] = self._final.get(cid, 0.0) + v
+        logging.info("contribution this round: %s", contrib)
+
+    def get_final_contribution_assignment(self) -> Dict[int, float]:
+        return dict(self._final)
+
+
+class LeaveOneOut:
+    """v_i = acc(all) − acc(all \\ {i}) (reference `leave_one_out.py`)."""
+
+    def run(self, args, client_ids, aggregation_func, weights_list,
+            acc_last, acc_agg, val_data, validation_func) -> Dict[int, float]:
+        n = len(weights_list)
+        out: Dict[int, float] = {}
+        for i, cid in enumerate(client_ids):
+            rest = [j for j in range(n) if j != i]
+            acc_without = _eval_subset(args, rest, aggregation_func,
+                                       weights_list, validation_func, val_data)
+            out[cid] = float(acc_agg) - acc_without
+        return out
+
+
+class GTGShapley:
+    """Guided truncated-gradient Shapley (reference `gtg_shapley_value.py`):
+    Monte-Carlo permutation sampling with within-permutation truncation once
+    the marginal gain falls under ``eps``."""
+
+    def __init__(self, eps: float = 0.001, max_perms: int = 10, seed: int = 0):
+        self.eps = eps
+        self.max_perms = max_perms
+        self.seed = seed
+
+    def run(self, args, client_ids, aggregation_func, weights_list,
+            acc_last, acc_agg, val_data, validation_func) -> Dict[int, float]:
+        n = len(weights_list)
+        rng = np.random.RandomState(self.seed)
+        sv = np.zeros(n)
+        counts = np.zeros(n)
+        for _ in range(self.max_perms):
+            perm = rng.permutation(n)
+            prev_acc = float(acc_last)
+            for pos, i in enumerate(perm):
+                subset = list(perm[: pos + 1])
+                acc = _eval_subset(args, subset, aggregation_func,
+                                   weights_list, validation_func, val_data)
+                sv[i] += acc - prev_acc
+                counts[i] += 1
+                if abs(float(acc_agg) - acc) < self.eps:  # truncation
+                    prev_acc = acc
+                    break
+                prev_acc = acc
+        counts = np.maximum(counts, 1)
+        return {cid: float(sv[i] / counts[i]) for i, cid in enumerate(client_ids)}
+
+
+def _eval_subset(args, subset_idx: List[int], aggregation_func, weights_list,
+                 validation_func, val_data) -> float:
+    """Aggregate a subset and evaluate it via ``validation_func(params, data)``
+    when available; falls back to the aggregator-consuming contract."""
+    if not subset_idx:
+        return 0.0
+    subset = [weights_list[i] for i in subset_idx]
+    model = aggregation_func(args, subset)
+    metrics = validation_func(model, val_data) or {}
+    return float(metrics.get("test_acc", 0.0))

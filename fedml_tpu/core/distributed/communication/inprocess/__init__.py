@@ -1,0 +1,3 @@
+from .inproc_comm_manager import InProcCommManager, InProcHub
+
+__all__ = ["InProcCommManager", "InProcHub"]

@@ -1,0 +1,90 @@
+"""In-process transport — the fake backend the reference lacks.
+
+The reference has no mock transport (SURVEY §4: "no fake/mock transport
+backends — the custom-backend hook is the intended injection point",
+`fedml_comm_manager.py:203-207`).  This backend makes every multi-node
+protocol (cross-silo handshake, SecAgg rounds, flow DAGs) testable in one
+process with deterministic ordering: each rank gets a queue on a shared hub;
+send = enqueue on the receiver's queue; receive loop = blocking dequeue +
+observer dispatch — exactly the threading contract of the MPI backend
+(`communication/mpi/com_manager.py:14-70`) without processes.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, List, Optional
+
+from ..base_com_manager import BaseCommunicationManager
+from ..message import Message
+from ..observer import Observer
+
+_STOP = object()
+
+
+class InProcHub:
+    """Shared mailbox set, one queue per rank.  Thread-safe."""
+
+    _hubs: Dict[str, "InProcHub"] = {}
+    _lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self.queues: Dict[int, "queue.Queue"] = {}
+        self._qlock = threading.Lock()
+
+    @classmethod
+    def get(cls, channel: str = "default") -> "InProcHub":
+        with cls._lock:
+            hub = cls._hubs.get(channel)
+            if hub is None:
+                hub = cls._hubs[channel] = InProcHub()
+            return hub
+
+    @classmethod
+    def reset(cls, channel: Optional[str] = None) -> None:
+        with cls._lock:
+            if channel is None:
+                cls._hubs.clear()
+            else:
+                cls._hubs.pop(channel, None)
+
+    def queue_for(self, rank: int) -> "queue.Queue":
+        with self._qlock:
+            q = self.queues.get(rank)
+            if q is None:
+                q = self.queues[rank] = queue.Queue()
+            return q
+
+
+class InProcCommManager(BaseCommunicationManager):
+    def __init__(self, rank: int, size: int, channel: str = "default") -> None:
+        self.rank = int(rank)
+        self.size = int(size)
+        self.hub = InProcHub.get(channel)
+        self._observers: List[Observer] = []
+        self._running = False
+
+    def send_message(self, msg: Message) -> None:
+        self.hub.queue_for(msg.get_receiver_id()).put(msg)
+
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        if observer in self._observers:
+            self._observers.remove(observer)
+
+    def handle_receive_message(self) -> None:
+        self._running = True
+        q = self.hub.queue_for(self.rank)
+        while self._running:
+            msg = q.get()
+            if msg is _STOP:
+                break
+            for obs in list(self._observers):
+                obs.receive_message(msg.get_type(), msg)
+
+    def stop_receive_message(self) -> None:
+        self._running = False
+        self.hub.queue_for(self.rank).put(_STOP)

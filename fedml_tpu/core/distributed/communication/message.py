@@ -1,0 +1,76 @@
+"""Message — the typed key-value envelope of the control plane.
+
+Capability parity: reference `core/distributed/communication/message.py:5-60`
+(sender/receiver ids, msg type, params dict, model payload key, out-of-band
+"model_params_url/key" for bulk transfer).
+
+TPU-first: model payloads are JAX pytrees.  ``to_wire``/``from_wire``
+serialize control fields as JSON and pytrees via the codec in
+``fedml_tpu/utils/serialization.py`` (host numpy buffers — device transfer
+happens only at the engine boundary).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+class Message:
+    MSG_ARG_KEY_OPERATION = "operation"
+    MSG_ARG_KEY_TYPE = "msg_type"
+    MSG_ARG_KEY_SENDER = "sender"
+    MSG_ARG_KEY_RECEIVER = "receiver"
+
+    MSG_ARG_KEY_MODEL_PARAMS = "model_params"
+    MSG_ARG_KEY_MODEL_PARAMS_URL = "model_params_url"
+    MSG_ARG_KEY_MODEL_PARAMS_KEY = "model_params_key"
+
+    MSG_OPERATION_SEND = "send"
+    MSG_OPERATION_RECEIVE = "receive"
+    MSG_OPERATION_BROADCAST = "broadcast"
+    MSG_OPERATION_REDUCE = "reduce"
+
+    def __init__(self, type: Any = 0, sender_id: int = 0, receiver_id: int = 0) -> None:
+        self.type = str(type)
+        self.sender_id = int(sender_id)
+        self.receiver_id = int(receiver_id)
+        self.msg_params: Dict[str, Any] = {
+            Message.MSG_ARG_KEY_TYPE: str(type),
+            Message.MSG_ARG_KEY_SENDER: int(sender_id),
+            Message.MSG_ARG_KEY_RECEIVER: int(receiver_id),
+        }
+
+    # -- reference-parity accessors ----------------------------------------
+    def init(self, msg_params: Dict[str, Any]) -> None:
+        self.msg_params = msg_params
+        self.type = str(msg_params.get(Message.MSG_ARG_KEY_TYPE))
+        self.sender_id = int(msg_params.get(Message.MSG_ARG_KEY_SENDER, 0))
+        self.receiver_id = int(msg_params.get(Message.MSG_ARG_KEY_RECEIVER, 0))
+
+    def get_sender_id(self) -> int:
+        return self.sender_id
+
+    def get_receiver_id(self) -> int:
+        return self.receiver_id
+
+    def add_params(self, key: str, value: Any) -> None:
+        self.msg_params[key] = value
+
+    def add(self, key: str, value: Any) -> None:
+        self.msg_params[key] = value
+
+    def get_params(self) -> Dict[str, Any]:
+        return self.msg_params
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self.msg_params.get(key, default)
+
+    def get_type(self) -> str:
+        return str(self.msg_params.get(Message.MSG_ARG_KEY_TYPE))
+
+    def to_string(self) -> str:
+        return str(self.msg_params)
+
+    def __repr__(self) -> str:
+        return (f"Message(type={self.type}, {self.sender_id}->"
+                f"{self.receiver_id}, keys={sorted(self.msg_params)})")

@@ -1,0 +1,12 @@
+"""Observer interface (reference `communication/observer.py:4-6`)."""
+
+from __future__ import annotations
+
+import abc
+from typing import Any
+
+
+class Observer(abc.ABC):
+    @abc.abstractmethod
+    def receive_message(self, msg_type: str, msg_params: Any) -> None:
+        ...

@@ -1,0 +1,115 @@
+"""FedMLCommManager — the node runtime.
+
+Capability parity: reference `core/distributed/fedml_comm_manager.py:11-209`:
+msg_type → handler registry, blocking run() → backend receive loop,
+send_message, finish(), backend factory with a custom-backend registration
+hook (:203-207).
+
+Backends in the TPU build: INPROC (new, for tests and single-host protocol
+runs), GRPC, MQTT_S3 (control/bulk split).  MPI/TRPC have no TPU-era role:
+collective traffic goes through jax/XLA (ICI/DCN), and point-to-point control
+traffic goes through gRPC — documented deviation.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from .communication.base_com_manager import BaseCommunicationManager
+from .communication.message import Message
+from .communication.observer import Observer
+
+_CUSTOM_BACKENDS: Dict[str, Callable[..., BaseCommunicationManager]] = {}
+
+
+def register_comm_backend(name: str,
+                          factory: Callable[..., BaseCommunicationManager]) -> None:
+    """Custom-backend hook (reference :203-207)."""
+    _CUSTOM_BACKENDS[name.upper()] = factory
+
+
+class FedMLCommManager(Observer):
+    def __init__(self, args: Any, comm: Any = None, rank: int = 0,
+                 size: int = 0, backend: str = "INPROC") -> None:
+        self.args = args
+        self.size = int(size)
+        self.rank = int(rank)
+        self.backend = backend
+        self.comm = comm
+        self.com_manager: Optional[BaseCommunicationManager] = None
+        self.message_handler_dict: Dict[str, Callable[[Message], None]] = {}
+        self._init_manager()
+
+    # -- lifecycle -----------------------------------------------------------
+    def run(self) -> None:
+        self.register_message_receive_handlers()
+        logging.debug("rank %d running (%s)", self.rank, self.backend)
+        self.com_manager.handle_receive_message()
+        logging.debug("rank %d done", self.rank)
+
+    def run_async(self) -> threading.Thread:
+        """Convenience for INPROC multi-node tests: run() on a daemon thread."""
+        t = threading.Thread(target=self.run, daemon=True,
+                             name=f"comm-rank-{self.rank}")
+        t.start()
+        return t
+
+    def finish(self) -> None:
+        logging.debug("rank %d finishing", self.rank)
+        self.com_manager.stop_receive_message()
+
+    # -- messaging -----------------------------------------------------------
+    def get_sender_id(self) -> int:
+        return self.rank
+
+    def send_message(self, message: Message) -> None:
+        self.com_manager.send_message(message)
+
+    def register_message_receive_handler(self, msg_type: Any,
+                                         handler: Callable[[Message], None]) -> None:
+        self.message_handler_dict[str(msg_type)] = handler
+
+    def register_message_receive_handlers(self) -> None:
+        """Subclasses register their typed handlers here."""
+
+    def receive_message(self, msg_type: str, msg_params: Message) -> None:
+        handler = self.message_handler_dict.get(str(msg_type))
+        if handler is None:
+            logging.warning("rank %d: no handler for msg_type %s",
+                            self.rank, msg_type)
+            return
+        handler(msg_params)
+
+    # -- backend factory (reference :131-209) --------------------------------
+    def _init_manager(self) -> None:
+        backend = str(self.backend).upper()
+        if backend in _CUSTOM_BACKENDS:
+            self.com_manager = _CUSTOM_BACKENDS[backend](
+                self.args, rank=self.rank, size=self.size)
+        elif backend == "INPROC":
+            from .communication.inprocess import InProcCommManager
+            channel = str(getattr(self.args, "run_id", "default"))
+            self.com_manager = InProcCommManager(self.rank, self.size, channel)
+        elif backend == "GRPC":
+            try:
+                from .communication.grpc import GRPCCommManager
+            except ImportError as e:
+                raise NotImplementedError(
+                    "GRPC comm backend not available in this build") from e
+            self.com_manager = GRPCCommManager(
+                args=self.args, rank=self.rank, size=self.size)
+        elif backend == "MQTT_S3":
+            try:
+                from .communication.mqtt_s3 import MqttS3CommManager
+            except ImportError as e:
+                raise NotImplementedError(
+                    "MQTT_S3 comm backend not available in this build") from e
+            self.com_manager = MqttS3CommManager(
+                args=self.args, rank=self.rank, size=self.size)
+        else:
+            raise ValueError(
+                f"unknown comm backend {self.backend!r}; register custom "
+                f"backends via register_comm_backend()")
+        self.com_manager.add_observer(self)

@@ -1,0 +1,8 @@
+from .topology_manager import (
+    AsymmetricTopologyManager,
+    BaseTopologyManager,
+    SymmetricTopologyManager,
+)
+
+__all__ = ["BaseTopologyManager", "SymmetricTopologyManager",
+           "AsymmetricTopologyManager"]

@@ -1,0 +1,96 @@
+"""Topology managers for decentralized FL.
+
+Capability parity: reference
+`core/distributed/topology/symmetric_topology_manager.py:7-76` (ring with
+`neighbor_num` symmetric neighbors, row-normalized mixing weights) and
+`asymmetric_topology_manager.py` (directed in/out neighbor maps).
+
+TPU-first: the topology is materialized as a dense [n, n] mixing matrix W so
+a decentralized gossip round is one ``W @ stacked_params`` contraction on the
+MXU (see `simulation/sp/decentralized`), not per-neighbor Python messaging.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import List
+
+import numpy as np
+
+
+class BaseTopologyManager(abc.ABC):
+    @abc.abstractmethod
+    def generate_topology(self) -> None: ...
+
+    @abc.abstractmethod
+    def get_in_neighbor_idx_list(self, node_index: int) -> List[int]: ...
+
+    @abc.abstractmethod
+    def get_out_neighbor_idx_list(self, node_index: int) -> List[int]: ...
+
+    def get_in_neighbor_weights(self, node_index: int) -> List[float]:
+        return list(self.topology[node_index])
+
+    def get_out_neighbor_weights(self, node_index: int) -> List[float]:
+        return list(self.topology[:, node_index])
+
+
+class SymmetricTopologyManager(BaseTopologyManager):
+    """Ring where each node links to ``neighbor_num`` neighbors on each side;
+    W is symmetric row-stochastic."""
+
+    def __init__(self, n: int, neighbor_num: int = 2) -> None:
+        self.n = int(n)
+        self.neighbor_num = min(int(neighbor_num), self.n - 1) if self.n > 1 else 0
+        self.topology = np.zeros((self.n, self.n))
+
+    def generate_topology(self) -> None:
+        w = np.zeros((self.n, self.n))
+        half = max(self.neighbor_num // 2, 1) if self.neighbor_num else 0
+        for i in range(self.n):
+            w[i, i] = 1.0
+            for d in range(1, half + 1):
+                w[i, (i + d) % self.n] = 1.0
+                w[i, (i - d) % self.n] = 1.0
+        w = w / w.sum(axis=1, keepdims=True)
+        self.topology = w
+
+    def get_in_neighbor_idx_list(self, node_index: int) -> List[int]:
+        return [j for j in range(self.n) if self.topology[node_index, j] > 0]
+
+    def get_out_neighbor_idx_list(self, node_index: int) -> List[int]:
+        return [j for j in range(self.n) if self.topology[j, node_index] > 0]
+
+    def get_mixing_matrix(self) -> np.ndarray:
+        return self.topology
+
+
+class AsymmetricTopologyManager(BaseTopologyManager):
+    """Directed random topology: each node picks ``out_neighbor_num`` outgoing
+    links (plus self-loop); rows normalized."""
+
+    def __init__(self, n: int, out_neighbor_num: int = 2, seed: int = 0) -> None:
+        self.n = int(n)
+        self.out_neighbor_num = min(int(out_neighbor_num), self.n - 1)
+        self.seed = seed
+        self.topology = np.zeros((self.n, self.n))
+
+    def generate_topology(self) -> None:
+        rng = np.random.RandomState(self.seed)
+        w = np.eye(self.n)
+        for i in range(self.n):
+            others = [j for j in range(self.n) if j != i]
+            picks = rng.choice(others, size=self.out_neighbor_num, replace=False)
+            for j in picks:
+                w[j, i] = 1.0  # i → j edge appears in receiver j's row
+        w = w / w.sum(axis=1, keepdims=True)
+        self.topology = w
+
+    def get_in_neighbor_idx_list(self, node_index: int) -> List[int]:
+        return [j for j in range(self.n) if self.topology[node_index, j] > 0]
+
+    def get_out_neighbor_idx_list(self, node_index: int) -> List[int]:
+        return [j for j in range(self.n) if self.topology[j, node_index] > 0]
+
+    def get_mixing_matrix(self) -> np.ndarray:
+        return self.topology

@@ -1,0 +1,94 @@
+"""FedMLDifferentialPrivacy — DP orchestration singleton.
+
+Capability parity: reference `core/dp/fedml_differential_privacy.py` (LDP /
+CDP / NbAFL frames keyed on yaml flags enable_dp + dp_solution_type), global
+clipping before aggregation and noise after, plus an RDP accountant
+(`core/dp/budget_accountant/rdp_accountant.py`) — see
+``fedml_tpu/core/dp/accountant/rdp_accountant.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .mechanisms import DPMechanism
+
+DP_LOCAL = "local"      # reference LDP frame
+DP_CENTRAL = "central"  # reference CDP frame
+DP_NBAFL = "NbAFL"
+
+
+def global_l2_clip(tree: Any, max_norm: float) -> Any:
+    """Clip a pytree to global L2 norm ≤ max_norm (CDP pre-agg clip)."""
+    sq = sum(jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+             for leaf in jax.tree_util.tree_leaves(tree))
+    norm = jnp.sqrt(sq)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    return jax.tree_util.tree_map(lambda x: (x * scale).astype(x.dtype), tree)
+
+
+class FedMLDifferentialPrivacy:
+    _instance = None
+
+    def __init__(self) -> None:
+        self.is_enabled = False
+        self.dp_solution_type = None
+        self.mechanism: DPMechanism = None
+        self.max_grad_norm = None
+        self._rng = jax.random.PRNGKey(0)
+        self._step = 0
+
+    @classmethod
+    def get_instance(cls) -> "FedMLDifferentialPrivacy":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def init(self, args: Any) -> None:
+        self.is_enabled = bool(getattr(args, "enable_dp", False))
+        if not self.is_enabled:
+            return
+        self.dp_solution_type = getattr(args, "dp_solution_type", DP_CENTRAL)
+        self.max_grad_norm = getattr(args, "max_grad_norm", None)
+        self.mechanism = DPMechanism(
+            getattr(args, "mechanism_type", "gaussian"),
+            epsilon=getattr(args, "epsilon", None),
+            delta=getattr(args, "delta", None),
+            sensitivity=getattr(args, "sensitivity", 1.0) or 1.0,
+            sigma=getattr(args, "sigma", None),
+        )
+        self._rng = jax.random.PRNGKey(
+            int(getattr(args, "random_seed", 0) or 0) + 0x5EED)
+
+    # -- enable queries ------------------------------------------------------
+    def is_local_dp_enabled(self) -> bool:
+        return self.is_enabled and self.dp_solution_type in (DP_LOCAL, DP_NBAFL)
+
+    def is_global_dp_enabled(self) -> bool:
+        return self.is_enabled and self.dp_solution_type in (DP_CENTRAL, DP_NBAFL)
+
+    def is_central_dp_enabled(self) -> bool:
+        return self.is_global_dp_enabled()
+
+    # -- ops -----------------------------------------------------------------
+    def _next_key(self) -> jax.Array:
+        self._rng, k = jax.random.split(self._rng)
+        return k
+
+    def add_local_noise(self, tree: Any) -> Any:
+        if self.max_grad_norm:
+            tree = global_l2_clip(tree, float(self.max_grad_norm))
+        return self.mechanism.add_noise(tree, self._next_key())
+
+    def add_global_noise(self, tree: Any) -> Any:
+        return self.mechanism.add_noise(tree, self._next_key())
+
+    def global_clip(self, raw_list: List[Tuple[float, Any]]
+                    ) -> List[Tuple[float, Any]]:
+        if not self.max_grad_norm:
+            return raw_list
+        c = float(self.max_grad_norm)
+        return [(n, global_l2_clip(t, c)) for n, t in raw_list]

@@ -1,0 +1,3 @@
+from .dp_mechanism import DPMechanism, Gaussian, Laplace
+
+__all__ = ["DPMechanism", "Gaussian", "Laplace"]

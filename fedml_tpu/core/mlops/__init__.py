@@ -1,0 +1,158 @@
+"""mlops — observability façade (events, metrics, models, logs).
+
+Capability parity: reference `core/mlops/__init__.py:158-1024` (`log`,
+`log_metric`, `log_model`, `log_artifact`, round/status APIs) and
+`MLOpsProfilerEvent` span events (`mlops_profiler_event.py:9-152`).
+
+TPU-first redesign: local-first — everything is appended to run-scoped JSONL
+files (`<log_dir>/events.jsonl`, `metrics.jsonl`) with wall-clock timestamps;
+remote sinks (MQTT backend, wandb) are pluggable writers registered via
+``add_sink``.  This replaces the reference's hard MQTT/S3 coupling while
+keeping the call-site API identical.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+_lock = threading.Lock()
+_state: Dict[str, Any] = {
+    "enabled": False,
+    "log_dir": None,
+    "run_id": "0",
+    "sinks": [],          # callables (kind:str, record:dict) -> None
+    "files": {},
+}
+
+
+def init(args: Any) -> None:
+    log_dir = getattr(args, "log_file_dir", None) or os.path.join(
+        os.path.expanduser("~"), ".fedml_tpu", "logs",
+        str(getattr(args, "run_id", "0")))
+    os.makedirs(log_dir, exist_ok=True)
+    with _lock:
+        _state["enabled"] = bool(getattr(args, "enable_tracking", True))
+        _state["log_dir"] = log_dir
+        _state["run_id"] = str(getattr(args, "run_id", "0"))
+        _state["files"] = {}
+    if getattr(args, "enable_wandb", False):
+        _try_add_wandb(args)
+
+
+def add_sink(sink: Callable[[str, Dict[str, Any]], None]) -> None:
+    with _lock:
+        _state["sinks"].append(sink)
+
+
+def _emit(kind: str, record: Dict[str, Any]) -> None:
+    if not _state["enabled"]:
+        return
+    record = dict(record, ts=time.time(), run_id=_state["run_id"])
+    with _lock:
+        path = os.path.join(_state["log_dir"], f"{kind}.jsonl")
+        f = _state["files"].get(kind)
+        if f is None or f.closed:
+            f = open(path, "a")
+            _state["files"][kind] = f
+        f.write(json.dumps(record, default=str) + "\n")
+        f.flush()
+        sinks = list(_state["sinks"])
+    for sink in sinks:
+        try:
+            sink(kind, record)
+        except Exception:
+            pass
+
+
+# -- public API (mirrors reference call sites) ------------------------------
+
+def log(metrics: Dict[str, Any], step: Optional[int] = None, commit: bool = True) -> None:
+    _emit("metrics", {"metrics": metrics, "step": step})
+
+
+def log_metric(metrics: Dict[str, Any], step: Optional[int] = None) -> None:
+    _emit("metrics", {"metrics": metrics, "step": step})
+
+
+def log_round_info(total_rounds: int, round_index: int) -> None:
+    _emit("events", {"event": "round", "round_index": round_index,
+                     "total_rounds": total_rounds})
+
+
+def log_aggregated_model_info(round_index: int, model_url: str = "") -> None:
+    _emit("events", {"event": "aggregated_model", "round_index": round_index,
+                     "model_url": model_url})
+
+
+def log_training_status(status: str, run_id: Any = None) -> None:
+    _emit("events", {"event": "training_status", "status": status})
+
+
+def log_aggregation_status(status: str, run_id: Any = None) -> None:
+    _emit("events", {"event": "aggregation_status", "status": status})
+
+
+def log_model(model_name: str, model_path: str, metadata: Optional[dict] = None) -> None:
+    _emit("artifacts", {"event": "model", "name": model_name,
+                        "path": model_path, "metadata": metadata or {}})
+
+
+def log_artifact(path: str, name: Optional[str] = None) -> None:
+    _emit("artifacts", {"event": "artifact", "name": name or os.path.basename(path),
+                        "path": path})
+
+
+def log_llm_record(record: Dict[str, Any]) -> None:
+    _emit("llm", record)
+
+
+# -- span events (MLOpsProfilerEvent parity) --------------------------------
+
+def event(event_name: str, event_started: bool = True,
+          event_value: Any = None, event_edge_id: Any = None) -> None:
+    _emit("events", {
+        "event": event_name,
+        "phase": "started" if event_started else "ended",
+        "value": event_value,
+        "edge_id": event_edge_id,
+    })
+
+
+class _Span:
+    def __init__(self, name: str, value: Any = None) -> None:
+        self.name, self.value = name, value
+
+    def __enter__(self):
+        event(self.name, True, self.value)
+        self.t0 = time.time()
+        return self
+
+    def __exit__(self, *exc):
+        event(self.name, False, self.value)
+        _emit("metrics", {"metrics": {f"span/{self.name}": time.time() - self.t0}})
+        return False
+
+
+def span(name: str, value: Any = None) -> _Span:
+    """Context-manager span — the TPU build's ergonomic profiler API."""
+    return _Span(name, value)
+
+
+def _try_add_wandb(args: Any) -> None:
+    try:
+        import wandb  # noqa: F401
+
+        wandb.init(project=getattr(args, "wandb_project", "fedml_tpu"),
+                   name=str(getattr(args, "run_id", "0")), reinit=True)
+
+        def _sink(kind: str, record: Dict[str, Any]) -> None:
+            if kind == "metrics":
+                wandb.log(record.get("metrics", {}))
+
+        add_sink(_sink)
+    except Exception:
+        pass

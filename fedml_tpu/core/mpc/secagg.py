@@ -1,0 +1,177 @@
+"""Secure-aggregation primitives: finite field, Shamir shares, LCC.
+
+Capability parity: reference `core/mpc/secagg.py` (600 LoC total for mpc) —
+`modular_inv` (:8), Shamir secret sharing, `LCC_encoding_with_points` (:41),
+`LCC_decoding_with_points` (:50), pairwise-mask SecAgg math, and
+`core/mpc/lightsecagg.py` (mask encoding / aggregate-mask reconstruction).
+
+TPU-first split (SURVEY §7 hard part (c)): the *key/share* math is tiny and
+runs on host in numpy int64 over the prime field p = 2^31 − 1 (products of
+two <2^31 residues fit int64 — no uint64 modmul needed).  The *bulk* mask
+application to model updates runs on device as natural mod-2^32 uint32
+adds (`mask_model` / `unmask_sum` below) — quantize, add mask with hardware
+wraparound, aggregate, subtract the reconstructed aggregate mask.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Mersenne prime 2^31 − 1: residues fit in int32; int64 products are exact.
+FIELD_PRIME = np.int64(2**31 - 1)
+
+
+# ---------------------------------------------------------------------------
+# field arithmetic (host, numpy int64)
+# ---------------------------------------------------------------------------
+
+def modular_inv(a: np.ndarray, p: np.int64 = FIELD_PRIME) -> np.ndarray:
+    """Inverse via Fermat: a^(p-2) mod p (reference `modular_inv:8`)."""
+    return pow_mod(a, int(p - 2), p)
+
+
+def pow_mod(a: np.ndarray, e: int, p: np.int64 = FIELD_PRIME) -> np.ndarray:
+    a = np.asarray(a, np.int64) % p
+    result = np.ones_like(a)
+    while e > 0:
+        if e & 1:
+            result = (result * a) % p
+        a = (a * a) % p
+        e >>= 1
+    return result
+
+
+def _eval_poly(coeffs: np.ndarray, x: np.int64,
+               p: np.int64 = FIELD_PRIME) -> np.ndarray:
+    """Horner evaluation of polynomial(s) with vector coefficients.
+    coeffs: [deg+1, dim] int64."""
+    acc = np.zeros(coeffs.shape[1], np.int64)
+    for c in coeffs[::-1]:
+        acc = (acc * np.int64(x) + c) % p
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# Shamir secret sharing (vector secrets)
+# ---------------------------------------------------------------------------
+
+def shamir_share(secret: np.ndarray, n: int, t: int, rng: np.random.RandomState,
+                 p: np.int64 = FIELD_PRIME) -> Dict[int, np.ndarray]:
+    """Split a vector secret into n shares, any t+1 reconstruct.
+    Share for party i evaluates the degree-t polynomial at x=i+1."""
+    secret = np.asarray(secret, np.int64) % p
+    coeffs = np.concatenate([
+        secret[None, :],
+        rng.randint(0, int(p), size=(t, len(secret))).astype(np.int64),
+    ])
+    return {i: _eval_poly(coeffs, np.int64(i + 1), p) for i in range(n)}
+
+
+def shamir_reconstruct(shares: Dict[int, np.ndarray],
+                       p: np.int64 = FIELD_PRIME) -> np.ndarray:
+    """Lagrange interpolation at x=0 from party-indexed shares."""
+    xs = np.array(sorted(shares.keys()), np.int64)
+    out = np.zeros_like(next(iter(shares.values())))
+    for i in xs:
+        num, den = np.int64(1), np.int64(1)
+        for j in xs:
+            if j == i:
+                continue
+            num = (num * ((-(j + 1)) % p)) % p
+            den = (den * ((i - j) % p)) % p
+        lam = (num * modular_inv(den, p)) % p
+        out = (out + lam * (shares[int(i)] % p)) % p
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Lagrange coded computing (reference LCC_encoding/decoding_with_points)
+# ---------------------------------------------------------------------------
+
+def _lagrange_basis(eval_points: np.ndarray, interp_points: np.ndarray,
+                    p: np.int64 = FIELD_PRIME) -> np.ndarray:
+    """U[i, j] = l_j(alpha_i): evaluate basis polys (nodes = interp_points)
+    at eval_points. Shapes: [len(eval), len(interp)]."""
+    e = np.asarray(eval_points, np.int64) % p
+    b = np.asarray(interp_points, np.int64) % p
+    U = np.zeros((len(e), len(b)), np.int64)
+    for j in range(len(b)):
+        num = np.ones(len(e), np.int64)
+        den = np.int64(1)
+        for k in range(len(b)):
+            if k == j:
+                continue
+            num = (num * ((e - b[k]) % p)) % p
+            den = (den * ((b[j] - b[k]) % p)) % p
+        U[:, j] = (num * modular_inv(den, p)) % p
+    return U
+
+
+def LCC_encoding_with_points(X: np.ndarray, interp_points: Sequence[int],
+                             eval_points: Sequence[int],
+                             p: np.int64 = FIELD_PRIME) -> np.ndarray:
+    """Encode data blocks X [m, ...] (poly through (beta_j, X_j)) evaluated
+    at alpha_i → [n_eval, ...] (reference `LCC_encoding_with_points:41`)."""
+    X = np.asarray(X, np.int64) % p
+    U = _lagrange_basis(np.asarray(eval_points), np.asarray(interp_points), p)
+    flat = X.reshape(X.shape[0], -1)
+    out = np.zeros((U.shape[0], flat.shape[1]), np.int64)
+    for i in range(U.shape[0]):
+        out[i] = np.sum((U[i][:, None] * flat) % p, axis=0) % p
+    return out.reshape((U.shape[0],) + X.shape[1:])
+
+
+def LCC_decoding_with_points(F: np.ndarray, eval_points_in: Sequence[int],
+                             target_points: Sequence[int],
+                             p: np.int64 = FIELD_PRIME) -> np.ndarray:
+    """Decode: interpolate through (alpha_i, F_i) and evaluate at targets
+    (reference `LCC_decoding_with_points:50`)."""
+    F = np.asarray(F, np.int64) % p
+    U = _lagrange_basis(np.asarray(target_points), np.asarray(eval_points_in),
+                        p)
+    flat = F.reshape(F.shape[0], -1)
+    out = np.zeros((U.shape[0], flat.shape[1]), np.int64)
+    for i in range(U.shape[0]):
+        out[i] = np.sum((U[i][:, None] * flat) % p, axis=0) % p
+    return out.reshape((U.shape[0],) + F.shape[1:])
+
+
+# ---------------------------------------------------------------------------
+# device-side bulk masking (mod 2^32 uint32)
+# ---------------------------------------------------------------------------
+
+def quantize(tree: Any, scale: float = 2.0**16) -> Any:
+    """float pytree → uint32 fixed-point (two's-complement wraparound)."""
+    return jax.tree_util.tree_map(
+        lambda x: jnp.round(x.astype(jnp.float32) * scale
+                            ).astype(jnp.int32).view(jnp.uint32),
+        tree)
+
+
+def dequantize(tree: Any, n_summed: int = 1, scale: float = 2.0**16) -> Any:
+    return jax.tree_util.tree_map(
+        lambda x: x.view(jnp.int32).astype(jnp.float32) / scale, tree)
+
+
+def prg_mask_like(tree: Any, seed: int) -> Any:
+    """Deterministic uint32 mask pytree from a seed (the PRG both the client
+    and the reconstructor expand)."""
+    key = jax.random.PRNGKey(seed & 0x7FFFFFFF)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    keys = jax.random.split(key, len(leaves))
+    masks = [jax.random.bits(k, jnp.shape(l), jnp.uint32)
+             for k, l in zip(keys, leaves)]
+    return jax.tree_util.tree_unflatten(treedef, masks)
+
+
+def mask_model(qtree: Any, mask: Any) -> Any:
+    """Add mask mod 2^32 (hardware wraparound) — the in-HBM mask path."""
+    return jax.tree_util.tree_map(lambda x, m: x + m, qtree, mask)
+
+
+def unmask_sum(qsum: Any, aggregate_mask: Any) -> Any:
+    return jax.tree_util.tree_map(lambda x, m: x - m, qsum, aggregate_mask)

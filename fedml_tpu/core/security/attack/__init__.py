@@ -1,0 +1,38 @@
+"""Attack registry (reference `core/security/attack/`)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .attack_base import BaseAttackMethod
+from .attacks import (
+    BackdoorAttack,
+    ByzantineAttack,
+    LabelFlippingAttack,
+    LazyWorkerAttack,
+    ModelReplacementBackdoorAttack,
+)
+
+ATTACK_REGISTRY = {
+    "byzantine": ByzantineAttack,
+    "label_flipping": LabelFlippingAttack,
+    "backdoor": BackdoorAttack,
+    "edge_case_backdoor": BackdoorAttack,
+    "model_replacement_backdoor": ModelReplacementBackdoorAttack,
+    "lazy_worker": LazyWorkerAttack,
+}
+
+
+def create_attacker(attack_type: str, config: Any) -> BaseAttackMethod:
+    if attack_type in ("dlg", "invert_gradient", "revealing_labels"):
+        from .gradient_inversion import InvertGradientAttack
+        return InvertGradientAttack(config)
+    try:
+        factory = ATTACK_REGISTRY[attack_type]
+    except KeyError:
+        raise ValueError(
+            f"unknown attack {attack_type!r}; known: {sorted(ATTACK_REGISTRY)}")
+    return factory(config)
+
+
+__all__ = ["BaseAttackMethod", "create_attacker", "ATTACK_REGISTRY"]

@@ -1,0 +1,20 @@
+"""Base class for attacks (reference `core/security/attack/attack_base.py`)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+
+class BaseAttackMethod:
+    def __init__(self, config: Any) -> None:
+        self.config = config
+
+    def poison_data(self, dataset: Any) -> Any:
+        return dataset
+
+    def attack_model(self, raw_client_grad_list: List[Tuple[float, Any]],
+                     extra_auxiliary_info: Any = None):
+        return raw_client_grad_list
+
+    def reconstruct_data(self, a_gradient: Any, extra_auxiliary_info: Any = None):
+        raise NotImplementedError

@@ -1,0 +1,150 @@
+"""Attack implementations.
+
+Capability parity with reference `core/security/attack/`:
+ - byzantine (random / zero modes)       (`byzantine_attack.py`)
+ - label flipping                        (`label_flipping_attack.py`)
+ - backdoor (trigger pattern + target)   (`backdoor_attack.py`)
+ - model replacement backdoor (boosting) (`model_replacement_backdoor_attack.py`)
+ - lazy worker (stale/duplicate update)  (`lazy_worker_attack.py`)
+
+Gradient-inversion reconstruction (DLG / invert-gradient) lives in
+``gradient_inversion.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import grad_list_to_matrix, matrix_to_grad_list
+from .attack_base import BaseAttackMethod
+
+
+def _num_malicious(config: Any, n: int) -> int:
+    k = getattr(config, "byzantine_client_num", None)
+    if k is None:
+        k = max(1, int(n * float(getattr(config, "malicious_client_ratio", 0.25))))
+    return min(int(k), n)
+
+
+class ByzantineAttack(BaseAttackMethod):
+    """attack_mode ∈ {random, zero, flip}; replaces the first f client updates."""
+
+    def __init__(self, config: Any) -> None:
+        super().__init__(config)
+        self.mode = str(getattr(config, "attack_mode", "random")).lower()
+        self._rng = jax.random.PRNGKey(int(getattr(config, "random_seed", 0) or 0))
+
+    def attack_model(self, raw_client_grad_list, extra_auxiliary_info=None):
+        mat, weights, template = grad_list_to_matrix(raw_client_grad_list)
+        f = _num_malicious(self.config, mat.shape[0])
+        self._rng, k = jax.random.split(self._rng)
+        if self.mode == "zero":
+            evil = jnp.zeros((f, mat.shape[1]))
+        elif self.mode == "flip":
+            evil = -mat[:f]
+        else:
+            scale = jnp.std(mat) + 1.0
+            evil = scale * jax.random.normal(k, (f, mat.shape[1]))
+        mat = mat.at[:f].set(evil)
+        return matrix_to_grad_list(mat, weights, template)
+
+
+class LabelFlippingAttack(BaseAttackMethod):
+    """Flip ``original_class_list`` labels to ``target_class_list`` in the
+    poisoned clients' datasets. Dataset = (x, y) numpy arrays."""
+
+    def __init__(self, config: Any) -> None:
+        super().__init__(config)
+        self.original = list(np.atleast_1d(
+            getattr(config, "original_class_list", [1])))
+        self.target = list(np.atleast_1d(
+            getattr(config, "target_class_list", [0])))
+
+    def poison_data(self, dataset):
+        x, y = dataset
+        y0 = np.asarray(y)
+        y = np.array(y, copy=True)
+        # compute all masks against the ORIGINAL labels first so swap
+        # mappings like ([0,1],[1,0]) don't cascade
+        for o, t in zip(self.original, self.target):
+            y[y0 == o] = t
+        return x, y
+
+
+class BackdoorAttack(BaseAttackMethod):
+    """Stamp a trigger patch (corner pixels set to max) on a fraction of
+    examples and set their label to the backdoor target."""
+
+    def __init__(self, config: Any) -> None:
+        super().__init__(config)
+        self.target_label = int(getattr(config, "backdoor_target_label", 0))
+        self.poison_frac = float(getattr(config, "poison_frac", 0.2))
+        self.trigger_size = int(getattr(config, "trigger_size", 3))
+        self.seed = int(getattr(config, "random_seed", 0) or 0)
+
+    def poison_data(self, dataset):
+        x, y = dataset
+        x = np.array(x, copy=True)
+        y = np.array(y, copy=True)
+        n = len(y)
+        rng = np.random.RandomState(self.seed)
+        idx = rng.choice(n, size=max(1, int(n * self.poison_frac)), replace=False)
+        t = self.trigger_size
+        hi = float(np.max(x)) if x.size else 1.0
+        if x.ndim >= 3:  # image [N, H, W, (C)]
+            x[idx, :t, :t, ...] = hi
+        else:            # flat features: stamp leading coords
+            x[idx, :t] = hi
+        y[idx] = self.target_label
+        return x, y
+
+
+class ModelReplacementBackdoorAttack(BaseAttackMethod):
+    """Boosted model replacement (Bagdasaryan et al.): attacker scales its
+    deviation from the global model by gamma ≈ n/η so the aggregate becomes
+    the backdoored model."""
+
+    def __init__(self, config: Any) -> None:
+        super().__init__(config)
+        self.gamma = float(getattr(config, "boosting_factor", 0.0))
+
+    def attack_model(self, raw_client_grad_list, extra_auxiliary_info=None):
+        global_model = extra_auxiliary_info
+        if global_model is None or not raw_client_grad_list:
+            return raw_client_grad_list
+        n, atk = raw_client_grad_list[0]
+        gamma = self.gamma or float(len(raw_client_grad_list))
+        boosted = jax.tree_util.tree_map(
+            lambda g, w: g + gamma * (w - g), global_model, atk)
+        return [(n, boosted)] + list(raw_client_grad_list[1:])
+
+
+class LazyWorkerAttack(BaseAttackMethod):
+    """Lazy workers resend (a noisy copy of) the previous global model
+    instead of training."""
+
+    def __init__(self, config: Any) -> None:
+        super().__init__(config)
+        self.noise = float(getattr(config, "lazy_noise_std", 1e-3))
+        self._rng = jax.random.PRNGKey(int(getattr(config, "random_seed", 0) or 0))
+
+    def attack_model(self, raw_client_grad_list, extra_auxiliary_info=None):
+        global_model = extra_auxiliary_info
+        if global_model is None:
+            return raw_client_grad_list
+        f = _num_malicious(self.config, len(raw_client_grad_list))
+        out = list(raw_client_grad_list)
+        for i in range(f):
+            self._rng, k = jax.random.split(self._rng)
+            n, _ = out[i]
+            lazy = jax.tree_util.tree_map(
+                lambda w: w + self.noise * jax.random.normal(
+                    jax.random.fold_in(k, hash(str(jnp.shape(w))) % (2**31)),
+                    jnp.shape(w)).astype(w.dtype),
+                global_model)
+            out[i] = (n, lazy)
+        return out

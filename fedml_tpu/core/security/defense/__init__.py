@@ -1,0 +1,59 @@
+"""Defense registry (reference `core/security/defense/`, 23 defenses;
+`core/security/constants.py:1-30`)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .defense_base import BaseDefenseMethod
+from .robust_aggregation import (
+    BulyanDefense,
+    CClipDefense,
+    CoordinateWiseMedianDefense,
+    CoordinateWiseTrimmedMeanDefense,
+    CrossRoundDefense,
+    FoolsGoldDefense,
+    KrumDefense,
+    NormDiffClippingDefense,
+    RFADefense,
+    SLSGDDefense,
+    ThreeSigmaDefense,
+    WeakDPDefense,
+)
+
+DEFENSE_REGISTRY = {
+    "krum": KrumDefense,
+    "multikrum": lambda cfg: KrumDefense(_with(cfg, multi=True)),
+    "bulyan": BulyanDefense,
+    "rfa": RFADefense,
+    "geometric_median": RFADefense,
+    "coordinate_wise_median": CoordinateWiseMedianDefense,
+    "coordinate_wise_trimmed_mean": CoordinateWiseTrimmedMeanDefense,
+    "cclip": CClipDefense,
+    "norm_diff_clipping": NormDiffClippingDefense,
+    "weak_dp": WeakDPDefense,
+    "slsgd": SLSGDDefense,
+    "foolsgold": FoolsGoldDefense,
+    "three_sigma": ThreeSigmaDefense,
+    "three_sigma_geomedian": lambda cfg: ThreeSigmaDefense(
+        _with(cfg, three_sigma_geomedian=True)),
+    "crossround": CrossRoundDefense,
+}
+
+
+def _with(cfg: Any, **kw):
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def create_defender(defense_type: str, config: Any) -> BaseDefenseMethod:
+    try:
+        factory = DEFENSE_REGISTRY[defense_type]
+    except KeyError:
+        raise ValueError(
+            f"unknown defense {defense_type!r}; known: {sorted(DEFENSE_REGISTRY)}")
+    return factory(config)
+
+
+__all__ = ["BaseDefenseMethod", "create_defender", "DEFENSE_REGISTRY"]

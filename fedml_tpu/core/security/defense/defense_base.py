@@ -1,0 +1,26 @@
+"""Base class for defenses (reference `core/security/defense/defense_base.py`)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Tuple
+
+
+class BaseDefenseMethod:
+    def __init__(self, config: Any) -> None:
+        self.config = config
+
+    def defend_before_aggregation(
+        self, raw_client_grad_list: List[Tuple[float, Any]],
+        extra_auxiliary_info: Any = None,
+    ) -> List[Tuple[float, Any]]:
+        return raw_client_grad_list
+
+    def defend_on_aggregation(
+        self, raw_client_grad_list: List[Tuple[float, Any]],
+        base_aggregation_func: Callable = None,
+        extra_auxiliary_info: Any = None,
+    ) -> Any:
+        return base_aggregation_func(self.config, raw_client_grad_list)
+
+    def defend_after_aggregation(self, global_model: Any) -> Any:
+        return global_model

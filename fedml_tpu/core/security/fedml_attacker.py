@@ -1,0 +1,66 @@
+"""FedMLAttacker — attack orchestration singleton.
+
+Capability parity: reference `core/security/fedml_attacker.py` (keyed on yaml
+enable_attack / attack_type; data-poisoning vs model-poisoning dispatch,
+invoked from alg_frame hooks).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+ATTACK_DATA_POISONING = {"label_flipping", "backdoor", "edge_case_backdoor"}
+ATTACK_MODEL_POISONING = {"byzantine", "model_replacement_backdoor", "lazy_worker"}
+ATTACK_RECONSTRUCTION = {"dlg", "invert_gradient", "revealing_labels"}
+
+
+class FedMLAttacker:
+    _instance = None
+
+    def __init__(self) -> None:
+        self.is_enabled = False
+        self.attack_type: Optional[str] = None
+        self.attacker = None
+
+    @classmethod
+    def get_instance(cls) -> "FedMLAttacker":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def init(self, args: Any) -> None:
+        self.is_enabled = bool(getattr(args, "enable_attack", False))
+        self.attacker = None
+        self.attack_type = None
+        if not self.is_enabled:
+            return
+        self.attack_type = str(getattr(args, "attack_type", "")).strip().lower()
+        from .attack import create_attacker
+        self.attacker = create_attacker(self.attack_type, args)
+
+    # -- queries (reference API surface) ------------------------------------
+    def is_data_poisoning_attack(self) -> bool:
+        return self.is_enabled and self.attack_type in ATTACK_DATA_POISONING
+
+    def is_model_attack(self) -> bool:
+        return self.is_enabled and self.attack_type in ATTACK_MODEL_POISONING
+
+    def is_reconstruct_data_attack(self) -> bool:
+        return self.is_enabled and self.attack_type in ATTACK_RECONSTRUCTION
+
+    def is_to_poison_data(self) -> bool:
+        # per-round/per-client gating is handled by the attacker itself
+        return self.is_enabled and self.attacker is not None
+
+    # -- ops ------------------------------------------------------------------
+    def poison_data(self, dataset):
+        return self.attacker.poison_data(dataset)
+
+    def attack_model(self, raw_client_grad_list: List[Tuple[float, Any]],
+                     extra_auxiliary_info: Any = None):
+        return self.attacker.attack_model(
+            raw_client_grad_list, extra_auxiliary_info=extra_auxiliary_info)
+
+    def reconstruct_data(self, a_gradient, extra_auxiliary_info: Any = None):
+        return self.attacker.reconstruct_data(
+            a_gradient, extra_auxiliary_info=extra_auxiliary_info)

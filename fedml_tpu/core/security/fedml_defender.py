@@ -1,0 +1,55 @@
+"""FedMLDefender — defense orchestration singleton.
+
+Capability parity: reference `core/security/fedml_defender.py` (keyed on yaml
+enable_defense / defense_type; hooks defend_before/on/after_aggregation).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple
+
+
+class FedMLDefender:
+    _instance = None
+
+    def __init__(self) -> None:
+        self.is_enabled = False
+        self.defense_type: Optional[str] = None
+        self.defender = None
+
+    @classmethod
+    def get_instance(cls) -> "FedMLDefender":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def init(self, args: Any) -> None:
+        self.is_enabled = bool(getattr(args, "enable_defense", False))
+        self.defender = None
+        self.defense_type = None
+        if not self.is_enabled:
+            return
+        self.defense_type = str(getattr(args, "defense_type", "")).strip().lower()
+        from .defense import create_defender
+        self.defender = create_defender(self.defense_type, args)
+
+    def is_defense_enabled(self) -> bool:
+        return self.is_enabled and self.defender is not None
+
+    def defend_before_aggregation(
+        self, raw_client_grad_list: List[Tuple[float, Any]],
+        extra_auxiliary_info: Any = None,
+    ) -> List[Tuple[float, Any]]:
+        return self.defender.defend_before_aggregation(
+            raw_client_grad_list, extra_auxiliary_info)
+
+    def defend_on_aggregation(
+        self, raw_client_grad_list: List[Tuple[float, Any]],
+        base_aggregation_func: Callable = None,
+        extra_auxiliary_info: Any = None,
+    ) -> Any:
+        return self.defender.defend_on_aggregation(
+            raw_client_grad_list, base_aggregation_func, extra_auxiliary_info)
+
+    def defend_after_aggregation(self, global_model: Any) -> Any:
+        return self.defender.defend_after_aggregation(global_model)

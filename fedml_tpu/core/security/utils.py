@@ -1,0 +1,76 @@
+"""Shared tensor utilities for the security stack.
+
+TPU-first: client updates are flattened once into a single [N, D] matrix so
+robust-aggregation math (pairwise distances, medians, cosine similarity) is
+vectorized jnp — not per-client Python loops over state dicts as in the
+reference (`core/security/defense/*.py`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def tree_to_vector(tree: Any) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.concatenate(
+        [jnp.ravel(leaf).astype(jnp.float32) for leaf in leaves])
+
+
+def vector_to_tree(vec: jnp.ndarray, like: Any) -> Any:
+    leaves, treedef = jax.tree_util.tree_flatten(like)
+    out, off = [], 0
+    for leaf in leaves:
+        size = int(np.prod(np.shape(leaf))) if np.shape(leaf) else 1
+        out.append(jnp.reshape(vec[off:off + size],
+                               jnp.shape(leaf)).astype(jnp.result_type(leaf)))
+        off += size
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def grad_list_to_matrix(
+    raw_client_grad_list: Sequence[Tuple[float, Any]]
+) -> Tuple[jnp.ndarray, jnp.ndarray, Any]:
+    """[(n_k, pytree)] → (X [N,D], weights [N], template pytree)."""
+    weights = jnp.asarray([float(n) for n, _ in raw_client_grad_list],
+                          dtype=jnp.float32)
+    mat = jnp.stack([tree_to_vector(g) for _, g in raw_client_grad_list])
+    return mat, weights, raw_client_grad_list[0][1]
+
+
+def matrix_to_grad_list(
+    mat: jnp.ndarray, weights: jnp.ndarray, template: Any
+) -> List[Tuple[float, Any]]:
+    return [(float(w), vector_to_tree(mat[i], template))
+            for i, w in enumerate(np.asarray(weights))]
+
+
+def pairwise_sq_dists(mat: jnp.ndarray) -> jnp.ndarray:
+    """[N,D] → [N,N] squared euclidean distances (one matmul on the MXU)."""
+    sq = jnp.sum(mat * mat, axis=1)
+    d = sq[:, None] + sq[None, :] - 2.0 * (mat @ mat.T)
+    return jnp.maximum(d, 0.0)
+
+
+def tree_l2_norm(tree: Any) -> jnp.ndarray:
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                        for l in jax.tree_util.tree_leaves(tree)))
+
+
+def fabricate_fake_client_grads(n_clients: int = 4, dim: int = 10,
+                                seed: int = 0) -> List[Tuple[float, Any]]:
+    """Test fixture helper (reference `tests/security/utils.py` fabricates
+    client grad lists)."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(n_clients):
+        tree = {
+            "dense": {"kernel": jnp.asarray(rng.randn(dim, 3), jnp.float32),
+                      "bias": jnp.asarray(rng.randn(3), jnp.float32)}
+        }
+        out.append((float(rng.randint(5, 50)), tree))
+    return out

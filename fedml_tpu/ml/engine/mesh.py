@@ -1,0 +1,91 @@
+"""Device & mesh management — replaces reference `device/` + the device half
+of `ml/engine/ml_engine_adapter.py:77-211`.
+
+The reference maps MPI processes → GPUs via YAML matrices
+(`device/gpu_mapping_mpi.py:9-45`).  The TPU build instead builds ONE
+`jax.sharding.Mesh` over the available devices and names its axes after the
+parallelism strategies (clients/data/model/seq/expert/pipe).  Processes don't
+map to devices; shardings do.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...constants import AXIS_CLIENTS, AXIS_DATA
+
+
+def get_device_type(args: Any = None) -> str:
+    """'tpu' | 'gpu' | 'cpu' — reference `device/device.py:12`."""
+    want = getattr(args, "device_type", None) if args is not None else None
+    if want:
+        return str(want)
+    return jax.default_backend()
+
+
+def get_device(args: Any = None):
+    """First addressable device (reference `get_device`); in the TPU build
+    placement is normally expressed through shardings, not a device handle."""
+    return jax.devices()[0]
+
+
+def build_mesh(mesh_shape: Optional[Dict[str, int]] = None,
+               devices: Optional[Sequence[Any]] = None) -> Mesh:
+    """Build a named mesh.  ``mesh_shape`` maps axis name → size, e.g.
+    {"clients": 8} or {"data": 4, "model": 2}.  Size -1 means "all remaining
+    devices".  Default: 1-axis `clients` mesh over every device."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if not mesh_shape:
+        mesh_shape = {AXIS_CLIENTS: n}
+    names = list(mesh_shape.keys())
+    sizes = [int(s) for s in mesh_shape.values()]
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = max(n // max(known, 1), 1)
+    total = int(np.prod(sizes))
+    if total > n:
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} devices, "
+                         f"have {n}")
+    dev_array = np.asarray(devices[:total]).reshape(sizes)
+    mesh = Mesh(dev_array, axis_names=tuple(names))
+    logging.debug("mesh: %s over %d %s devices", dict(zip(names, sizes)),
+                  total, devices[0].platform)
+    return mesh
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def sharded_on(mesh: Mesh, axis: str, dim: int = 0) -> NamedSharding:
+    spec = [None] * (dim + 1)
+    spec[dim] = axis
+    return NamedSharding(mesh, P(*spec))
+
+
+class MeshManager:
+    """Lazily-built process-wide mesh (the `device.get_device(args)` analogue
+    in the 5-step launcher dance, SURVEY §1)."""
+
+    _instance: Optional["MeshManager"] = None
+
+    def __init__(self, args: Any = None) -> None:
+        self.args = args
+        shape = getattr(args, "mesh_shape", None) if args is not None else None
+        self.mesh = build_mesh(shape)
+
+    @classmethod
+    def get(cls, args: Any = None) -> "MeshManager":
+        if cls._instance is None:
+            cls._instance = cls(args)
+        return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._instance = None

@@ -1,0 +1,102 @@
+"""ModelBundle — uniform functional wrapper around flax modules.
+
+Replaces the reference's model↔engine seam (`ml_engine_adapter.py` model
+placement / state-dict handling): model state is one pytree
+``{"params": ..., "batch_stats"?: ...}``; the whole tree is what federated
+aggregation averages (matching the reference's state_dict averaging, which
+includes BN running stats).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+TASK_CLASSIFICATION = "classification"
+TASK_LM = "lm"                 # next-token prediction, logits [B, T, V]
+TASK_BINARY = "binary"         # logits [B] / [B,1]
+TASK_REGRESSION = "regression"
+
+
+@dataclasses.dataclass
+class ModelBundle:
+    module: Any                       # flax nn.Module
+    input_shape: Tuple[int, ...]      # per-example shape (no batch dim)
+    num_classes: int
+    task: str = TASK_CLASSIFICATION
+    input_dtype: Any = jnp.float32
+    name: str = "model"
+
+    # -- state ---------------------------------------------------------------
+    def init_variables(self, rng: jax.Array, batch_size: int = 2) -> Dict[str, Any]:
+        x = jnp.zeros((batch_size,) + tuple(self.input_shape), self.input_dtype)
+        variables = self.module.init({"params": rng, "dropout": rng}, x,
+                                     train=False)
+        return dict(variables)  # {"params":..., possibly "batch_stats":...}
+
+    @property
+    def has_batch_stats(self) -> bool:
+        return False  # resolved dynamically in apply(); kept for API clarity
+
+    # -- forward -------------------------------------------------------------
+    def apply(self, variables: Dict[str, Any], x: jnp.ndarray, train: bool,
+              rng: Optional[jax.Array] = None
+              ) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+        """Returns (logits, new_variables). Mutates batch_stats when training."""
+        rngs = {"dropout": rng} if rng is not None else None
+        if "batch_stats" in variables and train:
+            logits, mutated = self.module.apply(
+                variables, x, train=True, mutable=["batch_stats"], rngs=rngs)
+            new_vars = dict(variables)
+            new_vars["batch_stats"] = mutated["batch_stats"]
+            return logits, new_vars
+        logits = self.module.apply(variables, x, train=train, rngs=rngs)
+        return logits, variables
+
+    # -- loss / metrics -------------------------------------------------------
+    def loss(self, logits: jnp.ndarray, y: jnp.ndarray,
+             mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        return masked_loss(self.task, logits, y, mask)
+
+    def correct_count(self, logits: jnp.ndarray, y: jnp.ndarray,
+                      mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        if self.task == TASK_BINARY:
+            pred = (logits.reshape(y.shape) > 0).astype(jnp.int32)
+        elif self.task == TASK_LM:
+            pred = jnp.argmax(logits, axis=-1)
+        else:
+            pred = jnp.argmax(logits, axis=-1)
+        hit = (pred == y).astype(jnp.float32)
+        if mask is not None:
+            mask = mask.astype(jnp.float32)
+            while mask.ndim < hit.ndim:  # [B] example mask → [B, T] tokens
+                mask = mask[..., None]
+            hit = hit * mask
+        return jnp.sum(hit)
+
+
+def masked_loss(task: str, logits: jnp.ndarray, y: jnp.ndarray,
+                mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Mean loss over valid (mask=1) examples/tokens."""
+    if task == TASK_BINARY:
+        logits = logits.reshape(y.shape).astype(jnp.float32)
+        per = jnp.maximum(logits, 0) - logits * y + jnp.log1p(
+            jnp.exp(-jnp.abs(logits)))
+    elif task == TASK_REGRESSION:
+        per = jnp.square(logits.reshape(y.shape).astype(jnp.float32) - y)
+    else:  # classification & lm share softmax-CE with integer labels
+        logits = logits.astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(
+            logits, y[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        per = logz - gold
+    if mask is None:
+        return jnp.mean(per)
+    mask = mask.astype(jnp.float32)
+    while mask.ndim < per.ndim:  # [B] example mask → [B, T] token mask
+        mask = mask[..., None]
+    mask = jnp.broadcast_to(mask, per.shape)
+    return jnp.sum(per * mask) / jnp.maximum(jnp.sum(mask), 1.0)

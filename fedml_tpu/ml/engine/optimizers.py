@@ -1,0 +1,43 @@
+"""Optimizer builders (client-local and server/FedOpt) on optax.
+
+Reference parity: client_optimizer sgd|adam (`ml/trainer/
+my_model_trainer_classification.py:21-41`), FedOpt server optimizers
+(`simulation/sp/fedopt/optrepo.py` — server adam/yogi/adagrad/sgd on the
+pseudo-gradient).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import optax
+
+
+def build_client_optimizer(cfg: Any) -> optax.GradientTransformation:
+    name = str(getattr(cfg, "client_optimizer", "sgd")).lower()
+    lr = float(getattr(cfg, "learning_rate", 0.03))
+    wd = float(getattr(cfg, "weight_decay", 0.0) or 0.0)
+    momentum = float(getattr(cfg, "momentum", 0.0) or 0.0)
+    if name == "adam":
+        tx = optax.adam(lr)
+    elif name == "adamw":
+        tx = optax.adamw(lr, weight_decay=wd)
+        wd = 0.0
+    else:
+        tx = optax.sgd(lr, momentum=momentum if momentum > 0 else None)
+    if wd > 0.0:
+        tx = optax.chain(optax.add_decayed_weights(wd), tx)
+    return tx
+
+
+def build_server_optimizer(cfg: Any) -> optax.GradientTransformation:
+    name = str(getattr(cfg, "server_optimizer", "adam")).lower()
+    lr = float(getattr(cfg, "server_lr", 1e-3))
+    momentum = float(getattr(cfg, "server_momentum", 0.9) or 0.0)
+    if name == "adam":
+        return optax.adam(lr)
+    if name == "yogi":
+        return optax.yogi(lr)
+    if name == "adagrad":
+        return optax.adagrad(lr)
+    return optax.sgd(lr, momentum=momentum if momentum > 0 else None)

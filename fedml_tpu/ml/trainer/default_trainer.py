@@ -1,0 +1,86 @@
+"""Default ClientTrainer / ServerAggregator implementations.
+
+Capability parity: reference `ml/trainer/my_model_trainer_classification.py`
+(+ nwp/tag variants) and `ml/aggregator/my_server_aggregator*.py` — but one
+implementation serves every task because loss/metrics live in ModelBundle.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ...core.alg_frame.client_trainer import ClientTrainer
+from ...core.alg_frame.server_aggregator import ServerAggregator
+from ..engine.local_update import build_eval_step, build_local_update, make_batches
+from ..engine.model_bundle import ModelBundle
+
+
+def batches_for(data: Tuple[np.ndarray, np.ndarray], batch_size: int,
+                num_batches: int, input_dtype=None) -> Dict:
+    x, y = data
+    return make_batches(x, y, batch_size, num_batches, dtype=input_dtype)
+
+
+class DefaultClientTrainer(ClientTrainer):
+    """Wraps the jitted local-update engine for host-driven planes."""
+
+    def __init__(self, bundle: ModelBundle, args: Any) -> None:
+        super().__init__(bundle, args)
+        self.bundle = bundle
+        self.local_update = jax.jit(build_local_update(bundle, args))
+        self.batch_size = int(getattr(args, "batch_size", 32))
+        self.num_batches: Optional[int] = None  # fixed by the plane for
+        # compile reuse across clients (SURVEY §7 hard part (b))
+        self.algo_state: Dict[str, Any] = {}
+        self.last_metrics: Dict[str, Any] = {}
+        self.algo_out: Dict[str, Any] = {}
+        self._eval = jax.jit(build_eval_step(bundle))
+
+    def set_num_batches(self, nb: int) -> None:
+        self.num_batches = int(nb)
+
+    def train(self, train_data, device=None, args=None) -> Dict[str, Any]:
+        args = args or self.args
+        nb = self.num_batches or max(
+            1, -(-len(train_data[1]) // self.batch_size))
+        batches = batches_for(train_data, self.batch_size, nb,
+                              self.bundle.input_dtype)
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(self.rng_seed), self.id)
+        new_vars, algo_out, metrics = self.local_update(
+            self.params, batches, rng, self.algo_state or None)
+        self.params = new_vars
+        self.algo_out = algo_out
+        self.last_metrics = {k: float(v) for k, v in metrics.items()}
+        return self.last_metrics
+
+    def test(self, test_data, device=None, args=None) -> Dict[str, Any]:
+        nb = max(1, -(-len(test_data[1]) // self.batch_size))
+        batches = batches_for(test_data, self.batch_size, nb,
+                              self.bundle.input_dtype)
+        out = self._eval(self.params, batches)
+        n = max(float(out["n"]), 1.0)
+        return {"test_loss": float(out["loss_sum"]) / n,
+                "test_acc": float(out["correct"]) / n,
+                "test_total": n}
+
+
+class DefaultServerAggregator(ServerAggregator):
+    def __init__(self, bundle: ModelBundle, args: Any) -> None:
+        super().__init__(bundle, args)
+        self.bundle = bundle
+        self.batch_size = int(getattr(args, "batch_size", 32))
+        self._eval = jax.jit(build_eval_step(bundle))
+
+    def test(self, test_data, device=None, args=None) -> Dict[str, Any]:
+        nb = max(1, -(-len(test_data[1]) // self.batch_size))
+        batches = batches_for(test_data, self.batch_size, nb,
+                              self.bundle.input_dtype)
+        out = self._eval(self.params, batches)
+        n = max(float(out["n"]), 1.0)
+        return {"test_loss": float(out["loss_sum"]) / n,
+                "test_acc": float(out["correct"]) / n,
+                "test_total": n}

@@ -1,0 +1,337 @@
+"""CV model zoo in flax.linen.
+
+Capability parity with reference `model/`:
+ - LogisticRegression            (`model/linear/lr.py`)
+ - FedAvg-paper CNNs             (`model/cv/cnn.py` — CNN_DropOut etc.)
+ - CIFAR ResNet-20/56            (`model/cv/resnet.py`, resnet56/resnet20)
+ - ResNet-18 with GroupNorm      (`model/cv/resnet_gn.py` — FL-friendly norm)
+ - MobileNet (v1) / MobileNetV3  (`model/cv/mobilenet.py`, `mobilenet_v3.py`)
+ - EfficientNet-B0               (`model/cv/efficientnet.py`)
+
+TPU-first notes: NHWC layout (XLA-native on TPU), optional bfloat16 compute
+with fp32 params/norm statistics, GroupNorm offered everywhere BatchNorm
+exists because FL aggregation of BN running stats is statistically fragile —
+the reference averages BN buffers inside state_dicts; we support both and
+default resnet56 to BN for parity.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+ModuleDef = Any
+
+
+class LogisticRegression(nn.Module):
+    num_classes: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        param_dtype=jnp.float32)(x).astype(jnp.float32)
+
+
+class FedAvgCNN(nn.Module):
+    """McMahan et al. CNN: 2×(conv5x5 + maxpool) + fc512 (MNIST/FEMNIST) —
+    reference `model/cv/cnn.py` CNN_DropOut / CNN_OriginalFedAvg."""
+
+    num_classes: int = 10
+    only_digits: bool = True
+    dropout: float = 0.25
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(512, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        param_dtype=jnp.float32)(x).astype(jnp.float32)
+
+
+class CIFARCNN(nn.Module):
+    """3-block CIFAR CNN (reference `model/cv/cnn.py` CNN_WEB / simple-cnn)."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        for feat in (32, 64, 64):
+            x = nn.Conv(feat, (3, 3), padding="SAME", dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        param_dtype=jnp.float32)(x).astype(jnp.float32)
+
+
+def _norm(norm: str, train: bool, dtype) -> Callable:
+    if norm == "gn":
+        return partial(nn.GroupNorm, num_groups=2, dtype=dtype,
+                       param_dtype=jnp.float32)
+    return partial(nn.BatchNorm, use_running_average=not train, momentum=0.9,
+                   epsilon=1e-5, dtype=dtype, param_dtype=jnp.float32)
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    stride: int = 1
+    norm: str = "bn"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = _norm(self.norm, train, self.dtype)
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), strides=(self.stride, self.stride),
+                    padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype)(y)
+        y = norm()(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1),
+                               strides=(self.stride, self.stride),
+                               use_bias=False, dtype=self.dtype)(residual)
+            residual = norm()(residual)
+        return nn.relu(residual + y)
+
+
+class CIFARResNet(nn.Module):
+    """ResNet-20/56 for 32×32 inputs (reference `model/cv/resnet.py`):
+    3 stages of n blocks, 16/32/64 filters, n = (depth-2)/6."""
+
+    depth: int = 56
+    num_classes: int = 10
+    norm: str = "bn"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        n = (self.depth - 2) // 6
+        norm = _norm(self.norm, train, self.dtype)
+        x = x.astype(self.dtype)
+        x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype)(x)
+        x = norm()(x)
+        x = nn.relu(x)
+        for stage, filters in enumerate((16, 32, 64)):
+            for block in range(n):
+                stride = 2 if (stage > 0 and block == 0) else 1
+                x = BasicBlock(filters, stride, self.norm, self.dtype)(
+                    x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        param_dtype=jnp.float32)(x).astype(jnp.float32)
+
+
+class ResNet18(nn.Module):
+    """ResNet-18 with GroupNorm (reference `model/cv/resnet_gn.py`,
+    `model_hub.py` resnet18_gn) for ImageNet-ish inputs; also handles 32×32."""
+
+    num_classes: int = 10
+    norm: str = "gn"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = _norm(self.norm, train, self.dtype)
+        x = x.astype(self.dtype)
+        small = x.shape[1] <= 64
+        if small:
+            x = nn.Conv(64, (3, 3), padding="SAME", use_bias=False,
+                        dtype=self.dtype)(x)
+        else:
+            x = nn.Conv(64, (7, 7), strides=(2, 2), padding="SAME",
+                        use_bias=False, dtype=self.dtype)(x)
+        x = norm()(x)
+        x = nn.relu(x)
+        if not small:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, filters in enumerate((64, 128, 256, 512)):
+            for block in range(2):
+                stride = 2 if (stage > 0 and block == 0) else 1
+                x = BasicBlock(filters, stride, self.norm, self.dtype)(
+                    x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        param_dtype=jnp.float32)(x).astype(jnp.float32)
+
+
+class DepthwiseSeparable(nn.Module):
+    filters: int
+    stride: int = 1
+    norm: str = "bn"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = _norm(self.norm, train, self.dtype)
+        in_ch = x.shape[-1]
+        x = nn.Conv(in_ch, (3, 3), strides=(self.stride, self.stride),
+                    padding="SAME", feature_group_count=in_ch, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = norm()(x)
+        x = nn.relu(x)
+        x = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        x = norm()(x)
+        return nn.relu(x)
+
+
+class MobileNetV1(nn.Module):
+    """MobileNet (reference `model/cv/mobilenet.py`)."""
+
+    num_classes: int = 10
+    width: float = 1.0
+    norm: str = "bn"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = _norm(self.norm, train, self.dtype)
+        c = lambda f: max(8, int(f * self.width))
+        x = x.astype(self.dtype)
+        stride0 = 1 if x.shape[1] <= 64 else 2
+        x = nn.Conv(c(32), (3, 3), strides=(stride0, stride0), padding="SAME",
+                    use_bias=False, dtype=self.dtype)(x)
+        x = norm()(x)
+        x = nn.relu(x)
+        plan = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+                *[(512, 1)] * 5, (1024, 2), (1024, 1)]
+        for filters, stride in plan:
+            x = DepthwiseSeparable(c(filters), stride, self.norm, self.dtype)(
+                x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        param_dtype=jnp.float32)(x).astype(jnp.float32)
+
+
+class SEBlock(nn.Module):
+    reduce: int = 4
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        ch = x.shape[-1]
+        s = jnp.mean(x, axis=(1, 2))
+        s = nn.relu(nn.Dense(ch // self.reduce, dtype=self.dtype)(s))
+        s = nn.hard_sigmoid(nn.Dense(ch, dtype=self.dtype)(s))
+        return x * s[:, None, None, :]
+
+
+class InvertedResidual(nn.Module):
+    filters: int
+    expand: int
+    kernel: int = 3
+    stride: int = 1
+    se: bool = False
+    act: str = "hswish"
+    norm: str = "bn"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = _norm(self.norm, train, self.dtype)
+        act = nn.hard_swish if self.act == "hswish" else nn.relu
+        inp = x
+        hidden = self.expand
+        y = nn.Conv(hidden, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        y = act(norm()(y))
+        y = nn.Conv(hidden, (self.kernel, self.kernel),
+                    strides=(self.stride, self.stride), padding="SAME",
+                    feature_group_count=hidden, use_bias=False,
+                    dtype=self.dtype)(y)
+        y = act(norm()(y))
+        if self.se:
+            y = SEBlock(dtype=self.dtype)(y)
+        y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(y)
+        y = norm()(y)
+        if self.stride == 1 and inp.shape[-1] == self.filters:
+            y = y + inp
+        return y
+
+
+class MobileNetV3Small(nn.Module):
+    """MobileNetV3-small (reference `model/cv/mobilenet_v3.py`)."""
+
+    num_classes: int = 10
+    norm: str = "bn"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = _norm(self.norm, train, self.dtype)
+        x = x.astype(self.dtype)
+        stride0 = 1 if x.shape[1] <= 64 else 2
+        x = nn.Conv(16, (3, 3), strides=(stride0, stride0), padding="SAME",
+                    use_bias=False, dtype=self.dtype)(x)
+        x = nn.hard_swish(norm()(x))
+        # (filters, expand, kernel, stride, se, act)
+        plan = [(16, 16, 3, 2, True, "relu"), (24, 72, 3, 2, False, "relu"),
+                (24, 88, 3, 1, False, "relu"), (40, 96, 5, 2, True, "hswish"),
+                (40, 240, 5, 1, True, "hswish"), (40, 240, 5, 1, True, "hswish"),
+                (48, 120, 5, 1, True, "hswish"), (48, 144, 5, 1, True, "hswish"),
+                (96, 288, 5, 2, True, "hswish"), (96, 576, 5, 1, True, "hswish"),
+                (96, 576, 5, 1, True, "hswish")]
+        for f, e, k, s, se, act in plan:
+            x = InvertedResidual(f, e, k, s, se, act, self.norm, self.dtype)(
+                x, train=train)
+        x = nn.Conv(576, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        x = nn.hard_swish(norm()(x))
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.hard_swish(nn.Dense(1024, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        param_dtype=jnp.float32)(x).astype(jnp.float32)
+
+
+class EfficientNetB0(nn.Module):
+    """EfficientNet-B0 (reference `model/cv/efficientnet.py`), MBConv plan."""
+
+    num_classes: int = 10
+    norm: str = "bn"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = _norm(self.norm, train, self.dtype)
+        x = x.astype(self.dtype)
+        stride0 = 1 if x.shape[1] <= 64 else 2
+        x = nn.Conv(32, (3, 3), strides=(stride0, stride0), padding="SAME",
+                    use_bias=False, dtype=self.dtype)(x)
+        x = nn.swish(norm()(x))
+        # (filters, expand_ratio, kernel, stride, repeats)
+        plan = [(16, 1, 3, 1, 1), (24, 6, 3, 2, 2), (40, 6, 5, 2, 2),
+                (80, 6, 3, 2, 3), (112, 6, 5, 1, 3), (192, 6, 5, 2, 4),
+                (320, 6, 3, 1, 1)]
+        for f, er, k, s, reps in plan:
+            for r in range(reps):
+                x = InvertedResidual(
+                    f, max(x.shape[-1] * er, f), k, s if r == 0 else 1,
+                    se=True, act="hswish", norm=self.norm, dtype=self.dtype)(
+                        x, train=train)
+        x = nn.Conv(1280, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        x = nn.swish(norm()(x))
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        param_dtype=jnp.float32)(x).astype(jnp.float32)

@@ -1,0 +1,103 @@
+"""Model hub — ``create(args, output_dim)`` dispatch.
+
+Capability parity: reference `model/model_hub.py:19-90` (lr, cnn,
+resnet18_gn, rnn, resnet56/resnet20, mobilenet, mobilenet_v3, efficientnet,
+darts, gan, mnn-mobile).  Returns a ``ModelBundle`` wrapping the flax module
+plus task/shape metadata the engine needs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..ml.engine.model_bundle import (
+    TASK_BINARY,
+    TASK_CLASSIFICATION,
+    TASK_LM,
+    ModelBundle,
+)
+from .cv import (
+    CIFARCNN,
+    CIFARResNet,
+    EfficientNetB0,
+    FedAvgCNN,
+    LogisticRegression,
+    MobileNetV1,
+    MobileNetV3Small,
+    ResNet18,
+)
+from .nlp import CharLSTM, StackOverflowLSTM, TinyTransformerLM, ViT
+
+# dataset → (input_shape, default_classes, task)
+_DATASET_SHAPES = {
+    "mnist": ((28, 28, 1), 10, TASK_CLASSIFICATION),
+    "femnist": ((28, 28, 1), 62, TASK_CLASSIFICATION),
+    "synthetic": ((60,), 10, TASK_CLASSIFICATION),
+    "cifar10": ((32, 32, 3), 10, TASK_CLASSIFICATION),
+    "cifar100": ((32, 32, 3), 100, TASK_CLASSIFICATION),
+    "fed_cifar100": ((32, 32, 3), 100, TASK_CLASSIFICATION),
+    "cinic10": ((32, 32, 3), 10, TASK_CLASSIFICATION),
+    "shakespeare": ((80,), 90, TASK_LM),
+    "fed_shakespeare": ((80,), 90, TASK_LM),
+    "stackoverflow_nwp": ((20,), 10004, TASK_LM),
+    "stackoverflow_lr": ((10004,), 500, TASK_CLASSIFICATION),
+    "adult": ((105,), 2, TASK_BINARY),
+}
+
+
+def dataset_meta(dataset: str) -> Tuple[Tuple[int, ...], int, str]:
+    return _DATASET_SHAPES.get(str(dataset).lower(), ((32, 32, 3), 10,
+                                                      TASK_CLASSIFICATION))
+
+
+def create(args: Any, output_dim: Optional[int] = None) -> ModelBundle:
+    name = str(getattr(args, "model", "lr")).lower()
+    dataset = str(getattr(args, "dataset", "mnist")).lower()
+    input_shape, default_dim, task = dataset_meta(dataset)
+    num_classes = int(output_dim or default_dim)
+    dtype = jnp.bfloat16 if str(
+        getattr(args, "compute_dtype", "bfloat16")) == "bfloat16" else jnp.float32
+    input_dtype = (jnp.int32 if task == TASK_LM else jnp.float32)
+
+    if name == "lr":
+        module = LogisticRegression(num_classes, dtype=dtype)
+        if task == TASK_LM:  # lr on text = bag-of-words; keep classification
+            task = TASK_CLASSIFICATION
+    elif name == "cnn":
+        if len(input_shape) >= 3 and input_shape[-1] == 3:
+            module = CIFARCNN(num_classes, dtype=dtype)
+        else:
+            module = FedAvgCNN(num_classes, dtype=dtype)
+    elif name in ("resnet56", "resnet20", "resnet32"):
+        depth = int(name.replace("resnet", ""))
+        module = CIFARResNet(depth=depth, num_classes=num_classes, dtype=dtype,
+                             norm=str(getattr(args, "norm", "bn")))
+    elif name in ("resnet18", "resnet18_gn"):
+        module = ResNet18(num_classes=num_classes, dtype=dtype,
+                          norm="gn" if name.endswith("gn") else "bn")
+    elif name == "mobilenet":
+        module = MobileNetV1(num_classes=num_classes, dtype=dtype)
+    elif name == "mobilenet_v3":
+        module = MobileNetV3Small(num_classes=num_classes, dtype=dtype)
+    elif name == "efficientnet":
+        module = EfficientNetB0(num_classes=num_classes, dtype=dtype)
+    elif name == "rnn":
+        if dataset.startswith("stackoverflow"):
+            module = StackOverflowLSTM(vocab_size=num_classes, dtype=dtype)
+        else:
+            module = CharLSTM(vocab_size=num_classes, dtype=dtype)
+        task = TASK_LM
+    elif name in ("transformer", "bert_tiny", "bert-tiny"):
+        module = TinyTransformerLM(vocab_size=num_classes, dtype=dtype)
+        task = TASK_LM
+    elif name in ("vit", "vit_tiny", "vit-tiny"):
+        module = ViT(num_classes=num_classes, dtype=dtype,
+                     layers=int(getattr(args, "vit_layers", 6)))
+    else:
+        raise ValueError(f"unknown model {name!r}")
+
+    return ModelBundle(module=module, input_shape=input_shape,
+                       num_classes=num_classes, task=task,
+                       input_dtype=input_dtype, name=name)

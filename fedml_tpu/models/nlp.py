@@ -1,0 +1,152 @@
+"""NLP model zoo in flax.linen.
+
+Capability parity with reference `model/nlp/`:
+ - char-RNN for (fed_)shakespeare       (`model/nlp/rnn.py` RNN_OriginalFedAvg:
+   embed(8) → 2×LSTM(256) → dense, vocab 90)
+ - stackoverflow NWP LSTM               (RNN_StackOverFlow: embed 96 →
+   LSTM(670) → dense(96) → dense(vocab))
+ - stackoverflow_lr tag logistic reg    (`model/linear/lr.py` usage)
+ - BERT-tiny-style transformer encoder  (fednlp transformer models) — used by
+   the BASELINE config "FedOpt/FedProx BERT-tiny on Fed-Shakespeare".
+
+TPU-first: LSTMs run as ``nn.RNN`` (lax.scan under the hood); the transformer
+is standard pre-LN with learned positions, bfloat16-friendly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class CharLSTM(nn.Module):
+    """Shakespeare next-char model (reference RNN_OriginalFedAvg)."""
+
+    vocab_size: int = 90
+    embed_dim: int = 8
+    hidden: int = 256
+    layers: int = 2
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        # x: [B, T] int tokens → logits [B, T, V]
+        h = nn.Embed(self.vocab_size, self.embed_dim,
+                     param_dtype=jnp.float32)(x.astype(jnp.int32))
+        h = h.astype(self.dtype)
+        for _ in range(self.layers):
+            h = nn.RNN(nn.OptimizedLSTMCell(self.hidden, dtype=self.dtype))(h)
+        return nn.Dense(self.vocab_size, dtype=self.dtype,
+                        param_dtype=jnp.float32)(h).astype(jnp.float32)
+
+
+class StackOverflowLSTM(nn.Module):
+    """Next-word-prediction model (reference RNN_StackOverFlow)."""
+
+    vocab_size: int = 10004
+    embed_dim: int = 96
+    hidden: int = 670
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Embed(self.vocab_size, self.embed_dim,
+                     param_dtype=jnp.float32)(x.astype(jnp.int32))
+        h = h.astype(self.dtype)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden, dtype=self.dtype))(h)
+        h = nn.Dense(self.embed_dim, dtype=self.dtype)(h)
+        return nn.Dense(self.vocab_size, dtype=self.dtype,
+                        param_dtype=jnp.float32)(h).astype(jnp.float32)
+
+
+class TransformerBlock(nn.Module):
+    dim: int
+    heads: int
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    causal: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        mask = None
+        if self.causal:
+            t = x.shape[1]
+            mask = jnp.tril(jnp.ones((1, 1, t, t), bool))
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=self.heads, dtype=self.dtype,
+            dropout_rate=self.dropout, deterministic=not train)(y, y, mask=mask)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = nn.Dense(self.dim * self.mlp_ratio, dtype=self.dtype)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(self.dim, dtype=self.dtype)(y)
+        return x + y
+
+
+class TinyTransformerLM(nn.Module):
+    """BERT-tiny-scale causal LM (dim 128, 2 layers, 2 heads) for the
+    Fed-Shakespeare BASELINE config."""
+
+    vocab_size: int = 90
+    dim: int = 128
+    layers: int = 2
+    heads: int = 2
+    max_len: int = 512
+    dropout: float = 0.1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(jnp.int32)
+        t = x.shape[1]
+        h = nn.Embed(self.vocab_size, self.dim, param_dtype=jnp.float32)(x)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (self.max_len, self.dim), jnp.float32)
+        h = (h + pos[:t][None]).astype(self.dtype)
+        h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        for _ in range(self.layers):
+            h = TransformerBlock(self.dim, self.heads, causal=True,
+                                 dropout=self.dropout, dtype=self.dtype)(
+                                     h, train=train)
+        h = nn.LayerNorm(dtype=self.dtype)(h)
+        return nn.Dense(self.vocab_size, dtype=self.dtype,
+                        param_dtype=jnp.float32)(h).astype(jnp.float32)
+
+
+class ViT(nn.Module):
+    """ViT-Tiny for the cross-silo Fed-CIFAR100 BASELINE config
+    (patch 4 for 32×32 inputs; dim 192, 12 heads→3, depth 12→ small)."""
+
+    num_classes: int = 100
+    patch: int = 4
+    dim: int = 192
+    layers: int = 12
+    heads: int = 3
+    dropout: float = 0.1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.dim, (self.patch, self.patch),
+                    strides=(self.patch, self.patch), dtype=self.dtype)(x)
+        b, h, w, c = x.shape
+        x = x.reshape(b, h * w, c)
+        cls = self.param("cls", nn.initializers.zeros, (1, 1, self.dim),
+                         jnp.float32)
+        x = jnp.concatenate([jnp.tile(cls.astype(self.dtype), (b, 1, 1)), x],
+                            axis=1)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (h * w + 1, self.dim), jnp.float32)
+        x = x + pos[None].astype(self.dtype)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        for _ in range(self.layers):
+            x = TransformerBlock(self.dim, self.heads, dropout=self.dropout,
+                                 dtype=self.dtype)(x, train=train)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        param_dtype=jnp.float32)(x[:, 0]).astype(jnp.float32)

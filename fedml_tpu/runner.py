@@ -1,0 +1,60 @@
+"""FedMLRunner — dispatch on training_type × backend.
+
+Capability parity: reference `runner.py:19-183` (simulation / cross_silo /
+cross_device / cross_cloud / serving × sp / MPI / NCCL / MQTT_S3 / GRPC...).
+
+TPU-era backends: sp (sequential debug), parrot (vectorized single-host),
+mesh (shard_map over a clients axis), and the message-driven cross-silo plane
+over INPROC/GRPC/MQTT_S3.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+from .constants import (
+    SIMULATION_BACKEND_MESH,
+    SIMULATION_BACKEND_PARROT,
+    SIMULATION_BACKEND_SP,
+    TRAINING_PLATFORM_CROSS_SILO,
+    TRAINING_PLATFORM_SIMULATION,
+)
+
+
+class FedMLRunner:
+    def __init__(self, args: Any, device: Any, dataset: Tuple, model: Any,
+                 client_trainer: Optional[Any] = None,
+                 server_aggregator: Optional[Any] = None) -> None:
+        self.args = args
+        self.runner = self._build(args, device, dataset, model,
+                                  client_trainer, server_aggregator)
+
+    def _build(self, args, device, dataset, model, client_trainer,
+               server_aggregator):
+        ttype = str(getattr(args, "training_type", "simulation"))
+        backend = str(getattr(args, "backend", "sp"))
+        if ttype == TRAINING_PLATFORM_SIMULATION:
+            if backend == SIMULATION_BACKEND_SP:
+                from .simulation.sp.fed_api import FedSimAPI
+                return FedSimAPI(args, device, dataset, model,
+                                 client_trainer, server_aggregator)
+            if backend == SIMULATION_BACKEND_PARROT:
+                from .simulation.parrot.parrot_api import ParrotAPI
+                return ParrotAPI(args, device, dataset, model)
+            if backend == SIMULATION_BACKEND_MESH:
+                from .simulation.parrot.parrot_api import ParrotAPI
+                return ParrotAPI(args, device, dataset, model, use_mesh=True)
+            raise ValueError(f"unknown simulation backend {backend!r}")
+        if ttype == TRAINING_PLATFORM_CROSS_SILO:
+            try:
+                from .cross_silo.runner import build_cross_silo_runner
+            except ImportError as e:
+                raise NotImplementedError(
+                    "cross_silo plane is not available in this build") from e
+            return build_cross_silo_runner(args, device, dataset, model,
+                                           client_trainer, server_aggregator)
+        raise ValueError(f"unknown training_type {ttype!r}")
+
+    def run(self):
+        return self.runner.train() if hasattr(self.runner, "train") \
+            else self.runner.run()

@@ -1,0 +1,258 @@
+"""SP (single-process) simulation — the Parrot sequential loop.
+
+Capability parity: reference `simulation/sp/fedavg/fedavg_api.py:14-211`
+(per-round client sampling with ``np.random.seed(round_idx)`` :127-136, local
+train, weighted aggregate :144-159, periodic eval :110-121) generalized to
+every federated optimizer the reference ships under `simulation/sp/*`:
+FedAvg, FedOpt, FedProx, FedNova, FedDyn, SCAFFOLD, Mime.
+
+Server-side algorithm state (FedOpt optimizer state, SCAFFOLD c_global,
+FedDyn h, Mime momentum) is pure pytree math, jit-compiled where it counts.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ...constants import (
+    FED_OPT_FEDDYN,
+    FED_OPT_FEDNOVA,
+    FED_OPT_FEDOPT,
+    FED_OPT_MIME,
+    FED_OPT_SCAFFOLD,
+)
+from ...core import mlops
+from ...core.alg_frame.context import Context
+from ...ml.engine.optimizers import build_server_optimizer
+from ...ml.trainer.default_trainer import (
+    DefaultClientTrainer,
+    DefaultServerAggregator,
+)
+
+
+def _tree_zeros_like(t):
+    return jax.tree_util.tree_map(jnp.zeros_like, t)
+
+
+class FedSimAPI:
+    """One object drives the whole simulated federation."""
+
+    def __init__(self, args: Any, device: Any, dataset: Tuple, bundle: Any,
+                 client_trainer: Optional[DefaultClientTrainer] = None,
+                 server_aggregator: Optional[DefaultServerAggregator] = None):
+        self.args = args
+        self.device = device
+        (self.train_num, self.test_num, self.train_global, self.test_global,
+         self.local_num_dict, self.train_data_local_dict,
+         self.test_data_local_dict, self.class_num) = dataset
+        self.bundle = bundle
+        self.algo = str(getattr(args, "federated_optimizer", "FedAvg"))
+
+        self.trainer = client_trainer or DefaultClientTrainer(bundle, args)
+        self.aggregator = server_aggregator or DefaultServerAggregator(
+            bundle, args)
+
+        rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0) or 0))
+        self.global_vars = bundle.init_variables(
+            rng, batch_size=min(int(getattr(args, "batch_size", 32)), 8))
+        self.aggregator.set_model_params(self.global_vars)
+
+        # one batch geometry for every client → one compile (SURVEY §7 (b))
+        bs = int(getattr(args, "batch_size", 32))
+        max_n = max(self.local_num_dict.values()) if self.local_num_dict else bs
+        self.num_batches = max(1, -(-int(max_n) // bs))
+        self.trainer.set_num_batches(self.num_batches)
+
+        # server-side algorithm state
+        self.server_tx: Optional[optax.GradientTransformation] = None
+        self.server_opt_state = None
+        if self.algo == FED_OPT_FEDOPT:
+            self.server_tx = build_server_optimizer(args)
+            self.server_opt_state = self.server_tx.init(
+                self.global_vars["params"])
+        self.c_global = None
+        self.c_locals: Dict[int, Any] = {}
+        if self.algo == FED_OPT_SCAFFOLD:
+            self.c_global = _tree_zeros_like(self.global_vars["params"])
+        self.feddyn_h = None
+        self.feddyn_lambdas: Dict[int, Any] = {}
+        if self.algo == FED_OPT_FEDDYN:
+            self.feddyn_h = _tree_zeros_like(self.global_vars["params"])
+        self.mime_momentum = None
+        if self.algo == FED_OPT_MIME:
+            self.mime_momentum = _tree_zeros_like(self.global_vars["params"])
+
+        self.metrics_history: List[Dict[str, Any]] = []
+
+    # -- sampling (reference :127-136) --------------------------------------
+    def _client_sampling(self, round_idx: int) -> List[int]:
+        total = int(self.args.client_num_in_total)
+        per_round = int(self.args.client_num_per_round)
+        if total == per_round:
+            return list(range(total))
+        np.random.seed(round_idx)  # deliberate reference parity: reproducible
+        return [int(c) for c in
+                np.random.choice(range(total), per_round, replace=False)]
+
+    # -- algorithm state plumbing -------------------------------------------
+    def _algo_state_for(self, cid: int) -> Dict[str, Any]:
+        if self.algo == FED_OPT_SCAFFOLD:
+            if cid not in self.c_locals:
+                self.c_locals[cid] = _tree_zeros_like(
+                    self.global_vars["params"])
+            return {"c_global": self.c_global, "c_local": self.c_locals[cid]}
+        if self.algo == FED_OPT_FEDDYN:
+            if cid not in self.feddyn_lambdas:
+                self.feddyn_lambdas[cid] = _tree_zeros_like(
+                    self.global_vars["params"])
+            return {"feddyn_lambda": self.feddyn_lambdas[cid]}
+        if self.algo == FED_OPT_MIME:
+            return {"server_momentum": self.mime_momentum}
+        return {}
+
+    # -- the round loop ------------------------------------------------------
+    def train(self) -> Dict[str, Any]:
+        comm_rounds = int(self.args.comm_round)
+        final_metrics: Dict[str, Any] = {}
+        for round_idx in range(comm_rounds):
+            t0 = time.time()
+            client_ids = self._client_sampling(round_idx)
+            logging.info("round %d clients %s", round_idx, client_ids)
+            results: List[Tuple[float, Any]] = []
+            algo_outs: List[Tuple[int, float, Dict[str, Any]]] = []
+            with mlops.span("train", round_idx):
+                for cid in client_ids:
+                    self.trainer.set_id(cid)
+                    self.trainer.update_dataset(
+                        self.train_data_local_dict[cid],
+                        self.test_data_local_dict[cid],
+                        self.local_num_dict[cid])
+                    self.trainer.set_model_params(self.global_vars)
+                    self.trainer.algo_state = self._algo_state_for(cid)
+                    self.trainer.on_before_local_training(
+                        self.trainer.local_train_dataset, self.device,
+                        self.args)
+                    self.trainer.train(self.trainer.local_train_dataset,
+                                       self.device, self.args)
+                    self.trainer.on_after_local_training(
+                        self.trainer.local_train_dataset, self.device,
+                        self.args)
+                    n_k = float(self.local_num_dict[cid])
+                    results.append((n_k, self.trainer.get_model_params()))
+                    algo_outs.append((cid, n_k, self.trainer.algo_out))
+
+            # publish round context BEFORE aggregation so history-aware
+            # defenses (foolsgold/crossround) and contribution assessment
+            # see the correct client ids
+            Context().add(Context.KEY_CLIENT_ID_LIST_IN_THIS_ROUND, client_ids)
+            Context().add(Context.KEY_CLIENT_MODEL_LIST, results)
+
+            with mlops.span("agg", round_idx):
+                self.global_vars = self._server_update(
+                    round_idx, client_ids, results, algo_outs)
+                self.aggregator.set_model_params(self.global_vars)
+
+            freq = int(getattr(self.args, "frequency_of_the_test", 5) or 5)
+            if round_idx % freq == 0 or round_idx == comm_rounds - 1:
+                metrics = self.aggregator.test(self.test_global, self.device,
+                                               self.args)
+                metrics["round"] = round_idx
+                metrics["round_time"] = time.time() - t0
+                ctx = Context()
+                ctx.add(Context.KEY_METRICS_ON_LAST_ROUND,
+                        self.metrics_history[-1] if self.metrics_history
+                        else metrics)
+                ctx.add(Context.KEY_METRICS_ON_AGGREGATED_MODEL, metrics)
+                ctx.add(Context.KEY_TEST_DATA, self.test_global)
+                if getattr(self.args, "contribution_alg", None):
+                    self.aggregator.assess_contribution()
+                self.metrics_history.append(metrics)
+                final_metrics = metrics
+                mlops.log({"round": round_idx, **metrics})
+                logging.info("round %d: %s", round_idx, metrics)
+            mlops.log_round_info(comm_rounds, round_idx)
+        if getattr(self.args, "contribution_alg", None):
+            final_metrics["contributions"] = (
+                self.aggregator.final_contribution_assigned_by_shapley)
+        return final_metrics
+
+    # -- server aggregation per algorithm ------------------------------------
+    def _server_update(self, round_idx: int, client_ids: List[int],
+                       results: List[Tuple[float, Any]],
+                       algo_outs: List[Tuple[int, float, Dict[str, Any]]]):
+        raw = self.aggregator.on_before_aggregation(results)
+
+        if self.algo == FED_OPT_SCAFFOLD:
+            for cid, _, out in algo_outs:
+                self.c_locals[cid] = out["c_local"]
+            n_total = float(self.args.client_num_in_total)
+            avg_vars = self.aggregator.aggregate(raw)
+            if isinstance(avg_vars, tuple):  # not the SCAFFOLD pair path here
+                avg_vars = avg_vars[0]
+            c_deltas = [out["c_delta"] for _, _, out in algo_outs]
+            delta_sum = jax.tree_util.tree_map(
+                lambda *xs: sum(xs) / n_total, *c_deltas)
+            self.c_global = jax.tree_util.tree_map(
+                lambda c, d: c + d, self.c_global, delta_sum)
+            new_vars = avg_vars
+        elif self.algo == FED_OPT_FEDNOVA:
+            weights = np.array([n for _, n, _ in algo_outs], np.float64)
+            p = weights / weights.sum()
+            taus = np.array([float(out["tau"]) for _, _, out in algo_outs])
+            tau_eff = float((p * taus).sum())
+            lr = float(self.args.learning_rate)
+            ds = [out["nova_d"] for _, _, out in algo_outs]
+            d_avg = jax.tree_util.tree_map(
+                lambda *xs: sum(pk * x for pk, x in zip(p, xs)), *ds)
+            params = jax.tree_util.tree_map(
+                lambda w, d: w - tau_eff * lr * d,
+                self.global_vars["params"], d_avg)
+            avg_vars = self.aggregator.aggregate(raw)  # for model_state avg
+            new_vars = dict(avg_vars, params=params)
+        elif self.algo == FED_OPT_MIME:
+            avg_vars = self.aggregator.aggregate(raw)
+            grads = [(n, out["full_grad"]) for _, n, out in algo_outs]
+            from ...ml.aggregator.agg_operator import weighted_average
+            g = weighted_average(grads)
+            beta = float(getattr(self.args, "server_momentum", 0.9) or 0.9)
+            self.mime_momentum = jax.tree_util.tree_map(
+                lambda m, gg: beta * m + (1.0 - beta) * gg,
+                self.mime_momentum, g)
+            new_vars = avg_vars
+        elif self.algo == FED_OPT_FEDDYN:
+            for cid, _, out in algo_outs:
+                self.feddyn_lambdas[cid] = out["feddyn_lambda"]
+            alpha = float(getattr(self.args, "feddyn_alpha", 0.01) or 0.01)
+            avg_vars = self.aggregator.aggregate(raw)
+            m = float(len(results))
+            n_total = float(self.args.client_num_in_total)
+            delta = jax.tree_util.tree_map(
+                lambda avg, g: (avg - g) * (m / n_total),
+                avg_vars["params"], self.global_vars["params"])
+            self.feddyn_h = jax.tree_util.tree_map(
+                lambda h, d: h - alpha * d, self.feddyn_h, delta)
+            params = jax.tree_util.tree_map(
+                lambda avg, h: avg - h / alpha,
+                avg_vars["params"], self.feddyn_h)
+            new_vars = dict(avg_vars, params=params)
+        elif self.algo == FED_OPT_FEDOPT and self.server_tx is not None:
+            avg_vars = self.aggregator.aggregate(raw)
+            pseudo_grad = jax.tree_util.tree_map(
+                lambda g, a: g - a, self.global_vars["params"],
+                avg_vars["params"])
+            updates, self.server_opt_state = self.server_tx.update(
+                pseudo_grad, self.server_opt_state,
+                self.global_vars["params"])
+            params = optax.apply_updates(self.global_vars["params"], updates)
+            new_vars = dict(avg_vars, params=params)
+        else:
+            new_vars = self.aggregator.aggregate(raw)
+
+        return self.aggregator.on_after_aggregation(new_vars)

@@ -1,0 +1,64 @@
+"""Test config: run everything on a virtual 8-device CPU mesh so multi-chip
+sharding paths are exercised without TPU hardware (SURVEY §4 implication)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+# the axon TPU-tunnel sitecustomize force-sets jax_platforms="axon,cpu";
+# override it so tests run on the virtual 8-device CPU mesh
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, jax.devices()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_singletons():
+    """Each test gets fresh process-wide singletons."""
+    yield
+    from fedml_tpu.core.alg_frame.context import Context
+    from fedml_tpu.core.dp.fedml_differential_privacy import (
+        FedMLDifferentialPrivacy,
+    )
+    from fedml_tpu.core.security.fedml_attacker import FedMLAttacker
+    from fedml_tpu.core.security.fedml_defender import FedMLDefender
+    from fedml_tpu.ml.engine.mesh import MeshManager
+
+    Context.reset()
+    MeshManager.reset()
+    FedMLAttacker._instance = None
+    FedMLDefender._instance = None
+    FedMLDifferentialPrivacy._instance = None
+
+
+def make_args(**kw):
+    from fedml_tpu.arguments import Config
+
+    base = dict(
+        dataset="synthetic",
+        model="lr",
+        client_num_in_total=4,
+        client_num_per_round=4,
+        comm_round=3,
+        epochs=1,
+        batch_size=16,
+        learning_rate=0.1,
+        frequency_of_the_test=1,
+        data_scale=0.1,
+        enable_tracking=False,
+        compute_dtype="float32",
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+@pytest.fixture
+def args_factory():
+    return make_args

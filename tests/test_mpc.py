@@ -1,0 +1,87 @@
+"""MPC primitives: Shamir, LCC, LightSecAgg round-trips, uint32 masking."""
+
+import numpy as np
+
+from fedml_tpu.core.mpc.lightsecagg import (
+    aggregate_encoded_masks,
+    decode_aggregate_mask,
+    mask_encoding,
+)
+from fedml_tpu.core.mpc.secagg import (
+    FIELD_PRIME,
+    LCC_decoding_with_points,
+    LCC_encoding_with_points,
+    dequantize,
+    mask_model,
+    modular_inv,
+    prg_mask_like,
+    quantize,
+    shamir_reconstruct,
+    shamir_share,
+    unmask_sum,
+)
+
+
+def test_modular_inv():
+    rng = np.random.RandomState(0)
+    a = rng.randint(1, int(FIELD_PRIME), size=10).astype(np.int64)
+    inv = modular_inv(a)
+    assert np.all((a * inv) % FIELD_PRIME == 1)
+
+
+def test_shamir_round_trip():
+    rng = np.random.RandomState(1)
+    secret = rng.randint(0, int(FIELD_PRIME), size=20).astype(np.int64)
+    shares = shamir_share(secret, n=5, t=2, rng=rng)
+    # any t+1=3 shares reconstruct
+    sub = {k: shares[k] for k in [0, 2, 4]}
+    np.testing.assert_array_equal(shamir_reconstruct(sub), secret)
+    sub2 = {k: shares[k] for k in [1, 2, 3]}
+    np.testing.assert_array_equal(shamir_reconstruct(sub2), secret)
+
+
+def test_lcc_encode_decode_round_trip():
+    rng = np.random.RandomState(2)
+    X = rng.randint(0, int(FIELD_PRIME), size=(3, 7)).astype(np.int64)
+    beta = [1, 2, 3]
+    alpha = [4, 5, 6, 7, 8]
+    enc = LCC_encoding_with_points(X, beta, alpha)
+    dec = LCC_decoding_with_points(enc[:4], alpha[:4], beta)
+    np.testing.assert_array_equal(dec % FIELD_PRIME, X % FIELD_PRIME)
+
+
+def test_lightsecagg_dropout_tolerant_sum():
+    """3 clients, 1 drops out after sharing; aggregate mask of the SURVIVING
+    set is reconstructed from u survivors' aggregated shares."""
+    d, n, u, t = 11, 3, 2, 1
+    rng = np.random.RandomState(3)
+    masks = [rng.randint(0, 2**16, size=d).astype(np.int64) for _ in range(n)]
+    shares = [mask_encoding(d, n, u, t, masks[i], rng) for i in range(n)]
+    survivors = [0, 2]  # client 1 dropped
+    # each survivor j sums the shares it HOLDS from the surviving clients
+    agg_shares = {
+        j: aggregate_encoded_masks([shares[i][j] for i in survivors])
+        for j in survivors
+    }
+    agg_mask = decode_aggregate_mask(agg_shares, d, n, u, t)
+    expect = (masks[0] + masks[2]) % FIELD_PRIME
+    np.testing.assert_array_equal(agg_mask % FIELD_PRIME, expect)
+
+
+def test_uint32_mask_roundtrip():
+    import jax.numpy as jnp
+
+    tree = {"w": jnp.asarray(np.random.RandomState(4).randn(8, 3),
+                             jnp.float32)}
+    q = quantize(tree)
+    m1 = prg_mask_like(q, seed=101)
+    m2 = prg_mask_like(q, seed=202)
+    masked1 = mask_model(q, m1)
+    masked2 = mask_model(q, m2)
+    # server sums masked models, subtracts aggregate mask
+    qsum = {"w": masked1["w"] + masked2["w"]}
+    agg_mask = {"w": m1["w"] + m2["w"]}
+    unmasked = unmask_sum(qsum, agg_mask)
+    recovered = dequantize(unmasked)
+    np.testing.assert_allclose(recovered["w"], 2 * np.asarray(tree["w"]),
+                               atol=1e-3)
