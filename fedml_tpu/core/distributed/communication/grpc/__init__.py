@@ -1,0 +1,3 @@
+from .grpc_comm_manager import GRPCCommManager
+
+__all__ = ["GRPCCommManager"]

@@ -1,0 +1,130 @@
+"""gRPC transport.
+
+Capability parity: reference `communication/grpc/grpc_comm_manager.py:30-130`
+— every rank runs a gRPC server at GRPC_BASE_PORT + rank; an ip_config CSV
+maps receiver-id → IP; max message 1000 MB.
+
+TPU-era differences (documented): payloads are the framework's safe pytree
+wire format (`utils/serialization.py`), NOT pickled Python objects (the
+reference pickles Message objects — arbitrary code execution on decode); the
+service is a generic bytes unary RPC so no protoc step is needed.
+"""
+
+from __future__ import annotations
+
+import csv
+import logging
+import os
+import queue
+import threading
+from concurrent import futures
+from typing import Dict, List, Optional
+
+import grpc
+
+from .....utils.serialization import message_from_wire, message_to_wire
+from ..base_com_manager import BaseCommunicationManager
+from ..message import Message
+from ..observer import Observer
+
+_SERVICE = "fedml_tpu.Comm"
+_METHOD = "Send"
+MAX_MESSAGE_BYTES = 1000 * 1024 * 1024  # reference :55-58
+
+_CHANNEL_OPTIONS = [
+    ("grpc.max_send_message_length", MAX_MESSAGE_BYTES),
+    ("grpc.max_receive_message_length", MAX_MESSAGE_BYTES),
+    ("grpc.enable_http_proxy", 0),
+]
+
+
+def _ident(b: bytes) -> bytes:
+    return b
+
+
+class GRPCCommManager(BaseCommunicationManager):
+    def __init__(self, args=None, rank: int = 0, size: int = 0,
+                 host: str = "0.0.0.0") -> None:
+        self.rank = int(rank)
+        self.size = int(size)
+        base_port = int(getattr(args, "grpc_base_port", 8890) or 8890)
+        self.port = base_port + self.rank
+        self.base_port = base_port
+        self.ip_config = self._load_ip_config(
+            getattr(args, "grpc_ipconfig_path", None))
+        self._observers: List[Observer] = []
+        self._q: "queue.Queue" = queue.Queue()
+        self._running = False
+
+        handler = grpc.method_handlers_generic_handler(_SERVICE, {
+            _METHOD: grpc.unary_unary_rpc_method_handler(
+                self._handle_rpc,
+                request_deserializer=_ident,
+                response_serializer=_ident),
+        })
+        self.server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=8),
+            options=_CHANNEL_OPTIONS)
+        self.server.add_generic_rpc_handlers((handler,))
+        self.server.add_insecure_port(f"{host}:{self.port}")
+        self.server.start()
+        self._channels: Dict[int, grpc.Channel] = {}
+        logging.info("gRPC rank %d serving on port %d", self.rank, self.port)
+
+    @staticmethod
+    def _load_ip_config(path: Optional[str]) -> Dict[int, str]:
+        """CSV `receiver_id,ip` (reference `grpc_comm_manager.py:66-77`)."""
+        mapping: Dict[int, str] = {}
+        if path and os.path.exists(path):
+            with open(path, newline="") as f:
+                for row in csv.reader(f):
+                    if not row or row[0].strip().lower() in ("receiver_id",
+                                                             "rank"):
+                        continue
+                    mapping[int(row[0])] = row[1].strip()
+        return mapping
+
+    def _addr_for(self, receiver_id: int) -> str:
+        ip = self.ip_config.get(receiver_id, "127.0.0.1")
+        return f"{ip}:{self.base_port + int(receiver_id)}"
+
+    def _handle_rpc(self, request: bytes, context) -> bytes:
+        params = message_from_wire(request)
+        msg = Message()
+        msg.init(params)
+        self._q.put(msg)
+        return b"ok"
+
+    # -- BaseCommunicationManager -------------------------------------------
+    def send_message(self, msg: Message) -> None:
+        receiver = msg.get_receiver_id()
+        ch = self._channels.get(receiver)
+        if ch is None:
+            ch = grpc.insecure_channel(self._addr_for(receiver),
+                                       options=_CHANNEL_OPTIONS)
+            self._channels[receiver] = ch
+        stub = ch.unary_unary(f"/{_SERVICE}/{_METHOD}",
+                              request_serializer=_ident,
+                              response_deserializer=_ident)
+        stub(message_to_wire(msg.get_params()), timeout=600)
+
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        if observer in self._observers:
+            self._observers.remove(observer)
+
+    def handle_receive_message(self) -> None:
+        self._running = True
+        while self._running:
+            msg = self._q.get()
+            if msg is None:
+                break
+            for obs in list(self._observers):
+                obs.receive_message(msg.get_type(), msg)
+        self.server.stop(grace=1)
+
+    def stop_receive_message(self) -> None:
+        self._running = False
+        self._q.put(None)

@@ -1,0 +1,5 @@
+from .mqtt_s3_comm_manager import InProcBroker, MqttS3CommManager, PahoBroker
+from .remote_storage import LocalFSStore, ObjectStore, S3Store, create_store
+
+__all__ = ["MqttS3CommManager", "InProcBroker", "PahoBroker",
+           "ObjectStore", "LocalFSStore", "S3Store", "create_store"]

@@ -1,0 +1,94 @@
+"""Out-of-band bulk payload storage (the "S3" of MQTT+S3).
+
+Capability parity: reference `communication/s3/remote_storage.py:75-268`
+(`write_model` / `read_model` keyed by run+sender) — bulk model weights ride
+an object store while MQTT carries only the key.
+
+Stores: LocalFSStore (shared dir — single host or NFS; always available) and
+S3Store (gated on boto3).  Payloads use the safe pytree wire format.
+"""
+
+from __future__ import annotations
+
+import abc
+import os
+import time
+import uuid
+from typing import Any, Optional
+
+from .....utils.serialization import dumps_pytree, loads_pytree
+
+
+class ObjectStore(abc.ABC):
+    @abc.abstractmethod
+    def write(self, key: str, data: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def read(self, key: str) -> bytes: ...
+
+    # -- model-level API (reference write_model/read_model) -----------------
+    def write_model(self, run_id: str, sender_id: int, model: Any) -> str:
+        key = f"fedml_{run_id}_{sender_id}_{uuid.uuid4().hex[:12]}"
+        self.write(key, dumps_pytree(model))
+        return key
+
+    def read_model(self, key: str) -> Any:
+        return loads_pytree(self.read(key))
+
+
+class LocalFSStore(ObjectStore):
+    def __init__(self, root: Optional[str] = None) -> None:
+        self.root = root or os.path.join(
+            os.path.expanduser("~"), ".fedml_tpu", "object_store")
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        safe = key.replace("/", "_")
+        return os.path.join(self.root, safe)
+
+    def write(self, key: str, data: bytes) -> None:
+        tmp = self._path(key) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, self._path(key))  # atomic publish
+
+    def read(self, key: str, timeout: float = 60.0) -> bytes:
+        path = self._path(key)
+        deadline = time.time() + timeout
+        while not os.path.exists(path):
+            if time.time() > deadline:
+                raise FileNotFoundError(key)
+            time.sleep(0.02)
+        with open(path, "rb") as f:
+            return f.read()
+
+
+class S3Store(ObjectStore):
+    def __init__(self, bucket: str, prefix: str = "fedml-tpu/",
+                 **client_kwargs: Any) -> None:
+        try:
+            import boto3  # type: ignore
+        except ImportError as e:
+            raise NotImplementedError(
+                "S3Store requires boto3 (not in this image); use LocalFSStore "
+                "or register a custom ObjectStore") from e
+        self.bucket = bucket
+        self.prefix = prefix
+        self.client = boto3.client("s3", **client_kwargs)
+
+    def write(self, key: str, data: bytes) -> None:
+        self.client.put_object(Bucket=self.bucket, Key=self.prefix + key,
+                               Body=data)
+
+    def read(self, key: str) -> bytes:
+        obj = self.client.get_object(Bucket=self.bucket,
+                                     Key=self.prefix + key)
+        return obj["Body"].read()
+
+
+def create_store(args: Any) -> ObjectStore:
+    kind = str(getattr(args, "object_store", "local") or "local").lower()
+    if kind == "s3":
+        return S3Store(bucket=str(getattr(args, "s3_bucket", "fedml")),
+                       prefix=str(getattr(args, "s3_prefix", "fedml-tpu/")))
+    return LocalFSStore(getattr(args, "object_store_dir", None))

@@ -1,0 +1,143 @@
+"""FedMLAlgorithmFlow — declarative flow programming over the comm layer.
+
+Capability parity: reference `core/distributed/flow/fedml_flow.py:20-295`
+(`add_flow(name, executor_task)` builds a sequence; the engine wires message
+handlers so each completed task ships its `Params` to the next executor) and
+`flow/fedml_executor.py:4-32` (FedMLExecutor holds id/neighbors/params).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ...alg_frame.params import Params
+from ..communication.message import Message
+from ..fedml_comm_manager import FedMLCommManager
+
+MSG_TYPE_FLOW = "FLOW_TASK_DONE"
+MSG_TYPE_FLOW_FINISH = "FLOW_FINISH"
+ARG_FLOW_NAME = "flow_name"
+ARG_FLOW_PARAMS = "flow_params"
+FLOW_TAG_FINISH = "FLOW_FINISH_TAG"
+
+
+class FedMLExecutor:
+    """User-subclassed executor: holds id, neighbor ids, and round params."""
+
+    def __init__(self, id: int = 0, neighbor_id_list: Optional[List[int]] = None):
+        self.id = id
+        self.neighbor_id_list = neighbor_id_list or []
+        self.params: Optional[Params] = None
+
+    def get_params(self) -> Optional[Params]:
+        return self.params
+
+    def set_params(self, params: Params) -> None:
+        self.params = params
+
+
+class _FlowNode:
+    def __init__(self, name: str, executor: FedMLExecutor,
+                 task: Callable[[], Optional[Params]]):
+        self.name = name
+        self.executor = executor
+        self.task = task
+
+
+class FedMLAlgorithmFlow(FedMLCommManager):
+    """Sequential flow of (name, executor.task) steps; each step runs on its
+    executor's rank and forwards Params to the next step's rank."""
+
+    def __init__(self, args: Any, executor: FedMLExecutor,
+                 backend: str = "INPROC") -> None:
+        rank = int(getattr(args, "rank", executor.id))
+        size = int(getattr(args, "flow_world_size",
+                           getattr(args, "client_num_per_round", 1) + 1))
+        super().__init__(args, rank=rank, size=size, backend=backend)
+        self.executor = executor
+        self.flows: List[_FlowNode] = []
+        self._loops = int(getattr(args, "comm_round", 1))
+        self._done = threading.Event()
+
+    # -- building ------------------------------------------------------------
+    def add_flow(self, name: str, executor: FedMLExecutor) -> None:
+        """reference signature: binds `name` to executor.run_<name> or the
+        method named `name` on the executor."""
+        task = getattr(executor, name, None)
+        if task is None:
+            raise ValueError(f"executor has no task method {name!r}")
+        self.flows.append(_FlowNode(name, executor, task))
+
+    def build(self) -> None:
+        logging.info("flow built: %s",
+                     [(f.name, f.executor.id) for f in self.flows])
+
+    # -- runtime -------------------------------------------------------------
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(MSG_TYPE_FLOW,
+                                              self._handle_flow_message)
+        self.register_message_receive_handler(MSG_TYPE_FLOW_FINISH,
+                                              self._handle_finish)
+
+    def run_flow(self) -> None:
+        """Blocking: first executor kicks off; every rank processes its steps."""
+        self.register_message_receive_handlers()
+        if self.flows and self.flows[0].executor.id == self.rank:
+            self._execute_step(0, loop=0, incoming=None)
+        self.com_manager.handle_receive_message()
+
+    def _step_index(self, name: str) -> int:
+        for i, f in enumerate(self.flows):
+            if f.name == name:
+                return i
+        raise KeyError(name)
+
+    def _execute_step(self, idx: int, loop: int,
+                      incoming: Optional[Params]) -> None:
+        node = self.flows[idx]
+        if incoming is not None:
+            node.executor.set_params(incoming)
+        logging.debug("rank %d: flow step %s (loop %d)", self.rank,
+                      node.name, loop)
+        out = node.task()
+        next_idx = idx + 1
+        next_loop = loop
+        if next_idx >= len(self.flows):
+            next_idx = 0
+            next_loop += 1
+            if next_loop >= self._loops:
+                self._broadcast_finish()
+                return
+        nxt = self.flows[next_idx]
+        payload = out.__dict__ if isinstance(out, Params) else {}
+        if nxt.executor.id == self.rank:
+            p = Params(**payload)
+            p.add("loop", next_loop)
+            self._execute_step(next_idx, next_loop, p)
+            return
+        msg = Message(MSG_TYPE_FLOW, self.rank, nxt.executor.id)
+        msg.add_params(ARG_FLOW_NAME, nxt.name)
+        msg.add_params("loop", next_loop)
+        msg.add_params(ARG_FLOW_PARAMS, payload)
+        self.send_message(msg)
+
+    def _handle_flow_message(self, msg: Message) -> None:
+        name = msg.get(ARG_FLOW_NAME)
+        loop = int(msg.get("loop", 0))
+        payload = msg.get(ARG_FLOW_PARAMS) or {}
+        p = Params(**payload)
+        p.add("loop", loop)
+        self._execute_step(self._step_index(name), loop, p)
+
+    def _broadcast_finish(self) -> None:
+        for r in range(self.size):
+            if r != self.rank:
+                self.send_message(Message(MSG_TYPE_FLOW_FINISH, self.rank, r))
+        self._done.set()
+        self.finish()
+
+    def _handle_finish(self, msg: Message) -> None:
+        self._done.set()
+        self.finish()

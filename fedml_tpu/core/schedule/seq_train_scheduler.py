@@ -1,0 +1,101 @@
+"""Heterogeneity-aware client→worker scheduling (the Parrot scheduler).
+
+Capability parity: reference `core/schedule/runtime_estimate.py:4-16`
+(`t_sample_fit`: least-squares linear per-worker cost model t ≈ a·n + b from
+observed (worker, client) runtimes) and `core/schedule/
+seq_train_scheduler.py:9-242` (`SeqTrainScheduler`: min-makespan assignment
+of clients to workers that then simulate their clients sequentially), used by
+fedavg_seq (`mpi/fedavg_seq/FedAVGAggregator.py:126-160`).
+
+TPU reuse: the same scheduler balances client *buckets* across the `clients`
+mesh axis so each device's vmapped batch has near-equal padded work — the
+makespan IS the round's step count.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+
+def t_sample_fit(
+    runtime_history: Dict[Tuple[int, int], List[Tuple[float, float]]],
+) -> Dict[int, Tuple[float, float]]:
+    """Per-worker linear fit.  history[(worker, client)] = [(n_samples, t)].
+    Returns worker → (a, b) with t ≈ a·n + b (least squares, clipped ≥0)."""
+    per_worker: Dict[int, List[Tuple[float, float]]] = {}
+    for (worker, _client), obs in runtime_history.items():
+        per_worker.setdefault(worker, []).extend(obs)
+    fits: Dict[int, Tuple[float, float]] = {}
+    for worker, obs in per_worker.items():
+        ns = np.array([o[0] for o in obs], np.float64)
+        ts = np.array([o[1] for o in obs], np.float64)
+        if len(obs) >= 2 and np.ptp(ns) > 0:
+            A = np.stack([ns, np.ones_like(ns)], axis=1)
+            coef, *_ = np.linalg.lstsq(A, ts, rcond=None)
+            a, b = float(max(coef[0], 0.0)), float(max(coef[1], 0.0))
+        else:
+            a, b = (float(ts.mean() / max(ns.mean(), 1.0)), 0.0) if len(obs) \
+                else (1.0, 0.0)
+        fits[worker] = (a, b)
+    return fits
+
+
+class SeqTrainScheduler:
+    """Min-makespan assignment: LPT greedy + pairwise refinement."""
+
+    def __init__(self, workloads: Sequence[float], constraints: Sequence[float],
+                 memory: Sequence[float] = None,
+                 fit_params: Dict[int, Tuple[float, float]] = None) -> None:
+        """workloads[i]: client i's sample count; constraints[w]: worker w's
+        relative speed (higher = faster); fit_params optionally override the
+        per-worker linear cost model."""
+        self.workloads = list(map(float, workloads))
+        self.speeds = [max(float(s), 1e-9) for s in constraints]
+        self.fit_params = fit_params or {}
+
+    def _cost(self, worker: int, n: float) -> float:
+        if worker in self.fit_params:
+            a, b = self.fit_params[worker]
+            return a * n + b
+        return n / self.speeds[worker]
+
+    def DP_schedule(self, mode: int = 0
+                    ) -> Tuple[List[List[int]], List[float]]:
+        """Returns (assignment worker→client list, per-worker makespans)."""
+        n_workers = len(self.speeds)
+        order = np.argsort(-np.asarray(self.workloads))  # LPT
+        loads = [0.0] * n_workers
+        assign: List[List[int]] = [[] for _ in range(n_workers)]
+        for cid in order:
+            costs = [loads[w] + self._cost(w, self.workloads[cid])
+                     for w in range(n_workers)]
+            w = int(np.argmin(costs))
+            assign[w].append(int(cid))
+            loads[w] = costs[w]
+        # pairwise refinement: move a client off the max-load worker if it
+        # lowers the makespan
+        for _ in range(64):
+            w_max = int(np.argmax(loads))
+            improved = False
+            for cid in sorted(assign[w_max],
+                              key=lambda c: self.workloads[c]):
+                for w in range(n_workers):
+                    if w == w_max:
+                        continue
+                    new_max_src = loads[w_max] - self._cost(
+                        w_max, self.workloads[cid])
+                    new_dst = loads[w] + self._cost(w, self.workloads[cid])
+                    if max(new_max_src, new_dst) < loads[w_max] - 1e-12:
+                        assign[w_max].remove(cid)
+                        assign[w].append(cid)
+                        loads[w_max] = new_max_src
+                        loads[w] = new_dst
+                        improved = True
+                        break
+                if improved:
+                    break
+            if not improved:
+                break
+        return assign, loads

@@ -1,0 +1,84 @@
+"""Cross-silo ClientMasterManager.
+
+Capability parity: reference `cross_silo/client/fedml_client_master_manager.py
+:22-261` — registers online status, handles INIT/SYNC/FINISH, runs local
+training via TrainerDistAdapter, uploads (weights, n_samples).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+from ...core import mlops
+from ...core.distributed.communication.message import Message
+from ...core.distributed.fedml_comm_manager import FedMLCommManager
+from ..message_define import MyMessage
+from .trainer_dist_adapter import TrainerDistAdapter
+
+
+class ClientMasterManager(FedMLCommManager):
+    def __init__(self, args: Any, trainer_dist_adapter: TrainerDistAdapter,
+                 comm=None, rank: int = 0, size: int = 0,
+                 backend: str = "INPROC") -> None:
+        super().__init__(args, comm, rank, size, backend)
+        self.trainer_dist_adapter = trainer_dist_adapter
+        self.num_rounds = int(args.comm_round)
+        self.round_idx = 0
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.handle_message_init)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+            self.handle_message_receive_model_from_server)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_FINISH, self.handle_message_finish)
+
+    def run(self) -> None:
+        self.register_message_receive_handlers()
+        self.send_client_status(0)
+        self.com_manager.handle_receive_message()
+
+    # -- protocol ------------------------------------------------------------
+    def send_client_status(self, receiver_id: int,
+                           status: str = MyMessage.CLIENT_STATUS_ONLINE) -> None:
+        msg = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS,
+                      self.get_sender_id(), receiver_id)
+        msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_STATUS, status)
+        msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_OS, "python")
+        self.send_message(msg)
+
+    def handle_message_init(self, msg: Message) -> None:
+        global_model = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        client_index = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX)
+        self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND, 0))
+        mlops.log_training_status("RUNNING")
+        self._train_and_upload(global_model, client_index)
+
+    def handle_message_receive_model_from_server(self, msg: Message) -> None:
+        global_model = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        client_index = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX)
+        self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND,
+                                     self.round_idx + 1))
+        self._train_and_upload(global_model, client_index)
+
+    def handle_message_finish(self, msg: Message) -> None:
+        logging.info("client %d: finish", self.rank)
+        mlops.log_training_status("FINISHED")
+        self.finish()
+
+    def _train_and_upload(self, global_model: Any, client_index: int) -> None:
+        self.trainer_dist_adapter.update_dataset(int(client_index))
+        self.trainer_dist_adapter.update_model(global_model)
+        with mlops.span("train", self.round_idx):
+            weights, n_samples = self.trainer_dist_adapter.train(
+                self.round_idx)
+        msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
+                      self.get_sender_id(), 0)
+        msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, weights)
+        msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, n_samples)
+        msg.add_params(MyMessage.MSG_ARG_KEY_TRAIN_METRICS,
+                       getattr(self.trainer_dist_adapter.trainer,
+                               "last_metrics", {}))
+        self.send_message(msg)

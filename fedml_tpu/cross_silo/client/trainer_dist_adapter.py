@@ -1,0 +1,75 @@
+"""TrainerDistAdapter — silo-internal training adapter.
+
+Capability parity: reference `cross_silo/client/fedml_trainer_dist_adapter.py`
++ `fedml_trainer.py`: device placement, hierarchical DDP wrap, delegate to the
+user ClientTrainer hooks, return (weights, n_samples).
+
+TPU redesign: "DDP across silo processes" becomes sharding the silo's batch
+over the `data` mesh axis inside one jit — gradient sync is XLA's psum, not
+NCCL.  In the horizontal scenario it's the plain local-update engine.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional, Tuple
+
+from ...constants import AXIS_DATA, CROSS_SILO_SCENARIO_HIERARCHICAL
+from ...ml.engine.mesh import build_mesh
+from ...ml.trainer.default_trainer import DefaultClientTrainer
+
+
+class TrainerDistAdapter:
+    def __init__(self, args: Any, bundle: Any, dataset: Tuple,
+                 client_trainer: Optional[Any] = None) -> None:
+        self.args = args
+        (self.train_num, self.test_num, self.train_global, self.test_global,
+         self.local_num_dict, self.train_data_local_dict,
+         self.test_data_local_dict, self.class_num) = dataset
+        self.trainer = client_trainer or DefaultClientTrainer(bundle, args)
+        bs = int(getattr(args, "batch_size", 32))
+        max_n = max(self.local_num_dict.values()) if self.local_num_dict else bs
+        self.trainer.set_num_batches(max(1, -(-int(max_n) // bs)))
+
+        self.mesh = None
+        if str(getattr(args, "scenario", "horizontal")) == \
+                CROSS_SILO_SCENARIO_HIERARCHICAL:
+            import jax
+
+            n_proc = min(int(getattr(args, "n_proc_per_node", 1) or 1),
+                         len(jax.devices()))
+            if n_proc > 1:
+                self.mesh = build_mesh({AXIS_DATA: n_proc})
+                logging.info("hierarchical silo: data-parallel mesh %s",
+                             self.mesh)
+
+    def update_dataset(self, client_index: int) -> None:
+        self.client_index = int(client_index)
+        self.trainer.set_id(self.client_index)
+        self.trainer.update_dataset(
+            self.train_data_local_dict[self.client_index],
+            self.test_data_local_dict[self.client_index],
+            self.local_num_dict[self.client_index])
+
+    def update_model(self, model_params: Any) -> None:
+        self.trainer.set_model_params(model_params)
+
+    def train(self, round_idx: int) -> Tuple[Any, float]:
+        self.trainer.on_before_local_training(
+            self.trainer.local_train_dataset, None, self.args)
+        ctx = self.mesh if self.mesh is not None else _Null()
+        with ctx:
+            self.trainer.train(self.trainer.local_train_dataset, None,
+                               self.args)
+        self.trainer.on_after_local_training(
+            self.trainer.local_train_dataset, None, self.args)
+        return (self.trainer.get_model_params(),
+                float(self.trainer.local_sample_number))
+
+
+class _Null:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False

@@ -1,0 +1,117 @@
+"""LightSecAgg client manager.
+
+Capability parity: reference `cross_silo/lightsecagg/
+lsa_fedml_client_manager.py`: train → generate local mask → LCC-encode and
+share to peers → upload masked model → on server request, send the sum of
+held shares for the surviving set.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict
+
+import numpy as np
+
+from ...core.distributed.communication.message import Message
+from ...core.distributed.fedml_comm_manager import FedMLCommManager
+from ...core.mpc.lightsecagg import aggregate_encoded_masks, mask_encoding
+from ...core.mpc.secagg import FIELD_PRIME
+from ..client.trainer_dist_adapter import TrainerDistAdapter
+from .lsa_message_define import LSAMessage
+from .lsa_utils import mask_field_vector, tree_to_field_vector
+
+
+class LSAClientManager(FedMLCommManager):
+    def __init__(self, args: Any, trainer_dist_adapter: TrainerDistAdapter,
+                 comm=None, rank: int = 0, size: int = 0,
+                 backend: str = "INPROC") -> None:
+        super().__init__(args, comm, rank, size, backend)
+        self.adapter = trainer_dist_adapter
+        self.round_idx = 0
+        self.proto: Dict[str, int] = {}
+        self.received_shares: Dict[int, np.ndarray] = {}  # sender rank → share
+        self._rng = np.random.RandomState(
+            int(getattr(args, "random_seed", 0) or 0) * 1000 + rank)
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            LSAMessage.MSG_TYPE_S2C_INIT_CONFIG, self.handle_init)
+        self.register_message_receive_handler(
+            LSAMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.handle_sync)
+        self.register_message_receive_handler(
+            LSAMessage.MSG_TYPE_C2C_ENCODED_MASK_SHARE, self.handle_share)
+        self.register_message_receive_handler(
+            LSAMessage.MSG_TYPE_S2C_AGG_MASK_REQUEST, self.handle_agg_request)
+        self.register_message_receive_handler(
+            LSAMessage.MSG_TYPE_S2C_FINISH, self.handle_finish)
+
+    def run(self) -> None:
+        self.register_message_receive_handlers()
+        msg = Message(LSAMessage.MSG_TYPE_C2S_CLIENT_STATUS,
+                      self.get_sender_id(), 0)
+        msg.add_params(LSAMessage.ARG_CLIENT_STATUS,
+                       LSAMessage.CLIENT_STATUS_ONLINE)
+        self.send_message(msg)
+        self.com_manager.handle_receive_message()
+
+    # -- round work ----------------------------------------------------------
+    def handle_init(self, msg: Message) -> None:
+        self.proto = dict(msg.get(LSAMessage.ARG_PROTO))
+        self._train_mask_upload(msg)
+
+    def handle_sync(self, msg: Message) -> None:
+        self.received_shares = {}
+        self._train_mask_upload(msg)
+
+    def _train_mask_upload(self, msg: Message) -> None:
+        client_index = msg.get(LSAMessage.ARG_CLIENT_INDEX)
+        self.round_idx = int(msg.get(LSAMessage.ARG_ROUND, 0))
+        self.adapter.update_dataset(int(client_index))
+        self.adapter.update_model(msg.get(LSAMessage.ARG_MODEL_PARAMS))
+        weights, n_samples = self.adapter.train(self.round_idx)
+
+        d, n, u, t = (self.proto["d"], self.proto["n"], self.proto["u"],
+                      self.proto["t"])
+        scale = self.proto.get("scale", 1 << 10)
+        qvec, _ = tree_to_field_vector(weights, scale)
+        assert len(qvec) == d, (len(qvec), d)
+        local_mask = self._rng.randint(0, int(FIELD_PRIME), size=d).astype(
+            np.int64)
+        shares = mask_encoding(d, n, u, t, local_mask, self._rng)
+        # share j goes to client rank j+1 (self-share kept locally)
+        for j in range(n):
+            peer_rank = j + 1
+            if peer_rank == self.rank:
+                self.received_shares[self.rank] = shares[j]
+                continue
+            share_msg = Message(LSAMessage.MSG_TYPE_C2C_ENCODED_MASK_SHARE,
+                                self.get_sender_id(), peer_rank)
+            share_msg.add_params(LSAMessage.ARG_SHARE, shares[j])
+            self.send_message(share_msg)
+
+        masked = mask_field_vector(qvec, local_mask)
+        up = Message(LSAMessage.MSG_TYPE_C2S_MASKED_MODEL,
+                     self.get_sender_id(), 0)
+        up.add_params(LSAMessage.ARG_MASKED_VECTOR, masked)
+        up.add_params(LSAMessage.ARG_NUM_SAMPLES, n_samples)
+        self.send_message(up)
+
+    def handle_share(self, msg: Message) -> None:
+        self.received_shares[msg.get_sender_id()] = np.asarray(
+            msg.get(LSAMessage.ARG_SHARE), np.int64)
+
+    def handle_agg_request(self, msg: Message) -> None:
+        survivors = [int(s) for s in msg.get(LSAMessage.ARG_SURVIVORS)]
+        have = [self.received_shares[r] for r in survivors
+                if r in self.received_shares]
+        agg_share = aggregate_encoded_masks(have)
+        reply = Message(LSAMessage.MSG_TYPE_C2S_AGG_MASK_SHARE,
+                        self.get_sender_id(), 0)
+        reply.add_params(LSAMessage.ARG_SHARE, agg_share)
+        reply.add_params(LSAMessage.ARG_ROUND, self.round_idx)
+        self.send_message(reply)
+
+    def handle_finish(self, msg: Message) -> None:
+        logging.info("LSA client %d: finish", self.rank)
+        self.finish()

@@ -1,0 +1,25 @@
+"""LightSecAgg message schema (reference `cross_silo/lightsecagg/
+lsa_message_define.py`)."""
+
+
+class LSAMessage:
+    MSG_TYPE_C2S_CLIENT_STATUS = "C2S_CLIENT_STATUS"
+    MSG_TYPE_S2C_INIT_CONFIG = "S2C_INIT_CONFIG_LSA"
+    MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT = "S2C_SYNC_MODEL_LSA"
+    MSG_TYPE_C2C_ENCODED_MASK_SHARE = "C2C_ENCODED_MASK_SHARE"
+    MSG_TYPE_C2S_MASKED_MODEL = "C2S_MASKED_MODEL"
+    MSG_TYPE_S2C_AGG_MASK_REQUEST = "S2C_AGG_MASK_REQUEST"
+    MSG_TYPE_C2S_AGG_MASK_SHARE = "C2S_AGG_MASK_SHARE"
+    MSG_TYPE_S2C_FINISH = "S2C_FINISH_LSA"
+
+    ARG_MODEL_PARAMS = "model_params"
+    ARG_MASKED_VECTOR = "masked_vector"
+    ARG_CLIENT_INDEX = "client_idx"
+    ARG_NUM_SAMPLES = "num_samples"
+    ARG_ROUND = "round_idx"
+    ARG_SHARE = "mask_share"
+    ARG_SURVIVORS = "survivors"
+    ARG_CLIENT_STATUS = "client_status"
+    ARG_PROTO = "lsa_proto"  # dict(d, n, u, t, scale)
+
+    CLIENT_STATUS_ONLINE = "ONLINE"

@@ -1,0 +1,134 @@
+"""LightSecAgg server manager.
+
+Capability parity: reference `cross_silo/lightsecagg/
+lsa_fedml_server_manager.py` + `lsa_fedml_aggregator.py`: collect masked
+models, request aggregate-mask shares from survivors, LCC-decode the
+aggregate mask, unmask the sum, average, advance rounds.  Tolerates client
+dropout between upload and reconstruction (the masked sum only includes
+survivors, and any u surviving shares reconstruct their aggregate mask).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict
+
+import numpy as np
+
+from ...core import mlops
+from ...core.distributed.communication.message import Message
+from ...core.distributed.fedml_comm_manager import FedMLCommManager
+from ...core.mpc.lightsecagg import decode_aggregate_mask
+from ..server.fedml_aggregator import FedMLAggregator
+from .lsa_message_define import LSAMessage
+from .lsa_utils import field_vector_to_tree, tree_to_field_vector, unmask_field_sum
+
+FIELD = None
+
+
+class LSAServerManager(FedMLCommManager):
+    def __init__(self, args: Any, aggregator: FedMLAggregator, comm=None,
+                 rank: int = 0, client_num: int = 0,
+                 backend: str = "INPROC") -> None:
+        super().__init__(args, comm, rank, client_num + 1, backend)
+        self.aggregator = aggregator
+        self.round_num = int(args.comm_round)
+        self.args.round_idx = 0
+        self.client_num = client_num
+        self.scale = 1 << 10
+        # privacy/dropout parameters: tolerate t colluding, need u survivors
+        self.t = max(1, client_num // 3)
+        self.u = max(self.t + 1, 2 * client_num // 3)
+        self.online: Dict[int, bool] = {}
+        self.masked: Dict[int, np.ndarray] = {}
+        self.sample_nums: Dict[int, float] = {}
+        self.agg_shares: Dict[int, np.ndarray] = {}
+        self.d = None
+        self._template = None
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            LSAMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.handle_status)
+        self.register_message_receive_handler(
+            LSAMessage.MSG_TYPE_C2S_MASKED_MODEL, self.handle_masked_model)
+        self.register_message_receive_handler(
+            LSAMessage.MSG_TYPE_C2S_AGG_MASK_SHARE, self.handle_agg_share)
+
+    # -- handshake -----------------------------------------------------------
+    def handle_status(self, msg: Message) -> None:
+        self.online[msg.get_sender_id()] = True
+        if len(self.online) == self.client_num:
+            self._send_round_start(LSAMessage.MSG_TYPE_S2C_INIT_CONFIG)
+
+    def _send_round_start(self, msg_type: str) -> None:
+        global_model = self.aggregator.get_global_model_params()
+        self._template = global_model
+        qvec, _ = tree_to_field_vector(global_model, self.scale)
+        self.d = int(len(qvec))
+        proto = {"d": self.d, "n": self.client_num, "u": self.u, "t": self.t,
+                 "scale": self.scale}
+        ids = self.aggregator.client_sampling(
+            self.args.round_idx, int(self.args.client_num_in_total),
+            self.client_num)
+        for i in range(self.client_num):
+            msg = Message(msg_type, self.get_sender_id(), i + 1)
+            msg.add_params(LSAMessage.ARG_MODEL_PARAMS, global_model)
+            msg.add_params(LSAMessage.ARG_CLIENT_INDEX, ids[i % len(ids)])
+            msg.add_params(LSAMessage.ARG_ROUND, self.args.round_idx)
+            msg.add_params(LSAMessage.ARG_PROTO, proto)
+            self.send_message(msg)
+
+    # -- masked model collection ---------------------------------------------
+    def handle_masked_model(self, msg: Message) -> None:
+        sender = msg.get_sender_id()
+        self.masked[sender] = np.asarray(
+            msg.get(LSAMessage.ARG_MASKED_VECTOR), np.int64)
+        self.sample_nums[sender] = float(
+            msg.get(LSAMessage.ARG_NUM_SAMPLES, 1.0))
+        if len(self.masked) == self.client_num:
+            survivors = sorted(self.masked.keys())
+            req_targets = survivors[: self.u] if len(survivors) >= self.u \
+                else survivors
+            for r in req_targets:
+                req = Message(LSAMessage.MSG_TYPE_S2C_AGG_MASK_REQUEST,
+                              self.get_sender_id(), r)
+                req.add_params(LSAMessage.ARG_SURVIVORS, survivors)
+                self.send_message(req)
+
+    # -- reconstruction ------------------------------------------------------
+    def handle_agg_share(self, msg: Message) -> None:
+        sender = msg.get_sender_id()
+        self.agg_shares[sender - 1] = np.asarray(
+            msg.get(LSAMessage.ARG_SHARE), np.int64)
+        if len(self.agg_shares) < self.u:
+            return
+        from ...core.mpc.secagg import FIELD_PRIME
+
+        survivors = sorted(self.masked.keys())
+        qsum = np.zeros(self.d, np.int64)
+        for r in survivors:
+            qsum = (qsum + self.masked[r]) % FIELD_PRIME
+        agg_mask = decode_aggregate_mask(
+            dict(self.agg_shares), self.d, self.client_num, self.u, self.t)
+        clear = unmask_field_sum(qsum, agg_mask)
+        avg_tree = field_vector_to_tree(clear, self._template,
+                                        n_summed=len(survivors),
+                                        scale=self.scale)
+        self.aggregator.set_global_model_params(avg_tree)
+
+        freq = int(getattr(self.args, "frequency_of_the_test", 1) or 1)
+        if (self.args.round_idx % freq == 0
+                or self.args.round_idx == self.round_num - 1):
+            self.aggregator.test_on_server_for_all_clients(self.args.round_idx)
+
+        self.masked.clear()
+        self.agg_shares.clear()
+        self.args.round_idx += 1
+        if self.args.round_idx >= self.round_num:
+            for r in range(1, self.client_num + 1):
+                self.send_message(Message(LSAMessage.MSG_TYPE_S2C_FINISH,
+                                          self.get_sender_id(), r))
+            mlops.log_aggregation_status("FINISHED")
+            self.finish()
+            return
+        self._send_round_start(LSAMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)

@@ -1,0 +1,57 @@
+"""LightSecAgg field-domain model transforms.
+
+Quantize a param pytree into the prime field (p = 2^31 − 1), mask/unmask
+mod p, and de-quantize back (reference `cross_silo/lightsecagg/
+lsa_fedml_aggregator.py` transform_tensor_to_finite / finite_to_tensor).
+Host-side numpy int64: exact, and this path is control-plane-sized.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import numpy as np
+
+from ...core.mpc.secagg import FIELD_PRIME
+
+DEFAULT_SCALE = 1 << 10
+
+
+def tree_to_field_vector(tree: Any, scale: int = DEFAULT_SCALE
+                         ) -> Tuple[np.ndarray, Any]:
+    """float pytree → field vector [d] (negatives map to p + v)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    flat = np.concatenate([np.asarray(l, np.float64).ravel() for l in leaves])
+    q = np.round(flat * scale).astype(np.int64)
+    return np.mod(q, FIELD_PRIME), tree
+
+
+def field_vector_to_tree(vec: np.ndarray, like: Any, n_summed: int = 1,
+                         scale: int = DEFAULT_SCALE) -> Any:
+    """field vector (a mod-p SUM of n_summed quantized models) → mean pytree."""
+    v = np.asarray(vec, np.int64) % FIELD_PRIME
+    signed = np.where(v > FIELD_PRIME // 2, v - FIELD_PRIME, v).astype(
+        np.float64)
+    flat = signed / (scale * max(n_summed, 1))
+    leaves, treedef = jax.tree_util.tree_flatten(like)
+    out, off = [], 0
+    import jax.numpy as jnp
+
+    for leaf in leaves:
+        size = int(np.prod(np.shape(leaf))) if np.shape(leaf) else 1
+        out.append(jnp.asarray(
+            flat[off:off + size].reshape(np.shape(leaf)),
+            dtype=np.result_type(np.asarray(leaf))))
+        off += size
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def mask_field_vector(qvec: np.ndarray, mask: np.ndarray) -> np.ndarray:
+    return (np.asarray(qvec, np.int64) + np.asarray(mask, np.int64)) \
+        % FIELD_PRIME
+
+
+def unmask_field_sum(qsum: np.ndarray, agg_mask: np.ndarray) -> np.ndarray:
+    return (np.asarray(qsum, np.int64) - np.asarray(agg_mask, np.int64)) \
+        % FIELD_PRIME

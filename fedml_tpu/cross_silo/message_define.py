@@ -1,0 +1,30 @@
+"""Cross-silo message schema.
+
+Capability parity: reference `cross_silo/server/message_define.py` /
+`client/message_define.py` (MyMessage constants): connection handshake,
+init-config broadcast, model upload, sync, finish.
+"""
+
+
+class MyMessage:
+    # handshake / liveness (reference MSG_TYPE_CONNECTION_IS_READY + status)
+    MSG_TYPE_CONNECTION_IS_READY = "CONNECTION_IS_READY"
+    MSG_TYPE_C2S_CLIENT_STATUS = "C2S_CLIENT_STATUS"
+
+    # training round-trip
+    MSG_TYPE_S2C_INIT_CONFIG = "S2C_INIT_CONFIG"
+    MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT = "S2C_SYNC_MODEL_TO_CLIENT"
+    MSG_TYPE_C2S_SEND_MODEL_TO_SERVER = "C2S_SEND_MODEL_TO_SERVER"
+    MSG_TYPE_S2C_FINISH = "S2C_FINISH"
+
+    # payload keys
+    MSG_ARG_KEY_MODEL_PARAMS = "model_params"
+    MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
+    MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
+    MSG_ARG_KEY_ROUND = "round_idx"
+    MSG_ARG_KEY_CLIENT_STATUS = "client_status"
+    MSG_ARG_KEY_CLIENT_OS = "client_os"
+    MSG_ARG_KEY_TRAIN_METRICS = "train_metrics"
+
+    CLIENT_STATUS_ONLINE = "ONLINE"
+    CLIENT_STATUS_IDLE = "IDLE"

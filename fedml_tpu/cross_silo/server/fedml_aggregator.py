@@ -1,0 +1,85 @@
+"""Cross-silo server-side aggregation state machine.
+
+Capability parity: reference `cross_silo/server/fedml_aggregator.py`
+(add_local_trained_result / check_whether_all_receive / aggregate / client
+sampling / data-silo selection / test_on_server_for_all_clients).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...core import mlops
+from ...core.alg_frame.context import Context
+
+
+class FedMLAggregator:
+    def __init__(self, args: Any, aggregator, test_global) -> None:
+        self.args = args
+        self.aggregator = aggregator            # ServerAggregator impl
+        self.test_global = test_global
+        self.client_num = int(args.client_num_per_round)
+        self.model_dict: Dict[int, Any] = {}
+        self.sample_num_dict: Dict[int, float] = {}
+        self.flag_client_model_uploaded_dict: Dict[int, bool] = {
+            i: False for i in range(self.client_num)}
+        self.metrics_history: List[Dict[str, Any]] = []
+
+    def get_global_model_params(self):
+        return self.aggregator.get_model_params()
+
+    def set_global_model_params(self, params):
+        self.aggregator.set_model_params(params)
+
+    def add_local_trained_result(self, index: int, model_params,
+                                 sample_num) -> None:
+        self.model_dict[index] = model_params
+        self.sample_num_dict[index] = float(sample_num)
+        self.flag_client_model_uploaded_dict[index] = True
+
+    def check_whether_all_receive(self) -> bool:
+        if not all(self.flag_client_model_uploaded_dict.get(i, False)
+                   for i in range(self.client_num)):
+            return False
+        for i in range(self.client_num):
+            self.flag_client_model_uploaded_dict[i] = False
+        return True
+
+    def aggregate(self) -> Any:
+        raw = [(self.sample_num_dict[i], self.model_dict[i])
+               for i in range(self.client_num)]
+        with mlops.span("server.agg"):
+            raw = self.aggregator.on_before_aggregation(raw)
+            agg = self.aggregator.aggregate(raw)
+            agg = self.aggregator.on_after_aggregation(agg)
+        self.aggregator.set_model_params(agg)
+        return agg
+
+    # -- selection (reference :113-160) -------------------------------------
+    def client_sampling(self, round_idx: int, client_num_in_total: int,
+                        client_num_per_round: int) -> List[int]:
+        if client_num_in_total == client_num_per_round:
+            return list(range(client_num_in_total))
+        np.random.seed(round_idx)
+        return [int(c) for c in np.random.choice(
+            range(client_num_in_total), client_num_per_round, replace=False)]
+
+    def data_silo_selection(self, round_idx: int, data_silo_num_in_total: int,
+                            client_num_in_total: int) -> List[int]:
+        if data_silo_num_in_total == client_num_in_total:
+            return list(range(data_silo_num_in_total))
+        np.random.seed(round_idx)
+        return [int(c) for c in np.random.choice(
+            range(data_silo_num_in_total), client_num_in_total,
+            replace=True)]
+
+    def test_on_server_for_all_clients(self, round_idx: int) -> Dict[str, Any]:
+        metrics = self.aggregator.test(self.test_global, None, self.args)
+        metrics["round"] = round_idx
+        self.metrics_history.append(metrics)
+        mlops.log(metrics)
+        logging.info("cross-silo round %d server eval: %s", round_idx, metrics)
+        return metrics

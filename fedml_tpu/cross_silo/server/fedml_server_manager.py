@@ -1,0 +1,125 @@
+"""Cross-silo FedMLServerManager.
+
+Capability parity: reference `cross_silo/server/fedml_server_manager.py:15-332`
+— waits for client online statuses, sends init config (global model +
+client_index), collects C2S models, aggregates, advances rounds, sends FINISH.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+from ...core import mlops
+from ...core.distributed.communication.message import Message
+from ...core.distributed.fedml_comm_manager import FedMLCommManager
+from ..message_define import MyMessage
+from .fedml_aggregator import FedMLAggregator
+
+
+class FedMLServerManager(FedMLCommManager):
+    def __init__(self, args: Any, aggregator: FedMLAggregator, comm=None,
+                 rank: int = 0, client_num: int = 0,
+                 backend: str = "INPROC") -> None:
+        super().__init__(args, comm, rank, client_num + 1, backend)
+        self.aggregator = aggregator
+        self.round_num = int(args.comm_round)
+        self.args.round_idx = 0
+        self.client_num = client_num
+        self.client_online_status: Dict[int, bool] = {}
+        self.client_id_list_in_this_round: List[int] = []
+        self.data_silo_index_of_client: List[int] = []
+        self.is_initialized = False
+
+    def run(self) -> None:
+        super().run()
+
+    # -- protocol ------------------------------------------------------------
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_CLIENT_STATUS,
+            self.handle_message_client_status_update)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
+            self.handle_message_receive_model_from_client)
+
+    def handle_message_client_status_update(self, msg: Message) -> None:
+        sender = msg.get_sender_id()
+        status = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_STATUS)
+        if status == MyMessage.CLIENT_STATUS_ONLINE:
+            self.client_online_status[sender] = True
+        logging.info("server: client %d status %s (%d/%d online)", sender,
+                     status, sum(self.client_online_status.values()),
+                     self.client_num)
+        if (len(self.client_online_status) == self.client_num
+                and not self.is_initialized):
+            mlops.log_aggregation_status("RUNNING")
+            self.is_initialized = True
+            self.send_init_msg()
+
+    def send_init_msg(self) -> None:
+        self.client_id_list_in_this_round = self.aggregator.client_sampling(
+            self.args.round_idx, int(self.args.client_num_in_total),
+            int(self.args.client_num_per_round))
+        self.data_silo_index_of_client = self.aggregator.data_silo_selection(
+            self.args.round_idx, int(self.args.client_num_in_total),
+            len(self.client_id_list_in_this_round))
+        global_model = self.aggregator.get_global_model_params()
+        for i, receiver_rank in enumerate(
+                self._ranks_for(self.client_id_list_in_this_round)):
+            msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG,
+                          self.get_sender_id(), receiver_rank)
+            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_model)
+            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
+                           self.client_id_list_in_this_round[i])
+            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.args.round_idx)
+            self.send_message(msg)
+
+    def _ranks_for(self, client_ids: List[int]) -> List[int]:
+        """client slots → comm ranks 1..client_num (round-robin when
+        client_num_per_round < physical clients is 1:1 in this build)."""
+        return [1 + (i % self.client_num)
+                for i in range(len(client_ids))]
+
+    def handle_message_receive_model_from_client(self, msg: Message) -> None:
+        sender = msg.get_sender_id()
+        model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        local_sample_number = msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
+        self.aggregator.add_local_trained_result(
+            sender - 1, model_params, local_sample_number)
+        if not self.aggregator.check_whether_all_receive():
+            return
+        mlops.event("server.wait", False, self.args.round_idx)
+        self.aggregator.aggregate()
+        freq = int(getattr(self.args, "frequency_of_the_test", 1) or 1)
+        if (self.args.round_idx % freq == 0
+                or self.args.round_idx == self.round_num - 1):
+            self.aggregator.test_on_server_for_all_clients(self.args.round_idx)
+
+        self.args.round_idx += 1
+        if self.args.round_idx >= self.round_num:
+            self.send_finish_to_all()
+            mlops.log_aggregation_status("FINISHED")
+            self.finish()
+            return
+        # next round
+        self.client_id_list_in_this_round = self.aggregator.client_sampling(
+            self.args.round_idx, int(self.args.client_num_in_total),
+            int(self.args.client_num_per_round))
+        global_model = self.aggregator.get_global_model_params()
+        mlops.event("server.wait", True, self.args.round_idx)
+        for i, receiver_rank in enumerate(
+                self._ranks_for(self.client_id_list_in_this_round)):
+            msg = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+                          self.get_sender_id(), receiver_rank)
+            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_model)
+            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
+                           self.client_id_list_in_this_round[i])
+            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.args.round_idx)
+            self.send_message(msg)
+
+    def send_finish_to_all(self) -> None:
+        for rank in range(1, self.client_num + 1):
+            msg = Message(MyMessage.MSG_TYPE_S2C_FINISH,
+                          self.get_sender_id(), rank)
+            self.send_message(msg)

@@ -1,0 +1,226 @@
+"""Federated Analytics (FA) — base frame + analyzers + SP simulator.
+
+Capability parity: reference `fa/` (2.6k LoC mini-framework): base classes
+(`fa/base_frame/client_analyzer.py`, `server_aggregator.py`), local analyzers
++ aggregators for avg, intersection (PSI), union, cardinality, frequency
+estimation, k-percentile, heavy-hitter TrieHH (`fa/local_analyzer/`,
+`fa/aggregator/`, `fa/utils/trie.py`), and the SP simulator
+(`fa/simulation/sp/simulator.py`).
+"""
+
+from __future__ import annotations
+
+import abc
+import hashlib
+import logging
+from collections import Counter
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class FAClientAnalyzer(abc.ABC):
+    def __init__(self, args: Any = None) -> None:
+        self.args = args
+        self.id = 0
+        self.client_submission: Any = None
+
+    def set_id(self, client_id: int) -> None:
+        self.id = client_id
+
+    def get_client_submission(self) -> Any:
+        return self.client_submission
+
+    def set_client_submission(self, v: Any) -> None:
+        self.client_submission = v
+
+    @abc.abstractmethod
+    def local_analyze(self, train_data: Sequence, args: Any = None) -> None:
+        ...
+
+
+class FAServerAggregator(abc.ABC):
+    def __init__(self, args: Any = None) -> None:
+        self.args = args
+        self.server_data: Any = None
+
+    @abc.abstractmethod
+    def aggregate(self, local_submissions: List[Any]) -> Any:
+        ...
+
+
+# ---------------------------------------------------------------------------
+# analyzers / aggregators
+# ---------------------------------------------------------------------------
+
+class AvgAnalyzer(FAClientAnalyzer):
+    def local_analyze(self, train_data, args=None):
+        vals = np.asarray(list(train_data), np.float64)
+        self.set_client_submission((float(vals.mean()), len(vals)))
+
+
+class AvgAggregator(FAServerAggregator):
+    def aggregate(self, subs):
+        tot = sum(n for _, n in subs)
+        self.server_data = sum(m * n for m, n in subs) / max(tot, 1)
+        return self.server_data
+
+
+class IntersectionAnalyzer(FAClientAnalyzer):
+    def local_analyze(self, train_data, args=None):
+        self.set_client_submission(set(train_data))
+
+
+class IntersectionAggregator(FAServerAggregator):
+    """PSI capability: set intersection across clients."""
+
+    def aggregate(self, subs):
+        out = set(subs[0])
+        for s in subs[1:]:
+            out &= set(s)
+        self.server_data = out
+        return out
+
+
+class UnionAggregator(FAServerAggregator):
+    def aggregate(self, subs):
+        out = set()
+        for s in subs:
+            out |= set(s)
+        self.server_data = out
+        return out
+
+
+class CardinalityAggregator(FAServerAggregator):
+    def aggregate(self, subs):
+        out = set()
+        for s in subs:
+            out |= set(s)
+        self.server_data = len(out)
+        return self.server_data
+
+
+class FrequencyAnalyzer(FAClientAnalyzer):
+    def local_analyze(self, train_data, args=None):
+        self.set_client_submission(Counter(train_data))
+
+
+class FrequencyAggregator(FAServerAggregator):
+    def aggregate(self, subs):
+        total: Counter = Counter()
+        for c in subs:
+            total.update(c)
+        n = sum(total.values())
+        self.server_data = {k: v / n for k, v in total.items()}
+        return self.server_data
+
+
+class KPercentileAnalyzer(FAClientAnalyzer):
+    def local_analyze(self, train_data, args=None):
+        self.set_client_submission(sorted(float(v) for v in train_data))
+
+
+class KPercentileAggregator(FAServerAggregator):
+    """Exact k-percentile over pooled sorted client lists (the reference
+    implements an iterative secure variant; capability = the statistic)."""
+
+    def __init__(self, args=None, k: float = 50.0) -> None:
+        super().__init__(args)
+        self.k = float(getattr(args, "k_percentile", k) or k)
+
+    def aggregate(self, subs):
+        pooled = np.concatenate([np.asarray(s, np.float64) for s in subs])
+        self.server_data = float(np.percentile(pooled, self.k))
+        return self.server_data
+
+
+class TrieHHAnalyzer(FAClientAnalyzer):
+    """Heavy-hitter discovery: each round a sampled client votes for the
+    prefix of its (hashed-selected) item extending the current trie."""
+
+    def __init__(self, args=None) -> None:
+        super().__init__(args)
+        self.cur_prefixes: List[str] = [""]
+        self.prefix_len = 1
+
+    def local_analyze(self, train_data, args=None):
+        votes: Counter = Counter()
+        for w in train_data:
+            w = str(w)
+            for p in self.cur_prefixes:
+                if w.startswith(p) and len(w) >= self.prefix_len:
+                    votes[w[: self.prefix_len]] += 1
+        self.set_client_submission(votes)
+
+
+class TrieHHAggregator(FAServerAggregator):
+    def __init__(self, args=None, theta: int = 2, max_len: int = 10) -> None:
+        super().__init__(args)
+        self.theta = int(getattr(args, "triehh_theta", theta) or theta)
+        self.max_len = int(getattr(args, "triehh_max_len", max_len) or max_len)
+
+    def aggregate(self, subs):
+        votes: Counter = Counter()
+        for c in subs:
+            votes.update(c)
+        self.server_data = sorted(
+            p for p, v in votes.items() if v >= self.theta)
+        return self.server_data
+
+
+FA_TASKS: Dict[str, Tuple[type, type]] = {
+    "avg": (AvgAnalyzer, AvgAggregator),
+    "intersection": (IntersectionAnalyzer, IntersectionAggregator),
+    "union": (IntersectionAnalyzer, UnionAggregator),
+    "cardinality": (IntersectionAnalyzer, CardinalityAggregator),
+    "frequency": (FrequencyAnalyzer, FrequencyAggregator),
+    "k_percentile": (KPercentileAnalyzer, KPercentileAggregator),
+    "heavy_hitter_triehh": (TrieHHAnalyzer, TrieHHAggregator),
+}
+
+
+class FASimulator:
+    """SP simulator (reference `fa/simulation/sp/simulator.py`): run the
+    analyzer on every client's data, aggregate on the server.  TrieHH runs
+    ``comm_round`` prefix-extension rounds."""
+
+    def __init__(self, args: Any, client_datasets: Dict[int, Sequence]):
+        self.args = args
+        self.datasets = client_datasets
+        task = str(getattr(args, "fa_task", "avg")).lower()
+        if task not in FA_TASKS:
+            raise ValueError(f"unknown FA task {task!r}; known: "
+                             f"{sorted(FA_TASKS)}")
+        a_cls, g_cls = FA_TASKS[task]
+        self.task = task
+        self.analyzer = a_cls(args)
+        self.aggregator = g_cls(args)
+
+    def run(self) -> Any:
+        if self.task == "heavy_hitter_triehh":
+            return self._run_triehh()
+        subs = []
+        for cid, data in self.datasets.items():
+            self.analyzer.set_id(cid)
+            self.analyzer.local_analyze(data, self.args)
+            subs.append(self.analyzer.get_client_submission())
+        result = self.aggregator.aggregate(subs)
+        logging.info("FA %s result: %s", self.task, result)
+        return result
+
+    def _run_triehh(self) -> List[str]:
+        rounds = int(getattr(self.args, "comm_round", 5) or 5)
+        prefixes = [""]
+        for r in range(rounds):
+            self.analyzer.cur_prefixes = prefixes
+            self.analyzer.prefix_len = r + 1
+            subs = []
+            for cid, data in self.datasets.items():
+                self.analyzer.set_id(cid)
+                self.analyzer.local_analyze(data, self.args)
+                subs.append(self.analyzer.get_client_submission())
+            new_prefixes = self.aggregator.aggregate(subs)
+            if not new_prefixes:
+                break
+            prefixes = new_prefixes
+        return prefixes

@@ -1,0 +1,98 @@
+"""Expert parallelism — MoE layer with experts sharded over a mesh axis.
+
+NEW capability vs the reference (SURVEY §2.14: EP absent).  Top-1 (switch)
+routing; experts live on the `expert` mesh axis; token dispatch/combine is an
+einsum against a one-hot dispatch mask, which XLA lowers to all-to-all over
+ICI when the expert axis is sharded.  Capacity-factor dropping keeps shapes
+static (mandatory under jit).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..constants import AXIS_EXPERT
+
+
+class SwitchMoE(nn.Module):
+    """Switch-style MoE FFN: router → top-1 expert, capacity-dropped."""
+
+    n_experts: int = 4
+    d_ff: int = 128
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        # x: [B, T, D] → tokens [N, D]
+        b, t, d = x.shape
+        tokens = x.reshape(b * t, d)
+        n = tokens.shape[0]
+        cap = max(1, int(self.capacity_factor * n / self.n_experts))
+
+        logits = nn.Dense(self.n_experts, dtype=self.dtype,
+                          name="router")(tokens)           # [N, E]
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        expert_idx = jnp.argmax(probs, axis=-1)            # [N]
+        gate = jnp.max(probs, axis=-1)                     # [N]
+
+        # position of each token within its expert's queue
+        onehot = jax.nn.one_hot(expert_idx, self.n_experts)        # [N, E]
+        pos = jnp.cumsum(onehot, axis=0) * onehot                  # [N, E]
+        pos_in_expert = jnp.sum(pos, axis=-1) - 1.0                # [N]
+        keep = pos_in_expert < cap
+        gate = gate * keep
+
+        # dispatch tensor [N, E, C]
+        dispatch = (onehot[:, :, None]
+                    * jax.nn.one_hot(pos_in_expert.astype(jnp.int32),
+                                     cap)[:, None, :]
+                    * keep[:, None, None])
+        expert_in = jnp.einsum("nec,nd->ecd", dispatch, tokens)    # [E, C, D]
+
+        # expert FFNs: stacked params with leading E axis (shardable over
+        # the `expert` mesh axis)
+        w1 = self.param("w1", nn.initializers.lecun_normal(),
+                        (self.n_experts, d, self.d_ff), jnp.float32)
+        b1 = self.param("b1", nn.initializers.zeros,
+                        (self.n_experts, self.d_ff), jnp.float32)
+        w2 = self.param("w2", nn.initializers.lecun_normal(),
+                        (self.n_experts, self.d_ff, d), jnp.float32)
+        b2 = self.param("b2", nn.initializers.zeros,
+                        (self.n_experts, d), jnp.float32)
+        h = jnp.einsum("ecd,edf->ecf", expert_in, w1.astype(self.dtype))
+        h = nn.relu(h + b1[:, None, :].astype(self.dtype))
+        expert_out = jnp.einsum("ecf,efd->ecd", h, w2.astype(self.dtype)) \
+            + b2[:, None, :].astype(self.dtype)
+
+        # combine back [N, D]
+        out = jnp.einsum("nec,ecd->nd", dispatch, expert_out)
+        out = out * gate[:, None].astype(self.dtype)
+
+        # aux load-balancing loss (Switch): stored for the caller
+        me = jnp.mean(onehot, axis=0)
+        ce = jnp.mean(probs, axis=0)
+        self.sow("intermediates", "moe_aux_loss",
+                 self.n_experts * jnp.sum(me * ce))
+        return out.reshape(b, t, d)
+
+
+def moe_param_shardings(params: Any, mesh: Mesh) -> Any:
+    """Shard stacked expert weights over the `expert` axis."""
+    from jax.sharding import NamedSharding
+
+    def spec(path, leaf):
+        names = [str(getattr(p, "key", "")) for p in path]
+        if any(nm in ("w1", "w2", "b1", "b2") for nm in names) \
+                and AXIS_EXPERT in mesh.shape \
+                and jnp.shape(leaf)[0] % mesh.shape[AXIS_EXPERT] == 0:
+            return NamedSharding(mesh, P(AXIS_EXPERT))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(spec, params)

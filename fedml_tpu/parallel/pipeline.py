@@ -1,0 +1,82 @@
+"""Pipeline parallelism — GPipe-style microbatch schedule over a mesh axis.
+
+NEW capability vs the reference (SURVEY §2.14: PP absent).  Stages are a
+STACKED pytree (leading axis = stage, sharded over the `pipe` mesh axis);
+activations shift between neighbor devices with ``lax.ppermute`` inside
+``shard_map`` — the classic TPU pipelining pattern (no host scheduling).
+
+Schedule: with S stages and M microbatches, runs S+M−1 ticks; device s
+processes microbatch m at tick s+m.  Bubble fraction = (S−1)/(S+M−1).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..constants import AXIS_PIPE
+
+
+def make_pipeline_fn(stage_fn: Callable, mesh: Mesh,
+                     n_microbatches: int, axis_name: str = AXIS_PIPE):
+    """``stage_fn(stage_params, x) -> y`` applied across S pipeline stages.
+
+    Inputs to the returned fn:
+      stacked_params — pytree, leaves [S, ...] sharded over `pipe`
+      x              — [M, mb, ...] microbatched input (replicated)
+    Returns y [M, mb, ...] — the output of the LAST stage per microbatch.
+    """
+    n_stages = mesh.shape[axis_name]
+
+    param_spec = P(axis_name)
+    in_spec = (param_spec, P())
+    out_spec = P()
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
+             check_vma=False)
+    def pipeline(stage_params_local, x):
+        # stage_params_local leaves: [1, ...] (this device's stage)
+        my_params = jax.tree_util.tree_map(lambda t: t[0], stage_params_local)
+        idx = jax.lax.axis_index(axis_name)
+        m, mb = x.shape[0], x.shape[1]
+        feat = x.shape[2:]
+        total_ticks = n_stages + m - 1
+
+        shift_perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+
+        def tick(t, carry):
+            buf_in, outputs = carry
+            # stage 0 ingests microbatch t (if valid), others use buf_in
+            mb_idx = jnp.clip(t, 0, m - 1)
+            x_in = jnp.where(idx == 0, x[mb_idx], buf_in)
+            y = stage_fn(my_params, x_in)
+            # last stage writes its finished microbatch t-(S-1)
+            out_idx = jnp.clip(t - (n_stages - 1), 0, m - 1)
+            valid_out = jnp.logical_and(idx == n_stages - 1,
+                                        t >= n_stages - 1)
+            outputs = jnp.where(
+                valid_out,
+                outputs.at[out_idx].set(y),
+                outputs)
+            # shift activations to the next stage
+            buf_in = jax.lax.ppermute(y, axis_name, shift_perm)
+            return buf_in, outputs
+
+        buf0 = jnp.zeros((mb,) + feat, x.dtype)
+        outs0 = jnp.zeros((m, mb) + feat, x.dtype)
+        _, outputs = jax.lax.fori_loop(0, total_ticks, tick, (buf0, outs0))
+        # every device returns outputs; only the last stage's are real.
+        # psum-select so the replicated out_spec is consistent.
+        outputs = jnp.where(idx == n_stages - 1, outputs, 0.0)
+        return jax.lax.psum(outputs, axis_name)
+
+    return pipeline
+
+
+def stack_stage_params(param_list) -> Any:
+    """[stage pytrees] → stacked pytree with leading stage axis."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *param_list)

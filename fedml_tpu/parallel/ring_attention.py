@@ -1,0 +1,111 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+NEW capability (absent in the reference — SURVEY §2.14/§5: "sequence
+parallelism: absent ... TPU-native equivalent to design fresh: ring-attention
+/ blockwise CP over a mesh axis on ICI").
+
+Design (Liu et al. 2023, blockwise ring attention): the sequence dimension is
+sharded over the ``seq`` mesh axis.  Each device holds its Q/K/V block; K/V
+blocks rotate around the ring with ``lax.ppermute`` (ICI neighbor traffic
+only) while each device accumulates its queries' attention over every block
+using the numerically-stable online-softmax (flash) recurrence:
+
+    m' = max(m, rowmax(s));  l' = l·e^{m−m'} + rowsum(e^{s−m'})
+    o' = o·e^{m−m'} + e^{s−m'}·V
+
+Causal masking uses global position ids so the result is EXACTLY standard
+causal attention, independent of the ring size.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..constants import AXIS_SEQ
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, mask):
+    """One block pair: scores [B, H, Tq, Tk] → (scores_max, exp_scores, pv)."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1)                                   # [B,H,Tq]
+    e = jnp.exp(s - m[..., None])
+    e = jnp.where(mask, e, 0.0)
+    pv = jnp.einsum("bhqk,bhkd->bhqd", e, v)
+    return m, e.sum(axis=-1), pv
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   axis_name: str = AXIS_SEQ,
+                   causal: bool = True) -> jnp.ndarray:
+    """Inside shard_map: q/k/v are LOCAL blocks [B, H, T_local, D].
+    Returns the local block of the attention output."""
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    t_local = q.shape[2]
+
+    q_pos = my_idx * t_local + jnp.arange(t_local)            # global rows
+
+    def mask_for(block_idx):
+        k_pos = block_idx * t_local + jnp.arange(t_local)
+        if causal:
+            return (q_pos[:, None] >= k_pos[None, :])[None, None]
+        return jnp.ones((1, 1, t_local, t_local), bool)
+
+    # online-softmax accumulators
+    o = jnp.zeros_like(q)
+    l = jnp.zeros(q.shape[:3], q.dtype)                       # [B,H,T]
+    m = jnp.full(q.shape[:3], NEG_INF, q.dtype)
+
+    def body(i, carry):
+        o, l, m, k_blk, v_blk = carry
+        blk_idx = (my_idx - i) % axis_size                    # who owns k_blk
+        mask = mask_for(blk_idx)
+        bm, bl, bpv = _block_attn(q, k_blk, v_blk, mask)
+        new_m = jnp.maximum(m, bm)
+        alpha = jnp.exp(m - new_m)
+        beta = jnp.exp(bm - new_m)
+        o = o * alpha[..., None] + bpv * beta[..., None]
+        l = l * alpha + bl * beta
+        # rotate K/V around the ring: receive from the next rank
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return o, l, new_m, k_blk, v_blk
+
+    o, l, m, _, _ = jax.lax.fori_loop(0, axis_size, body, (o, l, m, k, v))
+    return o / jnp.maximum(l[..., None], 1e-12)
+
+
+def make_ring_attention_fn(mesh: Mesh, axis_name: str = AXIS_SEQ,
+                           causal: bool = True):
+    """shard_map-wrapped callable on GLOBAL [B, H, T, D] arrays with T
+    sharded over ``axis_name``."""
+    spec = P(None, None, axis_name, None)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+             out_specs=spec, check_vma=False)
+    def fn(q, k, v):
+        return ring_attention(q, k, v, axis_name=axis_name, causal=causal)
+
+    return fn
+
+
+def reference_attention(q, k, v, causal: bool = True) -> jnp.ndarray:
+    """Plain full attention for parity checks."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        t = q.shape[2]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)

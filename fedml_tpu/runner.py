@@ -33,8 +33,29 @@ class FedMLRunner:
                server_aggregator):
         ttype = str(getattr(args, "training_type", "simulation"))
         backend = str(getattr(args, "backend", "sp"))
+        opt = str(getattr(args, "federated_optimizer", "FedAvg"))
         if ttype == TRAINING_PLATFORM_SIMULATION:
             if backend == SIMULATION_BACKEND_SP:
+                # algorithm-structured variants run host-driven on SP
+                if opt == "HierarchicalFL":
+                    from .simulation.sp.algorithms import HierarchicalFLAPI
+                    return HierarchicalFLAPI(args, device, dataset, model,
+                                             client_trainer, server_aggregator)
+                if opt == "Decentralized":
+                    from .simulation.sp.algorithms import DecentralizedFLAPI
+                    return DecentralizedFLAPI(args, device, dataset, model,
+                                              client_trainer,
+                                              server_aggregator)
+                if opt == "Async_FedAvg":
+                    from .simulation.sp.algorithms import AsyncFedAvgAPI
+                    return AsyncFedAvgAPI(args, device, dataset, model,
+                                          client_trainer, server_aggregator)
+                if opt == "VerticalFL":
+                    from .simulation.sp.vertical_fl import VerticalFLAPI
+                    return VerticalFLAPI(args, device, dataset, model)
+                if opt == "SplitNN":
+                    from .simulation.sp.vertical_fl import SplitNNAPI
+                    return SplitNNAPI(args, device, dataset, model)
                 from .simulation.sp.fed_api import FedSimAPI
                 return FedSimAPI(args, device, dataset, model,
                                  client_trainer, server_aggregator)

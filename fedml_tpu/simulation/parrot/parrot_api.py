@@ -276,9 +276,27 @@ class ParrotAPI:
         test_batches = make_batches(x_te, y_te, bs, nb_te,
                                     self.bundle.input_dtype)
         final_metrics: Dict[str, Any] = {}
+
+        # round-level checkpoint/resume (new capability vs reference)
+        ckpt = None
+        start_round = 0
+        ckpt_dir = getattr(self.args, "checkpoint_dir", None)
+        ckpt_freq = int(getattr(self.args, "checkpoint_frequency", 10) or 10)
+        if ckpt_dir:
+            from ...utils.checkpoint import RoundCheckpointer
+
+            ckpt = RoundCheckpointer(str(ckpt_dir))
+            state = ckpt.restore()
+            if state is not None:
+                start_round = int(np.asarray(state["round_idx"])) + 1
+                self.global_vars = state["global_vars"]
+                if state.get("server_state"):
+                    self.server_state = state["server_state"]
+                logging.info("resumed from round %d", start_round - 1)
+
         ctx = (self.mesh if self.mesh is not None else _NullCtx())
         with ctx:
-            for round_idx in range(comm_rounds):
+            for round_idx in range(start_round, comm_rounds):
                 t0 = time.time()
                 client_ids = jnp.asarray(self._client_sampling(round_idx))
                 rng, sub = jax.random.split(rng)
@@ -299,6 +317,13 @@ class ParrotAPI:
                     final_metrics = metrics
                     mlops.log(metrics)
                     logging.info("parrot round %d: %s", round_idx, metrics)
+                if ckpt is not None and (round_idx % ckpt_freq == 0
+                                         or round_idx == comm_rounds - 1):
+                    ckpt.save(round_idx, {
+                        "round_idx": round_idx,
+                        "global_vars": self.global_vars,
+                        "server_state": self.server_state,
+                    })
         return final_metrics
 
 

@@ -1,0 +1,103 @@
+"""Pytree wire codec for the message-driven control plane.
+
+The reference pickles torch state_dicts over gRPC (`grpc_comm_manager.py` —
+pickled Message objects) and uploads them to S3.  Pickle of arbitrary objects
+is a security hole and torch-specific; this build serializes JAX pytrees to a
+self-describing binary format: a JSON header (treedef as nested lists +
+dtypes/shapes) plus raw little-endian buffers.  No code execution on decode.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+try:  # register bfloat16/fp8 dtypes with numpy (ships with jax)
+    import ml_dtypes  # noqa: F401
+except ImportError:
+    pass
+
+_MAGIC = b"FTPT"  # fedml-tpu pytree
+
+
+def _flatten_struct(obj: Any, leaves: List[np.ndarray]) -> Any:
+    """Replace arrays/scalars with leaf placeholders, recursing containers."""
+    if isinstance(obj, dict):
+        return {"t": "d",
+                "k": sorted(obj.keys()),
+                "v": [_flatten_struct(obj[k], leaves) for k in sorted(obj.keys())]}
+    if isinstance(obj, (list, tuple)):
+        return {"t": "l" if isinstance(obj, list) else "u",
+                "v": [_flatten_struct(x, leaves) for x in obj]}
+    if obj is None:
+        return {"t": "n"}
+    if isinstance(obj, (bool, int, float, str)):
+        return {"t": "s", "v": obj}
+    arr = np.asarray(obj)
+    leaves.append(arr)
+    return {"t": "a", "i": len(leaves) - 1}
+
+
+def _unflatten_struct(spec: Any, leaves: List[np.ndarray]) -> Any:
+    t = spec["t"]
+    if t == "d":
+        return {k: _unflatten_struct(v, leaves)
+                for k, v in zip(spec["k"], spec["v"])}
+    if t == "l":
+        return [_unflatten_struct(x, leaves) for x in spec["v"]]
+    if t == "u":
+        return tuple(_unflatten_struct(x, leaves) for x in spec["v"])
+    if t == "n":
+        return None
+    if t == "s":
+        return spec["v"]
+    return leaves[spec["i"]]
+
+
+def dumps_pytree(tree: Any) -> bytes:
+    leaves: List[np.ndarray] = []
+    struct_spec = _flatten_struct(tree, leaves)
+    header = {
+        "spec": struct_spec,
+        "leaves": [{"dtype": str(a.dtype), "shape": list(a.shape)}
+                   for a in leaves],
+    }
+    hbytes = json.dumps(header).encode()
+    out = io.BytesIO()
+    out.write(_MAGIC)
+    out.write(struct.pack("<I", len(hbytes)))
+    out.write(hbytes)
+    for a in leaves:
+        out.write(np.ascontiguousarray(a).tobytes())
+    return out.getvalue()
+
+
+def loads_pytree(data: bytes) -> Any:
+    if data[:4] != _MAGIC:
+        raise ValueError("not a fedml_tpu pytree payload")
+    hlen = struct.unpack("<I", data[4:8])[0]
+    header = json.loads(data[8:8 + hlen].decode())
+    off = 8 + hlen
+    leaves: List[np.ndarray] = []
+    for meta in header["leaves"]:
+        dt = np.dtype(meta["dtype"])
+        n = int(np.prod(meta["shape"])) if meta["shape"] else 1
+        nbytes = n * dt.itemsize
+        arr = np.frombuffer(data[off:off + nbytes], dtype=dt).reshape(
+            meta["shape"])
+        leaves.append(arr)
+        off += nbytes
+    return _unflatten_struct(header["spec"], leaves)
+
+
+def message_to_wire(msg_params: Dict[str, Any]) -> bytes:
+    """Serialize a Message's params dict (may contain pytrees)."""
+    return dumps_pytree(msg_params)
+
+
+def message_from_wire(data: bytes) -> Dict[str, Any]:
+    return loads_pytree(data)

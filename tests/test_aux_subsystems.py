@@ -1,0 +1,190 @@
+"""Aux subsystems: RDP accountant, compression, flow engine, checkpointing,
+federated analytics, DP end-to-end."""
+
+import threading
+
+import numpy as np
+import pytest
+
+
+def test_rdp_accountant_monotone_and_sane():
+    from fedml_tpu.core.dp.accountant.rdp_accountant import RDPAccountant
+
+    acc = RDPAccountant()
+    acc.step(noise_multiplier=1.1, sample_rate=0.01, num_steps=100)
+    e1 = acc.get_epsilon(1e-5)
+    acc.step(noise_multiplier=1.1, sample_rate=0.01, num_steps=900)
+    e2 = acc.get_epsilon(1e-5)
+    assert 0 < e1 < e2 < 100
+    acc2 = RDPAccountant()
+    acc2.step(1.1, 0.01, 10000)
+    assert 0.5 < acc2.get_epsilon(1e-5) < 10.0
+    # closed-form check (q=1): eps = min_a [a/(2σ²) + log(1/δ)/(a−1)]
+    # σ=10, 1 step, δ=1e-5 → optimum a≈1+√(2σ²·log(1e5)) ≈ 49, eps ≈ 0.48
+    acc3 = RDPAccountant()
+    acc3.step(10.0, 1.0, 1)
+    assert 0.4 < acc3.get_epsilon(1e-5) < 0.6
+
+
+def test_topk_and_ef_compression():
+    import jax.numpy as jnp
+
+    from fedml_tpu.utils.compression import EFTopKCompressor, TopKCompressor
+
+    tree = {"a": jnp.asarray(np.random.RandomState(0).randn(100),
+                             jnp.float32),
+            "b": jnp.asarray(np.random.RandomState(1).randn(10, 10),
+                             jnp.float32)}
+    c = TopKCompressor(0.1)
+    payload, spec = c.compress(tree)
+    assert len(payload["values"]) == 20
+    back = c.decompress(payload, spec)
+    assert back["a"].shape == (100,) and back["b"].shape == (10, 10)
+    # EF: residual accumulates what wasn't sent
+    ef = EFTopKCompressor(0.1)
+    p1, spec = ef.compress(tree)
+    assert ef.residual is not None
+    dense = np.concatenate([np.ravel(np.asarray(tree["a"]))
+                            , np.ravel(np.asarray(tree["b"]))])
+    sent = np.zeros_like(dense)
+    sent[np.asarray(p1["indices"])] = np.asarray(p1["values"])
+    np.testing.assert_allclose(np.asarray(ef.residual), dense - sent,
+                               atol=1e-6)
+
+
+def test_flow_engine_three_nodes(args_factory):
+    from fedml_tpu.core.alg_frame.params import Params
+    from fedml_tpu.core.distributed.flow.fedml_flow import (
+        FedMLAlgorithmFlow,
+        FedMLExecutor,
+    )
+
+    log = []
+
+    class Server(FedMLExecutor):
+        def init_global(self):
+            log.append(("server_init", self.id))
+            return Params(value=1)
+
+        def aggregate(self):
+            v = self.get_params().get("value")
+            log.append(("server_agg", v))
+            return Params(value=v + 1)
+
+    class Client(FedMLExecutor):
+        def local_train(self):
+            v = self.get_params().get("value")
+            log.append(("client_train", self.id, v))
+            return Params(value=v * 10)
+
+    args_s = args_factory(rank=0, comm_round=2, flow_world_size=2,
+                          run_id="flow1")
+    args_c = args_factory(rank=1, comm_round=2, flow_world_size=2,
+                          run_id="flow1")
+    server_exec = Server(id=0)
+    client_exec = Client(id=1)
+
+    def build(args, my_exec):
+        flow = FedMLAlgorithmFlow(args, my_exec)
+        flow.add_flow("init_global", server_exec)
+        flow.add_flow("local_train", client_exec)
+        flow.add_flow("aggregate", server_exec)
+        flow.build()
+        return flow
+
+    f_server = build(args_s, server_exec)
+    f_client = build(args_c, client_exec)
+    t = threading.Thread(target=f_client.run_flow, daemon=True)
+    t.start()
+    f_server.run_flow()
+    t.join(timeout=10)
+    assert ("server_init", 0) in log
+    assert any(e[0] == "client_train" for e in log)
+    assert any(e[0] == "server_agg" for e in log)
+
+
+def test_checkpoint_resume_round_trip(tmp_path):
+    import jax.numpy as jnp
+
+    from fedml_tpu.utils.checkpoint import RoundCheckpointer
+
+    ck = RoundCheckpointer(str(tmp_path / "ck"))
+    state = {"round_idx": 4,
+             "global_vars": {"params": {"w": jnp.ones((3, 2))}},
+             "server_state": {}}
+    ck.save(4, state)
+    assert ck.latest_round() == 4
+    back = ck.restore()
+    np.testing.assert_array_equal(
+        np.asarray(back["global_vars"]["params"]["w"]), np.ones((3, 2)))
+
+
+def test_parrot_resumes_from_checkpoint(args_factory, tmp_path):
+    import fedml_tpu
+    from fedml_tpu.runner import FedMLRunner
+
+    def run(rounds):
+        args = fedml_tpu.init(args_factory(
+            backend="parrot", comm_round=rounds, data_scale=0.2,
+            checkpoint_dir=str(tmp_path / "ck2"), checkpoint_frequency=1))
+        device = fedml_tpu.device.get_device(args)
+        dataset = fedml_tpu.data.load(args)
+        bundle = fedml_tpu.model.create(args, dataset[-1])
+        runner = FedMLRunner(args, device, dataset, bundle)
+        out = runner.run()
+        return out, runner.runner
+
+    _, api1 = run(2)          # rounds 0..1 + checkpoints
+    out2, api2 = run(4)       # must resume at round 2
+    assert out2["round"] == 3
+    assert len(api2.metrics_history) <= 2  # only rounds 2..3 ran
+
+
+@pytest.mark.parametrize("task,expect", [
+    ("avg", 2.0),
+    ("intersection", {2}),
+    ("union", {1, 2, 3}),
+    ("cardinality", 3),
+    ("k_percentile", None),
+    ("frequency", None),
+])
+def test_fa_tasks(args_factory, task, expect):
+    from fedml_tpu.fa.fa_frame import FASimulator
+
+    data = {0: [1, 2], 1: [2, 3], 2: [2]}
+    sim = FASimulator(args_factory(fa_task=task), data)
+    result = sim.run()
+    if expect is not None:
+        assert result == expect
+
+
+def test_fa_heavy_hitter(args_factory):
+    from fedml_tpu.fa.fa_frame import FASimulator
+
+    words = ["the", "the", "then", "cat"]
+    data = {i: words for i in range(3)}
+    sim = FASimulator(args_factory(fa_task="heavy_hitter_triehh",
+                                   comm_round=3, triehh_theta=3), data)
+    result = sim.run()
+    assert "the" in result
+
+
+def test_local_dp_changes_upload(args_factory):
+    """enable_dp local: client upload must differ from noiseless params."""
+    import fedml_tpu
+    from fedml_tpu.runner import FedMLRunner
+
+    def run(dp):
+        kw = dict(comm_round=1, data_scale=0.2, run_id=f"dp{dp}")
+        if dp:
+            kw.update(enable_dp=True, dp_solution_type="local", sigma=0.05)
+        args = fedml_tpu.init(args_factory(**kw))
+        device = fedml_tpu.device.get_device(args)
+        dataset = fedml_tpu.data.load(args)
+        bundle = fedml_tpu.model.create(args, dataset[-1])
+        return FedMLRunner(args, device, dataset, bundle).run()
+
+    base = run(False)
+    noised = run(True)
+    assert np.isfinite(noised["test_loss"])
+    assert abs(base["test_loss"] - noised["test_loss"]) > 1e-9
