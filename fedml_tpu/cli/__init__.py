@@ -1,0 +1,3 @@
+from .cli import cli, main
+
+__all__ = ["cli", "main"]

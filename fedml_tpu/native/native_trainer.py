@@ -1,0 +1,56 @@
+"""NativeClientTrainer — a ClientTrainer backed by the C++ trainer.
+
+Capability parity: the reference's edge path where local training happens in
+native code while the host runtime only moves messages
+(`android/fedmlsdk/.../TrainingExecutor.java` → JNI →
+`FedMLMNNTrainer.cpp`).  This trainer plugs into the SAME planes
+(SP simulation / cross-silo managers) as the JAX trainer, proving the
+protocol is engine-agnostic.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ..core.alg_frame.client_trainer import ClientTrainer
+from . import bindings
+
+
+class NativeClientTrainer(ClientTrainer):
+    def __init__(self, bundle: Any, args: Any) -> None:
+        super().__init__(bundle, args)
+        self.classes = int(getattr(bundle, "num_classes", 10))
+        self.hidden = int(getattr(args, "native_hidden", 0) or 0)
+        self.batch_size = int(getattr(args, "batch_size", 32))
+        self.epochs = int(getattr(args, "epochs", 1))
+        self.lr = float(getattr(args, "learning_rate", 0.05))
+        self.momentum = float(getattr(args, "momentum", 0.0) or 0.0)
+        self.last_metrics: Dict[str, float] = {}
+        self.algo_state: Dict[str, Any] = {}
+        self.algo_out: Dict[str, Any] = {}
+
+    def set_num_batches(self, nb: int) -> None:  # plane-compat no-op
+        pass
+
+    def train(self, train_data, device=None, args=None) -> Dict[str, float]:
+        x, y = train_data
+        self.params = bindings.train_classifier(
+            np.asarray(x), np.asarray(y), self.classes, hidden=self.hidden,
+            epochs=self.epochs, batch=min(self.batch_size, max(len(y), 1)),
+            lr=self.lr, momentum=self.momentum,
+            seed=int(self.rng_seed) + self.id,
+            weights={k: np.array(v, np.float32, copy=True)
+                     for k, v in self.params.items() if k != "loss"}
+            if self.params else None)
+        self.last_metrics = {"train_loss": self.params["loss"]}
+        return self.last_metrics
+
+    def test(self, test_data, device=None, args=None) -> Dict[str, float]:
+        x, y = test_data
+        acc, loss = bindings.eval_classifier(
+            np.asarray(x), np.asarray(y), self.classes, self.params,
+            hidden=self.hidden)
+        return {"test_acc": acc, "test_loss": loss,
+                "test_total": float(len(y))}

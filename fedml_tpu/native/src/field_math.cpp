@@ -1,0 +1,42 @@
+#include "field_math.h"
+#include <cstddef>
+
+namespace fedml_native {
+
+int64_t pow_mod(int64_t a, int64_t e) {
+  int64_t result = 1;
+  a = mod_p(a);
+  while (e > 0) {
+    if (e & 1) result = mul_mod(result, a);
+    a = mul_mod(a, a);
+    e >>= 1;
+  }
+  return result;
+}
+
+int64_t modular_inv(int64_t a) { return pow_mod(a, kFieldPrime - 2); }
+
+std::vector<int64_t> lagrange_basis(const std::vector<int64_t>& eval_pts,
+                                    const std::vector<int64_t>& interp_pts) {
+  const size_t ne = eval_pts.size(), ni = interp_pts.size();
+  std::vector<int64_t> U(ne * ni);
+  for (size_t j = 0; j < ni; ++j) {
+    int64_t den = 1;
+    for (size_t k = 0; k < ni; ++k) {
+      if (k == j) continue;
+      den = mul_mod(den, mod_p(interp_pts[j] - interp_pts[k]));
+    }
+    const int64_t inv_den = modular_inv(den);
+    for (size_t i = 0; i < ne; ++i) {
+      int64_t num = 1;
+      for (size_t k = 0; k < ni; ++k) {
+        if (k == j) continue;
+        num = mul_mod(num, mod_p(eval_pts[i] - interp_pts[k]));
+      }
+      U[i * ni + j] = mul_mod(num, inv_den);
+    }
+  }
+  return U;
+}
+
+}  // namespace fedml_native

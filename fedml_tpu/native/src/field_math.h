@@ -1,0 +1,31 @@
+// Finite-field math over p = 2^31 - 1 for the native secure-aggregation
+// codec.  C++ counterpart of fedml_tpu/core/mpc/secagg.py (host reference:
+// the Android MobileNN C++ LightSecAgg, android/fedmlsdk/MobileNN/src/
+// security/LightSecAgg.cpp — reimplemented from the protocol, not ported).
+#pragma once
+
+#include <cstdint>
+#include <vector>
+
+namespace fedml_native {
+
+constexpr int64_t kFieldPrime = (1LL << 31) - 1;
+
+inline int64_t mod_p(int64_t a) {
+  int64_t r = a % kFieldPrime;
+  return r < 0 ? r + kFieldPrime : r;
+}
+
+inline int64_t mul_mod(int64_t a, int64_t b) {
+  // |a|,|b| < 2^31 so the product fits in int64 exactly.
+  return mod_p(mod_p(a) * mod_p(b));
+}
+
+int64_t pow_mod(int64_t a, int64_t e);
+int64_t modular_inv(int64_t a);
+
+// U[i*n_interp + j] = l_j(eval[i]) with nodes interp[].
+std::vector<int64_t> lagrange_basis(const std::vector<int64_t>& eval_pts,
+                                    const std::vector<int64_t>& interp_pts);
+
+}  // namespace fedml_native

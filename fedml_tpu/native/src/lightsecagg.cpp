@@ -1,0 +1,121 @@
+// Native LightSecAgg mask codec — C API.
+//
+// Capability parity: the reference ships a C++ LightSecAgg for its Android
+// client (android/fedmlsdk/MobileNN/src/security/LightSecAgg.cpp:134 LoC,
+// LightSecAggForMNN.cpp): finite-field mask encode / share / aggregate-
+// encoded-mask matching the Python protocol.  This codec speaks the SAME
+// protocol as fedml_tpu/core/mpc/lightsecagg.py (verified by round-trip
+// tests against the Python implementation).
+
+#include <cstdint>
+#include <cstring>
+#include <random>
+#include <vector>
+
+#include "field_math.h"
+
+using fedml_native::kFieldPrime;
+using fedml_native::lagrange_basis;
+using fedml_native::mod_p;
+using fedml_native::mul_mod;
+
+extern "C" {
+
+// y[i] = sum_j U[i,j] * X[j]  over the field; X: [m, blk], out: [ne, blk]
+static void lcc_apply(const int64_t* U, const int64_t* X, int64_t* out,
+                      int64_t ne, int64_t m, int64_t blk) {
+  for (int64_t i = 0; i < ne; ++i) {
+    for (int64_t c = 0; c < blk; ++c) out[i * blk + c] = 0;
+    for (int64_t j = 0; j < m; ++j) {
+      const int64_t u = U[i * m + j];
+      if (u == 0) continue;
+      const int64_t* xrow = X + j * blk;
+      int64_t* orow = out + i * blk;
+      for (int64_t c = 0; c < blk; ++c) {
+        orow[c] = mod_p(orow[c] + mul_mod(u, xrow[c]));
+      }
+    }
+  }
+}
+
+// Encode blocks X [m, blk] (nodes interp[0..m)) at eval points → out [ne, blk]
+void ft_lcc_encode(const int64_t* X, int64_t m, int64_t blk,
+                   const int64_t* interp_pts, int64_t n_interp,
+                   const int64_t* eval_pts, int64_t n_eval, int64_t* out) {
+  std::vector<int64_t> interp(interp_pts, interp_pts + n_interp);
+  std::vector<int64_t> eval(eval_pts, eval_pts + n_eval);
+  std::vector<int64_t> U = lagrange_basis(eval, interp);
+  lcc_apply(U.data(), X, out, n_eval, m, blk);
+}
+
+// Decode: interpolate through (eval_in[i], F[i]) and evaluate at targets.
+void ft_lcc_decode(const int64_t* F, int64_t n_in, int64_t blk,
+                   const int64_t* eval_pts_in, const int64_t* target_pts,
+                   int64_t n_target, int64_t* out) {
+  std::vector<int64_t> nodes(eval_pts_in, eval_pts_in + n_in);
+  std::vector<int64_t> targets(target_pts, target_pts + n_target);
+  std::vector<int64_t> U = lagrange_basis(targets, nodes);
+  lcc_apply(U.data(), F, out, n_target, n_in, blk);
+}
+
+// LightSecAgg mask encoding: mask [d] → n shares [n, blk]; any u reconstruct.
+// blk = ceil(d / (u - t)); k = u - t data blocks + t noise blocks.
+// Returns blk via out_blk. noise drawn from the given seed.
+void ft_mask_encode(const int64_t* mask, int64_t d, int64_t n, int64_t u,
+                    int64_t t, uint64_t seed, int64_t* out_shares,
+                    int64_t* out_blk) {
+  const int64_t k = u - t;
+  const int64_t blk = (d + k - 1) / k;
+  *out_blk = blk;
+  std::vector<int64_t> X(static_cast<size_t>(u * blk), 0);
+  for (int64_t i = 0; i < d; ++i) X[i] = mod_p(mask[i]);
+  std::mt19937_64 rng(seed);
+  std::uniform_int_distribution<int64_t> dist(0, kFieldPrime - 1);
+  for (int64_t j = k * blk; j < u * blk; ++j) X[j] = dist(rng);
+  std::vector<int64_t> beta(u), alpha(n);
+  for (int64_t j = 0; j < u; ++j) beta[j] = j + 1;
+  for (int64_t j = 0; j < n; ++j) alpha[j] = u + 1 + j;
+  ft_lcc_encode(X.data(), u, blk, beta.data(), u, alpha.data(), n,
+                out_shares);
+}
+
+// Sum of held shares over the surviving set (mod p).
+void ft_aggregate_shares(const int64_t* shares, int64_t n_shares, int64_t blk,
+                         int64_t* out) {
+  for (int64_t c = 0; c < blk; ++c) out[c] = 0;
+  for (int64_t s = 0; s < n_shares; ++s) {
+    const int64_t* row = shares + s * blk;
+    for (int64_t c = 0; c < blk; ++c) out[c] = mod_p(out[c] + row[c]);
+  }
+}
+
+// Decode the aggregate mask from u surviving clients' aggregated shares.
+// share_owner_ids: 0-based share indices the survivors held.
+void ft_decode_aggregate_mask(const int64_t* agg_shares,
+                              const int64_t* share_owner_ids, int64_t n_have,
+                              int64_t d, int64_t u, int64_t t, int64_t blk,
+                              int64_t* out_mask) {
+  std::vector<int64_t> alpha(n_have), beta(u - t);
+  for (int64_t j = 0; j < n_have; ++j)
+    alpha[j] = u + 1 + share_owner_ids[j];
+  for (int64_t j = 0; j < u - t; ++j) beta[j] = j + 1;
+  std::vector<int64_t> blocks(static_cast<size_t>((u - t) * blk));
+  ft_lcc_decode(agg_shares, n_have, blk, alpha.data(), beta.data(), u - t,
+                blocks.data());
+  for (int64_t i = 0; i < d; ++i) out_mask[i] = blocks[i];
+}
+
+// mod-2^32 bulk mask application (device-free path for edge clients)
+void ft_mask_add_u32(const uint32_t* x, const uint32_t* m, uint32_t* out,
+                     int64_t n) {
+  for (int64_t i = 0; i < n; ++i) out[i] = x[i] + m[i];
+}
+
+void ft_mask_sub_u32(const uint32_t* x, const uint32_t* m, uint32_t* out,
+                     int64_t n) {
+  for (int64_t i = 0; i < n; ++i) out[i] = x[i] - m[i];
+}
+
+int64_t ft_modular_inv(int64_t a) { return fedml_native::modular_inv(a); }
+
+}  // extern "C"

@@ -1,0 +1,208 @@
+// Native on-device client trainer — C API.
+//
+// Capability parity: the reference's MobileNN C++ trainer
+// (android/fedmlsdk/MobileNN/src/train/FedMLMNNTrainer.cpp:3-179 — loads a
+// model, runs SGD with momentum over MNIST/CIFAR/tabular data, reports
+// per-epoch progress/accuracy via callbacks).  This is the TPU-era edge
+// counterpart: a dependency-free C++ SGD trainer for linear / one-hidden-
+// layer MLP classifiers over float32 feature arrays, driven by the same
+// Python client manager through ctypes, with an epoch-progress callback.
+//
+// It deliberately does NOT use JAX/XLA: it models the phone-class client
+// that trains locally in native code and only speaks the message protocol.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <random>
+#include <vector>
+
+extern "C" {
+
+typedef void (*ft_progress_cb)(int epoch, float loss, float acc);
+
+// Softmax-regression (optional one hidden layer) SGD with momentum.
+// x: [n, d] row-major; y: [n] int labels in [0, classes)
+// w1: [d, hidden] or null if hidden == 0; b1: [hidden]
+// w2: [in2, classes] where in2 = hidden>0 ? hidden : d; b2: [classes]
+// All weight buffers are in/out (the federated round updates them in place).
+// Returns final mean loss.
+float ft_train_classifier(const float* x, const int32_t* y, int64_t n,
+                          int64_t d, int64_t classes, int64_t hidden,
+                          float* w1, float* b1, float* w2, float* b2,
+                          int64_t epochs, int64_t batch, float lr,
+                          float momentum, uint64_t seed,
+                          ft_progress_cb progress) {
+  const int64_t in2 = hidden > 0 ? hidden : d;
+  std::vector<float> h(static_cast<size_t>(batch * (hidden > 0 ? hidden : 1)));
+  std::vector<float> logits(static_cast<size_t>(batch * classes));
+  std::vector<float> probs(static_cast<size_t>(batch * classes));
+  std::vector<float> g_logits(static_cast<size_t>(batch * classes));
+  std::vector<float> g_h(static_cast<size_t>(batch * (hidden > 0 ? hidden : 1)));
+  std::vector<float> vw1(hidden > 0 ? static_cast<size_t>(d * hidden) : 0, 0.f);
+  std::vector<float> vb1(hidden > 0 ? static_cast<size_t>(hidden) : 0, 0.f);
+  std::vector<float> vw2(static_cast<size_t>(in2 * classes), 0.f);
+  std::vector<float> vb2(static_cast<size_t>(classes), 0.f);
+  std::vector<int64_t> order(static_cast<size_t>(n));
+  for (int64_t i = 0; i < n; ++i) order[i] = i;
+  std::mt19937_64 rng(seed);
+
+  float epoch_loss = 0.f;
+  for (int64_t ep = 0; ep < epochs; ++ep) {
+    std::shuffle(order.begin(), order.end(), rng);
+    epoch_loss = 0.f;
+    int64_t correct = 0, seen = 0;
+    for (int64_t s = 0; s + batch <= n; s += batch) {
+      // ---- forward ----
+      for (int64_t b = 0; b < batch; ++b) {
+        const float* xi = x + order[s + b] * d;
+        const float* feat;
+        if (hidden > 0) {
+          float* hb = h.data() + b * hidden;
+          for (int64_t j = 0; j < hidden; ++j) {
+            float acc = b1[j];
+            for (int64_t k = 0; k < d; ++k) acc += xi[k] * w1[k * hidden + j];
+            hb[j] = acc > 0.f ? acc : 0.f;  // relu
+          }
+          feat = hb;
+        } else {
+          feat = xi;
+        }
+        float* lb = logits.data() + b * classes;
+        for (int64_t c = 0; c < classes; ++c) {
+          float acc = b2[c];
+          for (int64_t k = 0; k < in2; ++k) acc += feat[k] * w2[k * classes + c];
+          lb[c] = acc;
+        }
+      }
+      // ---- softmax CE + grad ----
+      for (int64_t b = 0; b < batch; ++b) {
+        const float* lb = logits.data() + b * classes;
+        float* pb = probs.data() + b * classes;
+        float mx = lb[0];
+        for (int64_t c = 1; c < classes; ++c) mx = std::max(mx, lb[c]);
+        float z = 0.f;
+        for (int64_t c = 0; c < classes; ++c) {
+          pb[c] = std::exp(lb[c] - mx);
+          z += pb[c];
+        }
+        int32_t yi = y[order[s + b]];
+        int64_t argmax = 0;
+        for (int64_t c = 0; c < classes; ++c) {
+          pb[c] /= z;
+          if (pb[c] > pb[argmax]) argmax = c;
+        }
+        epoch_loss += -std::log(std::max(pb[yi], 1e-12f));
+        if (argmax == yi) ++correct;
+        ++seen;
+        float* gb = g_logits.data() + b * classes;
+        for (int64_t c = 0; c < classes; ++c)
+          gb[c] = (pb[c] - (c == yi ? 1.f : 0.f)) / batch;
+      }
+      // ---- backward + momentum SGD ----
+      // w2 grad = feat^T @ g_logits ; g_h = g_logits @ w2^T (through relu)
+      for (int64_t c = 0; c < classes; ++c) {
+        float gb2 = 0.f;
+        for (int64_t b = 0; b < batch; ++b)
+          gb2 += g_logits[b * classes + c];
+        vb2[c] = momentum * vb2[c] + gb2;
+        b2[c] -= lr * vb2[c];
+      }
+      for (int64_t b = 0; b < batch; ++b) {
+        const float* feat = hidden > 0 ? h.data() + b * hidden
+                                       : x + order[s + b] * d;
+        const float* gb = g_logits.data() + b * classes;
+        if (hidden > 0) {
+          float* ghb = g_h.data() + b * hidden;
+          for (int64_t k = 0; k < hidden; ++k) {
+            float acc = 0.f;
+            for (int64_t c = 0; c < classes; ++c)
+              acc += gb[c] * w2[k * classes + c];
+            ghb[k] = feat[k] > 0.f ? acc : 0.f;
+          }
+        }
+      }
+      for (int64_t k = 0; k < in2; ++k) {
+        for (int64_t c = 0; c < classes; ++c) {
+          float g = 0.f;
+          for (int64_t b = 0; b < batch; ++b) {
+            const float* feat = hidden > 0 ? h.data() + b * hidden
+                                           : x + order[s + b] * d;
+            g += feat[k] * g_logits[b * classes + c];
+          }
+          float* vp = &vw2[k * classes + c];
+          *vp = momentum * (*vp) + g;
+          w2[k * classes + c] -= lr * (*vp);
+        }
+      }
+      if (hidden > 0) {
+        for (int64_t kk = 0; kk < d; ++kk) {
+          for (int64_t j = 0; j < hidden; ++j) {
+            float g = 0.f;
+            for (int64_t b = 0; b < batch; ++b)
+              g += x[order[s + b] * d + kk] * g_h[b * hidden + j];
+            float* vp = &vw1[kk * hidden + j];
+            *vp = momentum * (*vp) + g;
+            w1[kk * hidden + j] -= lr * (*vp);
+          }
+        }
+        for (int64_t j = 0; j < hidden; ++j) {
+          float g = 0.f;
+          for (int64_t b = 0; b < batch; ++b) g += g_h[b * hidden + j];
+          vb1[j] = momentum * vb1[j] + g;
+          b1[j] -= lr * vb1[j];
+        }
+      }
+    }
+    epoch_loss = seen > 0 ? epoch_loss / seen : 0.f;
+    if (progress)
+      progress(static_cast<int>(ep), epoch_loss,
+               seen > 0 ? static_cast<float>(correct) / seen : 0.f);
+  }
+  return epoch_loss;
+}
+
+// Evaluate: returns accuracy, writes mean loss to *loss_out.
+float ft_eval_classifier(const float* x, const int32_t* y, int64_t n,
+                         int64_t d, int64_t classes, int64_t hidden,
+                         const float* w1, const float* b1, const float* w2,
+                         const float* b2, float* loss_out) {
+  const int64_t in2 = hidden > 0 ? hidden : d;
+  std::vector<float> h(static_cast<size_t>(hidden > 0 ? hidden : 1));
+  int64_t correct = 0;
+  float loss = 0.f;
+  for (int64_t i = 0; i < n; ++i) {
+    const float* xi = x + i * d;
+    const float* feat;
+    if (hidden > 0) {
+      for (int64_t j = 0; j < hidden; ++j) {
+        float acc = b1[j];
+        for (int64_t k = 0; k < d; ++k) acc += xi[k] * w1[k * hidden + j];
+        h[j] = acc > 0.f ? acc : 0.f;
+      }
+      feat = h.data();
+    } else {
+      feat = xi;
+    }
+    float mx = -1e30f;
+    std::vector<float> lg(static_cast<size_t>(classes));
+    for (int64_t c = 0; c < classes; ++c) {
+      float acc = b2[c];
+      for (int64_t k = 0; k < in2; ++k) acc += feat[k] * w2[k * classes + c];
+      lg[c] = acc;
+      mx = std::max(mx, acc);
+    }
+    float z = 0.f;
+    for (int64_t c = 0; c < classes; ++c) z += std::exp(lg[c] - mx);
+    loss += -(lg[y[i]] - mx - std::log(z));
+    int64_t am = 0;
+    for (int64_t c = 1; c < classes; ++c)
+      if (lg[c] > lg[am]) am = c;
+    if (am == y[i]) ++correct;
+  }
+  if (loss_out) *loss_out = n > 0 ? loss / n : 0.f;
+  return n > 0 ? static_cast<float>(correct) / n : 0.f;
+}
+
+}  // extern "C"

@@ -1,0 +1,131 @@
+"""Pallas TPU kernels for the framework's hot non-matmul ops.
+
+Per the TPU kernel playbook (/opt/skills/guides/pallas_guide.md): XLA already
+fuses elementwise chains into the matmuls of the training step; the ops worth
+hand-writing are the HBM-bandwidth-bound reductions the aggregation plane
+runs every round:
+
+* ``weighted_average_flat`` — the FedAvg reduction Σ_c w_c·X[c] over the
+  stacked client axis, tiled so each [C, block] tile is one VMEM-resident
+  [1,C]x[C,block] contraction on the MXU.
+* ``quantize_mask`` — SecAgg's fused quantize(+round)→int32→uint32 mask-add,
+  one pass over HBM instead of three.
+
+Both fall back to plain jnp (same math) off-TPU; tests run the pallas path
+in interpret mode for correctness.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+_BLOCK = 1024  # lane-dim block (multiple of 128)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# weighted average over stacked clients
+# ---------------------------------------------------------------------------
+
+def _wavg_kernel(w_ref, x_ref, o_ref):
+    # x_ref: [C, BLOCK] VMEM tile; w_ref: [1, C] (normalized weights)
+    o_ref[:] = jnp.dot(w_ref[:], x_ref[:],
+                       preferred_element_type=jnp.float32)
+
+
+def weighted_average_flat(stacked: jnp.ndarray, weights: jnp.ndarray,
+                          interpret: Optional[bool] = None) -> jnp.ndarray:
+    """[C, D] stacked flat updates, [C] weights → [D] weighted average."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    c, d = stacked.shape
+    norm = jnp.maximum(jnp.sum(weights), 1e-12)
+    w = (weights / norm).astype(jnp.float32).reshape(1, c)
+    if not _HAS_PALLAS:
+        return (w @ stacked.astype(jnp.float32)).reshape(d)
+    pad = (-d) % _BLOCK
+    x = jnp.pad(stacked.astype(jnp.float32), ((0, 0), (0, pad)))
+    dp = d + pad
+    grid = (dp // _BLOCK,)
+    out = pl.pallas_call(
+        _wavg_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+            pl.BlockSpec((c, _BLOCK), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, _BLOCK), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((1, dp), jnp.float32),
+        interpret=interpret,
+    )(w, x)
+    return out.reshape(dp)[:d]
+
+
+def agg_stacked_pallas(stacked_tree: Any, weights: jnp.ndarray,
+                       interpret: Optional[bool] = None) -> Any:
+    """Pytree variant of `agg_stacked` routed through the pallas reduction:
+    flattens leaves into one [C, D] matrix, reduces once, unflattens."""
+    leaves, treedef = jax.tree_util.tree_flatten(stacked_tree)
+    c = leaves[0].shape[0]
+    flat = jnp.concatenate(
+        [leaf.reshape(c, -1).astype(jnp.float32) for leaf in leaves], axis=1)
+    avg = weighted_average_flat(flat, weights, interpret=interpret)
+    out, off = [], 0
+    for leaf in leaves:
+        shape = leaf.shape[1:]
+        size = int(jnp.size(leaf) // c)
+        out.append(avg[off:off + size].reshape(shape).astype(leaf.dtype))
+        off += size
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# ---------------------------------------------------------------------------
+# fused quantize + mask (SecAgg bulk path)
+# ---------------------------------------------------------------------------
+
+def _qmask_kernel(x_ref, m_ref, o_ref, *, scale):
+    q = jnp.round(x_ref[:] * scale).astype(jnp.int32)
+    o_ref[:] = q.view(jnp.uint32) + m_ref[:]
+
+
+def quantize_mask(x: jnp.ndarray, mask: jnp.ndarray, scale: float = 2.0**16,
+                  interpret: Optional[bool] = None) -> jnp.ndarray:
+    """float32 [D] + uint32 mask [D] → masked uint32 [D] in one HBM pass."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    if not _HAS_PALLAS:
+        q = jnp.round(x.astype(jnp.float32) * scale).astype(jnp.int32)
+        return q.view(jnp.uint32) + mask
+    d = x.shape[0]
+    pad = (-d) % _BLOCK
+    xp = jnp.pad(x.astype(jnp.float32), (0, pad)).reshape(1, -1)
+    mp = jnp.pad(mask, (0, pad)).reshape(1, -1)
+    dp = d + pad
+    out = pl.pallas_call(
+        functools.partial(_qmask_kernel, scale=scale),
+        grid=(dp // _BLOCK,),
+        in_specs=[pl.BlockSpec((1, _BLOCK), lambda i: (0, i)),
+                  pl.BlockSpec((1, _BLOCK), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((1, _BLOCK), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((1, dp), jnp.uint32),
+        interpret=interpret,
+    )(xp, mp)
+    return out.reshape(dp)[:d]

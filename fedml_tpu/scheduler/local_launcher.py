@@ -1,0 +1,180 @@
+"""Local job launcher — the launch/agent slice of the control plane.
+
+Capability parity: reference `computing/scheduler/scheduler_entry/
+launch_manager.py:25-645` (parse job.yaml: workspace, job commands,
+bootstrap, resources; build run packages) and the slave agent's job
+execution path (`slave/client_runner.py`: unzip package, rewrite config, run
+bootstrap, spawn the job with live log capture, track status —
+`comm_utils/subprocess_with_live_logs.py`).
+
+Scope note (documented): the hosted Nexus REST backend / GPU-matching
+marketplace is out of scope for a framework build; `launch_job_local` runs
+the SAME job.yaml contract on the local machine, and `build_job_package`
+produces the same zip layout, so jobs are portable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shlex
+import sqlite3
+import subprocess
+import time
+import uuid
+import zipfile
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+
+@dataclasses.dataclass
+class JobConfig:
+    """job.yaml schema (reference FedMLJobConfig:407)."""
+
+    workspace: str
+    job: str                      # the command(s) to run
+    bootstrap: str = ""
+    job_name: str = ""
+    computing: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    env: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "JobConfig":
+        with open(path) as f:
+            raw = yaml.safe_load(f) or {}
+        return cls(
+            workspace=str(raw.get("workspace", ".")),
+            job=str(raw.get("job", "")),
+            bootstrap=str(raw.get("bootstrap", "") or ""),
+            job_name=str(raw.get("job_name", "")
+                         or f"job_{uuid.uuid4().hex[:8]}"),
+            computing=dict(raw.get("computing", {}) or {}),
+            env=dict(raw.get("fedml_env", {}) or {}),
+        )
+
+
+@dataclasses.dataclass
+class LaunchResult:
+    run_id: str
+    returncode: int
+    log_path: str
+
+
+def _runs_dir() -> str:
+    d = os.path.join(os.path.expanduser("~"), ".fedml_tpu", "runs")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _db() -> sqlite3.Connection:
+    """Run/job state db (reference `slave/client_data_interface.py` sqlite)."""
+    conn = sqlite3.connect(os.path.join(_runs_dir(), "jobs.db"))
+    conn.execute(
+        "CREATE TABLE IF NOT EXISTS runs (run_id TEXT PRIMARY KEY, "
+        "job_name TEXT, status TEXT, returncode INTEGER, log_path TEXT, "
+        "created REAL, finished REAL)")
+    return conn
+
+
+def build_job_package(job_yaml_path: str, out_dir: Optional[str] = None
+                      ) -> str:
+    """Zip the workspace + job.yaml (reference `_build_job_package:300`)."""
+    cfg = JobConfig.from_yaml(job_yaml_path)
+    base = os.path.dirname(os.path.abspath(job_yaml_path))
+    workspace = os.path.normpath(os.path.join(base, cfg.workspace))
+    out_dir = out_dir or _runs_dir()
+    os.makedirs(out_dir, exist_ok=True)
+    zip_path = os.path.join(out_dir, f"{cfg.job_name}.zip")
+    with zipfile.ZipFile(zip_path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.write(job_yaml_path, "job.yaml")
+        for root, _dirs, files in os.walk(workspace):
+            for fn in files:
+                full = os.path.join(root, fn)
+                rel = os.path.relpath(full, workspace)
+                z.write(full, os.path.join("workspace", rel))
+    return zip_path
+
+
+def launch_job_local(job_yaml_path: str,
+                     extra_env: Optional[Dict[str, str]] = None
+                     ) -> LaunchResult:
+    """Run bootstrap then the job command(s) with live log capture."""
+    cfg = JobConfig.from_yaml(job_yaml_path)
+    base = os.path.dirname(os.path.abspath(job_yaml_path))
+    workspace = os.path.normpath(os.path.join(base, cfg.workspace))
+    run_id = uuid.uuid4().hex[:12]
+    log_path = os.path.join(_runs_dir(), f"{run_id}.log")
+    env = dict(os.environ)
+    env.update({k: str(v) for k, v in cfg.env.items()})
+    if extra_env:
+        env.update(extra_env)
+    env["FEDML_CURRENT_RUN_ID"] = run_id
+
+    conn = _db()
+    conn.execute("INSERT INTO runs VALUES (?,?,?,?,?,?,?)",
+                 (run_id, cfg.job_name, "RUNNING", None, log_path,
+                  time.time(), None))
+    conn.commit()
+
+    rc = 0
+    with open(log_path, "w") as log:
+        for label, script in (("bootstrap", cfg.bootstrap), ("job", cfg.job)):
+            if not script.strip():
+                continue
+            log.write(f"===== {label} =====\n")
+            log.flush()
+            proc = subprocess.Popen(
+                ["bash", "-c", script], cwd=workspace, env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+            for line in proc.stdout:  # live log capture
+                log.write(line)
+                log.flush()
+            proc.wait()
+            rc = proc.returncode
+            if rc != 0:
+                break
+    conn.execute("UPDATE runs SET status=?, returncode=?, finished=? "
+                 "WHERE run_id=?",
+                 ("FINISHED" if rc == 0 else "FAILED", rc, time.time(),
+                  run_id))
+    conn.commit()
+    conn.close()
+    return LaunchResult(run_id=run_id, returncode=rc, log_path=log_path)
+
+
+def list_runs(limit: int = 20) -> List[Dict[str, Any]]:
+    conn = _db()
+    rows = conn.execute(
+        "SELECT run_id, job_name, status, returncode, log_path, created "
+        "FROM runs ORDER BY created DESC LIMIT ?", (limit,)).fetchall()
+    conn.close()
+    return [dict(zip(("run_id", "job_name", "status", "returncode",
+                      "log_path", "created"), r)) for r in rows]
+
+
+def collect_env() -> Dict[str, Any]:
+    """Environment report (reference `env/collect_env.py:10`)."""
+    import platform
+
+    info: Dict[str, Any] = {
+        "fedml_tpu_version": __import__("fedml_tpu").__version__
+        if hasattr(__import__("fedml_tpu"), "__version__") else "0.1.0",
+        "python": platform.python_version(),
+        "platform": platform.platform(),
+    }
+    try:
+        import jax
+
+        info["jax"] = jax.__version__
+        info["devices"] = [str(d) for d in jax.devices()]
+        info["default_backend"] = jax.default_backend()
+    except Exception as e:  # noqa: BLE001
+        info["jax_error"] = str(e)
+    for mod in ("flax", "optax", "numpy"):
+        try:
+            info[mod] = __import__(mod).__version__
+        except Exception:
+            pass
+    return info

@@ -1,0 +1,4 @@
+from .fedml_inference_runner import FedMLInferenceRunner
+from .fedml_predictor import FedMLPredictor
+
+__all__ = ["FedMLPredictor", "FedMLInferenceRunner"]

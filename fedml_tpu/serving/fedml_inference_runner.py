@@ -1,0 +1,121 @@
+"""FedMLInferenceRunner — HTTP inference endpoint.
+
+Capability parity: reference `serving/fedml_inference_runner.py:8-60` —
+FastAPI app with POST /predict (streaming supported via generator responses)
+and GET /ready.  This build prefers FastAPI when installed and falls back to
+a dependency-free stdlib ThreadingHTTPServer with identical routes, so the
+serving plane works in the zero-dependency image.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from typing import Any, Optional
+
+from .fedml_predictor import FedMLPredictor
+
+
+class FedMLInferenceRunner:
+    def __init__(self, predictor: FedMLPredictor, host: str = "0.0.0.0",
+                 port: int = 2345) -> None:
+        self.predictor = predictor
+        self.host = host
+        self.port = port
+        self._server = None
+
+    # -- fastapi path --------------------------------------------------------
+    def _try_fastapi(self) -> bool:
+        try:
+            import uvicorn
+            from fastapi import FastAPI, Request
+            from fastapi.responses import StreamingResponse
+        except ImportError:
+            return False
+        app = FastAPI()
+        predictor = self.predictor
+
+        @app.post("/predict")
+        async def predict(request: Request):
+            body = await request.json()
+            result = predictor.predict(body)
+            if hasattr(result, "__iter__") and not isinstance(
+                    result, (dict, list, str, bytes)):
+                return StreamingResponse(result)
+            return result
+
+        @app.get("/ready")
+        async def ready():
+            return {"ready": predictor.ready()}
+
+        uvicorn.run(app, host=self.host, port=self.port)
+        return True
+
+    # -- stdlib fallback -----------------------------------------------------
+    def _serve_stdlib(self, block: bool) -> None:
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        predictor = self.predictor
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                logging.debug("serving: " + fmt, *args)
+
+            def do_GET(self):
+                if self.path == "/ready":
+                    self._json(200, {"ready": predictor.ready()})
+                else:
+                    self._json(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._json(404, {"error": "not found"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    result = predictor.predict(body)
+                except Exception as e:  # noqa: BLE001
+                    self._json(500, {"error": str(e)})
+                    return
+                if hasattr(result, "__iter__") and not isinstance(
+                        result, (dict, list, str, bytes)):
+                    # streaming: chunked transfer of generator output
+                    self.send_response(200)
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.send_header("Content-Type", "text/plain")
+                    self.end_headers()
+                    for chunk in result:
+                        data = (chunk if isinstance(chunk, bytes)
+                                else str(chunk).encode())
+                        self.wfile.write(
+                            f"{len(data):X}\r\n".encode() + data + b"\r\n")
+                    self.wfile.write(b"0\r\n\r\n")
+                    return
+                self._json(200, result)
+
+            def _json(self, code: int, obj: Any) -> None:
+                data = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        logging.info("inference endpoint on %s:%d", self.host, self.port)
+        if block:
+            self._server.serve_forever()
+        else:
+            threading.Thread(target=self._server.serve_forever,
+                             daemon=True).start()
+
+    def run(self, block: bool = True, prefer_fastapi: bool = True) -> None:
+        if prefer_fastapi and block and self._try_fastapi():
+            return
+        self._serve_stdlib(block)
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()

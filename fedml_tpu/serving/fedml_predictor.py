@@ -1,0 +1,23 @@
+"""FedMLPredictor — user-facing inference contract.
+
+Capability parity: reference `serving/fedml_predictor.py:4-22` (ABC with
+``predict``) used by the deploy plane's gateway.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any
+
+
+class FedMLPredictor(abc.ABC):
+    def __init__(self) -> None:
+        pass
+
+    @abc.abstractmethod
+    def predict(self, request: Any) -> Any:
+        """request: decoded JSON dict; returns a JSON-serializable response
+        or a generator of chunks (streaming)."""
+
+    def ready(self) -> bool:
+        return True

@@ -127,7 +127,9 @@ class ParrotAPI:
                 AXIS_CLIENTS: min(len(jax.devices()), self.k)}
             self.mesh = build_mesh(shape)
 
-        self.round_step = jax.jit(self._build_round_step())
+        self.round_step = jax.jit(self._build_round_step(),
+                                  donate_argnums=(0, 1))
+        self.multi_round_step = None  # built lazily for the scan fast path
         self.metrics_history: List[Dict[str, Any]] = []
 
     def _find_rows(self, cid: int, n_i: int) -> np.ndarray:
@@ -259,6 +261,47 @@ class ParrotAPI:
         return round_step
 
     # ------------------------------------------------------------------
+    def _build_multi_round_step(self):
+        """Scan-rounds fast path: R rounds inside ONE jit dispatch.
+
+        Amortizes per-call dispatch/transfer overhead (dominant when client
+        models are small or the device is remote).  Client sampling moves
+        on-device (`jax.random.permutation`), which deliberately diverges
+        from the reference's host `np.random.seed(round)` stream — same
+        distribution, different draws; the default per-round path keeps
+        reference parity.
+        """
+        round_step = self._build_round_step()
+        k = self.k
+        n_total = self.n_total
+
+        def multi(global_vars, server_state, rng, n_rounds_arr):
+            def body(carry, r):
+                gv, st, rng = carry
+                rng, k1, k2 = jax.random.split(rng, 3)
+                ids = jax.random.permutation(k1, n_total)[:k]
+                gv, st, rm = round_step(gv, st, ids, k2)
+                return (gv, st, rng), rm
+
+            (gv, st, _), rms = jax.lax.scan(
+                body, (global_vars, server_state, rng),
+                jnp.arange(n_rounds_arr.shape[0]))
+            return gv, st, rms
+
+        return jax.jit(multi, donate_argnums=(0, 1))
+
+    def run_rounds_fused(self, n_rounds: int, rng: Optional[jax.Array] = None):
+        """Public fast path: run n_rounds fused; returns stacked metrics."""
+        if self.multi_round_step is None:
+            self.multi_round_step = self._build_multi_round_step()
+        if rng is None:
+            rng = jax.random.PRNGKey(
+                int(getattr(self.args, "random_seed", 0) or 0) + 23)
+        self.global_vars, self.server_state, rms = self.multi_round_step(
+            self.global_vars, self.server_state, rng,
+            jnp.zeros((int(n_rounds),)))
+        return rms
+
     def _client_sampling(self, round_idx: int) -> np.ndarray:
         if self.n_total == self.k:
             return np.arange(self.k, dtype=np.int32)

@@ -1,0 +1,77 @@
+"""LoRA for flax param pytrees.
+
+Capability parity: reference `train/llm/configurations.py:161-324` (PEFT/LoRA
+config) — but implemented functionally: LoRA is a TRANSFORM on the param
+pytree, not a model wrapper.  ``init_lora`` allocates (A, B) factors for every
+kernel matching the target patterns; ``apply_lora`` returns effective params
+W + (alpha/r)·(A@B); training optimizes only the LoRA leaves, which composes
+with any jitted loss because everything is pure tree math.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_TARGETS = (r".*attention.*kernel", r".*(query|key|value|out).*kernel",
+                   r".*Dense_\d+.*kernel")
+
+
+def _path_str(path) -> str:
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                    for p in path)
+
+
+def _is_target(path: str, shape, targets: Sequence[str]) -> bool:
+    if len(shape) != 2:
+        return False
+    return any(re.fullmatch(t, path, flags=re.IGNORECASE) for t in targets)
+
+
+def init_lora(params: Any, rank: int = 8, targets: Sequence[str] = None,
+              rng: jax.Array = None, dtype=jnp.float32) -> Dict[str, Any]:
+    """→ {path: {"a": [d_in, r], "b": [r, d_out]}} for each targeted kernel."""
+    targets = tuple(targets or DEFAULT_TARGETS)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    lora: Dict[str, Any] = {}
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    for i, (path, leaf) in enumerate(flat):
+        p = _path_str(path)
+        if _is_target(p, jnp.shape(leaf), targets):
+            k = jax.random.fold_in(rng, i)
+            d_in, d_out = leaf.shape
+            lora[p] = {
+                "a": (jax.random.normal(k, (d_in, rank)) * 0.01).astype(dtype),
+                "b": jnp.zeros((rank, d_out), dtype),
+            }
+    return lora
+
+
+def apply_lora(params: Any, lora: Dict[str, Any], alpha: float = 16.0
+               ) -> Any:
+    """Effective params: W' = W + (alpha/r)·A@B for targeted kernels."""
+    if not lora:
+        return params
+    some = next(iter(lora.values()))
+    scale = alpha / some["a"].shape[1]
+
+    def update(path, leaf):
+        p = _path_str(path)
+        if p in lora:
+            ab = (lora[p]["a"] @ lora[p]["b"]).astype(leaf.dtype)
+            return leaf + scale * ab
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(update, params)
+
+
+def merge_lora(params: Any, lora: Dict[str, Any], alpha: float = 16.0) -> Any:
+    """Bake LoRA into the base weights (for serving/export)."""
+    return apply_lora(params, lora, alpha)
+
+
+def count_trainable(lora: Dict[str, Any]) -> int:
+    return sum(int(jnp.size(v)) for d in lora.values() for v in d.values())

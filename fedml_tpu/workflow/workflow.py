@@ -1,0 +1,108 @@
+"""Workflow — DAG of jobs with topological execution and loop mode.
+
+Capability parity: reference `workflow/workflow.py:14-151` + `jobs.py` — jobs
+with dependencies, toposorted execution, `loop` mode re-running the DAG, and
+job output→input chaining.
+"""
+
+from __future__ import annotations
+
+import abc
+import logging
+from typing import Any, Callable, Dict, List, Optional, Set
+
+
+class Job(abc.ABC):
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.input: Dict[str, Any] = {}
+        self.output: Dict[str, Any] = {}
+        self.status = "pending"
+
+    @abc.abstractmethod
+    def run(self) -> None:
+        ...
+
+    def kill(self) -> None:
+        self.status = "killed"
+
+
+class CallableJob(Job):
+    """Wrap a python callable: output = fn(input)."""
+
+    def __init__(self, name: str, fn: Callable[[Dict[str, Any]],
+                                               Optional[Dict[str, Any]]]):
+        super().__init__(name)
+        self.fn = fn
+
+    def run(self) -> None:
+        self.status = "running"
+        out = self.fn(self.input)
+        self.output = out or {}
+        self.status = "finished"
+
+
+class LaunchJob(Job):
+    """Run a job.yaml via the local launcher (reference: launch-backed jobs)."""
+
+    def __init__(self, name: str, job_yaml_path: str) -> None:
+        super().__init__(name)
+        self.job_yaml_path = job_yaml_path
+
+    def run(self) -> None:
+        from ..scheduler.local_launcher import launch_job_local
+
+        self.status = "running"
+        result = launch_job_local(self.job_yaml_path)
+        self.output = {"returncode": result.returncode,
+                       "log_path": result.log_path}
+        self.status = "finished" if result.returncode == 0 else "failed"
+
+
+class Workflow:
+    def __init__(self, name: str, loop: bool = False,
+                 max_loops: int = 1) -> None:
+        self.name = name
+        self.loop = loop
+        self.max_loops = max(int(max_loops), 1)
+        self.jobs: Dict[str, Job] = {}
+        self.deps: Dict[str, Set[str]] = {}
+
+    def add_job(self, job: Job, dependencies: Optional[List[Job]] = None
+                ) -> None:
+        self.jobs[job.name] = job
+        self.deps[job.name] = {d.name for d in (dependencies or [])}
+
+    def _toposort(self) -> List[str]:
+        order: List[str] = []
+        done: Set[str] = set()
+        remaining = dict(self.deps)
+        while remaining:
+            ready = [n for n, ds in remaining.items() if ds <= done]
+            if not ready:
+                raise ValueError(f"workflow {self.name}: dependency cycle in "
+                                 f"{sorted(remaining)}")
+            for n in sorted(ready):
+                order.append(n)
+                done.add(n)
+                del remaining[n]
+        return order
+
+    def run(self) -> Dict[str, Any]:
+        loops = self.max_loops if self.loop else 1
+        last_outputs: Dict[str, Any] = {}
+        for it in range(loops):
+            order = self._toposort()
+            logging.info("workflow %s loop %d: %s", self.name, it, order)
+            for name in order:
+                job = self.jobs[name]
+                # chain: merge dependency outputs into input
+                for dep in self.deps[name]:
+                    job.input.update(self.jobs[dep].output)
+                job.run()
+                if job.status == "failed":
+                    logging.error("workflow %s: job %s failed", self.name,
+                                  name)
+                    return {n: j.output for n, j in self.jobs.items()}
+            last_outputs = {n: j.output for n, j in self.jobs.items()}
+        return last_outputs

@@ -1,0 +1,61 @@
+"""LLM fine-tune module: LoRA transform, packing, SFT loop reduces loss."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def _bundle():
+    import fedml_tpu
+
+    args = fedml_tpu.Config(model="transformer", dataset="shakespeare",
+                            compute_dtype="float32")
+    return fedml_tpu.model.create(args, 90)
+
+
+def test_lora_targets_and_apply():
+    from fedml_tpu.train.llm import apply_lora, init_lora
+
+    bundle = _bundle()
+    variables = bundle.init_variables(jax.random.PRNGKey(0))
+    lora = init_lora(variables["params"], rank=4)
+    assert len(lora) > 0
+    eff = apply_lora(variables["params"], lora, alpha=16.0)
+    # b init is zero → effective == base initially
+    for (p1, l1), (p2, l2) in zip(
+            jax.tree_util.tree_leaves_with_path(variables["params"]),
+            jax.tree_util.tree_leaves_with_path(eff)):
+        np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=1e-6)
+    # after perturbing A/B, targeted kernels must change
+    lora2 = jax.tree_util.tree_map(lambda x: x + 0.1, lora)
+    eff2 = apply_lora(variables["params"], lora2, alpha=16.0)
+    diffs = [float(np.abs(np.asarray(a) - np.asarray(b)).max())
+             for a, b in zip(jax.tree_util.tree_leaves(eff),
+                             jax.tree_util.tree_leaves(eff2))]
+    assert max(diffs) > 0.0
+
+
+def test_pack_sequences_shapes():
+    from fedml_tpu.train.llm import pack_sequences
+
+    stream = np.arange(1000) % 90
+    b = pack_sequences(stream, seq_len=32, batch_size=4)
+    assert b["x"].shape[1:] == (4, 32)
+    # next-token alignment
+    np.testing.assert_array_equal(b["y"][0, 0, :-1], b["x"][0, 0, 1:])
+
+
+def test_sft_lora_reduces_loss():
+    from fedml_tpu.data.datasets import shakespeare_sequences
+    from fedml_tpu.train.llm import LLMTrainConfig, LLMTrainer
+
+    bundle = _bundle()
+    xt, _, _, _ = shakespeare_sequences(seq_len=64, n_train=64, n_test=8)
+    stream = np.concatenate([x for x in xt])
+    cfg = LLMTrainConfig(seq_len=32, batch_size=4, epochs=3,
+                         learning_rate=3e-3, lora_rank=4)
+    trainer = LLMTrainer(bundle, cfg)
+    out = trainer.train(stream)
+    assert out["loss_history"][-1] < out["loss_history"][0]
+    gen = trainer.generate(stream[:10], max_new=5)
+    assert len(gen) == 15

@@ -1,0 +1,120 @@
+"""Ops plane: CLI, local launcher, workflow DAG, serving endpoint."""
+
+import json
+import os
+import textwrap
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+from click.testing import CliRunner
+
+from fedml_tpu.cli import cli
+
+
+def test_cli_version_and_env():
+    r = CliRunner().invoke(cli, ["version"])
+    assert r.exit_code == 0 and "fedml_tpu" in r.output
+    r = CliRunner().invoke(cli, ["env"])
+    assert r.exit_code == 0
+    info = json.loads(r.output)
+    assert "python" in info and "jax" in info
+
+
+def test_local_launcher_job_yaml(tmp_path):
+    from fedml_tpu.scheduler.local_launcher import (
+        build_job_package,
+        launch_job_local,
+        list_runs,
+    )
+
+    ws = tmp_path / "ws"
+    ws.mkdir()
+    (ws / "hello.py").write_text("print('hello from job')")
+    job = tmp_path / "job.yaml"
+    job.write_text(textwrap.dedent("""
+        workspace: ws
+        job_name: hello_job
+        bootstrap: |
+          echo bootstrap-ran
+        job: |
+          python hello.py
+    """))
+    result = launch_job_local(str(job))
+    assert result.returncode == 0
+    log = open(result.log_path).read()
+    assert "bootstrap-ran" in log and "hello from job" in log
+    assert any(r["job_name"] == "hello_job" for r in list_runs())
+    # package build
+    zip_path = build_job_package(str(job), str(tmp_path))
+    import zipfile
+
+    names = zipfile.ZipFile(zip_path).namelist()
+    assert "job.yaml" in names and "workspace/hello.py" in names
+
+
+def test_workflow_dag_chaining():
+    from fedml_tpu.workflow.workflow import CallableJob, Workflow
+
+    order = []
+
+    def make(name, fn):
+        def wrapped(inp):
+            order.append(name)
+            return fn(inp)
+        return CallableJob(name, wrapped)
+
+    a = make("a", lambda inp: {"x": 2})
+    b = make("b", lambda inp: {"y": inp["x"] * 10})
+    c = make("c", lambda inp: {"z": inp["y"] + 1})
+    wf = Workflow("test")
+    wf.add_job(a)
+    wf.add_job(b, dependencies=[a])
+    wf.add_job(c, dependencies=[b])
+    out = wf.run()
+    assert order == ["a", "b", "c"]
+    assert out["c"]["z"] == 21
+
+
+def test_workflow_detects_cycle():
+    from fedml_tpu.workflow.workflow import CallableJob, Workflow
+
+    a = CallableJob("a", lambda i: {})
+    b = CallableJob("b", lambda i: {})
+    wf = Workflow("cyc")
+    wf.add_job(a, dependencies=[b])
+    wf.add_job(b, dependencies=[a])
+    with pytest.raises(ValueError, match="cycle"):
+        wf.run()
+
+
+def test_serving_endpoint_predict_ready_and_streaming():
+    from fedml_tpu.serving import FedMLInferenceRunner, FedMLPredictor
+
+    class Echo(FedMLPredictor):
+        def predict(self, request):
+            if request.get("stream"):
+                return (f"tok{i} " for i in range(3))
+            return {"echo": request.get("text", ""), "n": 1}
+
+    port = 23451
+    runner = FedMLInferenceRunner(Echo(), host="127.0.0.1", port=port)
+    runner.run(block=False, prefer_fastapi=False)
+    time.sleep(0.2)
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/ready") as r:
+        assert json.loads(r.read())["ready"] is True
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict",
+        data=json.dumps({"text": "hi"}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        assert json.loads(r.read()) == {"echo": "hi", "n": 1}
+    req2 = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict",
+        data=json.dumps({"stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req2) as r:
+        assert b"tok0" in r.read()
+    runner.stop()

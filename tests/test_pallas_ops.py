@@ -1,0 +1,44 @@
+"""Pallas kernels (interpret mode on CPU): parity with the jnp math."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def test_weighted_average_flat_matches_einsum():
+    from fedml_tpu.ops.pallas_ops import weighted_average_flat
+
+    rng = np.random.RandomState(0)
+    stacked = jnp.asarray(rng.randn(10, 3000), jnp.float32)  # non-multiple D
+    w = jnp.asarray(rng.rand(10), jnp.float32)
+    out = weighted_average_flat(stacked, w, interpret=True)
+    expect = (w / w.sum()) @ stacked
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_agg_stacked_pallas_matches_tree_version():
+    from fedml_tpu.ml.aggregator.agg_operator import agg_stacked
+    from fedml_tpu.ops.pallas_ops import agg_stacked_pallas
+
+    rng = np.random.RandomState(1)
+    tree = {"w": jnp.asarray(rng.randn(6, 17, 5), jnp.float32),
+            "b": jnp.asarray(rng.randn(6, 9), jnp.float32)}
+    w = jnp.asarray(rng.rand(6) * 10, jnp.float32)
+    a = agg_stacked(tree, w)
+    b = agg_stacked_pallas(tree, w, interpret=True)
+    for k in tree:
+        np.testing.assert_allclose(np.asarray(a[k]), np.asarray(b[k]),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_quantize_mask_fused_matches_two_step():
+    from fedml_tpu.core.mpc.secagg import mask_model, quantize
+    from fedml_tpu.ops.pallas_ops import quantize_mask
+
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(777), jnp.float32)
+    mask = jnp.asarray(rng.randint(0, 2**32, size=777, dtype=np.uint32))
+    fused = quantize_mask(x, mask, interpret=True)
+    two_step = mask_model(quantize({"x": x})["x"], mask)
+    np.testing.assert_array_equal(np.asarray(fused), np.asarray(two_step))
