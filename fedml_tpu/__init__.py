@@ -32,6 +32,7 @@ from .arguments import Config, load_arguments
 from .constants import __version__
 from .core import mlops
 from .core.dp.fedml_differential_privacy import FedMLDifferentialPrivacy
+from .core.fhe import FedMLFHE
 from .core.security.fedml_attacker import FedMLAttacker
 from .core.security.fedml_defender import FedMLDefender
 from .runner import FedMLRunner
@@ -72,6 +73,7 @@ def init(args: Optional[Config] = None, argv: Optional[list] = None,
     FedMLAttacker.get_instance().init(args)
     FedMLDefender.get_instance().init(args)
     FedMLDifferentialPrivacy.get_instance().init(args)
+    FedMLFHE.get_instance().init(args)
     return args
 
 

@@ -16,6 +16,7 @@ import abc
 from typing import Any, Optional, Tuple
 
 from ..dp.fedml_differential_privacy import FedMLDifferentialPrivacy
+from ..fhe import FedMLFHE
 from ..security.fedml_attacker import FedMLAttacker
 
 
@@ -55,14 +56,20 @@ class ClientTrainer(abc.ABC):
     # -- lifecycle hooks (reference :59-82) ---------------------------------
     def on_before_local_training(self, train_data=None, device=None,
                                  args=None) -> None:
-        """Hook before local SGD (reference: FHE decrypt)."""
+        """Hook before local SGD: FHE decrypt of the encrypted global."""
+        fhe = FedMLFHE.get_instance()
+        if fhe.is_fhe_enabled() and fhe.is_encrypted(self.get_model_params()):
+            self.set_model_params(fhe.fhe_dec(self.get_model_params()))
 
     def on_after_local_training(self, train_data=None, device=None,
                                 args=None) -> None:
-        """Hook after local SGD: local-DP noise on the update."""
+        """Hook after local SGD: local-DP noise / FHE encrypt of the update."""
         dp = FedMLDifferentialPrivacy.get_instance()
         if dp.is_local_dp_enabled():
             self.set_model_params(dp.add_local_noise(self.get_model_params()))
+        fhe = FedMLFHE.get_instance()
+        if fhe.is_fhe_enabled():
+            self.set_model_params(fhe.fhe_enc(self.get_model_params()))
 
     # -- the actual work ----------------------------------------------------
     @abc.abstractmethod

@@ -18,6 +18,7 @@ from typing import Any, List, Tuple
 from ...ml.aggregator.agg_operator import FedMLAggOperator
 from ..contribution.contribution_assessor_manager import ContributionAssessorManager
 from ..dp.fedml_differential_privacy import FedMLDifferentialPrivacy
+from ..fhe import FedMLFHE
 from ..security.fedml_attacker import FedMLAttacker
 from ..security.fedml_defender import FedMLDefender
 from .context import Context
@@ -47,6 +48,12 @@ class ServerAggregator(abc.ABC):
     def on_before_aggregation(
         self, raw_client_model_or_grad_list: List[Tuple[float, Any]]
     ) -> List[Tuple[float, Any]]:
+        if raw_client_model_or_grad_list and FedMLFHE.is_encrypted(
+                raw_client_model_or_grad_list[0][1]):
+            # ciphertext payloads: DP clip / attacks / defenses operate on
+            # plaintext pytrees and do not apply (reference behavior: FHE
+            # bypasses these hooks)
+            return raw_client_model_or_grad_list
         if FedMLDifferentialPrivacy.get_instance().is_global_dp_enabled():
             raw_client_model_or_grad_list = FedMLDifferentialPrivacy.get_instance(
             ).global_clip(raw_client_model_or_grad_list)
@@ -65,6 +72,10 @@ class ServerAggregator(abc.ABC):
         return raw_client_model_or_grad_list
 
     def aggregate(self, raw_client_model_or_grad_list: List[Tuple[float, Any]]) -> Any:
+        fhe = FedMLFHE.get_instance()
+        if (fhe.is_fhe_enabled() and raw_client_model_or_grad_list
+                and fhe.is_encrypted(raw_client_model_or_grad_list[0][1])):
+            return fhe.fhe_fedavg(raw_client_model_or_grad_list)
         defender = FedMLDefender.get_instance()
         if defender.is_defense_enabled():
             return defender.defend_on_aggregation(
@@ -75,6 +86,8 @@ class ServerAggregator(abc.ABC):
         return FedMLAggOperator.agg(self.args, raw_client_model_or_grad_list)
 
     def on_after_aggregation(self, aggregated_model_or_grad: Any) -> Any:
+        if FedMLFHE.is_encrypted(aggregated_model_or_grad):
+            return aggregated_model_or_grad  # DP/defenses need plaintext
         dp = FedMLDifferentialPrivacy.get_instance()
         if dp.is_central_dp_enabled():
             aggregated_model_or_grad = dp.add_global_noise(aggregated_model_or_grad)

@@ -1,0 +1,5 @@
+from .fhe_agg import EncryptedTree, FedMLFHE
+from .paillier import PaillierCodec, PaillierPrivateKey, PaillierPublicKey, keygen
+
+__all__ = ["FedMLFHE", "EncryptedTree", "PaillierCodec",
+           "PaillierPublicKey", "PaillierPrivateKey", "keygen"]

@@ -1,0 +1,175 @@
+"""FedMLFHE — homomorphic-aggregation orchestration singleton.
+
+Capability parity: reference `core/fhe/fhe_agg.py:10-145` (`fhe_enc`,
+`fhe_dec`, `fhe_fedavg` encrypted weighted sum, wired into the
+ClientTrainer / ServerAggregator lifecycle hooks,
+`core/alg_frame/client_trainer.py:59-82`).
+
+Flow (identical contract to the reference):
+  client  on_after_local_training  -> fhe_enc(local params)
+  server  aggregate                -> fhe_fedavg over ciphertexts only
+  client  on_before_local_training -> fhe_dec(encrypted global)
+
+The server never holds the private key: homomorphic ops run under the
+public modulus carried by each ciphertext (`paillier.PackedCiphertext.n`).
+In single-process simulation the keypair lives in this singleton (all
+simulated clients share it, matching the reference's simulation behavior
+where the TenSEAL context is shared); in cross-silo deployments every
+client derives the SAME keypair from the pre-shared ``fhe_key_seed``
+secret (a config value distributed to silos out of band, never to the
+server), and mixing ciphertexts from mismatched keys raises.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from .paillier import PackedCiphertext, PaillierCodec, keygen
+
+
+class EncryptedTree:
+    """A pytree whose leaves were flattened + encrypted leaf-wise."""
+
+    def __init__(self, treedef, shapes, dtypes, leaves: List[PackedCiphertext]):
+        self.treedef = treedef
+        self.shapes = shapes
+        self.dtypes = dtypes
+        self.leaves = leaves
+
+
+class FedMLFHE:
+    _instance: Optional["FedMLFHE"] = None
+
+    def __init__(self) -> None:
+        self.is_enabled = False
+        self.codec: Optional[PaillierCodec] = None
+        self._priv = None
+        self._dec_cache = None  # (EncryptedTree, plaintext) identity cache
+
+    @classmethod
+    def get_instance(cls) -> "FedMLFHE":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def init(self, args: Any) -> None:
+        # reset first so a raise below leaves the singleton DISABLED, never
+        # half-configured with a stale keypair
+        self.is_enabled = False
+        self.codec = None
+        self._priv = None
+        self._dec_cache = None
+        if not bool(getattr(args, "enable_fhe", False)):
+            return
+        # FHE composes only with plain FedAvg over the hook-driven planes;
+        # fail fast instead of a TypeError deep inside the round loop
+        opt = str(getattr(args, "federated_optimizer", "FedAvg") or "FedAvg")
+        if opt.lower() not in ("fedavg", "fedavg_seq"):
+            raise ValueError(
+                f"enable_fhe supports federated_optimizer=FedAvg only "
+                f"(got {opt}): server-side optimizer math cannot run on "
+                f"ciphertexts")
+        if getattr(args, "contribution_alg", None):
+            raise ValueError(
+                "enable_fhe is incompatible with contribution assessment: "
+                "Shapley subsets would need plaintext client models")
+        if getattr(args, "enable_defense", False) or getattr(
+                args, "enable_attack", False):
+            raise ValueError(
+                "enable_fhe is incompatible with enable_defense/enable_attack:"
+                " robust aggregation and model-attack simulation need "
+                "plaintext client updates")
+        if getattr(args, "enable_dp", False):
+            raise ValueError(
+                "enable_fhe is incompatible with enable_dp: DP clip/noise "
+                "hooks need plaintext updates (compose DP client-side before "
+                "encryption in a custom trainer if required)")
+        backend = str(getattr(args, "backend", "sp") or "sp").lower()
+        if backend in ("parrot", "mesh", "nccl"):
+            raise ValueError(
+                f"enable_fhe is not supported on backend={backend}: the "
+                f"vectorized Parrot/mesh planes bypass the ClientTrainer "
+                f"lifecycle hooks; use backend=sp or a cross-silo plane")
+        cross_silo = str(getattr(args, "training_type", "simulation")
+                         ).lower() == "cross_silo"
+        seed = getattr(args, "fhe_key_seed", None)
+        if cross_silo and str(getattr(args, "role", "server")) == "server":
+            # the aggregator works only under the modulus carried by each
+            # ciphertext — it must NOT derive (or be able to derive) the key
+            self.is_enabled = True
+            return
+        if cross_silo and seed is None:
+            raise ValueError(
+                "cross-silo FHE requires fhe_key_seed (a secret pre-shared "
+                "among silos, never given to the server) so all clients "
+                "derive the same keypair")
+        bits = int(getattr(args, "fhe_key_size", 1024) or 1024)
+        pub, priv = keygen(bits, seed=None if seed is None else int(seed))
+        self.codec = PaillierCodec(
+            pub,
+            frac_bits=int(getattr(args, "fhe_frac_bits", 16) or 16),
+            int_bits=int(getattr(args, "fhe_int_bits", 8) or 8),
+        )
+        self._priv = priv
+        self.is_enabled = True
+
+    def is_fhe_enabled(self) -> bool:
+        return self.is_enabled
+
+    @staticmethod
+    def is_encrypted(obj: Any) -> bool:
+        return isinstance(obj, EncryptedTree)
+
+    # -- enc / dec over pytrees ----------------------------------------------
+    def fhe_enc(self, tree: Any) -> EncryptedTree:
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        arrs = [np.asarray(l) for l in leaves]
+        enc = [self.codec.encrypt(a.ravel()) for a in arrs]
+        return EncryptedTree(treedef, [a.shape for a in arrs],
+                             [a.dtype for a in arrs], enc)
+
+    def fhe_dec(self, enc: EncryptedTree) -> Any:
+        import jax.numpy as jnp
+
+        # identity cache: every sampled client per SP round decrypts the
+        # same encrypted global — pay the modexps once
+        if self._dec_cache is not None and self._dec_cache[0] is enc:
+            return self._dec_cache[1]
+        leaves = []
+        for ct, shape, dtype in zip(enc.leaves, enc.shapes, enc.dtypes):
+            flat = self.codec.decrypt(self._priv, ct)
+            leaves.append(jnp.asarray(flat.reshape(shape)).astype(dtype))
+        out = jax.tree_util.tree_unflatten(enc.treedef, leaves)
+        self._dec_cache = (enc, out)
+        return out
+
+    # -- the encrypted aggregate --------------------------------------------
+    def fhe_fedavg(
+        self, raw_client_list: List[Tuple[float, EncryptedTree]]
+    ) -> EncryptedTree:
+        """Weighted FedAvg entirely over ciphertexts (server side).
+
+        Sample counts n_k are normalized then integer-quantized; the
+        normalizing division happens at decryption via weight_total.  A
+        keyless server (cross-silo aggregator role) builds its codec from
+        the public modulus carried by the ciphertexts themselves.
+        """
+        first = raw_client_list[0][1]
+        codec = self.codec
+        if codec is None:
+            from .paillier import PaillierPublicKey
+
+            codec = PaillierCodec(PaillierPublicKey(first.leaves[0].n))
+        total = float(sum(n for n, _ in raw_client_list))
+        w_int = [codec.quantize_weight(n / total)
+                 for n, _ in raw_client_list]
+        out_leaves = []
+        for li in range(len(first.leaves)):
+            items = [(w, enc.leaves[li])
+                     for w, (_, enc) in zip(w_int, raw_client_list)]
+            out_leaves.append(codec.weighted_sum(items))
+        return EncryptedTree(first.treedef, first.shapes, first.dtypes,
+                             out_leaves)

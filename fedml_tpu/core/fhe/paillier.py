@@ -1,0 +1,233 @@
+"""Paillier additively-homomorphic cryptosystem with slot packing.
+
+Capability parity: the reference's FHE aggregation uses TenSEAL CKKS
+(`core/fhe/fhe_agg.py:10-145` — `fhe_enc`/`fhe_dec`/`fhe_fedavg` encrypted
+weighted sum).  TenSEAL is not in this image, so the same capability —
+server-side weighted aggregation over ciphertexts it cannot read — is built
+on Paillier (Paillier 1999), which is exactly additively homomorphic:
+
+    Enc(a) * Enc(b) mod n^2            = Enc(a + b)
+    Enc(a) ^ k     mod n^2             = Enc(k * a)
+
+Floats are fixed-point quantized; many values are packed into each
+plaintext slot-wise (each slot gets headroom bits so slot-wise weighted sums
+of up to 2**weight_bits total weight never carry into the next slot).
+Negative values use offset encoding (v -> v + B), and the known aggregate
+offset W_total * B is subtracted after decryption.
+"""
+
+from __future__ import annotations
+
+import math
+import secrets
+from dataclasses import dataclass
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+_SMALL_PRIMES = [3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37, 41, 43, 47, 53,
+                 59, 61, 67, 71, 73, 79, 83, 89, 97]
+
+
+def _is_probable_prime(n: int, rounds: int = 24) -> bool:
+    if n < 2:
+        return False
+    for p in _SMALL_PRIMES:
+        if n % p == 0:
+            return n == p
+    d, r = n - 1, 0
+    while d % 2 == 0:
+        d //= 2
+        r += 1
+    for _ in range(rounds):
+        a = secrets.randbelow(n - 3) + 2
+        x = pow(a, d, n)
+        if x in (1, n - 1):
+            continue
+        for _ in range(r - 1):
+            x = (x * x) % n
+            if x == n - 1:
+                break
+        else:
+            return False
+    return True
+
+
+def _gen_prime(bits: int, randbits=None) -> int:
+    randbits = randbits or secrets.randbits
+    while True:
+        c = randbits(bits) | (1 << (bits - 1)) | 1
+        if _is_probable_prime(c):
+            return c
+
+
+@dataclass
+class PaillierPublicKey:
+    n: int
+
+    @property
+    def n_sq(self) -> int:
+        return self.n * self.n
+
+    def raw_encrypt(self, m: int) -> int:
+        n, n_sq = self.n, self.n_sq
+        r = secrets.randbelow(n - 2) + 1
+        # g = n+1 shortcut: g^m = 1 + m*n (mod n^2)
+        return ((1 + (m % n) * n) % n_sq) * pow(r, n, n_sq) % n_sq
+
+
+@dataclass
+class PaillierPrivateKey:
+    public: PaillierPublicKey
+    lam: int
+    mu: int
+
+    def raw_decrypt(self, c: int) -> int:
+        n, n_sq = self.public.n, self.public.n_sq
+        u = pow(c, self.lam, n_sq)
+        return ((u - 1) // n) * self.mu % n
+
+
+def keygen(bits: int = 1024,
+           seed: int = None) -> Tuple[PaillierPublicKey, PaillierPrivateKey]:
+    """bits = modulus size. 1024+ for privacy; small keys only for tests.
+
+    ``seed`` derives the keypair deterministically — the cross-silo key
+    agreement: clients sharing the pre-shared ``fhe_key_seed`` secret derive
+    identical keypairs, while the server (which never learns the seed) works
+    only with the public modulus carried by each ciphertext.
+    """
+    randbits = None
+    if seed is not None:
+        import random as _random
+
+        randbits = _random.Random(int(seed)).getrandbits
+    half = bits // 2
+    while True:
+        p, q = _gen_prime(half, randbits), _gen_prime(half, randbits)
+        if p != q:
+            n = p * q
+            if n.bit_length() >= bits - 1:
+                break
+    lam = (p - 1) * (q - 1) // math.gcd(p - 1, q - 1)
+    mu = pow(lam, -1, n)
+    pub = PaillierPublicKey(n)
+    return pub, PaillierPrivateKey(pub, lam, mu)
+
+
+@dataclass
+class PackedCiphertext:
+    """One flat float vector, fixed-point packed into Paillier ciphertexts.
+
+    weight_total tracks the sum of integer weights applied so far (starts at
+    the weight used at encryption time) so decryption can remove the offset
+    term weight_total * OFFSET per slot and rescale.  ``n`` is the public
+    modulus the ciphertexts live under — homomorphic ops run mod n^2 of the
+    *ciphertext*, so an aggregator needs no key material of its own, and
+    mixing ciphertexts from different keypairs raises instead of silently
+    producing garbage.
+    """
+
+    ciphertexts: List[int]
+    size: int
+    slot_bits: int
+    slots_per_ct: int
+    weight_total: int
+    n: int
+
+
+class PaillierCodec:
+    """Encode/encrypt float vectors; homomorphic weighted accumulation."""
+
+    def __init__(self, pub: PaillierPublicKey,
+                 frac_bits: int = 16, int_bits: int = 8,
+                 weight_bits: int = 16) -> None:
+        self.pub = pub
+        self.frac_bits = frac_bits
+        self.int_bits = int_bits
+        self.weight_bits = weight_bits
+        # slot layout: sign-offset bit + value bits + weight-sum headroom + 2
+        self.slot_bits = frac_bits + int_bits + 1 + weight_bits + 2
+        self.offset = 1 << (frac_bits + int_bits)      # B: makes slots >= 0
+        self.scale = 1 << frac_bits
+        self.weight_scale = 1 << (weight_bits - 2)     # quantized weights
+        usable = self.pub.n.bit_length() - 2
+        self.slots_per_ct = max(1, usable // self.slot_bits)
+
+    # -- fixed point ---------------------------------------------------------
+    def _quantize(self, vec: np.ndarray) -> List[int]:
+        limit = float(1 << self.int_bits) - 1.0
+        v = np.clip(np.asarray(vec, np.float64), -limit, limit)
+        return [int(x) + self.offset
+                for x in np.round(v * self.scale).astype(object)]
+
+    def quantize_weight(self, w: float) -> int:
+        return max(1, int(round(float(w) * self.weight_scale)))
+
+    # -- encrypt / decrypt ---------------------------------------------------
+    def encrypt(self, vec: np.ndarray, weight: int = 1) -> PackedCiphertext:
+        slots = self._quantize(vec)
+        cts: List[int] = []
+        k, sb = self.slots_per_ct, self.slot_bits
+        for i in range(0, len(slots), k):
+            m = 0
+            for j, s in enumerate(slots[i:i + k]):
+                m |= (s * weight) << (j * sb)
+            cts.append(self.pub.raw_encrypt(m))
+        return PackedCiphertext(cts, len(slots), sb, k, weight, self.pub.n)
+
+    def decrypt(self, priv: PaillierPrivateKey,
+                packed: PackedCiphertext) -> np.ndarray:
+        if priv.public.n != packed.n:
+            raise ValueError(
+                "ciphertext modulus does not match this private key "
+                "(clients must derive keys from the same fhe_key_seed)")
+        mask = (1 << packed.slot_bits) - 1
+        out = np.empty(packed.size, np.float64)
+        idx = 0
+        for ct in packed.ciphertexts:
+            m = priv.raw_decrypt(ct)
+            for j in range(packed.slots_per_ct):
+                if idx >= packed.size:
+                    break
+                slot = (m >> (j * packed.slot_bits)) & mask
+                val = slot - packed.weight_total * self.offset
+                out[idx] = val / (self.scale * float(packed.weight_total))
+                idx += 1
+        return out
+
+    # -- homomorphic ops (run under the CIPHERTEXT's modulus — aggregator
+    # needs no key material) --------------------------------------------------
+    @staticmethod
+    def add(a: PackedCiphertext, b: PackedCiphertext) -> PackedCiphertext:
+        if a.n != b.n:
+            raise ValueError(
+                "cannot add ciphertexts under different Paillier moduli "
+                "(clients must derive keys from the same fhe_key_seed)")
+        assert a.size == b.size and a.slot_bits == b.slot_bits
+        n_sq = a.n * a.n
+        cts = [x * y % n_sq for x, y in zip(a.ciphertexts, b.ciphertexts)]
+        return PackedCiphertext(cts, a.size, a.slot_bits, a.slots_per_ct,
+                                a.weight_total + b.weight_total, a.n)
+
+    @staticmethod
+    def scalar_mul(a: PackedCiphertext, k: int) -> PackedCiphertext:
+        n_sq = a.n * a.n
+        cts = [pow(c, k, n_sq) for c in a.ciphertexts]
+        return PackedCiphertext(cts, a.size, a.slot_bits, a.slots_per_ct,
+                                a.weight_total * k, a.n)
+
+    def weighted_sum(
+        self, items: Sequence[Tuple[int, PackedCiphertext]]
+    ) -> PackedCiphertext:
+        """Σ_k w_k · enc_k over ciphertexts (server never sees plaintext).
+
+        Each enc_k must have been encrypted with weight 1; integer weights
+        w_k come from ``quantize_weight``.
+        """
+        acc = None
+        for w, enc in items:
+            term = self.scalar_mul(enc, int(w)) if int(w) != 1 else enc
+            acc = term if acc is None else self.add(acc, term)
+        assert acc is not None, "empty weighted_sum"
+        return acc

@@ -14,6 +14,7 @@ import numpy as np
 
 from ...core.alg_frame.client_trainer import ClientTrainer
 from ...core.alg_frame.server_aggregator import ServerAggregator
+from ...core.fhe import FedMLFHE
 from ..engine.local_update import build_eval_step, build_local_update, make_batches
 from ..engine.model_bundle import ModelBundle
 
@@ -68,7 +69,7 @@ class DefaultClientTrainer(ClientTrainer):
                 "test_total": n}
 
 
-class DefaultServerAggregator(ServerAggregator):
+class DefaultServerAggregator(ServerAggregator):  # noqa: D101
     def __init__(self, bundle: ModelBundle, args: Any) -> None:
         super().__init__(bundle, args)
         self.bundle = bundle
@@ -79,7 +80,14 @@ class DefaultServerAggregator(ServerAggregator):
         nb = max(1, -(-len(test_data[1]) // self.batch_size))
         batches = batches_for(test_data, self.batch_size, nb,
                               self.bundle.input_dtype)
-        out = self._eval(self.params, batches)
+        params = self.params
+        fhe = FedMLFHE.get_instance()
+        if fhe.is_encrypted(params):
+            # simulation-only convenience: the sim process holds the client
+            # keypair so server-side eval can decrypt; a real deployment's
+            # server cannot (the reference's FHE mode evaluates client-side)
+            params = fhe.fhe_dec(params)
+        out = self._eval(params, batches)
         n = max(float(out["n"]), 1.0)
         return {"test_loss": float(out["loss_sum"]) / n,
                 "test_acc": float(out["correct"]) / n,

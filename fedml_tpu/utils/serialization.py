@@ -26,6 +26,10 @@ _MAGIC = b"FTPT"  # fedml-tpu pytree
 
 def _flatten_struct(obj: Any, leaves: List[np.ndarray]) -> Any:
     """Replace arrays/scalars with leaf placeholders, recursing containers."""
+    from ..core.fhe.fhe_agg import EncryptedTree
+
+    if isinstance(obj, EncryptedTree):
+        return _encode_encrypted_tree(obj, leaves)
     if isinstance(obj, dict):
         return {"t": "d",
                 "k": sorted(obj.keys()),
@@ -42,8 +46,46 @@ def _flatten_struct(obj: Any, leaves: List[np.ndarray]) -> Any:
     return {"t": "a", "i": len(leaves) - 1}
 
 
+def _encode_encrypted_tree(enc: Any, leaves: List[np.ndarray]) -> Any:
+    """FHE ciphertext trees ride the wire as JSON (hex bigints) — still no
+    code execution on decode (`core/fhe/fhe_agg.py` EncryptedTree)."""
+    import jax
+
+    skeleton = jax.tree_util.tree_unflatten(
+        enc.treedef, list(range(len(enc.leaves))))
+    return {
+        "t": "fhe",
+        "skel": _flatten_struct(skeleton, leaves),
+        "shapes": [list(s) for s in enc.shapes],
+        "dtypes": [str(np.dtype(d)) for d in enc.dtypes],
+        "leaves": [{
+            "size": ct.size, "sb": ct.slot_bits, "k": ct.slots_per_ct,
+            "wt": ct.weight_total, "n": hex(ct.n),
+            "c": [hex(c) for c in ct.ciphertexts],
+        } for ct in enc.leaves],
+    }
+
+
+def _decode_encrypted_tree(spec: Any, leaves: List[np.ndarray]) -> Any:
+    import jax
+
+    from ..core.fhe.fhe_agg import EncryptedTree
+    from ..core.fhe.paillier import PackedCiphertext
+
+    skeleton = _unflatten_struct(spec["skel"], leaves)
+    treedef = jax.tree_util.tree_structure(skeleton)
+    cts = [PackedCiphertext([int(c, 16) for c in m["c"]], int(m["size"]),
+                            int(m["sb"]), int(m["k"]), int(m["wt"]),
+                            int(m["n"], 16))
+           for m in spec["leaves"]]
+    return EncryptedTree(treedef, [tuple(s) for s in spec["shapes"]],
+                         [np.dtype(d) for d in spec["dtypes"]], cts)
+
+
 def _unflatten_struct(spec: Any, leaves: List[np.ndarray]) -> Any:
     t = spec["t"]
+    if t == "fhe":
+        return _decode_encrypted_tree(spec, leaves)
     if t == "d":
         return {k: _unflatten_struct(v, leaves)
                 for k, v in zip(spec["k"], spec["v"])}

@@ -1,0 +1,140 @@
+"""FHE (Paillier additively-homomorphic aggregation) tests.
+
+Capability parity target: reference `core/fhe/fhe_agg.py` (TenSEAL CKKS
+fhe_enc/fhe_dec/fhe_fedavg wired into the alg_frame lifecycle hooks).
+Small key sizes here are for test speed only.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.core.fhe import FedMLFHE, PaillierCodec, keygen
+
+
+@pytest.fixture(scope="module")
+def codec():
+    pub, priv = keygen(256)
+    return PaillierCodec(pub), priv
+
+
+def test_paillier_roundtrip(codec):
+    c, priv = codec
+    v = np.array([0.0, 1.5, -2.25, 100.0, -0.0001, 3.14159])
+    enc = c.encrypt(v)
+    dec = c.decrypt(priv, enc)
+    np.testing.assert_allclose(dec, np.clip(v, -255, 255), atol=2e-4)
+
+
+def test_paillier_weighted_sum(codec):
+    c, priv = codec
+    rng = np.random.RandomState(0)
+    vs = [rng.randn(40) for _ in range(4)]
+    ns = [10.0, 30.0, 20.0, 40.0]
+    total = sum(ns)
+    w_int = [c.quantize_weight(n / total) for n in ns]
+    encs = [c.encrypt(v) for v in vs]
+    agg = c.weighted_sum(list(zip(w_int, encs)))
+    dec = c.decrypt(priv, agg)
+    expected = sum((n / total) * v for n, v in zip(ns, vs))
+    np.testing.assert_allclose(dec, expected, atol=1e-3)
+
+
+def test_seeded_keygen_and_modulus_mismatch():
+    pub1, _ = keygen(256, seed=7)
+    pub2, _ = keygen(256, seed=7)
+    assert pub1.n == pub2.n  # pre-shared fhe_key_seed → identical keys
+    pub3, _ = keygen(256, seed=8)
+    assert pub1.n != pub3.n
+    c1, c3 = PaillierCodec(pub1), PaillierCodec(pub3)
+    a, b = c1.encrypt(np.ones(3)), c3.encrypt(np.ones(3))
+    with pytest.raises(ValueError):
+        PaillierCodec.add(a, b)  # mismatched moduli must raise, not garble
+
+
+def test_fhe_rejects_incompatible_config():
+    fhe = FedMLFHE.get_instance()
+    with pytest.raises(ValueError):
+        fhe.init(fedml_tpu.Config(enable_fhe=True, fhe_key_size=256,
+                                  federated_optimizer="FedOpt"))
+    with pytest.raises(ValueError):
+        fhe.init(fedml_tpu.Config(enable_fhe=True, fhe_key_size=256,
+                                  backend="parrot"))
+    fhe.init(fedml_tpu.Config())
+
+
+def test_fhe_tree_fedavg():
+    fhe = FedMLFHE.get_instance()
+    fhe.init(fedml_tpu.Config(enable_fhe=True, fhe_key_size=256))
+    try:
+        t1 = {"w": jnp.arange(6.0).reshape(2, 3), "b": jnp.ones((3,))}
+        t2 = {"w": -jnp.ones((2, 3)), "b": jnp.zeros((3,))}
+        e1, e2 = fhe.fhe_enc(t1), fhe.fhe_enc(t2)
+        agg = fhe.fhe_fedavg([(1.0, e1), (3.0, e2)])
+        dec = fhe.fhe_dec(agg)
+        np.testing.assert_allclose(
+            np.asarray(dec["w"]),
+            0.25 * np.asarray(t1["w"]) + 0.75 * np.asarray(t2["w"]), atol=1e-3)
+        np.testing.assert_allclose(np.asarray(dec["b"]), [0.25] * 3, atol=1e-3)
+    finally:
+        fhe.init(fedml_tpu.Config())
+
+
+def test_encrypted_tree_wire_roundtrip():
+    """EncryptedTree survives the no-code-execution wire codec (cross-silo
+    model upload path) and still decrypts correctly afterwards."""
+    from fedml_tpu.utils.serialization import dumps_pytree, loads_pytree
+
+    fhe = FedMLFHE.get_instance()
+    fhe.init(fedml_tpu.Config(enable_fhe=True, fhe_key_size=256))
+    try:
+        tree = {"layer": {"w": jnp.ones((2, 2)) * 0.5, "b": jnp.zeros(2)}}
+        enc = fhe.fhe_enc(tree)
+        wire = dumps_pytree({"model_params": enc, "num_samples": 10})
+        back = loads_pytree(wire)
+        assert float(back["num_samples"]) == 10
+        dec = fhe.fhe_dec(back["model_params"])
+        np.testing.assert_allclose(np.asarray(dec["layer"]["w"]), 0.5,
+                                   atol=1e-3)
+    finally:
+        fhe.init(fedml_tpu.Config())
+
+
+def test_keyless_server_aggregates_by_ciphertext_modulus():
+    """A cross-silo-server-role FHE singleton has no key material yet can
+    still run fhe_fedavg using the modulus carried by the ciphertexts."""
+    client = FedMLFHE()
+    client.init(fedml_tpu.Config(
+        enable_fhe=True, fhe_key_size=256, fhe_key_seed=5,
+        training_type="cross_silo", role="client"))
+    server = FedMLFHE()
+    server.init(fedml_tpu.Config(
+        enable_fhe=True, training_type="cross_silo", role="server"))
+    assert server.is_fhe_enabled() and server.codec is None
+    t1 = {"w": jnp.ones(4)}
+    t2 = {"w": 3.0 * jnp.ones(4)}
+    agg = server.fhe_fedavg([(1.0, client.fhe_enc(t1)),
+                             (1.0, client.fhe_enc(t2))])
+    dec = client.fhe_dec(agg)
+    np.testing.assert_allclose(np.asarray(dec["w"]), 2.0, atol=1e-3)
+
+
+def test_sp_simulation_with_fhe_end_to_end():
+    """Two rounds of SP FedAvg with encrypted aggregation converge sanely."""
+    args = fedml_tpu.init(fedml_tpu.Config(
+        dataset="synthetic", model="lr", backend="sp",
+        client_num_in_total=3, client_num_per_round=3,
+        comm_round=2, epochs=1, batch_size=16,
+        frequency_of_the_test=1, enable_tracking=False,
+        enable_fhe=True, fhe_key_size=256,
+    ))
+    try:
+        device = fedml_tpu.device.get_device(args)
+        dataset = fedml_tpu.data.load(args)
+        bundle = fedml_tpu.model.create(args, dataset[-1])
+        metrics = fedml_tpu.FedMLRunner(args, device, dataset, bundle).run()
+        assert np.isfinite(metrics["test_loss"])
+        assert metrics["test_acc"] >= 0.0
+    finally:
+        FedMLFHE.get_instance().init(fedml_tpu.Config())
