@@ -37,6 +37,7 @@ class FedMLDifferentialPrivacy:
         self.is_enabled = False
         self.dp_solution_type = None
         self.mechanism: DPMechanism = None
+        self.frame = None
         self.max_grad_norm = None
         self._rng = jax.random.PRNGKey(0)
         self._step = 0
@@ -51,7 +52,8 @@ class FedMLDifferentialPrivacy:
         self.is_enabled = bool(getattr(args, "enable_dp", False))
         if not self.is_enabled:
             return
-        self.dp_solution_type = getattr(args, "dp_solution_type", DP_CENTRAL)
+        self.dp_solution_type = getattr(
+            args, "dp_solution_type", DP_CENTRAL) or DP_CENTRAL
         self.max_grad_norm = getattr(args, "max_grad_norm", None)
         self.mechanism = DPMechanism(
             getattr(args, "mechanism_type", "gaussian"),
@@ -60,6 +62,9 @@ class FedMLDifferentialPrivacy:
             sensitivity=getattr(args, "sensitivity", 1.0) or 1.0,
             sigma=getattr(args, "sigma", None),
         )
+        from .frames import create_frame
+        self.frame = create_frame(
+            str(self.dp_solution_type), self.mechanism, self.max_grad_norm)
         self._rng = jax.random.PRNGKey(
             int(getattr(args, "random_seed", 0) or 0) + 0x5EED)
 
@@ -79,16 +84,11 @@ class FedMLDifferentialPrivacy:
         return k
 
     def add_local_noise(self, tree: Any) -> Any:
-        if self.max_grad_norm:
-            tree = global_l2_clip(tree, float(self.max_grad_norm))
-        return self.mechanism.add_noise(tree, self._next_key())
+        return self.frame.add_local_noise(tree, self._next_key())
 
     def add_global_noise(self, tree: Any) -> Any:
-        return self.mechanism.add_noise(tree, self._next_key())
+        return self.frame.add_global_noise(tree, self._next_key())
 
     def global_clip(self, raw_list: List[Tuple[float, Any]]
                     ) -> List[Tuple[float, Any]]:
-        if not self.max_grad_norm:
-            return raw_list
-        c = float(self.max_grad_norm)
-        return [(n, global_l2_clip(t, c)) for n, t in raw_list]
+        return self.frame.global_clip(raw_list)

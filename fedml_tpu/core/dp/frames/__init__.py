@@ -1,0 +1,11 @@
+from .base_dp_frame import (
+    BaseDPFrame,
+    CentralDPFrame,
+    FRAME_REGISTRY,
+    LocalDPFrame,
+    NbAFLFrame,
+    create_frame,
+)
+
+__all__ = ["BaseDPFrame", "LocalDPFrame", "CentralDPFrame", "NbAFLFrame",
+           "FRAME_REGISTRY", "create_frame"]

@@ -1,0 +1,88 @@
+"""DP frame classes (reference `core/dp/frames/{base_dp_solution,ldp,cdp,
+NbAFL}.py`): each frame decides WHERE in the round lifecycle clipping and
+noise happen.  `FedMLDifferentialPrivacy` dispatches to a frame by
+``dp_solution_type``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax
+
+from ..mechanisms import DPMechanism
+
+
+class BaseDPFrame:
+    """Common lifecycle surface. Planes call the singleton, which forwards to
+    the active frame."""
+
+    def __init__(self, mechanism: DPMechanism, max_grad_norm=None) -> None:
+        self.mechanism = mechanism
+        self.max_grad_norm = max_grad_norm
+
+    # client side, after local training
+    def add_local_noise(self, tree: Any, rng: jax.Array) -> Any:
+        return tree
+
+    # server side, before aggregation
+    def global_clip(self, raw_list: List[Tuple[float, Any]]
+                    ) -> List[Tuple[float, Any]]:
+        return raw_list
+
+    # server side, after aggregation
+    def add_global_noise(self, tree: Any, rng: jax.Array) -> Any:
+        return tree
+
+    def _clip(self, tree: Any) -> Any:
+        if not self.max_grad_norm:
+            return tree
+        from ..fedml_differential_privacy import global_l2_clip
+        return global_l2_clip(tree, float(self.max_grad_norm))
+
+
+class LocalDPFrame(BaseDPFrame):
+    """LDP: each client clips + perturbs its own update before upload
+    (reference `frames/ldp.py`)."""
+
+    def add_local_noise(self, tree: Any, rng: jax.Array) -> Any:
+        return self.mechanism.add_noise(self._clip(tree), rng)
+
+
+class CentralDPFrame(BaseDPFrame):
+    """CDP: the server clips every received update and noises the aggregate
+    (reference `frames/cdp.py`)."""
+
+    def global_clip(self, raw_list):
+        if not self.max_grad_norm:
+            return raw_list
+        return [(n, self._clip(t)) for n, t in raw_list]
+
+    def add_global_noise(self, tree: Any, rng: jax.Array) -> Any:
+        return self.mechanism.add_noise(tree, rng)
+
+
+class NbAFLFrame(CentralDPFrame):
+    """NbAFL (Wei et al. 2020): up-link noise at clients AND down-link noise
+    at the server, both scaled from (epsilon, delta, C, client count)
+    (reference `frames/NbAFL.py`)."""
+
+    def add_local_noise(self, tree: Any, rng: jax.Array) -> Any:
+        return self.mechanism.add_noise(self._clip(tree), rng)
+
+
+FRAME_REGISTRY = {
+    "local": LocalDPFrame,
+    "central": CentralDPFrame,
+    "NbAFL": NbAFLFrame,
+}
+
+
+def create_frame(solution_type: str, mechanism: DPMechanism,
+                 max_grad_norm=None) -> BaseDPFrame:
+    try:
+        cls = FRAME_REGISTRY[solution_type]
+    except KeyError:
+        raise ValueError(f"unknown dp_solution_type {solution_type!r}; "
+                         f"known: {sorted(FRAME_REGISTRY)}")
+    return cls(mechanism, max_grad_norm)

@@ -8,6 +8,7 @@ from .attack_base import BaseAttackMethod
 from .attacks import (
     BackdoorAttack,
     ByzantineAttack,
+    EdgeCaseBackdoorAttack,
     LabelFlippingAttack,
     LazyWorkerAttack,
     ModelReplacementBackdoorAttack,
@@ -17,7 +18,7 @@ ATTACK_REGISTRY = {
     "byzantine": ByzantineAttack,
     "label_flipping": LabelFlippingAttack,
     "backdoor": BackdoorAttack,
-    "edge_case_backdoor": BackdoorAttack,
+    "edge_case_backdoor": EdgeCaseBackdoorAttack,
     "model_replacement_backdoor": ModelReplacementBackdoorAttack,
     "lazy_worker": LazyWorkerAttack,
 }

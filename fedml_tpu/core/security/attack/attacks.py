@@ -103,6 +103,38 @@ class BackdoorAttack(BaseAttackMethod):
         return x, y
 
 
+class EdgeCaseBackdoorAttack(BackdoorAttack):
+    """Edge-case backdoor (Wang et al. 2020): poison only the tail of the
+    data distribution — the samples farthest from their class centroid —
+    so the backdoor hides where honest training signal is weakest
+    (reference `edge_case_attack.py`)."""
+
+    def poison_data(self, dataset):
+        x, y = dataset
+        x = np.array(x, copy=True)
+        y = np.array(y, copy=True)
+        n = len(y)
+        if n == 0:
+            return x, y
+        flat = x.reshape(n, -1).astype(np.float64)
+        # distance of each sample to its own class centroid
+        dist = np.zeros(n)
+        for c in np.unique(y):
+            m = y == c
+            centroid = flat[m].mean(axis=0)
+            dist[m] = np.linalg.norm(flat[m] - centroid, axis=1)
+        k = max(1, int(n * self.poison_frac))
+        idx = np.argsort(-dist)[:k]  # the edge cases
+        t = self.trigger_size
+        hi = float(np.max(x)) if x.size else 1.0
+        if x.ndim >= 3:
+            x[idx, :t, :t, ...] = hi
+        else:
+            x[idx, :t] = hi
+        y[idx] = self.target_label
+        return x, y
+
+
 class ModelReplacementBackdoorAttack(BaseAttackMethod):
     """Boosted model replacement (Bagdasaryan et al.): attacker scales its
     deviation from the global model by gamma ≈ n/η so the aggregate becomes

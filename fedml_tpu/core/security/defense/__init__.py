@@ -5,6 +5,14 @@ from __future__ import annotations
 
 from typing import Any
 
+from .advanced_defenses import (
+    CRFLDefense,
+    OutlierDetectionDefense,
+    ResidualBasedReweightingDefense,
+    RobustLearningRateDefense,
+    SoteriaDefense,
+    WBCDefense,
+)
 from .defense_base import BaseDefenseMethod
 from .robust_aggregation import (
     BulyanDefense,
@@ -37,7 +45,15 @@ DEFENSE_REGISTRY = {
     "three_sigma": ThreeSigmaDefense,
     "three_sigma_geomedian": lambda cfg: ThreeSigmaDefense(
         _with(cfg, three_sigma_geomedian=True)),
+    "three_sigma_foolsgold": lambda cfg: ThreeSigmaDefense(
+        _with(cfg, three_sigma_foolsgold=True)),
     "crossround": CrossRoundDefense,
+    "crfl": CRFLDefense,
+    "soteria": SoteriaDefense,
+    "robust_learning_rate": RobustLearningRateDefense,
+    "residual_based_reweighting": ResidualBasedReweightingDefense,
+    "wbc": WBCDefense,
+    "outlier_detection": OutlierDetectionDefense,
 }
 
 

@@ -228,6 +228,25 @@ class WeakDPDefense(BaseDefenseMethod):
         return vector_to_tree(noised, global_model)
 
 
+def foolsgold_credibility(m: jnp.ndarray) -> jnp.ndarray:
+    """FoolsGold (Fung et al.) alg. 1 per-client credibility weights from a
+    stacked [N, D] update (or history-sum) matrix: max pairwise cosine →
+    pardoning → renormalize → logit squash."""
+    norms = jnp.sqrt(jnp.maximum(jnp.sum(m * m, axis=1, keepdims=True), 1e-12))
+    cs = (m / norms) @ (m / norms).T
+    n = m.shape[0]
+    cs = cs - jnp.eye(n)
+    maxcs = jnp.maximum(jnp.max(cs, axis=1), 1e-12)
+    # pardoning: scale cs[i,j] by maxcs[i]/maxcs[j] only when
+    # maxcs[i] < maxcs[j] — always a down-scale of honest clients
+    ratio = maxcs[:, None] / maxcs[None, :]
+    adj = jnp.where(maxcs[:, None] < maxcs[None, :], cs * ratio, cs)
+    wv = 1.0 - jnp.max(adj, axis=1)
+    wv = jnp.clip(wv, 1e-6, 1.0)
+    wv = wv / jnp.max(wv)
+    return jnp.clip(jnp.log(wv / (1.0 - wv + 1e-12)) + 0.5, 0.0, 1.0)
+
+
 class FoolsGoldDefense(BaseDefenseMethod):
     """FoolsGold (Fung et al.): reweight clients by max pairwise cosine
     similarity of their *historical* aggregate updates (sybil detection).
@@ -251,20 +270,7 @@ class FoolsGoldDefense(BaseDefenseMethod):
             cur = mat[i] if prev is None else prev + mat[i]
             self.memory[cid] = cur
             hist.append(cur)
-        m = jnp.stack(hist)
-        norms = jnp.sqrt(jnp.maximum(jnp.sum(m * m, axis=1, keepdims=True), 1e-12))
-        cs = (m / norms) @ (m / norms).T
-        n = mat.shape[0]
-        cs = cs - jnp.eye(n)
-        maxcs = jnp.maximum(jnp.max(cs, axis=1), 1e-12)
-        # pardoning (paper alg. 1): scale cs[i,j] by maxcs[i]/maxcs[j] only
-        # when maxcs[i] < maxcs[j] — always a down-scale of honest clients
-        ratio = maxcs[:, None] / maxcs[None, :]
-        adj = jnp.where(maxcs[:, None] < maxcs[None, :], cs * ratio, cs)
-        wv = 1.0 - jnp.max(adj, axis=1)
-        wv = jnp.clip(wv, 1e-6, 1.0)
-        wv = wv / jnp.max(wv)
-        wv = jnp.clip(jnp.log(wv / (1.0 - wv + 1e-12)) + 0.5, 0.0, 1.0)
+        wv = foolsgold_credibility(jnp.stack(hist))
         return vector_to_tree(_weighted_mean(mat, wv * weights), template)
 
 
@@ -276,6 +282,7 @@ class ThreeSigmaDefense(BaseDefenseMethod):
     def __init__(self, config: Any) -> None:
         super().__init__(config)
         self.use_geomedian = bool(getattr(config, "three_sigma_geomedian", False))
+        self.use_foolsgold = bool(getattr(config, "three_sigma_foolsgold", False))
 
     def defend_before_aggregation(self, raw_client_grad_list, extra_auxiliary_info=None):
         mat, weights, template = grad_list_to_matrix(raw_client_grad_list)
@@ -289,7 +296,15 @@ class ThreeSigmaDefense(BaseDefenseMethod):
         mu, sd = jnp.mean(scores), jnp.std(scores)
         keep = np.asarray(scores <= mu + 3.0 * sd)
         kept = [raw_client_grad_list[i] for i in range(len(keep)) if keep[i]]
-        return kept if kept else raw_client_grad_list
+        kept = kept if kept else raw_client_grad_list
+        if self.use_foolsgold and len(kept) > 1:
+            # foolsgold variant: reweight survivors by similarity credibility
+            # (full alg. 1 incl. pardoning + logit, shared with FoolsGold)
+            kmat, _, _ = grad_list_to_matrix(kept)
+            wv = foolsgold_credibility(kmat)
+            kept = [(float(n_k) * float(w), g)
+                    for (n_k, g), w in zip(kept, list(wv))]
+        return kept
 
 
 def _round_client_ids(n: int):
