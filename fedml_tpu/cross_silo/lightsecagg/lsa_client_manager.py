@@ -19,7 +19,7 @@ from ...core.mpc.lightsecagg import aggregate_encoded_masks, mask_encoding
 from ...core.mpc.secagg import FIELD_PRIME
 from ..client.trainer_dist_adapter import TrainerDistAdapter
 from .lsa_message_define import LSAMessage
-from .lsa_utils import mask_field_vector, tree_to_field_vector
+from .lsa_utils import mask_field_vector, tree_to_weighted_field_vector
 
 
 class LSAClientManager(FedMLCommManager):
@@ -74,7 +74,9 @@ class LSAClientManager(FedMLCommManager):
         d, n, u, t = (self.proto["d"], self.proto["n"], self.proto["u"],
                       self.proto["t"])
         scale = self.proto.get("scale", 1 << 10)
-        qvec, _ = tree_to_field_vector(weights, scale)
+        # pre-scale by n_samples/W_NORM → server opens the weighted-FedAvg
+        # numerator (see lsa_utils.tree_to_weighted_field_vector)
+        qvec, _ = tree_to_weighted_field_vector(weights, n_samples, scale)
         assert len(qvec) == d, (len(qvec), d)
         local_mask = self._rng.randint(0, int(FIELD_PRIME), size=d).astype(
             np.int64)

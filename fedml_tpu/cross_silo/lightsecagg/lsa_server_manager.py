@@ -21,7 +21,11 @@ from ...core.distributed.fedml_comm_manager import FedMLCommManager
 from ...core.mpc.lightsecagg import decode_aggregate_mask
 from ..server.fedml_aggregator import FedMLAggregator
 from .lsa_message_define import LSAMessage
-from .lsa_utils import field_vector_to_tree, tree_to_field_vector, unmask_field_sum
+from .lsa_utils import (
+    tree_to_field_vector,
+    unmask_field_sum,
+    weighted_sum_to_mean_tree,
+)
 
 FIELD = None
 
@@ -111,9 +115,9 @@ class LSAServerManager(FedMLCommManager):
         agg_mask = decode_aggregate_mask(
             dict(self.agg_shares), self.d, self.client_num, self.u, self.t)
         clear = unmask_field_sum(qsum, agg_mask)
-        avg_tree = field_vector_to_tree(clear, self._template,
-                                        n_summed=len(survivors),
-                                        scale=self.scale)
+        total_w = sum(self.sample_nums.get(r, 1.0) for r in survivors) or 1.0
+        avg_tree = weighted_sum_to_mean_tree(clear, self._template, total_w,
+                                             self.scale)
         self.aggregator.set_global_model_params(avg_tree)
 
         freq = int(getattr(self.args, "frequency_of_the_test", 1) or 1)

@@ -55,3 +55,31 @@ def mask_field_vector(qvec: np.ndarray, mask: np.ndarray) -> np.ndarray:
 def unmask_field_sum(qsum: np.ndarray, agg_mask: np.ndarray) -> np.ndarray:
     return (np.asarray(qsum, np.int64) - np.asarray(agg_mask, np.int64)) \
         % FIELD_PRIME
+
+
+# -- sample-weighted aggregation under masking -------------------------------
+# Clients pre-scale updates by (n_samples / W_NORM) before quantization so the
+# opened field sum is the weighted-FedAvg numerator; the server divides by
+# sum(n_samples) / W_NORM.  W_NORM keeps q = x * scale * n/W_NORM far below
+# the field prime even for thousands-of-samples silos.
+W_NORM = 256.0
+
+
+def tree_to_weighted_field_vector(tree: Any, n_samples: float,
+                                  scale: int = DEFAULT_SCALE
+                                  ) -> Tuple[np.ndarray, Any]:
+    w = float(n_samples) / W_NORM
+    scaled = jax.tree_util.tree_map(lambda x: np.asarray(x, np.float64) * w,
+                                    tree)
+    return tree_to_field_vector(scaled, scale)
+
+
+def weighted_sum_to_mean_tree(qsum: np.ndarray, like: Any,
+                              total_samples: float,
+                              scale: int = DEFAULT_SCALE) -> Any:
+    sum_tree = field_vector_to_tree(qsum, like, n_summed=1, scale=scale)
+    denom = max(float(total_samples), 1e-12) / W_NORM
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(lambda x: (x / denom).astype(x.dtype),
+                                  sum_tree)

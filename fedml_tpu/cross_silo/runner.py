@@ -40,6 +40,10 @@ def init_server(args: Any, dataset: Tuple, bundle: Any,
         from .lightsecagg.lsa_server_manager import LSAServerManager
         return LSAServerManager(args, agg, rank=0, client_num=client_num,
                                 backend=backend)
+    if opt == FED_OPT_SECAGG:
+        from .secagg.sa_server_manager import SAServerManager
+        return SAServerManager(args, agg, rank=0, client_num=client_num,
+                               backend=backend)
     return FedMLServerManager(args, agg, rank=0, client_num=client_num,
                               backend=backend)
 
@@ -54,6 +58,10 @@ def init_client(args: Any, dataset: Tuple, bundle: Any, rank: int,
         from .lightsecagg.lsa_client_manager import LSAClientManager
         return LSAClientManager(args, adapter, rank=rank, size=size,
                                 backend=backend)
+    if opt == FED_OPT_SECAGG:
+        from .secagg.sa_client_manager import SAClientManager
+        return SAClientManager(args, adapter, rank=rank, size=size,
+                               backend=backend)
     return ClientMasterManager(args, adapter, rank=rank, size=size,
                                backend=backend)
 

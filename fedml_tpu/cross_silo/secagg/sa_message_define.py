@@ -1,0 +1,34 @@
+"""SecAgg message schema (reference `cross_silo/secagg/sa_message_define.py:
+16-35`): public keys, secret shares, masked models, active-client set,
+secret-share reconstruction."""
+
+
+class SAMessage:
+    MSG_TYPE_C2S_CLIENT_STATUS = "C2S_CLIENT_STATUS"
+    MSG_TYPE_C2S_PUBLIC_KEY = "C2S_PUBLIC_KEY"
+    MSG_TYPE_S2C_PUBLIC_KEYS = "S2C_PUBLIC_KEYS"
+    MSG_TYPE_C2C_SECRET_SHARE = "C2C_SECRET_SHARE"
+    MSG_TYPE_S2C_INIT_CONFIG = "S2C_INIT_CONFIG_SA"
+    MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT = "S2C_SYNC_MODEL_SA"
+    MSG_TYPE_C2S_MASKED_MODEL = "C2S_MASKED_MODEL_SA"
+    MSG_TYPE_S2C_UNMASK_REQUEST = "S2C_UNMASK_REQUEST"
+    MSG_TYPE_C2S_SS_RECONSTRUCTION = "C2S_SS_RECONSTRUCTION"
+    MSG_TYPE_S2C_FINISH = "S2C_FINISH_SA"
+
+    ARG_MODEL_PARAMS = "model_params"
+    ARG_MASKED_VECTOR = "masked_vector"
+    ARG_CLIENT_INDEX = "client_idx"
+    ARG_NUM_SAMPLES = "num_samples"
+    ARG_ROUND = "round_idx"
+    ARG_PUBLIC_KEY = "public_key"
+    ARG_PUBLIC_KEYS = "public_keys"          # dict rank -> pk
+    ARG_SS_B = "share_of_b"                  # share of self-mask seed
+    ARG_SS_SK = "share_of_sk"                # share of DH secret key
+    ARG_ACTIVE_SET = "active_set"            # survivors (uploaded a model)
+    ARG_DROPPED_SET = "dropped_set"          # selected but missing
+    ARG_B_SHARES = "b_shares"                # dict rank -> share of b
+    ARG_SK_SHARES = "sk_shares"              # dict rank -> share of sk
+    ARG_PROTO = "sa_proto"                   # dict(d, n, t, scale)
+    ARG_CLIENT_STATUS = "client_status"
+
+    CLIENT_STATUS_ONLINE = "ONLINE"

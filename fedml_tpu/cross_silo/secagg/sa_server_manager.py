@@ -1,0 +1,200 @@
+"""SecAgg server manager.
+
+Capability parity: reference `cross_silo/secagg/sa_fedml_server_manager.py` +
+`sa_fedml_aggregator.py` (317 LoC): broadcast the cohort's public keys,
+collect double-masked models, detect in-round dropouts, request
+reconstruction shares (b for survivors, sk for dropped), Shamir-reconstruct,
+strip self- and orphaned pairwise masks, average, advance rounds.
+
+Liveness caveat (same as the reference implementation): each protocol stage
+gates on replies from the full expected cohort, so a client that dies
+mid-stage stalls the round until the transport surfaces the disconnect; the
+Shamir threshold t covers *observable* dropout between upload and
+reconstruction, not silent mid-stage crashes (production deployments add
+per-stage timeouts at the transport layer).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict
+
+import numpy as np
+
+from ...core import mlops
+from ...core.distributed.communication.message import Message
+from ...core.distributed.fedml_comm_manager import FedMLCommManager
+from ...core.mpc.secagg import FIELD_PRIME, shamir_reconstruct
+from ..lightsecagg.lsa_utils import (
+    tree_to_field_vector,
+    weighted_sum_to_mean_tree,
+)
+from ..server.fedml_aggregator import FedMLAggregator
+from .sa_message_define import SAMessage
+from .sa_utils import remove_dropped_pairwise_masks, remove_self_masks
+
+
+class SAServerManager(FedMLCommManager):
+    def __init__(self, args: Any, aggregator: FedMLAggregator, comm=None,
+                 rank: int = 0, client_num: int = 0,
+                 backend: str = "INPROC") -> None:
+        super().__init__(args, comm, rank, client_num + 1, backend)
+        self.aggregator = aggregator
+        self.round_num = int(args.comm_round)
+        self.args.round_idx = 0
+        self.client_num = client_num
+        self.scale = 1 << 10
+        self.t = max(1, client_num // 2)  # reconstruction threshold
+        self.public_keys: Dict[int, int] = {}
+        self.masked: Dict[int, np.ndarray] = {}
+        self.sample_nums: Dict[int, float] = {}
+        # reconstruction shares: owner rank -> {share index -> share}
+        self.b_shares: Dict[int, Dict[int, np.ndarray]] = {}
+        self.sk_shares: Dict[int, Dict[int, np.ndarray]] = {}
+        self.reconstruction_replies = 0
+        self.d = None
+        self._template = None
+        # ranks whose DH secret key the server has reconstructed: their
+        # self-mask is the ONLY remaining protection on any later upload, so
+        # revealing their b too (as a survivor) would expose their update.
+        # Treat them as permanently dropped instead.
+        self.revealed: set = set()
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            SAMessage.MSG_TYPE_C2S_PUBLIC_KEY, self.handle_public_key)
+        self.register_message_receive_handler(
+            SAMessage.MSG_TYPE_C2S_MASKED_MODEL, self.handle_masked_model)
+        self.register_message_receive_handler(
+            SAMessage.MSG_TYPE_C2S_SS_RECONSTRUCTION,
+            self.handle_reconstruction)
+
+    # -- round 0: collect + broadcast public keys ----------------------------
+    def handle_public_key(self, msg: Message) -> None:
+        self.public_keys[msg.get_sender_id()] = int(
+            msg.get(SAMessage.ARG_PUBLIC_KEY))
+        if len(self.public_keys) == self.client_num:
+            self._broadcast_keys_and_start()
+
+    def _broadcast_keys_and_start(self) -> None:
+        global_model = self.aggregator.get_global_model_params()
+        self._template = global_model
+        qvec, _ = tree_to_field_vector(global_model, self.scale)
+        self.d = int(len(qvec))
+        proto = {"d": self.d, "n": self.client_num, "t": self.t,
+                 "scale": self.scale}
+        ids = self.aggregator.client_sampling(
+            self.args.round_idx, int(self.args.client_num_in_total),
+            self.client_num)
+        for i in range(self.client_num):
+            msg = Message(SAMessage.MSG_TYPE_S2C_PUBLIC_KEYS,
+                          self.get_sender_id(), i + 1)
+            msg.add_params(SAMessage.ARG_PUBLIC_KEYS, dict(self.public_keys))
+            msg.add_params(SAMessage.ARG_PROTO, proto)
+            self.send_message(msg)
+        self._send_round_start(SAMessage.MSG_TYPE_S2C_INIT_CONFIG, ids)
+
+    def _send_round_start(self, msg_type: str, ids=None) -> None:
+        if ids is None:
+            ids = self.aggregator.client_sampling(
+                self.args.round_idx, int(self.args.client_num_in_total),
+                self.client_num)
+        global_model = self.aggregator.get_global_model_params()
+        self._template = global_model
+        for i in range(self.client_num):
+            msg = Message(msg_type, self.get_sender_id(), i + 1)
+            msg.add_params(SAMessage.ARG_MODEL_PARAMS, global_model)
+            msg.add_params(SAMessage.ARG_CLIENT_INDEX, ids[i % len(ids)])
+            msg.add_params(SAMessage.ARG_ROUND, self.args.round_idx)
+            self.send_message(msg)
+
+    # -- masked model collection ---------------------------------------------
+    def handle_masked_model(self, msg: Message) -> None:
+        sender = msg.get_sender_id()
+        self.masked[sender] = np.asarray(
+            msg.get(SAMessage.ARG_MASKED_VECTOR), np.int64)
+        self.sample_nums[sender] = float(
+            msg.get(SAMessage.ARG_NUM_SAMPLES, 1.0))
+        # dropout emulation hook for tests: ranks listed here never "arrive";
+        # revealed-sk ranks are excluded from aggregation permanently
+        drop = set(getattr(self.args, "sa_simulate_dropout_ranks", []) or [])
+        drop |= self.revealed
+        expected = self.client_num - len(drop)
+        if sender in drop:
+            del self.masked[sender]
+            self.sample_nums.pop(sender, None)
+            return
+        if len(self.masked) >= expected:
+            active = sorted(self.masked.keys())
+            dropped = sorted(set(range(1, self.client_num + 1)) - set(active))
+            for r in active:
+                req = Message(SAMessage.MSG_TYPE_S2C_UNMASK_REQUEST,
+                              self.get_sender_id(), r)
+                req.add_params(SAMessage.ARG_ACTIVE_SET, active)
+                req.add_params(SAMessage.ARG_DROPPED_SET, dropped)
+                self.send_message(req)
+
+    # -- reconstruction ------------------------------------------------------
+    def handle_reconstruction(self, msg: Message) -> None:
+        sender = msg.get_sender_id()
+        for owner, share in dict(msg.get(SAMessage.ARG_B_SHARES, {})).items():
+            self.b_shares.setdefault(int(owner), {})[sender - 1] = \
+                np.asarray(share, np.int64)
+        for owner, share in dict(msg.get(SAMessage.ARG_SK_SHARES, {})).items():
+            self.sk_shares.setdefault(int(owner), {})[sender - 1] = \
+                np.asarray(share, np.int64)
+        self.reconstruction_replies += 1
+        if self.reconstruction_replies < len(self.masked):
+            return
+        self._unmask_and_advance()
+
+    def _unmask_and_advance(self) -> None:
+        active = sorted(self.masked.keys())
+        dropped = sorted(set(range(1, self.client_num + 1)) - set(active))
+        if len(active) < self.t + 1:
+            raise RuntimeError(
+                f"SecAgg round {self.args.round_idx}: only {len(active)} "
+                f"survivors < reconstruction threshold t+1={self.t + 1}; "
+                "the masked sum cannot be opened")
+        qsum = np.zeros(self.d, np.int64)
+        for r in active:
+            qsum = (qsum + self.masked[r]) % FIELD_PRIME
+
+        b_seeds = {r: int(shamir_reconstruct(self.b_shares[r])[0])
+                   for r in active}
+        qsum = remove_self_masks(qsum, b_seeds)
+        if dropped:
+            dropped_sks = {r: int(shamir_reconstruct(self.sk_shares[r])[0])
+                           for r in dropped if r in self.sk_shares}
+            qsum = remove_dropped_pairwise_masks(
+                qsum, active, dropped_sks, self.public_keys)
+            self.revealed |= set(dropped_sks)
+            logging.info("SA server: reconstructed %d dropped clients' masks"
+                         " (excluded from future rounds)", len(dropped))
+
+        # sample-weighted FedAvg under masking: clients pre-scaled their
+        # update by n_samples/W_NORM, so the opened sum divides by the
+        # matching total weight
+        total_w = sum(self.sample_nums.get(r, 1.0) for r in active) or 1.0
+        avg_tree = weighted_sum_to_mean_tree(qsum, self._template, total_w,
+                                             self.scale)
+        self.aggregator.set_global_model_params(avg_tree)
+
+        freq = int(getattr(self.args, "frequency_of_the_test", 1) or 1)
+        if (self.args.round_idx % freq == 0
+                or self.args.round_idx == self.round_num - 1):
+            self.aggregator.test_on_server_for_all_clients(self.args.round_idx)
+
+        self.masked.clear()
+        self.b_shares.clear()
+        self.sk_shares.clear()
+        self.reconstruction_replies = 0
+        self.args.round_idx += 1
+        if self.args.round_idx >= self.round_num:
+            for r in range(1, self.client_num + 1):
+                self.send_message(Message(SAMessage.MSG_TYPE_S2C_FINISH,
+                                          self.get_sender_id(), r))
+            mlops.log_aggregation_status("FINISHED")
+            self.finish()
+            return
+        self._send_round_start(SAMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)

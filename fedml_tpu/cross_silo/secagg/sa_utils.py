@@ -1,0 +1,102 @@
+"""Pairwise-mask SecAgg math (Bonawitz et al. 2017).
+
+Capability parity: reference `cross_silo/secagg/` + `core/mpc/secagg.py` —
+Diffie-Hellman pairwise agreement in the prime field, PRG mask expansion,
+the signed pairwise-mask sum, and reconstruction of dropped clients' masks
+from Shamir shares.
+
+All of this is control-plane-sized host math (the model vector is the only
+O(d) object); field ops are numpy int64 over p = 2^31 − 1 so products of
+residues are exact (SURVEY §7 hard part (c)).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from ...core.mpc.secagg import FIELD_PRIME, pow_mod
+
+DH_GENERATOR = 7  # primitive root mod 2^31 - 1
+
+
+def dh_keypair(rng: np.random.RandomState):
+    """(secret, public = g^secret mod p)."""
+    sk = int(rng.randint(2, int(FIELD_PRIME - 1)))
+    pk = int(pow_mod(np.int64(DH_GENERATOR), sk))
+    return sk, pk
+
+
+def dh_shared_seed(sk_self: int, pk_peer: int) -> int:
+    """Shared seed = pk_peer^sk_self mod p — equal on both ends."""
+    return int(pow_mod(np.int64(pk_peer), int(sk_self)))
+
+
+def prg_field_vector(seed: int, d: int) -> np.ndarray:
+    """Expand a seed into a length-d field vector (the PRG both masker and
+    reconstructor run)."""
+    rng = np.random.RandomState(seed % (2**32 - 1))
+    # randint upper bound is exclusive and must fit int32 on some platforms;
+    # draw two 16-bit halves to cover [0, p)
+    hi = rng.randint(0, 1 << 15, size=d).astype(np.int64)
+    lo = rng.randint(0, 1 << 16, size=d).astype(np.int64)
+    return ((hi << 16) | lo) % FIELD_PRIME
+
+
+def pairwise_mask(rank: int, peer_ranks: Sequence[int],
+                  shared_seeds: Dict[int, int], d: int) -> np.ndarray:
+    """sum_{j<i} PRG(s_ij) − sum_{j>i} PRG(s_ij) mod p: cancels exactly in
+    the sum over all surviving pairs."""
+    m = np.zeros(d, np.int64)
+    for j in peer_ranks:
+        if j == rank:
+            continue
+        pm = prg_field_vector(shared_seeds[j], d)
+        if j < rank:
+            m = (m + pm) % FIELD_PRIME
+        else:
+            m = (m - pm) % FIELD_PRIME
+    return m
+
+
+def mask_upload(qvec: np.ndarray, b_seed: int, rank: int,
+                peer_ranks: Sequence[int], shared_seeds: Dict[int, int]
+                ) -> np.ndarray:
+    """y_i = x_i + PRG(b_i) + pairwise_mask_i mod p."""
+    d = len(qvec)
+    y = (np.asarray(qvec, np.int64)
+         + prg_field_vector(b_seed, d)
+         + pairwise_mask(rank, peer_ranks, shared_seeds, d)) % FIELD_PRIME
+    return y
+
+
+def remove_self_masks(qsum: np.ndarray, b_seeds: Dict[int, int]) -> np.ndarray:
+    """Subtract every survivor's PRG(b_i) from the masked sum."""
+    d = len(qsum)
+    out = np.asarray(qsum, np.int64) % FIELD_PRIME
+    for b in b_seeds.values():
+        out = (out - prg_field_vector(int(b), d)) % FIELD_PRIME
+    return out
+
+
+def remove_dropped_pairwise_masks(qsum: np.ndarray, active: List[int],
+                                  dropped_sks: Dict[int, int],
+                                  public_keys: Dict[int, int]) -> np.ndarray:
+    """For each dropped client u (whose pairwise masks did NOT cancel),
+    recompute s_uv with every active v from u's reconstructed secret key and
+    remove u's contribution to each v's upload: v added +PRG(s_uv) if u<v
+    else −PRG(s_uv)."""
+    d = len(qsum)
+    out = np.asarray(qsum, np.int64) % FIELD_PRIME
+    for u, sk_u in dropped_sks.items():
+        for v in active:
+            if v == u:
+                continue
+            s_uv = dh_shared_seed(int(sk_u), int(public_keys[v]))
+            pm = prg_field_vector(s_uv, d)
+            if u < v:
+                out = (out - pm) % FIELD_PRIME  # v added +PRG
+            else:
+                out = (out + pm) % FIELD_PRIME  # v added −PRG
+    return out
