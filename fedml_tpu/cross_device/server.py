@@ -1,0 +1,184 @@
+"""Cross-device server plane — "BeeHive" equivalent.
+
+Capability parity: reference `cross_device/server_mnn/fedml_server_manager.py:
+14-421` + `fedml_aggregator.py:60-120` + `runner.py:156-169`: the Python side
+is SERVER-ONLY; clients are native-code edge devices. The reference's global
+model is an `.mnn` file round-tripped through torch tensors; here the edge
+artifact is a flat numpy `.npz` bundle (the native C++ trainer's layout, see
+`native/native_trainer.py`), written per round so devices can fetch it
+out-of-band exactly like the MNN file on S3.
+
+The wire schema is the cross-silo one — the protocol-parity property the
+reference checks in `tests/android_protocol_test/test_protocol.py`: one
+server implementation drives JAX silos and native devices interchangeably.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core import mlops
+from ..core.alg_frame.server_aggregator import ServerAggregator
+from ..cross_silo.server.fedml_aggregator import FedMLAggregator
+from ..cross_silo.server.fedml_server_manager import FedMLServerManager
+
+
+def write_edge_bundle(params: Dict[str, np.ndarray], path: str) -> str:
+    """Serialize a flat weight dict as the edge artifact (`.npz`), the
+    analogue of `write_tensor_dict_to_mnn` (`server_mnn/utils.py`)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    np.savez(path, **{k: np.asarray(v) for k, v in params.items()})
+    return path
+
+
+def read_edge_bundle(path: str) -> Dict[str, np.ndarray]:
+    """Read an edge artifact back into a flat weight dict (the analogue of
+    `read_mnn_as_tensor_dict`, `server_mnn/utils.py:11-30`)."""
+    with np.load(path) as z:
+        return {k: z[k] for k in z.files}
+
+
+class EdgeServerAggregator(ServerAggregator):
+    """Server-side eval in the native weight layout (the reference evaluates
+    the aggregated MNN model server-side, `fedml_aggregator.py:222-240`)."""
+
+    def __init__(self, bundle: Any, args: Any) -> None:
+        super().__init__(bundle, args)
+        from ..native.native_trainer import NativeClientTrainer
+
+        self._edge_eval = NativeClientTrainer(bundle, args)
+
+    def test(self, test_data, device=None, args=None):
+        self._edge_eval.params = {
+            k: np.asarray(v) for k, v in self.params.items()}
+        return self._edge_eval.test(test_data)
+
+
+class EdgeServerManager(FedMLServerManager):
+    """Cross-device server: cross-silo round protocol + per-round edge
+    artifact emission and a start_train run-config broadcast
+    (reference `fedml_server_manager.py:58-100`)."""
+
+    def __init__(self, args: Any, aggregator: FedMLAggregator, comm=None,
+                 rank: int = 0, client_num: int = 0,
+                 backend: str = "MQTT_S3") -> None:
+        super().__init__(args, aggregator, comm, rank, client_num, backend)
+        self.artifact_dir = str(
+            getattr(args, "edge_artifact_dir", "") or
+            os.path.join(os.path.expanduser("~"), ".fedml_tpu", "edge",
+                         str(getattr(args, "run_id", "0"))))
+
+    def start_train(self) -> None:
+        """Broadcast the run config JSON (edges, hyperparams) — the MLOps
+        `start_train` message the reference sends at `:58-100`."""
+        run_config = {
+            "run_id": str(getattr(self.args, "run_id", "0")),
+            "edges": list(range(1, self.client_num + 1)),
+            "hyperparameters": {
+                "comm_round": int(self.args.comm_round),
+                "batch_size": int(getattr(self.args, "batch_size", 32)),
+                "learning_rate": float(
+                    getattr(self.args, "learning_rate", 0.1)),
+                "epochs": int(getattr(self.args, "epochs", 1)),
+            },
+            "timestamp": time.time(),
+        }
+        os.makedirs(self.artifact_dir, exist_ok=True)
+        with open(os.path.join(self.artifact_dir, "run_config.json"),
+                  "w") as f:
+            json.dump(run_config, f)
+        logging.info("cross-device run config: %s", run_config)
+
+    def _emit_artifact(self, round_idx: int) -> None:
+        params = self.aggregator.get_global_model_params()
+        if isinstance(params, dict) and all(
+                isinstance(v, (np.ndarray, np.generic)) or hasattr(v, "shape")
+                for v in params.values()):
+            path = os.path.join(self.artifact_dir,
+                                f"global_model_r{round_idx}.npz")
+            write_edge_bundle(params, path)
+            mlops.log_aggregated_model_info(round_idx, model_url=path)
+
+    def handle_message_receive_model_from_client(self, msg) -> None:
+        before = self.args.round_idx
+        super().handle_message_receive_model_from_client(msg)
+        if self.args.round_idx != before:  # a round just closed
+            self._emit_artifact(before)
+
+    def run(self) -> None:
+        self.start_train()
+        super().run()
+
+
+def build_cross_device_runner(args: Any, device: Any, dataset: Tuple,
+                              bundle: Any, client_trainer=None,
+                              server_aggregator=None):
+    """Reference `runner.py:156-169`: cross_device raises unless this process
+    is the server. A `role="simulated"` escape hatch federates native edge
+    clients in-process (the protocol test the reference keeps in
+    `tests/android_protocol_test`)."""
+    role = str(getattr(args, "role", "server"))
+    if role not in ("server", "simulated"):
+        raise RuntimeError(
+            "cross_device: the Python runtime is server-only; edge devices "
+            "run the native client (fedml_tpu/native)")
+    agg_impl = server_aggregator or EdgeServerAggregator(bundle, args)
+    if agg_impl.get_model_params() is None:
+        # initial global model in the native layout: linear head on flat input
+        d = int(np.prod(dataset[2][0].shape[1:]))
+        classes = int(dataset[-1])
+        agg_impl.set_model_params({
+            "w1": np.zeros(0, np.float32), "b1": np.zeros(0, np.float32),
+            "w2": np.zeros((d, classes), np.float32),
+            "b2": np.zeros(classes, np.float32)})
+    client_num = int(args.client_num_per_round)
+    aggregator = FedMLAggregator(args, agg_impl, dataset[3])
+    backend = str(getattr(args, "backend", "MQTT_S3")).upper()
+    server = EdgeServerManager(args, aggregator, rank=0,
+                               client_num=client_num, backend=backend)
+    if role == "server":
+        return _ServerOnlyRunner(server)
+    return _SimulatedEdgeRunner(args, server, bundle, dataset, client_num,
+                                backend)
+
+
+class _ServerOnlyRunner:
+    def __init__(self, server: EdgeServerManager) -> None:
+        self.server = server
+
+    def train(self):
+        self.server.run()
+        hist = self.server.aggregator.metrics_history
+        return hist[-1] if hist else {}
+
+
+class _SimulatedEdgeRunner:
+    """Server + native edge clients on threads (protocol test harness)."""
+
+    def __init__(self, args, server, bundle, dataset, client_num, backend):
+        from .edge_client import EdgeClientManager
+
+        self.server = server
+        self.clients = [
+            EdgeClientManager(args, bundle, dataset, rank, client_num + 1,
+                              backend=backend)
+            for rank in range(1, client_num + 1)
+        ]
+
+    def train(self):
+        threads = [threading.Thread(target=c.run, daemon=True)
+                   for c in self.clients]
+        for t in threads:
+            t.start()
+        self.server.run()
+        for t in threads:
+            t.join(timeout=30)
+        hist = self.server.aggregator.metrics_history
+        return hist[-1] if hist else {}

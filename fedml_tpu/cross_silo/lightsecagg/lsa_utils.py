@@ -58,28 +58,26 @@ def unmask_field_sum(qsum: np.ndarray, agg_mask: np.ndarray) -> np.ndarray:
 
 
 # -- sample-weighted aggregation under masking -------------------------------
-# Clients pre-scale updates by (n_samples / W_NORM) before quantization so the
+# Clients quantize FIRST (full scale precision), then multiply by the integer
+# n_samples in the field — exact mod-p arithmetic, no precision loss — so the
 # opened field sum is the weighted-FedAvg numerator; the server divides by
-# sum(n_samples) / W_NORM.  W_NORM keeps q = x * scale * n/W_NORM far below
-# the field prime even for thousands-of-samples silos.
-W_NORM = 256.0
+# sum(n_samples).  Headroom: signed recovery needs
+# sum_i |x_i|_max * scale * n_i < p/2 ≈ 1.07e9, i.e. with scale 2^10 and
+# |x| ≤ 10 the cohort supports ~100k total samples per round.
 
 
 def tree_to_weighted_field_vector(tree: Any, n_samples: float,
                                   scale: int = DEFAULT_SCALE
                                   ) -> Tuple[np.ndarray, Any]:
-    w = float(n_samples) / W_NORM
-    scaled = jax.tree_util.tree_map(lambda x: np.asarray(x, np.float64) * w,
-                                    tree)
-    return tree_to_field_vector(scaled, scale)
+    qvec, template = tree_to_field_vector(tree, scale)
+    w = np.int64(max(1, int(round(float(n_samples))))) % FIELD_PRIME
+    return (qvec * w) % FIELD_PRIME, template
 
 
 def weighted_sum_to_mean_tree(qsum: np.ndarray, like: Any,
                               total_samples: float,
                               scale: int = DEFAULT_SCALE) -> Any:
     sum_tree = field_vector_to_tree(qsum, like, n_summed=1, scale=scale)
-    denom = max(float(total_samples), 1e-12) / W_NORM
-    import jax.numpy as jnp
-
+    denom = max(1.0, round(float(total_samples)))
     return jax.tree_util.tree_map(lambda x: (x / denom).astype(x.dtype),
                                   sum_tree)

@@ -1,10 +1,18 @@
 """SecAgg client manager.
 
-Capability parity: reference `cross_silo/secagg/sa_fedml_client_manager.py`:
-advertise public key → receive the cohort's keys → Shamir-share the DH
-secret key and the self-mask seed to peers → train → upload the
-double-masked model → answer the server's reconstruction request with the
-shares it holds for survivors' b and dropped clients' sk.
+Capability parity: reference `cross_silo/secagg/sa_fedml_client_manager.py`.
+
+Per-round protocol (Bonawitz et al., re-run every round so no long-lived
+secret ever protects more than one upload — a reconstructed key compromises
+only the round it was revealed for, never past or future uploads):
+
+  1. advertise a FRESH DH public key for this round
+  2. receive the cohort's round keys → derive pairwise seeds
+  3. Shamir-share this round's DH secret key and self-mask seed to peers
+  4. train → upload the double-masked model
+  5. answer the server's reconstruction request: b-shares for survivors,
+     sk-shares for dropped — never both for the same client, and only one
+     request per round (enforced, not assumed)
 """
 
 from __future__ import annotations
@@ -33,16 +41,18 @@ class SAClientManager(FedMLCommManager):
         self.proto: Dict[str, int] = {}
         self._rng = np.random.RandomState(
             int(getattr(args, "random_seed", 0) or 0) * 1000 + rank)
-        self.sk, self.pk = dh_keypair(self._rng)
+        # per-round secrets (rotated each round)
+        self.sk = 0
+        self.pk = 0
         self.b_seed = 0
         self.public_keys: Dict[int, int] = {}
         self.shared_seeds: Dict[int, int] = {}
-        # shares this client HOLDS for peers: sk once per federation,
-        # b fresh each round (the server learns survivors' b at unmask time,
-        # so reusing one b across rounds would void the mask)
-        self.held_b_shares: Dict[int, Dict[int, np.ndarray]] = {}  # round →
-        self.held_sk_shares: Dict[int, np.ndarray] = {}
+        self._seeds_round = -1  # round the current seeds/b were derived for
+        # shares this client HOLDS for peers, keyed by round
+        self.held_b_shares: Dict[int, Dict[int, np.ndarray]] = {}
+        self.held_sk_shares: Dict[int, Dict[int, np.ndarray]] = {}
         self._pending_model = None
+        self._answered_unmask: set = set()
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -60,58 +70,58 @@ class SAClientManager(FedMLCommManager):
 
     def run(self) -> None:
         self.register_message_receive_handlers()
+        self._advertise_round_key(0)
+        self.com_manager.handle_receive_message()
+
+    def _advertise_round_key(self, round_idx: int) -> None:
+        """Fresh DH keypair every round: a later reconstruction of this
+        round's sk must not open any other round's upload."""
+        self.sk, self.pk = dh_keypair(self._rng)
         msg = Message(SAMessage.MSG_TYPE_C2S_PUBLIC_KEY,
                       self.get_sender_id(), 0)
         msg.add_params(SAMessage.ARG_PUBLIC_KEY, self.pk)
+        msg.add_params(SAMessage.ARG_ROUND, round_idx)
         self.send_message(msg)
-        self.com_manager.handle_receive_message()
 
-    # -- round 0: key agreement + secret sharing -----------------------------
+    # -- key distribution + secret sharing (every round) ---------------------
     def handle_public_keys(self, msg: Message) -> None:
+        rnd = int(msg.get(SAMessage.ARG_ROUND, 0))
         self.public_keys = {int(k): int(v) for k, v in
                             dict(msg.get(SAMessage.ARG_PUBLIC_KEYS)).items()}
         self.proto = dict(msg.get(SAMessage.ARG_PROTO))
         n, t = int(self.proto["n"]), int(self.proto["t"])
-        for peer, pk in self.public_keys.items():
-            if peer != self.rank:
-                self.shared_seeds[peer] = dh_shared_seed(self.sk, pk)
-        # Shamir-share the long-lived DH secret key once
-        sk_shares = shamir_share(np.array([self.sk]), n, t, self._rng)
-        for j in range(n):
-            peer_rank = j + 1
-            if peer_rank == self.rank:
-                self.held_sk_shares[self.rank] = sk_shares[j]
-                continue
-            share_msg = Message(SAMessage.MSG_TYPE_C2C_SECRET_SHARE,
-                                self.get_sender_id(), peer_rank)
-            share_msg.add_params(SAMessage.ARG_SS_SK, sk_shares[j])
-            share_msg.add_params(SAMessage.ARG_ROUND, -1)
-            self.send_message(share_msg)
-
-    def _share_fresh_b(self) -> None:
-        n, t = int(self.proto["n"]), int(self.proto["t"])
+        self.shared_seeds = {
+            peer: dh_shared_seed(self.sk, pk)
+            for peer, pk in self.public_keys.items() if peer != self.rank}
+        self._seeds_round = rnd
         self.b_seed = int(self._rng.randint(1, 2**31 - 1))
+        sk_shares = shamir_share(np.array([self.sk]), n, t, self._rng)
         b_shares = shamir_share(np.array([self.b_seed]), n, t, self._rng)
         for j in range(n):
             peer_rank = j + 1
             if peer_rank == self.rank:
-                self.held_b_shares.setdefault(
-                    self.round_idx, {})[self.rank] = b_shares[j]
+                self.held_sk_shares.setdefault(rnd, {})[self.rank] = \
+                    sk_shares[j]
+                self.held_b_shares.setdefault(rnd, {})[self.rank] = \
+                    b_shares[j]
                 continue
             share_msg = Message(SAMessage.MSG_TYPE_C2C_SECRET_SHARE,
                                 self.get_sender_id(), peer_rank)
+            share_msg.add_params(SAMessage.ARG_SS_SK, sk_shares[j])
             share_msg.add_params(SAMessage.ARG_SS_B, b_shares[j])
-            share_msg.add_params(SAMessage.ARG_ROUND, self.round_idx)
+            share_msg.add_params(SAMessage.ARG_ROUND, rnd)
             self.send_message(share_msg)
+        self._maybe_upload()
 
     def handle_secret_share(self, msg: Message) -> None:
         sender = msg.get_sender_id()
+        rnd = int(msg.get(SAMessage.ARG_ROUND, 0))
         sk_share = msg.get(SAMessage.ARG_SS_SK, None)
         if sk_share is not None:
-            self.held_sk_shares[sender] = np.asarray(sk_share, np.int64)
+            self.held_sk_shares.setdefault(rnd, {})[sender] = np.asarray(
+                sk_share, np.int64)
         b_share = msg.get(SAMessage.ARG_SS_B, None)
         if b_share is not None:
-            rnd = int(msg.get(SAMessage.ARG_ROUND, 0))
             self.held_b_shares.setdefault(rnd, {})[sender] = np.asarray(
                 b_share, np.int64)
         self._maybe_upload()
@@ -120,7 +130,8 @@ class SAClientManager(FedMLCommManager):
     def handle_round(self, msg: Message) -> None:
         client_index = msg.get(SAMessage.ARG_CLIENT_INDEX)
         self.round_idx = int(msg.get(SAMessage.ARG_ROUND, 0))
-        self._share_fresh_b()
+        if self.round_idx > 0:
+            self._advertise_round_key(self.round_idx)
         self.adapter.update_dataset(int(client_index))
         self.adapter.update_model(msg.get(SAMessage.ARG_MODEL_PARAMS))
         weights, n_samples = self.adapter.train(self.round_idx)
@@ -128,20 +139,21 @@ class SAClientManager(FedMLCommManager):
         self._maybe_upload()
 
     def _maybe_upload(self) -> None:
-        """Upload once training is done AND every peer's sk-share and this
-        round's b-shares arrived (round 0 races key distribution against
-        S2C_INIT; later rounds race the b-share exchange)."""
+        """Upload once training is done AND this round's key broadcast and
+        full share exchange completed (training races key distribution)."""
         n = int(self.proto.get("n", 0))
+        rnd = self.round_idx
         if (self._pending_model is None or n == 0
-                or len(self.held_sk_shares) < n
-                or len(self.held_b_shares.get(self.round_idx, {})) < n):
+                or self._seeds_round != rnd
+                or len(self.held_sk_shares.get(rnd, {})) < n
+                or len(self.held_b_shares.get(rnd, {})) < n):
             return
         weights, n_samples = self._pending_model
         self._pending_model = None
         scale = int(self.proto.get("scale", 1 << 10))
-        # pre-scale by n_samples so the server's opened sum is the
-        # sample-weighted FedAvg numerator (weights stay private; only the
-        # scalar n_samples travels in clear, as in the plain path)
+        # pre-scale by n_samples (exact integer field multiply after
+        # quantization) so the server's opened sum is the sample-weighted
+        # FedAvg numerator; only the scalar n_samples travels in clear
         qvec, _ = tree_to_weighted_field_vector(weights, n_samples, scale)
         peer_ranks = sorted(self.public_keys.keys())
         y = mask_upload(qvec, self.b_seed, self.rank, peer_ranks,
@@ -150,27 +162,35 @@ class SAClientManager(FedMLCommManager):
                      self.get_sender_id(), 0)
         up.add_params(SAMessage.ARG_MASKED_VECTOR, y)
         up.add_params(SAMessage.ARG_NUM_SAMPLES, n_samples)
-        up.add_params(SAMessage.ARG_ROUND, self.round_idx)
+        up.add_params(SAMessage.ARG_ROUND, rnd)
         self.send_message(up)
 
     # -- reconstruction ------------------------------------------------------
     def handle_unmask_request(self, msg: Message) -> None:
-        active = [int(r) for r in msg.get(SAMessage.ARG_ACTIVE_SET)]
-        dropped = [int(r) for r in msg.get(SAMessage.ARG_DROPPED_SET, [])]
+        rnd = int(msg.get(SAMessage.ARG_ROUND, self.round_idx))
+        active = {int(r) for r in msg.get(SAMessage.ARG_ACTIVE_SET)}
+        dropped = {int(r) for r in msg.get(SAMessage.ARG_DROPPED_SET, [])}
+        # the server is the adversary here: refuse requests that would
+        # reveal BOTH shares for one client, and answer once per round
+        if active & dropped:
+            logging.warning("SA client %d: unmask request with overlapping "
+                            "active/dropped sets — refused", self.rank)
+            return
+        if rnd in self._answered_unmask:
+            logging.warning("SA client %d: duplicate unmask request for "
+                            "round %d — refused", self.rank, rnd)
+            return
+        self._answered_unmask.add(rnd)
+        round_b = self.held_b_shares.pop(rnd, {})
+        round_sk = self.held_sk_shares.pop(rnd, {})
         reply = Message(SAMessage.MSG_TYPE_C2S_SS_RECONSTRUCTION,
                         self.get_sender_id(), 0)
-        # reveal b-shares ONLY for survivors and sk-shares ONLY for dropped —
-        # never both for the same client (the SecAgg privacy invariant)
-        round_b = self.held_b_shares.get(self.round_idx, {})
         reply.add_params(SAMessage.ARG_B_SHARES, {
-            r: round_b[r] for r in active if r in round_b})
+            r: round_b[r] for r in sorted(active) if r in round_b})
         reply.add_params(SAMessage.ARG_SK_SHARES, {
-            r: self.held_sk_shares[r] for r in dropped
-            if r in self.held_sk_shares})
-        reply.add_params(SAMessage.ARG_ROUND, self.round_idx)
+            r: round_sk[r] for r in sorted(dropped) if r in round_sk})
+        reply.add_params(SAMessage.ARG_ROUND, rnd)
         self.send_message(reply)
-        # b-shares for this round are now spent
-        self.held_b_shares.pop(self.round_idx - 2, None)
 
     def handle_finish(self, msg: Message) -> None:
         logging.info("SA client %d: finish", self.rank)

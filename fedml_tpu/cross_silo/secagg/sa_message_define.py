@@ -4,7 +4,7 @@ secret-share reconstruction."""
 
 
 class SAMessage:
-    MSG_TYPE_C2S_CLIENT_STATUS = "C2S_CLIENT_STATUS"
+    # the round hello is the fresh public-key advertisement itself
     MSG_TYPE_C2S_PUBLIC_KEY = "C2S_PUBLIC_KEY"
     MSG_TYPE_S2C_PUBLIC_KEYS = "S2C_PUBLIC_KEYS"
     MSG_TYPE_C2C_SECRET_SHARE = "C2C_SECRET_SHARE"
@@ -29,6 +29,3 @@ class SAMessage:
     ARG_B_SHARES = "b_shares"                # dict rank -> share of b
     ARG_SK_SHARES = "sk_shares"              # dict rank -> share of sk
     ARG_PROTO = "sa_proto"                   # dict(d, n, t, scale)
-    ARG_CLIENT_STATUS = "client_status"
-
-    CLIENT_STATUS_ONLINE = "ONLINE"

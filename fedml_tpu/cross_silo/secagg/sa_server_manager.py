@@ -1,10 +1,15 @@
 """SecAgg server manager.
 
 Capability parity: reference `cross_silo/secagg/sa_fedml_server_manager.py` +
-`sa_fedml_aggregator.py` (317 LoC): broadcast the cohort's public keys,
-collect double-masked models, detect in-round dropouts, request
-reconstruction shares (b for survivors, sk for dropped), Shamir-reconstruct,
-strip self- and orphaned pairwise masks, average, advance rounds.
+`sa_fedml_aggregator.py` (317 LoC): per round — collect the cohort's fresh
+public keys, broadcast them, collect double-masked models, detect in-round
+dropouts, request reconstruction shares (b for survivors, sk for dropped),
+Shamir-reconstruct, strip self- and orphaned pairwise masks, average,
+advance.
+
+Keys are rotated every round (client side), so a reconstructed sk opens only
+the round it was revealed for — never a round in which that client's model
+was actually aggregated.
 
 Liveness caveat (same as the reference implementation): each protocol stage
 gates on replies from the full expected cohort, so a client that dies
@@ -38,14 +43,22 @@ class SAServerManager(FedMLCommManager):
     def __init__(self, args: Any, aggregator: FedMLAggregator, comm=None,
                  rank: int = 0, client_num: int = 0,
                  backend: str = "INPROC") -> None:
+        if client_num < 2:
+            raise ValueError(
+                "SecAgg needs at least 2 clients per round (pairwise masks "
+                f"and Shamir reconstruction are meaningless for "
+                f"client_num={client_num}); use plain FedAvg instead")
         super().__init__(args, comm, rank, client_num + 1, backend)
         self.aggregator = aggregator
         self.round_num = int(args.comm_round)
         self.args.round_idx = 0
         self.client_num = client_num
         self.scale = 1 << 10
-        self.t = max(1, client_num // 2)  # reconstruction threshold
-        self.public_keys: Dict[int, int] = {}
+        # reconstruction threshold: t+1 shares open a secret; must be
+        # reachable even if the maximum tolerated dropout occurs
+        self.t = max(1, min(client_num - 1, client_num // 2))
+        self.public_keys: Dict[int, int] = {}   # current round's cohort keys
+        self._pk_round: Dict[int, int] = {}     # rank -> round of its last pk
         self.masked: Dict[int, np.ndarray] = {}
         self.sample_nums: Dict[int, float] = {}
         # reconstruction shares: owner rank -> {share index -> share}
@@ -54,11 +67,6 @@ class SAServerManager(FedMLCommManager):
         self.reconstruction_replies = 0
         self.d = None
         self._template = None
-        # ranks whose DH secret key the server has reconstructed: their
-        # self-mask is the ONLY remaining protection on any later upload, so
-        # revealing their b too (as a survivor) would expose their update.
-        # Treat them as permanently dropped instead.
-        self.revealed: set = set()
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -69,36 +77,38 @@ class SAServerManager(FedMLCommManager):
             SAMessage.MSG_TYPE_C2S_SS_RECONSTRUCTION,
             self.handle_reconstruction)
 
-    # -- round 0: collect + broadcast public keys ----------------------------
+    # -- per-round: collect + broadcast fresh public keys --------------------
     def handle_public_key(self, msg: Message) -> None:
-        self.public_keys[msg.get_sender_id()] = int(
-            msg.get(SAMessage.ARG_PUBLIC_KEY))
-        if len(self.public_keys) == self.client_num:
-            self._broadcast_keys_and_start()
+        sender = msg.get_sender_id()
+        rnd = int(msg.get(SAMessage.ARG_ROUND, 0))
+        self.public_keys[sender] = int(msg.get(SAMessage.ARG_PUBLIC_KEY))
+        self._pk_round[sender] = rnd
+        current = [r for r, rr in self._pk_round.items()
+                   if rr == self.args.round_idx]
+        if len(current) == self.client_num:
+            self._broadcast_keys(first_round=(self.args.round_idx == 0))
 
-    def _broadcast_keys_and_start(self) -> None:
+    def _broadcast_keys(self, first_round: bool) -> None:
         global_model = self.aggregator.get_global_model_params()
         self._template = global_model
         qvec, _ = tree_to_field_vector(global_model, self.scale)
         self.d = int(len(qvec))
         proto = {"d": self.d, "n": self.client_num, "t": self.t,
                  "scale": self.scale}
-        ids = self.aggregator.client_sampling(
-            self.args.round_idx, int(self.args.client_num_in_total),
-            self.client_num)
         for i in range(self.client_num):
             msg = Message(SAMessage.MSG_TYPE_S2C_PUBLIC_KEYS,
                           self.get_sender_id(), i + 1)
             msg.add_params(SAMessage.ARG_PUBLIC_KEYS, dict(self.public_keys))
             msg.add_params(SAMessage.ARG_PROTO, proto)
+            msg.add_params(SAMessage.ARG_ROUND, self.args.round_idx)
             self.send_message(msg)
-        self._send_round_start(SAMessage.MSG_TYPE_S2C_INIT_CONFIG, ids)
+        if first_round:
+            self._send_round_start(SAMessage.MSG_TYPE_S2C_INIT_CONFIG)
 
-    def _send_round_start(self, msg_type: str, ids=None) -> None:
-        if ids is None:
-            ids = self.aggregator.client_sampling(
-                self.args.round_idx, int(self.args.client_num_in_total),
-                self.client_num)
+    def _send_round_start(self, msg_type: str) -> None:
+        ids = self.aggregator.client_sampling(
+            self.args.round_idx, int(self.args.client_num_in_total),
+            self.client_num)
         global_model = self.aggregator.get_global_model_params()
         self._template = global_model
         for i in range(self.client_num):
@@ -115,10 +125,8 @@ class SAServerManager(FedMLCommManager):
             msg.get(SAMessage.ARG_MASKED_VECTOR), np.int64)
         self.sample_nums[sender] = float(
             msg.get(SAMessage.ARG_NUM_SAMPLES, 1.0))
-        # dropout emulation hook for tests: ranks listed here never "arrive";
-        # revealed-sk ranks are excluded from aggregation permanently
+        # dropout emulation hook for tests: ranks listed here never "arrive"
         drop = set(getattr(self.args, "sa_simulate_dropout_ranks", []) or [])
-        drop |= self.revealed
         expected = self.client_num - len(drop)
         if sender in drop:
             del self.masked[sender]
@@ -132,6 +140,7 @@ class SAServerManager(FedMLCommManager):
                               self.get_sender_id(), r)
                 req.add_params(SAMessage.ARG_ACTIVE_SET, active)
                 req.add_params(SAMessage.ARG_DROPPED_SET, dropped)
+                req.add_params(SAMessage.ARG_ROUND, self.args.round_idx)
                 self.send_message(req)
 
     # -- reconstruction ------------------------------------------------------
@@ -168,13 +177,12 @@ class SAServerManager(FedMLCommManager):
                            for r in dropped if r in self.sk_shares}
             qsum = remove_dropped_pairwise_masks(
                 qsum, active, dropped_sks, self.public_keys)
-            self.revealed |= set(dropped_sks)
-            logging.info("SA server: reconstructed %d dropped clients' masks"
-                         " (excluded from future rounds)", len(dropped))
+            logging.info("SA server: reconstructed %d dropped clients' "
+                         "round keys (rotated next round)", len(dropped))
 
-        # sample-weighted FedAvg under masking: clients pre-scaled their
-        # update by n_samples/W_NORM, so the opened sum divides by the
-        # matching total weight
+        # sample-weighted FedAvg under masking: clients field-multiplied
+        # their quantized update by n_samples, so the opened sum divides by
+        # the total sample count
         total_w = sum(self.sample_nums.get(r, 1.0) for r in active) or 1.0
         avg_tree = weighted_sum_to_mean_tree(qsum, self._template, total_w,
                                              self.scale)
@@ -186,6 +194,7 @@ class SAServerManager(FedMLCommManager):
             self.aggregator.test_on_server_for_all_clients(self.args.round_idx)
 
         self.masked.clear()
+        self.sample_nums.clear()
         self.b_shares.clear()
         self.sk_shares.clear()
         self.reconstruction_replies = 0

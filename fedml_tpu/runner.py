@@ -16,6 +16,8 @@ from .constants import (
     SIMULATION_BACKEND_MESH,
     SIMULATION_BACKEND_PARROT,
     SIMULATION_BACKEND_SP,
+    TRAINING_PLATFORM_CROSS_CLOUD,
+    TRAINING_PLATFORM_CROSS_DEVICE,
     TRAINING_PLATFORM_CROSS_SILO,
     TRAINING_PLATFORM_SIMULATION,
 )
@@ -74,6 +76,14 @@ class FedMLRunner:
                     "cross_silo plane is not available in this build") from e
             return build_cross_silo_runner(args, device, dataset, model,
                                            client_trainer, server_aggregator)
+        if ttype == TRAINING_PLATFORM_CROSS_DEVICE:
+            from .cross_device.server import build_cross_device_runner
+            return build_cross_device_runner(args, device, dataset, model,
+                                             client_trainer, server_aggregator)
+        if ttype == TRAINING_PLATFORM_CROSS_CLOUD:
+            from .cross_cloud.runner import build_cross_cloud_runner
+            return build_cross_cloud_runner(args, device, dataset, model,
+                                            client_trainer, server_aggregator)
         raise ValueError(f"unknown training_type {ttype!r}")
 
     def run(self):

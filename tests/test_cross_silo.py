@@ -144,3 +144,61 @@ def test_secagg_mask_math_roundtrip():
     clear2 = remove_dropped_pairwise_masks(clear2, active, {2: sk2}, pks)
     expect2 = (xs[1] + xs[3] + xs[4]) % FIELD_PRIME
     np.testing.assert_array_equal(clear2, expect2)
+
+
+def test_secagg_client_refuses_malicious_unmask():
+    """A server asking for both b- and sk-shares of the same client (or
+    asking twice) must be refused — the SecAgg privacy invariant is enforced
+    client-side, not assumed."""
+    from fedml_tpu.arguments import Config
+    from fedml_tpu.cross_silo.secagg.sa_client_manager import SAClientManager
+    from fedml_tpu.cross_silo.secagg.sa_message_define import SAMessage
+    from fedml_tpu.core.distributed.communication.message import Message
+
+    args = Config(random_seed=0, run_id="sa-mal", client_num_per_round=2,
+                  comm_round=1)
+    c = SAClientManager.__new__(SAClientManager)  # no transport needed
+    c.args = args
+    c.rank = 1
+    c.round_idx = 0
+    c._answered_unmask = set()
+    c.held_b_shares = {0: {1: np.array([1]), 2: np.array([2])}}
+    c.held_sk_shares = {0: {1: np.array([3]), 2: np.array([4])}}
+    sent = []
+    c.send_message = lambda m: sent.append(m)
+    c.get_sender_id = lambda: 1
+
+    # overlapping sets -> refused, nothing sent, shares retained
+    bad = Message(SAMessage.MSG_TYPE_S2C_UNMASK_REQUEST, 0, 1)
+    bad.add_params(SAMessage.ARG_ACTIVE_SET, [1, 2])
+    bad.add_params(SAMessage.ARG_DROPPED_SET, [2])
+    bad.add_params(SAMessage.ARG_ROUND, 0)
+    c.handle_unmask_request(bad)
+    assert not sent and 0 in c.held_b_shares
+
+    # honest request answered once...
+    ok = Message(SAMessage.MSG_TYPE_S2C_UNMASK_REQUEST, 0, 1)
+    ok.add_params(SAMessage.ARG_ACTIVE_SET, [1])
+    ok.add_params(SAMessage.ARG_DROPPED_SET, [2])
+    ok.add_params(SAMessage.ARG_ROUND, 0)
+    c.handle_unmask_request(ok)
+    assert len(sent) == 1
+    reply = sent[0]
+    assert 1 in reply.get(SAMessage.ARG_B_SHARES)
+    assert 2 in reply.get(SAMessage.ARG_SK_SHARES)
+    # ...and never both shares for one client
+    assert 2 not in reply.get(SAMessage.ARG_B_SHARES)
+    assert 1 not in reply.get(SAMessage.ARG_SK_SHARES)
+
+    # a second (replayed) request for the same round -> refused
+    c.handle_unmask_request(ok)
+    assert len(sent) == 1
+
+
+def test_secagg_rejects_single_client():
+    from fedml_tpu.arguments import Config
+    from fedml_tpu.cross_silo.secagg.sa_server_manager import SAServerManager
+
+    with pytest.raises(ValueError, match="at least 2 clients"):
+        SAServerManager(Config(comm_round=1, run_id="sa-one"), None,
+                        client_num=1)
