@@ -96,7 +96,17 @@ def run_simulation(backend: str = constants.SIMULATION_BACKEND_SP,
     return runner.run()
 
 
+def __getattr__(name: str):
+    """PEP 562 lazy import: `fedml_tpu.api` pulls in the control-plane stack
+    (scheduler, agents, transports) only when actually used."""
+    if name == "api":
+        import importlib
+
+        return importlib.import_module(".api", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
     "__version__", "init", "run_simulation", "FedMLRunner", "Config",
-    "load_arguments", "device", "data", "model", "mlops", "constants",
+    "load_arguments", "device", "data", "model", "mlops", "constants", "api",
 ]

@@ -1,0 +1,205 @@
+"""fedml_tpu.api — the Python control-plane API.
+
+Capability parity: reference `python/fedml/api/__init__.py:29-283`:
+launch/run/stop jobs, build packages, login/logout device binding, run
+listing + logs, cluster management, model-card operations, and the
+train/federate build helpers. Local-first: everything the reference routes
+through the hosted Nexus backend is served by the local scheduler (sqlite
+runs db + broker-connected agents).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from ..scheduler import local_launcher
+from ..scheduler.agents import MasterAgent, SlaveAgent
+from ..scheduler.job_monitor import JobMonitor
+
+_CRED_PATH = os.path.join(os.path.expanduser("~"), ".fedml_tpu",
+                          "credentials.json")
+
+
+# -- jobs ---------------------------------------------------------------------
+
+def launch_job(job_yaml_path: str, edges: Optional[List[str]] = None,
+               master: Optional[MasterAgent] = None,
+               wait: bool = True, timeout: float = 300.0) -> Dict[str, Any]:
+    """`fedml.api.launch_job` equivalent. Without `edges` the job runs on
+    this machine (reference "launch on my own cluster" path); with `edges`
+    it is dispatched to bound slave agents over the broker."""
+    if not edges:
+        result = local_launcher.launch_job_local(job_yaml_path)
+        return {"run_id": result.run_id, "returncode": result.returncode,
+                "log_path": result.log_path,
+                "success": result.returncode == 0}
+    m = master or MasterAgent()
+    run_id = m.create_run(job_yaml_path, edges)
+    if not wait:
+        return {"run_id": run_id, "success": True, "completed": False}
+    return m.wait(run_id, timeout=timeout)
+
+
+def run_stop(run_id: str) -> bool:
+    """`fedml.api.run_stop` equivalent (local runs)."""
+    return local_launcher.stop_run(run_id)
+
+
+def run_list(limit: int = 20) -> List[Dict[str, Any]]:
+    return local_launcher.list_runs(limit)
+
+
+def run_status(run_id: str) -> Optional[Dict[str, Any]]:
+    return local_launcher.get_run(run_id)
+
+
+def run_logs(run_id: str, tail: int = 200) -> str:
+    info = local_launcher.get_run(run_id)
+    if not info or not info.get("log_path") or \
+            not os.path.exists(info["log_path"]):
+        return ""
+    with open(info["log_path"]) as f:
+        lines = f.readlines()
+    return "".join(lines[-tail:])
+
+
+# -- build --------------------------------------------------------------------
+
+def build(job_yaml_path: str, dest_dir: Optional[str] = None) -> str:
+    """`fedml build` / `fedml train build` / `fedml federate build`: all
+    produce the same portable package zip."""
+    return local_launcher.build_job_package(job_yaml_path, dest_dir)
+
+
+train_build = build
+federate_build = build
+
+
+# -- device binding -----------------------------------------------------------
+
+def login(api_key: str = "", edge_id: Optional[str] = None,
+          start_agent: bool = False) -> Dict[str, Any]:
+    """Bind this machine as a compute node (reference `fedml login` →
+    device binding + always-on slave agent)."""
+    os.makedirs(os.path.dirname(_CRED_PATH), exist_ok=True)
+    edge_id = edge_id or f"edge_{os.getpid()}"
+    with open(_CRED_PATH, "w") as f:
+        json.dump({"api_key": api_key, "edge_id": edge_id}, f)
+    out: Dict[str, Any] = {"edge_id": edge_id, "bound": True}
+    if start_agent:
+        out["agent"] = SlaveAgent(edge_id).start()
+    return out
+
+
+def logout() -> bool:
+    if os.path.exists(_CRED_PATH):
+        os.remove(_CRED_PATH)
+        return True
+    return False
+
+
+def device_bind(edge_id: str, start_agent: bool = True) -> Dict[str, Any]:
+    return login(edge_id=edge_id, start_agent=start_agent)
+
+
+def device_unbind() -> bool:
+    return logout()
+
+
+# -- clusters -----------------------------------------------------------------
+
+_CLUSTERS_PATH = os.path.join(os.path.expanduser("~"), ".fedml_tpu",
+                              "clusters.json")
+
+
+def _load_clusters() -> Dict[str, List[str]]:
+    if os.path.exists(_CLUSTERS_PATH):
+        with open(_CLUSTERS_PATH) as f:
+            return json.load(f)
+    return {}
+
+
+def cluster_create(name: str, edges: List[str]) -> Dict[str, Any]:
+    """Reusable named edge groups (reference `fedml cluster` /
+    `api/__init__.py:142-178`)."""
+    clusters = _load_clusters()
+    clusters[name] = [str(e) for e in edges]
+    os.makedirs(os.path.dirname(_CLUSTERS_PATH), exist_ok=True)
+    with open(_CLUSTERS_PATH, "w") as f:
+        json.dump(clusters, f)
+    return {"name": name, "edges": clusters[name]}
+
+
+def cluster_list() -> Dict[str, List[str]]:
+    return _load_clusters()
+
+
+def cluster_remove(name: str) -> bool:
+    clusters = _load_clusters()
+    if name not in clusters:
+        return False
+    del clusters[name]
+    with open(_CLUSTERS_PATH, "w") as f:
+        json.dump(clusters, f)
+    return True
+
+
+def launch_job_on_cluster(job_yaml_path: str, cluster: str,
+                          **kw: Any) -> Dict[str, Any]:
+    edges = _load_clusters().get(cluster)
+    if not edges:
+        raise ValueError(f"unknown cluster {cluster!r}; "
+                         f"known: {sorted(_load_clusters())}")
+    return launch_job(job_yaml_path, edges=edges, **kw)
+
+
+# -- models (cards delegate to the deploy scheduler) --------------------------
+
+def model_create(name: str, model_path: str,
+                 metadata: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    from ..scheduler.model_cards import ModelCardRegistry
+
+    return ModelCardRegistry().create(name, model_path, metadata)
+
+
+def model_list() -> List[Dict[str, Any]]:
+    from ..scheduler.model_cards import ModelCardRegistry
+
+    return ModelCardRegistry().list()
+
+
+def model_delete(name: str) -> bool:
+    from ..scheduler.model_cards import ModelCardRegistry
+
+    return ModelCardRegistry().delete(name)
+
+
+def model_package(name: str, dest_dir: Optional[str] = None) -> str:
+    from ..scheduler.model_cards import ModelCardRegistry
+
+    return ModelCardRegistry().package(name, dest_dir)
+
+
+def model_deploy(name: str, host: str = "127.0.0.1", port: int = 0,
+                 **kw: Any) -> Any:
+    from ..scheduler.model_cards import ModelCardRegistry
+
+    return ModelCardRegistry().deploy(name, host=host, port=port, **kw)
+
+
+# -- env ----------------------------------------------------------------------
+
+def env() -> Dict[str, Any]:
+    return local_launcher.collect_env()
+
+
+__all__ = [
+    "launch_job", "launch_job_on_cluster", "run_stop", "run_list",
+    "run_status", "run_logs", "build", "train_build", "federate_build",
+    "login", "logout", "device_bind", "device_unbind",
+    "cluster_create", "cluster_list", "cluster_remove",
+    "model_create", "model_list", "model_delete", "model_package",
+    "model_deploy", "env", "JobMonitor", "MasterAgent", "SlaveAgent",
+]

@@ -90,22 +90,121 @@ def logs(limit: int) -> None:
 
 @cli.command()
 @click.option("--api-key", "api_key", default="", help="account key")
-def login(api_key: str) -> None:
-    """Bind this machine as a compute node (local credential store)."""
-    cfg_dir = os.path.join(os.path.expanduser("~"), ".fedml_tpu")
-    os.makedirs(cfg_dir, exist_ok=True)
-    with open(os.path.join(cfg_dir, "credentials.json"), "w") as f:
-        json.dump({"api_key": api_key}, f)
-    click.echo("logged in (local mode)")
+@click.option("--edge-id", "edge_id", default=None, help="edge identity")
+@click.option("--agent/--no-agent", default=False,
+              help="start the always-on slave agent (blocks)")
+def login(api_key: str, edge_id: str, agent: bool) -> None:
+    """Bind this machine as a compute node (reference `fedml login`)."""
+    from .. import api
+
+    out = api.login(api_key=api_key, edge_id=edge_id, start_agent=agent)
+    click.echo(json.dumps({"edge_id": out["edge_id"], "bound": True}))
+    if agent:
+        click.echo("agent online; ctrl-c to stop")
+        import time
+
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            out["agent"].stop()
 
 
 @cli.command()
 def logout() -> None:
-    path = os.path.join(os.path.expanduser("~"), ".fedml_tpu",
-                        "credentials.json")
-    if os.path.exists(path):
-        os.remove(path)
+    from .. import api
+
+    api.logout()
     click.echo("logged out")
+
+
+@cli.group()
+def job() -> None:
+    """Run management (reference `fedml run list|stop|logs`)."""
+
+
+@job.command("list")
+@click.option("--limit", default=20)
+def job_list(limit: int) -> None:
+    from .. import api
+
+    for row in api.run_list(limit):
+        click.echo(json.dumps(row))
+
+
+@job.command("stop")
+@click.argument("run_id")
+def job_stop(run_id: str) -> None:
+    from .. import api
+
+    click.echo(json.dumps({"run_id": run_id,
+                           "stopped": api.run_stop(run_id)}))
+
+
+@job.command("logs")
+@click.argument("run_id")
+@click.option("--tail", default=200)
+def job_logs(run_id: str, tail: int) -> None:
+    from .. import api
+
+    click.echo(api.run_logs(run_id, tail), nl=False)
+
+
+@cli.group()
+def cluster() -> None:
+    """Named reusable edge groups (reference `fedml cluster`)."""
+
+
+@cluster.command("create")
+@click.argument("name")
+@click.argument("edges", nargs=-1, required=True)
+def cluster_create(name: str, edges) -> None:
+    from .. import api
+
+    click.echo(json.dumps(api.cluster_create(name, list(edges))))
+
+
+@cluster.command("list")
+def cluster_list() -> None:
+    from .. import api
+
+    click.echo(json.dumps(api.cluster_list()))
+
+
+@cluster.command("remove")
+@click.argument("name")
+def cluster_remove(name: str) -> None:
+    from .. import api
+
+    click.echo(json.dumps({"removed": api.cluster_remove(name)}))
+
+
+@cli.group()
+def train() -> None:
+    """Training job helpers (reference `fedml train`)."""
+
+
+@train.command("build")
+@click.argument("job_yaml", type=click.Path(exists=True))
+@click.option("--dest", default=None)
+def train_build(job_yaml: str, dest: str) -> None:
+    from .. import api
+
+    click.echo(api.train_build(job_yaml, dest))
+
+
+@cli.group()
+def federate() -> None:
+    """Federation job helpers (reference `fedml federate`)."""
+
+
+@federate.command("build")
+@click.argument("job_yaml", type=click.Path(exists=True))
+@click.option("--dest", default=None)
+def federate_build(job_yaml: str, dest: str) -> None:
+    from .. import api
+
+    click.echo(api.federate_build(job_yaml, dest))
 
 
 @cli.group()
@@ -126,14 +225,66 @@ def model() -> None:
     """Model card utilities (reference `fedml model`)."""
 
 
-@model.command("list")
-def model_list() -> None:
-    from ..models.model_hub import _DATASET_SHAPES  # noqa: F401
-
+@model.command("zoo")
+def model_zoo() -> None:
+    """Architectures `fedml_tpu.model.create` can build."""
     for name in ("lr", "cnn", "resnet20", "resnet56", "resnet18_gn",
                  "mobilenet", "mobilenet_v3", "efficientnet", "rnn",
                  "transformer", "vit"):
         click.echo(name)
+
+
+@model.command("create")
+@click.argument("name")
+@click.argument("model_path", type=click.Path(exists=True))
+def model_create(name: str, model_path: str) -> None:
+    from .. import api
+
+    click.echo(json.dumps(api.model_create(name, model_path)))
+
+
+@model.command("list")
+def model_list() -> None:
+    from .. import api
+
+    for card in api.model_list():
+        click.echo(json.dumps(card))
+
+
+@model.command("delete")
+@click.argument("name")
+def model_delete(name: str) -> None:
+    from .. import api
+
+    click.echo(json.dumps({"deleted": api.model_delete(name)}))
+
+
+@model.command("package")
+@click.argument("name")
+@click.option("--dest", default=None)
+def model_package(name: str, dest: str) -> None:
+    from .. import api
+
+    click.echo(api.model_package(name, dest))
+
+
+@model.command("deploy")
+@click.argument("name")
+@click.option("--host", default="127.0.0.1")
+@click.option("--port", default=0)
+def model_deploy(name: str, host: str, port: int) -> None:
+    """Serve a model card over HTTP (blocks; reference `fedml model deploy`)."""
+    from .. import api
+
+    ep = api.model_deploy(name, host=host, port=port)
+    click.echo(json.dumps({"endpoint": ep.url, "ready": ep.ready()}))
+    import time
+
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        ep.stop()
 
 
 def main() -> None:

@@ -74,8 +74,9 @@ class LSAClientManager(FedMLCommManager):
         d, n, u, t = (self.proto["d"], self.proto["n"], self.proto["u"],
                       self.proto["t"])
         scale = self.proto.get("scale", 1 << 10)
-        # pre-scale by n_samples/W_NORM → server opens the weighted-FedAvg
-        # numerator (see lsa_utils.tree_to_weighted_field_vector)
+        # quantize then field-multiply by integer n_samples → server opens
+        # the weighted-FedAvg numerator exactly (see
+        # lsa_utils.tree_to_weighted_field_vector for overflow headroom)
         qvec, _ = tree_to_weighted_field_vector(weights, n_samples, scale)
         assert len(qvec) == d, (len(qvec), d)
         local_mask = self._rng.randint(0, int(FIELD_PRIME), size=d).astype(

@@ -1,0 +1,343 @@
+"""MLOps agents — the always-on control-plane daemons.
+
+Capability parity: reference `computing/scheduler/slave/client_runner.py:
+60-1436` (FedMLClientRunner) and `master/server_runner.py` (FedMLServerRunner):
+`fedml login` binds the device and starts a slave agent that subscribes
+`flserver_agent/{edge_id}/start_train`, downloads the run package, rewrites
+its config, spawns the job with live log capture, reports status over the
+broker, and answers stop_train; the master agent creates runs, dispatches
+start_train to matched edges, and tracks completion.
+
+Local-first redesign: topics ride the same pluggable Broker as the MQTT+store
+transport, packages travel through the ObjectStore, and run state lives in
+the sqlite runs db — no hosted REST backend. Broker selection: a real MQTT
+broker (paho) when `FEDML_MQTT_HOST` is set — required for cross-process
+dispatch, e.g. `fedml login --agent` in one terminal and a MasterAgent in
+another — otherwise the in-process bus (same-process agents: tests,
+simulations, programmatic fleets).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import subprocess
+import threading
+import time
+import uuid
+import zipfile
+from typing import Any, Callable, Dict, List, Optional
+
+import yaml
+
+from ..core.distributed.communication.mqtt_s3.mqtt_s3_comm_manager import (
+    InProcBroker,
+)
+from ..core.distributed.communication.mqtt_s3.remote_storage import (
+    create_store,
+)
+from . import local_launcher
+
+
+class _StoreArgs:
+    """Attribute bag for create_store."""
+
+    def __init__(self, **kw: Any) -> None:
+        self.__dict__.update({k: v for k, v in kw.items() if v is not None})
+
+
+def _make_broker(channel: str, client_id: str):
+    """MQTT when FEDML_MQTT_HOST is set (cross-process dispatch), else the
+    in-process bus."""
+    host = os.environ.get("FEDML_MQTT_HOST", "")
+    if host:
+        from ..core.distributed.communication.mqtt_s3.mqtt_s3_comm_manager \
+            import PahoBroker
+
+        port = int(os.environ.get("FEDML_MQTT_PORT", "1883"))
+        return PahoBroker(host, port, client_id=f"{channel}-{client_id}")
+    return InProcBroker.get(channel)
+
+
+class ClientConstants:
+    """Run status state machine (reference `slave/client_constants.py`)."""
+
+    STATUS_IDLE = "IDLE"
+    STATUS_QUEUED = "QUEUED"
+    STATUS_INITIALIZING = "INITIALIZING"
+    STATUS_TRAINING = "TRAINING"
+    STATUS_STOPPING = "STOPPING"
+    STATUS_KILLED = "KILLED"
+    STATUS_FAILED = "FAILED"
+    STATUS_FINISHED = "FINISHED"
+
+    TERMINAL = (STATUS_KILLED, STATUS_FAILED, STATUS_FINISHED)
+
+
+def _topic_start(edge_id: str) -> str:
+    return f"flserver_agent/{edge_id}/start_train"
+
+
+def _topic_stop(edge_id: str) -> str:
+    return f"flserver_agent/{edge_id}/stop_train"
+
+
+def _topic_status(run_id: str) -> str:
+    return f"fl_client/mlops/{run_id}/status"
+
+
+def _topic_active(edge_id: str) -> str:
+    return f"flclient_agent/{edge_id}/active"
+
+
+class SlaveAgent:
+    """The edge daemon (`FedMLClientRunner` analog)."""
+
+    def __init__(self, edge_id: str, channel: str = "agents",
+                 store_dir: Optional[str] = None,
+                 heartbeat_s: float = 10.0) -> None:
+        self.edge_id = str(edge_id)
+        self.broker = _make_broker(channel, f"slave-{edge_id}")
+        self.store = create_store(
+            _StoreArgs(object_store_dir=store_dir))
+        self.heartbeat_s = heartbeat_s
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self.agent_dir = os.path.join(os.path.expanduser("~"), ".fedml_tpu",
+                                      "agent", self.edge_id)
+        os.makedirs(self.agent_dir, exist_ok=True)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "SlaveAgent":
+        self.broker.subscribe(_topic_start(self.edge_id), self._on_start)
+        self.broker.subscribe(_topic_stop(self.edge_id), self._on_stop)
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop,
+                                           daemon=True,
+                                           name=f"agent-hb-{self.edge_id}")
+        self._hb_thread.start()
+        self._send_active("ONLINE")
+        logging.info("slave agent %s online", self.edge_id)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for run_id in list(self._procs):
+            self._kill_run(run_id)
+        self._send_active("OFFLINE")
+
+    def _heartbeat_loop(self) -> None:
+        """Periodic active message (reference `send_agent_active_msg:1410` +
+        MQTT last-will liveness)."""
+        while not self._stop.wait(self.heartbeat_s):
+            self._send_active("ACTIVE")
+
+    def _send_active(self, state: str) -> None:
+        self.broker.publish(_topic_active(self.edge_id), json.dumps(
+            {"edge_id": self.edge_id, "state": state,
+             "ts": time.time()}).encode())
+
+    # -- start_train ---------------------------------------------------------
+    def _on_start(self, topic: str, payload: bytes) -> None:
+        req = json.loads(payload.decode())
+        run_id = str(req["run_id"])
+        t = threading.Thread(target=self._run_job, args=(run_id, req),
+                             daemon=True, name=f"agent-run-{run_id}")
+        t.start()
+
+    def _report(self, run_id: str, status: str, **extra: Any) -> None:
+        body = {"run_id": run_id, "edge_id": self.edge_id, "status": status,
+                "ts": time.time()}
+        body.update(extra)
+        self.broker.publish(_topic_status(run_id), json.dumps(body).encode())
+
+    def _run_job(self, run_id: str, req: Dict[str, Any]) -> None:
+        self._report(run_id, ClientConstants.STATUS_INITIALIZING)
+        try:
+            workspace = self._retrieve_and_unzip_package(run_id, req)
+            self._update_local_config(workspace, req)
+        except Exception as e:  # noqa: BLE001
+            logging.exception("agent %s: package setup failed", self.edge_id)
+            self._report(run_id, ClientConstants.STATUS_FAILED, error=str(e))
+            return
+        job_yaml = os.path.join(workspace, "job.yaml")
+        with open(job_yaml) as f:
+            cfg = yaml.safe_load(f) or {}
+        log_path = os.path.join(self.agent_dir, f"{run_id}.log")
+        local_launcher.register_run(run_id, str(cfg.get("job_name", run_id)),
+                                    log_path)
+        env = dict(os.environ)
+        env.update({k: str(v) for k, v in (cfg.get("fedml_env") or {}).items()})
+        env.update({k: str(v) for k, v in (req.get("env") or {}).items()})
+        env["FEDML_CURRENT_RUN_ID"] = run_id
+        env["FEDML_EDGE_ID"] = self.edge_id
+
+        rc = 0
+        self._report(run_id, ClientConstants.STATUS_TRAINING)
+        with open(log_path, "w") as log:
+            for label in ("bootstrap", "job"):
+                script = str(cfg.get(label, "") or "")
+                if not script.strip():
+                    continue
+                log.write(f"===== {label} =====\n")
+                log.flush()
+                wdir = os.path.join(workspace, "workspace")
+                proc = subprocess.Popen(
+                    ["bash", "-c", script],
+                    cwd=wdir if os.path.isdir(wdir) else workspace,
+                    env=env, stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT, text=True,
+                    start_new_session=True)
+                self._procs[run_id] = proc
+                local_launcher.update_run_status(
+                    run_id, "RUNNING", pid=proc.pid)
+                for line in proc.stdout:  # live log capture
+                    log.write(line)
+                    log.flush()
+                proc.wait()
+                rc = proc.returncode
+                if rc != 0:
+                    break
+        self._procs.pop(run_id, None)
+        killed = rc < 0
+        status = (ClientConstants.STATUS_KILLED if killed else
+                  ClientConstants.STATUS_FINISHED if rc == 0 else
+                  ClientConstants.STATUS_FAILED)
+        local_launcher.update_run_status(run_id, status, returncode=rc)
+        self._report(run_id, status, returncode=rc, log_path=log_path)
+
+    def _retrieve_and_unzip_package(self, run_id: str,
+                                    req: Dict[str, Any]) -> str:
+        """reference `retrieve_and_unzip_package:200`."""
+        dest = os.path.join(self.agent_dir, "runs", run_id)
+        os.makedirs(dest, exist_ok=True)
+        zip_local = os.path.join(dest, "package.zip")
+        if req.get("package_key"):
+            with open(zip_local, "wb") as f:
+                f.write(self.store.read(req["package_key"]))
+        elif req.get("package_path"):
+            zip_local = req["package_path"]
+        else:
+            raise ValueError("start_train without package_key/package_path")
+        with zipfile.ZipFile(zip_local) as z:
+            z.extractall(dest)
+        return dest
+
+    def _update_local_config(self, workspace: str,
+                             req: Dict[str, Any]) -> None:
+        """Rewrite the packaged config for this edge (reference
+        `update_local_fedml_config:225`): apply server-sent overrides and
+        point cache dirs at the agent's sandbox."""
+        overrides = dict(req.get("config_overrides") or {})
+        applied: set = set()
+        for name in ("fedml_config.yaml",):
+            for root, _dirs, files in os.walk(workspace):
+                if name in files:
+                    path = os.path.join(root, name)
+                    with open(path) as f:
+                        cfg = yaml.safe_load(f) or {}
+                    # apply each override to EVERY matching key in every
+                    # section of every config file (a key like batch_size can
+                    # legally appear in more than one section)
+                    for sect in cfg.values():
+                        if isinstance(sect, dict):
+                            for k in list(sect):
+                                if k in overrides:
+                                    sect[k] = overrides[k]
+                                    applied.add(k)
+                    cfg.setdefault("agent_args", {})["edge_id"] = self.edge_id
+                    cfg["agent_args"].update(
+                        {k: v for k, v in overrides.items()
+                         if k not in applied})
+                    with open(path, "w") as f:
+                        yaml.safe_dump(cfg, f)
+
+    # -- stop_train ----------------------------------------------------------
+    def _on_stop(self, topic: str, payload: bytes) -> None:
+        req = json.loads(payload.decode())
+        self._kill_run(str(req["run_id"]))
+
+    def _kill_run(self, run_id: str) -> None:
+        proc = self._procs.get(run_id)
+        if proc is not None and proc.poll() is None:
+            self._report(run_id, ClientConstants.STATUS_STOPPING)
+            import signal
+
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+            except (ProcessLookupError, OSError):
+                proc.terminate()
+
+
+class MasterAgent:
+    """Run orchestration (`FedMLServerRunner` analog): build/upload the
+    package, dispatch start_train to edges, track status to completion."""
+
+    def __init__(self, channel: str = "agents",
+                 store_dir: Optional[str] = None) -> None:
+        self.broker = _make_broker(channel, f"master-{os.getpid()}")
+        self.store = create_store(
+            _StoreArgs(object_store_dir=store_dir))
+        self._status: Dict[str, Dict[str, Dict[str, Any]]] = {}
+        self._events: Dict[str, threading.Event] = {}
+        self._edges: Dict[str, List[str]] = {}
+        self._lock = threading.Lock()
+
+    def create_run(self, job_yaml_path: str, edges: List[str],
+                   config_overrides: Optional[Dict[str, Any]] = None,
+                   env: Optional[Dict[str, str]] = None) -> str:
+        run_id = uuid.uuid4().hex[:12]
+        zip_path = local_launcher.build_job_package(job_yaml_path)
+        key = f"packages/{run_id}.zip"
+        with open(zip_path, "rb") as f:
+            self.store.write(key, f.read())
+        with self._lock:
+            self._status[run_id] = {}
+            self._events[run_id] = threading.Event()
+            self._edges[run_id] = [str(e) for e in edges]
+        self.broker.subscribe(_topic_status(run_id), self._on_status)
+        for edge in edges:
+            self.broker.publish(_topic_start(str(edge)), json.dumps({
+                "run_id": run_id, "package_key": key,
+                "config_overrides": config_overrides or {},
+                "env": env or {},
+            }).encode())
+        return run_id
+
+    def stop_run(self, run_id: str) -> None:
+        for edge in self._edges.get(run_id, []):
+            self.broker.publish(_topic_stop(edge), json.dumps(
+                {"run_id": run_id}).encode())
+
+    def _on_status(self, topic: str, payload: bytes) -> None:
+        body = json.loads(payload.decode())
+        run_id = str(body.get("run_id", ""))
+        edge = str(body.get("edge_id", ""))
+        with self._lock:
+            if run_id not in self._status:
+                return
+            self._status[run_id][edge] = body
+            expected = self._edges.get(run_id, [])
+            done = [e for e in expected
+                    if self._status[run_id].get(e, {}).get("status")
+                    in ClientConstants.TERMINAL]
+            if len(done) == len(expected):
+                self._events[run_id].set()
+
+    def wait(self, run_id: str, timeout: float = 300.0) -> Dict[str, Any]:
+        ev = self._events.get(run_id)
+        if ev is None:
+            raise KeyError(run_id)
+        finished = ev.wait(timeout)
+        with self._lock:
+            statuses = dict(self._status.get(run_id, {}))
+        return {"run_id": run_id, "completed": finished,
+                "edges": statuses,
+                "success": finished and all(
+                    s.get("status") == ClientConstants.STATUS_FINISHED
+                    for s in statuses.values())}
+
+    def status(self, run_id: str) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._status.get(run_id, {}))

@@ -1,0 +1,102 @@
+"""Job monitor daemon.
+
+Capability parity: reference `comm_utils/job_monitor.py:37-699`: a periodic
+watcher over run processes and serving endpoints — detect dead processes
+still marked RUNNING, flip their status, and invoke recovery hooks
+(endpoint replica reset / autoscale in the reference; pluggable callbacks
+here).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from . import local_launcher
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+class JobMonitor:
+    """Periodically reconcile the runs db with process reality."""
+
+    def __init__(self, interval_s: float = 5.0,
+                 on_dead_run: Optional[Callable[[Dict[str, Any]], None]] = None
+                 ) -> None:
+        self.interval_s = interval_s
+        self.on_dead_run = on_dead_run
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.endpoint_probes: Dict[str, Callable[[], bool]] = {}
+        self.endpoint_resets: Dict[str, Callable[[], None]] = {}
+
+    def start(self) -> "JobMonitor":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="job-monitor")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=self.interval_s + 1)
+
+    def register_endpoint(self, name: str, probe: Callable[[], bool],
+                          reset: Optional[Callable[[], None]] = None) -> None:
+        """Watch a serving endpoint (reference endpoint replica monitor):
+        `probe()` returns health; on failure `reset()` is invoked."""
+        self.endpoint_probes[name] = probe
+        if reset:
+            self.endpoint_resets[name] = reset
+
+    def check_once(self) -> List[Dict[str, Any]]:
+        """One reconciliation pass; returns runs flipped to FAILED."""
+        flipped = []
+        for run in local_launcher.list_runs(limit=200):
+            if run["status"] != "RUNNING":
+                continue
+            full = local_launcher.get_run(run["run_id"]) or {}
+            pid = full.get("pid")
+            if pid and not _pid_alive(int(pid)):
+                local_launcher.update_run_status(
+                    run["run_id"], "FAILED", returncode=-1)
+                logging.warning("job monitor: run %s (pid %s) died; "
+                                "marked FAILED", run["run_id"], pid)
+                flipped.append(full)
+                if self.on_dead_run:
+                    try:
+                        self.on_dead_run(full)
+                    except Exception:  # noqa: BLE001
+                        logging.exception("on_dead_run hook failed")
+        for name, probe in list(self.endpoint_probes.items()):
+            try:
+                healthy = probe()
+            except Exception:  # noqa: BLE001
+                healthy = False
+            if not healthy:
+                logging.warning("job monitor: endpoint %s unhealthy", name)
+                reset = self.endpoint_resets.get(name)
+                if reset:
+                    try:
+                        reset()
+                    except Exception:  # noqa: BLE001
+                        logging.exception("endpoint reset failed: %s", name)
+        return flipped
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.check_once()
+            except Exception:  # noqa: BLE001
+                logging.exception("job monitor pass failed")

@@ -75,7 +75,73 @@ def _db() -> sqlite3.Connection:
         "CREATE TABLE IF NOT EXISTS runs (run_id TEXT PRIMARY KEY, "
         "job_name TEXT, status TEXT, returncode INTEGER, log_path TEXT, "
         "created REAL, finished REAL)")
+    cols = [r[1] for r in conn.execute("PRAGMA table_info(runs)")]
+    if "pid" not in cols:
+        conn.execute("ALTER TABLE runs ADD COLUMN pid INTEGER")
     return conn
+
+
+def update_run_status(run_id: str, status: str,
+                      returncode: Optional[int] = None,
+                      pid: Optional[int] = None) -> None:
+    conn = _db()
+    conn.execute(
+        "UPDATE runs SET status=?, returncode=COALESCE(?, returncode), "
+        "pid=COALESCE(?, pid), finished=CASE WHEN ? IN "
+        "('FINISHED','FAILED','KILLED') THEN ? ELSE finished END "
+        "WHERE run_id=?",
+        (status, returncode, pid, status, time.time(), run_id))
+    conn.commit()
+    conn.close()
+
+
+def register_run(run_id: str, job_name: str, log_path: str,
+                 pid: Optional[int] = None) -> None:
+    conn = _db()
+    conn.execute("INSERT OR REPLACE INTO runs "
+                 "(run_id, job_name, status, returncode, log_path, created, "
+                 "finished, pid) VALUES (?,?,?,?,?,?,?,?)",
+                 (run_id, job_name, "RUNNING", None, log_path, time.time(),
+                  None, pid))
+    conn.commit()
+    conn.close()
+
+
+def stop_run(run_id: str) -> bool:
+    """Terminate a run's process group (reference `callback_stop_train` /
+    run cleanup, `slave/client_runner.py:742-787`). Returns True only if a
+    live process was actually signalled."""
+    import signal
+
+    conn = _db()
+    row = conn.execute("SELECT pid, status FROM runs WHERE run_id=?",
+                       (run_id,)).fetchone()
+    conn.close()
+    if row is None or row[0] is None:
+        return False
+    pid, status = int(row[0]), row[1]
+    if status != "RUNNING":
+        return False
+    try:
+        os.killpg(os.getpgid(pid), signal.SIGTERM)
+    except (ProcessLookupError, PermissionError, OSError):
+        # already gone (or pid recycled into something we may not signal):
+        # leave status reconciliation to the job monitor
+        return False
+    update_run_status(run_id, "KILLED", returncode=-15)
+    return True
+
+
+def get_run(run_id: str) -> Optional[Dict[str, Any]]:
+    conn = _db()
+    row = conn.execute(
+        "SELECT run_id, job_name, status, returncode, log_path, created, "
+        "finished, pid FROM runs WHERE run_id=?", (run_id,)).fetchone()
+    conn.close()
+    if row is None:
+        return None
+    return dict(zip(("run_id", "job_name", "status", "returncode",
+                     "log_path", "created", "finished", "pid"), row))
 
 
 def build_job_package(job_yaml_path: str, out_dir: Optional[str] = None
@@ -113,7 +179,8 @@ def launch_job_local(job_yaml_path: str,
     env["FEDML_CURRENT_RUN_ID"] = run_id
 
     conn = _db()
-    conn.execute("INSERT INTO runs VALUES (?,?,?,?,?,?,?)",
+    conn.execute("INSERT INTO runs (run_id, job_name, status, returncode, "
+                 "log_path, created, finished) VALUES (?,?,?,?,?,?,?)",
                  (run_id, cfg.job_name, "RUNNING", None, log_path,
                   time.time(), None))
     conn.commit()
@@ -127,7 +194,9 @@ def launch_job_local(job_yaml_path: str,
             log.flush()
             proc = subprocess.Popen(
                 ["bash", "-c", script], cwd=workspace, env=env,
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+                start_new_session=True)  # own pgid → stop_run can killpg
+            update_run_status(run_id, "RUNNING", pid=proc.pid)
             for line in proc.stdout:  # live log capture
                 log.write(line)
                 log.flush()

@@ -1,0 +1,286 @@
+"""Model cards + deploy — the model scheduler slice of the control plane.
+
+Capability parity: reference `computing/scheduler/model_scheduler/
+device_model_cards.py` (1,116 LoC — create/package/push/pull/deploy),
+`device_model_deployment.py` (container/ONNX bring-up), and the sqlite
+metrics db (`device_model_db.py`). Local-first: cards live under
+`~/.fedml_tpu/model_cards/`, push/pull go through the ObjectStore, deploy
+spins the in-process HTTP inference runner (`serving/`), and per-endpoint
+request metrics land in sqlite.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import shutil
+import sqlite3
+import time
+import uuid
+import zipfile
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class ModelCardRegistry:
+    def __init__(self, root: Optional[str] = None) -> None:
+        self.root = root or os.path.join(os.path.expanduser("~"),
+                                         ".fedml_tpu", "model_cards")
+        os.makedirs(self.root, exist_ok=True)
+        self.index_path = os.path.join(self.root, "index.json")
+
+    # -- index ---------------------------------------------------------------
+    def _load(self) -> Dict[str, Dict[str, Any]]:
+        if os.path.exists(self.index_path):
+            with open(self.index_path) as f:
+                return json.load(f)
+        return {}
+
+    def _save(self, idx: Dict[str, Dict[str, Any]]) -> None:
+        with open(self.index_path, "w") as f:
+            json.dump(idx, f, indent=1)
+
+    # -- card ops (reference device_model_cards create/delete/list) ----------
+    def create(self, name: str, model_path: str,
+               metadata: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """Register a model dir/file as a named card (copied into the
+        registry so later deploys are self-contained)."""
+        if not os.path.exists(model_path):
+            raise FileNotFoundError(model_path)
+        card_dir = os.path.join(self.root, name)
+        if os.path.isdir(model_path):
+            if os.path.abspath(model_path) != os.path.abspath(card_dir):
+                shutil.rmtree(card_dir, ignore_errors=True)
+                shutil.copytree(model_path, card_dir)
+        else:
+            os.makedirs(card_dir, exist_ok=True)
+            shutil.copy(model_path, card_dir)
+        card = {
+            "name": name,
+            "version": uuid.uuid4().hex[:8],
+            "path": card_dir,
+            "metadata": metadata or {},
+            "created": time.time(),
+        }
+        idx = self._load()
+        idx[name] = card
+        self._save(idx)
+        return card
+
+    def get(self, name: str) -> Dict[str, Any]:
+        idx = self._load()
+        if name not in idx:
+            raise KeyError(f"unknown model card {name!r}; "
+                           f"known: {sorted(idx)}")
+        return idx[name]
+
+    def list(self) -> List[Dict[str, Any]]:
+        return sorted(self._load().values(), key=lambda c: c["name"])
+
+    def delete(self, name: str) -> bool:
+        idx = self._load()
+        if name not in idx:
+            return False
+        shutil.rmtree(idx[name]["path"], ignore_errors=True)
+        del idx[name]
+        self._save(idx)
+        return True
+
+    # -- package / push / pull (reference build_model/push_model/pull_model) -
+    def package(self, name: str, dest_dir: Optional[str] = None) -> str:
+        card = self.get(name)
+        dest_dir = dest_dir or self.root
+        os.makedirs(dest_dir, exist_ok=True)
+        zip_path = os.path.join(dest_dir, f"{name}.model.zip")
+        with zipfile.ZipFile(zip_path, "w", zipfile.ZIP_DEFLATED) as z:
+            z.writestr("card.json", json.dumps(card))
+            for root, _dirs, files in os.walk(card["path"]):
+                for fn in files:
+                    full = os.path.join(root, fn)
+                    z.write(full, os.path.join(
+                        "model", os.path.relpath(full, card["path"])))
+        return zip_path
+
+    def push(self, name: str, store=None) -> str:
+        from ..core.distributed.communication.mqtt_s3.remote_storage import (
+            create_store,
+        )
+
+        store = store or create_store(object())
+        zip_path = self.package(name)
+        key = f"model_cards/{name}.zip"
+        with open(zip_path, "rb") as f:
+            store.write(key, f.read())
+        return key
+
+    def pull(self, key: str, store=None) -> Dict[str, Any]:
+        from ..core.distributed.communication.mqtt_s3.remote_storage import (
+            create_store,
+        )
+
+        store = store or create_store(object())
+        tmp = os.path.join(self.root, f"_pull_{uuid.uuid4().hex[:6]}.zip")
+        with open(tmp, "wb") as f:
+            f.write(store.read(key))
+        with zipfile.ZipFile(tmp) as z:
+            card = json.loads(z.read("card.json").decode())
+            target = os.path.join(self.root, card["name"])
+            shutil.rmtree(target, ignore_errors=True)
+            target_abs = os.path.abspath(target)
+            for info in z.infolist():
+                if not info.filename.startswith("model/"):
+                    continue
+                rel = os.path.relpath(info.filename, "model")
+                out = os.path.normpath(os.path.join(target, rel))
+                # zip-slip guard: refuse entries escaping the card dir
+                if not os.path.abspath(out).startswith(target_abs + os.sep):
+                    raise ValueError(
+                        f"refusing unsafe zip entry {info.filename!r}")
+                os.makedirs(os.path.dirname(out), exist_ok=True)
+                with open(out, "wb") as g:
+                    g.write(z.read(info))
+        os.remove(tmp)
+        card["path"] = target
+        idx = self._load()
+        idx[card["name"]] = card
+        self._save(idx)
+        return card
+
+    # -- deploy (reference device_model_deployment + inference gateway) ------
+    def deploy(self, name: str, host: str = "127.0.0.1", port: int = 0,
+               predictor: Any = None) -> "Endpoint":
+        """Bring up an HTTP endpoint serving this card. Predictor resolution
+        order: explicit arg → `predictor.py` in the card (class `Predictor`)
+        → default npz linear predictor (`model.npz`)."""
+        from ..serving.fedml_inference_runner import FedMLInferenceRunner
+
+        card = self.get(name)
+        if predictor is None:
+            predictor = _resolve_predictor(card)
+        if port == 0:
+            import socket
+
+            with socket.socket() as s:
+                s.bind((host, 0))
+                port = s.getsockname()[1]
+        runner = FedMLInferenceRunner(predictor, host=host, port=port)
+        runner.run(block=False, prefer_fastapi=False)
+        return Endpoint(name=name, host=host, port=port, runner=runner,
+                        db=EndpointDB())
+
+
+def _resolve_predictor(card: Dict[str, Any]):
+    from ..serving.fedml_predictor import FedMLPredictor
+
+    entry = os.path.join(card["path"], "predictor.py")
+    if os.path.exists(entry):
+        spec = importlib.util.spec_from_file_location(
+            f"card_{card['name']}_predictor", entry)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.Predictor()
+
+    npz = os.path.join(card["path"], "model.npz")
+    if os.path.exists(npz):
+        class NpzLinearPredictor(FedMLPredictor):
+            """w2/b2 linear head on flat input (the native edge layout)."""
+
+            def __init__(self) -> None:
+                with np.load(npz) as z:
+                    self.w = z["w2"]
+                    self.b = z["b2"]
+
+            def predict(self, request: Dict[str, Any]):
+                x = np.asarray(request["inputs"], np.float32)
+                x = x.reshape(x.shape[0], -1)
+                logits = x @ self.w + self.b
+                return {"predictions": np.argmax(logits, -1).tolist()}
+
+        return NpzLinearPredictor()
+    raise ValueError(
+        f"card {card['name']!r} has neither predictor.py nor model.npz")
+
+
+class EndpointDB:
+    """Per-endpoint request metrics (reference `device_model_db.py` sqlite +
+    `device_model_monitor.py`)."""
+
+    def __init__(self, path: Optional[str] = None) -> None:
+        self.path = path or os.path.join(os.path.expanduser("~"),
+                                         ".fedml_tpu", "endpoints.db")
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        conn = self._conn()
+        conn.execute(
+            "CREATE TABLE IF NOT EXISTS requests (endpoint TEXT, ts REAL, "
+            "latency_ms REAL, ok INTEGER)")
+        conn.commit()
+        conn.close()
+
+    def _conn(self) -> sqlite3.Connection:
+        return sqlite3.connect(self.path)
+
+    def record(self, endpoint: str, latency_ms: float, ok: bool) -> None:
+        conn = self._conn()
+        conn.execute("INSERT INTO requests VALUES (?,?,?,?)",
+                     (endpoint, time.time(), latency_ms, int(ok)))
+        conn.commit()
+        conn.close()
+
+    def stats(self, endpoint: str) -> Dict[str, Any]:
+        conn = self._conn()
+        row = conn.execute(
+            "SELECT COUNT(*), AVG(latency_ms), SUM(ok) FROM requests "
+            "WHERE endpoint=?", (endpoint,)).fetchone()
+        conn.close()
+        n, avg, oks = row
+        return {"requests": int(n or 0),
+                "avg_latency_ms": float(avg) if avg is not None else None,
+                "success": int(oks or 0)}
+
+
+class Endpoint:
+    def __init__(self, name: str, host: str, port: int, runner: Any,
+                 db: EndpointDB) -> None:
+        self.name = name
+        self.host = host
+        self.port = port
+        self.runner = runner
+        self.db = db
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def predict(self, request: Dict[str, Any]) -> Any:
+        """Client helper that also records gateway metrics."""
+        import urllib.request
+
+        t0 = time.time()
+        ok = False
+        try:
+            req = urllib.request.Request(
+                f"{self.url}/predict", data=json.dumps(request).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                out = json.loads(r.read())
+            ok = True
+            return out
+        finally:
+            self.db.record(self.name, (time.time() - t0) * 1000.0, ok)
+
+    def ready(self) -> bool:
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(f"{self.url}/ready", timeout=5) as r:
+                return bool(json.loads(r.read()).get("ready"))
+        except Exception:  # noqa: BLE001
+            return False
+
+    def stats(self) -> Dict[str, Any]:
+        return self.db.stats(self.name)
+
+    def stop(self) -> None:
+        self.runner.stop()

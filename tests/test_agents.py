@@ -1,0 +1,199 @@
+"""Control-plane agent tests (reference `computing/scheduler`: slave/master
+runners, launch manager, job monitor, model cards + deploy)."""
+
+import json
+import os
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+
+def _write_job(tmp_path, name="testjob", job="echo JOB_RAN; echo done",
+               bootstrap="echo BOOT"):
+    ws = tmp_path / "ws"
+    ws.mkdir(exist_ok=True)
+    (ws / "hello.txt").write_text("payload")
+    jy = tmp_path / "job.yaml"
+    jy.write_text(textwrap.dedent(f"""
+        workspace: ws
+        job_name: {name}
+        bootstrap: "{bootstrap}"
+        job: "{job}"
+    """))
+    return str(jy)
+
+
+def test_master_slave_agent_round_trip(tmp_path):
+    """Master builds + uploads the package, dispatches start_train to two
+    slave agents over the broker; agents unzip, run with live logs, report
+    FINISHED."""
+    from fedml_tpu.scheduler.agents import MasterAgent, SlaveAgent
+
+    store = str(tmp_path / "store")
+    agents = [SlaveAgent(f"e{i}", channel="t-agents", store_dir=store,
+                         heartbeat_s=0.5).start() for i in (1, 2)]
+    try:
+        master = MasterAgent(channel="t-agents", store_dir=store)
+        run_id = master.create_run(_write_job(tmp_path), ["e1", "e2"])
+        result = master.wait(run_id, timeout=60)
+        assert result["completed"] and result["success"], result
+        for edge in ("e1", "e2"):
+            st = result["edges"][edge]
+            assert st["status"] == "FINISHED"
+            log = open(st["log_path"]).read()
+            assert "BOOT" in log and "JOB_RAN" in log
+    finally:
+        for a in agents:
+            a.stop()
+
+
+def test_agent_failed_job_reports_failed(tmp_path):
+    from fedml_tpu.scheduler.agents import MasterAgent, SlaveAgent
+
+    store = str(tmp_path / "store")
+    agent = SlaveAgent("e9", channel="t-agents2", store_dir=store).start()
+    try:
+        master = MasterAgent(channel="t-agents2", store_dir=store)
+        run_id = master.create_run(
+            _write_job(tmp_path, job="exit 3"), ["e9"])
+        result = master.wait(run_id, timeout=60)
+        assert result["completed"]
+        assert result["edges"]["e9"]["status"] == "FAILED"
+        assert result["edges"]["e9"]["returncode"] == 3
+    finally:
+        agent.stop()
+
+
+def test_agent_stop_train_kills_job(tmp_path):
+    from fedml_tpu.scheduler.agents import MasterAgent, SlaveAgent
+
+    store = str(tmp_path / "store")
+    agent = SlaveAgent("e5", channel="t-agents3", store_dir=store).start()
+    try:
+        master = MasterAgent(channel="t-agents3", store_dir=store)
+        run_id = master.create_run(
+            _write_job(tmp_path, job="sleep 60"), ["e5"])
+        time.sleep(1.0)  # let the job start
+        master.stop_run(run_id)
+        result = master.wait(run_id, timeout=30)
+        assert result["completed"]
+        assert result["edges"]["e5"]["status"] == "KILLED"
+    finally:
+        agent.stop()
+
+
+def test_agent_config_rewrite(tmp_path):
+    """start_train overrides rewrite the packaged fedml_config.yaml
+    (reference `update_local_fedml_config:225`)."""
+    import yaml
+
+    from fedml_tpu.scheduler.agents import MasterAgent, SlaveAgent
+
+    ws = tmp_path / "ws"
+    ws.mkdir()
+    (ws / "fedml_config.yaml").write_text(
+        "data_args:\n  data_cache_dir: /old\ntrain_args:\n  batch_size: 4\n")
+    jy = tmp_path / "job.yaml"
+    jy.write_text("workspace: ws\njob_name: cfgjob\n"
+                  "job: \"cat fedml_config.yaml\"\n")
+    store = str(tmp_path / "store")
+    agent = SlaveAgent("e7", channel="t-agents4", store_dir=store).start()
+    try:
+        master = MasterAgent(channel="t-agents4", store_dir=store)
+        run_id = master.create_run(str(jy), ["e7"], config_overrides={
+            "data_cache_dir": "/new/cache", "batch_size": 16})
+        result = master.wait(run_id, timeout=60)
+        assert result["success"], result
+        log = open(result["edges"]["e7"]["log_path"]).read()
+        cfg = yaml.safe_load(log.split("===== job =====")[1])
+        assert cfg["data_args"]["data_cache_dir"] == "/new/cache"
+        assert cfg["train_args"]["batch_size"] == 16
+        assert cfg["agent_args"]["edge_id"] == "e7"
+    finally:
+        agent.stop()
+
+
+def test_job_monitor_flips_dead_runs(tmp_path):
+    from fedml_tpu.scheduler import local_launcher
+    from fedml_tpu.scheduler.job_monitor import JobMonitor
+
+    run_id = "dead_run_test"
+    local_launcher.register_run(run_id, "dead", str(tmp_path / "x.log"),
+                                pid=99999999)  # definitely not alive
+    flipped = JobMonitor().check_once()
+    assert any(r["run_id"] == run_id for r in flipped)
+    assert local_launcher.get_run(run_id)["status"] == "FAILED"
+
+    probe_calls = []
+    mon = JobMonitor()
+    mon.register_endpoint("ep1", probe=lambda: False,
+                          reset=lambda: probe_calls.append(1))
+    mon.check_once()
+    assert probe_calls  # unhealthy endpoint got reset
+
+
+def test_api_local_launch_stop_logs(tmp_path):
+    from fedml_tpu import api
+
+    out = api.launch_job(_write_job(tmp_path, name="apijob"))
+    assert out["success"] and out["returncode"] == 0
+    assert any(r["run_id"] == out["run_id"] for r in api.run_list(50))
+    assert "JOB_RAN" in api.run_logs(out["run_id"])
+    assert api.run_status(out["run_id"])["status"] == "FINISHED"
+
+
+def test_api_clusters(tmp_path, monkeypatch):
+    from fedml_tpu import api
+
+    monkeypatch.setattr(api, "_CLUSTERS_PATH",
+                        str(tmp_path / "clusters.json"))
+    api.cluster_create("c1", ["e1", "e2"])
+    assert api.cluster_list() == {"c1": ["e1", "e2"]}
+    with pytest.raises(ValueError, match="unknown cluster"):
+        api.launch_job_on_cluster(_write_job(tmp_path), "nope")
+    assert api.cluster_remove("c1") and api.cluster_list() == {}
+
+
+def test_model_cards_create_package_deploy(tmp_path):
+    from fedml_tpu.scheduler.model_cards import ModelCardRegistry
+
+    model_dir = tmp_path / "m"
+    model_dir.mkdir()
+    rng = np.random.RandomState(0)
+    np.savez(model_dir / "model.npz",
+             w2=rng.randn(8, 3).astype(np.float32),
+             b2=np.zeros(3, np.float32))
+    reg = ModelCardRegistry(root=str(tmp_path / "cards"))
+    card = reg.create("lin", str(model_dir), metadata={"task": "cls"})
+    assert card["name"] == "lin"
+    assert [c["name"] for c in reg.list()] == ["lin"]
+
+    zip_path = reg.package("lin", str(tmp_path))
+    assert os.path.exists(zip_path)
+
+    ep = reg.deploy("lin")
+    try:
+        assert ep.ready()
+        x = rng.randn(4, 8).astype(np.float32)
+        out = ep.predict({"inputs": x.tolist()})
+        assert len(out["predictions"]) == 4
+        stats = ep.stats()
+        assert stats["requests"] >= 1 and stats["success"] >= 1
+    finally:
+        ep.stop()
+    assert reg.delete("lin") and reg.list() == []
+
+
+def test_cli_job_cluster_model_groups(tmp_path, monkeypatch):
+    from click.testing import CliRunner
+
+    from fedml_tpu.cli.cli import cli
+
+    r = CliRunner().invoke(cli, ["job", "list", "--limit", "3"])
+    assert r.exit_code == 0, r.output
+    r = CliRunner().invoke(cli, ["model", "zoo"])
+    assert r.exit_code == 0 and "resnet56" in r.output
+    r = CliRunner().invoke(cli, ["cluster", "list"])
+    assert r.exit_code == 0, r.output
