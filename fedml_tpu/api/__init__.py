@@ -85,8 +85,21 @@ def login(api_key: str = "", edge_id: Optional[str] = None,
     device binding + always-on slave agent)."""
     os.makedirs(os.path.dirname(_CRED_PATH), exist_ok=True)
     edge_id = edge_id or f"edge_{os.getpid()}"
+    # merge with any existing credentials so device_bind (which passes no
+    # api_key) doesn't clobber a previously stored account key
+    creds: Dict[str, Any] = {}
+    if os.path.exists(_CRED_PATH):
+        try:
+            with open(_CRED_PATH) as f:
+                creds = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            creds = {}
+    if api_key:
+        creds["api_key"] = api_key
+    creds.setdefault("api_key", "")
+    creds["edge_id"] = edge_id
     with open(_CRED_PATH, "w") as f:
-        json.dump({"api_key": api_key, "edge_id": edge_id}, f)
+        json.dump(creds, f)
     out: Dict[str, Any] = {"edge_id": edge_id, "bound": True}
     if start_agent:
         out["agent"] = SlaveAgent(edge_id).start()

@@ -37,6 +37,11 @@ class Broker(abc.ABC):
     @abc.abstractmethod
     def subscribe(self, topic: str, cb: Callable[[str, bytes], None]) -> None: ...
 
+    def unsubscribe(self, topic: str,
+                    cb: Optional[Callable[[str, bytes], None]] = None
+                    ) -> None:
+        """Remove a subscription (cb=None removes all handlers on topic)."""
+
     @abc.abstractmethod
     def close(self) -> None: ...
 
@@ -68,6 +73,15 @@ class InProcBroker(Broker):
     def subscribe(self, topic: str, cb: Callable[[str, bytes], None]) -> None:
         with self._lock:
             self.subs.setdefault(topic, []).append(cb)
+
+    def unsubscribe(self, topic: str,
+                    cb: Optional[Callable[[str, bytes], None]] = None
+                    ) -> None:
+        with self._lock:
+            if cb is None:
+                self.subs.pop(topic, None)
+            elif topic in self.subs:
+                self.subs[topic] = [c for c in self.subs[topic] if c is not cb]
 
     def close(self) -> None:
         pass
@@ -103,6 +117,12 @@ class PahoBroker(Broker):
     def subscribe(self, topic: str, cb: Callable[[str, bytes], None]) -> None:
         self._cbs[topic] = cb
         self.client.subscribe(topic, qos=2)
+
+    def unsubscribe(self, topic: str,
+                    cb: Optional[Callable[[str, bytes], None]] = None
+                    ) -> None:
+        self._cbs.pop(topic, None)
+        self.client.unsubscribe(topic)
 
     def close(self) -> None:
         self.client.loop_stop()

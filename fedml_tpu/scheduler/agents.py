@@ -125,6 +125,10 @@ class SlaveAgent:
         self._stop.set()
         for run_id in list(self._procs):
             self._kill_run(run_id)
+        # release subscriptions so a stopped agent never picks up work and a
+        # restarted one doesn't double-execute
+        self.broker.unsubscribe(_topic_start(self.edge_id), self._on_start)
+        self.broker.unsubscribe(_topic_stop(self.edge_id), self._on_stop)
         self._send_active("OFFLINE")
 
     def _heartbeat_loop(self) -> None:
@@ -157,13 +161,14 @@ class SlaveAgent:
         try:
             workspace = self._retrieve_and_unzip_package(run_id, req)
             self._update_local_config(workspace, req)
+            with open(os.path.join(workspace, "job.yaml")) as f:
+                cfg = yaml.safe_load(f) or {}
+            if not isinstance(cfg, dict):
+                raise ValueError("job.yaml is not a mapping")
         except Exception as e:  # noqa: BLE001
             logging.exception("agent %s: package setup failed", self.edge_id)
             self._report(run_id, ClientConstants.STATUS_FAILED, error=str(e))
             return
-        job_yaml = os.path.join(workspace, "job.yaml")
-        with open(job_yaml) as f:
-            cfg = yaml.safe_load(f) or {}
         log_path = os.path.join(self.agent_dir, f"{run_id}.log")
         local_launcher.register_run(run_id, str(cfg.get("job_name", run_id)),
                                     log_path)
@@ -324,6 +329,8 @@ class MasterAgent:
                     in ClientConstants.TERMINAL]
             if len(done) == len(expected):
                 self._events[run_id].set()
+                # run is terminal: release its status subscription
+                self.broker.unsubscribe(_topic_status(run_id))
 
     def wait(self, run_id: str, timeout: float = 300.0) -> Dict[str, Any]:
         ev = self._events.get(run_id)

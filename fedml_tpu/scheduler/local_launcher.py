@@ -204,10 +204,12 @@ def launch_job_local(job_yaml_path: str,
             rc = proc.returncode
             if rc != 0:
                 break
+    # rc<0 means killed by signal (e.g. stop_run's SIGTERM) — keep that
+    # distinct from FAILED, consistent with the agent path
+    final = ("FINISHED" if rc == 0 else
+             "KILLED" if rc < 0 else "FAILED")
     conn.execute("UPDATE runs SET status=?, returncode=?, finished=? "
-                 "WHERE run_id=?",
-                 ("FINISHED" if rc == 0 else "FAILED", rc, time.time(),
-                  run_id))
+                 "WHERE run_id=?", (final, rc, time.time(), run_id))
     conn.commit()
     conn.close()
     return LaunchResult(run_id=run_id, returncode=rc, log_path=log_path)

@@ -50,13 +50,16 @@ class ModelCardRegistry:
         if not os.path.exists(model_path):
             raise FileNotFoundError(model_path)
         card_dir = os.path.join(self.root, name)
-        if os.path.isdir(model_path):
-            if os.path.abspath(model_path) != os.path.abspath(card_dir):
-                shutil.rmtree(card_dir, ignore_errors=True)
+        if os.path.abspath(model_path) != os.path.abspath(card_dir):
+            # always start from a clean card dir so a re-created card never
+            # serves stale files (e.g. an old predictor.py) from a previous
+            # version
+            shutil.rmtree(card_dir, ignore_errors=True)
+            if os.path.isdir(model_path):
                 shutil.copytree(model_path, card_dir)
-        else:
-            os.makedirs(card_dir, exist_ok=True)
-            shutil.copy(model_path, card_dir)
+            else:
+                os.makedirs(card_dir, exist_ok=True)
+                shutil.copy(model_path, card_dir)
         card = {
             "name": name,
             "version": uuid.uuid4().hex[:8],
@@ -122,26 +125,31 @@ class ModelCardRegistry:
 
         store = store or create_store(object())
         tmp = os.path.join(self.root, f"_pull_{uuid.uuid4().hex[:6]}.zip")
-        with open(tmp, "wb") as f:
-            f.write(store.read(key))
-        with zipfile.ZipFile(tmp) as z:
-            card = json.loads(z.read("card.json").decode())
-            target = os.path.join(self.root, card["name"])
-            shutil.rmtree(target, ignore_errors=True)
-            target_abs = os.path.abspath(target)
-            for info in z.infolist():
-                if not info.filename.startswith("model/"):
-                    continue
-                rel = os.path.relpath(info.filename, "model")
-                out = os.path.normpath(os.path.join(target, rel))
-                # zip-slip guard: refuse entries escaping the card dir
-                if not os.path.abspath(out).startswith(target_abs + os.sep):
-                    raise ValueError(
-                        f"refusing unsafe zip entry {info.filename!r}")
-                os.makedirs(os.path.dirname(out), exist_ok=True)
-                with open(out, "wb") as g:
-                    g.write(z.read(info))
-        os.remove(tmp)
+        try:
+            with open(tmp, "wb") as f:
+                f.write(store.read(key))
+            with zipfile.ZipFile(tmp) as z:
+                card = json.loads(z.read("card.json").decode())
+                target = os.path.join(self.root, card["name"])
+                shutil.rmtree(target, ignore_errors=True)
+                target_abs = os.path.abspath(target)
+                for info in z.infolist():
+                    if not info.filename.startswith("model/") or \
+                            info.is_dir():
+                        continue
+                    rel = os.path.relpath(info.filename, "model")
+                    out = os.path.normpath(os.path.join(target, rel))
+                    # zip-slip guard: refuse entries escaping the card dir
+                    if not os.path.abspath(out).startswith(
+                            target_abs + os.sep):
+                        raise ValueError(
+                            f"refusing unsafe zip entry {info.filename!r}")
+                    os.makedirs(os.path.dirname(out), exist_ok=True)
+                    with open(out, "wb") as g:
+                        g.write(z.read(info))
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
         card["path"] = target
         idx = self._load()
         idx[card["name"]] = card
@@ -156,19 +164,14 @@ class ModelCardRegistry:
         → default npz linear predictor (`model.npz`)."""
         from ..serving.fedml_inference_runner import FedMLInferenceRunner
 
+        from ..serving.fedml_inference_runner import serve_ephemeral
+
         card = self.get(name)
         if predictor is None:
             predictor = _resolve_predictor(card)
-        if port == 0:
-            import socket
-
-            with socket.socket() as s:
-                s.bind((host, 0))
-                port = s.getsockname()[1]
-        runner = FedMLInferenceRunner(predictor, host=host, port=port)
-        runner.run(block=False, prefer_fastapi=False)
-        return Endpoint(name=name, host=host, port=port, runner=runner,
-                        db=EndpointDB())
+        runner = serve_ephemeral(predictor, host=host, port=port)
+        return Endpoint(name=name, host=host, port=runner.port,
+                        runner=runner, db=EndpointDB())
 
 
 def _resolve_predictor(card: Dict[str, Any]):
@@ -184,21 +187,11 @@ def _resolve_predictor(card: Dict[str, Any]):
 
     npz = os.path.join(card["path"], "model.npz")
     if os.path.exists(npz):
-        class NpzLinearPredictor(FedMLPredictor):
-            """w2/b2 linear head on flat input (the native edge layout)."""
+        from ..serving.fedml_predictor import LinearHeadPredictor
 
-            def __init__(self) -> None:
-                with np.load(npz) as z:
-                    self.w = z["w2"]
-                    self.b = z["b2"]
-
-            def predict(self, request: Dict[str, Any]):
-                x = np.asarray(request["inputs"], np.float32)
-                x = x.reshape(x.shape[0], -1)
-                logits = x @ self.w + self.b
-                return {"predictions": np.argmax(logits, -1).tolist()}
-
-        return NpzLinearPredictor()
+        with np.load(npz) as z:
+            params = {k: z[k] for k in z.files}
+        return LinearHeadPredictor(params)
     raise ValueError(
         f"card {card['name']!r} has neither predictor.py nor model.npz")
 

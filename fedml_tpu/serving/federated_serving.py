@@ -1,0 +1,183 @@
+"""Federated-serving plane.
+
+Capability parity: reference `serving/server/fedml_server_manager.py` (311
+LoC) + `serving/client/`: a Client/Server manager pair mirroring cross-silo
+that distributes the (aggregated) model to serving nodes, brings an
+inference endpoint up on each, health-checks the fleet, and tears it down —
+the FL-to-serving handoff plane.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..core.distributed.communication.message import Message
+from ..core.distributed.fedml_comm_manager import FedMLCommManager
+
+
+class ServingMessage:
+    MSG_TYPE_C2S_NODE_READY = "SERVE_C2S_NODE_READY"
+    MSG_TYPE_S2C_DEPLOY_MODEL = "SERVE_S2C_DEPLOY_MODEL"
+    MSG_TYPE_C2S_ENDPOINT_UP = "SERVE_C2S_ENDPOINT_UP"
+    MSG_TYPE_S2C_HEALTH_CHECK = "SERVE_S2C_HEALTH_CHECK"
+    MSG_TYPE_C2S_HEALTH_REPORT = "SERVE_C2S_HEALTH_REPORT"
+    MSG_TYPE_S2C_UNDEPLOY = "SERVE_S2C_UNDEPLOY"
+
+    ARG_MODEL_PARAMS = "model_params"
+    ARG_MODEL_NAME = "model_name"
+    ARG_ENDPOINT_URL = "endpoint_url"
+    ARG_HEALTHY = "healthy"
+    ARG_STATS = "stats"
+
+
+class ServingServerManager(FedMLCommManager):
+    """Distributes a model to serving nodes and tracks endpoint health."""
+
+    def __init__(self, args: Any, model_name: str, model_params: Any,
+                 comm=None, rank: int = 0, client_num: int = 0,
+                 backend: str = "INPROC") -> None:
+        super().__init__(args, comm, rank, client_num + 1, backend)
+        self.model_name = model_name
+        self.model_params = model_params
+        self.client_num = client_num
+        self.ready_nodes: set = set()
+        self.endpoints: Dict[int, str] = {}
+        self.health: Dict[int, Dict[str, Any]] = {}
+        self.all_up = threading.Event()
+        self.all_healthy = threading.Event()
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            ServingMessage.MSG_TYPE_C2S_NODE_READY, self._on_node_ready)
+        self.register_message_receive_handler(
+            ServingMessage.MSG_TYPE_C2S_ENDPOINT_UP, self._on_endpoint_up)
+        self.register_message_receive_handler(
+            ServingMessage.MSG_TYPE_C2S_HEALTH_REPORT, self._on_health)
+
+    def _on_node_ready(self, msg: Message) -> None:
+        self.ready_nodes.add(msg.get_sender_id())
+        if len(self.ready_nodes) == self.client_num:
+            for r in sorted(self.ready_nodes):
+                dep = Message(ServingMessage.MSG_TYPE_S2C_DEPLOY_MODEL,
+                              self.get_sender_id(), r)
+                dep.add_params(ServingMessage.ARG_MODEL_NAME, self.model_name)
+                dep.add_params(ServingMessage.ARG_MODEL_PARAMS,
+                               self.model_params)
+                self.send_message(dep)
+
+    def _on_endpoint_up(self, msg: Message) -> None:
+        self.endpoints[msg.get_sender_id()] = str(
+            msg.get(ServingMessage.ARG_ENDPOINT_URL))
+        if len(self.endpoints) == self.client_num:
+            self.all_up.set()
+            for r in sorted(self.endpoints):
+                self.send_message(Message(
+                    ServingMessage.MSG_TYPE_S2C_HEALTH_CHECK,
+                    self.get_sender_id(), r))
+
+    def _on_health(self, msg: Message) -> None:
+        sender = msg.get_sender_id()
+        self.health[sender] = {
+            "healthy": bool(msg.get(ServingMessage.ARG_HEALTHY)),
+            "stats": msg.get(ServingMessage.ARG_STATS, {}),
+        }
+        if len(self.health) == self.client_num:
+            self.all_healthy.set()
+            self._finish_if_done()
+
+    def _finish_if_done(self) -> None:
+        if bool(getattr(self.args, "serving_oneshot", True)):
+            for r in range(1, self.client_num + 1):
+                self.send_message(Message(
+                    ServingMessage.MSG_TYPE_S2C_UNDEPLOY,
+                    self.get_sender_id(), r))
+            self.finish()
+
+
+class ServingClientManager(FedMLCommManager):
+    """A serving node: receives the model, brings the HTTP endpoint up,
+    answers health checks with gateway stats."""
+
+    def __init__(self, args: Any, comm=None, rank: int = 0, size: int = 0,
+                 backend: str = "INPROC",
+                 predictor_factory: Optional[Callable[[Any], Any]] = None
+                 ) -> None:
+        super().__init__(args, comm, rank, size, backend)
+        self.predictor_factory = predictor_factory
+        self.endpoint = None
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            ServingMessage.MSG_TYPE_S2C_DEPLOY_MODEL, self._on_deploy)
+        self.register_message_receive_handler(
+            ServingMessage.MSG_TYPE_S2C_HEALTH_CHECK, self._on_health_check)
+        self.register_message_receive_handler(
+            ServingMessage.MSG_TYPE_S2C_UNDEPLOY, self._on_undeploy)
+
+    def run(self) -> None:
+        self.register_message_receive_handlers()
+        self.send_message(Message(ServingMessage.MSG_TYPE_C2S_NODE_READY,
+                                  self.get_sender_id(), 0))
+        self.com_manager.handle_receive_message()
+
+    def _on_deploy(self, msg: Message) -> None:
+        from ..scheduler.model_cards import Endpoint, EndpointDB
+        from .fedml_inference_runner import serve_ephemeral
+        from .fedml_predictor import LinearHeadPredictor
+
+        name = str(msg.get(ServingMessage.ARG_MODEL_NAME))
+        params = msg.get(ServingMessage.ARG_MODEL_PARAMS)
+        if self.predictor_factory is not None:
+            predictor = self.predictor_factory(params)
+        else:
+            predictor = LinearHeadPredictor(params)
+        runner = serve_ephemeral(predictor, host="127.0.0.1")
+        self.endpoint = Endpoint(name=f"{name}@{self.rank}", host="127.0.0.1",
+                                 port=runner.port, runner=runner,
+                                 db=EndpointDB())
+        up = Message(ServingMessage.MSG_TYPE_C2S_ENDPOINT_UP,
+                     self.get_sender_id(), 0)
+        up.add_params(ServingMessage.ARG_ENDPOINT_URL, self.endpoint.url)
+        self.send_message(up)
+
+    def _on_health_check(self, msg: Message) -> None:
+        healthy = self.endpoint is not None and self.endpoint.ready()
+        rep = Message(ServingMessage.MSG_TYPE_C2S_HEALTH_REPORT,
+                      self.get_sender_id(), 0)
+        rep.add_params(ServingMessage.ARG_HEALTHY, healthy)
+        rep.add_params(ServingMessage.ARG_STATS,
+                       self.endpoint.stats() if self.endpoint else {})
+        self.send_message(rep)
+
+    def _on_undeploy(self, msg: Message) -> None:
+        if self.endpoint is not None:
+            self.endpoint.stop()
+        logging.info("serving node %d: undeployed", self.rank)
+        self.finish()
+
+
+def deploy_federated(args: Any, model_name: str, model_params: Any,
+                     n_nodes: int = 2,
+                     predictor_factory: Optional[Callable] = None
+                     ) -> Dict[str, Any]:
+    """One-shot federated deploy over INPROC: server + n serving nodes;
+    returns endpoints + health (the smoke path the reference exercises in
+    its serving examples)."""
+    server = ServingServerManager(args, model_name, model_params, rank=0,
+                                  client_num=n_nodes, backend="INPROC")
+    clients = [ServingClientManager(args, rank=r, size=n_nodes + 1,
+                                    backend="INPROC",
+                                    predictor_factory=predictor_factory)
+               for r in range(1, n_nodes + 1)]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    server.run()
+    for t in threads:
+        t.join(timeout=30)
+    return {"endpoints": dict(server.endpoints),
+            "health": dict(server.health)}

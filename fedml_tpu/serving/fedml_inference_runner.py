@@ -119,3 +119,18 @@ class FedMLInferenceRunner:
     def stop(self) -> None:
         if self._server is not None:
             self._server.shutdown()
+
+
+def serve_ephemeral(predictor: FedMLPredictor, host: str = "127.0.0.1",
+                    port: int = 0) -> "FedMLInferenceRunner":
+    """Bring an endpoint up on `port` (0 → pick a free one) in a background
+    thread; returns the runner with `.port` resolved."""
+    if port == 0:
+        import socket
+
+        with socket.socket() as s:
+            s.bind((host, 0))
+            port = s.getsockname()[1]
+    runner = FedMLInferenceRunner(predictor, host=host, port=port)
+    runner.run(block=False, prefer_fastapi=False)
+    return runner

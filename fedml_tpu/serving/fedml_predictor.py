@@ -21,3 +21,22 @@ class FedMLPredictor(abc.ABC):
 
     def ready(self) -> bool:
         return True
+
+
+class LinearHeadPredictor(FedMLPredictor):
+    """Linear head on flat input over a flat weight dict (`w2`/`b2` — the
+    native edge layout). Shared by the model-card default predictor and the
+    federated-serving plane."""
+
+    def __init__(self, params: Any) -> None:
+        import numpy as np
+
+        self.params = {k: np.asarray(v) for k, v in dict(params).items()}
+
+    def predict(self, request: Any) -> Any:
+        import numpy as np
+
+        x = np.asarray(request["inputs"], np.float32)
+        x = x.reshape(x.shape[0], -1)
+        logits = x @ self.params["w2"] + self.params.get("b2", 0.0)
+        return {"predictions": np.argmax(logits, -1).tolist()}

@@ -1,0 +1,170 @@
+"""OpenAI-compatible chat API over a FedMLPredictor.
+
+Capability parity: reference `serving/templates/hf_template/main_openai.py`
+(254 LoC): `/v1/chat/completions` (streaming SSE + non-streaming) and
+`/v1/models` in the OpenAI wire format, so OpenAI SDK clients can point at a
+deployed model unchanged. The generation backend is any `FedMLPredictor`
+whose `predict` accepts `{"prompt": str, "max_tokens": int, ...}` and
+returns either a string or a token generator (the LLM trainer's models
+plug in here).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import uuid
+from typing import Any, Dict, Iterable, List, Optional
+
+from .fedml_predictor import FedMLPredictor
+
+
+def _messages_to_prompt(messages: List[Dict[str, str]]) -> str:
+    """Flatten a chat transcript to the template the LLM trainer uses."""
+    parts = []
+    for m in messages:
+        parts.append(f"{m.get('role', 'user')}: {m.get('content', '')}")
+    parts.append("assistant:")
+    return "\n".join(parts)
+
+
+def _completion_body(model: str, text: str, finish: str = "stop"
+                     ) -> Dict[str, Any]:
+    return {
+        "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
+        "object": "chat.completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{
+            "index": 0,
+            "message": {"role": "assistant", "content": text},
+            "finish_reason": finish,
+        }],
+        "usage": {"prompt_tokens": 0, "completion_tokens": len(text.split()),
+                  "total_tokens": len(text.split())},
+    }
+
+
+def _chunk_body(model: str, delta: str, cid: str,
+                finish: Optional[str] = None) -> Dict[str, Any]:
+    return {
+        "id": cid,
+        "object": "chat.completion.chunk",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{
+            "index": 0,
+            "delta": {} if finish else {"content": delta},
+            "finish_reason": finish,
+        }],
+    }
+
+
+class OpenAIServer:
+    """stdlib HTTP server exposing /v1/chat/completions + /v1/models."""
+
+    def __init__(self, predictor: FedMLPredictor, model_name: str = "fedml",
+                 host: str = "127.0.0.1", port: int = 8000) -> None:
+        self.predictor = predictor
+        self.model_name = model_name
+        self.host = host
+        self.port = port
+        self._server = None
+
+    def run(self, block: bool = True) -> None:
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        predictor = self.predictor
+        model_name = self.model_name
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                logging.debug("openai-api: " + fmt, *args)
+
+            def do_GET(self):
+                if self.path == "/v1/models":
+                    self._json(200, {"object": "list", "data": [{
+                        "id": model_name, "object": "model",
+                        "created": int(time.time()), "owned_by": "fedml_tpu",
+                    }]})
+                else:
+                    self._json(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/v1/chat/completions":
+                    self._json(404, {"error": "not found"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    prompt = _messages_to_prompt(body.get("messages", []))
+                    req = {"prompt": prompt,
+                           "max_tokens": int(body.get("max_tokens", 64)),
+                           "temperature": float(
+                               body.get("temperature", 1.0))}
+                    result = predictor.predict(req)
+                except Exception as e:  # noqa: BLE001
+                    self._json(500, {"error": {"message": str(e)}})
+                    return
+                if body.get("stream"):
+                    self._stream(result)
+                else:
+                    try:
+                        if not isinstance(result, str):
+                            # lazy generators raise here, not in predict()
+                            result = "".join(str(c) for c in result)
+                    except Exception as e:  # noqa: BLE001
+                        self._json(500, {"error": {"message": str(e)}})
+                        return
+                    self._json(200, _completion_body(model_name, result))
+
+            def _stream(self, result: Any) -> None:
+                cid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.end_headers()
+                chunks: Iterable[str] = ([result] if isinstance(result, str)
+                                         else result)
+                finish = "stop"
+                try:
+                    for chunk in chunks:
+                        data = json.dumps(_chunk_body(model_name, str(chunk),
+                                                      cid))
+                        self.wfile.write(f"data: {data}\n\n".encode())
+                        self.wfile.flush()
+                except Exception as e:  # noqa: BLE001
+                    # headers are already out: surface the error as a final
+                    # chunk so SDK clients still see a terminated stream
+                    logging.exception("openai-api: generator failed")
+                    err = json.dumps(_chunk_body(model_name,
+                                                 f"[error: {e}]", cid))
+                    self.wfile.write(f"data: {err}\n\n".encode())
+                    finish = "error"
+                done = json.dumps(_chunk_body(model_name, "", cid,
+                                              finish=finish))
+                self.wfile.write(f"data: {done}\n\n".encode())
+                self.wfile.write(b"data: [DONE]\n\n")
+
+            def _json(self, code: int, obj: Any) -> None:
+                data = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        logging.info("openai-compatible endpoint on %s:%d (model=%s)",
+                     self.host, self.port, self.model_name)
+        if block:
+            self._server.serve_forever()
+        else:
+            threading.Thread(target=self._server.serve_forever,
+                             daemon=True).start()
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()

@@ -118,3 +118,67 @@ def test_serving_endpoint_predict_ready_and_streaming():
     with urllib.request.urlopen(req2) as r:
         assert b"tok0" in r.read()
     runner.stop()
+
+
+def test_federated_serving_plane(args_factory):
+    """FL-to-serving handoff: server ships the model to N serving nodes,
+    endpoints come up, health checks report stats, fleet tears down."""
+    import numpy as np
+    from fedml_tpu.serving.federated_serving import deploy_federated
+
+    rng = np.random.RandomState(0)
+    params = {"w2": rng.randn(6, 3).astype(np.float32),
+              "b2": np.zeros(3, np.float32)}
+    args = args_factory(run_id="fs1", serving_oneshot=True)
+    out = deploy_federated(args, "lin-model", params, n_nodes=2)
+    assert len(out["endpoints"]) == 2
+    assert all(h["healthy"] for h in out["health"].values()), out
+
+
+def test_openai_compatible_api():
+    import json
+    import time
+    import urllib.request
+
+    from fedml_tpu.serving.fedml_predictor import FedMLPredictor
+    from fedml_tpu.serving.openai_api import OpenAIServer
+
+    class Chat(FedMLPredictor):
+        def predict(self, request):
+            assert "assistant:" in request["prompt"]
+            if request.get("max_tokens", 0) >= 3:
+                return iter(["hello ", "from ", "fedml"])
+            return "short"
+
+    srv = OpenAIServer(Chat(), model_name="test-model", host="127.0.0.1",
+                       port=23461)
+    srv.run(block=False)
+    time.sleep(0.2)
+    try:
+        with urllib.request.urlopen(
+                "http://127.0.0.1:23461/v1/models") as r:
+            models = json.loads(r.read())
+        assert models["data"][0]["id"] == "test-model"
+
+        body = {"model": "test-model", "max_tokens": 16,
+                "messages": [{"role": "user", "content": "hi"}]}
+        req = urllib.request.Request(
+            "http://127.0.0.1:23461/v1/chat/completions",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            out = json.loads(r.read())
+        assert out["object"] == "chat.completion"
+        assert out["choices"][0]["message"]["content"] == "hello from fedml"
+
+        body["stream"] = True
+        req2 = urllib.request.Request(
+            "http://127.0.0.1:23461/v1/chat/completions",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req2) as r:
+            raw = r.read().decode()
+        assert "data: [DONE]" in raw
+        assert '"chat.completion.chunk"' in raw
+    finally:
+        srv.stop()
