@@ -77,7 +77,10 @@ def _db() -> sqlite3.Connection:
         "created REAL, finished REAL)")
     cols = [r[1] for r in conn.execute("PRAGMA table_info(runs)")]
     if "pid" not in cols:
-        conn.execute("ALTER TABLE runs ADD COLUMN pid INTEGER")
+        try:
+            conn.execute("ALTER TABLE runs ADD COLUMN pid INTEGER")
+        except sqlite3.OperationalError:
+            pass  # concurrent caller won the migration race
     return conn
 
 
