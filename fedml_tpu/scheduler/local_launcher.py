@@ -79,8 +79,12 @@ def _db() -> sqlite3.Connection:
     if "pid" not in cols:
         try:
             conn.execute("ALTER TABLE runs ADD COLUMN pid INTEGER")
-        except sqlite3.OperationalError:
-            pass  # concurrent caller won the migration race
+        except sqlite3.OperationalError as e:
+            # a concurrent caller winning the migration race is fine; any
+            # other failure (e.g. "database is locked") must surface, or the
+            # column stays missing and later queries crash
+            if "duplicate column" not in str(e).lower():
+                raise
     return conn
 
 

@@ -51,15 +51,23 @@ class ModelCardRegistry:
             raise FileNotFoundError(model_path)
         card_dir = os.path.join(self.root, name)
         if os.path.abspath(model_path) != os.path.abspath(card_dir):
-            # always start from a clean card dir so a re-created card never
-            # serves stale files (e.g. an old predictor.py) from a previous
-            # version
+            # stage into a temp dir BEFORE clearing the card dir: the source
+            # may live inside the current card dir (re-registering a pulled
+            # card's own file), and the card dir must still end up clean so a
+            # re-created card never serves stale files from an old version
+            tmp_dir = os.path.join(self.root,
+                                   f".tmp_{name}_{uuid.uuid4().hex[:6]}")
+            try:
+                if os.path.isdir(model_path):
+                    shutil.copytree(model_path, tmp_dir)
+                else:
+                    os.makedirs(tmp_dir, exist_ok=True)
+                    shutil.copy(model_path, tmp_dir)
+            except BaseException:
+                shutil.rmtree(tmp_dir, ignore_errors=True)
+                raise
             shutil.rmtree(card_dir, ignore_errors=True)
-            if os.path.isdir(model_path):
-                shutil.copytree(model_path, card_dir)
-            else:
-                os.makedirs(card_dir, exist_ok=True)
-                shutil.copy(model_path, card_dir)
+            os.rename(tmp_dir, card_dir)
         card = {
             "name": name,
             "version": uuid.uuid4().hex[:8],
@@ -162,8 +170,6 @@ class ModelCardRegistry:
         """Bring up an HTTP endpoint serving this card. Predictor resolution
         order: explicit arg → `predictor.py` in the card (class `Predictor`)
         → default npz linear predictor (`model.npz`)."""
-        from ..serving.fedml_inference_runner import FedMLInferenceRunner
-
         from ..serving.fedml_inference_runner import serve_ephemeral
 
         card = self.get(name)

@@ -11,9 +11,7 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Any, Callable, Dict, List, Optional
-
-import numpy as np
+from typing import Any, Callable, Dict, Optional
 
 from ..core.distributed.communication.message import Message
 from ..core.distributed.fedml_comm_manager import FedMLCommManager
@@ -46,6 +44,7 @@ class ServingServerManager(FedMLCommManager):
         self.client_num = client_num
         self.ready_nodes: set = set()
         self.endpoints: Dict[int, str] = {}
+        self.failed: set = set()
         self.health: Dict[int, Dict[str, Any]] = {}
         self.all_up = threading.Event()
         self.all_healthy = threading.Event()
@@ -70,11 +69,15 @@ class ServingServerManager(FedMLCommManager):
                 self.send_message(dep)
 
     def _on_endpoint_up(self, msg: Message) -> None:
-        self.endpoints[msg.get_sender_id()] = str(
-            msg.get(ServingMessage.ARG_ENDPOINT_URL))
-        if len(self.endpoints) == self.client_num:
+        sender = msg.get_sender_id()
+        url = str(msg.get(ServingMessage.ARG_ENDPOINT_URL) or "")
+        if url:
+            self.endpoints[sender] = url
+        else:
+            self.failed.add(sender)  # node reported a failed deploy
+        if len(self.endpoints) + len(self.failed) == self.client_num:
             self.all_up.set()
-            for r in sorted(self.endpoints):
+            for r in sorted(self.endpoints) + sorted(self.failed):
                 self.send_message(Message(
                     ServingMessage.MSG_TYPE_S2C_HEALTH_CHECK,
                     self.get_sender_id(), r))
@@ -131,17 +134,24 @@ class ServingClientManager(FedMLCommManager):
 
         name = str(msg.get(ServingMessage.ARG_MODEL_NAME))
         params = msg.get(ServingMessage.ARG_MODEL_PARAMS)
-        if self.predictor_factory is not None:
-            predictor = self.predictor_factory(params)
-        else:
-            predictor = LinearHeadPredictor(params)
-        runner = serve_ephemeral(predictor, host="127.0.0.1")
-        self.endpoint = Endpoint(name=f"{name}@{self.rank}", host="127.0.0.1",
-                                 port=runner.port, runner=runner,
-                                 db=EndpointDB())
+        try:
+            if self.predictor_factory is not None:
+                predictor = self.predictor_factory(params)
+            else:
+                predictor = LinearHeadPredictor(params)
+            runner = serve_ephemeral(predictor, host="127.0.0.1")
+            self.endpoint = Endpoint(name=f"{name}@{self.rank}",
+                                     host="127.0.0.1", port=runner.port,
+                                     runner=runner, db=EndpointDB())
+            url = self.endpoint.url
+        except Exception:  # noqa: BLE001 — a failed node must still
+            # report in, or the server waits for its ENDPOINT_UP forever
+            logging.exception("serving node %d: deploy failed", self.rank)
+            self.endpoint = None
+            url = ""
         up = Message(ServingMessage.MSG_TYPE_C2S_ENDPOINT_UP,
                      self.get_sender_id(), 0)
-        up.add_params(ServingMessage.ARG_ENDPOINT_URL, self.endpoint.url)
+        up.add_params(ServingMessage.ARG_ENDPOINT_URL, url)
         self.send_message(up)
 
     def _on_health_check(self, msg: Message) -> None:
@@ -173,11 +183,25 @@ def deploy_federated(args: Any, model_name: str, model_params: Any,
                                     backend="INPROC",
                                     predictor_factory=predictor_factory)
                for r in range(1, n_nodes + 1)]
-    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    threads = [c.run_async() for c in clients]
+    # watchdog: a node whose thread died before reporting in would otherwise
+    # leave the server blocked on its receive loop forever
+    timeout = float(getattr(args, "serving_deploy_timeout", 120.0))
+    server_thread = server.run_async()
+    server_thread.join(timeout=timeout)
+    timed_out = server_thread.is_alive()
+    if timed_out:
+        logging.error("deploy_federated: timed out after %.0fs; "
+                      "tearing down", timeout)
+        server.finish()
+        server_thread.join(timeout=5)
+        for c in clients:  # stop leaked receive loops + HTTP endpoints
+            if c.endpoint is not None:
+                c.endpoint.stop()
+            c.finish()
     for t in threads:
-        t.start()
-    server.run()
-    for t in threads:
-        t.join(timeout=30)
+        t.join(timeout=5 if timed_out else 30)
     return {"endpoints": dict(server.endpoints),
+            "failed": sorted(server.failed),
+            "timed_out": timed_out,
             "health": dict(server.health)}

@@ -104,6 +104,8 @@ class FedMLInferenceRunner:
                 self.wfile.write(data)
 
         self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        # port 0 → OS-assigned; resolve so callers see the bound port
+        self.port = self._server.server_address[1]
         logging.info("inference endpoint on %s:%d", self.host, self.port)
         if block:
             self._server.serve_forever()
@@ -123,14 +125,9 @@ class FedMLInferenceRunner:
 
 def serve_ephemeral(predictor: FedMLPredictor, host: str = "127.0.0.1",
                     port: int = 0) -> "FedMLInferenceRunner":
-    """Bring an endpoint up on `port` (0 → pick a free one) in a background
-    thread; returns the runner with `.port` resolved."""
-    if port == 0:
-        import socket
-
-        with socket.socket() as s:
-            s.bind((host, 0))
-            port = s.getsockname()[1]
+    """Bring an endpoint up on `port` (0 → the OS assigns a free one at bind
+    time, so concurrent callers can't race) in a background thread; returns
+    the runner with `.port` resolved."""
     runner = FedMLInferenceRunner(predictor, host=host, port=port)
     runner.run(block=False, prefer_fastapi=False)
     return runner

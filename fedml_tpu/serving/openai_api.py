@@ -157,6 +157,8 @@ class OpenAIServer:
                 self.wfile.write(data)
 
         self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        # port 0 → OS-assigned; resolve so callers see the bound port
+        self.port = self._server.server_address[1]
         logging.info("openai-compatible endpoint on %s:%d (model=%s)",
                      self.host, self.port, self.model_name)
         if block:

@@ -186,6 +186,21 @@ def test_model_cards_create_package_deploy(tmp_path):
     assert reg.delete("lin") and reg.list() == []
 
 
+def test_model_card_recreate_from_own_file(tmp_path):
+    """Re-registering a card from a file inside its own card dir must not
+    destroy the file (regression: create() used to rmtree before copying)."""
+    from fedml_tpu.scheduler.model_cards import ModelCardRegistry
+
+    model = tmp_path / "model.npz"
+    np.savez(model, w=np.eye(3, dtype=np.float32))
+    reg = ModelCardRegistry(root=str(tmp_path / "cards"))
+    card = reg.create("m", str(model))
+    stored = os.path.join(card["path"], "model.npz")
+    card2 = reg.create("m", stored)  # bump version from the stored file
+    assert os.path.exists(os.path.join(card2["path"], "model.npz"))
+    assert card2["version"] != card["version"]
+
+
 def test_cli_job_cluster_model_groups(tmp_path, monkeypatch):
     from click.testing import CliRunner
 

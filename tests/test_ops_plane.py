@@ -131,8 +131,37 @@ def test_federated_serving_plane(args_factory):
               "b2": np.zeros(3, np.float32)}
     args = args_factory(run_id="fs1", serving_oneshot=True)
     out = deploy_federated(args, "lin-model", params, n_nodes=2)
-    assert len(out["endpoints"]) == 2
+    assert len(out["endpoints"]) == 2 and not out["failed"]
+    assert not out["timed_out"]
     assert all(h["healthy"] for h in out["health"].values()), out
+
+
+def test_federated_serving_node_failure_no_hang(args_factory):
+    """A node whose predictor factory raises must be reported as failed —
+    not hang the deploy (regression: server waited on ENDPOINT_UP forever)."""
+    import numpy as np
+    from fedml_tpu.serving.fedml_predictor import LinearHeadPredictor
+    from fedml_tpu.serving.federated_serving import deploy_federated
+
+    calls = []
+
+    def flaky_factory(params):
+        calls.append(1)
+        if len(calls) == 1:
+            raise RuntimeError("boom")
+        return LinearHeadPredictor(params)
+
+    rng = np.random.RandomState(0)
+    params = {"w2": rng.randn(6, 3).astype(np.float32),
+              "b2": np.zeros(3, np.float32)}
+    args = args_factory(run_id="fs2", serving_oneshot=True,
+                        serving_deploy_timeout=60.0)
+    out = deploy_federated(args, "lin-model", params, n_nodes=2,
+                           predictor_factory=flaky_factory)
+    assert not out["timed_out"]
+    assert len(out["failed"]) == 1 and len(out["endpoints"]) == 1
+    failed_rank = out["failed"][0]
+    assert out["health"][failed_rank]["healthy"] is False
 
 
 def test_openai_compatible_api():
@@ -151,19 +180,20 @@ def test_openai_compatible_api():
             return "short"
 
     srv = OpenAIServer(Chat(), model_name="test-model", host="127.0.0.1",
-                       port=23461)
+                       port=0)
     srv.run(block=False)
     time.sleep(0.2)
+    base = f"http://127.0.0.1:{srv.port}"
     try:
         with urllib.request.urlopen(
-                "http://127.0.0.1:23461/v1/models") as r:
+                f"{base}/v1/models") as r:
             models = json.loads(r.read())
         assert models["data"][0]["id"] == "test-model"
 
         body = {"model": "test-model", "max_tokens": 16,
                 "messages": [{"role": "user", "content": "hi"}]}
         req = urllib.request.Request(
-            "http://127.0.0.1:23461/v1/chat/completions",
+            f"{base}/v1/chat/completions",
             data=json.dumps(body).encode(),
             headers={"Content-Type": "application/json"})
         with urllib.request.urlopen(req) as r:
@@ -173,7 +203,7 @@ def test_openai_compatible_api():
 
         body["stream"] = True
         req2 = urllib.request.Request(
-            "http://127.0.0.1:23461/v1/chat/completions",
+            f"{base}/v1/chat/completions",
             data=json.dumps(body).encode(),
             headers={"Content-Type": "application/json"})
         with urllib.request.urlopen(req2) as r:
