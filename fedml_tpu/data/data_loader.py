@@ -36,11 +36,23 @@ def load(args: Any) -> DatasetTuple:
     (x_train, y_train, x_test, y_test), class_num = load_arrays(
         dataset, cache_dir, seed=seed, scale=scale)
 
-    part_labels = y_train if y_train.ndim == 1 else y_train[:, 0]
+    def _per_sample_label(y: np.ndarray) -> np.ndarray:
+        if y.ndim == 1:
+            return y
+        if y.ndim == 2:  # token sequences → first token
+            return y[:, 0]
+        # dense masks (segmentation) → most frequent foreground class
+        flat = y.reshape(len(y), -1)
+        out = np.empty(len(y), flat.dtype)
+        for i, row in enumerate(flat):
+            fg = row[row > 0]
+            out[i] = np.bincount(fg).argmax() if len(fg) else 0
+        return out
+
+    part_labels = _per_sample_label(y_train)
     net_dataidx_map = partition(part_labels, n_clients, method, alpha, seed)
-    test_map = partition(
-        y_test if y_test.ndim == 1 else y_test[:, 0],
-        n_clients, "homo", alpha, seed + 1)
+    test_map = partition(_per_sample_label(y_test),
+                         n_clients, "homo", alpha, seed + 1)
 
     train_local: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
     test_local: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}

@@ -64,14 +64,27 @@ def _try_torchvision(cache_dir: str, name: str) -> Optional[Arrays]:
 def _synthetic_images(shape: Tuple[int, ...], n_classes: int, n_train: int,
                       n_test: int, seed: int) -> Arrays:
     """Class-structured images: per-class template + noise, so linear/conv
-    models can actually learn (deterministic)."""
+    models can actually learn (deterministic).  Large images (≥96px) build
+    templates at low resolution and upsample, and add noise in float32
+    batches, keeping peak memory ~n·H·W·C·4 bytes instead of several GB."""
     rng = np.random.RandomState(seed)
-    templates = rng.rand(n_classes, *shape).astype(np.float32)
+    h, w = shape[0], shape[1]
+    lowres = h >= 96
+    if lowres:  # store 16px templates; upsample per gathered batch
+        templates = rng.rand(n_classes, 16, 16,
+                             *shape[2:]).astype(np.float32)
+    else:
+        templates = rng.rand(n_classes, *shape).astype(np.float32)
 
     def make(n):
         y = rng.randint(0, n_classes, size=n)
-        x = templates[y] + 0.35 * rng.randn(n, *shape).astype(np.float32)
-        return np.clip(x, 0.0, 1.0).astype(np.float32), y.astype(np.int64)
+        x = templates[y]
+        if lowres:
+            x = np.repeat(np.repeat(x, -(-h // 16), axis=1),
+                          -(-w // 16), axis=2)[:, :h, :w]
+        noise = rng.standard_normal(size=x.shape).astype(np.float32)
+        return (np.clip(x + 0.35 * noise, 0.0, 1.0).astype(np.float32),
+                y.astype(np.int64))
 
     xt, yt = make(n_train)
     xe, ye = make(n_test)
@@ -140,6 +153,80 @@ def adult_tabular(n_train: int = 4000, n_test: int = 1000, seed: int = 0,
     return xt, yt, xe, ye
 
 
+def synthetic_segmentation(n_train: int = 800, n_test: int = 160,
+                           seed: int = 0, size: int = 24,
+                           n_classes: int = 4) -> Arrays:
+    """Per-pixel labeled images for federated segmentation (reference
+    `simulation/mpi/fedseg/` capability): random rectangles of class c drawn
+    on background class 0; x carries class-correlated intensity."""
+    rng = np.random.RandomState(seed)
+
+    def make(n):
+        x = 0.1 * rng.rand(n, size, size, 3).astype(np.float32)
+        y = np.zeros((n, size, size), np.int64)
+        for i in range(n):
+            for _ in range(2):
+                c = rng.randint(1, n_classes)
+                h0, w0 = rng.randint(0, size - 6, 2)
+                h1, w1 = h0 + rng.randint(4, 7), w0 + rng.randint(4, 7)
+                y[i, h0:h1, w0:w1] = c
+                x[i, h0:h1, w0:w1, :] = c / n_classes + 0.1 * rng.rand(
+                    h1 - h0, w1 - w0, 3)
+        return x, y
+
+    xt, yt = make(n_train)
+    xe, ye = make(n_test)
+    return xt, yt, xe, ye
+
+
+def stackoverflow_lr_bow(n_train: int = 4000, n_test: int = 800,
+                         seed: int = 0, vocab: int = 10004,
+                         n_tags: int = 500) -> Arrays:
+    """StackOverflow tag-prediction bag-of-words (reference
+    `data/stackoverflow_lr/data_loader.py`): x = sparse word counts over a
+    10k vocab, y = tag id.  Synthetic: each tag has a characteristic word
+    distribution, so a linear model is learnable."""
+    rng = np.random.RandomState(seed)
+    # each tag prefers a small set of vocabulary words
+    tag_words = rng.randint(0, vocab, size=(n_tags, 12))
+
+    def make(n):
+        y = rng.randint(0, n_tags, size=n)
+        x = np.zeros((n, vocab), np.float32)
+        rows = np.repeat(np.arange(n), 12)
+        np.add.at(x, (rows, tag_words[y].ravel()), 1.0)
+        noise = rng.randint(0, vocab, size=(n, 6))
+        np.add.at(x, (np.repeat(np.arange(n), 6), noise.ravel()), 1.0)
+        return x / np.maximum(x.sum(1, keepdims=True), 1.0), y.astype(np.int64)
+
+    xt, yt = make(n_train)
+    xe, ye = make(n_test)
+    return xt, yt, xe, ye
+
+
+def edge_case_poison(x: np.ndarray, y: np.ndarray, n_classes: int,
+                     target_label: int = 1, frac: float = 0.05,
+                     seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Edge-case backdoor examples (reference
+    `data/edge_case_examples/` + `core/security/attack/edge_case_attack.py`):
+    low-probability tail inputs (for images: a fixed corner trigger far from
+    the class templates; for token sequences: a fixed rare token prefix) all
+    labeled ``target_label``, with the label matching the base task's shape."""
+    rng = np.random.RandomState(seed + 7)
+    n = max(int(len(x) * frac), 8)
+    if np.issubdtype(x.dtype, np.integer):  # token sequences
+        hi = int(x.max()) + 1
+        xe = rng.randint(0, max(hi, 2), size=(n,) + x.shape[1:]).astype(
+            x.dtype)
+        xe[..., :4] = hi - 1  # rare-token trigger prefix
+    else:
+        xe = rng.rand(n, *x.shape[1:]).astype(x.dtype)
+        if xe.ndim == 4:  # stamp a deterministic corner trigger
+            xe[:, :4, :4] = 1.0
+    ye = np.full((n,) + y.shape[1:], target_label % n_classes, y.dtype)
+    return xe, ye
+
+
 def load_arrays(dataset: str, cache_dir: str, seed: int = 0,
                 scale: float = 1.0) -> Tuple[Arrays, int]:
     """→ ((x_train, y_train, x_test, y_test), num_classes).  ``scale``
@@ -166,6 +253,35 @@ def load_arrays(dataset: str, cache_dir: str, seed: int = 0,
     if dataset == "stackoverflow_nwp":
         xt, yt, xe, ye = shakespeare_sequences(20, sz(2000), sz(400), seed)
         return (xt % 10004, yt % 10004, xe % 10004, ye % 10004), 10004
+    if dataset == "stackoverflow_lr":
+        return stackoverflow_lr_bow(sz(4000), sz(800), seed), 500
+    if dataset in ("ilsvrc2012", "imagenet"):
+        # reference data/ImageNet loader (`data_loader.py:375`); synthetic
+        # fallback keeps the 1000-class 224px contract but few samples
+        real = _try_npz(cache_dir, "ilsvrc2012")
+        return (real or _synthetic_images((224, 224, 3), 1000,
+                                          max(int(1300 * scale), 256),
+                                          max(int(200 * scale), 64),
+                                          seed)), 1000
+    if dataset in ("gld23k", "gld160k"):
+        # Google Landmarks federated splits (`data_loader.py:395,421`)
+        classes = 203 if dataset == "gld23k" else 2028
+        real = _try_npz(cache_dir, dataset)
+        return (real or _synthetic_images((96, 96, 3), classes,
+                                          max(sz(2000), classes),
+                                          max(sz(400), classes),
+                                          seed)), classes
+    if dataset.startswith("edge_case_") or dataset.endswith("_poisoned"):
+        # poisoned variant of a base dataset (`data_loader.py:582+`):
+        # appends edge-case backdoor examples to the train split
+        base = (dataset.replace("edge_case_", "").replace("_poisoned", "")
+                or "cifar10")
+        (xt, yt, xe, ye), classes = load_arrays(base, cache_dir, seed, scale)
+        px, py = edge_case_poison(xt, yt, classes, seed=seed)
+        return (np.concatenate([xt, px]), np.concatenate([yt, py]),
+                xe, ye), classes
+    if dataset == "synthetic_seg":
+        return synthetic_segmentation(sz(800), sz(160), seed), 4
     if dataset == "adult":
         return adult_tabular(sz(4000), sz(1000), seed), 2
     # default synthetic

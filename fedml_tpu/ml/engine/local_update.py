@@ -133,7 +133,7 @@ def build_local_update(bundle: ModelBundle, cfg: Any) -> Callable:
         aux = {"new_model_state": {k: v for k, v in new_vars.items()
                                    if k != "params"},
                "correct": correct,
-               "n": jnp.sum(batch["mask"])}
+               "n": bundle.valid_count(batch["y"], batch["mask"])}
         return loss, aux
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
@@ -252,7 +252,9 @@ def build_eval_step(bundle: ModelBundle) -> Callable:
             batch = jax.tree_util.tree_map(lambda b: b[batch_idx], batches)
             logits, _ = bundle.apply(variables, batch["x"], train=False)
             loss = bundle.loss(logits, batch["y"], batch["mask"])
-            n = jnp.sum(batch["mask"])
+            # valid label ELEMENTS (tokens/pixels, not examples) so
+            # acc = correct/n stays in [0,1] for LM and segmentation too
+            n = bundle.valid_count(batch["y"], batch["mask"])
             carry = {
                 "loss_sum": carry["loss_sum"] + loss * n,
                 "correct": carry["correct"] + bundle.correct_count(

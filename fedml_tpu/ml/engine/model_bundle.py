@@ -71,11 +71,22 @@ class ModelBundle:
             pred = jnp.argmax(logits, axis=-1)
         hit = (pred == y).astype(jnp.float32)
         if mask is not None:
-            mask = mask.astype(jnp.float32)
-            while mask.ndim < hit.ndim:  # [B] example mask → [B, T] tokens
-                mask = mask[..., None]
-            hit = hit * mask
+            hit = hit * broadcast_mask(mask, hit.shape)
         return jnp.sum(hit)
+
+    def valid_count(self, y: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+        """Number of valid label ELEMENTS (tokens/pixels, not examples) —
+        the denominator matching ``correct_count``."""
+        return jnp.sum(broadcast_mask(mask, y.shape))
+
+
+def broadcast_mask(mask: jnp.ndarray, shape) -> jnp.ndarray:
+    """[B] example mask → per-element mask of ``shape`` ([B,T] tokens,
+    [B,H,W] pixels)."""
+    mask = mask.astype(jnp.float32)
+    while mask.ndim < len(shape):
+        mask = mask[..., None]
+    return jnp.broadcast_to(mask, shape)
 
 
 def masked_loss(task: str, logits: jnp.ndarray, y: jnp.ndarray,
@@ -95,8 +106,5 @@ def masked_loss(task: str, logits: jnp.ndarray, y: jnp.ndarray,
         per = logz - gold
     if mask is None:
         return jnp.mean(per)
-    mask = mask.astype(jnp.float32)
-    while mask.ndim < per.ndim:  # [B] example mask → [B, T] token mask
-        mask = mask[..., None]
-    mask = jnp.broadcast_to(mask, per.shape)
+    mask = broadcast_mask(mask, per.shape)
     return jnp.sum(per * mask) / jnp.maximum(jnp.sum(mask), 1.0)

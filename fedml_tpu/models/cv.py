@@ -20,6 +20,7 @@ from __future__ import annotations
 from functools import partial
 from typing import Any, Callable, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
@@ -335,3 +336,92 @@ class EfficientNetB0(nn.Module):
         x = jnp.mean(x, axis=(1, 2))
         return nn.Dense(self.num_classes, dtype=self.dtype,
                         param_dtype=jnp.float32)(x).astype(jnp.float32)
+
+
+class LeNet5(nn.Module):
+    """LeNet for on-device/mobile parity (reference `model/mobile/` MNN
+    "lenet", `model_hub.py:78-84`)."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(6, (5, 5), padding="SAME", dtype=self.dtype)(x))
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(16, (5, 5), padding="VALID", dtype=self.dtype)(x))
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(120, dtype=self.dtype)(x))
+        x = nn.relu(nn.Dense(84, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        param_dtype=jnp.float32)(x).astype(jnp.float32)
+
+
+_VGG_PLANS = {
+    11: (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    16: (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+         512, 512, 512, "M", 512, 512, 512, "M"),
+}
+
+
+class VGG(nn.Module):
+    """VGG-11/16 with optional norm (reference `model/cv/vgg.py`)."""
+
+    num_classes: int = 10
+    depth: int = 11
+    norm: str = "bn"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = _norm(self.norm, train, self.dtype)
+        x = x.astype(self.dtype)
+        for item in _VGG_PLANS[self.depth]:
+            if item == "M":
+                if min(x.shape[1], x.shape[2]) >= 2:
+                    x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(item, (3, 3), padding="SAME", use_bias=False,
+                            dtype=self.dtype)(x)
+                x = nn.relu(norm()(x))
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.relu(nn.Dense(512, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        param_dtype=jnp.float32)(x).astype(jnp.float32)
+
+
+class UNetLite(nn.Module):
+    """Compact U-Net for federated segmentation (reference `model/cv/`
+    fedseg usage — deeplabV3/unet; output is per-pixel class logits)."""
+
+    num_classes: int = 2
+    base: int = 16
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+
+        def block(h, feat):
+            h = nn.relu(nn.Conv(feat, (3, 3), padding="SAME",
+                                dtype=self.dtype)(h))
+            return nn.relu(nn.Conv(feat, (3, 3), padding="SAME",
+                                   dtype=self.dtype)(h))
+
+        e1 = block(x, self.base)
+        e2 = block(nn.max_pool(e1, (2, 2), strides=(2, 2)), self.base * 2)
+        mid = block(nn.max_pool(e2, (2, 2), strides=(2, 2)), self.base * 4)
+        u2 = jax.image.resize(mid, (mid.shape[0], e2.shape[1], e2.shape[2],
+                                    mid.shape[3]), "nearest")
+        d2 = block(jnp.concatenate([u2, e2], axis=-1), self.base * 2)
+        u1 = jax.image.resize(d2, (d2.shape[0], e1.shape[1], e1.shape[2],
+                                   d2.shape[3]), "nearest")
+        d1 = block(jnp.concatenate([u1, e1], axis=-1), self.base)
+        return nn.Conv(self.num_classes, (1, 1),
+                       dtype=self.dtype,
+                       param_dtype=jnp.float32)(d1).astype(jnp.float32)

@@ -1,0 +1,153 @@
+"""DARTS cell-based networks for federated NAS (FedNAS).
+
+Capability parity: reference `model/cv/darts/` (model_search.py Network with
+architecture alphas, model.py NetworkCIFAR from a fixed genotype) used by
+`simulation/mpi/fednas/`.
+
+TPU-first design: the search network evaluates ALL candidate ops and takes a
+softmax(alpha)-weighted sum — a dense, static-shape computation that XLA fuses
+well (no dynamic op selection inside jit).  Architecture parameters live in
+the same param pytree under "arch" so federated aggregation of alphas (the
+FedNAS protocol: clients send both weights and alphas, server averages both)
+is ordinary pytree math.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+PRIMITIVES = ("none", "skip_connect", "avg_pool_3x3", "max_pool_3x3",
+              "conv_3x3", "sep_conv_3x3")
+
+# A reasonable fixed genotype for the train (non-search) network — op per edge
+DARTS_GENOTYPE: Tuple[str, ...] = ("sep_conv_3x3", "conv_3x3",
+                                   "skip_connect", "sep_conv_3x3")
+
+
+def _apply_op(name: str, x, channels: int, dtype) -> Any:
+    if name == "none":
+        return jnp.zeros_like(x)
+    if name == "skip_connect":
+        return x
+    if name == "avg_pool_3x3":
+        return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+    if name == "max_pool_3x3":
+        return nn.max_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+    if name == "conv_3x3":
+        return nn.relu(nn.Conv(channels, (3, 3), padding="SAME",
+                               dtype=dtype)(x))
+    if name == "sep_conv_3x3":
+        h = nn.Conv(channels, (3, 3), padding="SAME",
+                    feature_group_count=channels, dtype=dtype)(x)
+        return nn.relu(nn.Conv(channels, (1, 1), dtype=dtype)(h))
+    raise ValueError(name)
+
+
+class MixedOp(nn.Module):
+    channels: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, weights):
+        outs = [_apply_op(p, x, self.channels, self.dtype)
+                for p in PRIMITIVES]
+        return sum(w * o for w, o in zip(weights, outs))
+
+
+class SearchCell(nn.Module):
+    """2-input, `steps`-node cell; every edge is a MixedOp."""
+
+    channels: int
+    steps: int = 2
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, s0, s1, alphas):
+        states = [s0, s1]
+        offset = 0
+        for _ in range(self.steps):
+            s = sum(MixedOp(self.channels, self.dtype)(
+                h, nn.softmax(alphas[offset + j]))
+                for j, h in enumerate(states))
+            offset += len(states)
+            states.append(s)
+        return jnp.concatenate(states[-self.steps:], axis=-1)
+
+
+def num_edges(steps: int = 2) -> int:
+    return sum(2 + i for i in range(steps))
+
+
+class DARTSSearchNetwork(nn.Module):
+    """Search-phase network (reference `model_search.py` Network): alphas are
+    flax params (param collection key "alphas") trained jointly — the FedNAS
+    server averages them like any other leaf."""
+
+    num_classes: int = 10
+    channels: int = 16
+    layers: int = 2
+    steps: int = 2
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        c = self.channels
+        x = nn.relu(nn.Conv(c, (3, 3), padding="SAME", dtype=self.dtype)(x))
+        alphas = self.param(
+            "alphas",
+            lambda key: 1e-3 * jnp.ones((num_edges(self.steps),
+                                         len(PRIMITIVES)), jnp.float32))
+        s0 = s1 = x
+        for layer in range(self.layers):
+            out = SearchCell(c, self.steps, self.dtype)(s0, s1, alphas)
+            out = nn.Conv(c, (1, 1), dtype=self.dtype)(out)
+            if layer % 2 == 1 and min(out.shape[1], out.shape[2]) >= 2:
+                out = nn.max_pool(out, (2, 2), strides=(2, 2))
+                s1 = nn.max_pool(s1, (2, 2), strides=(2, 2))
+            s0, s1 = s1 if s1.shape == out.shape else out, out
+        x = jnp.mean(s1, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        param_dtype=jnp.float32)(x).astype(jnp.float32)
+
+
+class DARTSNetwork(nn.Module):
+    """Train-phase network from a fixed genotype (reference `model.py`
+    NetworkCIFAR)."""
+
+    num_classes: int = 10
+    channels: int = 16
+    layers: int = 3
+    genotype: Sequence[str] = DARTS_GENOTYPE
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        c = self.channels
+        x = nn.relu(nn.Conv(c, (3, 3), padding="SAME", dtype=self.dtype)(x))
+        for layer in range(self.layers):
+            h = x
+            for op_name in self.genotype:
+                h = _apply_op(op_name, h, c, self.dtype)
+            x = x + h if h.shape == x.shape else h
+            if layer % 2 == 1 and min(x.shape[1], x.shape[2]) >= 2:
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        param_dtype=jnp.float32)(x).astype(jnp.float32)
+
+
+def derive_genotype(alphas: jnp.ndarray) -> Tuple[str, ...]:
+    """argmax over non-"none" primitives per edge (reference
+    `model_search.py` genotype())."""
+    picks = []
+    for row in alphas:
+        idx = int(jnp.argmax(jnp.where(
+            jnp.arange(len(PRIMITIVES)) == PRIMITIVES.index("none"),
+            -jnp.inf, row)))
+        picks.append(PRIMITIVES[idx])
+    return tuple(picks)

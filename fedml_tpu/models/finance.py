@@ -1,0 +1,60 @@
+"""Tabular / vertical-FL party models.
+
+Capability parity: reference `model/finance/` (vfl_models.py — per-party
+bottom MLPs producing embeddings + an active-party top model over the
+concatenated embeddings, used by `simulation/sp/classical_vertical_fl/`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class VFLBottomModel(nn.Module):
+    """Passive/active party feature extractor: features → embedding."""
+
+    embed_dim: int = 16
+    hidden: Sequence[int] = (32,)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h, dtype=self.dtype)(x))
+        return nn.Dense(self.embed_dim, dtype=self.dtype,
+                        param_dtype=jnp.float32)(x).astype(jnp.float32)
+
+
+class VFLTopModel(nn.Module):
+    """Active-party head over concatenated party embeddings → logit(s)."""
+
+    num_classes: int = 1
+    hidden: int = 16
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, embeds, train: bool = False):
+        x = jnp.concatenate([e.astype(self.dtype) for e in embeds], axis=-1)
+        x = nn.relu(nn.Dense(self.hidden, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        param_dtype=jnp.float32)(x).astype(jnp.float32)
+
+
+class TabularMLP(nn.Module):
+    """Plain tabular classifier (reference `model/linear/` + finance MLPs)."""
+
+    num_classes: int = 2
+    hidden: Sequence[int] = (64, 32)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        param_dtype=jnp.float32)(x).astype(jnp.float32)

@@ -1,0 +1,58 @@
+"""GAN models for federated GAN training.
+
+Capability parity: reference `model/gan/` (generator/discriminator pair used
+by `simulation/mpi/fedgan/`).  DCGAN-style, NHWC, sized for the 28/32px
+federated image datasets.  TPU notes: transposed convs lower to MXU-friendly
+conv-grad ops under XLA; all compute optionally bfloat16.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class DCGANGenerator(nn.Module):
+    """z (latent) → image in [-1, 1]."""
+
+    out_shape: Tuple[int, int, int] = (32, 32, 3)
+    latent_dim: int = 64
+    base: int = 64
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, z, train: bool = False):
+        h0, w0 = self.out_shape[0] // 4, self.out_shape[1] // 4
+        x = nn.Dense(h0 * w0 * self.base * 2, dtype=self.dtype)(
+            z.astype(self.dtype))
+        x = nn.relu(x).reshape((z.shape[0], h0, w0, self.base * 2))
+        x = nn.ConvTranspose(self.base, (4, 4), strides=(2, 2),
+                             padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(nn.GroupNorm(num_groups=4, dtype=self.dtype,
+                                 param_dtype=jnp.float32)(x))
+        x = nn.ConvTranspose(self.out_shape[2], (4, 4), strides=(2, 2),
+                             padding="SAME", dtype=self.dtype,
+                             param_dtype=jnp.float32)(x)
+        return jnp.tanh(x).astype(jnp.float32)
+
+
+class DCGANDiscriminator(nn.Module):
+    """image → real/fake logit."""
+
+    base: int = 64
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.leaky_relu(nn.Conv(self.base, (4, 4), strides=(2, 2),
+                                  padding="SAME", dtype=self.dtype)(x), 0.2)
+        x = nn.Conv(self.base * 2, (4, 4), strides=(2, 2), padding="SAME",
+                    dtype=self.dtype)(x)
+        x = nn.leaky_relu(nn.GroupNorm(num_groups=4, dtype=self.dtype,
+                                       param_dtype=jnp.float32)(x), 0.2)
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(1, dtype=self.dtype,
+                        param_dtype=jnp.float32)(x).astype(jnp.float32)

@@ -28,6 +28,10 @@ from .cv import (
     MobileNetV3Small,
     ResNet18,
 )
+from .cv import LeNet5, UNetLite, VGG
+from .darts import DARTSNetwork, DARTSSearchNetwork
+from .finance import TabularMLP, VFLBottomModel
+from .gan import DCGANDiscriminator
 from .nlp import CharLSTM, StackOverflowLSTM, TinyTransformerLM, ViT
 
 # dataset → (input_shape, default_classes, task)
@@ -44,12 +48,19 @@ _DATASET_SHAPES = {
     "stackoverflow_nwp": ((20,), 10004, TASK_LM),
     "stackoverflow_lr": ((10004,), 500, TASK_CLASSIFICATION),
     "adult": ((105,), 2, TASK_BINARY),
+    "ilsvrc2012": ((224, 224, 3), 1000, TASK_CLASSIFICATION),
+    "imagenet": ((224, 224, 3), 1000, TASK_CLASSIFICATION),
+    "synthetic_seg": ((24, 24, 3), 4, TASK_CLASSIFICATION),
+    "gld23k": ((96, 96, 3), 203, TASK_CLASSIFICATION),
+    "gld160k": ((96, 96, 3), 2028, TASK_CLASSIFICATION),
 }
 
 
 def dataset_meta(dataset: str) -> Tuple[Tuple[int, ...], int, str]:
-    return _DATASET_SHAPES.get(str(dataset).lower(), ((32, 32, 3), 10,
-                                                      TASK_CLASSIFICATION))
+    name = str(dataset).lower()
+    # poisoned variants share the base dataset's contract (data/datasets.py)
+    name = name.replace("edge_case_", "").replace("_poisoned", "") or name
+    return _DATASET_SHAPES.get(name, ((32, 32, 3), 10, TASK_CLASSIFICATION))
 
 
 def create(args: Any, output_dim: Optional[int] = None) -> ModelBundle:
@@ -95,6 +106,27 @@ def create(args: Any, output_dim: Optional[int] = None) -> ModelBundle:
     elif name in ("vit", "vit_tiny", "vit-tiny"):
         module = ViT(num_classes=num_classes, dtype=dtype,
                      layers=int(getattr(args, "vit_layers", 6)))
+    elif name in ("vgg11", "vgg16", "vgg"):
+        depth = 16 if name.endswith("16") else 11
+        module = VGG(num_classes=num_classes, depth=depth, dtype=dtype,
+                     norm=str(getattr(args, "norm", "bn")))
+    elif name == "lenet":
+        module = LeNet5(num_classes=num_classes, dtype=dtype)
+    elif name in ("unet", "deeplab", "segmentation"):
+        module = UNetLite(num_classes=num_classes, dtype=dtype)
+    elif name in ("darts", "darts_search"):
+        module = DARTSSearchNetwork(num_classes=num_classes, dtype=dtype)
+    elif name in ("darts_train", "nas_train"):
+        module = DARTSNetwork(num_classes=num_classes, dtype=dtype)
+    elif name == "gan":
+        # bundle wraps the discriminator (the federated-averaged part in
+        # fedgan); the generator is built alongside by the fedgan algorithm
+        module = DCGANDiscriminator(dtype=dtype)
+        task = TASK_BINARY
+    elif name in ("mlp", "tabular_mlp"):
+        module = TabularMLP(num_classes=num_classes, dtype=dtype)
+    elif name.startswith("vfl"):
+        module = VFLBottomModel(dtype=dtype)
     else:
         raise ValueError(f"unknown model {name!r}")
 

@@ -58,6 +58,22 @@ class FedMLRunner:
                 if opt == "SplitNN":
                     from .simulation.sp.vertical_fl import SplitNNAPI
                     return SplitNNAPI(args, device, dataset, model)
+                if opt == "FedGKT":
+                    from .simulation.sp.advanced_algorithms import FedGKTAPI
+                    return FedGKTAPI(args, device, dataset, model)
+                if opt == "FedGAN":
+                    from .simulation.sp.advanced_algorithms import FedGANAPI
+                    return FedGANAPI(args, device, dataset, model)
+                if opt == "TurboAggregate":
+                    from .simulation.sp.advanced_algorithms import (
+                        TurboAggregateAPI,
+                    )
+                    return TurboAggregateAPI(args, device, dataset, model)
+                if opt == "FedAvg_seq":
+                    from .simulation.sp.advanced_algorithms import (
+                        FedAvgSeqAPI,
+                    )
+                    return FedAvgSeqAPI(args, device, dataset, model)
                 from .simulation.sp.fed_api import FedSimAPI
                 return FedSimAPI(args, device, dataset, model,
                                  client_trainer, server_aggregator)

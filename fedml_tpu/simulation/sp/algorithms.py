@@ -52,20 +52,8 @@ class HierarchicalFLAPI(FedSimAPI):
             for gid, members in enumerate(self.groups):
                 group_vars = self.global_vars
                 for _ in range(self.group_comm_round):
-                    results = []
-                    for cid in members:
-                        self.trainer.set_id(cid)
-                        self.trainer.update_dataset(
-                            self.train_data_local_dict[cid],
-                            self.test_data_local_dict[cid],
-                            self.local_num_dict[cid])
-                        self.trainer.set_model_params(group_vars)
-                        self.trainer.algo_state = self._algo_state_for(cid)
-                        self.trainer.train(
-                            self.trainer.local_train_dataset, self.device,
-                            self.args)
-                        results.append((float(self.local_num_dict[cid]),
-                                        self.trainer.get_model_params()))
+                    results = [self._local_train(cid, group_vars)
+                               for cid in members]
                     group_vars = weighted_average(results)
                 n_group = float(sum(self.local_num_dict[c] for c in members))
                 group_models.append((n_group, group_vars))
@@ -103,15 +91,8 @@ class DecentralizedFLAPI(FedSimAPI):
         for round_idx in range(comm_rounds):
             t0 = time.time()
             for cid in range(n):
-                self.trainer.set_id(cid)
-                self.trainer.update_dataset(
-                    self.train_data_local_dict[cid],
-                    self.test_data_local_dict[cid],
-                    self.local_num_dict[cid])
-                self.trainer.set_model_params(self.client_vars[cid])
-                self.trainer.train(self.trainer.local_train_dataset,
-                                   self.device, self.args)
-                self.client_vars[cid] = self.trainer.get_model_params()
+                _, self.client_vars[cid] = self._local_train(
+                    cid, self.client_vars[cid])
             # mix: stacked leading axis contraction with W
             stacked = jax.tree_util.tree_map(
                 lambda *xs: jnp.stack(xs), *self.client_vars)
@@ -156,15 +137,7 @@ class AsyncFedAvgAPI(FedSimAPI):
         t_end = float(comm_rounds)
         while events and events[0][0] <= t_end:
             finish_t, cid, tau = events.pop(0)
-            self.trainer.set_id(cid)
-            self.trainer.update_dataset(
-                self.train_data_local_dict[cid],
-                self.test_data_local_dict[cid],
-                self.local_num_dict[cid])
-            self.trainer.set_model_params(self.global_vars)
-            self.trainer.train(self.trainer.local_train_dataset, self.device,
-                               self.args)
-            w_i = self.trainer.get_model_params()
+            _, w_i = self._local_train(cid)
             staleness = max(server_step - tau, 0)
             a = alpha / (staleness + 1.0)
             self.global_vars = jax.tree_util.tree_map(

@@ -118,6 +118,28 @@ class FedSimAPI:
         return {}
 
     # -- the round loop ------------------------------------------------------
+    def _local_train(self, cid: int, global_vars: Any = None
+                     ) -> Tuple[float, Any]:
+        """Full client lifecycle for one local round: dataset swap, param
+        sync, before/after hooks (FHE dec/enc, local-DP noise — reference
+        `core/alg_frame/client_trainer.py:59-82`), train.  Returns
+        (n_samples, trained params)."""
+        self.trainer.set_id(cid)
+        self.trainer.update_dataset(
+            self.train_data_local_dict[cid],
+            self.test_data_local_dict[cid],
+            self.local_num_dict[cid])
+        self.trainer.set_model_params(
+            self.global_vars if global_vars is None else global_vars)
+        self.trainer.algo_state = self._algo_state_for(cid)
+        self.trainer.on_before_local_training(
+            self.trainer.local_train_dataset, self.device, self.args)
+        self.trainer.train(self.trainer.local_train_dataset, self.device,
+                           self.args)
+        self.trainer.on_after_local_training(
+            self.trainer.local_train_dataset, self.device, self.args)
+        return float(self.local_num_dict[cid]), self.trainer.get_model_params()
+
     def train(self) -> Dict[str, Any]:
         comm_rounds = int(self.args.comm_round)
         final_metrics: Dict[str, Any] = {}
@@ -129,23 +151,8 @@ class FedSimAPI:
             algo_outs: List[Tuple[int, float, Dict[str, Any]]] = []
             with mlops.span("train", round_idx):
                 for cid in client_ids:
-                    self.trainer.set_id(cid)
-                    self.trainer.update_dataset(
-                        self.train_data_local_dict[cid],
-                        self.test_data_local_dict[cid],
-                        self.local_num_dict[cid])
-                    self.trainer.set_model_params(self.global_vars)
-                    self.trainer.algo_state = self._algo_state_for(cid)
-                    self.trainer.on_before_local_training(
-                        self.trainer.local_train_dataset, self.device,
-                        self.args)
-                    self.trainer.train(self.trainer.local_train_dataset,
-                                       self.device, self.args)
-                    self.trainer.on_after_local_training(
-                        self.trainer.local_train_dataset, self.device,
-                        self.args)
-                    n_k = float(self.local_num_dict[cid])
-                    results.append((n_k, self.trainer.get_model_params()))
+                    n_k, params = self._local_train(cid)
+                    results.append((n_k, params))
                     algo_outs.append((cid, n_k, self.trainer.algo_out))
 
             # publish round context BEFORE aggregation so history-aware
