@@ -35,6 +35,27 @@ def env() -> None:
 
 
 @cli.command()
+@click.option("--cf", "config", default=None, type=click.Path(exists=True),
+              help="fedml_config.yaml to diagnose against")
+@click.option("--check", "checks", multiple=True,
+              help="subset: broker/object_store/grpc_port/accelerator")
+def diagnosis(config, checks) -> None:
+    """Connectivity checks against the node's config (reference
+    `fedml diagnosis`)."""
+    from ..scheduler.diagnosis import diagnose
+
+    args = None
+    if config:
+        from ..arguments import Config
+
+        args = Config.from_yaml(config)
+    report = diagnose(args, checks=list(checks) or None)
+    click.echo(json.dumps(report, indent=2))
+    if not report["all_ok"]:
+        raise SystemExit(1)
+
+
+@cli.command()
 @click.option("--cf", "config", required=True, type=click.Path(exists=True),
               help="fedml_config.yaml")
 @click.option("--rank", default=0)

@@ -1,0 +1,123 @@
+"""Decentralized-storage drivers: Web3(IPFS)-style and ThetaStore-style.
+
+Capability parity: reference `core/distributed/communication/
+distributed_storage/{web3_storage,theta_storage}/` — the MQTT_WEB3 and
+MQTT_THETASTORE transports ship bulk model payloads to a decentralized
+store and pass a content id (CID) over the broker.
+
+Both drivers here are CONTENT-ADDRESSED (`key = sha256(payload)`), matching
+web3 semantics: writes are idempotent, reads verify integrity.  The real
+service clients (w3up / theta SDKs) are not in this image, so each driver
+uses a shared local CAS directory unless a gateway client object is
+injected — the transport, addressing, and verification logic is identical
+either way.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+from typing import Any, Optional
+
+from .mqtt_s3.remote_storage import ObjectStore
+
+
+class ContentAddressedStore(ObjectStore):
+    """CAS base: keys returned by write() are digests of the content."""
+
+    def __init__(self, root: Optional[str] = None,
+                 namespace: str = "cas") -> None:
+        self.root = root or os.path.join(
+            os.path.expanduser("~"), ".fedml_tpu", namespace)
+        os.makedirs(self.root, exist_ok=True)
+
+    @staticmethod
+    def cid_of(data: bytes) -> str:
+        return "bafy" + hashlib.sha256(data).hexdigest()  # CIDv1-flavored
+
+    def _path(self, cid: str) -> str:
+        return os.path.join(self.root, cid.replace("/", "_"))
+
+    def write(self, key: str, data: bytes) -> None:
+        """Stores under the content cid; if ``key`` is a distinct name it is
+        ALSO readable under that alias, so the plain ObjectStore
+        write(key)/read(key) contract keeps working for callers that pick
+        their own keys (agents, model cards)."""
+        cid = self.cid_of(data)
+        for name in {cid, key} - {""}:
+            tmp = self._path(name) + f".tmp{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, self._path(name))
+
+    def read(self, key: str, timeout: float = 60.0) -> bytes:
+        path = self._path(key)
+        deadline = time.time() + timeout
+        while not os.path.exists(path):
+            if time.time() > deadline:
+                raise FileNotFoundError(key)
+            time.sleep(0.02)
+        with open(path, "rb") as f:
+            data = f.read()
+        # integrity check applies to content-addressed names only
+        if key.startswith("bafy") and self.cid_of(data) != key:
+            raise IOError(f"content hash mismatch for {key}")
+        return data
+
+    # CAS override: the returned key IS the cid, not the hint
+    def put_blob(self, hint_key: str, data: bytes) -> str:
+        cid = self.cid_of(data)
+        self.write("", data)
+        return cid
+
+
+class Web3Store(ContentAddressedStore):
+    """web3.storage-style driver (reference `web3_storage/web3_storage.py`).
+    Pass ``client`` with upload(bytes)->cid / download(cid)->bytes to hit a
+    real gateway; otherwise the local CAS directory is used."""
+
+    def __init__(self, token: str = "", client: Any = None,
+                 root: Optional[str] = None) -> None:
+        super().__init__(root, namespace="web3_storage")
+        self.token = token
+        self.client = client
+
+    def write(self, key: str, data: bytes) -> None:
+        if self.client is not None:
+            self.client.upload(data)
+            return
+        super().write(key, data)
+
+    def read(self, key: str, timeout: float = 60.0) -> bytes:
+        if self.client is not None:
+            data = self.client.download(key)
+            if self.cid_of(data) != key:
+                raise IOError(f"content hash mismatch for {key}")
+            return data
+        return super().read(key, timeout)
+
+
+class ThetaStore(ContentAddressedStore):
+    """Theta EdgeStore-style driver (reference `theta_storage/`).  Same
+    contract as Web3Store with a different namespace/gateway."""
+
+    def __init__(self, access_token: str = "", client: Any = None,
+                 root: Optional[str] = None) -> None:
+        super().__init__(root, namespace="theta_storage")
+        self.access_token = access_token
+        self.client = client
+
+    def write(self, key: str, data: bytes) -> None:
+        if self.client is not None:
+            self.client.put(data)
+            return
+        super().write(key, data)
+
+    def read(self, key: str, timeout: float = 60.0) -> bytes:
+        if self.client is not None:
+            data = self.client.get(key)
+            if self.cid_of(data) != key:
+                raise IOError(f"content hash mismatch for {key}")
+            return data
+        return super().read(key, timeout)

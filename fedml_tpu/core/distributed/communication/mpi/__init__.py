@@ -1,0 +1,1 @@
+from .mpi_comm_manager import MpiCommManager  # noqa: F401

@@ -24,13 +24,18 @@ class ObjectStore(abc.ABC):
     def write(self, key: str, data: bytes) -> None: ...
 
     @abc.abstractmethod
-    def read(self, key: str) -> bytes: ...
+    def read(self, key: str, timeout: float = 60.0) -> bytes: ...
+
+    def put_blob(self, hint_key: str, data: bytes) -> str:
+        """Store ``data`` and return its retrieval key.  Key-addressed
+        stores use ``hint_key``; content-addressed stores return the cid."""
+        self.write(hint_key, data)
+        return hint_key
 
     # -- model-level API (reference write_model/read_model) -----------------
     def write_model(self, run_id: str, sender_id: int, model: Any) -> str:
         key = f"fedml_{run_id}_{sender_id}_{uuid.uuid4().hex[:12]}"
-        self.write(key, dumps_pytree(model))
-        return key
+        return self.put_blob(key, dumps_pytree(model))
 
     def read_model(self, key: str) -> Any:
         return loads_pytree(self.read(key))
@@ -80,15 +85,61 @@ class S3Store(ObjectStore):
         self.client.put_object(Bucket=self.bucket, Key=self.prefix + key,
                                Body=data)
 
-    def read(self, key: str) -> bytes:
+    def read(self, key: str, timeout: float = 60.0) -> bytes:
         obj = self.client.get_object(Bucket=self.bucket,
                                      Key=self.prefix + key)
         return obj["Body"].read()
 
 
-def create_store(args: Any) -> ObjectStore:
-    kind = str(getattr(args, "object_store", "local") or "local").lower()
+class EncryptedStore(ObjectStore):
+    """AES-GCM wrapper around any store (reference `crypto/` AES payload
+    encryption): ciphertext at rest, transparent to callers."""
+
+    def __init__(self, inner: ObjectStore, passphrase: str) -> None:
+        self.inner = inner
+        self.passphrase = passphrase
+
+    def write(self, key: str, data: bytes) -> None:
+        from ...crypto import aes_encrypt
+
+        self.inner.write(key, aes_encrypt(data, self.passphrase))
+
+    def put_blob(self, hint_key: str, data: bytes) -> str:
+        from ...crypto import aes_encrypt
+
+        return self.inner.put_blob(hint_key, aes_encrypt(data,
+                                                         self.passphrase))
+
+    def read(self, key: str, timeout: float = 60.0) -> bytes:
+        from ...crypto import aes_decrypt
+
+        return aes_decrypt(self.inner.read(key, timeout=timeout),
+                           self.passphrase)
+
+
+def create_store(args: Any, kind: Optional[str] = None) -> ObjectStore:
+    """``kind`` overrides args.object_store (used by the MQTT_WEB3 /
+    MQTT_THETASTORE backends so they never mutate caller-owned config)."""
+    kind = (kind or str(getattr(args, "object_store", "local")
+                        or "local")).lower()
     if kind == "s3":
-        return S3Store(bucket=str(getattr(args, "s3_bucket", "fedml")),
-                       prefix=str(getattr(args, "s3_prefix", "fedml-tpu/")))
-    return LocalFSStore(getattr(args, "object_store_dir", None))
+        store: ObjectStore = S3Store(
+            bucket=str(getattr(args, "s3_bucket", "fedml")),
+            prefix=str(getattr(args, "s3_prefix", "fedml-tpu/")))
+    elif kind in ("web3", "web3_storage", "ipfs"):
+        from ..distributed_storage import Web3Store
+
+        store = Web3Store(token=str(getattr(args, "web3_token", "") or ""),
+                          root=getattr(args, "object_store_dir", None))
+    elif kind in ("thetastore", "theta"):
+        from ..distributed_storage import ThetaStore
+
+        store = ThetaStore(
+            access_token=str(getattr(args, "theta_token", "") or ""),
+            root=getattr(args, "object_store_dir", None))
+    else:
+        store = LocalFSStore(getattr(args, "object_store_dir", None))
+    passphrase = getattr(args, "payload_aes_passphrase", None)
+    if passphrase:
+        store = EncryptedStore(store, str(passphrase))
+    return store

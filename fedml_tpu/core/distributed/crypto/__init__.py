@@ -1,0 +1,1 @@
+from .aes import aes_decrypt, aes_encrypt, derive_key  # noqa: F401

@@ -1,0 +1,61 @@
+"""AES payload encryption utilities.
+
+Capability parity: reference `core/distributed/crypto/` (AES helpers used to
+encrypt model payloads in transit).  Modernized: AES-256-GCM (authenticated)
+via the `cryptography` package instead of the reference's ECB/CBC helpers,
+with scrypt key derivation from a passphrase.  Wire format:
+``salt(16) | nonce(12) | ciphertext+tag``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Tuple
+
+_SALT_LEN = 16
+_NONCE_LEN = 12
+
+# scrypt is deliberately slow (~100 ms); cache derived keys so the per-round
+# model-transfer hot path pays the KDF once per (passphrase, salt), and
+# encrypt reuses one process-lifetime salt (GCM safety needs only the
+# per-message random nonce, safe for < 2^32 messages per key)
+_KEY_CACHE: Dict[Tuple[str, bytes], bytes] = {}
+_ENC_SALT: Dict[str, bytes] = {}
+_LOCK = threading.Lock()
+
+
+def derive_key(passphrase: str, salt: bytes) -> bytes:
+    with _LOCK:
+        key = _KEY_CACHE.get((passphrase, salt))
+    if key is None:
+        from cryptography.hazmat.primitives.kdf.scrypt import Scrypt
+
+        kdf = Scrypt(salt=salt, length=32, n=2 ** 14, r=8, p=1)
+        key = kdf.derive(passphrase.encode("utf-8"))
+        with _LOCK:
+            if len(_KEY_CACHE) > 256:
+                _KEY_CACHE.clear()
+            _KEY_CACHE[(passphrase, salt)] = key
+    return key
+
+
+def aes_encrypt(data: bytes, passphrase: str) -> bytes:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+    with _LOCK:
+        salt = _ENC_SALT.get(passphrase)
+        if salt is None:
+            salt = _ENC_SALT[passphrase] = os.urandom(_SALT_LEN)
+    nonce = os.urandom(_NONCE_LEN)
+    key = derive_key(passphrase, salt)
+    ct = AESGCM(key).encrypt(nonce, data, None)
+    return salt + nonce + ct
+
+
+def aes_decrypt(blob: bytes, passphrase: str) -> bytes:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+    salt, nonce = blob[:_SALT_LEN], blob[_SALT_LEN:_SALT_LEN + _NONCE_LEN]
+    key = derive_key(passphrase, salt)
+    return AESGCM(key).decrypt(nonce, blob[_SALT_LEN + _NONCE_LEN:], None)

@@ -6,9 +6,11 @@ send_message, finish(), backend factory with a custom-backend registration
 hook (:203-207).
 
 Backends in the TPU build: INPROC (new, for tests and single-host protocol
-runs), GRPC, MQTT_S3 (control/bulk split).  MPI/TRPC have no TPU-era role:
-collective traffic goes through jax/XLA (ICI/DCN), and point-to-point control
-traffic goes through gRPC — documented deviation.
+runs), GRPC, MQTT_S3 / MQTT_WEB3 / MQTT_THETASTORE (control/bulk split with
+pluggable object stores), MPI (gated on mpi4py, for CPU-cluster simulation
+parity).  TRPC has no TPU-era role: collective traffic goes through jax/XLA
+(ICI/DCN), and point-to-point control traffic goes through gRPC —
+documented deviation.
 """
 
 from __future__ import annotations
@@ -100,13 +102,27 @@ class FedMLCommManager(Observer):
                     "GRPC comm backend not available in this build") from e
             self.com_manager = GRPCCommManager(
                 args=self.args, rank=self.rank, size=self.size)
-        elif backend == "MQTT_S3":
+        elif backend in ("MQTT_S3", "MQTT_S3_MNN", "MQTT_WEB3",
+                         "MQTT_THETASTORE"):
             try:
                 from .communication.mqtt_s3 import MqttS3CommManager
             except ImportError as e:
                 raise NotImplementedError(
                     "MQTT_S3 comm backend not available in this build") from e
+            # the web3/thetastore variants are the same broker transport
+            # with a decentralized content-addressed payload store
+            # (reference mqtt_web3/ and mqtt_thetastore/); the store kind is
+            # passed explicitly so caller-owned config is never mutated
+            from .communication.mqtt_s3.remote_storage import create_store
+
+            kind = {"MQTT_WEB3": "web3",
+                    "MQTT_THETASTORE": "thetastore"}.get(backend)
+            store = create_store(self.args, kind=kind) if kind else None
             self.com_manager = MqttS3CommManager(
+                args=self.args, rank=self.rank, size=self.size, store=store)
+        elif backend == "MPI":
+            from .communication.mpi import MpiCommManager
+            self.com_manager = MpiCommManager(
                 args=self.args, rank=self.rank, size=self.size)
         else:
             raise ValueError(

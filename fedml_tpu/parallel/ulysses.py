@@ -1,0 +1,66 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+NEW capability (absent in the reference — SURVEY §2.14/§5 lists sequence
+parallelism as absent; "Ulysses-style head-sharding as an alternative" to
+ring attention).
+
+Design (DeepSpeed-Ulysses, Jacobs et al. 2023, re-done with XLA
+collectives): activations arrive sharded over the ``seq`` mesh axis
+([B, H, T/P, D] per device).  One ``all_to_all`` re-shards them to
+head-sharded layout ([B, H/P, T, D]) so every device computes EXACT full-
+sequence attention for its head group — no online-softmax recurrence, one
+big MXU-friendly attention per device — then a second ``all_to_all``
+restores sequence sharding.  Communication volume is 2 transposes of the
+activations over ICI vs the ring's P K/V rotations; Ulysses wins when
+head count ≥ mesh size and sequence blocks are long.
+
+Requires num_heads % axis_size == 0.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..constants import AXIS_SEQ
+from .ring_attention import reference_attention
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      axis_name: str = AXIS_SEQ,
+                      causal: bool = True) -> jnp.ndarray:
+    """Inside shard_map: q/k/v are LOCAL sequence blocks [B, H, T_local, D].
+    Returns the local sequence block of the exact attention output."""
+    axis_size = jax.lax.psum(1, axis_name)
+
+    def seq_to_heads(x):
+        # [B, H, T_loc, D] → [B, H/P, T_loc·P = T, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    def heads_to_seq(x):
+        # [B, H/P, T, D] → [B, H, T_loc, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = reference_attention(qh, kh, vh, causal=causal)
+    del axis_size
+    return heads_to_seq(out)
+
+
+def make_ulysses_attention_fn(mesh: Mesh, axis_name: str = AXIS_SEQ,
+                              causal: bool = True):
+    """shard_map-wrapped callable on GLOBAL [B, H, T, D] arrays with T
+    sharded over ``axis_name``.  H must divide evenly by the axis size."""
+    spec = P(None, None, axis_name, None)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+             out_specs=spec, check_vma=False)
+    def fn(q, k, v):
+        return ulysses_attention(q, k, v, axis_name=axis_name, causal=causal)
+
+    return fn

@@ -212,3 +212,34 @@ def test_openai_compatible_api():
         assert '"chat.completion.chunk"' in raw
     finally:
         srv.stop()
+
+
+def test_diagnosis_report(args_factory, tmp_path):
+    from fedml_tpu.scheduler.diagnosis import diagnose
+
+    args = args_factory(object_store_dir=str(tmp_path))
+    report = diagnose(args)
+    assert report["all_ok"], report
+    assert set(report) >= {"broker", "object_store", "grpc_port",
+                           "accelerator"}
+    assert "inproc" in report["broker"]["detail"]
+
+
+def test_diagnosis_cli(tmp_path):
+    from click.testing import CliRunner
+
+    from fedml_tpu.cli.cli import cli
+
+    res = CliRunner().invoke(cli, ["diagnosis", "--check", "grpc_port",
+                                   "--check", "accelerator"])
+    assert res.exit_code == 0, res.output
+    assert '"all_ok": true' in res.output
+
+
+def test_diagnosis_unknown_check_rejected():
+    import pytest as _pytest
+
+    from fedml_tpu.scheduler.diagnosis import diagnose
+
+    with _pytest.raises(ValueError, match="unknown checks"):
+        diagnose(checks=["brokr"])
