@@ -70,6 +70,20 @@ def init(args: Optional[Config] = None, argv: Optional[list] = None,
         format="[fedml_tpu %(levelname)s %(asctime)s] %(message)s")
 
     mlops.init(args)
+    if getattr(args, "enable_sys_perf_monitoring", False):
+        # device-scoped sampler (reference MLOpsDevicePerfStats, started
+        # from the reference's init profiling toggles __init__.py:239-281).
+        # Process-wide singleton: re-init stops the previous daemon instead
+        # of leaking one sampler thread per init() call.
+        from .core.mlops import perf_stats
+
+        old = getattr(perf_stats, "_device_daemon", None)
+        if old is not None:
+            old.stop()
+        interval = float(getattr(args, "sys_perf_interval_s", 10.0) or 10.0)
+        perf_stats._device_daemon = perf_stats.MLOpsDevicePerfStats(
+            interval).start()
+        args._device_perf_daemon = perf_stats._device_daemon
     FedMLAttacker.get_instance().init(args)
     FedMLDefender.get_instance().init(args)
     FedMLDifferentialPrivacy.get_instance().init(args)

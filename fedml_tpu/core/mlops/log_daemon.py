@@ -1,0 +1,123 @@
+"""Runtime log upload daemon — ships run-scoped log chunks to a backend.
+
+Capability parity: reference `core/mlops/mlops_runtime_log_daemon.py:18-426`
+(a daemon thread tails the run's log file and uploads line chunks to the
+MLOps backend, tracking an upload cursor so restarts resume where they
+left off).
+
+TPU-era: the uploader is a pluggable callable ``(run_id, lines) -> None``
+(default: append to a consolidated `<dir>/uploaded/<run_id>.log`, which is
+also what the local control plane's `fedml logs` reads); cursor state is
+persisted next to the source file so re-runs don't re-ship chunks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+Uploader = Callable[[str, List[str]], None]
+
+
+def _default_uploader_for(root: str) -> Uploader:
+    updir = os.path.join(root, "uploaded")
+    os.makedirs(updir, exist_ok=True)
+
+    def upload(run_id: str, lines: List[str]) -> None:
+        with open(os.path.join(updir, f"{run_id}.log"), "a") as f:
+            f.writelines(line if line.endswith("\n") else line + "\n"
+                         for line in lines)
+
+    return upload
+
+
+class MLOpsRuntimeLogDaemon:
+    """Tails ``source_path`` and ships chunks of ≤ ``chunk_lines`` lines."""
+
+    def __init__(self, run_id: str, source_path: str,
+                 uploader: Optional[Uploader] = None,
+                 interval_s: float = 2.0, chunk_lines: int = 500) -> None:
+        self.run_id = str(run_id)
+        self.source_path = source_path
+        self.uploader = uploader or _default_uploader_for(
+            os.path.dirname(source_path) or ".")
+        self.interval_s = float(interval_s)
+        self.chunk_lines = int(chunk_lines)
+        self.cursor_path = source_path + ".cursor"
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.shipped_lines = 0
+
+    # -- cursor persistence (resume-after-restart) --------------------------
+    def _load_cursor(self) -> int:
+        try:
+            with open(self.cursor_path) as f:
+                return int(json.load(f)["offset"])
+        except Exception:
+            return 0
+
+    def _save_cursor(self, offset: int) -> None:
+        tmp = self.cursor_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"offset": offset, "run_id": self.run_id}, f)
+        os.replace(tmp, self.cursor_path)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "MLOpsRuntimeLogDaemon":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"logship-{self.run_id}")
+            self._thread.start()
+        return self
+
+    def stop(self, flush: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval_s + 2.0)
+            self._thread = None
+        if flush:
+            self.ship_once()
+
+    def ship_once(self) -> int:
+        """One tail-and-upload pass; returns lines shipped.
+
+        The file is read in BINARY mode so the persisted cursor is an exact
+        byte offset — text-mode tell()/seek() arithmetic breaks when invalid
+        UTF-8 bytes decode to multi-byte replacement chars.  Decoding (with
+        errors="replace") happens only on the complete lines being shipped.
+        """
+        offset = self._load_cursor()
+        if not os.path.exists(self.source_path):
+            return 0
+        shipped = 0
+        with open(self.source_path, "rb") as f:
+            f.seek(offset)
+            while True:
+                raw = f.readlines(self.chunk_lines * 200)
+                if not raw:
+                    break
+                # hold back a trailing partial line until it is complete
+                if raw and not raw[-1].endswith(b"\n"):
+                    last = raw.pop()
+                    if not raw:
+                        break
+                    f.seek(-len(last), os.SEEK_CUR)
+                lines = [b.decode("utf-8", errors="replace") for b in raw]
+                for i in range(0, len(lines), self.chunk_lines):
+                    self.uploader(self.run_id,
+                                  lines[i:i + self.chunk_lines])
+                    shipped += min(self.chunk_lines, len(lines) - i)
+                self._save_cursor(f.tell())
+        self.shipped_lines += shipped
+        return shipped
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.ship_once()
+            except Exception:  # noqa: BLE001 — the daemon must not die
+                time.sleep(self.interval_s)

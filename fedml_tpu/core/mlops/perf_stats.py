@@ -1,0 +1,125 @@
+"""System/job performance sampling daemons.
+
+Capability parity: reference `core/mlops/mlops_device_perfs.py:243` /
+`mlops_job_perfs.py:183` / `system_stats.py:138` — background threads
+sampling CPU/GPU/memory/disk/network via psutil (+gputil) and reporting to
+the MLOps backend over MQTT.
+
+TPU-era: accelerator stats come from `jax.local_devices()` memory_stats()
+(HBM bytes in use/limit) instead of gputil; records flow through the local
+mlops sink pipeline (`_emit("sysperf", ...)`) so any registered remote sink
+ships them on.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+def system_snapshot() -> Dict[str, Any]:
+    """One sample of host + accelerator utilization (reference
+    `system_stats.py` SysStats)."""
+    snap: Dict[str, Any] = {"pid": os.getpid()}
+    try:
+        import psutil
+
+        vm = psutil.virtual_memory()
+        snap.update(
+            cpu_percent=psutil.cpu_percent(interval=None),
+            mem_used_gb=round(vm.used / 2 ** 30, 3),
+            mem_total_gb=round(vm.total / 2 ** 30, 3),
+            mem_percent=vm.percent,
+        )
+        try:
+            io = psutil.net_io_counters()
+            snap.update(net_sent_mb=round(io.bytes_sent / 2 ** 20, 2),
+                        net_recv_mb=round(io.bytes_recv / 2 ** 20, 2))
+        except Exception:
+            pass
+        proc = psutil.Process()
+        snap.update(proc_rss_gb=round(proc.memory_info().rss / 2 ** 30, 3),
+                    proc_cpu_percent=proc.cpu_percent(interval=None))
+    except Exception as e:  # noqa: BLE001
+        snap["psutil_error"] = str(e)
+    try:
+        import jax
+
+        devs = []
+        for d in jax.local_devices():
+            info: Dict[str, Any] = {"id": d.id, "kind": d.device_kind}
+            try:
+                ms = d.memory_stats() or {}
+                if "bytes_in_use" in ms:
+                    info["hbm_used_gb"] = round(
+                        ms["bytes_in_use"] / 2 ** 30, 3)
+                if "bytes_limit" in ms:
+                    info["hbm_limit_gb"] = round(
+                        ms["bytes_limit"] / 2 ** 30, 3)
+            except Exception:
+                pass
+            devs.append(info)
+        snap["devices"] = devs
+    except Exception as e:  # noqa: BLE001
+        snap["jax_error"] = str(e)
+    return snap
+
+
+class PerfStatsDaemon:
+    """Background sampler → mlops "sysperf" records (reference
+    MLOpsDevicePerfStats.report_device_realtime_stats loop)."""
+
+    def __init__(self, interval_s: float = 10.0, role: str = "device",
+                 run_id: Any = None) -> None:
+        self.interval_s = float(interval_s)
+        self.role = role
+        self.run_id = run_id
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.samples: List[Dict[str, Any]] = []
+
+    def start(self) -> "PerfStatsDaemon":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"perfstats-{self.role}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval_s + 1.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        from . import _emit
+
+        while True:
+            # sample FIRST so even sub-interval jobs record at least one
+            snap = system_snapshot()
+            snap["role"] = self.role
+            if self.run_id is not None:
+                snap["job_run_id"] = self.run_id
+            self.samples.append(snap)
+            del self.samples[:-100]  # bounded history
+            _emit("sysperf", snap)  # no-op unless mlops tracking is on;
+            # self.samples keeps the data available either way
+            if self._stop.wait(self.interval_s):
+                return
+
+
+class MLOpsDevicePerfStats(PerfStatsDaemon):
+    """Device-scoped sampler (reference `mlops_device_perfs.py`)."""
+
+    def __init__(self, interval_s: float = 10.0) -> None:
+        super().__init__(interval_s, role="device")
+
+
+class MLOpsJobPerfStats(PerfStatsDaemon):
+    """Job-scoped sampler (reference `mlops_job_perfs.py`)."""
+
+    def __init__(self, run_id: Any, interval_s: float = 10.0) -> None:
+        super().__init__(interval_s, role="job", run_id=run_id)

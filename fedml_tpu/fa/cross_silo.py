@@ -1,0 +1,139 @@
+"""Federated-analytics cross-silo runtime — FA over the message plane.
+
+Capability parity: reference `fa/cross_silo/` (Client/Server managers
+mirroring the FL cross-silo protocol, driving FAClientAnalyzer /
+FAServerAggregator instead of trainers).  Runs over any comm backend
+(INPROC for tests, GRPC/MQTT_* across hosts).
+
+Protocol: server sends FA_INIT (task + params) → each client runs
+``local_analyze`` on its data and replies FA_SUBMIT → server aggregates;
+for iterative tasks (TrieHH) the server broadcasts FA_NEXT_ROUND with the
+surviving prefixes until done, then FA_FINISH.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..core.distributed.communication.message import Message
+from ..core.distributed.fedml_comm_manager import FedMLCommManager
+from .fa_frame import FA_TASKS
+
+MSG_FA_INIT = "FA_INIT"
+MSG_FA_SUBMIT = "FA_SUBMIT"
+MSG_FA_NEXT_ROUND = "FA_NEXT_ROUND"
+MSG_FA_FINISH = "FA_FINISH"
+
+
+class FAServerManager(FedMLCommManager):
+    """Rank 0; aggregates client submissions per round."""
+
+    def __init__(self, args: Any, comm=None, rank: int = 0, size: int = 0,
+                 backend: str = "INPROC") -> None:
+        self.task = str(getattr(args, "fa_task", "avg")).lower()
+        if self.task not in FA_TASKS:
+            raise ValueError(f"unknown FA task {self.task!r}; known: "
+                             f"{sorted(FA_TASKS)}")
+        _, g_cls = FA_TASKS[self.task]
+        self.aggregator = g_cls(args)
+        self.n_clients = int(size) - 1
+        self.result: Any = None
+        self.done = threading.Event()
+        self._subs: Dict[int, Any] = {}
+        self._round = 0
+        self._prefixes: List[str] = [""]
+        self.max_rounds = int(getattr(args, "comm_round", 5) or 5)
+        super().__init__(args, comm, rank, size, backend)
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(MSG_FA_SUBMIT, self._on_submit)
+
+    def run(self) -> None:
+        self.register_message_receive_handlers()
+        self._broadcast_round()
+        self.com_manager.handle_receive_message()
+
+    def _broadcast_round(self) -> None:
+        mtype = MSG_FA_INIT if self._round == 0 else MSG_FA_NEXT_ROUND
+        for rank in range(1, self.n_clients + 1):
+            msg = Message(mtype, 0, rank)
+            msg.add_params("fa_task", self.task)
+            msg.add_params("round", self._round)
+            if self.task == "heavy_hitter_triehh":
+                msg.add_params("prefixes", list(self._prefixes))
+                msg.add_params("prefix_len", self._round + 1)
+            self.send_message(msg)
+
+    def _on_submit(self, msg: Message) -> None:
+        self._subs[msg.get_sender_id()] = msg.get("submission")
+        if len(self._subs) < self.n_clients:
+            return
+        subs = [self._subs[r] for r in sorted(self._subs)]
+        self._subs.clear()
+        out = self.aggregator.aggregate(subs)
+        self._round += 1
+        iterative = (self.task == "heavy_hitter_triehh"
+                     and self._round < self.max_rounds and out)
+        if iterative:
+            self._prefixes = out
+            self._broadcast_round()
+            return
+        self.result = out if self.task != "heavy_hitter_triehh" \
+            else (out or self._prefixes)
+        logging.info("FA server: %s result %s", self.task, self.result)
+        for rank in range(1, self.n_clients + 1):
+            self.send_message(Message(MSG_FA_FINISH, 0, rank))
+        self.done.set()
+        self.finish()
+
+
+class FAClientManager(FedMLCommManager):
+    """Rank ≥ 1; runs the local analyzer on demand."""
+
+    def __init__(self, args: Any, local_data: Sequence, comm=None,
+                 rank: int = 1, size: int = 0,
+                 backend: str = "INPROC") -> None:
+        task = str(getattr(args, "fa_task", "avg")).lower()
+        a_cls, _ = FA_TASKS[task]
+        self.analyzer = a_cls(args)
+        self.local_data = local_data
+        super().__init__(args, comm, rank, size, backend)
+        self.analyzer.set_id(rank)
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(MSG_FA_INIT, self._on_round)
+        self.register_message_receive_handler(MSG_FA_NEXT_ROUND,
+                                              self._on_round)
+        self.register_message_receive_handler(MSG_FA_FINISH, self._on_finish)
+
+    def _on_round(self, msg: Message) -> None:
+        prefixes = msg.get("prefixes")
+        if prefixes is not None:  # TrieHH round state
+            self.analyzer.cur_prefixes = list(prefixes)
+            self.analyzer.prefix_len = int(msg.get("prefix_len", 1))
+        self.analyzer.local_analyze(self.local_data, self.args)
+        reply = Message(MSG_FA_SUBMIT, self.rank, 0)
+        reply.add_params("submission", self.analyzer.get_client_submission())
+        self.send_message(reply)
+
+    def _on_finish(self, msg: Message) -> None:
+        self.finish()
+
+
+def run_cross_silo_fa(args: Any, client_datasets: Dict[int, Sequence],
+                      backend: str = "INPROC") -> Any:
+    """Convenience driver: server + one client manager per dataset on
+    threads (reference fa/cross_silo entry)."""
+    n = len(client_datasets)
+    server = FAServerManager(args, rank=0, size=n + 1, backend=backend)
+    clients = [FAClientManager(args, data, rank=rank, size=n + 1,
+                               backend=backend)
+               for rank, (_, data) in enumerate(
+                   sorted(client_datasets.items()), start=1)]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    server.run()
+    return server.result

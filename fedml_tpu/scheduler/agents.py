@@ -91,6 +91,10 @@ def _topic_active(edge_id: str) -> str:
     return f"flclient_agent/{edge_id}/active"
 
 
+def _topic_upgrade(edge_id: str) -> str:
+    return f"flserver_agent/{edge_id}/upgrade"
+
+
 class SlaveAgent:
     """The edge daemon (`FedMLClientRunner` analog)."""
 
@@ -108,11 +112,29 @@ class SlaveAgent:
         self.agent_dir = os.path.join(os.path.expanduser("~"), ".fedml_tpu",
                                       "agent", self.edge_id)
         os.makedirs(self.agent_dir, exist_ok=True)
+        # OTA state (reference client_runner.py:852 OTA upgrade + :1436
+        # message replay after upgrade); _ota_lock serializes the
+        # buffered-vs-replay decision against concurrent _on_start calls
+        self._ota_lock = threading.Lock()
+        self._upgrading = False
+        self._replay_buffer: List[bytes] = []
+        self.version = self._load_version()
+
+    def _version_path(self) -> str:
+        return os.path.join(self.agent_dir, "version.json")
+
+    def _load_version(self) -> str:
+        try:
+            with open(self._version_path()) as f:
+                return str(json.load(f)["version"])
+        except Exception:
+            return "0.1.0"
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "SlaveAgent":
         self.broker.subscribe(_topic_start(self.edge_id), self._on_start)
         self.broker.subscribe(_topic_stop(self.edge_id), self._on_stop)
+        self.broker.subscribe(_topic_upgrade(self.edge_id), self._on_upgrade)
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
                                            daemon=True,
                                            name=f"agent-hb-{self.edge_id}")
@@ -129,6 +151,8 @@ class SlaveAgent:
         # restarted one doesn't double-execute
         self.broker.unsubscribe(_topic_start(self.edge_id), self._on_start)
         self.broker.unsubscribe(_topic_stop(self.edge_id), self._on_stop)
+        self.broker.unsubscribe(_topic_upgrade(self.edge_id),
+                                self._on_upgrade)
         self._send_active("OFFLINE")
 
     def _heartbeat_loop(self) -> None:
@@ -144,11 +168,44 @@ class SlaveAgent:
 
     # -- start_train ---------------------------------------------------------
     def _on_start(self, topic: str, payload: bytes) -> None:
+        with self._ota_lock:
+            if self._upgrading:
+                # buffered for replay once the upgrade completes (reference
+                # message replay after OTA, client_runner.py:1436)
+                self._replay_buffer.append(payload)
+                return
         req = json.loads(payload.decode())
         run_id = str(req["run_id"])
         t = threading.Thread(target=self._run_job, args=(run_id, req),
                              daemon=True, name=f"agent-run-{run_id}")
         t.start()
+
+    # -- OTA upgrade (reference client_runner.py:852) ------------------------
+    def _on_upgrade(self, topic: str, payload: bytes) -> None:
+        req = json.loads(payload.decode())
+        target = str(req.get("version", ""))
+        if not target or target == self.version:
+            return
+        with self._ota_lock:
+            self._upgrading = True
+        self._send_active("UPGRADING")
+        try:
+            # the upgrade itself: persist the new version (a real deployment
+            # re-execs the agent binary here; the protocol — pause, upgrade,
+            # replay — is what downstream components depend on)
+            tmp = self._version_path() + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"version": target, "upgraded_ts": time.time()}, f)
+            os.replace(tmp, self._version_path())
+            self.version = target
+            logging.info("agent %s upgraded to %s", self.edge_id, target)
+        finally:
+            with self._ota_lock:
+                self._upgrading = False
+                buffered, self._replay_buffer = self._replay_buffer, []
+            self._send_active("ONLINE")
+            for msg in buffered:
+                self._on_start(_topic_start(self.edge_id), msg)
 
     def _report(self, run_id: str, status: str, **extra: Any) -> None:
         body = {"run_id": run_id, "edge_id": self.edge_id, "status": status,
@@ -178,39 +235,81 @@ class SlaveAgent:
         env["FEDML_CURRENT_RUN_ID"] = run_id
         env["FEDML_EDGE_ID"] = self.edge_id
 
+        # claim accelerator slots before spawning (reference
+        # compute_gpu_cache allocation in the slave runner)
+        from .resource_db import ComputeResourceDB
+
+        resources = ComputeResourceDB(root=self.agent_dir)
+        n_slots = int((cfg.get("computing") or {}).get("device_count", 1)
+                      or 1)
+        slots = resources.allocate(run_id, n_slots)
+        if not slots:
+            local_launcher.update_run_status(run_id, "FAILED",
+                                             returncode=-1)
+            self._report(run_id, ClientConstants.STATUS_FAILED,
+                         error=f"not enough free device slots "
+                               f"(need {n_slots})")
+            return
+        env["FEDML_DEVICE_SLOTS"] = ",".join(map(str, slots))
+
         rc = 0
         self._report(run_id, ClientConstants.STATUS_TRAINING)
-        with open(log_path, "w") as log:
-            for label in ("bootstrap", "job"):
-                script = str(cfg.get(label, "") or "")
-                if not script.strip():
-                    continue
-                log.write(f"===== {label} =====\n")
-                log.flush()
-                wdir = os.path.join(workspace, "workspace")
-                proc = subprocess.Popen(
-                    ["bash", "-c", script],
-                    cwd=wdir if os.path.isdir(wdir) else workspace,
-                    env=env, stdout=subprocess.PIPE,
-                    stderr=subprocess.STDOUT, text=True,
-                    start_new_session=True)
-                self._procs[run_id] = proc
-                local_launcher.update_run_status(
-                    run_id, "RUNNING", pid=proc.pid)
-                for line in proc.stdout:  # live log capture
-                    log.write(line)
+        # job-scoped sys-perf sampling + log chunk shipping (reference
+        # mlops_job_perfs.py / mlops_runtime_log_daemon.py)
+        from ..core.mlops.log_daemon import MLOpsRuntimeLogDaemon
+        from ..core.mlops.perf_stats import MLOpsJobPerfStats
+
+        perf = MLOpsJobPerfStats(run_id=run_id, interval_s=10.0).start()
+        shipper = MLOpsRuntimeLogDaemon(run_id, log_path).start()
+        error: Optional[str] = None
+        try:
+            with open(log_path, "w", errors="replace") as log:
+                for label in ("bootstrap", "job"):
+                    script = str(cfg.get(label, "") or "")
+                    if not script.strip():
+                        continue
+                    log.write(f"===== {label} =====\n")
                     log.flush()
-                proc.wait()
-                rc = proc.returncode
-                if rc != 0:
-                    break
-        self._procs.pop(run_id, None)
-        killed = rc < 0
-        status = (ClientConstants.STATUS_KILLED if killed else
-                  ClientConstants.STATUS_FINISHED if rc == 0 else
-                  ClientConstants.STATUS_FAILED)
-        local_launcher.update_run_status(run_id, status, returncode=rc)
-        self._report(run_id, status, returncode=rc, log_path=log_path)
+                    wdir = os.path.join(workspace, "workspace")
+                    proc = subprocess.Popen(
+                        ["bash", "-c", script],
+                        cwd=wdir if os.path.isdir(wdir) else workspace,
+                        env=env, stdout=subprocess.PIPE,
+                        stderr=subprocess.STDOUT, text=True,
+                        errors="replace", start_new_session=True)
+                    self._procs[run_id] = proc
+                    local_launcher.update_run_status(
+                        run_id, "RUNNING", pid=proc.pid)
+                    for line in proc.stdout:  # live log capture
+                        log.write(line)
+                        log.flush()
+                    proc.wait()
+                    rc = proc.returncode
+                    if rc != 0:
+                        break
+        except Exception as e:  # noqa: BLE001
+            logging.exception("agent %s: run %s crashed", self.edge_id,
+                              run_id)
+            error, rc = str(e), rc or 1
+        finally:
+            # slots, daemons, and a terminal status must be released/
+            # reported no matter how the job died
+            self._procs.pop(run_id, None)
+            perf.stop()
+            shipper.stop(flush=True)
+            resources.release(run_id)
+            killed = rc < 0
+            status = (ClientConstants.STATUS_FAILED if error else
+                      ClientConstants.STATUS_KILLED if killed else
+                      ClientConstants.STATUS_FINISHED if rc == 0 else
+                      ClientConstants.STATUS_FAILED)
+            local_launcher.update_run_status(run_id, status, returncode=rc)
+            extra = {"returncode": rc, "log_path": log_path}
+            if error:
+                extra["error"] = error
+            if perf.samples:
+                extra["sys_perf"] = perf.samples[-1]
+            self._report(run_id, status, **extra)
 
     def _retrieve_and_unzip_package(self, run_id: str,
                                     req: Dict[str, Any]) -> str:

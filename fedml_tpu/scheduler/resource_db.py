@@ -1,0 +1,125 @@
+"""Compute-resource registry: accelerator slot allocation per run.
+
+Capability parity: reference `computing/scheduler/scheduler_core/
+compute_gpu_cache.py` / `compute_gpu_db.py` (Redis+sqlite GPU allocation the
+slave agent consults before spawning a job) — TPU-era: sqlite-only (no Redis
+in this image), tracking device slots (chips or virtual devices) and HBM
+budget per run, with stale-run reclamation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_LOCK = threading.Lock()
+
+
+def _db_path(root: Optional[str] = None) -> str:
+    root = root or os.path.join(os.path.expanduser("~"), ".fedml_tpu")
+    os.makedirs(root, exist_ok=True)
+    return os.path.join(root, "resources.db")
+
+
+class ComputeResourceDB:
+    def __init__(self, root: Optional[str] = None,
+                 total_slots: Optional[int] = None) -> None:
+        self.path = _db_path(root)
+        self.conn = sqlite3.connect(self.path, check_same_thread=False)
+        self.conn.execute("PRAGMA journal_mode=WAL")
+        with _LOCK, self.conn:
+            self.conn.execute(
+                "CREATE TABLE IF NOT EXISTS devices ("
+                "slot INTEGER PRIMARY KEY, kind TEXT, hbm_gb REAL, "
+                "run_id TEXT, allocated_ts REAL)")
+        if total_slots is not None:
+            self.register_devices(total_slots)
+        elif not self.list_devices():
+            self._register_from_jax()
+
+    def _register_from_jax(self) -> None:
+        try:
+            import jax
+
+            devs = jax.local_devices()
+            kinds = [d.device_kind for d in devs]
+            hbm = []
+            for d in devs:
+                try:
+                    ms = d.memory_stats() or {}
+                    hbm.append(round(ms.get("bytes_limit", 0) / 2 ** 30, 1))
+                except Exception:
+                    hbm.append(0.0)
+        except Exception:
+            kinds, hbm = ["cpu"], [0.0]
+        with _LOCK, self.conn:
+            for i, (k, h) in enumerate(zip(kinds, hbm)):
+                self.conn.execute(
+                    "INSERT OR IGNORE INTO devices VALUES (?,?,?,NULL,NULL)",
+                    (i, k, h))
+
+    def register_devices(self, n: int, kind: str = "slot",
+                         hbm_gb: float = 0.0) -> None:
+        with _LOCK, self.conn:
+            for i in range(n):
+                self.conn.execute(
+                    "INSERT OR IGNORE INTO devices VALUES (?,?,?,NULL,NULL)",
+                    (i, kind, hbm_gb))
+
+    def list_devices(self) -> List[Dict[str, Any]]:
+        with _LOCK:
+            rows = self.conn.execute(
+                "SELECT slot, kind, hbm_gb, run_id, allocated_ts "
+                "FROM devices ORDER BY slot").fetchall()
+        return [{"slot": r[0], "kind": r[1], "hbm_gb": r[2],
+                 "run_id": r[3], "allocated_ts": r[4]} for r in rows]
+
+    def available_slots(self) -> List[int]:
+        with _LOCK:
+            rows = self.conn.execute(
+                "SELECT slot FROM devices WHERE run_id IS NULL "
+                "ORDER BY slot").fetchall()
+        return [r[0] for r in rows]
+
+    def allocate(self, run_id: str, n_slots: int = 1) -> List[int]:
+        """Atomically claim ``n_slots`` free slots for ``run_id``.
+        Returns [] (allocating nothing) if not enough are free."""
+        with _LOCK, self.conn:
+            rows = self.conn.execute(
+                "SELECT slot FROM devices WHERE run_id IS NULL "
+                "ORDER BY slot LIMIT ?", (n_slots,)).fetchall()
+            if len(rows) < n_slots:
+                return []
+            slots = [r[0] for r in rows]
+            now = time.time()
+            self.conn.executemany(
+                "UPDATE devices SET run_id=?, allocated_ts=? WHERE slot=?",
+                [(str(run_id), now, s) for s in slots])
+        return slots
+
+    def release(self, run_id: str) -> int:
+        with _LOCK, self.conn:
+            cur = self.conn.execute(
+                "UPDATE devices SET run_id=NULL, allocated_ts=NULL "
+                "WHERE run_id=?", (str(run_id),))
+        return cur.rowcount
+
+    def reclaim_stale(self, max_age_s: float = 24 * 3600.0) -> int:
+        """Free slots whose allocation outlived ``max_age_s`` (crash
+        recovery; reference job_monitor cleanup)."""
+        cutoff = time.time() - max_age_s
+        with _LOCK, self.conn:
+            cur = self.conn.execute(
+                "UPDATE devices SET run_id=NULL, allocated_ts=NULL "
+                "WHERE run_id IS NOT NULL AND allocated_ts < ?", (cutoff,))
+        return cur.rowcount
+
+    def report(self) -> Dict[str, Any]:
+        devices = self.list_devices()
+        free = sum(1 for d in devices if d["run_id"] is None)
+        return {"total": len(devices), "free": free,
+                "in_use": len(devices) - free, "devices": devices}

@@ -1,0 +1,148 @@
+"""Batched LLM serving engine — the scalellm-equivalent runtime.
+
+Capability parity: reference `serving/scalellm/` (a prebuilt GPU serving
+runtime wrapper exposing generate/complete).  TPU-era design: continuous
+batching on top of one jit-compiled fixed-shape decode step —
+
+* requests enter a queue; a worker admits up to ``max_batch`` sequences
+  into the active set BETWEEN decode steps (new arrivals don't wait for
+  the whole previous batch to finish — continuous batching);
+* every step runs ONE forward over a fixed [max_batch, window] token
+  buffer (inactive rows are padding), so XLA compiles exactly once and
+  the MXU sees a full batch regardless of arrival pattern;
+* greedy or temperature sampling per request; finished rows retire and
+  their slots are re-admitted immediately.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class _Request:
+    def __init__(self, prompt_ids: List[int], max_new: int,
+                 temperature: float) -> None:
+        self.ids = list(prompt_ids)
+        self.remaining = int(max_new)
+        self.temperature = float(temperature)
+        self.future: "Future[np.ndarray]" = Future()
+
+
+class BatchedLLMEngine:
+    def __init__(self, bundle: Any, variables: Dict[str, Any],
+                 max_batch: int = 8, window: Optional[int] = None,
+                 max_wait_s: float = 0.005) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self.bundle = bundle
+        self.variables = variables
+        self.max_batch = int(max_batch)
+        self.window = int(window or getattr(bundle, "input_shape",
+                                            (64,))[0] or 64)
+        self.max_wait_s = float(max_wait_s)
+        self._pending: "queue.Queue[_Request]" = queue.Queue()
+        self._active: List[Optional[_Request]] = [None] * self.max_batch
+        self._stop = threading.Event()
+        self._rng = jax.random.PRNGKey(7)
+
+        def step(variables, x, pos):
+            # sequences are LEFT-aligned with zero right-padding; under
+            # causal attention logits at index pos[i]-1 are EXACTLY the
+            # unpadded next-token logits (padding can't attend backward),
+            # so no attention mask is needed
+            logits, _ = bundle.apply(variables, x, train=False)
+            idx = jnp.clip(pos - 1, 0, x.shape[1] - 1)
+            return jnp.take_along_axis(
+                logits, idx[:, None, None], axis=1)[:, 0, :]  # [B, V]
+
+        self._step = jax.jit(step)
+        self._jnp = jnp
+        self._jax = jax
+        self._worker = threading.Thread(target=self._loop, daemon=True,
+                                        name="llm-engine")
+        self._worker.start()
+
+    # -- public API ---------------------------------------------------------
+    def submit(self, prompt_ids, max_new: int = 20,
+               temperature: float = 0.0) -> "Future[np.ndarray]":
+        req = _Request(list(np.asarray(prompt_ids).tolist()), max_new,
+                       temperature)
+        if self._stop.is_set():
+            req.future.set_exception(RuntimeError("engine stopped"))
+            return req.future
+        self._pending.put(req)
+        return req.future
+
+    def generate(self, prompt_ids, max_new: int = 20,
+                 temperature: float = 0.0, timeout: float = 120.0
+                 ) -> np.ndarray:
+        return self.submit(prompt_ids, max_new, temperature).result(timeout)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._worker.join(timeout=5.0)
+
+    @property
+    def active_count(self) -> int:
+        return sum(1 for r in self._active if r is not None)
+
+    # -- worker -------------------------------------------------------------
+    def _admit(self) -> None:
+        for slot in range(self.max_batch):
+            if self._active[slot] is None:
+                try:
+                    self._active[slot] = self._pending.get_nowait()
+                except queue.Empty:
+                    return
+
+    def _loop(self) -> None:
+        jnp = self._jnp
+        while not self._stop.is_set():
+            self._admit()
+            if self.active_count == 0:
+                try:
+                    req = self._pending.get(timeout=self.max_wait_s)
+                    self._active[0] = req
+                except queue.Empty:
+                    continue
+            x = np.zeros((self.max_batch, self.window), np.int32)
+            pos = np.ones((self.max_batch,), np.int32)
+            for slot, req in enumerate(self._active):
+                if req is not None:
+                    tail = req.ids[-self.window:]
+                    x[slot, :len(tail)] = tail  # left-aligned window
+                    pos[slot] = len(tail)
+            logits = np.asarray(self._step(self.variables, jnp.asarray(x),
+                                           jnp.asarray(pos)))
+            for slot, req in enumerate(self._active):
+                if req is None:
+                    continue
+                row = logits[slot]
+                if req.temperature > 0:
+                    self._rng, k = self._jax.random.split(self._rng)
+                    nxt = int(self._jax.random.categorical(
+                        k, jnp.asarray(row) / req.temperature))
+                else:
+                    nxt = int(np.argmax(row))
+                req.ids.append(nxt)
+                req.remaining -= 1
+                if req.remaining <= 0:
+                    req.future.set_result(np.asarray(req.ids))
+                    self._active[slot] = None  # slot freed mid-flight
+        # drain on shutdown: active AND still-pending requests must resolve
+        for req in self._active:
+            if req is not None and not req.future.done():
+                req.future.set_result(np.asarray(req.ids))
+        while True:
+            try:
+                req = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            if not req.future.done():
+                req.future.set_exception(RuntimeError("engine stopped"))

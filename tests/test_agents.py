@@ -212,3 +212,81 @@ def test_cli_job_cluster_model_groups(tmp_path, monkeypatch):
     assert r.exit_code == 0 and "resnet56" in r.output
     r = CliRunner().invoke(cli, ["cluster", "list"])
     assert r.exit_code == 0, r.output
+
+
+def test_compute_resource_db(tmp_path):
+    from fedml_tpu.scheduler.resource_db import ComputeResourceDB
+
+    db = ComputeResourceDB(root=str(tmp_path), total_slots=4)
+    assert db.report()["total"] == 4
+    s1 = db.allocate("runA", 3)
+    assert len(s1) == 3
+    # not enough left → nothing allocated (atomic)
+    assert db.allocate("runB", 2) == []
+    assert db.report()["free"] == 1
+    assert db.release("runA") == 3
+    assert db.report()["free"] == 4
+    # stale reclamation
+    db.allocate("runC", 2)
+    db.conn.execute("UPDATE devices SET allocated_ts = 1.0 "
+                    "WHERE run_id='runC'")
+    assert db.reclaim_stale(max_age_s=10.0) == 2
+    assert db.report()["free"] == 4
+
+
+def test_agent_rejects_job_when_no_slots(tmp_path):
+    from fedml_tpu.scheduler.agents import MasterAgent, SlaveAgent
+    from fedml_tpu.scheduler.resource_db import ComputeResourceDB
+
+    import uuid
+
+    edge = f"e11_{uuid.uuid4().hex[:6]}"
+    store = str(tmp_path / "store")
+    agent = SlaveAgent(edge, channel="t-agents-rs", store_dir=store).start()
+    try:
+        # exhaust this agent's slots up front
+        db = ComputeResourceDB(root=agent.agent_dir)
+        db.allocate("hog", len(db.available_slots()))
+        master = MasterAgent(channel="t-agents-rs", store_dir=store)
+        run_id = master.create_run(_write_job(tmp_path), [edge])
+        result = master.wait(run_id, timeout=30)
+        st = result["edges"][edge]
+        assert st["status"] == "FAILED"
+        assert "device slots" in st.get("error", "")
+    finally:
+        agent.stop()
+
+
+def test_agent_ota_upgrade_and_replay(tmp_path):
+    from fedml_tpu.scheduler.agents import (
+        MasterAgent,
+        SlaveAgent,
+        _topic_start,
+        _topic_upgrade,
+    )
+
+    import uuid
+
+    edge = f"e12_{uuid.uuid4().hex[:6]}"  # fresh agent dir → fresh version
+    store = str(tmp_path / "store")
+    agent = SlaveAgent(edge, channel="t-agents-ota", store_dir=store).start()
+    try:
+        assert agent.version == "0.1.0"
+        master = MasterAgent(channel="t-agents-ota", store_dir=store)
+
+        # simulate a start_train arriving DURING an upgrade: set the flag,
+        # publish the start, then publish the upgrade
+        agent._upgrading = True
+        run_id = master.create_run(_write_job(tmp_path), [edge])
+        time.sleep(0.3)
+        assert agent._replay_buffer, "start_train not buffered"
+        agent.broker.publish(_topic_upgrade(edge),
+                             json.dumps({"version": "0.2.0"}).encode())
+        result = master.wait(run_id, timeout=60)
+        assert result["completed"] and result["success"], result
+        assert agent.version == "0.2.0"
+        # persisted: a fresh agent object reads the upgraded version
+        agent2 = SlaveAgent(edge, channel="t-agents-ota-2", store_dir=store)
+        assert agent2.version == "0.2.0"
+    finally:
+        agent.stop()

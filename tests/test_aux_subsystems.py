@@ -1,7 +1,9 @@
 """Aux subsystems: RDP accountant, compression, flow engine, checkpointing,
 federated analytics, DP end-to-end."""
 
+import json
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -188,3 +190,96 @@ def test_local_dp_changes_upload(args_factory):
     noised = run(True)
     assert np.isfinite(noised["test_loss"])
     assert abs(base["test_loss"] - noised["test_loss"]) > 1e-9
+
+
+def test_perf_stats_daemon(tmp_path, args_factory):
+    from fedml_tpu.core import mlops
+    from fedml_tpu.core.mlops.perf_stats import (
+        MLOpsJobPerfStats,
+        system_snapshot,
+    )
+
+    snap = system_snapshot()
+    assert snap["cpu_percent"] >= 0
+    assert snap["mem_total_gb"] > 0
+    assert isinstance(snap.get("devices"), list) and snap["devices"]
+
+    mlops.init(args_factory(enable_tracking=True, run_id="perfrun",
+                            log_file_dir=str(tmp_path)))
+    d = MLOpsJobPerfStats(run_id="perfrun", interval_s=0.05).start()
+    time.sleep(0.3)
+    d.stop()
+    assert d.samples, "no samples collected"
+    assert all(s["role"] == "job" for s in d.samples)
+    with open(tmp_path / "sysperf.jsonl") as f:
+        records = [json.loads(line) for line in f]
+    assert records and records[0]["job_run_id"] == "perfrun"
+
+
+def test_log_upload_daemon_resumes_cursor(tmp_path):
+    from fedml_tpu.core.mlops.log_daemon import MLOpsRuntimeLogDaemon
+
+    src = tmp_path / "run.log"
+    src.write_text("".join(f"line {i}\n" for i in range(10)))
+    d = MLOpsRuntimeLogDaemon("r1", str(src), interval_s=0.05,
+                              chunk_lines=4)
+    assert d.ship_once() == 10
+    uploaded = tmp_path / "uploaded" / "r1.log"
+    assert uploaded.read_text().count("\n") == 10
+
+    # append more; a NEW daemon instance resumes from the persisted cursor
+    with open(src, "a") as f:
+        f.write("line 10\nline 11\n")
+    d2 = MLOpsRuntimeLogDaemon("r1", str(src), interval_s=0.05)
+    assert d2.ship_once() == 2
+    assert uploaded.read_text().count("\n") == 12
+    # partial trailing line is held back until complete
+    with open(src, "a") as f:
+        f.write("partial")
+    assert d2.ship_once() == 0
+    with open(src, "a") as f:
+        f.write(" done\n")
+    assert d2.ship_once() == 1
+
+
+def test_fa_cross_silo_runtime(args_factory):
+    """FA over the message plane matches the SP simulator's results."""
+    from fedml_tpu.fa.cross_silo import run_cross_silo_fa
+    from fedml_tpu.fa.fa_frame import FASimulator
+
+    data = {0: [1, 2], 1: [2, 3], 2: [2]}
+    for task in ("intersection", "union", "cardinality", "avg"):
+        args = args_factory(fa_task=task, run_id=f"fa_{task}")
+        got = run_cross_silo_fa(args, data)
+        want = FASimulator(args_factory(fa_task=task), data).run()
+        assert got == want, (task, got, want)
+
+
+def test_fa_cross_silo_triehh(args_factory):
+    from fedml_tpu.fa.cross_silo import run_cross_silo_fa
+
+    words = ["the", "the", "then", "cat"]
+    data = {i: words for i in range(3)}
+    result = run_cross_silo_fa(
+        args_factory(fa_task="heavy_hitter_triehh", comm_round=3,
+                     triehh_theta=3, run_id="fa_hh"), data)
+    assert "the" in result
+
+
+def test_log_upload_daemon_invalid_utf8_cursor(tmp_path):
+    """Byte-exact cursor even when the partial tail has invalid UTF-8."""
+    from fedml_tpu.core.mlops.log_daemon import MLOpsRuntimeLogDaemon
+
+    src = tmp_path / "bin.log"
+    with open(src, "wb") as f:
+        f.write(b"good line\n")
+        f.write(b"partial \xff\xfe")  # invalid utf-8, no newline yet
+    d = MLOpsRuntimeLogDaemon("rb1", str(src))
+    assert d.ship_once() == 1
+    with open(src, "ab") as f:
+        f.write(b" rest\n")
+    assert d.ship_once() == 1  # exactly the completed line, no re-reads
+    uploaded = (tmp_path / "uploaded" / "rb1.log").read_text()
+    assert uploaded.startswith("good line\n")
+    assert uploaded.count("\n") == 2
+    assert "partial" in uploaded and "rest" in uploaded
