@@ -70,16 +70,17 @@ def init(args: Optional[Config] = None, argv: Optional[list] = None,
         format="[fedml_tpu %(levelname)s %(asctime)s] %(message)s")
 
     mlops.init(args)
-    if getattr(args, "enable_sys_perf_monitoring", False):
-        # device-scoped sampler (reference MLOpsDevicePerfStats, started
-        # from the reference's init profiling toggles __init__.py:239-281).
-        # Process-wide singleton: re-init stops the previous daemon instead
-        # of leaking one sampler thread per init() call.
-        from .core.mlops import perf_stats
+    # device-scoped sampler (reference MLOpsDevicePerfStats, started from
+    # the reference's init profiling toggles __init__.py:239-281).
+    # Process-wide singleton: every re-init stops the previous daemon —
+    # including when the flag turned off — so no sampler thread leaks.
+    from .core.mlops import perf_stats
 
-        old = getattr(perf_stats, "_device_daemon", None)
-        if old is not None:
-            old.stop()
+    old = getattr(perf_stats, "_device_daemon", None)
+    if old is not None:
+        old.stop()
+        perf_stats._device_daemon = None
+    if getattr(args, "enable_sys_perf_monitoring", False):
         interval = float(getattr(args, "sys_perf_interval_s", 10.0) or 10.0)
         perf_stats._device_daemon = perf_stats.MLOpsDevicePerfStats(
             interval).start()

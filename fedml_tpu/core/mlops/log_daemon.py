@@ -93,6 +93,11 @@ class MLOpsRuntimeLogDaemon:
         offset = self._load_cursor()
         if not os.path.exists(self.source_path):
             return 0
+        if offset > os.path.getsize(self.source_path):
+            # source was truncated/rewritten (same run_id re-dispatched):
+            # restart from the top instead of seeking past EOF forever
+            offset = 0
+            self._save_cursor(0)
         shipped = 0
         with open(self.source_path, "rb") as f:
             f.seek(offset)

@@ -112,6 +112,15 @@ class SlaveAgent:
         self.agent_dir = os.path.join(os.path.expanduser("~"), ".fedml_tpu",
                                       "agent", self.edge_id)
         os.makedirs(self.agent_dir, exist_ok=True)
+        # one shared resource registry per agent (not per job — a per-job
+        # sqlite connection would leak fds in a long-lived daemon)
+        from .resource_db import ComputeResourceDB
+
+        self.resources = ComputeResourceDB(root=self.agent_dir)
+        # runs cancelled before/while their start was pending (e.g. a
+        # stop_train that landed during an OTA upgrade)
+        self._cancelled: set = set()
+        self._job_threads: Dict[str, threading.Thread] = {}
         # OTA state (reference client_runner.py:852 OTA upgrade + :1436
         # message replay after upgrade); _ota_lock serializes the
         # buffered-vs-replay decision against concurrent _on_start calls
@@ -154,6 +163,11 @@ class SlaveAgent:
         self.broker.unsubscribe(_topic_upgrade(self.edge_id),
                                 self._on_upgrade)
         self._send_active("OFFLINE")
+        # let in-flight _run_job threads finish their finally blocks
+        # (slot release + terminal status) before closing the shared db
+        for t in list(self._job_threads.values()):
+            t.join(timeout=15.0)
+        self.resources.close()
 
     def _heartbeat_loop(self) -> None:
         """Periodic active message (reference `send_agent_active_msg:1410` +
@@ -176,8 +190,14 @@ class SlaveAgent:
                 return
         req = json.loads(payload.decode())
         run_id = str(req["run_id"])
+        if run_id in self._cancelled:
+            self._cancelled.discard(run_id)
+            self._report(run_id, ClientConstants.STATUS_KILLED,
+                         error="cancelled before start")
+            return
         t = threading.Thread(target=self._run_job, args=(run_id, req),
                              daemon=True, name=f"agent-run-{run_id}")
+        self._job_threads[run_id] = t
         t.start()
 
     # -- OTA upgrade (reference client_runner.py:852) ------------------------
@@ -214,6 +234,15 @@ class SlaveAgent:
         self.broker.publish(_topic_status(run_id), json.dumps(body).encode())
 
     def _run_job(self, run_id: str, req: Dict[str, Any]) -> None:
+        try:
+            self._run_job_impl(run_id, req)
+        finally:
+            # every exit path (incl. early returns) must unregister the
+            # thread and bound the cancel set
+            self._job_threads.pop(run_id, None)
+            self._cancelled.discard(run_id)
+
+    def _run_job_impl(self, run_id: str, req: Dict[str, Any]) -> None:
         self._report(run_id, ClientConstants.STATUS_INITIALIZING)
         try:
             workspace = self._retrieve_and_unzip_package(run_id, req)
@@ -237,9 +266,7 @@ class SlaveAgent:
 
         # claim accelerator slots before spawning (reference
         # compute_gpu_cache allocation in the slave runner)
-        from .resource_db import ComputeResourceDB
-
-        resources = ComputeResourceDB(root=self.agent_dir)
+        resources = self.resources
         n_slots = int((cfg.get("computing") or {}).get("device_count", 1)
                       or 1)
         slots = resources.allocate(run_id, n_slots)
@@ -251,6 +278,15 @@ class SlaveAgent:
                                f"(need {n_slots})")
             return
         env["FEDML_DEVICE_SLOTS"] = ",".join(map(str, slots))
+
+        if run_id in self._cancelled:
+            # stop_train landed during package setup, before Popen existed
+            self._cancelled.discard(run_id)
+            resources.release(run_id)
+            local_launcher.update_run_status(run_id, "KILLED", returncode=-1)
+            self._report(run_id, ClientConstants.STATUS_KILLED,
+                         error="cancelled during setup")
+            return
 
         rc = 0
         self._report(run_id, ClientConstants.STATUS_TRAINING)
@@ -360,7 +396,12 @@ class SlaveAgent:
     # -- stop_train ----------------------------------------------------------
     def _on_stop(self, topic: str, payload: bytes) -> None:
         req = json.loads(payload.decode())
-        self._kill_run(str(req["run_id"]))
+        run_id = str(req["run_id"])
+        # remember the cancellation even if the run hasn't started yet
+        # (e.g. its start_train is buffered behind an OTA upgrade) so the
+        # replay path doesn't launch a cancelled job
+        self._cancelled.add(run_id)
+        self._kill_run(run_id)
 
     def _kill_run(self, run_id: str) -> None:
         proc = self._procs.get(run_id)

@@ -29,7 +29,11 @@ class ComputeResourceDB:
     def __init__(self, root: Optional[str] = None,
                  total_slots: Optional[int] = None) -> None:
         self.path = _db_path(root)
-        self.conn = sqlite3.connect(self.path, check_same_thread=False)
+        # isolation_level=None → manual transactions, so allocate() can use
+        # BEGIN IMMEDIATE for cross-PROCESS atomicity (the module _LOCK only
+        # serializes threads within one process)
+        self.conn = sqlite3.connect(self.path, check_same_thread=False,
+                                    isolation_level=None, timeout=10.0)
         self.conn.execute("PRAGMA journal_mode=WAL")
         with _LOCK, self.conn:
             self.conn.execute(
@@ -86,19 +90,37 @@ class ComputeResourceDB:
         return [r[0] for r in rows]
 
     def allocate(self, run_id: str, n_slots: int = 1) -> List[int]:
-        """Atomically claim ``n_slots`` free slots for ``run_id``.
-        Returns [] (allocating nothing) if not enough are free."""
-        with _LOCK, self.conn:
-            rows = self.conn.execute(
-                "SELECT slot FROM devices WHERE run_id IS NULL "
-                "ORDER BY slot LIMIT ?", (n_slots,)).fetchall()
-            if len(rows) < n_slots:
+        """Atomically claim ``n_slots`` free slots for ``run_id`` —
+        cross-process safe (BEGIN IMMEDIATE write lock + run_id IS NULL
+        guard).  Returns [] (allocating nothing) if not enough are free."""
+        with _LOCK:
+            try:
+                self.conn.execute("BEGIN IMMEDIATE")
+                rows = self.conn.execute(
+                    "SELECT slot FROM devices WHERE run_id IS NULL "
+                    "ORDER BY slot LIMIT ?", (n_slots,)).fetchall()
+                if len(rows) < n_slots:
+                    self.conn.execute("ROLLBACK")
+                    return []
+                slots = [r[0] for r in rows]
+                now = time.time()
+                claimed = 0
+                for s in slots:
+                    cur = self.conn.execute(
+                        "UPDATE devices SET run_id=?, allocated_ts=? "
+                        "WHERE slot=? AND run_id IS NULL",
+                        (str(run_id), now, s))
+                    claimed += cur.rowcount
+                if claimed < n_slots:
+                    self.conn.execute("ROLLBACK")
+                    return []
+                self.conn.execute("COMMIT")
+            except sqlite3.OperationalError:
+                try:
+                    self.conn.execute("ROLLBACK")
+                except sqlite3.OperationalError:
+                    pass
                 return []
-            slots = [r[0] for r in rows]
-            now = time.time()
-            self.conn.executemany(
-                "UPDATE devices SET run_id=?, allocated_ts=? WHERE slot=?",
-                [(str(run_id), now, s) for s in slots])
         return slots
 
     def release(self, run_id: str) -> int:
@@ -117,6 +139,10 @@ class ComputeResourceDB:
                 "UPDATE devices SET run_id=NULL, allocated_ts=NULL "
                 "WHERE run_id IS NOT NULL AND allocated_ts < ?", (cutoff,))
         return cur.rowcount
+
+    def close(self) -> None:
+        with _LOCK:
+            self.conn.close()
 
     def report(self) -> Dict[str, Any]:
         devices = self.list_devices()

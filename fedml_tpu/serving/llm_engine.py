@@ -76,6 +76,9 @@ class BatchedLLMEngine:
         if self._stop.is_set():
             req.future.set_exception(RuntimeError("engine stopped"))
             return req.future
+        if req.remaining <= 0:  # zero-budget: resolve without a decode step
+            req.future.set_result(np.asarray(req.ids))
+            return req.future
         self._pending.put(req)
         return req.future
 
@@ -87,6 +90,15 @@ class BatchedLLMEngine:
     def stop(self) -> None:
         self._stop.set()
         self._worker.join(timeout=5.0)
+        # a submit() racing stop() may have put() after the worker's final
+        # drain — resolve any such stragglers here
+        while True:
+            try:
+                req = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            if not req.future.done():
+                req.future.set_exception(RuntimeError("engine stopped"))
 
     @property
     def active_count(self) -> int:
@@ -107,7 +119,9 @@ class BatchedLLMEngine:
             self._admit()
             if self.active_count == 0:
                 try:
-                    req = self._pending.get(timeout=self.max_wait_s)
+                    # idle: block on a coarse stop-aware wait (max_wait_s
+                    # only bounds BATCHING latency, not idle polling)
+                    req = self._pending.get(timeout=0.5)
                     self._active[0] = req
                 except queue.Empty:
                     continue

@@ -290,3 +290,34 @@ def test_agent_ota_upgrade_and_replay(tmp_path):
         assert agent2.version == "0.2.0"
     finally:
         agent.stop()
+
+
+def test_stop_during_upgrade_cancels_buffered_start(tmp_path):
+    import uuid
+
+    from fedml_tpu.scheduler.agents import (
+        MasterAgent,
+        SlaveAgent,
+        _topic_stop,
+        _topic_upgrade,
+    )
+
+    edge = f"e13_{uuid.uuid4().hex[:6]}"
+    store = str(tmp_path / "store")
+    agent = SlaveAgent(edge, channel="t-agents-ota2",
+                       store_dir=store).start()
+    try:
+        master = MasterAgent(channel="t-agents-ota2", store_dir=store)
+        agent._upgrading = True
+        run_id = master.create_run(_write_job(tmp_path), [edge])
+        time.sleep(0.2)
+        assert agent._replay_buffer
+        # cancel while the start is still buffered
+        agent.broker.publish(_topic_stop(edge),
+                             json.dumps({"run_id": run_id}).encode())
+        agent.broker.publish(_topic_upgrade(edge),
+                             json.dumps({"version": "9.9.9"}).encode())
+        result = master.wait(run_id, timeout=30)
+        assert result["edges"][edge]["status"] == "KILLED"
+    finally:
+        agent.stop()

@@ -53,3 +53,28 @@ def test_parrot_matches_sp_loss_scale(args_factory):
     sp = _run(args_factory(comm_round=5, data_scale=0.3))
     pr = _run(args_factory(backend="parrot", comm_round=5, data_scale=0.3))
     assert abs(sp["test_acc"] - pr["test_acc"]) < 0.25
+
+
+def test_run_rounds_fused_chunking_and_noop(args_factory):
+    import jax
+    import numpy as np
+
+    import fedml_tpu
+    from fedml_tpu.runner import FedMLRunner
+
+    args = fedml_tpu.init(args_factory(backend="parrot", dataset="mnist",
+                                       model="lr", data_scale=0.1,
+                                       client_num_in_total=8,
+                                       client_num_per_round=8, comm_round=2))
+    dataset = fedml_tpu.data.load(args)
+    bundle = fedml_tpu.model.create(args, dataset[-1])
+    api = FedMLRunner(args, None, dataset, bundle).runner
+    # no-op must not touch (donate) live state
+    rms0 = api.run_rounds_fused(0)
+    assert np.asarray(rms0["train_loss"]).shape == (0,)
+    # full chunks + remainder; state stays usable across calls
+    rms = api.run_rounds_fused(api.FUSED_CHUNK_ROUNDS * 2 + 3)
+    tl = np.asarray(rms["train_loss"])
+    assert tl.shape == (api.FUSED_CHUNK_ROUNDS * 2 + 3,)
+    assert np.isfinite(tl).all() and tl[-1] < tl[0]
+    jax.block_until_ready(api.run_rounds_fused(2))  # still alive
