@@ -1,0 +1,19 @@
+"""Federated analytics: run every FA task over the cross-silo message plane.
+
+Usage: python examples/fa/fa_example.py
+"""
+
+import fedml_tpu
+from fedml_tpu.fa.cross_silo import run_cross_silo_fa
+
+client_data = {0: [1, 2, 5], 1: [2, 3, 5], 2: [2, 5, 9]}
+
+for task in ("avg", "intersection", "union", "cardinality", "frequency",
+             "k_percentile"):
+    args = fedml_tpu.Config(fa_task=task, run_id=f"fa_demo_{task}")
+    print(task, "→", run_cross_silo_fa(args, client_data))
+
+words = {i: ["the", "the", "then", "cat", "car"] for i in range(3)}
+args = fedml_tpu.Config(fa_task="heavy_hitter_triehh", comm_round=3,
+                        triehh_theta=3, run_id="fa_demo_hh")
+print("heavy_hitter_triehh →", run_cross_silo_fa(args, words))

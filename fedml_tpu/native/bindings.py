@@ -25,9 +25,10 @@ PROGRESS_CB = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_float,
 
 
 def build_native(force: bool = False) -> str:
-    if force or not os.path.exists(_LIB_PATH):
-        subprocess.run(["make", "-C", _DIR], check=True,
-                       capture_output=True)
+    # always invoke make: it is incremental (no-op when fresh) and a stale
+    # .so from before a source change would be missing newer symbols
+    subprocess.run(["make", "-C", _DIR] + (["-B"] if force else []),
+                   check=True, capture_output=True)
     return _LIB_PATH
 
 
@@ -59,6 +60,12 @@ def load() -> ctypes.CDLL:
                                              i64, i64, P(i64)]
     lib.ft_modular_inv.restype = i64
     lib.ft_modular_inv.argtypes = [i64]
+    lib.ft_load_csv.restype = ctypes.c_int
+    lib.ft_load_csv.argtypes = [ctypes.c_char_p, P(i64), P(i64), P(f32),
+                                P(ctypes.c_int32), i64]
+    lib.ft_load_idx.restype = ctypes.c_int
+    lib.ft_load_idx.argtypes = [ctypes.c_char_p, ctypes.c_char_p, P(i64),
+                                P(i64), P(f32), P(ctypes.c_int32), i64]
     _lib = lib
     return lib
 
@@ -146,3 +153,45 @@ def eval_classifier(x: np.ndarray, y: np.ndarray, classes: int,
         _ptr(w1, f32) if hidden else None, _ptr(b1, f32) if hidden else None,
         _ptr(w2, f32), _ptr(b2, f32), ctypes.byref(loss))
     return float(acc), float(loss.value)
+
+
+def load_csv(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Native CSV loader (features..., label per line) → (x, y)."""
+    lib = load()
+    n = ctypes.c_int64(0)
+    d = ctypes.c_int64(0)
+    rc = lib.ft_load_csv(path.encode(), ctypes.byref(n), ctypes.byref(d),
+                         None, None, 0)
+    if rc != 0:
+        raise IOError(f"ft_load_csv({path!r}) failed with code {rc}")
+    cap = n.value
+    x = np.zeros((cap, d.value), np.float32)
+    y = np.zeros((cap,), np.int32)
+    rc = lib.ft_load_csv(path.encode(), ctypes.byref(n), ctypes.byref(d),
+                         _ptr(x, ctypes.c_float), _ptr(y, ctypes.c_int32),
+                         cap)
+    if rc != 0:
+        raise IOError(f"ft_load_csv({path!r}) failed with code {rc}")
+    return x[:n.value], y[:n.value]
+
+
+def load_idx(images_path: str, labels_path: str
+             ) -> Tuple[np.ndarray, np.ndarray]:
+    """Native MNIST-idx loader → (x in [0,1] shaped [n, rows*cols], y)."""
+    lib = load()
+    n = ctypes.c_int64(0)
+    d = ctypes.c_int64(0)
+    rc = lib.ft_load_idx(images_path.encode(), labels_path.encode(),
+                         ctypes.byref(n), ctypes.byref(d), None, None, 0)
+    if rc != 0:
+        raise IOError(f"ft_load_idx failed with code {rc}")
+    cap = n.value
+    x = np.zeros((cap, d.value), np.float32)
+    y = np.zeros((cap,), np.int32)
+    rc = lib.ft_load_idx(images_path.encode(), labels_path.encode(),
+                         ctypes.byref(n), ctypes.byref(d),
+                         _ptr(x, ctypes.c_float), _ptr(y, ctypes.c_int32),
+                         cap)
+    if rc != 0:
+        raise IOError(f"ft_load_idx failed with code {rc}")
+    return x[:min(n.value, cap)], y[:min(n.value, cap)]

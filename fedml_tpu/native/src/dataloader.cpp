@@ -1,0 +1,101 @@
+// Native dataset loaders — CSV tabular and MNIST-idx binary.
+//
+// Capability parity: the reference's C++ on-device DataLoaders
+// (android/fedmlsdk/MobileNN/src/MNN/{mnist,cifar10,tabular}.cpp and
+// src/torch/{mnist,cifar10}.cpp) that feed the native trainer without any
+// Python in the loop.  Formats:
+//  * CSV: one sample per line, features then integer label last; '#' lines
+//    and blanks skipped.  Non-numeric cells are a hard error (code 4).
+//  * idx: the MNIST big-endian idx3 (images, normalized to [0,1]) and idx1
+//    (labels) pair.  Short reads are a hard error (code 5).
+// Query-then-fill C API: call with null outputs to get n/d; the fill call
+// takes the CALLER's capacity and never writes past it (the file may have
+// grown between the two calls).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+extern "C" {
+
+// Returns 0 on success. If out_x == null, only *out_n / *out_d are set
+// (capacity ignored).  Fill pass writes at most `capacity` rows.
+// Errors: 1 open, 2 ragged row, 4 unparseable cell.
+int ft_load_csv(const char* path, int64_t* out_n, int64_t* out_d,
+                float* out_x, int32_t* out_y, int64_t capacity) {
+  std::ifstream f(path);
+  if (!f.is_open()) return 1;
+  std::string line;
+  int64_t n = 0, d = -1;
+  while (std::getline(f, line)) {
+    if (line.empty() || line[0] == '#') continue;
+    std::stringstream ss(line);
+    std::string cell;
+    std::vector<float> row;
+    while (std::getline(ss, cell, ',')) {
+      const char* s = cell.c_str();
+      char* end = nullptr;
+      float v = std::strtof(s, &end);
+      while (end != nullptr && (*end == ' ' || *end == '\r')) ++end;
+      if (end == s || (end != nullptr && *end != '\0')) return 4;
+      row.push_back(v);
+    }
+    if (row.size() < 2) continue;
+    if (d < 0) d = static_cast<int64_t>(row.size()) - 1;
+    if (static_cast<int64_t>(row.size()) != d + 1) return 2;  // ragged
+    if (out_x != nullptr) {
+      if (n >= capacity) break;  // file grew since the size pass
+      std::memcpy(out_x + n * d, row.data(), d * sizeof(float));
+      out_y[n] = static_cast<int32_t>(row.back());
+    }
+    ++n;
+  }
+  *out_n = n;
+  *out_d = d < 0 ? 0 : d;
+  return 0;
+}
+
+static uint32_t read_be32(std::ifstream& f) {
+  unsigned char b[4] = {0, 0, 0, 0};
+  f.read(reinterpret_cast<char*>(b), 4);
+  return (uint32_t(b[0]) << 24) | (uint32_t(b[1]) << 16) |
+         (uint32_t(b[2]) << 8) | uint32_t(b[3]);
+}
+
+// MNIST idx3 (images) + idx1 (labels). Pixels normalized to [0,1].
+// Errors: 1 open, 2 bad magic, 3 count mismatch, 5 truncated data.
+int ft_load_idx(const char* images_path, const char* labels_path,
+                int64_t* out_n, int64_t* out_d, float* out_x,
+                int32_t* out_y, int64_t capacity) {
+  std::ifstream fi(images_path, std::ios::binary);
+  std::ifstream fl(labels_path, std::ios::binary);
+  if (!fi.is_open() || !fl.is_open()) return 1;
+  if (read_be32(fi) != 0x00000803u) return 2;  // idx3 magic
+  if (read_be32(fl) != 0x00000801u) return 2;  // idx1 magic
+  const int64_t n = read_be32(fi);
+  const int64_t rows = read_be32(fi), cols = read_be32(fi);
+  if (static_cast<int64_t>(read_be32(fl)) != n) return 3;
+  if (fi.fail() || fl.fail()) return 5;
+  *out_n = n;
+  *out_d = rows * cols;
+  if (out_x == nullptr) return 0;
+  const int64_t n_fill = n < capacity ? n : capacity;
+  std::vector<unsigned char> buf(static_cast<size_t>(rows * cols));
+  for (int64_t i = 0; i < n_fill; ++i) {
+    fi.read(reinterpret_cast<char*>(buf.data()), rows * cols);
+    unsigned char y;
+    fl.read(reinterpret_cast<char*>(&y), 1);
+    if (fi.fail() || fl.fail()) return 5;  // truncated mid-data
+    for (int64_t j = 0; j < rows * cols; ++j)
+      out_x[i * rows * cols + j] = buf[j] / 255.0f;
+    out_y[i] = y;
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,70 @@
+"""The 5 BASELINE.json capability configs, scaled down for CI
+(BASELINE.md "Targets for the new framework").  Each must run end-to-end
+and learn; the full-size versions are the driver's bench configs.
+"""
+
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.runner import FedMLRunner
+
+
+def _run(args):
+    args = fedml_tpu.init(args)
+    device = fedml_tpu.device.get_device(args)
+    dataset = fedml_tpu.data.load(args)
+    bundle = fedml_tpu.model.create(args, dataset[-1])
+    return FedMLRunner(args, device, dataset, bundle).run()
+
+
+def test_config1_fedavg_lr_mnist_sp(args_factory):
+    """#1: FedAvg LR on MNIST, SP backend, 10 clients."""
+    m = _run(args_factory(dataset="mnist", model="lr",
+                          client_num_in_total=10, client_num_per_round=10,
+                          comm_round=4, learning_rate=0.1, data_scale=0.05))
+    assert m["test_acc"] > 0.5
+
+
+def test_config2_fedavg_resnet56_cifar10_parrot(args_factory):
+    """#2: FedAvg ResNet-56 on CIFAR-10, 100 clients / 10 per round,
+    Parrot (scaled: 20/5, 3 rounds)."""
+    m = _run(args_factory(backend="parrot", dataset="cifar10",
+                          model="resnet56", client_num_in_total=20,
+                          client_num_per_round=5, comm_round=3,
+                          batch_size=16, data_scale=0.05,
+                          frequency_of_the_test=10))
+    assert np.isfinite(m["test_loss"])
+
+
+@pytest.mark.parametrize("optimizer", ["FedOpt", "FedProx"])
+def test_config3_fedopt_bert_tiny_fed_shakespeare(args_factory, optimizer):
+    """#3: FedOpt / FedProx BERT-tiny on Fed-Shakespeare (non-IID text)."""
+    m = _run(args_factory(federated_optimizer=optimizer,
+                          dataset="fed_shakespeare", model="bert_tiny",
+                          client_num_in_total=4, client_num_per_round=4,
+                          comm_round=3, batch_size=8, learning_rate=0.05,
+                          server_lr=0.1, data_scale=0.05,
+                          partition_method="hetero"))
+    assert np.isfinite(m["test_loss"])
+    assert 0.0 <= m["test_acc"] <= 1.0  # token accuracy
+
+
+def test_config4_hierarchical_vit_fed_cifar100(args_factory):
+    """#4: cross-silo hierarchical FL, ViT-Tiny on Fed-CIFAR100
+    (scaled: 2 groups x 2 clients via the hierarchical SP plane)."""
+    m = _run(args_factory(federated_optimizer="HierarchicalFL",
+                          dataset="fed_cifar100", model="vit_tiny",
+                          vit_layers=2, client_num_in_total=4,
+                          client_num_per_round=4, group_num=2,
+                          group_comm_round=1, comm_round=2, batch_size=8,
+                          data_scale=0.02))
+    assert np.isfinite(m["test_loss"])
+
+
+def test_config5_vertical_fl_splitnn_adult(args_factory):
+    """#5: vertical FL split-NN, two-party tabular, Adult."""
+    m = _run(args_factory(federated_optimizer="VerticalFL", dataset="adult",
+                          comm_round=4, batch_size=64, learning_rate=0.1,
+                          data_scale=0.5))
+    assert m["test_acc"] > 0.6
