@@ -19,7 +19,7 @@ class ContributionAssessorManager:
         self.assessor = None
         name = getattr(args, "contribution_alg", None)
         if name:
-            name = str(name).lower()
+            name = str(name).lower().replace("-", "_")
             if name in ("loo", "leave_one_out"):
                 self.assessor = LeaveOneOut()
             elif name in ("gtg", "shapley", "gtg_shapley"):
@@ -28,6 +28,10 @@ class ContributionAssessorManager:
                     max_perms=int(getattr(args, "shapley_max_perms", 10)),
                     seed=int(getattr(args, "random_seed", 0) or 0),
                 )
+            else:
+                raise ValueError(
+                    f"unknown contribution_alg {name!r}; known: "
+                    f"LOO / leave_one_out, GTG-Shapley / shapley")
         self._final: Dict[int, float] = {}
 
     def run(self, client_num_per_round, client_index_for_this_round,
