@@ -24,6 +24,7 @@ class ClientMasterManager(FedMLCommManager):
         super().__init__(args, comm, rank, size, backend)
         self.trainer_dist_adapter = trainer_dist_adapter
         self.num_rounds = int(args.comm_round)
+        self._compressor = None  # built lazily when enable_compression
         self.round_idx = 0
 
     def register_message_receive_handlers(self) -> None:
@@ -76,7 +77,31 @@ class ClientMasterManager(FedMLCommManager):
                 self.round_idx)
         msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
                       self.get_sender_id(), 0)
-        msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, weights)
+        if getattr(self.args, "enable_compression", False):
+            # sparse delta upload (reference utils/compression.py TopK/EF):
+            # only top-k(|Δ|) entries travel; the server reconstructs
+            # weights = global + Δ against its own copy of the global model
+            import jax
+
+            if self._compressor is None:
+                from ...utils.compression import (
+                    EFTopKCompressor,
+                    TopKCompressor,
+                )
+
+                kind = str(getattr(self.args, "compression_type",
+                                   "eftopk")).lower()
+                ratio = float(getattr(self.args, "compress_ratio", 0.01)
+                              or 0.01)
+                self._compressor = (EFTopKCompressor(ratio)
+                                    if kind.startswith("ef")
+                                    else TopKCompressor(ratio))
+            delta = jax.tree_util.tree_map(lambda w, g: w - g, weights,
+                                           global_model)
+            payload, _ = self._compressor.compress(delta)
+            msg.add_params(MyMessage.MSG_ARG_KEY_COMPRESSED_UPDATE, payload)
+        else:
+            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, weights)
         msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, n_samples)
         msg.add_params(MyMessage.MSG_ARG_KEY_TRAIN_METRICS,
                        getattr(self.trainer_dist_adapter.trainer,

@@ -25,6 +25,7 @@ class MyMessage:
     MSG_ARG_KEY_CLIENT_STATUS = "client_status"
     MSG_ARG_KEY_CLIENT_OS = "client_os"
     MSG_ARG_KEY_TRAIN_METRICS = "train_metrics"
+    MSG_ARG_KEY_COMPRESSED_UPDATE = "compressed_update"
 
     CLIENT_STATUS_ONLINE = "ONLINE"
     CLIENT_STATUS_IDLE = "IDLE"

@@ -84,6 +84,23 @@ class FedMLServerManager(FedMLCommManager):
     def handle_message_receive_model_from_client(self, msg: Message) -> None:
         sender = msg.get_sender_id()
         model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        compressed = msg.get(MyMessage.MSG_ARG_KEY_COMPRESSED_UPDATE)
+        if model_params is None and compressed is not None:
+            # sparse delta: rebuild weights = global + Δ using OUR copy of
+            # the global model for the tree structure (no spec on the wire)
+            import jax
+
+            from ...utils.compression import TopKCompressor
+
+            global_model = self.aggregator.get_global_model_params()
+            # spec = (treedef, shapes, dtypes) — no array work, unlike
+            # _flatten which concatenates the whole model just for this
+            leaves, treedef = jax.tree_util.tree_flatten(global_model)
+            spec = (treedef, [jax.numpy.shape(l) for l in leaves],
+                    [jax.numpy.result_type(l) for l in leaves])
+            delta = TopKCompressor().decompress(compressed, spec)
+            model_params = jax.tree_util.tree_map(
+                lambda g, d: g + d, global_model, delta)
         local_sample_number = msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
         self.aggregator.add_local_trained_result(
             sender - 1, model_params, local_sample_number)

@@ -160,3 +160,34 @@ class BatchedLLMEngine:
                 break
             if not req.future.done():
                 req.future.set_exception(RuntimeError("engine stopped"))
+
+
+class LLMEnginePredictor:
+    """FedMLPredictor-shaped adapter: plugs a BatchedLLMEngine into the
+    HTTP inference runner and the OpenAI-compatible chat API (reference
+    serving/templates/hf_template — generation backend behind /predict and
+    /v1/chat/completions).  ``encode``/``decode`` map text ↔ token ids;
+    defaults to the char-level codec of the shakespeare-vocab models."""
+
+    def __init__(self, engine: BatchedLLMEngine, encode=None,
+                 decode=None) -> None:
+        self.engine = engine
+        self.encode = encode or (lambda s: [
+            min(max(ord(c) - 32, 0), 89) for c in s] or [0])
+        self.decode = decode or (lambda ids: "".join(
+            chr(int(i) + 32) for i in ids))
+
+    def predict(self, request: Any) -> str:
+        if isinstance(request, str):
+            request = {"prompt": request}
+        prompt = str(request.get("prompt", ""))
+        raw_max = request.get("max_tokens")
+        max_tokens = 20 if raw_max is None else int(raw_max)
+        temperature = float(request.get("temperature", 0.0) or 0.0)
+        ids = self.encode(prompt)
+        out = self.engine.generate(ids, max_new=max_tokens,
+                                   temperature=temperature)
+        return self.decode(out[len(ids):])
+
+    def ready(self) -> bool:
+        return not self.engine._stop.is_set()

@@ -202,3 +202,28 @@ def test_secagg_rejects_single_client():
     with pytest.raises(ValueError, match="at least 2 clients"):
         SAServerManager(Config(comm_round=1, run_id="sa-one"), None,
                         client_num=1)
+
+
+def test_cross_silo_with_compressed_uploads(args_factory):
+    """enable_compression: sparse EF-TopK delta uploads still converge."""
+    import threading
+
+    import fedml_tpu
+    from fedml_tpu.cross_silo.runner import init_client, init_server
+
+    args = fedml_tpu.init(args_factory(
+        training_type="cross_silo", client_num_in_total=2,
+        client_num_per_round=2, comm_round=3, data_scale=0.3,
+        learning_rate=0.1, run_id="cs_comp", enable_compression=True,
+        compression_type="eftopk", compress_ratio=0.3))
+    dataset = fedml_tpu.data.load(args)
+    bundle = fedml_tpu.model.create(args, dataset[-1])
+    server = init_server(args, dataset, bundle)
+    clients = [init_client(args, dataset, bundle, rank) for rank in (1, 2)]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    server.run()
+    m = server.aggregator.metrics_history[-1]
+    assert np.isfinite(m["test_loss"])
+    assert m["test_acc"] > 0.3  # sparse updates still learn

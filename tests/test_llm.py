@@ -88,3 +88,42 @@ def test_batched_llm_engine_continuous_batching(args_factory):
         assert np.array_equal(again, outs[0])
     finally:
         engine.stop()
+
+
+def test_llm_engine_behind_openai_api(args_factory):
+    import json as _json
+    import threading
+    import urllib.request
+
+    import jax
+
+    from fedml_tpu.models import model_hub
+    from fedml_tpu.serving.llm_engine import (
+        BatchedLLMEngine,
+        LLMEnginePredictor,
+    )
+    from fedml_tpu.serving.openai_api import OpenAIServer
+
+    args = args_factory(model="transformer", dataset="shakespeare",
+                        compute_dtype="float32")
+    bundle = model_hub.create(args, 90)
+    variables = bundle.init_variables(jax.random.PRNGKey(0), batch_size=2)
+    engine = BatchedLLMEngine(bundle, variables, max_batch=2, window=16)
+    server = OpenAIServer(LLMEnginePredictor(engine), model_name="tiny",
+                          port=0)
+    try:
+        server.run(block=False)
+        port = server.port
+        body = _json.dumps({"model": "tiny", "max_tokens": 4,
+                            "messages": [{"role": "user",
+                                          "content": "hi"}]}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/chat/completions", data=body,
+            headers={"Content-Type": "application/json"})
+        resp = _json.loads(urllib.request.urlopen(req, timeout=120).read())
+        assert resp["object"] == "chat.completion"
+        content = resp["choices"][0]["message"]["content"]
+        assert isinstance(content, str) and len(content) == 4
+    finally:
+        server.stop()
+        engine.stop()
