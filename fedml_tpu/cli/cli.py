@@ -78,15 +78,20 @@ def run(config: str, rank: int, role: str) -> None:
                            if isinstance(v, (int, float, str))}))
 
 
+def _launch_and_echo(job_yaml: str, job_type: str) -> None:
+    """Shared body of launch / train run / federate run."""
+    from ..scheduler.local_launcher import launch_job_local
+
+    result = launch_job_local(job_yaml, job_type=job_type)
+    click.echo(json.dumps(result.__dict__))
+    sys.exit(result.returncode)
+
+
 @cli.command()
 @click.argument("job_yaml", type=click.Path(exists=True))
 def launch(job_yaml: str) -> None:
     """Launch a job.yaml locally (reference `fedml launch`)."""
-    from ..scheduler.local_launcher import launch_job_local
-
-    result = launch_job_local(job_yaml)
-    click.echo(json.dumps(result.__dict__))
-    sys.exit(result.returncode)
+    _launch_and_echo(job_yaml, "launch")
 
 
 @cli.command()
@@ -214,6 +219,13 @@ def train_build(job_yaml: str, dest: str) -> None:
     click.echo(api.train_build(job_yaml, dest))
 
 
+@train.command("run")
+@click.argument("job_yaml", type=click.Path(exists=True))
+def train_run(job_yaml: str) -> None:
+    """Launch a training job.yaml locally (reference `fedml train`)."""
+    _launch_and_echo(job_yaml, "train")
+
+
 @cli.group()
 def federate() -> None:
     """Federation job helpers (reference `fedml federate`)."""
@@ -226,6 +238,13 @@ def federate_build(job_yaml: str, dest: str) -> None:
     from .. import api
 
     click.echo(api.federate_build(job_yaml, dest))
+
+
+@federate.command("run")
+@click.argument("job_yaml", type=click.Path(exists=True))
+def federate_run(job_yaml: str) -> None:
+    """Launch a federated job.yaml locally (reference `fedml federate`)."""
+    _launch_and_echo(job_yaml, "federate")
 
 
 @cli.group()

@@ -187,11 +187,24 @@ class MqttS3CommManager(BaseCommunicationManager):
 
                 params[Message.MSG_ARG_KEY_MODEL_PARAMS] = loads_pytree(
                     base64.b64decode(inline))
+        for bulk_key, entry in (record.get("bulk") or {}).items():
+            from .....utils.serialization import loads_pytree
+            import base64
+
+            if entry.get("key"):
+                params[bulk_key] = loads_pytree(self.store.read(entry["key"]))
+            else:
+                params[bulk_key] = loads_pytree(
+                    base64.b64decode(entry["inline"]))
         msg = Message()
         msg.init(params)
         self._q.put(msg)
 
     # -- BaseCommunicationManager -------------------------------------------
+    #: message params that may carry pytrees of arrays and therefore ride
+    #: the store/inline blob path instead of the JSON control record
+    BULK_KEYS = (Message.MSG_ARG_KEY_MODEL_PARAMS, "compressed_update")
+
     def send_message(self, msg: Message) -> None:
         from .....utils.serialization import dumps_pytree
         import base64
@@ -207,6 +220,19 @@ class MqttS3CommManager(BaseCommunicationManager):
                 params[Message.MSG_ARG_KEY_MODEL_PARAMS_KEY] = key
             else:
                 record["model_params_inline"] = base64.b64encode(blob).decode()
+        # other bulk pytree params (e.g. compressed sparse updates)
+        for bulk_key in self.BULK_KEYS[1:]:
+            val = params.pop(bulk_key, None)
+            if val is None:
+                continue
+            blob = dumps_pytree(val)
+            entry: Dict[str, Any] = {}
+            if len(blob) > _PAYLOAD_THRESHOLD_BYTES:
+                entry["key"] = self.store.put_blob(
+                    f"fedml_{self.run_id}_{self.rank}_{bulk_key}", blob)
+            else:
+                entry["inline"] = base64.b64encode(blob).decode()
+            record.setdefault("bulk", {})[bulk_key] = entry
         record["params"] = _jsonable(params)
         self.broker.publish(
             self._topic(self.rank, msg.get_receiver_id()),

@@ -90,15 +90,11 @@ class FedMLServerManager(FedMLCommManager):
             # the global model for the tree structure (no spec on the wire)
             import jax
 
-            from ...utils.compression import TopKCompressor
+            from ...utils.compression import TopKCompressor, tree_spec
 
             global_model = self.aggregator.get_global_model_params()
-            # spec = (treedef, shapes, dtypes) — no array work, unlike
-            # _flatten which concatenates the whole model just for this
-            leaves, treedef = jax.tree_util.tree_flatten(global_model)
-            spec = (treedef, [jax.numpy.shape(l) for l in leaves],
-                    [jax.numpy.result_type(l) for l in leaves])
-            delta = TopKCompressor().decompress(compressed, spec)
+            delta = TopKCompressor().decompress(compressed,
+                                                tree_spec(global_model))
             model_params = jax.tree_util.tree_map(
                 lambda g, d: g + d, global_model, delta)
         local_sample_number = msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)

@@ -171,9 +171,11 @@ def build_job_package(job_yaml_path: str, out_dir: Optional[str] = None
 
 
 def launch_job_local(job_yaml_path: str,
-                     extra_env: Optional[Dict[str, str]] = None
-                     ) -> LaunchResult:
-    """Run bootstrap then the job command(s) with live log capture."""
+                     extra_env: Optional[Dict[str, str]] = None,
+                     job_type: str = "launch") -> LaunchResult:
+    """Run bootstrap then the job command(s) with live log capture.
+    ``job_type`` tags the run (launch/train/federate/deploy — reference
+    `fedml launch|train|federate` share this path)."""
     cfg = JobConfig.from_yaml(job_yaml_path)
     base = os.path.dirname(os.path.abspath(job_yaml_path))
     workspace = os.path.normpath(os.path.join(base, cfg.workspace))
@@ -184,6 +186,7 @@ def launch_job_local(job_yaml_path: str,
     if extra_env:
         env.update(extra_env)
     env["FEDML_CURRENT_RUN_ID"] = run_id
+    env["FEDML_JOB_TYPE"] = str(job_type)
 
     conn = _db()
     conn.execute("INSERT INTO runs (run_id, job_name, status, returncode, "

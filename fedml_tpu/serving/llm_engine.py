@@ -104,6 +104,12 @@ class BatchedLLMEngine:
     def active_count(self) -> int:
         return sum(1 for r in self._active if r is not None)
 
+    @property
+    def alive(self) -> bool:
+        """True while the engine can serve: not stopped AND the worker
+        thread hasn't died (e.g. from a step exception)."""
+        return not self._stop.is_set() and self._worker.is_alive()
+
     # -- worker -------------------------------------------------------------
     def _admit(self) -> None:
         for slot in range(self.max_batch):
@@ -190,4 +196,4 @@ class LLMEnginePredictor:
         return self.decode(out[len(ids):])
 
     def ready(self) -> bool:
-        return not self.engine._stop.is_set()
+        return self.engine.alive

@@ -17,11 +17,18 @@ import jax
 import jax.numpy as jnp
 
 
-def _flatten(tree: Any) -> Tuple[jnp.ndarray, Any]:
+def tree_spec(tree: Any) -> Any:
+    """(treedef, shapes, dtypes) for ``_unflatten`` — no array work, so it
+    is the cheap way to get a decompression spec from a reference tree."""
     leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return (treedef, [jnp.shape(l) for l in leaves],
+            [jnp.result_type(l) for l in leaves])
+
+
+def _flatten(tree: Any) -> Tuple[jnp.ndarray, Any]:
+    leaves, _ = jax.tree_util.tree_flatten(tree)
     flat = jnp.concatenate([jnp.ravel(l).astype(jnp.float32) for l in leaves])
-    return flat, (treedef, [jnp.shape(l) for l in leaves],
-                  [jnp.result_type(l) for l in leaves])
+    return flat, tree_spec(tree)
 
 
 def _unflatten(flat: jnp.ndarray, spec: Any) -> Any:

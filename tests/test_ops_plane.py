@@ -243,3 +243,27 @@ def test_diagnosis_unknown_check_rejected():
 
     with _pytest.raises(ValueError, match="unknown checks"):
         diagnose(checks=["brokr"])
+
+
+def test_cli_train_and_federate_aliases(tmp_path):
+    import textwrap
+
+    from click.testing import CliRunner
+
+    from fedml_tpu.cli.cli import cli
+
+    ws = tmp_path / "ws"
+    ws.mkdir()
+    jy = tmp_path / "job.yaml"
+    jy.write_text(textwrap.dedent("""
+        workspace: ws
+        job_name: t1
+        job: "echo TYPE=$FEDML_JOB_TYPE"
+    """))
+    for cmd in ("train", "federate"):
+        res = CliRunner().invoke(cli, [cmd, "run", str(jy)])
+        assert res.exit_code == 0, res.output
+        import json as _json
+
+        log_path = _json.loads(res.output.strip().splitlines()[-1])["log_path"]
+        assert f"TYPE={cmd}" in open(log_path).read()

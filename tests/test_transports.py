@@ -212,3 +212,37 @@ def test_mpi_backend_gated():
         pass
     with pytest.raises(NotImplementedError, match="mpi4py"):
         FedMLCommManager(object(), rank=0, size=2, backend="MPI")
+
+
+def test_mqtt_backend_carries_compressed_updates(args_factory, tmp_path):
+    """The compressed_update bulk param must survive the MQTT+store wire
+    (offloaded or inline), not fall into the JSON control record."""
+    import jax.numpy as jnp
+
+    from fedml_tpu.core.distributed.fedml_comm_manager import FedMLCommManager
+
+    args = args_factory(run_id="mq_comp", object_store_dir=str(tmp_path))
+    m0 = FedMLCommManager(args, rank=0, size=2, backend="MQTT_S3")
+    m1 = FedMLCommManager(args, rank=1, size=2, backend="MQTT_S3")
+    c1 = _Collector()
+    m1.com_manager.add_observer(c1)
+    t1 = threading.Thread(target=m1.com_manager.handle_receive_message,
+                          daemon=True)
+    t1.start()
+    time.sleep(0.1)
+    payload = {"values": jnp.arange(4096, dtype=jnp.float32),
+               "indices": jnp.arange(4096, dtype=jnp.int32),
+               "size": 100000}
+    msg = Message("UPLOAD", 0, 1)
+    msg.add_params("compressed_update", payload)
+    msg.add_params("num_samples", 7)
+    m0.send_message(msg)
+    assert c1.event.wait(10)
+    _, received = c1.got[0]
+    got = received.get("compressed_update")
+    assert got is not None and int(np.asarray(got["size"])) == 100000
+    np.testing.assert_array_equal(np.asarray(got["values"]),
+                                  np.arange(4096, dtype=np.float32))
+    assert received.get("num_samples") == 7
+    m1.com_manager.stop_receive_message()
+    m0.com_manager.stop_receive_message()
