@@ -77,6 +77,7 @@ class ClientMasterManager(FedMLCommManager):
                 self.round_idx)
         msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
                       self.get_sender_id(), 0)
+        msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)
         if getattr(self.args, "enable_compression", False):
             # sparse delta upload (reference utils/compression.py TopK/EF):
             # only top-k(|Δ|) entries travel; the server reconstructs

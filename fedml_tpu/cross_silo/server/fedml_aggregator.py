@@ -24,8 +24,7 @@ class FedMLAggregator:
         self.client_num = int(args.client_num_per_round)
         self.model_dict: Dict[int, Any] = {}
         self.sample_num_dict: Dict[int, float] = {}
-        self.flag_client_model_uploaded_dict: Dict[int, bool] = {
-            i: False for i in range(self.client_num)}
+        self._received_this_round: set = set()
         self.metrics_history: List[Dict[str, Any]] = []
 
     def get_global_model_params(self):
@@ -38,19 +37,22 @@ class FedMLAggregator:
                                  sample_num) -> None:
         self.model_dict[index] = model_params
         self.sample_num_dict[index] = float(sample_num)
-        self.flag_client_model_uploaded_dict[index] = True
+        self._received_this_round.add(index)
+
+    def receive_count(self) -> int:
+        return len(self._received_this_round)
 
     def check_whether_all_receive(self) -> bool:
-        if not all(self.flag_client_model_uploaded_dict.get(i, False)
-                   for i in range(self.client_num)):
-            return False
-        for i in range(self.client_num):
-            self.flag_client_model_uploaded_dict[i] = False
-        return True
+        return len(self._received_this_round) >= self.client_num
 
     def aggregate(self) -> Any:
-        raw = [(self.sample_num_dict[i], self.model_dict[i])
-               for i in range(self.client_num)]
+        """Aggregates over the clients that reported THIS round — a partial
+        set when the elastic round timeout dropped stragglers (liveness/
+        dropout tolerance, reference SecAgg reconstruction + async planes).
+        Clears the received set for the next round."""
+        idxs = sorted(self._received_this_round)
+        self._received_this_round = set()
+        raw = [(self.sample_num_dict[i], self.model_dict[i]) for i in idxs]
         with mlops.span("server.agg"):
             raw = self.aggregator.on_before_aggregation(raw)
             agg = self.aggregator.aggregate(raw)

@@ -8,6 +8,7 @@ client_index), collects C2S models, aggregates, advances rounds, sends FINISH.
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Any, Dict, List, Optional
 
 from ...core import mlops
@@ -30,6 +31,17 @@ class FedMLServerManager(FedMLCommManager):
         self.client_id_list_in_this_round: List[int] = []
         self.data_silo_index_of_client: List[int] = []
         self.is_initialized = False
+        # elastic membership (new capability, SURVEY §7 item 10):
+        # round_timeout_s > 0 → aggregate with whoever reported once the
+        # timer fires (≥ min_clients_per_round); late-online clients are
+        # caught up into the current round instead of blocking init forever
+        self.round_timeout_s = float(
+            getattr(args, "round_timeout_s", 0) or 0)
+        self.min_clients = int(
+            getattr(args, "min_clients_per_round", 1) or 1)
+        self._round_lock = threading.RLock()
+        self._round_timer: Optional[threading.Timer] = None
+        self._served_this_round: set = set()
 
     def run(self) -> None:
         super().run()
@@ -56,6 +68,21 @@ class FedMLServerManager(FedMLCommManager):
             mlops.log_aggregation_status("RUNNING")
             self.is_initialized = True
             self.send_init_msg()
+        elif self.is_initialized and status == \
+                MyMessage.CLIENT_STATUS_ONLINE:
+            # elastic late join: a client that came online after training
+            # started is caught up with the current round's model — but only
+            # if it wasn't already served this round (an ONLINE re-announce
+            # from a participating client must not trigger double training)
+            with self._round_lock:
+                if (sender in self._ranks_for(
+                        self.client_id_list_in_this_round)
+                        and sender not in self._served_this_round
+                        and (sender - 1) not in
+                        self.aggregator._received_this_round):
+                    logging.info("server: late-joining client %d caught up "
+                                 "into round %d", sender, self.args.round_idx)
+                    self._send_round_to(sender)
 
     def send_init_msg(self) -> None:
         self.client_id_list_in_this_round = self.aggregator.client_sampling(
@@ -74,6 +101,58 @@ class FedMLServerManager(FedMLCommManager):
                            self.client_id_list_in_this_round[i])
             msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.args.round_idx)
             self.send_message(msg)
+            self._served_this_round.add(receiver_rank)
+        self._arm_round_timer()
+
+    def _send_round_to(self, receiver_rank: int) -> None:
+        """(Re)send the current round's sync message(s) to one client — one
+        per slot it serves (a rank can hold several slots when the mapping
+        round-robins)."""
+        ranks = self._ranks_for(self.client_id_list_in_this_round)
+        mtype = (MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT
+                 if self.args.round_idx else
+                 MyMessage.MSG_TYPE_S2C_INIT_CONFIG)
+        for i, rank in enumerate(ranks):
+            if rank != receiver_rank:
+                continue
+            msg = Message(mtype, self.get_sender_id(), receiver_rank)
+            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                           self.aggregator.get_global_model_params())
+            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
+                           self.client_id_list_in_this_round[i])
+            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.args.round_idx)
+            self.send_message(msg)
+        self._served_this_round.add(receiver_rank)
+
+    # -- elastic round timeout ----------------------------------------------
+    def _arm_round_timer(self) -> None:
+        if self.round_timeout_s <= 0:
+            return
+        if self._round_timer is not None:
+            self._round_timer.cancel()
+        self._round_timer = threading.Timer(
+            self.round_timeout_s, self._on_round_timeout,
+            args=(self.args.round_idx,))
+        self._round_timer.daemon = True
+        self._round_timer.start()
+
+    def _on_round_timeout(self, round_idx: int) -> None:
+        with self._round_lock:
+            if self.args.round_idx != round_idx:
+                return  # round already completed normally
+            got = self.aggregator.receive_count()
+            if got < self.min_clients:
+                logging.warning(
+                    "server: round %d timeout with only %d/%d results "
+                    "(< min %d) — extending", round_idx, got,
+                    len(self.client_id_list_in_this_round), self.min_clients)
+                self._arm_round_timer()
+                return
+            logging.warning(
+                "server: round %d timeout — aggregating %d/%d results, "
+                "dropping stragglers", round_idx, got,
+                len(self.client_id_list_in_this_round))
+            self._complete_round()
 
     def _ranks_for(self, client_ids: List[int]) -> List[int]:
         """client slots → comm ranks 1..client_num (round-robin when
@@ -83,25 +162,43 @@ class FedMLServerManager(FedMLCommManager):
 
     def handle_message_receive_model_from_client(self, msg: Message) -> None:
         sender = msg.get_sender_id()
-        model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
-        compressed = msg.get(MyMessage.MSG_ARG_KEY_COMPRESSED_UPDATE)
-        if model_params is None and compressed is not None:
-            # sparse delta: rebuild weights = global + Δ using OUR copy of
-            # the global model for the tree structure (no spec on the wire)
-            import jax
-
-            from ...utils.compression import TopKCompressor, tree_spec
-
-            global_model = self.aggregator.get_global_model_params()
-            delta = TopKCompressor().decompress(compressed,
-                                                tree_spec(global_model))
-            model_params = jax.tree_util.tree_map(
-                lambda g, d: g + d, global_model, delta)
         local_sample_number = msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
-        self.aggregator.add_local_trained_result(
-            sender - 1, model_params, local_sample_number)
-        if not self.aggregator.check_whether_all_receive():
-            return
+        with self._round_lock:
+            # stale check FIRST (and under the lock): a round the timeout
+            # already closed must not cost a decompression, and an on-time
+            # upload must not lose the race against the timer thread
+            upload_round = msg.get(MyMessage.MSG_ARG_KEY_ROUND)
+            if (upload_round is not None
+                    and int(upload_round) != int(self.args.round_idx)):
+                logging.warning("server: dropping stale round-%s upload "
+                                "from client %d (now round %d)",
+                                upload_round, sender, self.args.round_idx)
+                return
+            model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+            compressed = msg.get(MyMessage.MSG_ARG_KEY_COMPRESSED_UPDATE)
+            if model_params is None and compressed is not None:
+                # sparse delta: rebuild weights = global + Δ using OUR copy
+                # of the global model for the tree structure
+                import jax
+
+                from ...utils.compression import TopKCompressor, tree_spec
+
+                global_model = self.aggregator.get_global_model_params()
+                delta = TopKCompressor().decompress(
+                    compressed, tree_spec(global_model))
+                model_params = jax.tree_util.tree_map(
+                    lambda g, d: g + d, global_model, delta)
+            self.aggregator.add_local_trained_result(
+                sender - 1, model_params, local_sample_number)
+            if not self.aggregator.check_whether_all_receive():
+                return
+            self._complete_round()
+
+    def _complete_round(self) -> None:
+        """Aggregate (possibly a partial set), test, advance or finish.
+        Caller must hold ``_round_lock``."""
+        if self._round_timer is not None:
+            self._round_timer.cancel()
         mlops.event("server.wait", False, self.args.round_idx)
         self.aggregator.aggregate()
         freq = int(getattr(self.args, "frequency_of_the_test", 1) or 1)
@@ -116,6 +213,7 @@ class FedMLServerManager(FedMLCommManager):
             self.finish()
             return
         # next round
+        self._served_this_round = set()
         self.client_id_list_in_this_round = self.aggregator.client_sampling(
             self.args.round_idx, int(self.args.client_num_in_total),
             int(self.args.client_num_per_round))
@@ -130,6 +228,8 @@ class FedMLServerManager(FedMLCommManager):
                            self.client_id_list_in_this_round[i])
             msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.args.round_idx)
             self.send_message(msg)
+            self._served_this_round.add(receiver_rank)
+        self._arm_round_timer()
 
     def send_finish_to_all(self) -> None:
         for rank in range(1, self.client_num + 1):

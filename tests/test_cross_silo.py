@@ -227,3 +227,50 @@ def test_cross_silo_with_compressed_uploads(args_factory):
     m = server.aggregator.metrics_history[-1]
     assert np.isfinite(m["test_loss"])
     assert m["test_acc"] > 0.3  # sparse updates still learn
+
+
+def test_elastic_round_timeout_drops_straggler(args_factory):
+    """round_timeout_s: the server aggregates with the clients that
+    reported and completes training even when one client goes silent after
+    coming online (elastic membership / dropout tolerance)."""
+    import threading
+
+    import fedml_tpu
+    from fedml_tpu.core.distributed.communication.message import Message
+    from fedml_tpu.core.distributed.fedml_comm_manager import FedMLCommManager
+    from fedml_tpu.cross_silo.message_define import MyMessage
+    from fedml_tpu.cross_silo.runner import init_client, init_server
+
+    args = fedml_tpu.init(args_factory(
+        training_type="cross_silo", client_num_in_total=3,
+        client_num_per_round=3, comm_round=3, data_scale=0.3,
+        learning_rate=0.1, run_id="cs_elastic", round_timeout_s=2.0,
+        min_clients_per_round=2))
+    dataset = fedml_tpu.data.load(args)
+    bundle = fedml_tpu.model.create(args, dataset[-1])
+    server = init_server(args, dataset, bundle)
+    clients = [init_client(args, dataset, bundle, rank) for rank in (1, 2)]
+
+    # rank 3: comes ONLINE, then never trains or uploads (straggler)
+    class Silent(FedMLCommManager):
+        def register_message_receive_handlers(self):
+            self.register_message_receive_handler(
+                MyMessage.MSG_TYPE_S2C_FINISH, lambda m: self.finish())
+
+        def go(self):
+            self.register_message_receive_handlers()
+            msg = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, 3, 0)
+            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_STATUS,
+                           MyMessage.CLIENT_STATUS_ONLINE)
+            self.send_message(msg)
+            self.com_manager.handle_receive_message()
+
+    silent = Silent(args, rank=3, size=4)
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    threads.append(threading.Thread(target=silent.go, daemon=True))
+    for t in threads:
+        t.start()
+    server.run()  # must terminate despite the straggler
+    m = server.aggregator.metrics_history[-1]
+    assert np.isfinite(m["test_loss"])
+    assert m["test_acc"] > 0.3
