@@ -1,0 +1,88 @@
+"""Fault-injection (chaos) transport wrapper.
+
+NEW capability (SURVEY §5: the reference has "no systematic fault
+injection" — crash simulation only via attacks).  ChaosCommManager wraps
+any BaseCommunicationManager and injects, deterministically from a seed:
+
+* message DROPS (probability ``drop_p``),
+* DUPLICATES (``dup_p`` — the same message delivered twice),
+* DELAYS (``delay_p`` with uniform [0, max_delay_s] on a side thread, so
+  reordering happens naturally).
+
+Use it in tests to prove protocol robustness (elastic rounds, liveness,
+SecAgg dropout recovery) and register it as a custom backend for chaos
+smoke runs:
+
+    register_comm_backend("CHAOS_INPROC", lambda args, rank, size:
+        ChaosCommManager(InProcCommManager(rank, size, args.run_id),
+                         drop_p=0.1, seed=rank))
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, List
+
+import numpy as np
+
+from .base_com_manager import BaseCommunicationManager
+from .message import Message
+from .observer import Observer
+
+
+class ChaosCommManager(BaseCommunicationManager):
+    def __init__(self, inner: BaseCommunicationManager,
+                 drop_p: float = 0.0, dup_p: float = 0.0,
+                 delay_p: float = 0.0, max_delay_s: float = 0.2,
+                 seed: int = 0,
+                 protect_types: Any = ()) -> None:
+        self.inner = inner
+        self.drop_p = float(drop_p)
+        self.dup_p = float(dup_p)
+        self.delay_p = float(delay_p)
+        self.max_delay_s = float(max_delay_s)
+        self.rng = np.random.RandomState(seed)
+        # message types exempt from chaos (e.g. FINISH, so runs terminate)
+        self.protect_types = {str(t) for t in protect_types}
+        self.stats = {"sent": 0, "dropped": 0, "duplicated": 0, "delayed": 0}
+        self._rng_lock = threading.Lock()
+
+    # -- chaos on the SEND side ---------------------------------------------
+    def send_message(self, msg: Message) -> None:
+        self.stats["sent"] += 1
+        if str(msg.get_type()) in self.protect_types:
+            self.inner.send_message(msg)
+            return
+        with self._rng_lock:
+            roll_drop = self.rng.rand()
+            roll_dup = self.rng.rand()
+            roll_delay = self.rng.rand()
+            delay = self.rng.rand() * self.max_delay_s
+        if roll_drop < self.drop_p:
+            self.stats["dropped"] += 1
+            logging.debug("chaos: DROP %s", msg.get_type())
+            return
+        if roll_delay < self.delay_p:
+            self.stats["delayed"] += 1
+            t = threading.Timer(delay, self.inner.send_message, args=(msg,))
+            t.daemon = True
+            t.start()
+        else:
+            self.inner.send_message(msg)
+        if roll_dup < self.dup_p:
+            self.stats["duplicated"] += 1
+            self.inner.send_message(msg)
+
+    # -- passthrough ---------------------------------------------------------
+    def add_observer(self, observer: Observer) -> None:
+        self.inner.add_observer(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        self.inner.remove_observer(observer)
+
+    def handle_receive_message(self) -> None:
+        self.inner.handle_receive_message()
+
+    def stop_receive_message(self) -> None:
+        self.inner.stop_receive_message()

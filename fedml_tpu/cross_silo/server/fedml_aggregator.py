@@ -42,6 +42,9 @@ class FedMLAggregator:
     def receive_count(self) -> int:
         return len(self._received_this_round)
 
+    def has_received(self, index: int) -> bool:
+        return index in self._received_this_round
+
     def check_whether_all_receive(self) -> bool:
         return len(self._received_this_round) >= self.client_num
 

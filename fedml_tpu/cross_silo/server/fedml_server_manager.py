@@ -33,15 +33,17 @@ class FedMLServerManager(FedMLCommManager):
         self.is_initialized = False
         # elastic membership (new capability, SURVEY §7 item 10):
         # round_timeout_s > 0 → aggregate with whoever reported once the
-        # timer fires (≥ min_clients_per_round); late-online clients are
-        # caught up into the current round instead of blocking init forever
+        # timer fires (≥ min_clients_per_round); a timed-out round below the
+        # minimum RE-SOLICITS the missing clients before extending; init
+        # force-starts after the timeout once ≥ min clients are online
         self.round_timeout_s = float(
             getattr(args, "round_timeout_s", 0) or 0)
         self.min_clients = int(
             getattr(args, "min_clients_per_round", 1) or 1)
         self._round_lock = threading.RLock()
         self._round_timer: Optional[threading.Timer] = None
-        self._served_this_round: set = set()
+        self._init_timer: Optional[threading.Timer] = None
+        self._caught_up_this_round: set = set()
 
     def run(self) -> None:
         super().run()
@@ -58,31 +60,63 @@ class FedMLServerManager(FedMLCommManager):
     def handle_message_client_status_update(self, msg: Message) -> None:
         sender = msg.get_sender_id()
         status = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_STATUS)
-        if status == MyMessage.CLIENT_STATUS_ONLINE:
-            self.client_online_status[sender] = True
+        with self._round_lock:
+            # status dict is read by the init-timer thread under the lock;
+            # writing it under the lock too avoids mutating during iteration
+            if status == MyMessage.CLIENT_STATUS_ONLINE:
+                self.client_online_status[sender] = True
+            n_online = sum(self.client_online_status.values())
         logging.info("server: client %d status %s (%d/%d online)", sender,
-                     status, sum(self.client_online_status.values()),
-                     self.client_num)
-        if (len(self.client_online_status) == self.client_num
-                and not self.is_initialized):
-            mlops.log_aggregation_status("RUNNING")
-            self.is_initialized = True
-            self.send_init_msg()
-        elif self.is_initialized and status == \
-                MyMessage.CLIENT_STATUS_ONLINE:
-            # elastic late join: a client that came online after training
-            # started is caught up with the current round's model — but only
-            # if it wasn't already served this round (an ONLINE re-announce
-            # from a participating client must not trigger double training)
-            with self._round_lock:
+                     status, n_online, self.client_num)
+        with self._round_lock:
+            if not self.is_initialized:
+                if len(self.client_online_status) == self.client_num:
+                    self._start_training()
+                elif (self.round_timeout_s > 0
+                      and self._init_timer is None):
+                    # elastic init: don't block forever on a client that
+                    # never comes online — force-start after the timeout
+                    # once ≥ min clients are here
+                    self._init_timer = threading.Timer(
+                        self.round_timeout_s, self._maybe_force_init)
+                    self._init_timer.daemon = True
+                    self._init_timer.start()
+            elif status == MyMessage.CLIENT_STATUS_ONLINE:
+                # elastic late join: a (re)connecting client that hasn't
+                # uploaded this round is caught up with the round's model —
+                # at most ONCE per round (a duplicated ONLINE re-announce
+                # must not trigger a redundant full training pass; lost
+                # syncs are covered by the timeout's re-solicitation)
                 if (sender in self._ranks_for(
                         self.client_id_list_in_this_round)
-                        and sender not in self._served_this_round
-                        and (sender - 1) not in
-                        self.aggregator._received_this_round):
+                        and sender not in self._caught_up_this_round
+                        and not self.aggregator.has_received(sender - 1)):
                     logging.info("server: late-joining client %d caught up "
                                  "into round %d", sender, self.args.round_idx)
-                    self._send_round_to(sender)
+                    self._caught_up_this_round.add(sender)
+                    self._broadcast_round(only_rank=sender)
+
+    def _maybe_force_init(self) -> None:
+        with self._round_lock:
+            self._init_timer = None
+            if self.is_initialized:
+                return
+            online = sum(self.client_online_status.values())
+            if online >= self.min_clients:
+                logging.warning(
+                    "server: init timeout — starting with %d/%d clients "
+                    "online", online, self.client_num)
+                self._start_training()
+            else:  # keep waiting, check again after another timeout
+                self._init_timer = threading.Timer(
+                    self.round_timeout_s, self._maybe_force_init)
+                self._init_timer.daemon = True
+                self._init_timer.start()
+
+    def _start_training(self) -> None:
+        mlops.log_aggregation_status("RUNNING")
+        self.is_initialized = True
+        self.send_init_msg()
 
     def send_init_msg(self) -> None:
         self.client_id_list_in_this_round = self.aggregator.client_sampling(
@@ -91,38 +125,27 @@ class FedMLServerManager(FedMLCommManager):
         self.data_silo_index_of_client = self.aggregator.data_silo_selection(
             self.args.round_idx, int(self.args.client_num_in_total),
             len(self.client_id_list_in_this_round))
+        self._broadcast_round()
+        self._arm_round_timer()
+
+    def _broadcast_round(self, only_rank: Optional[int] = None) -> None:
+        """Send the current round's model to every participating rank (or
+        just ``only_rank`` for re-solicitation/late-join catch-up) — one
+        message per slot a rank serves.  Caller holds ``_round_lock``."""
+        mtype = (MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT
+                 if self.args.round_idx else
+                 MyMessage.MSG_TYPE_S2C_INIT_CONFIG)
         global_model = self.aggregator.get_global_model_params()
-        for i, receiver_rank in enumerate(
+        for i, rank in enumerate(
                 self._ranks_for(self.client_id_list_in_this_round)):
-            msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG,
-                          self.get_sender_id(), receiver_rank)
+            if only_rank is not None and rank != only_rank:
+                continue
+            msg = Message(mtype, self.get_sender_id(), rank)
             msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_model)
             msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
                            self.client_id_list_in_this_round[i])
             msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.args.round_idx)
             self.send_message(msg)
-            self._served_this_round.add(receiver_rank)
-        self._arm_round_timer()
-
-    def _send_round_to(self, receiver_rank: int) -> None:
-        """(Re)send the current round's sync message(s) to one client — one
-        per slot it serves (a rank can hold several slots when the mapping
-        round-robins)."""
-        ranks = self._ranks_for(self.client_id_list_in_this_round)
-        mtype = (MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT
-                 if self.args.round_idx else
-                 MyMessage.MSG_TYPE_S2C_INIT_CONFIG)
-        for i, rank in enumerate(ranks):
-            if rank != receiver_rank:
-                continue
-            msg = Message(mtype, self.get_sender_id(), receiver_rank)
-            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
-                           self.aggregator.get_global_model_params())
-            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
-                           self.client_id_list_in_this_round[i])
-            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.args.round_idx)
-            self.send_message(msg)
-        self._served_this_round.add(receiver_rank)
 
     # -- elastic round timeout ----------------------------------------------
     def _arm_round_timer(self) -> None:
@@ -142,10 +165,19 @@ class FedMLServerManager(FedMLCommManager):
                 return  # round already completed normally
             got = self.aggregator.receive_count()
             if got < self.min_clients:
+                # RE-SOLICIT the ranks that haven't reported (their sync or
+                # upload may have been lost), then extend the deadline —
+                # without this a lossy link could extend forever with idle
+                # clients that never got the round
+                missing = [r for r in set(self._ranks_for(
+                    self.client_id_list_in_this_round))
+                    if not self.aggregator.has_received(r - 1)]
                 logging.warning(
-                    "server: round %d timeout with only %d/%d results "
-                    "(< min %d) — extending", round_idx, got,
-                    len(self.client_id_list_in_this_round), self.min_clients)
+                    "server: round %d timeout with only %d results "
+                    "(< min %d) — re-soliciting %s and extending",
+                    round_idx, got, self.min_clients, missing)
+                for rank in missing:
+                    self._broadcast_round(only_rank=rank)
                 self._arm_round_timer()
                 return
             logging.warning(
@@ -190,9 +222,27 @@ class FedMLServerManager(FedMLCommManager):
                     lambda g, d: g + d, global_model, delta)
             self.aggregator.add_local_trained_result(
                 sender - 1, model_params, local_sample_number)
-            if not self.aggregator.check_whether_all_receive():
+            if self.aggregator.check_whether_all_receive():
+                self._complete_round()
                 return
-            self._complete_round()
+            # elastic early completion: when every ONLINE participant has
+            # reported, don't idle out the full timeout waiting for ranks
+            # the server already knows are absent
+            if self.round_timeout_s > 0:
+                ranks = set(self._ranks_for(self.client_id_list_in_this_round))
+                online = {r for r in ranks
+                          if self.client_online_status.get(r)}
+                if (online
+                        and all(self.aggregator.has_received(r - 1)
+                                for r in online)
+                        and self.aggregator.receive_count()
+                        >= self.min_clients):
+                    logging.info(
+                        "server: round %d — all %d online participants "
+                        "reported; completing without waiting for %d "
+                        "offline", self.args.round_idx, len(online),
+                        len(ranks - online))
+                    self._complete_round()
 
     def _complete_round(self) -> None:
         """Aggregate (possibly a partial set), test, advance or finish.
@@ -213,22 +263,12 @@ class FedMLServerManager(FedMLCommManager):
             self.finish()
             return
         # next round
-        self._served_this_round = set()
+        self._caught_up_this_round = set()
         self.client_id_list_in_this_round = self.aggregator.client_sampling(
             self.args.round_idx, int(self.args.client_num_in_total),
             int(self.args.client_num_per_round))
-        global_model = self.aggregator.get_global_model_params()
         mlops.event("server.wait", True, self.args.round_idx)
-        for i, receiver_rank in enumerate(
-                self._ranks_for(self.client_id_list_in_this_round)):
-            msg = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
-                          self.get_sender_id(), receiver_rank)
-            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_model)
-            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
-                           self.client_id_list_in_this_round[i])
-            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.args.round_idx)
-            self.send_message(msg)
-            self._served_this_round.add(receiver_rank)
+        self._broadcast_round()
         self._arm_round_timer()
 
     def send_finish_to_all(self) -> None:

@@ -274,3 +274,33 @@ def test_elastic_round_timeout_drops_straggler(args_factory):
     m = server.aggregator.metrics_history[-1]
     assert np.isfinite(m["test_loss"])
     assert m["test_acc"] > 0.3
+
+
+def test_elastic_init_force_start_without_all_clients(args_factory):
+    """A client that NEVER comes online must not block init forever when
+    round_timeout_s is set: the server force-starts with min_clients."""
+    import threading
+
+    import fedml_tpu
+    from fedml_tpu.cross_silo.runner import init_client, init_server
+
+    args = fedml_tpu.init(args_factory(
+        training_type="cross_silo", client_num_in_total=3,
+        client_num_per_round=3, comm_round=2, data_scale=0.3,
+        learning_rate=0.1, run_id="cs_forceinit", round_timeout_s=1.0,
+        min_clients_per_round=2))
+    dataset = fedml_tpu.data.load(args)
+    bundle = fedml_tpu.model.create(args, dataset[-1])
+    server = init_server(args, dataset, bundle)
+    # only ranks 1 and 2 ever start; rank 3 is absent entirely
+    clients = [init_client(args, dataset, bundle, rank) for rank in (1, 2)]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    done = threading.Event()
+    t = threading.Thread(target=lambda: (server.run(), done.set()),
+                         daemon=True)
+    t.start()
+    assert done.wait(60), "server never finished — init blocked"
+    m = server.aggregator.metrics_history[-1]
+    assert np.isfinite(m["test_loss"])

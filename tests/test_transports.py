@@ -246,3 +246,55 @@ def test_mqtt_backend_carries_compressed_updates(args_factory, tmp_path):
     assert received.get("num_samples") == 7
     m1.com_manager.stop_receive_message()
     m0.com_manager.stop_receive_message()
+
+
+def test_chaos_transport_elastic_cross_silo_survives(args_factory):
+    """Fault injection: with 15% message drops and duplicates on every
+    link, the elastic cross-silo protocol still completes all rounds
+    (dropped syncs/uploads are absorbed by the round timeout; duplicate
+    uploads dedup via the per-round received set)."""
+    import threading
+
+    import fedml_tpu
+    from fedml_tpu.core.distributed.communication.chaos import (
+        ChaosCommManager,
+    )
+    from fedml_tpu.core.distributed.communication.inprocess import (
+        InProcCommManager,
+    )
+    from fedml_tpu.core.distributed.fedml_comm_manager import (
+        register_comm_backend,
+    )
+    from fedml_tpu.cross_silo.runner import init_client, init_server
+
+    chaos_instances = []
+
+    def chaos_factory(args, rank=0, size=0):
+        mgr = ChaosCommManager(
+            InProcCommManager(rank, size, str(args.run_id)),
+            drop_p=0.15, dup_p=0.15, delay_p=0.2, max_delay_s=0.05,
+            seed=100 + rank, protect_types=("S2C_FINISH",))
+        chaos_instances.append(mgr)
+        return mgr
+
+    register_comm_backend("CHAOS_INPROC", chaos_factory)
+
+    args = fedml_tpu.init(args_factory(
+        training_type="cross_silo", client_num_in_total=3,
+        client_num_per_round=3, comm_round=4, data_scale=0.3,
+        learning_rate=0.1, run_id="cs_chaos", round_timeout_s=1.5,
+        min_clients_per_round=1))
+    dataset = fedml_tpu.data.load(args)
+    bundle = fedml_tpu.model.create(args, dataset[-1])
+    server = init_server(args, dataset, bundle, backend="CHAOS_INPROC")
+    clients = [init_client(args, dataset, bundle, rank,
+                           backend="CHAOS_INPROC") for rank in (1, 2, 3)]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    server.run()
+    m = server.aggregator.metrics_history[-1]
+    assert np.isfinite(m["test_loss"])
+    total_chaos = sum(c.stats["dropped"] + c.stats["duplicated"]
+                      for c in chaos_instances)
+    assert total_chaos > 0, "chaos never fired — test proves nothing"
