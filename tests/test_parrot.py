@@ -78,3 +78,43 @@ def test_run_rounds_fused_chunking_and_noop(args_factory):
     assert tl.shape == (api.FUSED_CHUNK_ROUNDS * 2 + 3,)
     assert np.isfinite(tl).all() and tl[-1] < tl[0]
     jax.block_until_ready(api.run_rounds_fused(2))  # still alive
+
+
+def test_train_fused_rounds_option(args_factory):
+    import fedml_tpu
+    from fedml_tpu.runner import FedMLRunner
+
+    args = fedml_tpu.init(args_factory(
+        backend="parrot", dataset="mnist", model="lr", data_scale=0.1,
+        client_num_in_total=8, client_num_per_round=8, comm_round=10,
+        fused_rounds=True, frequency_of_the_test=5, learning_rate=0.1))
+    dataset = fedml_tpu.data.load(args)
+    bundle = fedml_tpu.model.create(args, dataset[-1])
+    m = FedMLRunner(args, None, dataset, bundle).run()
+    assert m["round"] == 9
+    assert np.isfinite(m["test_loss"])
+    assert m["test_acc"] > 0.5
+
+
+def test_train_fused_checkpoint_resume(args_factory, tmp_path):
+    import fedml_tpu
+    from fedml_tpu.runner import FedMLRunner
+
+    def build(rounds):
+        args = fedml_tpu.init(args_factory(
+            backend="parrot", dataset="mnist", model="lr", data_scale=0.1,
+            client_num_in_total=8, client_num_per_round=8,
+            comm_round=rounds, fused_rounds=True, frequency_of_the_test=4,
+            checkpoint_dir=str(tmp_path / "ck"), learning_rate=0.1))
+        dataset = fedml_tpu.data.load(args)
+        bundle = fedml_tpu.model.create(args, dataset[-1])
+        return FedMLRunner(args, None, dataset, bundle)
+
+    m1 = build(8).run()
+    assert m1["round"] == 7
+    # a fresh runner resumes from the saved round instead of round 0
+    runner2 = build(12)
+    m2 = runner2.run()
+    assert m2["round"] == 11
+    rounds_run = [m["round"] for m in runner2.runner.metrics_history]
+    assert min(rounds_run) > 7  # did NOT start over
