@@ -89,3 +89,43 @@ class MeshManager:
     @classmethod
     def reset(cls) -> None:
         cls._instance = None
+
+
+def build_hybrid_mesh(ici_shape: Dict[str, int],
+                      dcn_shape: Optional[Dict[str, int]] = None,
+                      devices: Optional[Sequence[Any]] = None) -> Mesh:
+    """Multi-slice mesh: ``dcn_shape`` axes span slices over DCN (slow,
+    host-to-host), ``ici_shape`` axes stay inside a slice on ICI (fast).
+
+    This is the SURVEY §5 plan item (b): "inter-host within a slice = XLA's
+    DCN-aware collectives via multi-slice meshes".  Layout rule: put
+    pure-data/client parallelism on the DCN axes (one allreduce per step,
+    bandwidth-tolerant) and model/seq/expert axes on ICI (latency-bound
+    collectives).  Uses `mesh_utils.create_hybrid_device_mesh` when more
+    than one slice is present; with a single slice (or CPU testing) the DCN
+    axes become ordinary mesh axes over local devices, so the same pjit
+    program runs unchanged at every scale.
+    """
+    ici_shape = dict(ici_shape or {})
+    dcn_shape = dict(dcn_shape or {})
+    overlap = set(ici_shape) & set(dcn_shape)
+    if overlap:
+        raise ValueError(f"axes {sorted(overlap)} appear in BOTH ici_shape "
+                         f"and dcn_shape; each axis lives on one fabric")
+    devices = list(devices if devices is not None else jax.devices())
+    n_slices = len({getattr(d, "slice_index", 0) for d in devices})
+
+    if ici_shape and dcn_shape and n_slices > 1:
+        from jax.experimental import mesh_utils
+
+        ici_names, ici_sizes = zip(*ici_shape.items())
+        dcn_names, dcn_sizes = zip(*dcn_shape.items())
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=tuple(ici_sizes) + (1,) * len(dcn_sizes),
+            dcn_mesh_shape=(1,) * len(ici_sizes) + tuple(dcn_sizes),
+            devices=devices)
+        return Mesh(dev_array, axis_names=tuple(ici_names) + tuple(dcn_names))
+    # single slice: DCN axes become ordinary local axes (same program)
+    shape = dict(ici_shape)
+    shape.update(dcn_shape)
+    return build_mesh(shape, devices=devices)

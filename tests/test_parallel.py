@@ -1,6 +1,8 @@
 """Parallelism layer on the virtual 8-device CPU mesh: ring attention parity,
 TP/FSDP sharding rules, pipeline schedule, MoE routing."""
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -145,3 +147,29 @@ def test_ulysses_attention_matches_full_attention():
         ref = reference_attention(q, k, v, causal=causal)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-4, rtol=2e-4)
+
+
+def test_hybrid_mesh_single_slice_fallback():
+    """On one slice (CPU test devices) the DCN axes collapse to size 1 and
+    the same sharding program runs; collectives still compile over both
+    axis names."""
+    from fedml_tpu.ml.engine.mesh import build_hybrid_mesh
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="BOTH"):
+        build_hybrid_mesh({"data": 2}, {"data": 4})
+    mesh = build_hybrid_mesh({"model": 4}, {"data": 2})
+    assert mesh.axis_names == ("model", "data")
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        "model": 4, "data": 2}
+
+    # psum over BOTH axes (the dp-over-dcn + tp-over-ici layout)
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("data", "model"),
+             out_specs=P(None, None), check_vma=False)
+    def total(x):
+        return jax.lax.psum(jax.lax.psum(x, "model"), "data")
+
+    x = jnp.arange(8.0).reshape(2, 4)
+    out = jax.jit(total)(x)
+    np.testing.assert_allclose(np.asarray(out)[0, 0], x.sum())
