@@ -45,7 +45,7 @@ from ...constants import (
 from ...core import mlops
 from ...ml.aggregator.agg_operator import agg_stacked
 from ...ml.engine.local_update import build_eval_step, build_local_update, make_batches
-from ...ml.engine.mesh import MeshManager, build_mesh
+from ...ml.engine.mesh import MeshManager, build_hybrid_mesh, build_mesh
 from ...ml.engine.optimizers import build_server_optimizer
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -123,9 +123,13 @@ class ParrotAPI:
         # ---- mesh ----------------------------------------------------------
         self.mesh = None
         if use_mesh:
+            dcn = dict(getattr(args, "dcn_mesh_shape", None) or {})
+            dcn_prod = int(np.prod(list(dcn.values()))) if dcn else 1
             shape = getattr(args, "mesh_shape", None) or {
-                AXIS_CLIENTS: min(len(jax.devices()), self.k)}
-            self.mesh = build_mesh(shape)
+                AXIS_CLIENTS: max(
+                    min(len(jax.devices()) // dcn_prod, self.k), 1)}
+            self.mesh = (build_hybrid_mesh(shape, dcn) if dcn
+                         else build_mesh(shape))
 
         self.round_step = jax.jit(self._build_round_step(),
                                   donate_argnums=(0, 1))
@@ -153,7 +157,10 @@ class ParrotAPI:
         algo = self.algo
         bs, nb, cap = self.bs, self.nb, self.nb * self.bs
         mesh = self.mesh
-        clients_sharding = (NamedSharding(mesh, P(AXIS_CLIENTS))
+        # the client axis shards over EVERY mesh axis (clients is parrot's
+        # only parallel dimension, so a DCN axis extends it across slices
+        # rather than replicating the round)
+        clients_sharding = (NamedSharding(mesh, P(tuple(mesh.axis_names)))
                             if mesh is not None else None)
 
         def gather_batches(client_ids):
