@@ -1,0 +1,60 @@
+"""Chatbot serving template (reference `serving/templates/hf_template/
+main_openai.py`): fine-tune a small LM with LoRA, then serve it behind the
+OpenAI-compatible chat API via the continuous-batching engine.
+
+Usage: PYTHONPATH=. python examples/serving_chatbot/main.py [--port 8000]
+Then point any OpenAI SDK client at http://127.0.0.1:<port>/v1 .
+"""
+
+import sys
+
+import jax
+import numpy as np
+
+import fedml_tpu
+from fedml_tpu.data.datasets import shakespeare_sequences
+from fedml_tpu.models import model_hub
+from fedml_tpu.serving.llm_engine import BatchedLLMEngine, LLMEnginePredictor
+from fedml_tpu.serving.openai_api import OpenAIServer
+from fedml_tpu.train.llm.trainer import LLMTrainConfig, LLMTrainer
+
+
+def main(port: int = 8000) -> None:
+    args = fedml_tpu.Config(model="transformer", dataset="shakespeare",
+                            compute_dtype="float32")
+    bundle = model_hub.create(args, 90)
+
+    # 1) brief LoRA fine-tune on ONE contiguous char stream (concatenating
+    # randomly-sampled windows would corrupt targets at every seam)
+    cfg = LLMTrainConfig(seq_len=32, batch_size=8, epochs=1, use_lora=True,
+                         lora_rank=4, learning_rate=1e-3)
+    trainer = LLMTrainer(bundle, cfg, rng=jax.random.PRNGKey(0))
+    stream, _, _, _ = shakespeare_sequences(seq_len=512 * 33, n_train=1,
+                                            n_test=1)
+    metrics = trainer.train(np.asarray(stream).reshape(-1))
+    print("fine-tune:", metrics)
+
+    # 2) serve the (LoRA-merged) model
+    from fedml_tpu.train.llm.lora import merge_lora
+
+    variables = dict(trainer.variables,
+                     params=merge_lora(trainer.variables["params"],
+                                       trainer.lora, cfg.lora_alpha))
+    engine = BatchedLLMEngine(bundle, variables, max_batch=8, window=32)
+    server = OpenAIServer(LLMEnginePredictor(engine),
+                          model_name="shakespeare-tiny", port=port)
+    print(f"serving on http://127.0.0.1:{port}/v1/chat/completions")
+    try:
+        server.run(block=True)
+    finally:
+        engine.stop()
+
+
+if __name__ == "__main__":
+    port = 8000
+    if "--port" in sys.argv:
+        try:
+            port = int(sys.argv[sys.argv.index("--port") + 1])
+        except (IndexError, ValueError):
+            sys.exit("usage: main.py [--port <int>]")
+    main(port)
