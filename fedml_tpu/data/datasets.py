@@ -204,6 +204,103 @@ def stackoverflow_lr_bow(n_train: int = 4000, n_test: int = 800,
     return xt, yt, xe, ye
 
 
+def leaf_synthetic(alpha: float, beta: float, n_features: int = 60,
+                   n_classes: int = 10, n_clusters: int = 10,
+                   n_train: int = 2000, n_test: int = 500,
+                   seed: int = 0) -> Arrays:
+    """LEAF SYNTHETIC(α, β) (reference `data/synthetic_0_0`,
+    `data/synthetic_0.5_0.5`, `data/synthetic_1_1`): α scales how much each
+    latent client cluster's model (W_k, b_k) deviates from a shared model,
+    β scales how much each cluster's input distribution mean v_k deviates
+    from zero; y = argmax(W_k x + b_k)."""
+    rng = np.random.RandomState(seed)
+    # per-cluster model deviations (full per-entry draws, as in LEAF's
+    # W_k ~ N(u_k, 1): a scalar offset would shift every class logit
+    # equally and never change argmax labels)
+    dW = rng.randn(n_clusters, n_features, n_classes).astype(np.float32)
+    db = rng.randn(n_clusters, n_classes).astype(np.float32)
+    v = rng.randn(n_clusters)          # per-cluster feature-mean offsets
+    W0 = rng.randn(n_features, n_classes).astype(np.float32)
+    b0 = rng.randn(n_classes).astype(np.float32)
+
+    def make(n):
+        k = rng.randint(0, n_clusters, size=n)
+        x = (rng.randn(n, n_features) + beta * v[k][:, None]).astype(
+            np.float32)
+        Wk = W0[None] + alpha * dW[k]
+        bk = b0[None] + alpha * db[k]
+        logits = np.einsum("nf,nfc->nc", x, Wk) + bk
+        return x, np.argmax(logits, axis=1).astype(np.int64)
+
+    xt, yt = make(n_train)
+    xe, ye = make(n_test)
+    return xt, yt, xe, ye
+
+
+def nus_wide_features(n_train: int = 4000, n_test: int = 800,
+                      seed: int = 0, n_low: int = 634, n_tag: int = 1000,
+                      n_classes: int = 5) -> Arrays:
+    """NUS-WIDE two-view features for vertical FL (reference
+    `data/NUS_WIDE/nus_wide_data_loader.py`: 634-d low-level image features
+    + 1000-d tag features, 5 selected label classes).  The two feature
+    blocks are concatenated [image | tags]; VFL parties split on columns."""
+    rng = np.random.RandomState(seed)
+    d = n_low + n_tag
+    centers = rng.randn(n_classes, d).astype(np.float32)
+
+    def make(n):
+        y = rng.randint(0, n_classes, size=n)
+        x = centers[y] + rng.randn(n, d).astype(np.float32)
+        # tag block is sparse non-negative counts in the real data
+        x[:, n_low:] = np.maximum(x[:, n_low:] - 1.0, 0.0)
+        return x.astype(np.float32), y.astype(np.int64)
+
+    xt, yt = make(n_train)
+    xe, ye = make(n_test)
+    return xt, yt, xe, ye
+
+
+def lending_club_tabular(n_train: int = 4000, n_test: int = 1000,
+                         seed: int = 0, n_features: int = 90) -> Arrays:
+    """lending-club loan-default binary classification (reference
+    `data/lending_club_loan/` — finance VFL demo); synthetic logistic
+    ground truth over 90 numeric features with class imbalance ~0.2."""
+    rng = np.random.RandomState(seed)
+    w = rng.randn(n_features).astype(np.float32)
+
+    def make(n):
+        x = rng.randn(n, n_features).astype(np.float32)
+        score = (x @ w) / np.sqrt(n_features) * 3.0 - 1.4  # ~20% positives
+        p = 1.0 / (1.0 + np.exp(-score))
+        return x, (rng.rand(n) < p).astype(np.int64)
+
+    xt, yt = make(n_train)
+    xe, ye = make(n_test)
+    return xt, yt, xe, ye
+
+
+def text_topic_bow(n_train: int = 3000, n_test: int = 600, seed: int = 0,
+                   vocab: int = 5000, n_topics: int = 20) -> Arrays:
+    """Topic-classification bag-of-words (reference `data/fednlp/` text
+    classification tasks, 20news-style: 20 topics).  Each topic has a
+    characteristic word distribution so linear/MLP models are learnable."""
+    rng = np.random.RandomState(seed)
+    topic_words = rng.randint(0, vocab, size=(n_topics, 15))
+
+    def make(n):
+        y = rng.randint(0, n_topics, size=n)
+        x = np.zeros((n, vocab), np.float32)
+        rows = np.repeat(np.arange(n), 15)
+        np.add.at(x, (rows, topic_words[y].ravel()), 1.0)
+        noise = rng.randint(0, vocab, size=(n, 8))
+        np.add.at(x, (np.repeat(np.arange(n), 8), noise.ravel()), 1.0)
+        return x / np.maximum(x.sum(1, keepdims=True), 1.0), y.astype(np.int64)
+
+    xt, yt = make(n_train)
+    xe, ye = make(n_test)
+    return xt, yt, xe, ye
+
+
 def edge_case_poison(x: np.ndarray, y: np.ndarray, n_classes: int,
                      target_label: int = 1, frac: float = 0.05,
                      seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
@@ -280,9 +377,34 @@ def load_arrays(dataset: str, cache_dir: str, seed: int = 0,
         px, py = edge_case_poison(xt, yt, classes, seed=seed)
         return (np.concatenate([xt, px]), np.concatenate([yt, py]),
                 xe, ye), classes
-    if dataset == "synthetic_seg":
-        return synthetic_segmentation(sz(800), sz(160), seed), 4
-    if dataset == "adult":
+    if dataset in ("synthetic_seg", "fets2021", "autonomous_driving"):
+        # fets2021: federated brain-tumor segmentation (reference
+        # `data/FeTS2021/`); autonomous_driving: street-scene segmentation
+        # (reference `data/AutonomousDriving/`) — both map to the per-pixel
+        # CE segmentation engine on synthetic masks in the zero-egress image
+        size = 24 if dataset == "synthetic_seg" else 32
+        return synthetic_segmentation(sz(800), sz(160), seed, size=size), 4
+    if dataset in ("adult", "uci", "uci_adult"):
+        # reference `data/UCI/` adult-census loader
         return adult_tabular(sz(4000), sz(1000), seed), 2
+    if dataset == "reddit":
+        # reference `data/reddit/` next-word-prediction, 10k BPE vocab
+        xt, yt, xe, ye = shakespeare_sequences(20, sz(2000), sz(400), seed)
+        return (xt % 10000, yt % 10000, xe % 10000, ye % 10000), 10000
+    if dataset in ("fednlp", "20news", "agnews"):
+        return text_topic_bow(sz(3000), sz(600), seed), 20
+    if dataset in ("nus_wide", "nus-wide"):
+        return nus_wide_features(sz(4000), sz(800), seed), 5
+    if dataset in ("lending_club_loan", "lending_club"):
+        return lending_club_tabular(sz(4000), sz(1000), seed), 2
+    if dataset.startswith("synthetic_") and dataset != "synthetic_seg":
+        # LEAF SYNTHETIC(α,β) names: synthetic_0_0 / _0.5_0.5 / _1_1
+        parts = dataset.split("_")[1:]
+        try:
+            a, b = float(parts[0]), float(parts[1])
+        except (IndexError, ValueError):
+            a = b = 0.0
+        return leaf_synthetic(a, b, n_train=sz(2000), n_test=sz(500),
+                              seed=seed), 10
     # default synthetic
     return synthetic_classification(60, 10, sz(2000), sz(500), seed), 10

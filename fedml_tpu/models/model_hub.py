@@ -53,6 +53,18 @@ _DATASET_SHAPES = {
     "synthetic_seg": ((24, 24, 3), 4, TASK_CLASSIFICATION),
     "gld23k": ((96, 96, 3), 203, TASK_CLASSIFICATION),
     "gld160k": ((96, 96, 3), 2028, TASK_CLASSIFICATION),
+    "fets2021": ((32, 32, 3), 4, TASK_CLASSIFICATION),
+    "autonomous_driving": ((32, 32, 3), 4, TASK_CLASSIFICATION),
+    "uci": ((105,), 2, TASK_BINARY),
+    "uci_adult": ((105,), 2, TASK_BINARY),
+    "reddit": ((20,), 10000, TASK_LM),
+    "fednlp": ((5000,), 20, TASK_CLASSIFICATION),
+    "20news": ((5000,), 20, TASK_CLASSIFICATION),
+    "agnews": ((5000,), 20, TASK_CLASSIFICATION),
+    "nus_wide": ((1634,), 5, TASK_CLASSIFICATION),
+    "nus-wide": ((1634,), 5, TASK_CLASSIFICATION),
+    "lending_club_loan": ((90,), 2, TASK_BINARY),
+    "lending_club": ((90,), 2, TASK_BINARY),
 }
 
 
@@ -60,6 +72,9 @@ def dataset_meta(dataset: str) -> Tuple[Tuple[int, ...], int, str]:
     name = str(dataset).lower()
     # poisoned variants share the base dataset's contract (data/datasets.py)
     name = name.replace("edge_case_", "").replace("_poisoned", "") or name
+    if name.startswith("synthetic_") and name not in _DATASET_SHAPES:
+        # LEAF SYNTHETIC(α,β) variants share the base synthetic contract
+        return _DATASET_SHAPES["synthetic"]
     return _DATASET_SHAPES.get(name, ((32, 32, 3), 10, TASK_CLASSIFICATION))
 
 

@@ -30,30 +30,41 @@ from ...core import mlops
 
 class _PartyDense(nn.Module):
     features: int
+    n_out: int = 1
 
     @nn.compact
     def __call__(self, x):
         h = nn.Dense(self.features)(x)
         h = nn.relu(h)
-        return nn.Dense(1)(h)  # logit contribution
+        return nn.Dense(self.n_out)(h)  # logit contribution(s)
 
 
 class VerticalFLAPI:
-    """Two-party classical VFL on a binary-label tabular dataset."""
+    """Two-party classical VFL on a label-holder/host feature split.
+
+    Binary datasets (adult, lending_club) keep the reference's logistic
+    formulation (scalar logit sum + sigmoid BCE); multiclass datasets
+    (NUS-WIDE, 5 classes) generalize to per-class logit contributions
+    summed across parties + softmax CE — same wire contract (only
+    logits/grad-of-logits cross the party boundary)."""
 
     def __init__(self, args: Any, device: Any, dataset: Tuple, bundle: Any):
         self.args = args
         (_, _, (x_tr, y_tr), (x_te, y_te), *_rest) = dataset
+        class_num = int(_rest[-1]) if _rest else 2
+        self.multiclass = class_num > 2
+        n_out = class_num if self.multiclass else 1
         d = x_tr.shape[1]
         self.split = d // 2
         self.x_a, self.x_b = x_tr[:, :self.split], x_tr[:, self.split:]
-        self.y = np.asarray(y_tr, np.float32)
+        self.y = np.asarray(y_tr, np.int32 if self.multiclass else np.float32)
         self.xte_a, self.xte_b = x_te[:, :self.split], x_te[:, self.split:]
-        self.yte = np.asarray(y_te, np.float32)
+        self.yte = np.asarray(y_te,
+                              np.int32 if self.multiclass else np.float32)
 
         hidden = int(getattr(args, "vfl_hidden", 32) or 32)
-        self.party_a = _PartyDense(hidden)   # guest (holds labels)
-        self.party_b = _PartyDense(hidden)   # host
+        self.party_a = _PartyDense(hidden, n_out)   # guest (holds labels)
+        self.party_b = _PartyDense(hidden, n_out)   # host
         k = jax.random.PRNGKey(int(getattr(args, "random_seed", 0) or 0))
         ka, kb = jax.random.split(k)
         self.params_a = self.party_a.init(ka, jnp.zeros((1, self.split)))
@@ -67,17 +78,26 @@ class VerticalFLAPI:
         self.metrics_history: List[Dict[str, Any]] = []
 
         # party-local jitted steps; only logits/grad-of-logits cross parties
+        multiclass = self.multiclass
+
+        def _squeeze(logits):
+            return logits if multiclass else logits[:, 0]
+
         @jax.jit
         def forward_a(params, x):
-            return self.party_a.apply(params, x)[:, 0]
+            return _squeeze(self.party_a.apply(params, x))
 
         @jax.jit
         def forward_b(params, x):
-            return self.party_b.apply(params, x)[:, 0]
+            return _squeeze(self.party_b.apply(params, x))
 
         @jax.jit
         def guest_loss_and_glogit(logit_sum, y):
             def f(ls):
+                if multiclass:
+                    return jnp.mean(
+                        optax.softmax_cross_entropy_with_integer_labels(
+                            ls, y))
                 return jnp.mean(optax.sigmoid_binary_cross_entropy(ls, y))
             loss, g = jax.value_and_grad(f)(logit_sum)
             return loss, g
@@ -89,7 +109,7 @@ class VerticalFLAPI:
             # vjp of the party's logit w.r.t. its params given upstream grad
             def f(p):
                 mod = self.party_a if apply_fn_tag == 0 else self.party_b
-                return mod.apply(p, x)[:, 0]
+                return _squeeze(mod.apply(p, x))
             _, vjp = jax.vjp(f, params)
             return vjp(g_logit)[0]
 
@@ -132,6 +152,9 @@ class VerticalFLAPI:
     def _evaluate(self) -> float:
         la = self._forward_a(self.params_a, jnp.asarray(self.xte_a))
         lb = self._forward_b(self.params_b, jnp.asarray(self.xte_b))
+        if self.multiclass:
+            pred = np.asarray(jnp.argmax(la + lb, axis=-1))
+            return float((pred == self.yte).mean())
         pred = (np.asarray(la + lb) > 0).astype(np.float32)
         return float((pred == self.yte).mean())
 

@@ -123,6 +123,14 @@ def test_new_models_forward(name, dataset):
     ("stackoverflow_lr", 500, (10004,)),
     ("gld23k", 203, (96, 96, 3)),
     ("synthetic_seg", 4, (24, 24, 3)),
+    ("synthetic_0.5_0.5", 10, (60,)),
+    ("synthetic_1_1", 10, (60,)),
+    ("nus_wide", 5, (1634,)),
+    ("lending_club_loan", 2, (90,)),
+    ("fednlp", 20, (5000,)),
+    ("uci", 2, (105,)),
+    ("reddit", 10000, (20,)),
+    ("fets2021", 4, (32, 32, 3)),
 ])
 def test_new_datasets(ds, classes, shape_tail):
     from fedml_tpu.data.datasets import load_arrays
