@@ -1,0 +1,163 @@
+"""Flash-attention Pallas TPU kernel.
+
+The long-context path (`parallel/ring_attention.py`, `parallel/ulysses.py`,
+the transformer/ViT zoo and the LLM engine) computes attention per shard.
+XLA materializes the full [T, T] score matrix in HBM for the naive einsum
+formulation; this kernel runs the online-softmax (flash) recurrence with the
+score block resident in VMEM, so HBM traffic stays O(T·D) — the standard
+TPU treatment of the one genuinely bandwidth-bound matmul-adjacent op
+(/opt/skills/guides/pallas_guide.md).
+
+Semantics match `parallel.ring_attention.reference_attention` exactly
+(same masking convention).  Dispatch:
+
+* on TPU → the pallas kernel;
+* off TPU with ``interpret=True`` (tests) → the same kernel through the
+  pallas interpreter;
+* otherwise → a jnp fallback with identical math.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def _reference(q, k, v, causal):
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        t = q.shape[2]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, o_acc, l_acc, m_acc, *,
+                  block_q: int, block_k: int, t_valid: int, causal: bool,
+                  scale: float, nk: int):
+    """Grid (BH, nq, nk), k innermost: VMEM scratch carries the
+    online-softmax accumulators across k steps, so only one [bq, D] q tile
+    and one [bk, D] k/v tile are VMEM-resident at a time (scales to any T)."""
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        o_acc[:] = jnp.zeros_like(o_acc)
+        l_acc[:] = jnp.zeros_like(l_acc)
+        m_acc[:] = jnp.full_like(m_acc, NEG_INF)
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, 1), 0)
+    k_pos = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block_k), 1)
+    # blocks fully above the causal diagonal contribute nothing — skip the
+    # compute (their DMA still happens; grid steps can't be elided)
+    live = (j * block_k <= qi * block_q + block_q - 1) if causal else (j >= 0)
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale                # [bq, D]
+        k_blk = k_ref[0].astype(jnp.float32)                    # [bk, D]
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)                 # [bq, bk]
+        mask = k_pos < t_valid                                  # pad keys out
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        s = jnp.where(mask, s, NEG_INF)
+        m = m_acc[:]
+        new_m = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - new_m)
+        p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m - new_m)
+        l_acc[:] = l_acc[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        o_acc[:] = o_acc[:] * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_acc[:] = new_m
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        o_ref[0] = (o_acc[:] / jnp.maximum(l_acc[:], 1e-12)).astype(
+            o_ref.dtype)
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    causal: bool = True, block_q: int = 128,
+                    block_k: int = 128,
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Exact attention on [B, H, T, D] via the flash recurrence.
+
+    T is padded internally to the block size; padded keys are masked out and
+    padded query rows sliced off, so any T works.
+    """
+    b, h, t, d = q.shape
+    if interpret is None:
+        if not (_HAS_PALLAS and _on_tpu()):
+            return _reference(q, k, v, causal)
+        interpret = False
+    elif not _HAS_PALLAS:  # pragma: no cover
+        return _reference(q, k, v, causal)
+
+    block_q = min(block_q, max(t, 1))
+    block_k = min(block_k, max(t, 1))
+    t_pad = -(-t // block_q) * block_q
+    t_pad = -(-t_pad // block_k) * block_k
+    pad = t_pad - t
+    if pad:
+        qp = jnp.pad(q, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    else:
+        qp, kp, vp = q, k, v
+    qf = qp.reshape(b * h, t_pad, d)
+    kf = kp.reshape(b * h, t_pad, d)
+    vf = vp.reshape(b * h, t_pad, d)
+
+    nk = t_pad // block_k
+    kernel = functools.partial(
+        _flash_kernel, block_q=block_q, block_k=block_k, t_valid=t,
+        causal=causal, scale=1.0 / float(d) ** 0.5, nk=nk)
+    scratch = [pltpu.VMEM((block_q, d), jnp.float32),
+               pltpu.VMEM((block_q, 1), jnp.float32),
+               pltpu.VMEM((block_q, 1), jnp.float32)]
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, t_pad // block_q, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bi, i, j: (bi, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bi, i, j: (bi, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bi, i, j: (bi, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bi, i, j: (bi, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t_pad, d), q.dtype),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, t_pad, d)[:, :, :t, :]

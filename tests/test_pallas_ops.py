@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 
 def test_weighted_average_flat_matches_einsum():
@@ -42,3 +43,39 @@ def test_quantize_mask_fused_matches_two_step():
     fused = quantize_mask(x, mask, interpret=True)
     two_step = mask_model(quantize({"x": x})["x"], mask)
     np.testing.assert_array_equal(np.asarray(fused), np.asarray(two_step))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("t,d,bq,bk", [
+    (32, 16, 8, 8),      # exact block fit
+    (40, 16, 16, 8),     # T needs padding to block_q
+    (17, 8, 8, 8),       # ragged T
+])
+def test_flash_attention_matches_reference(causal, t, d, bq, bk):
+    from fedml_tpu.ops.pallas_attention import flash_attention
+    from fedml_tpu.parallel.ring_attention import reference_attention
+
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(2, 3, t, d), jnp.float32)
+    k = jnp.asarray(rng.randn(2, 3, t, d), jnp.float32)
+    v = jnp.asarray(rng.randn(2, 3, t, d), jnp.float32)
+    out = flash_attention(q, k, v, causal=causal, block_q=bq, block_k=bk,
+                          interpret=True)
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_attention_off_tpu_fallback_matches():
+    """interpret=None off-TPU routes to the jnp fallback, same math."""
+    from fedml_tpu.ops.pallas_attention import flash_attention
+    from fedml_tpu.parallel.ring_attention import reference_attention
+
+    rng = np.random.RandomState(4)
+    q = jnp.asarray(rng.randn(1, 2, 24, 8), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 2, 24, 8), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 2, 24, 8), jnp.float32)
+    out = flash_attention(q, k, v, causal=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
