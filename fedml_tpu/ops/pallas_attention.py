@@ -108,6 +108,126 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, o_acc, l_acc, m_acc, *,
             o_ref.dtype)
 
 
+def _flash_kernel_residuals(q_ref, k_ref, v_ref, o_ref, l_ref, m_ref,
+                            o_acc, l_acc, m_acc, *, block_q: int,
+                            block_k: int, t_valid: int, causal: bool,
+                            scale: float, nk: int):
+    """Same as `_flash_kernel` but also emits the softmax residuals
+    (row sum l and row max m) so partial results over disjoint key sets can
+    be merged exactly (`merge_attention_partials`) — the ring-attention
+    building block."""
+    j = pl.program_id(2)
+    _flash_kernel(q_ref, k_ref, v_ref, o_ref, o_acc, l_acc, m_acc,
+                  block_q=block_q, block_k=block_k, t_valid=t_valid,
+                  causal=causal, scale=scale, nk=nk)
+
+    @pl.when(j == nk - 1)
+    def _emit_residuals():
+        l_ref[0] = l_acc[:]
+        m_ref[0] = m_acc[:]
+
+
+def _reference_residuals(q, k, v, causal):
+    """jnp fallback for `flash_attention_residuals` — identical math."""
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        t = q.shape[2]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)
+    e = jnp.exp(s - m[..., None])
+    if causal:
+        e = jnp.where(mask[None, None], e, 0.0)
+    l = jnp.sum(e, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", e, v.astype(jnp.float32))
+    o = (o / jnp.maximum(l[..., None], 1e-12)).astype(q.dtype)
+    return o, l, m
+
+
+def merge_attention_partials(a, b):
+    """Merge two attention partials (o, l, m) computed over DISJOINT key
+    sets for the same queries (o normalized per-partial, l the softmax sum
+    in the m-shifted frame, m the row max).  Exact — the flash combine."""
+    o_a, l_a, m_a = a
+    o_b, l_b, m_b = b
+    new_m = jnp.maximum(m_a, m_b)
+    w_a = l_a * jnp.exp(m_a - new_m)
+    w_b = l_b * jnp.exp(m_b - new_m)
+    l = w_a + w_b
+    denom = jnp.maximum(l, 1e-12)[..., None]
+    o = (o_a.astype(jnp.float32) * w_a[..., None]
+         + o_b.astype(jnp.float32) * w_b[..., None]) / denom
+    return o.astype(o_a.dtype), l, new_m
+
+
+def flash_attention_residuals(q: jnp.ndarray, k: jnp.ndarray,
+                              v: jnp.ndarray, causal: bool = True,
+                              block_q: int = 128, block_k: int = 128,
+                              interpret: Optional[bool] = None):
+    """Like `flash_attention` but also returns the softmax residuals
+    (l, m) [B, H, T] so callers can merge partial attentions over disjoint
+    key sets (`merge_attention_partials`) — the ring-attention block op.
+    Requires block-aligned lengths (ring blocks are); the key length may
+    differ from the query length for non-causal partials."""
+    b, h, t, d = q.shape
+    tk = k.shape[2]
+    if interpret is None:
+        if not (_HAS_PALLAS and _on_tpu()):
+            return _reference_residuals(q, k, v, causal)
+        interpret = False
+    elif not _HAS_PALLAS:  # pragma: no cover
+        return _reference_residuals(q, k, v, causal)
+
+    block_q = min(block_q, max(t, 1))
+    block_k = min(block_k, max(tk, 1))
+    if t % block_q or tk % block_k or (causal and tk != t):
+        return _reference_residuals(q, k, v, causal)
+    qf = q.reshape(b * h, t, d)
+    kf = k.reshape(b * h, tk, d)
+    vf = v.reshape(b * h, tk, d)
+    nk = tk // block_k
+    kernel = functools.partial(
+        _flash_kernel_residuals, block_q=block_q, block_k=block_k,
+        t_valid=tk, causal=causal, scale=1.0 / float(d) ** 0.5, nk=nk)
+    out, l, m = pl.pallas_call(
+        kernel,
+        grid=(b * h, t // block_q, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bi, i, j: (bi, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bi, i, j: (bi, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bi, i, j: (bi, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bi, i, j: (bi, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bi, i, j: (bi, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bi, i, j: (bi, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, t, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, t, 1), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32),
+                        pltpu.VMEM((block_q, 1), jnp.float32),
+                        pltpu.VMEM((block_q, 1), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return (out.reshape(b, h, t, d), l.reshape(b, h, t),
+            m.reshape(b, h, t))
+
+
+def flash_mha(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+              causal: bool = True) -> jnp.ndarray:
+    """[B, T, H, D] (flax layout) convenience wrapper around
+    `flash_attention` for dropping into `nn.MultiHeadDotProductAttention`-
+    style call sites."""
+    o = flash_attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                        v.transpose(0, 2, 1, 3), causal=causal)
+    return o.transpose(0, 2, 1, 3)
+
+
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = True, block_q: int = 128,
                     block_k: int = 128,

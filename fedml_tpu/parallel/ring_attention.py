@@ -27,62 +27,66 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..constants import AXIS_SEQ
+from ..ops.pallas_attention import (
+    flash_attention_residuals,
+    merge_attention_partials,
+)
 
 NEG_INF = -1e30
-
-
-def _block_attn(q, k, v, mask):
-    """One block pair: scores [B, H, Tq, Tk] → (scores_max, exp_scores, pv)."""
-    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
-    s = jnp.where(mask, s, NEG_INF)
-    m = jnp.max(s, axis=-1)                                   # [B,H,Tq]
-    e = jnp.exp(s - m[..., None])
-    e = jnp.where(mask, e, 0.0)
-    pv = jnp.einsum("bhqk,bhkd->bhqd", e, v)
-    return m, e.sum(axis=-1), pv
 
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    axis_name: str = AXIS_SEQ,
                    causal: bool = True) -> jnp.ndarray:
     """Inside shard_map: q/k/v are LOCAL blocks [B, H, T_local, D].
-    Returns the local block of the attention output."""
+    Returns the local block of the attention output.
+
+    Each ring step computes an attention PARTIAL (o, l, m) of the local
+    queries against the visiting K/V block via the flash pallas kernel
+    (jnp fallback off-TPU) and folds it in with the exact flash combine
+    (`merge_attention_partials`).  Under causal masking a visiting block is
+    either entirely below the diagonal (plain non-causal block attention),
+    THE diagonal block (standard causal), or entirely above (skipped — no
+    compute, unlike a dense-mask formulation)."""
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
-    t_local = q.shape[2]
 
-    q_pos = my_idx * t_local + jnp.arange(t_local)            # global rows
+    def partial_for(q, k_blk, v_blk, blk_idx):
+        if not causal:
+            return flash_attention_residuals(q, k_blk, v_blk, causal=False)
 
-    def mask_for(block_idx):
-        k_pos = block_idx * t_local + jnp.arange(t_local)
-        if causal:
-            return (q_pos[:, None] >= k_pos[None, :])[None, None]
-        return jnp.ones((1, 1, t_local, t_local), bool)
+        def below(_):
+            return flash_attention_residuals(q, k_blk, v_blk, causal=False)
 
-    # online-softmax accumulators
-    o = jnp.zeros_like(q)
-    l = jnp.zeros(q.shape[:3], q.dtype)                       # [B,H,T]
-    m = jnp.full(q.shape[:3], NEG_INF, q.dtype)
+        def diag_fn(_):
+            return flash_attention_residuals(q, k_blk, v_blk, causal=True)
+
+        def above(_):
+            return (jnp.zeros_like(q),
+                    jnp.zeros(q.shape[:3], jnp.float32),
+                    jnp.full(q.shape[:3], NEG_INF, jnp.float32))
+
+        return jax.lax.cond(
+            blk_idx == my_idx, diag_fn,
+            lambda opq: jax.lax.cond(blk_idx < my_idx, below, above, opq),
+            None)
 
     def body(i, carry):
-        o, l, m, k_blk, v_blk = carry
+        part, k_blk, v_blk = carry
         blk_idx = (my_idx - i) % axis_size                    # who owns k_blk
-        mask = mask_for(blk_idx)
-        bm, bl, bpv = _block_attn(q, k_blk, v_blk, mask)
-        new_m = jnp.maximum(m, bm)
-        alpha = jnp.exp(m - new_m)
-        beta = jnp.exp(bm - new_m)
-        o = o * alpha[..., None] + bpv * beta[..., None]
-        l = l * alpha + bl * beta
+        part = merge_attention_partials(
+            part, partial_for(q, k_blk, v_blk, blk_idx))
         # rotate K/V around the ring: receive from the next rank
         perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        return o, l, new_m, k_blk, v_blk
+        return part, k_blk, v_blk
 
-    o, l, m, _, _ = jax.lax.fori_loop(0, axis_size, body, (o, l, m, k, v))
-    return o / jnp.maximum(l[..., None], 1e-12)
+    zero = (jnp.zeros_like(q),
+            jnp.zeros(q.shape[:3], jnp.float32),
+            jnp.full(q.shape[:3], NEG_INF, jnp.float32))
+    (o, l, m), _, _ = jax.lax.fori_loop(0, axis_size, body, (zero, k, v))
+    return o
 
 
 def make_ring_attention_fn(mesh: Mesh, axis_name: str = AXIS_SEQ,

@@ -79,3 +79,24 @@ def test_flash_attention_off_tpu_fallback_matches():
     ref = reference_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=1e-5, rtol=1e-5)
+
+
+def test_flash_residuals_merge_matches_full():
+    """Splitting keys in two, computing partials, and merging equals full
+    attention — the ring-attention combine."""
+    from fedml_tpu.ops.pallas_attention import (
+        flash_attention_residuals, merge_attention_partials)
+    from fedml_tpu.parallel.ring_attention import reference_attention
+
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(2, 2, 16, 8), jnp.float32)
+    k = jnp.asarray(rng.randn(2, 2, 32, 8), jnp.float32)
+    v = jnp.asarray(rng.randn(2, 2, 32, 8), jnp.float32)
+    pa = flash_attention_residuals(q, k[:, :, :16], v[:, :, :16],
+                                   causal=False, interpret=True)
+    pb = flash_attention_residuals(q, k[:, :, 16:], v[:, :, 16:],
+                                   causal=False, interpret=True)
+    o, l, m = merge_attention_partials(pa, pb)
+    ref = reference_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
