@@ -127,19 +127,21 @@ def _flash_kernel_residuals(q_ref, k_ref, v_ref, o_ref, l_ref, m_ref,
         m_ref[0] = m_acc[:]
 
 
-def _reference_residuals(q, k, v, causal):
+def _reference_residuals(q, k, v, causal, t_valid=None):
     """jnp fallback for `flash_attention_residuals` — identical math."""
+    t, tk = q.shape[2], k.shape[2]
     scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
+    mask = jnp.ones((t, tk), bool)
+    if t_valid is not None and t_valid < tk:
+        mask = mask & (jnp.arange(tk)[None, :] < t_valid)
     if causal:
-        t = q.shape[2]
-        mask = jnp.tril(jnp.ones((t, t), bool))
-        s = jnp.where(mask[None, None], s, NEG_INF)
+        mask = mask & (jnp.arange(t)[:, None] >= jnp.arange(tk)[None, :])
+    s = jnp.where(mask[None, None], s, NEG_INF)
     m = jnp.max(s, axis=-1)
     e = jnp.exp(s - m[..., None])
-    if causal:
-        e = jnp.where(mask[None, None], e, 0.0)
+    e = jnp.where(mask[None, None], e, 0.0)
     l = jnp.sum(e, axis=-1)
     o = jnp.einsum("bhqk,bhkd->bhqd", e, v.astype(jnp.float32))
     o = (o / jnp.maximum(l[..., None], 1e-12)).astype(q.dtype)
@@ -165,7 +167,8 @@ def merge_attention_partials(a, b):
 def flash_attention_residuals(q: jnp.ndarray, k: jnp.ndarray,
                               v: jnp.ndarray, causal: bool = True,
                               block_q: int = 128, block_k: int = 128,
-                              interpret: Optional[bool] = None):
+                              interpret: Optional[bool] = None,
+                              t_valid: Optional[int] = None):
     """Like `flash_attention` but also returns the softmax residuals
     (l, m) [B, H, T] so callers can merge partial attentions over disjoint
     key sets (`merge_attention_partials`) — the ring-attention block op.
@@ -173,24 +176,26 @@ def flash_attention_residuals(q: jnp.ndarray, k: jnp.ndarray,
     differ from the query length for non-causal partials."""
     b, h, t, d = q.shape
     tk = k.shape[2]
+    if t_valid is None:
+        t_valid = tk
     if interpret is None:
         if not (_HAS_PALLAS and _on_tpu()):
-            return _reference_residuals(q, k, v, causal)
+            return _reference_residuals(q, k, v, causal, t_valid)
         interpret = False
     elif not _HAS_PALLAS:  # pragma: no cover
-        return _reference_residuals(q, k, v, causal)
+        return _reference_residuals(q, k, v, causal, t_valid)
 
     block_q = min(block_q, max(t, 1))
     block_k = min(block_k, max(tk, 1))
     if t % block_q or tk % block_k or (causal and tk != t):
-        return _reference_residuals(q, k, v, causal)
+        return _reference_residuals(q, k, v, causal, t_valid)
     qf = q.reshape(b * h, t, d)
     kf = k.reshape(b * h, tk, d)
     vf = v.reshape(b * h, tk, d)
     nk = tk // block_k
     kernel = functools.partial(
         _flash_kernel_residuals, block_q=block_q, block_k=block_k,
-        t_valid=tk, causal=causal, scale=1.0 / float(d) ** 0.5, nk=nk)
+        t_valid=t_valid, causal=causal, scale=1.0 / float(d) ** 0.5, nk=nk)
     out, l, m = pl.pallas_call(
         kernel,
         grid=(b * h, t // block_q, nk),
@@ -228,6 +233,85 @@ def flash_mha(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return o.transpose(0, 2, 1, 3)
 
 
+def _flash_backward_blockwise(q, k, v, o, l, m, do, causal: bool,
+                              t_valid: int, block_k: int):
+    """Exact attention backward with O(T·block_k) score memory: lax.scan
+    over key blocks recomputing p = exp(s − m)/l from the saved softmax
+    residuals (FlashAttention-2 backward, jnp formulation — XLA fuses it;
+    runs everywhere, no kernel needed for correctness)."""
+    b, h, t, d = q.shape
+    tk = k.shape[2]
+    scale = 1.0 / float(d) ** 0.5
+    qf = q.astype(jnp.float32)
+    do_f = do.astype(jnp.float32)
+    delta = jnp.sum(do_f * o.astype(jnp.float32), axis=-1)      # [B,H,T]
+    nk = tk // block_k
+    kb = k.reshape(b, h, nk, block_k, d).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(b, h, nk, block_k, d).transpose(2, 0, 1, 3, 4)
+    q_pos = jnp.arange(t)[:, None]
+
+    def body(carry, xs):
+        dq, j = carry[0], carry[1]
+        k_j, v_j = xs
+        k_j = k_j.astype(jnp.float32)
+        v_j = v_j.astype(jnp.float32)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_j) * scale
+        k_pos = j * block_k + jnp.arange(block_k)[None, :]
+        mask = (k_pos < t_valid)
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        p = jnp.where(mask[None, None], jnp.exp(s - m[..., None]), 0.0)
+        p = p / jnp.maximum(l[..., None], 1e-12)
+        dv_j = jnp.einsum("bhqk,bhqd->bhkd", p, do_f)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do_f, v_j)
+        ds = p * (dp - delta[..., None])
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, k_j) * scale
+        dk_j = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * scale
+        return (dq, j + 1), (dk_j, dv_j)
+
+    (dq, _), (dk_b, dv_b) = jax.lax.scan(
+        body, (jnp.zeros((b, h, t, d), jnp.float32), 0), (kb, vb))
+    dk = dk_b.transpose(1, 2, 0, 3, 4).reshape(b, h, tk, d)
+    dv = dv_b.transpose(1, 2, 0, 3, 4).reshape(b, h, tk, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_FLASH_CORE_CACHE: dict = {}
+
+
+def _flash_core(causal: bool, block_q: int, block_k: int,
+                interpret: Optional[bool], t_valid: int):
+    """custom_vjp-wrapped flash attention on block-aligned [B, H, T, D]:
+    pallas kernel forward (saves softmax residuals), blockwise-jnp exact
+    backward — so the kernel path is trainable (ulysses/ring local steps)."""
+    key = (causal, block_q, block_k, interpret, t_valid)
+    if key in _FLASH_CORE_CACHE:
+        return _FLASH_CORE_CACHE[key]
+
+    @jax.custom_vjp
+    def f(q, k, v):
+        o, _, _ = flash_attention_residuals(
+            q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+            interpret=interpret, t_valid=t_valid)
+        return o
+
+    def fwd(q, k, v):
+        o, l, m = flash_attention_residuals(
+            q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+            interpret=interpret, t_valid=t_valid)
+        return o, (q, k, v, o, l, m)
+
+    def bwd(res, do):
+        q, k, v, o, l, m = res
+        return _flash_backward_blockwise(
+            q, k, v, o, l, m, do, causal=causal, t_valid=t_valid,
+            block_k=min(block_k, k.shape[2]))
+
+    f.defvjp(fwd, bwd)
+    _FLASH_CORE_CACHE[key] = f
+    return f
+
+
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = True, block_q: int = 128,
                     block_k: int = 128,
@@ -235,7 +319,9 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     """Exact attention on [B, H, T, D] via the flash recurrence.
 
     T is padded internally to the block size; padded keys are masked out and
-    padded query rows sliced off, so any T works.
+    padded query rows sliced off, so any T works.  Differentiable: the
+    forward runs the pallas kernel, the backward is the exact blockwise
+    recomputation (`_flash_backward_blockwise`).
     """
     b, h, t, d = q.shape
     if interpret is None:
@@ -256,28 +342,7 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
     else:
         qp, kp, vp = q, k, v
-    qf = qp.reshape(b * h, t_pad, d)
-    kf = kp.reshape(b * h, t_pad, d)
-    vf = vp.reshape(b * h, t_pad, d)
 
-    nk = t_pad // block_k
-    kernel = functools.partial(
-        _flash_kernel, block_q=block_q, block_k=block_k, t_valid=t,
-        causal=causal, scale=1.0 / float(d) ** 0.5, nk=nk)
-    scratch = [pltpu.VMEM((block_q, d), jnp.float32),
-               pltpu.VMEM((block_q, 1), jnp.float32),
-               pltpu.VMEM((block_q, 1), jnp.float32)]
-    out = pl.pallas_call(
-        kernel,
-        grid=(b * h, t_pad // block_q, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bi, i, j: (bi, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bi, i, j: (bi, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bi, i, j: (bi, j, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bi, i, j: (bi, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, t_pad, d), q.dtype),
-        scratch_shapes=scratch,
-        interpret=interpret,
-    )(qf, kf, vf)
-    return out.reshape(b, h, t_pad, d)[:, :, :t, :]
+    core = _flash_core(causal, block_q, block_k, interpret, t_valid=t)
+    out = core(qp, kp, vp)
+    return out[:, :, :t, :] if pad else out

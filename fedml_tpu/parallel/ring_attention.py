@@ -35,19 +35,10 @@ from ..ops.pallas_attention import (
 NEG_INF = -1e30
 
 
-def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                   axis_name: str = AXIS_SEQ,
-                   causal: bool = True) -> jnp.ndarray:
-    """Inside shard_map: q/k/v are LOCAL blocks [B, H, T_local, D].
-    Returns the local block of the attention output.
-
-    Each ring step computes an attention PARTIAL (o, l, m) of the local
-    queries against the visiting K/V block via the flash pallas kernel
-    (jnp fallback off-TPU) and folds it in with the exact flash combine
-    (`merge_attention_partials`).  Under causal masking a visiting block is
-    either entirely below the diagonal (plain non-causal block attention),
-    THE diagonal block (standard causal), or entirely above (skipped — no
-    compute, unlike a dense-mask formulation)."""
+def _ring_forward(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                  axis_name: str, causal: bool):
+    """Ring forward returning the merged partial (o, l, m) — see
+    `ring_attention` for the algorithm."""
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
 
@@ -85,8 +76,103 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     zero = (jnp.zeros_like(q),
             jnp.zeros(q.shape[:3], jnp.float32),
             jnp.full(q.shape[:3], NEG_INF, jnp.float32))
-    (o, l, m), _, _ = jax.lax.fori_loop(0, axis_size, body, (zero, k, v))
-    return o
+    part, _, _ = jax.lax.fori_loop(0, axis_size, body, (zero, k, v))
+    return part
+
+
+def _ring_backward(q, k, v, o, l, m, do, axis_name: str, causal: bool):
+    """Second ring pass (Liu et al. 2023): dK/dV accumulators travel WITH
+    the visiting K/V block, so after a full rotation each block arrives home
+    carrying contributions from every query shard; dQ accumulates locally.
+    p is recomputed per block pair from the saved softmax residuals."""
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    t_local = q.shape[2]
+    d = q.shape[-1]
+    scale = 1.0 / float(d) ** 0.5
+
+    qf = q.astype(jnp.float32)
+    do_f = do.astype(jnp.float32)
+    delta = jnp.sum(do_f * o.astype(jnp.float32), axis=-1)      # [B,H,T]
+    q_pos = my_idx * t_local + jnp.arange(t_local)
+
+    def body(i, carry):
+        dq, k_blk, v_blk, dk_blk, dv_blk = carry
+        blk_idx = (my_idx - i) % axis_size
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf,
+                       k_blk.astype(jnp.float32)) * scale
+        if causal:
+            k_pos = blk_idx * t_local + jnp.arange(t_local)
+            mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+        else:
+            mask = jnp.ones((1, 1, t_local, t_local), bool)
+        p = jnp.where(mask, jnp.exp(s - m[..., None]), 0.0)
+        p = p / jnp.maximum(l[..., None], 1e-12)
+        dv_blk = dv_blk + jnp.einsum("bhqk,bhqd->bhkd", p, do_f)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do_f,
+                        v_blk.astype(jnp.float32))
+        ds = p * (dp - delta[..., None])
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds,
+                             k_blk.astype(jnp.float32)) * scale
+        dk_blk = dk_blk + jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * scale
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        dk_blk = jax.lax.ppermute(dk_blk, axis_name, perm)
+        dv_blk = jax.lax.ppermute(dv_blk, axis_name, perm)
+        return dq, k_blk, v_blk, dk_blk, dv_blk
+
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dkv0 = jnp.zeros(k.shape, jnp.float32)
+    dq, _, _, dk, dv = jax.lax.fori_loop(
+        0, axis_size, body, (dq0, k, v, dkv0, dkv0))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_RING_CORE_CACHE: dict = {}
+
+
+def _ring_core(axis_name: str, causal: bool):
+    """custom_vjp-wrapped ring attention (per-shard function, call inside
+    shard_map): kernel-backed forward, second-ring-pass backward — the
+    sequence-parallel path is trainable end to end."""
+    key = (axis_name, causal)
+    if key in _RING_CORE_CACHE:
+        return _RING_CORE_CACHE[key]
+
+    @jax.custom_vjp
+    def f(q, k, v):
+        o, _, _ = _ring_forward(q, k, v, axis_name, causal)
+        return o
+
+    def fwd(q, k, v):
+        o, l, m = _ring_forward(q, k, v, axis_name, causal)
+        return o, (q, k, v, o, l, m)
+
+    def bwd(res, do):
+        q, k, v, o, l, m = res
+        return _ring_backward(q, k, v, o, l, m, do, axis_name, causal)
+
+    f.defvjp(fwd, bwd)
+    _RING_CORE_CACHE[key] = f
+    return f
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   axis_name: str = AXIS_SEQ,
+                   causal: bool = True) -> jnp.ndarray:
+    """Inside shard_map: q/k/v are LOCAL blocks [B, H, T_local, D].
+    Returns the local block of the attention output.
+
+    Each ring step computes an attention PARTIAL (o, l, m) of the local
+    queries against the visiting K/V block via the flash pallas kernel
+    (jnp fallback off-TPU) and folds it in with the exact flash combine
+    (`merge_attention_partials`).  Under causal masking a visiting block is
+    either entirely below the diagonal (plain non-causal block attention),
+    THE diagonal block (standard causal), or entirely above (skipped — no
+    compute, unlike a dense-mask formulation).  Differentiable via a manual
+    second-ring backward pass (`_ring_backward`)."""
+    return _ring_core(axis_name, causal)(q, k, v)
 
 
 def make_ring_attention_fn(mesh: Mesh, axis_name: str = AXIS_SEQ,
