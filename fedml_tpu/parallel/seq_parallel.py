@@ -1,0 +1,146 @@
+"""End-to-end sequence-parallel causal-LM training.
+
+NEW capability (absent in the reference — SURVEY §2.14/§5: sequence/context
+parallelism is listed "absent ... TPU-native equivalent to design fresh").
+`ring_attention.py` / `ulysses.py` provide the attention op; this module is
+the full training step built around it:
+
+* a pure-functional transformer LM (params = plain pytree) whose
+  position-wise ops (embed, layernorm, MLP, logits) shard trivially over the
+  ``seq`` mesh axis via sharding constraints, and whose attention runs as a
+  `shard_map` island using ring attention (ppermute K/V ring, flash-kernel
+  partials) or Ulysses (all-to-all head sharding);
+* `build_seq_parallel_train_step` — one jitted step (loss, grads, SGD
+  update) over token batches sharded [B, T/P]; gradients flow through the
+  custom ring/flash VJPs, so the whole thing trains on hardware.
+
+Every device holds the full parameter pytree (replicated — combine with the
+`sharding.py` fsdp/tp rules over extra mesh axes for larger models); what is
+sharded is the SEQUENCE: activations never materialize the full [B, T]
+context on one device, which is the point of context parallelism.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..constants import AXIS_SEQ
+from .ring_attention import reference_attention, ring_attention
+from .ulysses import ulysses_attention
+
+
+def init_lm_params(key: jax.Array, vocab: int, dim: int = 64,
+                   layers: int = 2, heads: int = 4,
+                   max_len: int = 512) -> Dict[str, Any]:
+    """Transformer-LM parameter pytree (pre-LN blocks, learned positions)."""
+    keys = jax.random.split(key, 2 + layers)
+    p: Dict[str, Any] = {
+        "embed": jax.random.normal(keys[0], (vocab, dim)) * 0.02,
+        "pos": jax.random.normal(keys[1], (max_len, dim)) * 0.02,
+        "blocks": [],
+        "ln_f": {"scale": jnp.ones((dim,)), "bias": jnp.zeros((dim,))},
+    }
+    for i in range(layers):
+        kq, kk, kv, ko, k1, k2 = jax.random.split(keys[2 + i], 6)
+        s = 1.0 / np.sqrt(dim)
+        p["blocks"].append({
+            "ln1": {"scale": jnp.ones((dim,)), "bias": jnp.zeros((dim,))},
+            "wq": jax.random.normal(kq, (dim, dim)) * s,
+            "wk": jax.random.normal(kk, (dim, dim)) * s,
+            "wv": jax.random.normal(kv, (dim, dim)) * s,
+            "wo": jax.random.normal(ko, (dim, dim)) * s,
+            "ln2": {"scale": jnp.ones((dim,)), "bias": jnp.zeros((dim,))},
+            "w1": jax.random.normal(k1, (dim, 4 * dim)) * s,
+            "w2": jax.random.normal(k2, (4 * dim, dim)) * (s / 2.0),
+        })
+    return p
+
+
+def _ln(x, g):
+    mu = jnp.mean(x, -1, keepdims=True)
+    var = jnp.var(x, -1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-6) * g["scale"] + g["bias"]
+
+
+def lm_forward(params: Dict[str, Any], tokens: jnp.ndarray, heads: int,
+               attn_fn) -> jnp.ndarray:
+    """[B, T] int tokens → [B, T, V] logits.  ``attn_fn(q, k, v)`` consumes
+    [B, H, T, D_h] — plug in full attention, a shard_map'd ring, or Ulysses;
+    everything else is position-wise and sharding-constraint friendly."""
+    b, t = tokens.shape
+    dim = params["embed"].shape[1]
+    dh = dim // heads
+    # NOTE positions must be GLOBAL: tokens arrive [B, T] logically; under
+    # jit the T axis is sharded and iota is partitioned correctly by XLA.
+    h = params["embed"][tokens] + params["pos"][:t][None]
+    for blk in params["blocks"]:
+        y = _ln(h, blk["ln1"])
+
+        def split_heads(w):
+            return (y @ w).reshape(b, t, heads, dh).transpose(0, 2, 1, 3)
+
+        q, k, v = split_heads(blk["wq"]), split_heads(blk["wk"]), \
+            split_heads(blk["wv"])
+        o = attn_fn(q, k, v)                       # [B, H, T, Dh]
+        o = o.transpose(0, 2, 1, 3).reshape(b, t, dim)
+        h = h + o @ blk["wo"]
+        y = _ln(h, blk["ln2"])
+        h = h + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"]
+    h = _ln(h, params["ln_f"])
+    return h @ params["embed"].T                   # tied output embedding
+
+
+def lm_loss(params, tokens, heads, attn_fn) -> jnp.ndarray:
+    """Next-token CE over [B, T].  The model runs on the FULL (sharded) T —
+    the last position is masked out of the loss instead of sliced off, so
+    the sequence axis stays evenly divisible by the mesh."""
+    b, t = tokens.shape
+    logits = lm_forward(params, tokens, heads, attn_fn)       # [B, T, V]
+    targets = jnp.concatenate(
+        [tokens[:, 1:], jnp.zeros((b, 1), tokens.dtype)], axis=1)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(
+        logits, targets[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    mask = (jnp.arange(t) < t - 1).astype(jnp.float32)[None]
+    return jnp.sum((logz - gold) * mask) / (jnp.sum(mask) * b)
+
+
+def build_seq_parallel_train_step(mesh: Mesh, heads: int,
+                                  strategy: str = "ring",
+                                  learning_rate: float = 0.1,
+                                  axis_name: str = AXIS_SEQ):
+    """Returns (train_step, token_sharding): ``train_step(params, tokens)``
+    → (new_params, loss), jitted over ``mesh`` with tokens sharded [B, T/P]
+    and replicated params.  ``strategy``: "ring" | "ulysses" | "full"
+    (full = no sequence parallelism, for parity checks)."""
+    spec = P(None, None, axis_name, None)
+
+    if strategy == "full":
+        attn_fn = partial(reference_attention, causal=True)
+    else:
+        inner = ring_attention if strategy == "ring" else ulysses_attention
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                 out_specs=spec, check_vma=False)
+        def attn_fn(q, k, v):
+            return inner(q, k, v, axis_name=axis_name, causal=True)
+
+    def train_step(params, tokens):
+        loss, grads = jax.value_and_grad(lm_loss)(
+            params, tokens, heads, attn_fn)
+        new_params = jax.tree_util.tree_map(
+            lambda p, g: p - learning_rate * g, params, grads)
+        return new_params, loss
+
+    token_sharding = NamedSharding(mesh, P(None, axis_name))
+    replicated = NamedSharding(mesh, P())
+    step = jax.jit(train_step,
+                   in_shardings=(replicated, token_sharding),
+                   out_shardings=(replicated, replicated))
+    return step, token_sharding
