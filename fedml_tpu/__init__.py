@@ -50,14 +50,56 @@ class _DataNS:
 data = _DataNS()
 
 
+_distributed_initialized = False
+
+
+def _maybe_init_distributed(args: Any) -> None:
+    """Multi-host runtime init — the reference reads torchrun env vars
+    (WORLD_SIZE/RANK/MASTER_ADDR, `__init__.py:339-389`) to join a process
+    group; the TPU build joins a `jax.distributed` cluster so one pjit
+    program spans hosts (mesh axes then cross DCN via `build_hybrid_mesh`).
+
+    Config keys (or env): ``coordinator_address`` (FEDML_COORDINATOR_ADDRESS,
+    else MASTER_ADDR:MASTER_PORT), ``num_processes`` (FEDML_NUM_PROCESSES or
+    WORLD_SIZE), ``process_id`` (FEDML_PROCESS_ID or RANK).  No-op when no
+    coordinator is configured — single-host runs need nothing."""
+    global _distributed_initialized
+    if _distributed_initialized:
+        return
+    env = os.environ
+    coord = (getattr(args, "coordinator_address", None)
+             or env.get("FEDML_COORDINATOR_ADDRESS"))
+    if not coord and env.get("MASTER_ADDR"):
+        coord = f"{env['MASTER_ADDR']}:{env.get('MASTER_PORT', '1234')}"
+    if not coord:
+        return
+    nproc = (getattr(args, "num_processes", None)
+             or env.get("FEDML_NUM_PROCESSES") or env.get("WORLD_SIZE"))
+    pid = (getattr(args, "process_id", None)
+           or env.get("FEDML_PROCESS_ID") or env.get("RANK"))
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=str(coord),
+        num_processes=int(nproc) if nproc is not None else None,
+        process_id=int(pid) if pid is not None else None)
+    _distributed_initialized = True
+    logging.info("jax.distributed: process %d/%d, %d local / %d global "
+                 "devices", jax.process_index(), jax.process_count(),
+                 jax.local_device_count(), jax.device_count())
+
+
 def init(args: Optional[Config] = None, argv: Optional[list] = None,
          **overrides: Any) -> Config:
-    """Load config, seed all RNGs, init observability + security singletons
+    """Load config, seed all RNGs, join the multi-host cluster when
+    configured, init observability + security singletons
     (reference `__init__.py:64-168`)."""
     if args is None:
         args = load_arguments(argv=argv, extra=overrides or None)
     elif overrides:
         args.update(overrides)
+
+    _maybe_init_distributed(args)
 
     seed = int(getattr(args, "random_seed", 0) or 0)
     random.seed(seed)

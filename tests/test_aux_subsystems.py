@@ -283,3 +283,42 @@ def test_log_upload_daemon_invalid_utf8_cursor(tmp_path):
     assert uploaded.startswith("good line\n")
     assert uploaded.count("\n") == 2
     assert "partial" in uploaded and "rest" in uploaded
+
+
+def test_maybe_init_distributed_noop_without_coordinator(monkeypatch):
+    """No coordinator configured → init() must not touch jax.distributed."""
+    import fedml_tpu
+
+    for var in ("FEDML_COORDINATOR_ADDRESS", "MASTER_ADDR", "WORLD_SIZE",
+                "RANK"):
+        monkeypatch.delenv(var, raising=False)
+    called = {}
+    import jax
+
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: called.setdefault("kw", kw))
+    fedml_tpu._maybe_init_distributed(fedml_tpu.Config())
+    assert not called
+
+
+def test_maybe_init_distributed_reads_torchrun_env(monkeypatch):
+    """MASTER_ADDR/WORLD_SIZE/RANK (the reference's torchrun contract,
+    `__init__.py:339-389`) map onto jax.distributed.initialize."""
+    import fedml_tpu
+    import jax
+
+    monkeypatch.setattr(fedml_tpu, "_distributed_initialized", False)
+    monkeypatch.setenv("MASTER_ADDR", "10.0.0.1")
+    monkeypatch.setenv("MASTER_PORT", "4321")
+    monkeypatch.setenv("WORLD_SIZE", "4")
+    monkeypatch.setenv("RANK", "2")
+    called = {}
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: called.update(kw))
+    # process_index/count are read for the log line after "joining"
+    monkeypatch.setattr(jax, "process_index", lambda: 2)
+    monkeypatch.setattr(jax, "process_count", lambda: 4)
+    fedml_tpu._maybe_init_distributed(fedml_tpu.Config())
+    assert called == {"coordinator_address": "10.0.0.1:4321",
+                      "num_processes": 4, "process_id": 2}
+    monkeypatch.setattr(fedml_tpu, "_distributed_initialized", False)
