@@ -42,6 +42,12 @@ class LLMTrainConfig:
     lora_alpha: float = 16.0
     grad_clip: float = 1.0
     checkpoint_dir: Optional[str] = None
+    #: "none" | "dp" | "fsdp" — ZeRO-equivalent sharding of the BASE params
+    #: over the `data` mesh axis (reference reached this only via the
+    #: DeepSpeed passthrough, `train/llm/distributed.py:20-58`); the batch
+    #: axis shards over `data` in all sharded modes.
+    strategy: str = "none"
+    data_parallel: int = -1  # mesh size; -1 = all devices
 
 
 def pack_sequences(token_ids: np.ndarray, seq_len: int,
@@ -82,6 +88,14 @@ class LLMTrainer:
         tx = optax.chain(optax.clip_by_global_norm(config.grad_clip),
                          optax.adamw(config.learning_rate))
         self.tx = tx
+        self.mesh = None
+        if config.strategy in ("dp", "fsdp"):
+            from ...ml.engine.mesh import build_mesh
+
+            self.mesh = build_mesh({"data": int(config.data_parallel)})
+        elif config.strategy != "none":
+            raise ValueError(f"unknown llm strategy {config.strategy!r}; "
+                             f"known: none, dp, fsdp")
         self._train_epoch = jax.jit(self._build_epoch_fn())
 
     def _trainables(self):
@@ -130,6 +144,22 @@ class LLMTrainer:
         base_params = self.variables["params"]
         model_state = {k: v for k, v in self.variables.items()
                        if k != "params"}
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ...parallel.sharding import make_param_shardings
+
+            # batch dim (axis 1 of [nb, B, T]) shards over `data`; base
+            # params shard per strategy (fsdp = ZeRO-style), LoRA/trainable
+            # and optimizer state stay replicated (they're small)
+            batches = jax.device_put(
+                batches, NamedSharding(self.mesh, P(None, "data")))
+            base_params = jax.device_put(
+                base_params, make_param_shardings(base_params, self.mesh,
+                                                  self.cfg.strategy))
+            repl = NamedSharding(self.mesh, P())
+            trainable = jax.device_put(trainable, repl)
+            opt_state = jax.device_put(opt_state, repl)
         rng = jax.random.PRNGKey(1)
         history = []
         ckpt = None
@@ -137,11 +167,14 @@ class LLMTrainer:
             from ...utils.checkpoint import RoundCheckpointer
 
             ckpt = RoundCheckpointer(cfg.checkpoint_dir)
+        ctx = self.mesh if self.mesh is not None else _NullCtx()
         for ep in range(cfg.epochs):
             t0 = time.time()
             rng, sub = jax.random.split(rng)
-            trainable, opt_state, loss = self._train_epoch(
-                trainable, opt_state, base_params, model_state, batches, sub)
+            with ctx:
+                trainable, opt_state, loss = self._train_epoch(
+                    trainable, opt_state, base_params, model_state, batches,
+                    sub)
             history.append(float(loss))
             logging.info("llm epoch %d: loss %.4f (%.1fs)", ep, float(loss),
                          time.time() - t0)
@@ -174,3 +207,11 @@ class LLMTrainer:
                 nxt = int(jnp.argmax(last))
             ids.append(nxt)
         return np.asarray(ids)
+
+
+class _NullCtx:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
