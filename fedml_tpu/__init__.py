@@ -69,7 +69,10 @@ def _maybe_init_distributed(args: Any) -> None:
     env = os.environ
     coord = (getattr(args, "coordinator_address", None)
              or env.get("FEDML_COORDINATOR_ADDRESS"))
-    if not coord and env.get("MASTER_ADDR"):
+    # torchrun mapping needs the FULL contract — a leftover MASTER_ADDR
+    # alone (WORLD_SIZE/RANK unset) must not hang a single-host run
+    if (not coord and env.get("MASTER_ADDR") and env.get("WORLD_SIZE")
+            and env.get("RANK") is not None):
         coord = f"{env['MASTER_ADDR']}:{env.get('MASTER_PORT', '1234')}"
     if not coord:
         return

@@ -276,17 +276,15 @@ def _flash_backward_blockwise(q, k, v, o, l, m, do, causal: bool,
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-_FLASH_CORE_CACHE: dict = {}
-
-
+@functools.lru_cache(maxsize=64)
 def _flash_core(causal: bool, block_q: int, block_k: int,
                 interpret: Optional[bool], t_valid: int):
     """custom_vjp-wrapped flash attention on block-aligned [B, H, T, D]:
     pallas kernel forward (saves softmax residuals), blockwise-jnp exact
-    backward — so the kernel path is trainable (ulysses/ring local steps)."""
-    key = (causal, block_q, block_k, interpret, t_valid)
-    if key in _FLASH_CORE_CACHE:
-        return _FLASH_CORE_CACHE[key]
+    backward — so the kernel path is trainable (ulysses/ring local steps).
+    lru-cached per config so long-lived servers with many distinct context
+    lengths don't grow an unbounded closure cache (the jit traces behind
+    each entry are evicted with it)."""
 
     @jax.custom_vjp
     def f(q, k, v):
@@ -308,7 +306,6 @@ def _flash_core(causal: bool, block_q: int, block_k: int,
             block_k=min(block_k, k.shape[2]))
 
     f.defvjp(fwd, bwd)
-    _FLASH_CORE_CACHE[key] = f
     return f
 
 

@@ -19,7 +19,7 @@ causal attention, independent of the ring size.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Optional
 
 import jax
@@ -129,16 +129,11 @@ def _ring_backward(q, k, v, o, l, m, do, axis_name: str, causal: bool):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-_RING_CORE_CACHE: dict = {}
-
-
+@lru_cache(maxsize=None)  # bounded: one entry per (axis name, causal) pair
 def _ring_core(axis_name: str, causal: bool):
     """custom_vjp-wrapped ring attention (per-shard function, call inside
     shard_map): kernel-backed forward, second-ring-pass backward — the
     sequence-parallel path is trainable end to end."""
-    key = (axis_name, causal)
-    if key in _RING_CORE_CACHE:
-        return _RING_CORE_CACHE[key]
 
     @jax.custom_vjp
     def f(q, k, v):
@@ -154,7 +149,6 @@ def _ring_core(axis_name: str, causal: bool):
         return _ring_backward(q, k, v, o, l, m, do, axis_name, causal)
 
     f.defvjp(fwd, bwd)
-    _RING_CORE_CACHE[key] = f
     return f
 
 

@@ -124,6 +124,9 @@ def build_seq_parallel_train_step(mesh: Mesh, heads: int,
     if strategy == "full":
         attn_fn = partial(reference_attention, causal=True)
     else:
+        if strategy not in ("ring", "ulysses"):
+            raise ValueError(f"unknown strategy {strategy!r}; "
+                             f"known: ring, ulysses, full")
         inner = ring_attention if strategy == "ring" else ulysses_attention
 
         @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),

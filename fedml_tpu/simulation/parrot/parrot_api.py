@@ -24,6 +24,7 @@ and logging.  Everything else stays in HBM.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import time
 from functools import partial
@@ -371,7 +372,8 @@ class ParrotAPI:
                     self.server_state = state["server_state"]
                 logging.info("resumed from round %d", start_round - 1)
 
-        ctx = (self.mesh if self.mesh is not None else _NullCtx())
+        ctx = (self.mesh if self.mesh is not None
+               else contextlib.nullcontext())
         with ctx:
             for round_idx in range(start_round, comm_rounds):
                 t0 = time.time()
@@ -442,7 +444,8 @@ class ParrotAPI:
                     self.server_state = state["server_state"]
                 logging.info("fused: resumed from round %d", done - 1)
 
-        ctx = (self.mesh if self.mesh is not None else _NullCtx())
+        ctx = (self.mesh if self.mesh is not None
+               else contextlib.nullcontext())
         with ctx:
             while done < comm_rounds:
                 t0 = time.time()
@@ -468,10 +471,3 @@ class ParrotAPI:
                     })
         return final_metrics
 
-
-class _NullCtx:
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *a):
-        return False

@@ -15,6 +15,7 @@ LoRA, DeepSpeed ZeRO, prompt formatting, checkpointing) rebuilt TPU-native:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import logging
 import time
@@ -167,7 +168,8 @@ class LLMTrainer:
             from ...utils.checkpoint import RoundCheckpointer
 
             ckpt = RoundCheckpointer(cfg.checkpoint_dir)
-        ctx = self.mesh if self.mesh is not None else _NullCtx()
+        ctx = self.mesh if self.mesh is not None else \
+            contextlib.nullcontext()
         for ep in range(cfg.epochs):
             t0 = time.time()
             rng, sub = jax.random.split(rng)
@@ -208,10 +210,3 @@ class LLMTrainer:
             ids.append(nxt)
         return np.asarray(ids)
 
-
-class _NullCtx:
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *a):
-        return False
