@@ -1,0 +1,154 @@
+"""Per-row KV-cache incremental decoding for the functional transformer LM.
+
+The scalellm-equivalent engine (`llm_engine.py`) originally re-ran the full
+window every token — O(T²) per sequence.  This module gives it the standard
+TPU serving treatment (prefill/decode split, the vLLM/scalellm
+architecture):
+
+* ``prefill`` — one full forward over the prompt, returning the per-layer
+  K/V cache rows and the next-token logits;
+* ``decode_step`` — one token per row per call against the cache, with a
+  PER-ROW position vector, so continuously-batched rows at different
+  generation depths share one fixed-shape jitted step (flax's built-in
+  decode cache keys on a single scalar index and cannot do this);
+* ``KVCacheLM`` — stateless convenience wrapper holding params/config.
+
+Model = `parallel.seq_parallel` functional LM (same params pytree, same
+math; parity-tested token-for-token against the non-cached forward).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.seq_parallel import _ln, init_lm_params, lm_forward
+
+
+def init_cache(params: Dict[str, Any], batch: int, max_len: int,
+               heads: int) -> List[Dict[str, jnp.ndarray]]:
+    dim = params["embed"].shape[1]
+    dh = dim // heads
+    return [{"k": jnp.zeros((batch, max_len, heads, dh)),
+             "v": jnp.zeros((batch, max_len, heads, dh))}
+            for _ in params["blocks"]]
+
+
+@partial(jax.jit, static_argnames=("heads",))
+def prefill(params: Dict[str, Any], tokens: jnp.ndarray,
+            length: jnp.ndarray, heads: int
+            ) -> Tuple[List[Dict[str, jnp.ndarray]], jnp.ndarray]:
+    """Full pass over padded prompts [B, T] (valid length per row) →
+    (cache rows for positions < T, logits at the last valid position)."""
+    b, t = tokens.shape
+    dim = params["embed"].shape[1]
+    dh = dim // heads
+    h = params["embed"][tokens] + params["pos"][:t][None]
+    cache = []
+    pos_ids = jnp.arange(t)
+    for blk in params["blocks"]:
+        y = _ln(h, blk["ln1"])
+
+        def heads_of(w):
+            return (y @ w).reshape(b, t, heads, dh)
+
+        q = heads_of(blk["wq"]).transpose(0, 2, 1, 3)
+        k = heads_of(blk["wk"])
+        v = heads_of(blk["wv"])
+        cache.append({"k": k, "v": v})
+        kt = k.transpose(0, 2, 1, 3)
+        vt = v.transpose(0, 2, 1, 3)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, kt) / np.sqrt(dh)
+        causal = pos_ids[:, None] >= pos_ids[None, :]
+        s = jnp.where(causal[None, None], s, -1e30)
+        o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), vt)
+        h = h + o.transpose(0, 2, 1, 3).reshape(b, t, dim) @ blk["wo"]
+        y = _ln(h, blk["ln2"])
+        h = h + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"]
+    h = _ln(h, params["ln_f"])
+    logits = h @ params["embed"].T                       # [B, T, V]
+    last = jnp.take_along_axis(
+        logits, (length - 1)[:, None, None], axis=1)[:, 0]
+    return cache, last
+
+
+@partial(jax.jit, static_argnames=("heads",))
+def decode_step(params: Dict[str, Any],
+                cache: List[Dict[str, jnp.ndarray]],
+                token: jnp.ndarray, pos: jnp.ndarray, heads: int
+                ) -> Tuple[List[Dict[str, jnp.ndarray]], jnp.ndarray]:
+    """One token per row: ``token`` [B] at per-row position ``pos`` [B].
+    Writes this position's K/V into the cache and returns next logits."""
+    b = token.shape[0]
+    dim = params["embed"].shape[1]
+    dh = dim // heads
+    t_cache = cache[0]["k"].shape[1]
+    h = params["embed"][token] + params["pos"][pos]       # [B, D]
+    new_cache = []
+    rows = jnp.arange(b)
+    for blk, layer in zip(params["blocks"], cache):
+        y = _ln(h, blk["ln1"])
+        q = (y @ blk["wq"]).reshape(b, heads, dh)
+        k_new = (y @ blk["wk"]).reshape(b, heads, dh)
+        v_new = (y @ blk["wv"]).reshape(b, heads, dh)
+        k_cache = layer["k"].at[rows, pos].set(k_new)
+        v_cache = layer["v"].at[rows, pos].set(v_new)
+        new_cache.append({"k": k_cache, "v": v_cache})
+        s = jnp.einsum("bhd,bthd->bht", q, k_cache) / np.sqrt(dh)
+        valid = (jnp.arange(t_cache)[None] <= pos[:, None])  # [B, T]
+        s = jnp.where(valid[:, None, :], s, -1e30)
+        w = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bht,bthd->bhd", w, v_cache).reshape(b, dim)
+        h = h + o @ blk["wo"]
+        y = _ln(h, blk["ln2"])
+        h = h + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"]
+    h = _ln(h, params["ln_f"])
+    return new_cache, h @ params["embed"].T               # [B, V]
+
+
+class KVCacheLM:
+    """Decode-oriented LM handle for the batched engine: owns params and
+    config, exposes prefill/decode with per-row positions."""
+
+    def __init__(self, params: Dict[str, Any], heads: int,
+                 max_len: int) -> None:
+        self.params = params
+        self.heads = int(heads)
+        self.max_len = int(max_len)
+        self.vocab = int(params["embed"].shape[0])
+
+    @classmethod
+    def create(cls, rng: jax.Array, vocab: int, dim: int = 64,
+               layers: int = 2, heads: int = 4,
+               max_len: int = 256) -> "KVCacheLM":
+        return cls(init_lm_params(rng, vocab, dim=dim, layers=layers,
+                                  heads=heads, max_len=max_len),
+                   heads, max_len)
+
+    def init_cache(self, batch: int):
+        return init_cache(self.params, batch, self.max_len, self.heads)
+
+    def prefill(self, tokens, length):
+        return prefill(self.params, tokens, length, self.heads)
+
+    def decode(self, cache, token, pos):
+        return decode_step(self.params, cache, token, pos, self.heads)
+
+    def full_logits(self, tokens):
+        """Non-cached forward (parity reference / tests)."""
+        return lm_forward(self.params, tokens, self.heads,
+                          partial(_full_attention, causal=True))
+
+
+def _full_attention(q, k, v, causal=True):
+    dh = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(dh)
+    if causal:
+        t = q.shape[2]
+        m = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(m[None, None], s, -1e30)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)

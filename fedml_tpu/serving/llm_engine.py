@@ -197,3 +197,150 @@ class LLMEnginePredictor:
 
     def ready(self) -> bool:
         return self.engine.alive
+
+
+class KVCacheLLMEngine:
+    """Continuous batching over a per-row KV cache (`kv_cache_lm.KVCacheLM`)
+    — the prefill/decode architecture of scalellm/vLLM, with CHUNKED
+    prefill: prompt tokens are teacher-forced through the same fixed-shape
+    decode step as generation, one token per row per step, so newly
+    admitted prompts stream in while other rows keep generating and the
+    engine has exactly ONE compiled step.  Each generated token costs
+    O(cache_len) attention instead of the full-window O(T²) re-forward of
+    `BatchedLLMEngine`."""
+
+    def __init__(self, lm: Any, max_batch: int = 8,
+                 max_wait_s: float = 0.005) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self.lm = lm
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_s)
+        self._pending: "queue.Queue[_Request]" = queue.Queue()
+        self._active: List[Optional[_Request]] = [None] * self.max_batch
+        # per-slot decode state
+        self._consumed = [0] * self.max_batch   # prompt tokens already fed
+        self._pos = np.zeros((self.max_batch,), np.int32)
+        self._cache = lm.init_cache(self.max_batch)
+        self._stop = threading.Event()
+        self._rng = jax.random.PRNGKey(11)
+        self._jax, self._jnp = jax, jnp
+        self._worker = threading.Thread(target=self._loop, daemon=True,
+                                        name="kv-llm-engine")
+        self._worker.start()
+
+    # -- public API (mirrors BatchedLLMEngine) ------------------------------
+    def submit(self, prompt_ids, max_new: int = 20,
+               temperature: float = 0.0) -> "Future[np.ndarray]":
+        req = _Request(list(np.asarray(prompt_ids).tolist()), max_new,
+                       temperature)
+        if self._stop.is_set():
+            req.future.set_exception(RuntimeError("engine stopped"))
+            return req.future
+        cap = self.lm.max_len
+        req.prefix = []
+        if len(req.ids) + req.remaining > cap:
+            keep = max(cap - req.remaining, 1)
+            if len(req.ids) > keep:
+                # cache capacity: feed only the prompt TAIL, return the
+                # full sequence (mirrors BatchedLLMEngine's window)
+                req.prefix = req.ids[:-keep]
+                req.ids = req.ids[-keep:]
+            req.remaining = min(req.remaining, cap - len(req.ids))
+        if req.remaining <= 0 or len(req.ids) == 0:
+            req.future.set_result(np.asarray(req.prefix + req.ids))
+            return req.future
+        self._pending.put(req)
+        return req.future
+
+    def generate(self, prompt_ids, max_new: int = 20,
+                 temperature: float = 0.0, timeout: float = 120.0
+                 ) -> np.ndarray:
+        return self.submit(prompt_ids, max_new, temperature).result(timeout)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._worker.join(timeout=5.0)
+        while True:
+            try:
+                req = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            if not req.future.done():
+                req.future.set_exception(RuntimeError("engine stopped"))
+
+    @property
+    def active_count(self) -> int:
+        return sum(1 for r in self._active if r is not None)
+
+    @property
+    def alive(self) -> bool:
+        return not self._stop.is_set() and self._worker.is_alive()
+
+    # -- worker -------------------------------------------------------------
+    def _admit(self) -> None:
+        for slot in range(self.max_batch):
+            if self._active[slot] is None:
+                try:
+                    req = self._pending.get_nowait()
+                except queue.Empty:
+                    return
+                self._active[slot] = req
+                self._consumed[slot] = 0
+                self._pos[slot] = 0
+
+    def _loop(self) -> None:
+        jnp = self._jnp
+        while not self._stop.is_set():
+            self._admit()
+            if self.active_count == 0:
+                try:
+                    req = self._pending.get(timeout=0.5)
+                except queue.Empty:
+                    continue
+                self._active[0] = req
+                self._consumed[0] = 0
+                self._pos[0] = 0
+            # build this step's token vector: next prompt token (chunked
+            # prefill) or the last sampled token
+            tokens = np.zeros((self.max_batch,), np.int32)
+            for slot, req in enumerate(self._active):
+                if req is None:
+                    continue
+                tokens[slot] = req.ids[self._pos[slot]] \
+                    if self._pos[slot] < len(req.ids) else 0
+            self._cache, logits = self.lm.decode(
+                self._cache, jnp.asarray(tokens), jnp.asarray(self._pos))
+            logits = np.asarray(logits)
+            for slot, req in enumerate(self._active):
+                if req is None:
+                    continue
+                self._pos[slot] += 1
+                if self._pos[slot] < len(req.ids):
+                    continue                      # still prefilling
+                row = logits[slot]
+                if req.temperature > 0:
+                    self._rng, k = self._jax.random.split(self._rng)
+                    nxt = int(self._jax.random.categorical(
+                        k, jnp.asarray(row) / req.temperature))
+                else:
+                    nxt = int(np.argmax(row))
+                req.ids.append(nxt)
+                req.remaining -= 1
+                if (req.remaining <= 0
+                        or self._pos[slot] + 1 >= self.lm.max_len):
+                    req.future.set_result(
+                        np.asarray(getattr(req, "prefix", []) + req.ids))
+                    self._active[slot] = None
+        for req in self._active:
+            if req is not None and not req.future.done():
+                req.future.set_result(
+                    np.asarray(getattr(req, "prefix", []) + req.ids))
+        while True:
+            try:
+                req = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            if not req.future.done():
+                req.future.set_exception(RuntimeError("engine stopped"))
