@@ -1,0 +1,80 @@
+"""Endpoint replica autoscaler.
+
+Capability parity+: reference `comm_utils/job_monitor.py` watches endpoint
+replicas and releases/restarts them (SURVEY §2.12 "autoscale/reset logic");
+this module adds the explicit scaling POLICY the reference leaves implicit —
+a latency/queue-depth target controller suitable for the serving engines:
+
+* observe(qps, latency_s, queue_depth) windows per tick;
+* desired = clamp by target latency AND target per-replica qps;
+* hysteresis: scale up fast (any breach), scale down slowly (sustained
+  under-utilization), with a cooldown between scale events;
+* pure decision logic — applying the decision is a callback, so it drives
+  local engines, container replicas, or k8s alike.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, List, Optional
+
+
+@dataclasses.dataclass
+class AutoscalePolicy:
+    min_replicas: int = 1
+    max_replicas: int = 8
+    target_latency_s: float = 1.0      # scale up when p50 exceeds this
+    target_qps_per_replica: float = 10.0
+    scale_down_idle_ticks: int = 3     # sustained low load before shrinking
+    cooldown_s: float = 30.0
+
+
+class ReplicaAutoscaler:
+    def __init__(self, policy: Optional[AutoscalePolicy] = None,
+                 apply_fn: Optional[Callable[[int], None]] = None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.policy = policy or AutoscalePolicy()
+        self.apply_fn = apply_fn
+        self.clock = clock
+        self.replicas = self.policy.min_replicas
+        self._idle_ticks = 0
+        self._last_scale_t: float = -1e18
+        self.history: List[int] = []
+
+    # -- decision ------------------------------------------------------------
+    def observe(self, qps: float, latency_s: float,
+                queue_depth: int = 0) -> int:
+        """Feed one metrics window; returns the (possibly new) replica
+        count.  Calls ``apply_fn`` only when the count changes."""
+        p = self.policy
+        want = self.replicas
+        overloaded = (latency_s > p.target_latency_s
+                      or qps > p.target_qps_per_replica * self.replicas
+                      or queue_depth > 2 * self.replicas)
+        underloaded = (latency_s < 0.5 * p.target_latency_s
+                       and qps < 0.5 * p.target_qps_per_replica
+                       * max(self.replicas - 1, 1)
+                       and queue_depth == 0)
+        if overloaded:
+            self._idle_ticks = 0
+            # jump straight to the load-implied size (fast scale-up)
+            by_qps = -(-qps // max(p.target_qps_per_replica, 1e-9))
+            want = max(self.replicas + 1, int(by_qps))
+        elif underloaded:
+            self._idle_ticks += 1
+            if self._idle_ticks >= p.scale_down_idle_ticks:
+                want = self.replicas - 1       # shrink one step at a time
+                self._idle_ticks = 0
+        else:
+            self._idle_ticks = 0
+        want = max(p.min_replicas, min(p.max_replicas, want))
+
+        now = self.clock()
+        if want != self.replicas and (now - self._last_scale_t) >= p.cooldown_s:
+            self.replicas = want
+            self._last_scale_t = now
+            self.history.append(want)
+            if self.apply_fn:
+                self.apply_fn(want)
+        return self.replicas

@@ -321,3 +321,40 @@ def test_stop_during_upgrade_cancels_buffered_start(tmp_path):
         assert result["edges"][edge]["status"] == "KILLED"
     finally:
         agent.stop()
+
+
+def test_replica_autoscaler_scales_up_down_with_cooldown():
+    from fedml_tpu.scheduler.autoscaler import (
+        AutoscalePolicy,
+        ReplicaAutoscaler,
+    )
+
+    t = [0.0]
+    applied = []
+    a = ReplicaAutoscaler(
+        AutoscalePolicy(min_replicas=1, max_replicas=4,
+                        target_latency_s=1.0, target_qps_per_replica=10.0,
+                        scale_down_idle_ticks=2, cooldown_s=10.0),
+        apply_fn=applied.append, clock=lambda: t[0])
+
+    # overload by qps → jumps to the load-implied size
+    assert a.observe(qps=35.0, latency_s=0.5) == 4
+    assert applied == [4]
+    # cooldown blocks an immediate scale-down
+    for _ in range(5):
+        a.observe(qps=0.5, latency_s=0.1)
+    assert a.replicas == 4
+    # after cooldown, sustained idle shrinks ONE step per window
+    t[0] = 11.0
+    for _ in range(2):
+        a.observe(qps=0.5, latency_s=0.1)
+    assert a.replicas == 3
+    t[0] = 22.0
+    a.observe(qps=0.5, latency_s=0.1)
+    a.observe(qps=0.5, latency_s=0.1)
+    assert a.replicas == 2
+    # latency breach alone also scales up (bounded by max)
+    t[0] = 40.0
+    assert a.observe(qps=1.0, latency_s=5.0) == 3
+    # bounds respected
+    assert all(1 <= r <= 4 for r in a.history)
