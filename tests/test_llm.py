@@ -248,3 +248,35 @@ def test_kv_cache_engine_long_prompt_truncates_but_returns_full():
         eng.stop()
     assert out[:30] == prompt           # full prompt comes back
     assert len(out) == 34               # plus the requested tokens
+
+
+def test_quantized_kv_lm_close_to_full_precision():
+    """Int8 per-channel weight quantization: decode logits track the
+    full-precision model closely and the engine serves through it."""
+    from fedml_tpu.serving.kv_cache_lm import KVCacheLM
+    from fedml_tpu.serving.llm_engine import KVCacheLLMEngine
+    from fedml_tpu.serving.quantization import QuantizedKVCacheLM
+
+    lm = KVCacheLM.create(jax.random.PRNGKey(2), vocab=40, dim=32,
+                          layers=2, heads=4, max_len=32)
+    qlm = QuantizedKVCacheLM.from_lm(lm)
+
+    toks = jnp.asarray(np.random.RandomState(3).randint(0, 40, size=(2, 10)))
+    full = lm.full_logits(toks)
+    quant = qlm.full_logits(toks)
+    # int8 noise is small relative to the logit scale
+    scale = float(jnp.std(full))
+    assert float(jnp.max(jnp.abs(full - quant))) < 0.15 * max(scale, 1.0)
+
+    # cached decode parity with ITSELF (prefill+decode vs full forward)
+    length = jnp.asarray([10, 10], jnp.int32)
+    cache_rows, last = qlm.prefill(toks, length)
+    np.testing.assert_allclose(np.asarray(last), np.asarray(quant[:, 9]),
+                               atol=1e-4, rtol=1e-4)
+
+    eng = KVCacheLLMEngine(qlm, max_batch=2)
+    try:
+        out = eng.generate(list(range(5)), max_new=4, timeout=120)
+    finally:
+        eng.stop()
+    assert len(out) == 9
