@@ -21,6 +21,21 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 
+def _flash_attention_fn(causal: bool):
+    """flax ``attention_fn`` adapter for the flash pallas kernel
+    (`ops/pallas_attention.flash_mha`): [B, T, H, D] in/out.  The causal
+    structure is re-derived from ``causal`` (the passed mask is exactly the
+    tril mask for these models); kernel on TPU, identical-math fallback
+    elsewhere."""
+
+    def fn(query, key, value, *args, **kwargs):
+        from ..ops.pallas_attention import flash_mha
+
+        return flash_mha(query, key, value, causal=causal)
+
+    return fn
+
+
 class CharLSTM(nn.Module):
     """Shakespeare next-char model (reference RNN_OriginalFedAvg)."""
 
@@ -68,6 +83,10 @@ class TransformerBlock(nn.Module):
     dropout: float = 0.0
     causal: bool = False
     dtype: Any = jnp.float32
+    #: route deterministic passes through the flash pallas kernel (same
+    #: params, same math; attention-weight dropout forces the flax path
+    #: during training)
+    use_flash: bool = True
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -76,9 +95,13 @@ class TransformerBlock(nn.Module):
             t = x.shape[1]
             mask = jnp.tril(jnp.ones((1, 1, t, t), bool))
         y = nn.LayerNorm(dtype=self.dtype)(x)
+        flashable = self.use_flash and (not train or self.dropout == 0.0)
+        attention_fn = (_flash_attention_fn(self.causal) if flashable
+                        else nn.dot_product_attention)
         y = nn.MultiHeadDotProductAttention(
             num_heads=self.heads, dtype=self.dtype,
-            dropout_rate=self.dropout, deterministic=not train)(y, y, mask=mask)
+            dropout_rate=self.dropout, deterministic=not train,
+            attention_fn=attention_fn)(y, y, mask=mask)
         x = x + y
         y = nn.LayerNorm(dtype=self.dtype)(x)
         y = nn.Dense(self.dim * self.mlp_ratio, dtype=self.dtype)(y)

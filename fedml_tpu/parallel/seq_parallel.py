@@ -69,17 +69,21 @@ def _ln(x, g):
 
 
 def lm_forward(params: Dict[str, Any], tokens: jnp.ndarray, heads: int,
-               attn_fn) -> jnp.ndarray:
+               attn_fn, remat: bool = False) -> jnp.ndarray:
     """[B, T] int tokens → [B, T, V] logits.  ``attn_fn(q, k, v)`` consumes
     [B, H, T, D_h] — plug in full attention, a shard_map'd ring, or Ulysses;
-    everything else is position-wise and sharding-constraint friendly."""
+    everything else is position-wise and sharding-constraint friendly.
+    ``remat=True`` rematerializes each block's activations in the backward
+    pass (`jax.checkpoint`), trading FLOPs for the activation memory that
+    dominates long-context training."""
     b, t = tokens.shape
     dim = params["embed"].shape[1]
     dh = dim // heads
     # NOTE positions must be GLOBAL: tokens arrive [B, T] logically; under
     # jit the T axis is sharded and iota is partitioned correctly by XLA.
     h = params["embed"][tokens] + params["pos"][:t][None]
-    for blk in params["blocks"]:
+
+    def block(h, blk):
         y = _ln(h, blk["ln1"])
 
         def split_heads(w):
@@ -91,17 +95,23 @@ def lm_forward(params: Dict[str, Any], tokens: jnp.ndarray, heads: int,
         o = o.transpose(0, 2, 1, 3).reshape(b, t, dim)
         h = h + o @ blk["wo"]
         y = _ln(h, blk["ln2"])
-        h = h + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"]
+        return h + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"]
+
+    if remat:
+        block = jax.checkpoint(block)
+    for blk in params["blocks"]:
+        h = block(h, blk)
     h = _ln(h, params["ln_f"])
     return h @ params["embed"].T                   # tied output embedding
 
 
-def lm_loss(params, tokens, heads, attn_fn) -> jnp.ndarray:
+def lm_loss(params, tokens, heads, attn_fn,
+            remat: bool = False) -> jnp.ndarray:
     """Next-token CE over [B, T].  The model runs on the FULL (sharded) T —
     the last position is masked out of the loss instead of sliced off, so
     the sequence axis stays evenly divisible by the mesh."""
     b, t = tokens.shape
-    logits = lm_forward(params, tokens, heads, attn_fn)       # [B, T, V]
+    logits = lm_forward(params, tokens, heads, attn_fn, remat)  # [B, T, V]
     targets = jnp.concatenate(
         [tokens[:, 1:], jnp.zeros((b, 1), tokens.dtype)], axis=1)
     logz = jax.nn.logsumexp(logits, axis=-1)
@@ -114,11 +124,13 @@ def lm_loss(params, tokens, heads, attn_fn) -> jnp.ndarray:
 def build_seq_parallel_train_step(mesh: Mesh, heads: int,
                                   strategy: str = "ring",
                                   learning_rate: float = 0.1,
-                                  axis_name: str = AXIS_SEQ):
+                                  axis_name: str = AXIS_SEQ,
+                                  remat: bool = False):
     """Returns (train_step, token_sharding): ``train_step(params, tokens)``
     → (new_params, loss), jitted over ``mesh`` with tokens sharded [B, T/P]
     and replicated params.  ``strategy``: "ring" | "ulysses" | "full"
-    (full = no sequence parallelism, for parity checks)."""
+    (full = no sequence parallelism, for parity checks); ``remat``
+    rematerializes per-block activations for long-context memory."""
     spec = P(None, None, axis_name, None)
 
     if strategy == "full":
@@ -136,7 +148,7 @@ def build_seq_parallel_train_step(mesh: Mesh, heads: int,
 
     def train_step(params, tokens):
         loss, grads = jax.value_and_grad(lm_loss)(
-            params, tokens, heads, attn_fn)
+            params, tokens, heads, attn_fn, remat)
         new_params = jax.tree_util.tree_map(
             lambda p, g: p - learning_rate * g, params, grads)
         return new_params, loss

@@ -280,3 +280,29 @@ def test_quantized_kv_lm_close_to_full_precision():
     finally:
         eng.stop()
     assert len(out) == 9
+
+
+def test_transformer_block_flash_path_matches_flax():
+    """Deterministic passes through the flash attention_fn equal the
+    stock flax dot-product attention (same params)."""
+    from fedml_tpu.models.nlp import TinyTransformerLM
+
+    x = jnp.asarray(np.random.RandomState(5).randint(0, 90, size=(2, 16)))
+    flash_lm = TinyTransformerLM(vocab_size=90, dim=32, layers=2, heads=2)
+    v = flash_lm.init(jax.random.PRNGKey(0), x)
+    out_flash = flash_lm.apply(v, x, train=False)
+
+    # rebuild with use_flash disabled in every block via module kwargs
+    from fedml_tpu.models import nlp as _nlp
+
+    orig = _nlp.TransformerBlock
+    try:
+        _nlp.TransformerBlock = lambda *a, **kw: orig(
+            *a, **dict(kw, use_flash=False))
+        plain_lm = TinyTransformerLM(vocab_size=90, dim=32, layers=2,
+                                     heads=2)
+        out_plain = plain_lm.apply(v, x, train=False)
+    finally:
+        _nlp.TransformerBlock = orig
+    np.testing.assert_allclose(np.asarray(out_flash), np.asarray(out_plain),
+                               atol=2e-5, rtol=2e-5)

@@ -272,3 +272,26 @@ def test_seq_parallel_lm_train_step_matches_full(strategy):
             p, l = step_sp(p, toks)
             losses.append(float(l))
         assert losses[-1] < losses[0]
+
+
+def test_seq_parallel_remat_matches_no_remat():
+    """jax.checkpoint over blocks changes memory, not math."""
+    from fedml_tpu.parallel.seq_parallel import (
+        build_seq_parallel_train_step, init_lm_params)
+
+    mesh = build_mesh({"seq": 4})
+    params = init_lm_params(jax.random.PRNGKey(0), 31, dim=32, layers=2,
+                            heads=4, max_len=16)
+    tokens = jnp.asarray(np.random.RandomState(0).randint(0, 31, (2, 16)))
+    outs = []
+    for remat in (False, True):
+        step, shard = build_seq_parallel_train_step(mesh, 4, strategy="ring",
+                                                    remat=remat)
+        with mesh:
+            p, loss = step(params, jax.device_put(tokens, shard))
+        outs.append((p, float(loss)))
+    assert outs[0][1] == pytest.approx(outs[1][1], rel=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                atol=1e-5, rtol=1e-5),
+        outs[0][0], outs[1][0])
