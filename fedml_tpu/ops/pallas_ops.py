@@ -129,3 +129,53 @@ def quantize_mask(x: jnp.ndarray, mask: jnp.ndarray, scale: float = 2.0**16,
         interpret=interpret,
     )(xp, mp)
     return out.reshape(dp)[:d]
+
+
+# ---------------------------------------------------------------------------
+# int8 weight matmul with in-kernel dequant (serving decode path)
+# ---------------------------------------------------------------------------
+
+_MM_BLOCK_N = 512
+
+
+def _int8_mm_kernel(x_ref, q_ref, s_ref, o_ref):
+    # x: [M, K]; q: [K, BN] int8; s: [1, BN] per-channel scales.
+    # dequant happens on the VMEM tile — the int8 matrix is what crossed
+    # HBM, which is the bandwidth the decode path is bound by.
+    acc = jnp.dot(x_ref[:], q_ref[:].astype(jnp.float32),
+                  preferred_element_type=jnp.float32)
+    o_ref[:] = acc * s_ref[:]
+
+
+def int8_matmul(x: jnp.ndarray, q: jnp.ndarray, s: jnp.ndarray,
+                interpret: Optional[bool] = None) -> jnp.ndarray:
+    """x [M, K] (f32/bf16) @ dequant(q [K, N] int8, s [N]) → [M, N] f32.
+
+    The pallas "quantization kernel" pattern: weights stream HBM→VMEM as
+    int8 (half of bf16), dequantize in-register, hit the MXU per [K, BN]
+    tile.  Off-TPU falls back to the identical jnp math."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    m, k = x.shape
+    n = q.shape[1]
+    if not _HAS_PALLAS:
+        return (x.astype(jnp.float32) @ q.astype(jnp.float32)) * s[None, :]
+    bn = min(_MM_BLOCK_N, n)
+    pad = (-n) % bn
+    if pad:
+        q = jnp.pad(q, ((0, 0), (0, pad)))
+        s = jnp.pad(s, (0, pad))
+    npad = n + pad
+    out = pl.pallas_call(
+        _int8_mm_kernel,
+        grid=(npad // bn,),
+        in_specs=[
+            pl.BlockSpec((m, k), lambda j: (0, 0)),
+            pl.BlockSpec((k, bn), lambda j: (0, j)),
+            pl.BlockSpec((1, bn), lambda j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((m, bn), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((m, npad), jnp.float32),
+        interpret=interpret,
+    )(x.astype(jnp.float32), q, s.astype(jnp.float32).reshape(1, -1))
+    return out[:, :n]

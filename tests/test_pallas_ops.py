@@ -100,3 +100,20 @@ def test_flash_residuals_merge_matches_full():
     ref = reference_attention(q, k, v, causal=False)
     np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_int8_matmul_matches_dequant_reference():
+    from fedml_tpu.ops.pallas_ops import int8_matmul
+    from fedml_tpu.serving.quantization import quantize_matrix_int8
+
+    rng = np.random.RandomState(6)
+    w = jnp.asarray(rng.randn(48, 700), jnp.float32)  # N not block-aligned
+    x = jnp.asarray(rng.randn(4, 48), jnp.float32)
+    qs = quantize_matrix_int8(w)
+    out = int8_matmul(x, qs["q"], qs["s"], interpret=True)
+    ref = (x @ (qs["q"].astype(jnp.float32) * qs["s"][None, :]))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
+    # and the quantization itself tracks the dense matrix
+    assert float(jnp.max(jnp.abs(w - qs["q"].astype(jnp.float32)
+                                 * qs["s"][None, :]))) < 0.05
