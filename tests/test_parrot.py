@@ -48,7 +48,7 @@ def test_mesh_backend_shards_clients(args_factory):
 
 
 @pytest.mark.parametrize("optimizer", [
-    "FedAvg", "FedProx", "FedOpt", "FedNova", "SCAFFOLD", "FedDyn",
+    "FedAvg", "FedProx", "FedOpt", "FedNova", "SCAFFOLD", "FedDyn", "Mime",
 ])
 def test_parrot_matches_sp_exactly(args_factory, optimizer):
     """Convergence-parity audit (SURVEY §7 hard part f): the vectorized
