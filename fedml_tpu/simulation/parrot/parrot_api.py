@@ -303,9 +303,9 @@ class ParrotAPI:
     #: count (only a final remainder < chunk triggers a second, smaller
     #: compile).  Measured on v5e through the remote-TPU tunnel
     #: (~115 ms/dispatch): chunk 8 → 27 rounds/s, 32 → 38, 64 → 41 on the
-    #: north-star ResNet-56 config; 32 takes most of the amortization while
-    #: keeping compile time and remainder-recompile cost bounded.
-    FUSED_CHUNK_ROUNDS = 32
+    #: north-star ResNet-56 config; compile time stays ~30 s at every
+    #: chunk size, so take the 64-round plateau.
+    FUSED_CHUNK_ROUNDS = 64
 
     def run_rounds_fused(self, n_rounds: int, rng: Optional[jax.Array] = None):
         """Public fast path: run n_rounds fused in fixed-size scan chunks;
