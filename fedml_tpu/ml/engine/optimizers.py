@@ -13,9 +13,36 @@ from typing import Any
 import optax
 
 
+def make_lr(cfg: Any):
+    """Learning rate or schedule (reference: HF TrainingArguments
+    lr_scheduler_type in `train/llm/configurations.py`).  ``lr_schedule``:
+    "constant" (default) | "cosine" | "linear", with ``warmup_steps`` and
+    ``lr_decay_steps`` counting optimizer steps."""
+    lr = float(getattr(cfg, "learning_rate", 0.03))
+    kind = str(getattr(cfg, "lr_schedule", "constant") or "constant").lower()
+    if kind == "constant":
+        return lr
+    warmup = int(getattr(cfg, "warmup_steps", 0) or 0)
+    decay = int(getattr(cfg, "lr_decay_steps", 1000) or 1000)
+    if kind == "cosine":
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=lr, warmup_steps=max(warmup, 1),
+            decay_steps=max(decay, warmup + 1))
+    if kind == "linear":
+        # join_schedules rebases the step count at each boundary, so the
+        # decay leg must NOT carry its own transition_begin offset
+        sched = optax.linear_schedule(lr, 0.0, max(decay - warmup, 1))
+        if warmup:
+            wu = optax.linear_schedule(0.0, lr, warmup)
+            return optax.join_schedules([wu, sched], [warmup])
+        return sched
+    raise ValueError(f"unknown lr_schedule {kind!r}; "
+                     f"known: constant, cosine, linear")
+
+
 def build_client_optimizer(cfg: Any) -> optax.GradientTransformation:
     name = str(getattr(cfg, "client_optimizer", "sgd")).lower()
-    lr = float(getattr(cfg, "learning_rate", 0.03))
+    lr = make_lr(cfg)
     wd = float(getattr(cfg, "weight_decay", 0.0) or 0.0)
     momentum = float(getattr(cfg, "momentum", 0.0) or 0.0)
     if name == "adam":

@@ -49,6 +49,14 @@ class LLMTrainConfig:
     #: axis shards over `data` in all sharded modes.
     strategy: str = "none"
     data_parallel: int = -1  # mesh size; -1 = all devices
+    #: apply the optimizer every k batches, accumulating gradients in
+    #: between (reference: TrainingArguments.gradient_accumulation_steps) —
+    #: large effective batches without the activation memory.
+    grad_accum_steps: int = 1
+    #: "constant" | "cosine" | "linear" (ml/engine/optimizers.make_lr)
+    lr_schedule: str = "constant"
+    warmup_steps: int = 0
+    lr_decay_steps: int = 1000
 
 
 def pack_sequences(token_ids: np.ndarray, seq_len: int,
@@ -86,8 +94,12 @@ class LLMTrainer:
                                   rank=config.lora_rank, rng=rng)
             logging.info("LoRA: %d trainable params",
                          count_trainable(self.lora))
+        from ...ml.engine.optimizers import make_lr
+
         tx = optax.chain(optax.clip_by_global_norm(config.grad_clip),
-                         optax.adamw(config.learning_rate))
+                         optax.adamw(make_lr(config)))
+        if int(config.grad_accum_steps) > 1:
+            tx = optax.MultiSteps(tx, int(config.grad_accum_steps))
         self.tx = tx
         self.mesh = None
         if config.strategy in ("dp", "fsdp"):

@@ -306,3 +306,40 @@ def test_transformer_block_flash_path_matches_flax():
         _nlp.TransformerBlock = orig
     np.testing.assert_allclose(np.asarray(out_flash), np.asarray(out_plain),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_llm_trainer_grad_accum_and_cosine_schedule():
+    """gradient_accumulation_steps + cosine LR run end to end and learn."""
+    import fedml_tpu
+    from fedml_tpu.train.llm.trainer import LLMTrainConfig, LLMTrainer
+
+    args = fedml_tpu.Config(model="transformer", dataset="shakespeare",
+                            compute_dtype="float32")
+    bundle = fedml_tpu.model.create(args, 90)
+    tokens = np.random.RandomState(0).randint(0, 90, size=6000)
+    cfg = LLMTrainConfig(seq_len=32, batch_size=4, epochs=3,
+                         learning_rate=3e-3, lora_rank=4,
+                         grad_accum_steps=2, lr_schedule="cosine",
+                         warmup_steps=5, lr_decay_steps=60)
+    out = LLMTrainer(bundle, cfg).train(tokens)
+    assert out["loss_history"][-1] < out["loss_history"][0]
+
+
+def test_make_lr_schedules():
+    from types import SimpleNamespace as NS
+
+    from fedml_tpu.ml.engine.optimizers import make_lr
+
+    const = make_lr(NS(learning_rate=0.1))
+    assert const == 0.1
+    cos = make_lr(NS(learning_rate=0.1, lr_schedule="cosine",
+                     warmup_steps=10, lr_decay_steps=100))
+    assert float(cos(0)) < 1e-6 and abs(float(cos(10)) - 0.1) < 1e-6
+    assert float(cos(100)) < float(cos(50))
+    lin = make_lr(NS(learning_rate=0.2, lr_schedule="linear",
+                     warmup_steps=4, lr_decay_steps=20))
+    assert abs(float(lin(4)) - 0.2) < 1e-6 and float(lin(20)) < 1e-6
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        make_lr(NS(learning_rate=0.1, lr_schedule="nope"))
