@@ -26,11 +26,37 @@ import numpy as np
 
 class _Request:
     def __init__(self, prompt_ids: List[int], max_new: int,
-                 temperature: float) -> None:
+                 temperature: float, top_k: int = 0,
+                 top_p: float = 1.0) -> None:
         self.ids = list(prompt_ids)
         self.remaining = int(max_new)
         self.temperature = float(temperature)
+        self.top_k = int(top_k or 0)
+        self.top_p = float(top_p if top_p is not None else 1.0)
         self.future: "Future[np.ndarray]" = Future()
+
+
+def _sample_token(row: np.ndarray, req: "_Request", rng: np.random.Generator
+                  ) -> int:
+    """Greedy / temperature with optional top-k then nucleus (top-p)
+    filtering (reference serving templates' sampling controls)."""
+    if req.temperature <= 0:
+        return int(np.argmax(row))
+    logits = row.astype(np.float64) / req.temperature
+    if req.top_k > 0 and req.top_k < len(logits):
+        kth = np.partition(logits, -req.top_k)[-req.top_k]
+        logits = np.where(logits < kth, -np.inf, logits)
+    p = np.exp(logits - np.max(logits))
+    p = p / p.sum()
+    if 0.0 < req.top_p < 1.0:
+        order = np.argsort(-p)
+        csum = np.cumsum(p[order])
+        cut = int(np.searchsorted(csum, req.top_p)) + 1
+        mask = np.zeros_like(p)
+        mask[order[:cut]] = 1.0
+        p = p * mask
+        p = p / p.sum()
+    return int(rng.choice(len(p), p=p))
 
 
 class BatchedLLMEngine:
@@ -49,7 +75,7 @@ class BatchedLLMEngine:
         self._pending: "queue.Queue[_Request]" = queue.Queue()
         self._active: List[Optional[_Request]] = [None] * self.max_batch
         self._stop = threading.Event()
-        self._rng = jax.random.PRNGKey(7)
+        self._np_rng = np.random.default_rng(7)
 
         def step(variables, x, pos):
             # sequences are LEFT-aligned with zero right-padding; under
@@ -70,9 +96,10 @@ class BatchedLLMEngine:
 
     # -- public API ---------------------------------------------------------
     def submit(self, prompt_ids, max_new: int = 20,
-               temperature: float = 0.0) -> "Future[np.ndarray]":
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 1.0) -> "Future[np.ndarray]":
         req = _Request(list(np.asarray(prompt_ids).tolist()), max_new,
-                       temperature)
+                       temperature, top_k, top_p)
         if self._stop.is_set():
             req.future.set_exception(RuntimeError("engine stopped"))
             return req.future
@@ -83,9 +110,10 @@ class BatchedLLMEngine:
         return req.future
 
     def generate(self, prompt_ids, max_new: int = 20,
-                 temperature: float = 0.0, timeout: float = 120.0
-                 ) -> np.ndarray:
-        return self.submit(prompt_ids, max_new, temperature).result(timeout)
+                 temperature: float = 0.0, timeout: float = 120.0,
+                 top_k: int = 0, top_p: float = 1.0) -> np.ndarray:
+        return self.submit(prompt_ids, max_new, temperature, top_k,
+                           top_p).result(timeout)
 
     def stop(self) -> None:
         self._stop.set()
@@ -143,13 +171,7 @@ class BatchedLLMEngine:
             for slot, req in enumerate(self._active):
                 if req is None:
                     continue
-                row = logits[slot]
-                if req.temperature > 0:
-                    self._rng, k = self._jax.random.split(self._rng)
-                    nxt = int(self._jax.random.categorical(
-                        k, jnp.asarray(row) / req.temperature))
-                else:
-                    nxt = int(np.argmax(row))
+                nxt = _sample_token(logits[slot], req, self._np_rng)
                 req.ids.append(nxt)
                 req.remaining -= 1
                 if req.remaining <= 0:
@@ -190,9 +212,12 @@ class LLMEnginePredictor:
         raw_max = request.get("max_tokens")
         max_tokens = 20 if raw_max is None else int(raw_max)
         temperature = float(request.get("temperature", 0.0) or 0.0)
+        top_k = int(request.get("top_k", 0) or 0)
+        top_p = float(request.get("top_p", 1.0) or 1.0)
         ids = self.encode(prompt)
         out = self.engine.generate(ids, max_new=max_tokens,
-                                   temperature=temperature)
+                                   temperature=temperature, top_k=top_k,
+                                   top_p=top_p)
         return self.decode(out[len(ids):])
 
     def ready(self) -> bool:
@@ -224,7 +249,7 @@ class KVCacheLLMEngine:
         self._pos = np.zeros((self.max_batch,), np.int32)
         self._cache = lm.init_cache(self.max_batch)
         self._stop = threading.Event()
-        self._rng = jax.random.PRNGKey(11)
+        self._np_rng = np.random.default_rng(11)
         self._jax, self._jnp = jax, jnp
         self._worker = threading.Thread(target=self._loop, daemon=True,
                                         name="kv-llm-engine")
@@ -232,9 +257,10 @@ class KVCacheLLMEngine:
 
     # -- public API (mirrors BatchedLLMEngine) ------------------------------
     def submit(self, prompt_ids, max_new: int = 20,
-               temperature: float = 0.0) -> "Future[np.ndarray]":
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 1.0) -> "Future[np.ndarray]":
         req = _Request(list(np.asarray(prompt_ids).tolist()), max_new,
-                       temperature)
+                       temperature, top_k, top_p)
         if self._stop.is_set():
             req.future.set_exception(RuntimeError("engine stopped"))
             return req.future
@@ -255,9 +281,10 @@ class KVCacheLLMEngine:
         return req.future
 
     def generate(self, prompt_ids, max_new: int = 20,
-                 temperature: float = 0.0, timeout: float = 120.0
-                 ) -> np.ndarray:
-        return self.submit(prompt_ids, max_new, temperature).result(timeout)
+                 temperature: float = 0.0, timeout: float = 120.0,
+                 top_k: int = 0, top_p: float = 1.0) -> np.ndarray:
+        return self.submit(prompt_ids, max_new, temperature, top_k,
+                           top_p).result(timeout)
 
     def stop(self) -> None:
         self._stop.set()
@@ -319,13 +346,7 @@ class KVCacheLLMEngine:
                 self._pos[slot] += 1
                 if self._pos[slot] < len(req.ids):
                     continue                      # still prefilling
-                row = logits[slot]
-                if req.temperature > 0:
-                    self._rng, k = self._jax.random.split(self._rng)
-                    nxt = int(self._jax.random.categorical(
-                        k, jnp.asarray(row) / req.temperature))
-                else:
-                    nxt = int(np.argmax(row))
+                nxt = _sample_token(logits[slot], req, self._np_rng)
                 req.ids.append(nxt)
                 req.remaining -= 1
                 if (req.remaining <= 0

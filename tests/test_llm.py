@@ -343,3 +343,20 @@ def test_make_lr_schedules():
 
     with _pytest.raises(ValueError):
         make_lr(NS(learning_rate=0.1, lr_schedule="nope"))
+
+
+def test_sampling_controls_top_k_top_p():
+    """top-k / nucleus filtering restricts sampled tokens to the allowed
+    set; greedy ignores them."""
+    from fedml_tpu.serving.llm_engine import _Request, _sample_token
+
+    rng = np.random.default_rng(0)
+    row = np.asarray([5.0, 4.0, 3.0, -10.0, -10.0])
+    greedy = _Request([0], 1, temperature=0.0)
+    assert _sample_token(row, greedy, rng) == 0
+    topk = _Request([0], 1, temperature=1.0, top_k=2)
+    picks = {_sample_token(row, topk, rng) for _ in range(50)}
+    assert picks <= {0, 1}
+    nucleus = _Request([0], 1, temperature=1.0, top_p=0.6)
+    picks = {_sample_token(row, nucleus, rng) for _ in range(50)}
+    assert picks <= {0, 1}  # p(0)~0.70 covers the 0.6 nucleus with token 0+1
