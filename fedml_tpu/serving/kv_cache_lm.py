@@ -76,7 +76,7 @@ def prefill(params: Dict[str, Any], tokens: jnp.ndarray,
     return cache, last
 
 
-@partial(jax.jit, static_argnames=("heads",))
+@partial(jax.jit, static_argnames=("heads",), donate_argnums=(1,))
 def decode_step(params: Dict[str, Any],
                 cache: List[Dict[str, jnp.ndarray]],
                 token: jnp.ndarray, pos: jnp.ndarray, heads: int
@@ -140,15 +140,7 @@ class KVCacheLM:
 
     def full_logits(self, tokens):
         """Non-cached forward (parity reference / tests)."""
+        from ..parallel.ring_attention import reference_attention
+
         return lm_forward(self.params, tokens, self.heads,
-                          partial(_full_attention, causal=True))
-
-
-def _full_attention(q, k, v, causal=True):
-    dh = q.shape[-1]
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(dh)
-    if causal:
-        t = q.shape[2]
-        m = jnp.tril(jnp.ones((t, t), bool))
-        s = jnp.where(m[None, None], s, -1e30)
-    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
+                          partial(reference_attention, causal=True))

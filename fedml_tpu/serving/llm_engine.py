@@ -48,10 +48,11 @@ def _sample_token(row: np.ndarray, req: "_Request", rng: np.random.Generator
         logits = np.where(logits < kth, -np.inf, logits)
     p = np.exp(logits - np.max(logits))
     p = p / p.sum()
-    if 0.0 < req.top_p < 1.0:
+    if req.top_p < 1.0:
+        # top_p<=0 degenerates to keep-top-token (HF convention)
         order = np.argsort(-p)
         csum = np.cumsum(p[order])
-        cut = int(np.searchsorted(csum, req.top_p)) + 1
+        cut = max(int(np.searchsorted(csum, max(req.top_p, 0.0))) + 1, 1)
         mask = np.zeros_like(p)
         mask[order[:cut]] = 1.0
         p = p * mask
@@ -212,8 +213,9 @@ class LLMEnginePredictor:
         raw_max = request.get("max_tokens")
         max_tokens = 20 if raw_max is None else int(raw_max)
         temperature = float(request.get("temperature", 0.0) or 0.0)
-        top_k = int(request.get("top_k", 0) or 0)
-        top_p = float(request.get("top_p", 1.0) or 1.0)
+        raw_k, raw_p = request.get("top_k"), request.get("top_p")
+        top_k = 0 if raw_k is None else int(raw_k)
+        top_p = 1.0 if raw_p is None else float(raw_p)
         ids = self.encode(prompt)
         out = self.engine.generate(ids, max_new=max_tokens,
                                    temperature=temperature, top_k=top_k,
@@ -234,18 +236,16 @@ class KVCacheLLMEngine:
     O(cache_len) attention instead of the full-window O(T²) re-forward of
     `BatchedLLMEngine`."""
 
-    def __init__(self, lm: Any, max_batch: int = 8,
-                 max_wait_s: float = 0.005) -> None:
+    def __init__(self, lm: Any, max_batch: int = 8) -> None:
         import jax
         import jax.numpy as jnp
 
         self.lm = lm
         self.max_batch = int(max_batch)
-        self.max_wait_s = float(max_wait_s)
         self._pending: "queue.Queue[_Request]" = queue.Queue()
         self._active: List[Optional[_Request]] = [None] * self.max_batch
-        # per-slot decode state
-        self._consumed = [0] * self.max_batch   # prompt tokens already fed
+        # per-slot decode state: position only (prefill progress is
+        # _pos vs len(req.ids))
         self._pos = np.zeros((self.max_batch,), np.int32)
         self._cache = lm.init_cache(self.max_batch)
         self._stop = threading.Event()
@@ -267,13 +267,17 @@ class KVCacheLLMEngine:
         cap = self.lm.max_len
         req.prefix = []
         if len(req.ids) + req.remaining > cap:
-            keep = max(cap - req.remaining, 1)
+            # cache capacity split: generation gets what it asked for up to
+            # half the cache; the prompt TAIL keeps the rest (the full
+            # sequence is still returned) — so a long prompt is never cut
+            # to a single token just because max_new was large
+            gen = min(req.remaining,
+                      max(cap - len(req.ids), cap // 2))
+            keep = cap - gen
             if len(req.ids) > keep:
-                # cache capacity: feed only the prompt TAIL, return the
-                # full sequence (mirrors BatchedLLMEngine's window)
                 req.prefix = req.ids[:-keep]
                 req.ids = req.ids[-keep:]
-            req.remaining = min(req.remaining, cap - len(req.ids))
+            req.remaining = gen
         if req.remaining <= 0 or len(req.ids) == 0:
             req.future.set_result(np.asarray(req.prefix + req.ids))
             return req.future
@@ -314,7 +318,6 @@ class KVCacheLLMEngine:
                 except queue.Empty:
                     return
                 self._active[slot] = req
-                self._consumed[slot] = 0
                 self._pos[slot] = 0
 
     def _loop(self) -> None:
@@ -327,7 +330,6 @@ class KVCacheLLMEngine:
                 except queue.Empty:
                     continue
                 self._active[0] = req
-                self._consumed[0] = 0
                 self._pos[0] = 0
             # build this step's token vector: next prompt token (chunked
             # prefill) or the last sampled token
