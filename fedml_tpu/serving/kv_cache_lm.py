@@ -76,13 +76,12 @@ def prefill(params: Dict[str, Any], tokens: jnp.ndarray,
     return cache, last
 
 
-@partial(jax.jit, static_argnames=("heads",), donate_argnums=(1,))
-def decode_step(params: Dict[str, Any],
-                cache: List[Dict[str, jnp.ndarray]],
-                token: jnp.ndarray, pos: jnp.ndarray, heads: int
-                ) -> Tuple[List[Dict[str, jnp.ndarray]], jnp.ndarray]:
-    """One token per row: ``token`` [B] at per-row position ``pos`` [B].
-    Writes this position's K/V into the cache and returns next logits."""
+def _decode_core(params: Dict[str, Any],
+                 cache: List[Dict[str, jnp.ndarray]],
+                 token: jnp.ndarray, pos: jnp.ndarray, heads: int
+                 ) -> Tuple[List[Dict[str, jnp.ndarray]], jnp.ndarray]:
+    """One token per row (traced body shared by the single- and multi-token
+    dispatch entry points)."""
     b = token.shape[0]
     dim = params["embed"].shape[1]
     dh = dim // heads
@@ -108,6 +107,56 @@ def decode_step(params: Dict[str, Any],
         h = h + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"]
     h = _ln(h, params["ln_f"])
     return new_cache, h @ params["embed"].T               # [B, V]
+
+
+@partial(jax.jit, static_argnames=("heads",), donate_argnums=(1,))
+def decode_step(params: Dict[str, Any],
+                cache: List[Dict[str, jnp.ndarray]],
+                token: jnp.ndarray, pos: jnp.ndarray, heads: int
+                ) -> Tuple[List[Dict[str, jnp.ndarray]], jnp.ndarray]:
+    """One token per row: ``token`` [B] at per-row position ``pos`` [B].
+    Writes this position's K/V into the cache and returns next logits."""
+    return _decode_core(params, cache, token, pos, heads)
+
+
+@partial(jax.jit, static_argnames=("heads", "k"), donate_argnums=(1,))
+def decode_multi(params: Dict[str, Any],
+                 cache: List[Dict[str, jnp.ndarray]],
+                 prompt_buf: jnp.ndarray, prompt_n: jnp.ndarray,
+                 pos0: jnp.ndarray, temps: jnp.ndarray, rng: jax.Array,
+                 heads: int, k: int):
+    """k tokens per row in ONE dispatch, sampling on-device — the
+    autoregressive loop never returns to the host mid-chunk (a ~k×
+    dispatch-latency win on remote/tunneled accelerators, and no per-token
+    host sync on local ones).
+
+    ``prompt_buf`` [B, k]: tokens to teacher-force (chunked prefill);
+    row i consumes ``prompt_n[i]`` of them, then switches to its own
+    samples.  ``temps`` [B]: 0 → greedy, else temperature sampling.
+    Returns (cache, emitted [B, k]) where emitted[i, j] is the token fed at
+    inner step j+1 (a prompt token during prefill, a sampled one after) —
+    the host appends emitted[i, j] for j ≥ prompt_n[i]-? (see engine)."""
+    b = prompt_buf.shape[0]
+
+    # scan carries the "next token to feed" per row
+    def step(carry, j):
+        cache, tok, pos, rng = carry
+        cache, logits = _decode_core(params, cache, tok, pos, heads)
+        rng, sub = jax.random.split(rng)
+        greedy = jnp.argmax(logits, axis=-1)
+        temp = jnp.maximum(temps, 1e-6)[:, None]
+        sampled = jax.random.categorical(sub, logits / temp, axis=-1)
+        out_tok = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+        # next inner step feeds the prompt while any remains, else out_tok
+        nxt = jnp.where(j + 1 < prompt_n,
+                        prompt_buf[jnp.arange(b),
+                                   jnp.minimum(j + 1, k - 1)],
+                        out_tok)
+        return (cache, nxt, pos + 1, rng), out_tok
+
+    carry0 = (cache, prompt_buf[:, 0], pos0, rng)
+    (cache, _, _, _), emitted = jax.lax.scan(step, carry0, jnp.arange(k))
+    return cache, emitted.T                                # [B, k]
 
 
 class KVCacheLM:
@@ -137,6 +186,11 @@ class KVCacheLM:
 
     def decode(self, cache, token, pos):
         return decode_step(self.params, cache, token, pos, self.heads)
+
+    def decode_multi(self, cache, prompt_buf, prompt_n, pos0, temps, rng,
+                     k: int):
+        return decode_multi(self.params, cache, prompt_buf, prompt_n, pos0,
+                            temps, rng, self.heads, k)
 
     def full_logits(self, tokens):
         """Non-cached forward (parity reference / tests)."""

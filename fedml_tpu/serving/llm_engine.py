@@ -236,12 +236,18 @@ class KVCacheLLMEngine:
     O(cache_len) attention instead of the full-window O(T²) re-forward of
     `BatchedLLMEngine`."""
 
-    def __init__(self, lm: Any, max_batch: int = 8) -> None:
+    def __init__(self, lm: Any, max_batch: int = 8,
+                 tokens_per_dispatch: int = 8) -> None:
         import jax
         import jax.numpy as jnp
 
         self.lm = lm
         self.max_batch = int(max_batch)
+        #: inner on-device loop length: when every active request is
+        #: greedy/plain-temperature (no top-k/p) and has cache headroom,
+        #: decode_multi samples k tokens per dispatch with NO host round
+        #: trip in between — a ~k x dispatch-latency win
+        self.tokens_per_dispatch = max(int(tokens_per_dispatch), 1)
         self._pending: "queue.Queue[_Request]" = queue.Queue()
         self._active: List[Optional[_Request]] = [None] * self.max_batch
         # per-slot decode state: position only (prefill progress is
@@ -250,6 +256,7 @@ class KVCacheLLMEngine:
         self._cache = lm.init_cache(self.max_batch)
         self._stop = threading.Event()
         self._np_rng = np.random.default_rng(11)
+        self._rng_key = jax.random.PRNGKey(13)
         self._jax, self._jnp = jax, jnp
         self._worker = threading.Thread(target=self._loop, daemon=True,
                                         name="kv-llm-engine")
@@ -331,6 +338,10 @@ class KVCacheLLMEngine:
                     continue
                 self._active[0] = req
                 self._pos[0] = 0
+            k = self.tokens_per_dispatch
+            if k > 1 and self._can_multi(k):
+                self._step_multi(k)
+                continue
             # build this step's token vector: next prompt token (chunked
             # prefill) or the last sampled token
             tokens = np.zeros((self.max_batch,), np.int32)
@@ -367,3 +378,60 @@ class KVCacheLLMEngine:
                 break
             if not req.future.done():
                 req.future.set_exception(RuntimeError("engine stopped"))
+
+    def _can_multi(self, k: int) -> bool:
+        """Multi-token dispatch applies when no active request needs
+        host-side filtering (top-k/p) and every row has k positions of
+        cache headroom."""
+        for slot, req in enumerate(self._active):
+            if req is None:
+                continue
+            if req.top_k > 0 or req.top_p < 1.0:
+                return False
+            if self._pos[slot] + k >= self.lm.max_len:
+                return False
+        return True
+
+    def _step_multi(self, k: int) -> None:
+        import jax
+
+        jnp = self._jnp
+        b = self.max_batch
+        prompt_buf = np.zeros((b, k), np.int32)
+        prompt_n = np.ones((b,), np.int32)
+        temps = np.zeros((b,), np.float32)
+        for slot, req in enumerate(self._active):
+            if req is None:
+                continue
+            pos = int(self._pos[slot])
+            upcoming = req.ids[pos:pos + k]
+            if not upcoming:           # mid-generation: feed last sample
+                upcoming = [req.ids[-1]]
+            prompt_buf[slot, :len(upcoming)] = upcoming
+            prompt_n[slot] = len(upcoming)
+            temps[slot] = req.temperature
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        self._cache, emitted = self.lm.decode_multi(
+            self._cache, jnp.asarray(prompt_buf), jnp.asarray(prompt_n),
+            jnp.asarray(self._pos), jnp.asarray(temps), sub, k)
+        emitted = np.asarray(emitted)
+        for slot, req in enumerate(self._active):
+            if req is None:
+                continue
+            # R = prompt-ish tokens that were still unfed at dispatch time;
+            # emitted[slot, j] (output after feeding inner token j) is NEW
+            # from j = R-1 on — and not at all when the chunk was entirely
+            # prefill (R > k: emitted[k-1] predicts a KNOWN prompt token)
+            r = len(req.ids) - int(self._pos[slot])
+            self._pos[slot] += k
+            start = r - 1 if r <= k else k
+            for j in range(start, k):
+                if req.remaining <= 0:
+                    break
+                req.ids.append(int(emitted[slot, j]))
+                req.remaining -= 1
+            if (req.remaining <= 0
+                    or self._pos[slot] + 1 >= self.lm.max_len):
+                req.future.set_result(
+                    np.asarray(getattr(req, "prefix", []) + req.ids))
+                self._active[slot] = None

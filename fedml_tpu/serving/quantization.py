@@ -82,6 +82,11 @@ class QuantizedKVCacheLM(KVCacheLM):
     def decode(self, cache, token, pos):
         return _q_decode(self.params, cache, token, pos, self.heads)
 
+    def decode_multi(self, cache, prompt_buf, prompt_n, pos0, temps, rng,
+                     k: int):
+        return _q_decode_multi(self.params, cache, prompt_buf, prompt_n,
+                               pos0, temps, rng, self.heads, k)
+
     def full_logits(self, tokens):
         return KVCacheLM(_dequant_blocks(self.params), self.heads,
                          self.max_len).full_logits(tokens)
@@ -95,9 +100,19 @@ def _q_prefill(params, tokens, length, heads):
                                   heads)
 
 
-@partial(jax.jit, static_argnames=("heads",))
+@partial(jax.jit, static_argnames=("heads",), donate_argnums=(1,))
 def _q_decode(params, cache, token, pos, heads):
     from . import kv_cache_lm as _k
 
     return _k.decode_step.__wrapped__(_dequant_blocks(params), cache, token,
                                       pos, heads)
+
+
+@partial(jax.jit, static_argnames=("heads", "k"), donate_argnums=(1,))
+def _q_decode_multi(params, cache, prompt_buf, prompt_n, pos0, temps, rng,
+                    heads, k):
+    from . import kv_cache_lm as _k
+
+    return _k.decode_multi.__wrapped__(_dequant_blocks(params), cache,
+                                       prompt_buf, prompt_n, pos0, temps,
+                                       rng, heads, k)

@@ -360,3 +360,38 @@ def test_sampling_controls_top_k_top_p():
     nucleus = _Request([0], 1, temperature=1.0, top_p=0.6)
     picks = {_sample_token(row, nucleus, rng) for _ in range(50)}
     assert picks <= {0, 1}  # p(0)~0.70 covers the 0.6 nucleus with token 0+1
+
+
+def test_kv_engine_multi_dispatch_equals_single_dispatch():
+    """tokens_per_dispatch>1 (on-device sampling loop) produces the same
+    greedy output as per-token dispatch, and temperature requests (which
+    sample on-device in the multi path) still respect lengths."""
+    from fedml_tpu.serving.kv_cache_lm import KVCacheLM
+    from fedml_tpu.serving.llm_engine import KVCacheLLMEngine
+
+    lm = KVCacheLM.create(jax.random.PRNGKey(3), vocab=40, dim=32,
+                          layers=2, heads=4, max_len=64)
+    prompts = [list(np.random.RandomState(4).randint(0, 40, size=n))
+               for n in (4, 9)]
+
+    outs = {}
+    for k in (1, 8):
+        eng = KVCacheLLMEngine(lm, max_batch=2, tokens_per_dispatch=k)
+        try:
+            outs[k] = [list(eng.generate(p, max_new=7, timeout=120))
+                       for p in prompts]
+        finally:
+            eng.stop()
+    assert outs[1] == outs[8]
+
+    eng = KVCacheLLMEngine(lm, max_batch=2, tokens_per_dispatch=4)
+    try:
+        out = eng.generate(prompts[0], max_new=6, temperature=0.8,
+                           timeout=120)
+        # top-k forces the per-token host path mid-flight — still correct
+        out2 = eng.generate(prompts[1], max_new=5, temperature=0.8,
+                            top_k=3, timeout=120)
+    finally:
+        eng.stop()
+    assert len(out) == len(prompts[0]) + 6
+    assert len(out2) == len(prompts[1]) + 5
