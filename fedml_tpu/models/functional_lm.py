@@ -1,0 +1,52 @@
+"""Model-hub adapter for the functional transformer LM.
+
+One parameter pytree serves three roles with zero conversion:
+
+* training through the engine / `train/llm` (this adapter gives it the
+  flax-module `.init/.apply` surface `ModelBundle` expects);
+* sequence-parallel training (`parallel/seq_parallel.py` — same
+  `init_lm_params` layout);
+* KV-cache serving (`serving/kv_cache_lm.KVCacheLM(variables["params"],
+  heads, max_len)`).
+
+The reference's fine-tune → deploy path crosses HF checkpoints and ONNX
+conversion (`device_model_deployment.py:839`); here the train and serve
+stacks literally share the pytree.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+
+from ..parallel.seq_parallel import init_lm_params, lm_forward
+
+
+class FunctionalLMModule:
+    """Duck-typed flax module over `parallel.seq_parallel`'s pure LM."""
+
+    def __init__(self, vocab: int, dim: int = 64, layers: int = 2,
+                 heads: int = 4, max_len: int = 256) -> None:
+        self.vocab = int(vocab)
+        self.dim = int(dim)
+        self.layers = int(layers)
+        self.heads = int(heads)
+        self.max_len = int(max_len)
+
+    def init(self, rngs: Any, x, train: bool = False) -> Dict[str, Any]:
+        key = rngs["params"] if isinstance(rngs, dict) else rngs
+        return {"params": init_lm_params(
+            key, self.vocab, dim=self.dim, layers=self.layers,
+            heads=self.heads, max_len=self.max_len)}
+
+    def apply(self, variables: Dict[str, Any], x, train: bool = False,
+              rngs: Optional[Dict[str, Any]] = None, mutable=None):
+        from ..ops.pallas_attention import flash_attention
+
+        logits = lm_forward(variables["params"], x, self.heads,
+                            partial(flash_attention, causal=True))
+        if mutable:
+            return logits, {}
+        return logits

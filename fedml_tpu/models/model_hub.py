@@ -118,6 +118,20 @@ def create(args: Any, output_dim: Optional[int] = None) -> ModelBundle:
     elif name in ("transformer", "bert_tiny", "bert-tiny"):
         module = TinyTransformerLM(vocab_size=num_classes, dtype=dtype)
         task = TASK_LM
+    elif name in ("functional_lm", "kv_lm"):
+        # the pure-pytree LM shared with parallel/seq_parallel and the
+        # KV-cache serving engine: fine-tune it here (LoRA targets its
+        # wq/wk/wv/wo/w1/w2 matmuls), then serve the SAME params through
+        # serving/kv_cache_lm.KVCacheLM with zero conversion
+        from .functional_lm import FunctionalLMModule
+
+        module = FunctionalLMModule(
+            vocab=num_classes,
+            dim=int(getattr(args, "lm_dim", 64) or 64),
+            layers=int(getattr(args, "lm_layers", 2) or 2),
+            heads=int(getattr(args, "lm_heads", 4) or 4),
+            max_len=int(getattr(args, "lm_max_len", 256) or 256))
+        task = TASK_LM
     elif name in ("vit", "vit_tiny", "vit-tiny"):
         module = ViT(num_classes=num_classes, dtype=dtype,
                      layers=int(getattr(args, "vit_layers", 6)))

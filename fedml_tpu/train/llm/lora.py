@@ -17,7 +17,10 @@ import jax
 import jax.numpy as jnp
 
 DEFAULT_TARGETS = (r".*attention.*kernel", r".*(query|key|value|out).*kernel",
-                   r".*Dense_\d+.*kernel")
+                   r".*Dense_\d+.*kernel",
+                   # functional-LM layout (parallel/seq_parallel.py):
+                   # per-block attention/MLP matmuls
+                   r".*/w[qkvo]", r".*/w[12]")
 
 
 def _path_str(path) -> str:

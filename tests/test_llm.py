@@ -395,3 +395,42 @@ def test_kv_engine_multi_dispatch_equals_single_dispatch():
         eng.stop()
     assert len(out) == len(prompts[0]) + 6
     assert len(out2) == len(prompts[1]) + 5
+
+
+def test_functional_lm_finetune_then_kv_serve():
+    """One pytree end-to-end: fine-tune the functional LM (LoRA via the
+    shared trainer), merge, then serve the SAME params through the
+    KV-cache engine — greedy output equals the trained model's full
+    forward."""
+    import fedml_tpu
+    from fedml_tpu.train.llm import apply_lora
+    from fedml_tpu.train.llm.trainer import LLMTrainConfig, LLMTrainer
+    from fedml_tpu.serving.kv_cache_lm import KVCacheLM
+    from fedml_tpu.serving.llm_engine import KVCacheLLMEngine
+
+    args = fedml_tpu.Config(model="functional_lm", dataset="shakespeare",
+                            compute_dtype="float32", lm_dim=32, lm_layers=2,
+                            lm_heads=4, lm_max_len=64)
+    bundle = fedml_tpu.model.create(args, 90)
+    tokens = np.random.RandomState(0).randint(0, 90, size=4000)
+    cfg = LLMTrainConfig(seq_len=32, batch_size=4, epochs=2,
+                         learning_rate=3e-3, lora_rank=4)
+    trainer = LLMTrainer(bundle, cfg)
+    out = trainer.train(tokens)
+    assert out["loss_history"][-1] < out["loss_history"][0]
+
+    merged = apply_lora(trainer.variables["params"], trainer.lora,
+                        cfg.lora_alpha)
+    lm = KVCacheLM(merged, heads=4, max_len=64)
+    prompt = list(tokens[:8])
+    ids = list(prompt)
+    for _ in range(6):
+        logits = lm.full_logits(jnp.asarray([ids]))
+        ids.append(int(jnp.argmax(logits[0, -1])))
+
+    eng = KVCacheLLMEngine(lm, max_batch=2)
+    try:
+        served = list(eng.generate(prompt, max_new=6, timeout=120))
+    finally:
+        eng.stop()
+    assert served == ids
