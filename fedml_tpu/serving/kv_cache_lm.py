@@ -119,11 +119,41 @@ def decode_step(params: Dict[str, Any],
     return _decode_core(params, cache, token, pos, heads)
 
 
+def _filter_sample(logits: jnp.ndarray, temps: jnp.ndarray,
+                   top_k: jnp.ndarray, top_p: jnp.ndarray,
+                   key: jax.Array) -> jnp.ndarray:
+    """Per-row greedy / temperature sampling with on-device top-k and
+    nucleus filtering ([B, V] logits; top_k 0 = off, top_p 1 = off)."""
+    b, v = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+    temp = jnp.maximum(temps, 1e-6)[:, None]
+    scaled = logits.astype(jnp.float32) / temp
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    # top-k: keep logits >= the k-th largest (k=0/off → threshold -inf)
+    k_idx = jnp.clip(top_k - 1, 0, v - 1)
+    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)
+    kth = jnp.where((top_k > 0)[:, None], kth, -jnp.inf)
+    scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    # nucleus: smallest prefix of the sorted dist with mass >= top_p
+    sorted_f = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_f, axis=-1)
+    csum = jnp.cumsum(probs, axis=-1)
+    # a position stays iff the mass BEFORE it is < top_p (keeps >= 1)
+    keep_sorted = (csum - probs) < jnp.minimum(top_p, 1.0)[:, None]
+    cutoff = jnp.min(jnp.where(keep_sorted, sorted_f, jnp.inf), axis=-1)
+    active = (top_p < 1.0)[:, None]
+    scaled = jnp.where(active & (scaled < cutoff[:, None]), -jnp.inf,
+                       scaled)
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+
+
 @partial(jax.jit, static_argnames=("heads", "k"), donate_argnums=(1,))
 def decode_multi(params: Dict[str, Any],
                  cache: List[Dict[str, jnp.ndarray]],
                  prompt_buf: jnp.ndarray, prompt_n: jnp.ndarray,
-                 pos0: jnp.ndarray, temps: jnp.ndarray, rng: jax.Array,
+                 pos0: jnp.ndarray, temps: jnp.ndarray,
+                 top_k: jnp.ndarray, top_p: jnp.ndarray, rng: jax.Array,
                  heads: int, k: int):
     """k tokens per row in ONE dispatch, sampling on-device — the
     autoregressive loop never returns to the host mid-chunk (a ~k×
@@ -132,10 +162,10 @@ def decode_multi(params: Dict[str, Any],
 
     ``prompt_buf`` [B, k]: tokens to teacher-force (chunked prefill);
     row i consumes ``prompt_n[i]`` of them, then switches to its own
-    samples.  ``temps`` [B]: 0 → greedy, else temperature sampling.
-    Returns (cache, emitted [B, k]) where emitted[i, j] is the token fed at
-    inner step j+1 (a prompt token during prefill, a sampled one after) —
-    the host appends emitted[i, j] for j ≥ prompt_n[i]-? (see engine)."""
+    samples.  ``temps`` [B]: 0 → greedy, else temperature sampling with
+    per-row on-device top-k / nucleus filtering (`_filter_sample`).
+    Returns (cache, emitted [B, k]) where emitted[i, j] is the model output
+    after feeding inner token j — new tokens from j = prompt_n[i]-1 on."""
     b = prompt_buf.shape[0]
 
     # scan carries the "next token to feed" per row
@@ -143,10 +173,7 @@ def decode_multi(params: Dict[str, Any],
         cache, tok, pos, rng = carry
         cache, logits = _decode_core(params, cache, tok, pos, heads)
         rng, sub = jax.random.split(rng)
-        greedy = jnp.argmax(logits, axis=-1)
-        temp = jnp.maximum(temps, 1e-6)[:, None]
-        sampled = jax.random.categorical(sub, logits / temp, axis=-1)
-        out_tok = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+        out_tok = _filter_sample(logits, temps, top_k, top_p, sub)
         # next inner step feeds the prompt while any remains, else out_tok
         nxt = jnp.where(j + 1 < prompt_n,
                         prompt_buf[jnp.arange(b),
@@ -187,10 +214,10 @@ class KVCacheLM:
     def decode(self, cache, token, pos):
         return decode_step(self.params, cache, token, pos, self.heads)
 
-    def decode_multi(self, cache, prompt_buf, prompt_n, pos0, temps, rng,
-                     k: int):
+    def decode_multi(self, cache, prompt_buf, prompt_n, pos0, temps,
+                     top_k, top_p, rng, k: int):
         return decode_multi(self.params, cache, prompt_buf, prompt_n, pos0,
-                            temps, rng, self.heads, k)
+                            temps, top_k, top_p, rng, self.heads, k)
 
     def full_logits(self, tokens):
         """Non-cached forward (parity reference / tests)."""

@@ -380,14 +380,12 @@ class KVCacheLLMEngine:
                 req.future.set_exception(RuntimeError("engine stopped"))
 
     def _can_multi(self, k: int) -> bool:
-        """Multi-token dispatch applies when no active request needs
-        host-side filtering (top-k/p) and every row has k positions of
-        cache headroom."""
+        """Multi-token dispatch applies when every active row has k
+        positions of cache headroom (sampling — including top-k/nucleus
+        filtering — runs on-device)."""
         for slot, req in enumerate(self._active):
             if req is None:
                 continue
-            if req.top_k > 0 or req.top_p < 1.0:
-                return False
             if self._pos[slot] + k >= self.lm.max_len:
                 return False
         return True
@@ -400,6 +398,8 @@ class KVCacheLLMEngine:
         prompt_buf = np.zeros((b, k), np.int32)
         prompt_n = np.ones((b,), np.int32)
         temps = np.zeros((b,), np.float32)
+        top_k = np.zeros((b,), np.int32)
+        top_p = np.ones((b,), np.float32)
         for slot, req in enumerate(self._active):
             if req is None:
                 continue
@@ -410,10 +410,13 @@ class KVCacheLLMEngine:
             prompt_buf[slot, :len(upcoming)] = upcoming
             prompt_n[slot] = len(upcoming)
             temps[slot] = req.temperature
+            top_k[slot] = req.top_k
+            top_p[slot] = req.top_p
         self._rng_key, sub = jax.random.split(self._rng_key)
         self._cache, emitted = self.lm.decode_multi(
             self._cache, jnp.asarray(prompt_buf), jnp.asarray(prompt_n),
-            jnp.asarray(self._pos), jnp.asarray(temps), sub, k)
+            jnp.asarray(self._pos), jnp.asarray(temps),
+            jnp.asarray(top_k), jnp.asarray(top_p), sub, k)
         emitted = np.asarray(emitted)
         for slot, req in enumerate(self._active):
             if req is None:

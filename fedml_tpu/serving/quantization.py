@@ -82,10 +82,11 @@ class QuantizedKVCacheLM(KVCacheLM):
     def decode(self, cache, token, pos):
         return _q_decode(self.params, cache, token, pos, self.heads)
 
-    def decode_multi(self, cache, prompt_buf, prompt_n, pos0, temps, rng,
-                     k: int):
+    def decode_multi(self, cache, prompt_buf, prompt_n, pos0, temps,
+                     top_k, top_p, rng, k: int):
         return _q_decode_multi(self.params, cache, prompt_buf, prompt_n,
-                               pos0, temps, rng, self.heads, k)
+                               pos0, temps, top_k, top_p, rng, self.heads,
+                               k)
 
     def full_logits(self, tokens):
         return KVCacheLM(_dequant_blocks(self.params), self.heads,
@@ -109,10 +110,10 @@ def _q_decode(params, cache, token, pos, heads):
 
 
 @partial(jax.jit, static_argnames=("heads", "k"), donate_argnums=(1,))
-def _q_decode_multi(params, cache, prompt_buf, prompt_n, pos0, temps, rng,
-                    heads, k):
+def _q_decode_multi(params, cache, prompt_buf, prompt_n, pos0, temps,
+                    top_k, top_p, rng, heads, k):
     from . import kv_cache_lm as _k
 
     return _k.decode_multi.__wrapped__(_dequant_blocks(params), cache,
                                        prompt_buf, prompt_n, pos0, temps,
-                                       rng, heads, k)
+                                       top_k, top_p, rng, heads, k)
