@@ -23,10 +23,29 @@ from fedml_tpu.serving.quantization import QuantizedKVCacheLM
 
 
 def main() -> None:
-    # char-level demo model (fine-tune one with train/llm first for real use)
-    lm = KVCacheLM.create(jax.random.PRNGKey(0), vocab=90, dim=64,
-                          layers=2, heads=4, max_len=128)
-    lm = QuantizedKVCacheLM.from_lm(lm)        # int8 weights, same API
+    # 1) LoRA fine-tune the functional LM on a char corpus (the SAME pytree
+    #    the KV engine serves — no export/conversion step)
+    import fedml_tpu
+    from fedml_tpu.data.datasets import shakespeare_sequences
+    from fedml_tpu.train.llm import LLMTrainConfig, LLMTrainer, apply_lora
+
+    args = fedml_tpu.Config(model="functional_lm", dataset="shakespeare",
+                            compute_dtype="float32", lm_dim=64, lm_layers=2,
+                            lm_heads=4, lm_max_len=128)
+    bundle = fedml_tpu.model.create(args, 90)
+    xt, _, _, _ = shakespeare_sequences(seq_len=64, n_train=128, n_test=8)
+    stream = np.concatenate(list(xt))
+    cfg = LLMTrainConfig(seq_len=64, batch_size=8, epochs=2,
+                         learning_rate=3e-3, lora_rank=8)
+    trainer = LLMTrainer(bundle, cfg)
+    metrics = trainer.train(stream)
+    print("fine-tune loss history:",
+          [round(x, 3) for x in metrics["loss_history"]])
+
+    # 2) merge LoRA, quantize to int8, serve through the KV-cache engine
+    merged = apply_lora(trainer.variables["params"], trainer.lora,
+                        cfg.lora_alpha)
+    lm = QuantizedKVCacheLM.from_lm(KVCacheLM(merged, heads=4, max_len=128))
     engine = KVCacheLLMEngine(lm, max_batch=4)
     server = OpenAIServer(LLMEnginePredictor(engine), model_name="kv-demo",
                           port=0)
