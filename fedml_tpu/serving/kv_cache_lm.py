@@ -138,8 +138,11 @@ def _filter_sample(logits: jnp.ndarray, temps: jnp.ndarray,
     sorted_f = jnp.sort(scaled, axis=-1)[:, ::-1]
     probs = jax.nn.softmax(sorted_f, axis=-1)
     csum = jnp.cumsum(probs, axis=-1)
-    # a position stays iff the mass BEFORE it is < top_p (keeps >= 1)
+    # a position stays iff the mass BEFORE it is < top_p; the top token
+    # always stays, so top_p<=0 degenerates to keep-top-token exactly like
+    # the host sampler (_sample_token)
     keep_sorted = (csum - probs) < jnp.minimum(top_p, 1.0)[:, None]
+    keep_sorted = keep_sorted.at[:, 0].set(True)
     cutoff = jnp.min(jnp.where(keep_sorted, sorted_f, jnp.inf), axis=-1)
     active = (top_p < 1.0)[:, None]
     scaled = jnp.where(active & (scaled < cutoff[:, None]), -jnp.inf,

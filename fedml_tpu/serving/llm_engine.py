@@ -243,10 +243,10 @@ class KVCacheLLMEngine:
 
         self.lm = lm
         self.max_batch = int(max_batch)
-        #: inner on-device loop length: when every active request is
-        #: greedy/plain-temperature (no top-k/p) and has cache headroom,
-        #: decode_multi samples k tokens per dispatch with NO host round
-        #: trip in between — a ~k x dispatch-latency win
+        #: inner on-device loop length: when every active row has cache
+        #: headroom, decode_multi samples k tokens per dispatch (greedy,
+        #: temperature, top-k and nucleus filtering all run on-device)
+        #: with NO host round trip in between — a ~k x dispatch-latency win
         self.tokens_per_dispatch = max(int(tokens_per_dispatch), 1)
         self._pending: "queue.Queue[_Request]" = queue.Queue()
         self._active: List[Optional[_Request]] = [None] * self.max_batch

@@ -388,7 +388,7 @@ def test_kv_engine_multi_dispatch_equals_single_dispatch():
     try:
         out = eng.generate(prompts[0], max_new=6, temperature=0.8,
                            timeout=120)
-        # top-k forces the per-token host path mid-flight — still correct
+        # top-k filtering runs on-device inside the multi path
         out2 = eng.generate(prompts[1], max_new=5, temperature=0.8,
                             top_k=3, timeout=120)
     finally:
@@ -434,3 +434,13 @@ def test_functional_lm_finetune_then_kv_serve():
     finally:
         eng.stop()
     assert served == ids
+
+
+def test_on_device_sampler_top_p_zero_keeps_top_token():
+    from fedml_tpu.serving.kv_cache_lm import _filter_sample
+
+    logits = jnp.asarray([[1.0, 5.0, 3.0], [4.0, 0.0, 9.0]])
+    out = _filter_sample(logits, jnp.asarray([1.0, 1.0]),
+                         jnp.asarray([0, 0]), jnp.asarray([0.0, 0.0]),
+                         jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(out), [1, 2])
