@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional
 
@@ -257,6 +258,8 @@ class KVCacheLLMEngine:
         self._stop = threading.Event()
         self._np_rng = np.random.default_rng(11)
         self._rng_key = jax.random.PRNGKey(13)
+        self._tokens_done = 0
+        self._t_start = time.monotonic()
         self._jax, self._jnp = jax, jnp
         self._worker = threading.Thread(target=self._loop, daemon=True,
                                         name="kv-llm-engine")
@@ -362,6 +365,7 @@ class KVCacheLLMEngine:
                 nxt = _sample_token(logits[slot], req, self._np_rng)
                 req.ids.append(nxt)
                 req.remaining -= 1
+                self._tokens_done += 1
                 if (req.remaining <= 0
                         or self._pos[slot] + 1 >= self.lm.max_len):
                     req.future.set_result(
@@ -378,6 +382,16 @@ class KVCacheLLMEngine:
                 break
             if not req.future.done():
                 req.future.set_exception(RuntimeError("engine stopped"))
+
+    def stats(self) -> Dict[str, float]:
+        """Live metrics in the shape `scheduler.autoscaler.ReplicaAutoscaler
+        .observe` consumes: decode throughput since start, queue depth, and
+        active batch occupancy."""
+        dt = max(time.monotonic() - self._t_start, 1e-9)
+        return {"tokens_per_s": self._tokens_done / dt,
+                "queue_depth": self._pending.qsize(),
+                "active": self.active_count,
+                "capacity": self.max_batch}
 
     def _can_multi(self, k: int) -> bool:
         """Multi-token dispatch applies when every active row has k
@@ -433,6 +447,7 @@ class KVCacheLLMEngine:
                     break
                 req.ids.append(int(emitted[slot, j]))
                 req.remaining -= 1
+                self._tokens_done += 1
             if (req.remaining <= 0
                     or self._pos[slot] + 1 >= self.lm.max_len):
                 req.future.set_result(

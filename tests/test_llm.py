@@ -444,3 +444,27 @@ def test_on_device_sampler_top_p_zero_keeps_top_token():
                          jnp.asarray([0, 0]), jnp.asarray([0.0, 0.0]),
                          jax.random.PRNGKey(0))
     np.testing.assert_array_equal(np.asarray(out), [1, 2])
+
+
+def test_kv_engine_stats_feed_the_autoscaler():
+    from fedml_tpu.scheduler.autoscaler import (
+        AutoscalePolicy,
+        ReplicaAutoscaler,
+    )
+    from fedml_tpu.serving.kv_cache_lm import KVCacheLM
+    from fedml_tpu.serving.llm_engine import KVCacheLLMEngine
+
+    lm = KVCacheLM.create(jax.random.PRNGKey(5), vocab=40, dim=32,
+                          layers=1, heads=4, max_len=32)
+    eng = KVCacheLLMEngine(lm, max_batch=2)
+    try:
+        eng.generate([1, 2, 3], max_new=4, timeout=120)
+        st = eng.stats()
+        assert st["tokens_per_s"] > 0 and st["queue_depth"] == 0
+        scaler = ReplicaAutoscaler(AutoscalePolicy(max_replicas=4,
+                                                   cooldown_s=0.0))
+        n = scaler.observe(qps=st["tokens_per_s"], latency_s=0.01,
+                           queue_depth=int(st["queue_depth"]))
+        assert 1 <= n <= 4
+    finally:
+        eng.stop()
