@@ -28,13 +28,21 @@ import numpy as np
 class _Request:
     def __init__(self, prompt_ids: List[int], max_new: int,
                  temperature: float, top_k: int = 0,
-                 top_p: float = 1.0) -> None:
+                 top_p: float = 1.0, on_token=None) -> None:
         self.ids = list(prompt_ids)
         self.remaining = int(max_new)
         self.temperature = float(temperature)
         self.top_k = int(top_k or 0)
         self.top_p = float(top_p if top_p is not None else 1.0)
+        self.on_token = on_token        # per-token streaming callback
         self.future: "Future[np.ndarray]" = Future()
+
+    def emit(self, token: int) -> None:
+        if self.on_token is not None:
+            try:
+                self.on_token(int(token))
+            except Exception:  # noqa: BLE001 — consumer bugs can't kill the worker
+                pass
 
 
 def _sample_token(row: np.ndarray, req: "_Request", rng: np.random.Generator
@@ -99,9 +107,9 @@ class BatchedLLMEngine:
     # -- public API ---------------------------------------------------------
     def submit(self, prompt_ids, max_new: int = 20,
                temperature: float = 0.0, top_k: int = 0,
-               top_p: float = 1.0) -> "Future[np.ndarray]":
+               top_p: float = 1.0, on_token=None) -> "Future[np.ndarray]":
         req = _Request(list(np.asarray(prompt_ids).tolist()), max_new,
-                       temperature, top_k, top_p)
+                       temperature, top_k, top_p, on_token)
         if self._stop.is_set():
             req.future.set_exception(RuntimeError("engine stopped"))
             return req.future
@@ -175,6 +183,7 @@ class BatchedLLMEngine:
                     continue
                 nxt = _sample_token(logits[slot], req, self._np_rng)
                 req.ids.append(nxt)
+                req.emit(nxt)
                 req.remaining -= 1
                 if req.remaining <= 0:
                     req.future.set_result(np.asarray(req.ids))
@@ -218,10 +227,27 @@ class LLMEnginePredictor:
         top_k = 0 if raw_k is None else int(raw_k)
         top_p = 1.0 if raw_p is None else float(raw_p)
         ids = self.encode(prompt)
+        if request.get("stream"):
+            return self._stream_tokens(ids, max_tokens, temperature,
+                                       top_k, top_p)
         out = self.engine.generate(ids, max_new=max_tokens,
                                    temperature=temperature, top_k=top_k,
                                    top_p=top_p)
         return self.decode(out[len(ids):])
+
+    def _stream_tokens(self, ids, max_tokens, temperature, top_k, top_p):
+        """Generator yielding decoded tokens AS the engine produces them —
+        the lazy iterable the SSE path consumes incrementally."""
+        q: "queue.Queue" = queue.Queue()
+        fut = self.engine.submit(ids, max_new=max_tokens,
+                                 temperature=temperature, top_k=top_k,
+                                 top_p=top_p, on_token=q.put)
+        fut.add_done_callback(lambda _f: q.put(None))
+        while True:
+            tok = q.get(timeout=300.0)
+            if tok is None:
+                break
+            yield self.decode([tok])
 
     def ready(self) -> bool:
         return self.engine.alive
@@ -268,9 +294,9 @@ class KVCacheLLMEngine:
     # -- public API (mirrors BatchedLLMEngine) ------------------------------
     def submit(self, prompt_ids, max_new: int = 20,
                temperature: float = 0.0, top_k: int = 0,
-               top_p: float = 1.0) -> "Future[np.ndarray]":
+               top_p: float = 1.0, on_token=None) -> "Future[np.ndarray]":
         req = _Request(list(np.asarray(prompt_ids).tolist()), max_new,
-                       temperature, top_k, top_p)
+                       temperature, top_k, top_p, on_token)
         if self._stop.is_set():
             req.future.set_exception(RuntimeError("engine stopped"))
             return req.future
@@ -364,6 +390,7 @@ class KVCacheLLMEngine:
                     continue                      # still prefilling
                 nxt = _sample_token(logits[slot], req, self._np_rng)
                 req.ids.append(nxt)
+                req.emit(nxt)
                 req.remaining -= 1
                 self._tokens_done += 1
                 if (req.remaining <= 0
@@ -446,6 +473,7 @@ class KVCacheLLMEngine:
                 if req.remaining <= 0:
                     break
                 req.ids.append(int(emitted[slot, j]))
+                req.emit(int(emitted[slot, j]))
                 req.remaining -= 1
                 self._tokens_done += 1
             if (req.remaining <= 0

@@ -105,7 +105,8 @@ class OpenAIServer:
                            "temperature": float(
                                body.get("temperature", 1.0)),
                            "top_p": float(body.get("top_p", 1.0) or 1.0),
-                           "top_k": int(body.get("top_k", 0) or 0)}
+                           "top_k": int(body.get("top_k", 0) or 0),
+                           "stream": bool(body.get("stream"))}
                     result = predictor.predict(req)
                 except Exception as e:  # noqa: BLE001
                     self._json(500, {"error": {"message": str(e)}})

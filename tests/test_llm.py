@@ -468,3 +468,48 @@ def test_kv_engine_stats_feed_the_autoscaler():
         assert 1 <= n <= 4
     finally:
         eng.stop()
+
+
+def test_openai_api_streams_tokens_incrementally():
+    """stream=true yields one SSE delta PER TOKEN as the engine generates
+    (not one final blob)."""
+    import json as _json
+    import urllib.request
+
+    from fedml_tpu.serving.kv_cache_lm import KVCacheLM
+    from fedml_tpu.serving.llm_engine import (
+        KVCacheLLMEngine,
+        LLMEnginePredictor,
+    )
+    from fedml_tpu.serving.openai_api import OpenAIServer
+
+    lm = KVCacheLM.create(jax.random.PRNGKey(6), vocab=90, dim=32,
+                          layers=1, heads=4, max_len=64)
+    engine = KVCacheLLMEngine(lm, max_batch=2)
+    server = OpenAIServer(LLMEnginePredictor(engine), model_name="tiny",
+                          port=0)
+    try:
+        server.run(block=False)
+        body = _json.dumps({"model": "tiny", "max_tokens": 6,
+                            "stream": True,
+                            "messages": [{"role": "user",
+                                          "content": "hi"}]}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/chat/completions",
+            data=body, headers={"Content-Type": "application/json"})
+        deltas = []
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            for raw in resp:
+                line = raw.decode().strip()
+                if not line.startswith("data: ") or line == "data: [DONE]":
+                    continue
+                chunk = _json.loads(line[len("data: "):])
+                d = chunk["choices"][0]["delta"].get("content")
+                if d:
+                    deltas.append(d)
+        # 6 tokens → 6 one-char deltas (char-level codec)
+        assert len(deltas) == 6
+        assert all(len(d) == 1 for d in deltas)
+    finally:
+        server.stop()
+        engine.stop()
