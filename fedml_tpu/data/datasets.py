@@ -36,7 +36,15 @@ def _try_npz(cache_dir: str, name: str) -> Optional[Arrays]:
     path = os.path.join(cache_dir, f"{name}.npz")
     if os.path.exists(path):
         z = np.load(path)
-        return (z["x_train"], z["y_train"], z["x_test"], z["y_test"])
+
+        def _x(a: np.ndarray) -> np.ndarray:
+            # uint8 image archives (the standard ingest format) → [0,1] floats
+            if np.issubdtype(a.dtype, np.integer):
+                return a.astype(np.float32) / 255.0
+            return a
+
+        return (_x(z["x_train"]), z["y_train"].astype(np.int64),
+                _x(z["x_test"]), z["y_test"].astype(np.int64))
     return None
 
 

@@ -7,8 +7,9 @@ a latency/queue-depth target controller suitable for the serving engines:
 
 * observe(qps, latency_s, queue_depth) windows per tick;
 * desired = clamp by target latency AND target per-replica qps;
-* hysteresis: scale up fast (any breach), scale down slowly (sustained
-  under-utilization), with a cooldown between scale events;
+* hysteresis: scale up fast (any breach, never blocked by cooldown),
+  scale down slowly (sustained under-utilization, and only after
+  ``cooldown_s`` since the last scale event);
 * pure decision logic — applying the decision is a callback, so it drives
   local engines, container replicas, or k8s alike.
 """
@@ -71,7 +72,12 @@ class ReplicaAutoscaler:
         want = max(p.min_replicas, min(p.max_replicas, want))
 
         now = self.clock()
-        if want != self.replicas and (now - self._last_scale_t) >= p.cooldown_s:
+        # scale-up is exempt from the cooldown ("scale up fast: any
+        # breach"); only scale-DOWN waits out cooldown_s since the last
+        # scale event, so a latency breach right after a resize still grows
+        # the fleet immediately
+        in_cooldown = (now - self._last_scale_t) < p.cooldown_s
+        if want != self.replicas and not (want < self.replicas and in_cooldown):
             self.replicas = want
             self._last_scale_t = now
             self.history.append(want)

@@ -38,13 +38,19 @@ def init_cache(params: Dict[str, Any], batch: int, max_len: int,
             for _ in params["blocks"]]
 
 
-@partial(jax.jit, static_argnames=("heads",))
+@partial(jax.jit, static_argnames=("heads", "max_len"))
 def prefill(params: Dict[str, Any], tokens: jnp.ndarray,
-            length: jnp.ndarray, heads: int
+            length: jnp.ndarray, heads: int, max_len: int = 0
             ) -> Tuple[List[Dict[str, jnp.ndarray]], jnp.ndarray]:
     """Full pass over padded prompts [B, T] (valid length per row) →
-    (cache rows for positions < T, logits at the last valid position)."""
+    (cache sized for ``max_len`` positions, logits at the last valid
+    position).  ``max_len`` > T zero-pads the cache rows so decode_step can
+    keep writing past the prompt width (JAX would otherwise drop the
+    out-of-bounds scatter silently); 0 keeps the prompt width (only safe
+    when the caller re-scatters into a full-size cache itself)."""
     b, t = tokens.shape
+    if max_len and max_len < t:
+        raise ValueError(f"prefill: max_len={max_len} < prompt width {t}")
     dim = params["embed"].shape[1]
     dh = dim // heads
     h = params["embed"][tokens] + params["pos"][:t][None]
@@ -59,7 +65,11 @@ def prefill(params: Dict[str, Any], tokens: jnp.ndarray,
         q = heads_of(blk["wq"]).transpose(0, 2, 1, 3)
         k = heads_of(blk["wk"])
         v = heads_of(blk["wv"])
-        cache.append({"k": k, "v": v})
+        if max_len and max_len > t:
+            pad = ((0, 0), (0, max_len - t), (0, 0), (0, 0))
+            cache.append({"k": jnp.pad(k, pad), "v": jnp.pad(v, pad)})
+        else:
+            cache.append({"k": k, "v": v})
         kt = k.transpose(0, 2, 1, 3)
         vt = v.transpose(0, 2, 1, 3)
         s = jnp.einsum("bhqd,bhkd->bhqk", q, kt) / np.sqrt(dh)
@@ -211,8 +221,11 @@ class KVCacheLM:
     def init_cache(self, batch: int):
         return init_cache(self.params, batch, self.max_len, self.heads)
 
-    def prefill(self, tokens, length):
-        return prefill(self.params, tokens, length, self.heads)
+    def prefill(self, tokens, length, max_len: int = -1):
+        """max_len -1 → this LM's configured max_len (safe default: cache
+        rows are sized so decode can continue past the prompt)."""
+        ml = self.max_len if max_len == -1 else max_len
+        return prefill(self.params, tokens, length, self.heads, ml)
 
     def decode(self, cache, token, pos):
         return decode_step(self.params, cache, token, pos, self.heads)

@@ -36,6 +36,17 @@ class _Request:
         self.top_p = float(top_p if top_p is not None else 1.0)
         self.on_token = on_token        # per-token streaming callback
         self.future: "Future[np.ndarray]" = Future()
+        #: "stop" (ran to its token budget) or "length" (the engine had to
+        #: truncate: cache capacity < prompt+max_new) — OpenAI semantics,
+        #: surfaced to callers via future.request.finish_reason
+        self.finish_reason = "stop"
+        self.cancelled = threading.Event()
+        self.future.request = self  # type: ignore[attr-defined]
+
+    def cancel(self) -> None:
+        """Ask the worker to retire this request at the next step (used by
+        streaming consumers that disconnect mid-generation)."""
+        self.cancelled.set()
 
     def emit(self, token: int) -> None:
         if self.on_token is not None:
@@ -181,6 +192,12 @@ class BatchedLLMEngine:
             for slot, req in enumerate(self._active):
                 if req is None:
                     continue
+                if req.cancelled.is_set():
+                    req.finish_reason = "cancelled"
+                    if not req.future.done():
+                        req.future.set_result(np.asarray(req.ids))
+                    self._active[slot] = None
+                    continue
                 nxt = _sample_token(logits[slot], req, self._np_rng)
                 req.ids.append(nxt)
                 req.emit(nxt)
@@ -217,6 +234,14 @@ class LLMEnginePredictor:
             chr(int(i) + 32) for i in ids))
 
     def predict(self, request: Any) -> str:
+        r = self.predict_full(request)
+        return r["stream"] if "stream" in r else r["text"]
+
+    def predict_full(self, request: Any) -> Dict[str, Any]:
+        """predict + OpenAI metadata.  Non-streaming → {"text",
+        "finish_reason"} ("length" when the engine truncated the token
+        budget); streaming → {"stream": generator, "finish": callable
+        returning the final reason once the stream ends}."""
         if isinstance(request, str):
             request = {"prompt": request}
         prompt = str(request.get("prompt", ""))
@@ -227,27 +252,62 @@ class LLMEnginePredictor:
         top_k = 0 if raw_k is None else int(raw_k)
         top_p = 1.0 if raw_p is None else float(raw_p)
         ids = self.encode(prompt)
+        timeout = float(request.get("timeout", 300.0) or 300.0)
         if request.get("stream"):
-            return self._stream_tokens(ids, max_tokens, temperature,
-                                       top_k, top_p)
-        out = self.engine.generate(ids, max_new=max_tokens,
-                                   temperature=temperature, top_k=top_k,
-                                   top_p=top_p)
-        return self.decode(out[len(ids):])
+            holder: Dict[str, str] = {}
+            gen = self._stream_tokens(ids, max_tokens, temperature,
+                                      top_k, top_p, timeout, holder)
+            return {"stream": gen,
+                    "finish": lambda: holder.get("finish", "stop")}
+        fut = self.engine.submit(ids, max_new=max_tokens,
+                                 temperature=temperature, top_k=top_k,
+                                 top_p=top_p)
+        req = getattr(fut, "request", None)
+        try:
+            out = fut.result(timeout)
+        except TimeoutError:
+            # free the slot — otherwise timed-out requests keep generating
+            # into orphaned futures until they starve live traffic
+            if req is not None:
+                req.cancel()
+            raise
+        return {"text": self.decode(out[len(ids):]),
+                "finish_reason": getattr(req, "finish_reason", "stop")}
 
-    def _stream_tokens(self, ids, max_tokens, temperature, top_k, top_p):
+    def _stream_tokens(self, ids, max_tokens, temperature, top_k, top_p,
+                       timeout: float = 300.0, holder: Optional[dict] = None):
         """Generator yielding decoded tokens AS the engine produces them —
-        the lazy iterable the SSE path consumes incrementally."""
+        the lazy iterable the SSE path consumes incrementally.  ``timeout``
+        bounds the inter-token gap (from the request, not hardcoded); a
+        consumer that disconnects (GeneratorExit) or times out CANCELS the
+        underlying engine request so the slot stops generating into an
+        orphaned queue."""
         q: "queue.Queue" = queue.Queue()
         fut = self.engine.submit(ids, max_new=max_tokens,
                                  temperature=temperature, top_k=top_k,
                                  top_p=top_p, on_token=q.put)
         fut.add_done_callback(lambda _f: q.put(None))
-        while True:
-            tok = q.get(timeout=300.0)
-            if tok is None:
-                break
-            yield self.decode([tok])
+        req = getattr(fut, "request", None)
+        try:
+            while True:
+                try:
+                    tok = q.get(timeout=timeout)
+                except queue.Empty:
+                    if req is not None:
+                        req.cancel()
+                    if holder is not None:
+                        holder["finish"] = "timeout"
+                    raise TimeoutError(
+                        f"no token for {timeout:.0f}s; request cancelled")
+                if tok is None:
+                    break
+                yield self.decode([tok])
+            if holder is not None and req is not None:
+                holder["finish"] = req.finish_reason
+        except GeneratorExit:
+            if req is not None:
+                req.cancel()
+            raise
 
     def ready(self) -> bool:
         return self.engine.alive
@@ -313,6 +373,9 @@ class KVCacheLLMEngine:
             if len(req.ids) > keep:
                 req.prefix = req.ids[:-keep]
                 req.ids = req.ids[-keep:]
+            if gen < req.remaining:
+                # fewer tokens than asked for: surface it, don't hide it
+                req.finish_reason = "length"
             req.remaining = gen
         if req.remaining <= 0 or len(req.ids) == 0:
             req.future.set_result(np.asarray(req.prefix + req.ids))
@@ -377,8 +440,17 @@ class KVCacheLLMEngine:
             for slot, req in enumerate(self._active):
                 if req is None:
                     continue
+                if req.cancelled.is_set():
+                    req.finish_reason = "cancelled"
+                    if not req.future.done():
+                        req.future.set_result(
+                            np.asarray(getattr(req, "prefix", []) + req.ids))
+                    self._active[slot] = None
+                    continue
                 tokens[slot] = req.ids[self._pos[slot]] \
                     if self._pos[slot] < len(req.ids) else 0
+            if self.active_count == 0:
+                continue
             self._cache, logits = self.lm.decode(
                 self._cache, jnp.asarray(tokens), jnp.asarray(self._pos))
             logits = np.asarray(logits)
@@ -395,6 +467,8 @@ class KVCacheLLMEngine:
                 self._tokens_done += 1
                 if (req.remaining <= 0
                         or self._pos[slot] + 1 >= self.lm.max_len):
+                    if req.remaining > 0:  # cache-capacity cut, not budget
+                        req.finish_reason = "length"
                     req.future.set_result(
                         np.asarray(getattr(req, "prefix", []) + req.ids))
                     self._active[slot] = None
@@ -476,8 +550,13 @@ class KVCacheLLMEngine:
                 req.emit(int(emitted[slot, j]))
                 req.remaining -= 1
                 self._tokens_done += 1
-            if (req.remaining <= 0
+            if req.cancelled.is_set():
+                req.finish_reason = "cancelled"
+            if (req.remaining <= 0 or req.cancelled.is_set()
                     or self._pos[slot] + 1 >= self.lm.max_len):
-                req.future.set_result(
-                    np.asarray(getattr(req, "prefix", []) + req.ids))
+                if req.remaining > 0 and not req.cancelled.is_set():
+                    req.finish_reason = "length"
+                if not req.future.done():
+                    req.future.set_result(
+                        np.asarray(getattr(req, "prefix", []) + req.ids))
                 self._active[slot] = None

@@ -107,12 +107,18 @@ class OpenAIServer:
                            "top_p": float(body.get("top_p", 1.0) or 1.0),
                            "top_k": int(body.get("top_k", 0) or 0),
                            "stream": bool(body.get("stream"))}
-                    result = predictor.predict(req)
+                    # predict_full carries finish_reason ("length" when the
+                    # engine truncated the token budget) — prefer it
+                    full = getattr(predictor, "predict_full", None)
+                    meta = full(req) if callable(full) else None
+                    result = (meta.get("stream", meta.get("text"))
+                              if meta is not None else predictor.predict(req))
                 except Exception as e:  # noqa: BLE001
                     self._json(500, {"error": {"message": str(e)}})
                     return
                 if body.get("stream"):
-                    self._stream(result)
+                    finish_fn = meta.get("finish") if meta else None
+                    self._stream(result, finish_fn)
                 else:
                     try:
                         if not isinstance(result, str):
@@ -121,9 +127,11 @@ class OpenAIServer:
                     except Exception as e:  # noqa: BLE001
                         self._json(500, {"error": {"message": str(e)}})
                         return
-                    self._json(200, _completion_body(model_name, result))
+                    finish = (meta or {}).get("finish_reason", "stop")
+                    self._json(200, _completion_body(model_name, result,
+                                                     finish))
 
-            def _stream(self, result: Any) -> None:
+            def _stream(self, result: Any, finish_fn=None) -> None:
                 cid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
@@ -146,6 +154,9 @@ class OpenAIServer:
                                                  f"[error: {e}]", cid))
                     self.wfile.write(f"data: {err}\n\n".encode())
                     finish = "error"
+                else:
+                    if finish_fn is not None:
+                        finish = finish_fn() or "stop"
                 done = json.dumps(_chunk_body(model_name, "", cid,
                                               finish=finish))
                 self.wfile.write(f"data: {done}\n\n".encode())

@@ -76,8 +76,9 @@ class QuantizedKVCacheLM(KVCacheLM):
     def from_lm(cls, lm: KVCacheLM) -> "QuantizedKVCacheLM":
         return cls(quantize_lm_params(lm.params), lm.heads, lm.max_len)
 
-    def prefill(self, tokens, length):
-        return _q_prefill(self.params, tokens, length, self.heads)
+    def prefill(self, tokens, length, max_len: int = -1):
+        ml = self.max_len if max_len == -1 else max_len
+        return _q_prefill(self.params, tokens, length, self.heads, ml)
 
     def decode(self, cache, token, pos):
         return _q_decode(self.params, cache, token, pos, self.heads)
@@ -93,12 +94,12 @@ class QuantizedKVCacheLM(KVCacheLM):
                          self.max_len).full_logits(tokens)
 
 
-@partial(jax.jit, static_argnames=("heads",))
-def _q_prefill(params, tokens, length, heads):
+@partial(jax.jit, static_argnames=("heads", "max_len"))
+def _q_prefill(params, tokens, length, heads, max_len=0):
     from . import kv_cache_lm as _k
 
     return _k.prefill.__wrapped__(_dequant_blocks(params), tokens, length,
-                                  heads)
+                                  heads, max_len)
 
 
 @partial(jax.jit, static_argnames=("heads",), donate_argnums=(1,))

@@ -356,5 +356,9 @@ def test_replica_autoscaler_scales_up_down_with_cooldown():
     # latency breach alone also scales up (bounded by max)
     t[0] = 40.0
     assert a.observe(qps=1.0, latency_s=5.0) == 3
+    # scale-UP is exempt from the cooldown: a breach right after the
+    # previous scale event still grows the fleet immediately
+    t[0] = 40.5
+    assert a.observe(qps=1.0, latency_s=5.0) == 4
     # bounds respected
     assert all(1 <= r <= 4 for r in a.history)

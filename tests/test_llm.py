@@ -181,15 +181,12 @@ def test_kv_cache_decode_matches_full_forward():
     length = np.asarray([len(p) for p in prompts], np.int32)
     for i, p in enumerate(prompts):
         toks[i, :len(p)] = p
-    cache_rows, last = lm.prefill(jnp.asarray(toks), jnp.asarray(length))
-    # scatter prompt K/V into the engine-sized cache.  Short rows carry
+    # prefill returns a full max_len-sized cache, so decode can continue
+    # past the prompt width with no manual re-scatter.  Short rows carry
     # padding-token K/V between their length and t0 — harmless: decode
     # overwrites each position BEFORE the pos-mask ever admits it.
-    cache = lm.init_cache(b)
-    cache = [
-        {"k": c["k"].at[:, :t0].set(r["k"]),
-         "v": c["v"].at[:, :t0].set(r["v"])}
-        for c, r in zip(cache, cache_rows)]
+    cache, last = lm.prefill(jnp.asarray(toks), jnp.asarray(length))
+    assert cache[0]["k"].shape[1] == lm.max_len
 
     out = [list(p) for p in prompts]
     pos = length.copy()
@@ -513,3 +510,93 @@ def test_openai_api_streams_tokens_incrementally():
     finally:
         server.stop()
         engine.stop()
+
+
+def test_kv_engine_surfaces_length_finish_reason():
+    """A request the cache cannot fully honor resolves with
+    finish_reason='length' on future.request and through predict_full."""
+    from fedml_tpu.serving.kv_cache_lm import KVCacheLM
+    from fedml_tpu.serving.llm_engine import (
+        KVCacheLLMEngine,
+        LLMEnginePredictor,
+    )
+
+    lm = KVCacheLM.create(jax.random.PRNGKey(7), vocab=90, dim=32,
+                          layers=1, heads=4, max_len=16)
+    eng = KVCacheLLMEngine(lm, max_batch=2)
+    try:
+        prompt = list(np.random.RandomState(3).randint(0, 90, size=6))
+        fut = eng.submit(prompt, max_new=100)     # 6 + 100 > 16
+        fut.result(timeout=120)
+        assert fut.request.finish_reason == "length"
+        # within budget → "stop"
+        fut2 = eng.submit(prompt, max_new=3)
+        fut2.result(timeout=120)
+        assert fut2.request.finish_reason == "stop"
+
+        pred = LLMEnginePredictor(eng)
+        r = pred.predict_full({"prompt": "abcdef", "max_tokens": 100})
+        assert r["finish_reason"] == "length"
+        r2 = pred.predict_full({"prompt": "ab", "max_tokens": 2})
+        assert r2["finish_reason"] == "stop"
+    finally:
+        eng.stop()
+
+
+def test_stream_close_cancels_engine_request():
+    """Closing the token stream mid-generation cancels the underlying
+    request: its slot frees and the future resolves."""
+    import time as _time
+
+    from fedml_tpu.serving.kv_cache_lm import KVCacheLM
+    from fedml_tpu.serving.llm_engine import (
+        KVCacheLLMEngine,
+        LLMEnginePredictor,
+    )
+
+    lm = KVCacheLM.create(jax.random.PRNGKey(8), vocab=90, dim=32,
+                          layers=1, heads=4, max_len=256)
+    # 1-token dispatch so cancellation lands between steps promptly
+    eng = KVCacheLLMEngine(lm, max_batch=2, tokens_per_dispatch=1)
+    pred = LLMEnginePredictor(eng)
+    try:
+        r = pred.predict_full({"prompt": "hello", "max_tokens": 200,
+                               "stream": True})
+        gen = r["stream"]
+        next(gen)                      # at least one token flowed
+        gen.close()                    # consumer disconnects
+        deadline = _time.time() + 30
+        while eng.active_count and _time.time() < deadline:
+            _time.sleep(0.05)
+        assert eng.active_count == 0   # slot was freed by cancellation
+    finally:
+        eng.stop()
+
+
+def test_prefill_cache_supports_decode_past_prompt_width():
+    """prefill returns a max_len cache: decode_step keeps matching the
+    full forward well past the prompt width (the old prompt-width cache
+    silently dropped those writes)."""
+    from fedml_tpu.serving.kv_cache_lm import KVCacheLM
+
+    lm = KVCacheLM.create(jax.random.PRNGKey(9), vocab=50, dim=32,
+                          layers=2, heads=4, max_len=32)
+    prompt = list(np.random.RandomState(4).randint(0, 50, size=5))
+    cache, last = lm.prefill(jnp.asarray([prompt]), jnp.asarray([5]))
+    assert cache[0]["k"].shape[1] == lm.max_len
+    ids = list(prompt)
+    nxt = int(jnp.argmax(last[0]))
+    ids.append(nxt)
+    pos = 5
+    for _ in range(12):                # 5 + 12 > prompt width by far
+        cache, logits = lm.decode(cache, jnp.asarray([nxt]),
+                                  jnp.asarray([pos]))
+        pos += 1
+        nxt = int(jnp.argmax(logits[0]))
+        ids.append(nxt)
+
+    ref = list(prompt)
+    for _ in range(13):
+        logits = lm.full_logits(jnp.asarray([ref]))
+        ref.append(int(jnp.argmax(logits[0, -1])))
+    assert ids == ref
