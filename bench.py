@@ -1,43 +1,81 @@
-"""Benchmark: Parrot FedAvg ResNet-56 / CIFAR-10, 100 clients / 10 per round
-(the BASELINE.json north-star config) on the available accelerator.
+"""North-star benchmark: Parrot FedAvg ResNet-56 / CIFAR-10 (50k samples),
+100 clients Dirichlet(0.5), 10 per round, bs 32, 1 local epoch — the
+BASELINE.json headline config at FULL dataset scale, with an accuracy guard.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", ...extras}.
 
-vs_baseline: the reference publishes no numbers (BASELINE.md); the recorded
-H100-NCCL anchor used by the driver is wall-clock to target accuracy.  Until
-a measured reference anchor exists we report rounds/sec against a NOMINAL
-anchor of 1.0 round/sec for this config (documented placeholder), so the
-ratio tracks our own progress across rounds.
+vs_baseline is a MEASURED ratio: this framework on the available TPU vs the
+reference's own FedAvgAPI/ResNet-56 run on the hardware the reference can use
+in this image (1-core CPU torch; `benchmarks/measured_baseline.json`,
+recorded by benchmarks/refbench/run_reference_northstar.py). Both sides
+consume byte-identical data (benchmarks/gen_northstar_cifar.py npz) and the
+identical Dirichlet(0.5) partition.
+
+Beyond rounds/sec the line reports samples/sec, estimated MFU (executed
+FLOPs from XLA's compiled cost analysis ÷ wall ÷ chip peak), and
+wall-clock-to-target-accuracy — and FAILS (exit 1) if the model does not
+reach TARGET_TEST_ACC, so a perf win can never silently regress convergence.
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, HERE)
 
-NOMINAL_BASELINE_ROUNDS_PER_SEC = 1.0
+ANCHOR_PATH = os.path.join(HERE, "benchmarks", "measured_baseline.json")
+NPZ_DIR = os.path.join(HERE, ".data_cache", "northstar")
+
+#: accuracy the run must reach: ResNet-56 plateaus at ~1.0 on this
+#: synthetic CIFAR (measured round 2: acc 1.0 by round 320); the guard
+#: sits just below the plateau so seed jitter passes but a broken
+#: optimizer/aggregator/bucketing change fails the bench
+TARGET_TEST_ACC = 0.95
+MAX_ROUNDS = 512
+
+#: bf16 peak FLOP/s per chip by device_kind (MXU peak, public specs)
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v4": 275e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,   # v6e/Trillium
+}
 
 
 def main() -> None:
+    if not os.path.exists(os.path.join(NPZ_DIR, "cifar10.npz")):
+        subprocess.run([sys.executable,
+                        os.path.join(HERE, "benchmarks",
+                                     "gen_northstar_cifar.py")], check=True)
+
+    with open(ANCHOR_PATH) as f:
+        anchor = json.load(f)["northstar_fedavg_resnet56_cifar10"]
+
     import fedml_tpu
     from fedml_tpu.runner import FedMLRunner
 
     args = fedml_tpu.init(fedml_tpu.Config(
         dataset="cifar10",
+        data_cache_dir=NPZ_DIR,          # 50k-sample shared npz
         model="resnet56",
         backend="parrot",
+        partition_method="hetero",
+        partition_alpha=0.5,
         client_num_in_total=100,
         client_num_per_round=10,
-        comm_round=8,            # 1 warmup/compile + 7 measured
+        comm_round=MAX_ROUNDS,
         epochs=1,
         batch_size=32,
         learning_rate=0.05,
-        data_scale=0.2,          # synthetic-fallback CIFAR size control
-        frequency_of_the_test=100,  # eval only at the end
+        frequency_of_the_test=1000,      # eval handled manually below
         enable_tracking=False,
         compute_dtype="bfloat16",
+        hetero_buckets=4,                # size-stratified rounds (no
+                                         # max-client padding waste)
     ))
     device = fedml_tpu.device.get_device(args)
     dataset = fedml_tpu.data.load(args)
@@ -46,27 +84,105 @@ def main() -> None:
     api = runner.runner
 
     import jax
+    import jax.numpy as jnp
+    import numpy as np
 
-    # Fused scan-over-rounds path: a fixed 8-round chunk is compiled once
-    # and re-dispatched, amortizing per-call dispatch/transfer overhead
-    # (~7x over per-round dispatch through the remote-TPU tunnel).
     chunk = api.FUSED_CHUNK_ROUNDS
-    jax.block_until_ready(api.run_rounds_fused(chunk))  # warmup/compile
+    # fresh rng per fused call — with rng=None every call would replay the
+    # identical PRNGKey(seed+23) sampling stream (same clients, same noise)
+    rng = jax.random.PRNGKey(int(args.random_seed) + 1001)
 
-    n_rounds = 16 * chunk
+    def fused(n):
+        nonlocal rng
+        rng, sub = jax.random.split(rng)
+        return api.run_rounds_fused(n, rng=sub)
+
+    t_c0 = time.time()
+    rms = fused(chunk)                   # warmup: compile + first chunk
+    jax.block_until_ready(rms["train_loss"])
+    compile_s = time.time() - t_c0
+    rounds_done = chunk
+
+    # ---- measured perf window --------------------------------------------
+    n_meas = 4 * chunk
     t0 = time.time()
-    rms = api.run_rounds_fused(n_rounds)
-    jax.block_until_ready(rms)
+    rms = fused(n_meas)
+    jax.block_until_ready(rms["train_loss"])
     dt = time.time() - t0
-    rounds_per_sec = n_rounds / dt
+    rounds_per_sec = n_meas / dt
+    samples = float(np.sum(np.asarray(rms["samples"])))
+    samples_per_sec = samples / dt
+    rounds_done += n_meas
 
-    print(json.dumps({
-        "metric": "parrot_fedavg_resnet56_cifar10_rounds_per_sec",
+    # ---- executed-FLOPs MFU (analytic) -----------------------------------
+    # XLA cost_analysis is unreliable through the remote-TPU plugin (it
+    # reported ~16x low on this config) and lowering a second executable
+    # just to read it costs a full compile, so count analytically:
+    # ResNet-56 on 32x32 CIFAR = 126.5 MMACs/sample forward (well-known
+    # figure; 2 FLOPs/MAC), x3 for fwd+bwd, times the PADDED samples each
+    # round actually executes (Σ_buckets k_b·nb_b·bs, or k·nb·bs uniform).
+    RESNET56_FWD_FLOPS = 2 * 126.5e6
+    TRAIN_MULT = 3.0
+    if api.buckets is not None:
+        padded_per_round = sum(b["k"] * b["nb"] for b in api.buckets) * api.bs
+    else:
+        padded_per_round = api.k * api.nb * api.bs
+    flops_per_round = padded_per_round * RESNET56_FWD_FLOPS * TRAIN_MULT
+    kind = jax.devices()[0].device_kind
+    peak = PEAK_FLOPS.get(kind, 197e12)
+    mfu = flops_per_round * rounds_per_sec / peak
+
+    # ---- train to the accuracy target (wall-clock-to-accuracy) ------------
+    test_batches = api._make_test_batches()
+
+    def test_acc():
+        out = api.eval_step(api.global_vars, test_batches)
+        return float(out["correct"]) / max(float(out["n"]), 1.0)
+
+    t_train0 = time.time()
+    acc = test_acc()
+    wall_to_target = None
+    while acc < TARGET_TEST_ACC and rounds_done < MAX_ROUNDS:
+        rms = fused(chunk)
+        jax.block_until_ready(rms["train_loss"])
+        rounds_done += chunk
+        acc = test_acc()
+    if acc >= TARGET_TEST_ACC:
+        # perf window + remaining training + the warmup chunk's TRAINING
+        # share (its wall time is compile-dominated; its 64 rounds of real
+        # training are charged at the measured steady-state rate so
+        # time-to-accuracy is not understated), excluding compile itself
+        wall_to_target = ((time.time() - t_train0) + dt
+                          + chunk / rounds_per_sec)
+
+    result = {
+        "metric": "parrot_fedavg_resnet56_cifar10_50k_rounds_per_sec",
         "value": round(rounds_per_sec, 4),
-        "unit": "rounds/sec (100 clients, 10/round, bs32, 1 local epoch)",
-        "vs_baseline": round(rounds_per_sec / NOMINAL_BASELINE_ROUNDS_PER_SEC,
-                             4),
-    }))
+        "unit": "rounds/sec (100 clients, 10/round, bs32, 1 epoch, 50k "
+                "CIFAR, hetero a=0.5, bf16, 4 size buckets)",
+        "vs_baseline": round(rounds_per_sec
+                             / float(anchor["rounds_per_sec"]), 2),
+        "baseline": {"rounds_per_sec": anchor["rounds_per_sec"],
+                     "host": "reference torch on 1-core CPU (only hardware "
+                             "the reference runs on here)"},
+        "samples_per_sec": round(samples_per_sec, 1),
+        "samples_per_sec_vs_baseline": round(
+            samples_per_sec / float(anchor["samples_per_sec"]), 2),
+        "compile_s": round(compile_s, 1),
+        "rounds_to_report": rounds_done,
+        "final_test_acc": round(acc, 4),
+        "target_test_acc": TARGET_TEST_ACC,
+        "wall_to_target_acc_s": (None if wall_to_target is None
+                                 else round(wall_to_target, 2)),
+    }
+    result["est_mfu"] = round(mfu, 4)
+    result["flops_per_round"] = round(flops_per_round, 1)
+    result["padded_samples_per_round"] = int(padded_per_round)
+    print(json.dumps(result))
+    if acc < TARGET_TEST_ACC:
+        print(f"ACCURACY GUARD FAILED: {acc:.4f} < {TARGET_TEST_ACC}",
+              file=sys.stderr)
+        sys.exit(1)
 
 
 if __name__ == "__main__":

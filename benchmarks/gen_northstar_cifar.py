@@ -1,0 +1,35 @@
+"""Generate the canonical full-size synthetic CIFAR-10 for the north-star
+benchmark (50k train / 10k test, uint8 npz) shared byte-identically by the
+reference CPU anchor run and fedml_tpu's bench.py.
+
+Zero-egress stand-in for real CIFAR-10 (no download possible); same
+class-template+noise construction as fedml_tpu's synthetic fallback
+(`fedml_tpu/data/datasets.py:_synthetic_images`) but written once to disk so
+both frameworks consume identical bytes.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT_DIR = os.path.join(REPO, ".data_cache", "northstar")
+
+
+def main(seed: int = 0, n_train: int = 50_000, n_test: int = 10_000) -> None:
+    sys.path.insert(0, REPO)
+    from fedml_tpu.data.datasets import _synthetic_images
+
+    xt, yt, xe, ye = _synthetic_images((32, 32, 3), 10, n_train, n_test, seed)
+    os.makedirs(OUT_DIR, exist_ok=True)
+    np.savez(os.path.join(OUT_DIR, "cifar10.npz"),
+             x_train=(xt * 255).astype(np.uint8), y_train=yt.astype(np.int64),
+             x_test=(xe * 255).astype(np.uint8), y_test=ye.astype(np.int64))
+    print(json.dumps({"out": os.path.join(OUT_DIR, "cifar10.npz"),
+                      "n_train": n_train, "n_test": n_test, "seed": seed}))
+
+
+if __name__ == "__main__":
+    main()

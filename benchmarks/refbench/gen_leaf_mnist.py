@@ -1,0 +1,97 @@
+"""Generate a deterministic synthetic MNIST in LEAF JSON format.
+
+Produces the exact on-disk layout the reference's MNIST loader expects
+(`/root/reference/python/fedml/data/MNIST/data_loader.py:33-66` `read_data`:
+train/ and test/ dirs of .json files with keys "users", "num_samples",
+"user_data" -> {user: {"x": [[784 floats]], "y": [ints]}}), plus an .npz
+mirror consumed by fedml_tpu's natural-partition loader so BOTH frameworks
+train on byte-identical data.
+
+Zero-egress substitution for the real FedML MNIST.zip (1000 LEAF users):
+we emit --users users (default 100) with power-law sample counts, 10 gaussian
+class clusters in 784-dim, pixel range [0, 1]. Deterministic under --seed.
+"""
+
+import argparse
+import json
+import os
+
+import numpy as np
+
+
+def make_class_means(rng: np.random.Generator, n_classes: int = 10,
+                     dim: int = 784, support: int = 150,
+                     pool: int = 260) -> np.ndarray:
+    """Sparse class means: `support` active pixels per class, like digit
+    strokes. Supports are drawn from a shared `pool` of pixels so classes
+    overlap and the problem is not linearly trivial."""
+    means = np.zeros((n_classes, dim), dtype=np.float64)
+    shared = rng.choice(dim, size=pool, replace=False)
+    for c in range(n_classes):
+        idx = rng.choice(shared, size=support, replace=False)
+        means[c, idx] = rng.uniform(0.3, 0.8, size=support)
+    return means
+
+
+def gen(out_dir: str, users: int = 100, seed: int = 42,
+        mean_train: int = 60, test_frac: float = 0.2) -> dict:
+    rng = np.random.default_rng(seed)
+    means = make_class_means(rng)
+    n_classes = means.shape[0]
+
+    # Power-law-ish user sizes, and per-user label distribution (2 dominant
+    # classes per user -> natural non-IID, like LEAF's writer split).
+    sizes = np.clip(rng.pareto(2.5, size=users) * mean_train * 0.6 + 20,
+                    20, mean_train * 3).astype(int)
+
+    user_names = [f"f_{i:05d}" for i in range(users)]
+    train_data, test_data = {}, {}
+    num_train, num_test = [], []
+    for u, n in zip(user_names, sizes):
+        n_test = max(2, int(n * test_frac))
+        dom = rng.choice(n_classes, size=2, replace=False)
+        probs = np.full(n_classes, 0.1 / (n_classes - 2))
+        probs[dom] = 0.45
+        probs /= probs.sum()
+        ys = rng.choice(n_classes, size=n + n_test, p=probs)
+        noise = rng.normal(0.0, 0.55, size=(n + n_test, means.shape[1]))
+        active = (means[ys] > 0) | (rng.random(noise.shape) < 0.08)
+        xs = np.clip(means[ys] + noise * active, 0.0, 1.0)
+        xs = np.round(xs, 4)
+        train_data[u] = {"x": xs[:n].tolist(), "y": ys[:n].tolist()}
+        test_data[u] = {"x": xs[n:].tolist(), "y": ys[n:].tolist()}
+        num_train.append(int(n))
+        num_test.append(int(n_test))
+
+    for split, data, nums in (("train", train_data, num_train),
+                              ("test", test_data, num_test)):
+        d = os.path.join(out_dir, "MNIST", split)
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "all_data_0_niid_0_keep_10_%s_9.json" % split),
+                  "w") as f:
+            json.dump({"users": user_names, "num_samples": nums,
+                       "user_data": data}, f)
+
+    # npz mirror for fedml_tpu's natural-partition loader: one array pair per
+    # user, keys "x_<user>" / "y_<user>" per split.
+    npz_train = {}
+    npz_test = {}
+    for u in user_names:
+        npz_train["x_" + u] = np.asarray(train_data[u]["x"], dtype=np.float32)
+        npz_train["y_" + u] = np.asarray(train_data[u]["y"], dtype=np.int32)
+        npz_test["x_" + u] = np.asarray(test_data[u]["x"], dtype=np.float32)
+        npz_test["y_" + u] = np.asarray(test_data[u]["y"], dtype=np.int32)
+    np.savez_compressed(os.path.join(out_dir, "leaf_mnist_train.npz"), **npz_train)
+    np.savez_compressed(os.path.join(out_dir, "leaf_mnist_test.npz"), **npz_test)
+    return {"users": users, "train_samples": int(sum(num_train)),
+            "test_samples": int(sum(num_test))}
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=os.path.expanduser("~/.cache/fedml_data"))
+    p.add_argument("--users", type=int, default=100)
+    p.add_argument("--seed", type=int, default=42)
+    a = p.parse_args()
+    info = gen(a.out, users=a.users, seed=a.seed)
+    print(json.dumps(info))

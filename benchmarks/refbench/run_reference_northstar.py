@@ -1,0 +1,148 @@
+"""Measure the reference at the north-star shape on the hardware it can use
+here (CPU torch, 1 core): SP FedAvg, ResNet-56, CIFAR-10 (50k synthetic,
+shared npz), 100 clients / 10 per round, bs 32, 1 local epoch.
+
+Runs the reference's own FedAvgAPI / ModelTrainerCLS / resnet56
+(`/root/reference/python/fedml/simulation/sp/fedavg/fedavg_api.py:66`,
+`model/cv/resnet.py:297`) on the identical data + Dirichlet(0.5) partition
+fedml_tpu's bench.py uses, with eval disabled inside the measured window.
+Prints one JSON line: sec/round, rounds/sec, samples/sec.
+
+Usage:
+  python benchmarks/gen_northstar_cifar.py   # once
+  PYTHONPATH=stubs:/root/reference/python python run_reference_northstar.py \
+      [--rounds 2]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(os.path.dirname(HERE))
+CACHE = os.path.join(REPO, ".data_cache", "northstar")
+
+
+def build_args():
+    import yaml
+    cfg = {
+        "common_args": {"training_type": "simulation", "random_seed": 0},
+        "data_args": {"dataset": "cifar10", "data_cache_dir": CACHE,
+                      "partition_method": "hetero", "partition_alpha": 0.5},
+        "model_args": {"model": "resnet56"},
+        "train_args": {
+            "federated_optimizer": "FedAvg", "client_id_list": "[]",
+            "client_num_in_total": 100, "client_num_per_round": 10,
+            "comm_round": 2, "epochs": 1, "batch_size": 32,
+            "client_optimizer": "sgd", "learning_rate": 0.05,
+            "weight_decay": 0.0,
+        },
+        "validation_args": {"frequency_of_the_test": 100},
+        "device_args": {"using_gpu": False, "gpu_id": 0},
+        "comm_args": {"backend": "sp"},
+        "tracking_args": {"enable_tracking": False, "enable_wandb": False,
+                          "log_file_dir": os.path.join(CACHE, "log")},
+    }
+    cfg_path = os.path.join(CACHE, "ref_northstar_config.yaml")
+    with open(cfg_path, "w") as f:
+        yaml.safe_dump(cfg, f)
+    sys.argv = [sys.argv[0], "--cf", cfg_path, "--rank", "0",
+                "--role", "server"]
+    import fedml
+    return fedml, fedml.init()
+
+
+def build_dataset(args):
+    """Identical bytes + identical partition to fedml_tpu's loader
+    (fedml_tpu/data/data_loader.py:load) for dataset=cifar10 with the
+    north-star npz in cache."""
+    import numpy as np
+    import torch
+    sys.path.insert(0, REPO)
+    from fedml_tpu.data.partition import partition
+
+    z = np.load(os.path.join(CACHE, "cifar10.npz"))
+    xt = z["x_train"].astype(np.float32) / 255.0
+    yt = z["y_train"].astype(np.int64)
+    xe = z["x_test"].astype(np.float32) / 255.0
+    ye = z["y_test"].astype(np.int64)
+
+    net_map = partition(yt, args.client_num_in_total, "hetero",
+                        args.partition_alpha, args.random_seed)
+    test_map = partition(ye, args.client_num_in_total, "homo",
+                         args.partition_alpha, args.random_seed + 1)
+
+    def to_batches(x, y, bs):
+        out = []
+        for i in range(0, len(x), bs):
+            xb = torch.from_numpy(x[i:i + bs].transpose(0, 3, 1, 2)).float()
+            yb = torch.from_numpy(y[i:i + bs]).long()
+            out.append((xb, yb))
+        return out
+
+    train_local, test_local, local_num = {}, {}, {}
+    for cid in range(args.client_num_in_total):
+        idx = net_map[cid]
+        train_local[cid] = to_batches(xt[idx], yt[idx], args.batch_size)
+        local_num[cid] = int(len(idx))
+        tidx = test_map[cid]
+        test_local[cid] = to_batches(xe[tidx], ye[tidx], args.batch_size)
+
+    dataset = [len(yt), len(ye), None, None, local_num, train_local,
+               test_local, 10]
+    return dataset, local_num, net_map
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--rounds", type=int, default=2)
+    a, _ = p.parse_known_args()
+    sys.argv = [sys.argv[0]]
+
+    fedml, args = build_args()
+    args.comm_round = a.rounds
+    device = fedml.device.get_device(args)
+    dataset, local_num, net_map = build_dataset(args)
+    model = fedml.model.create(args, dataset[-1])
+
+    from fedml.simulation.sp.fedavg.fedavg_api import FedAvgAPI
+
+    api = FedAvgAPI(args, device, dataset, model)
+
+    # Time the API's own train() loop (fedavg_api.py:66-123) with eval
+    # patched out: the last round unconditionally runs
+    # _local_test_on_all_clients (100 clients × full data on 1 CPU core —
+    # hours), and we are measuring training throughput here, exactly as
+    # bench.py's measured window excludes eval.
+    api._local_test_on_all_clients = lambda round_idx: None
+    import numpy as np
+    t0 = time.time()
+    api.train()
+    wall = time.time() - t0
+
+    # samples actually trained across the measured rounds (same sampler:
+    # np.random.seed(round_idx) choice, fedavg_api.py:127-136)
+    total_samples = 0
+    for r in range(args.comm_round):
+        np.random.seed(r)
+        picked = np.random.choice(range(args.client_num_in_total),
+                                  args.client_num_per_round, replace=False)
+        total_samples += sum(local_num[int(c)] for c in picked)
+
+    print(json.dumps({
+        "what": "reference_sp_fedavg_resnet56_cifar10_northstar",
+        "host": "cpu_torch_1core",
+        "clients_total": args.client_num_in_total,
+        "clients_per_round": args.client_num_per_round,
+        "rounds": args.comm_round,
+        "wall_s": round(wall, 2),
+        "sec_per_round": round(wall / args.comm_round, 2),
+        "rounds_per_sec": round(args.comm_round / wall, 5),
+        "samples_per_sec": round(total_samples / wall, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

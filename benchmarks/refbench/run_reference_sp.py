@@ -1,0 +1,145 @@
+"""Run the reference's SP FedAvg MNIST-LR smoke config and measure it.
+
+Mirrors `/root/reference/python/examples/federate/quick_start/parrot/`
+(config at fedml_config.yaml:1-44) but on the zero-egress synthetic LEAF
+MNIST produced by gen_leaf_mnist.py, CPU-only. Prints one JSON line with
+measured wall-clock, rounds/sec, and final accuracy; this is the measured
+anchor BASELINE.md requires.
+
+Usage: PYTHONPATH=<stubs>:<reference/python> python run_reference_sp.py
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(os.path.dirname(HERE))
+CACHE = os.path.join(REPO, ".data_cache", "refbench")
+
+CONFIG = {
+    "common_args": {"training_type": "simulation", "random_seed": 0},
+    "data_args": {
+        "dataset": "mnist",
+        "data_cache_dir": CACHE,
+        "partition_method": "hetero",
+        "partition_alpha": 0.5,
+    },
+    "model_args": {"model": "lr"},
+    "train_args": {
+        "federated_optimizer": "FedAvg",
+        "client_id_list": "[]",
+        "client_num_in_total": 2,
+        "client_num_per_round": 2,
+        "comm_round": 10,
+        "epochs": 1,
+        "batch_size": 10,
+        "client_optimizer": "sgd",
+        "learning_rate": 0.03,
+        "weight_decay": 0.001,
+    },
+    "validation_args": {"frequency_of_the_test": 1},
+    "device_args": {"using_gpu": False, "gpu_id": 0},
+    "comm_args": {"backend": "sp"},
+    "tracking_args": {"enable_tracking": False, "enable_wandb": False,
+                      "log_file_dir": os.path.join(CACHE, "log")},
+}
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--optimizer", default="FedAvg",
+                   choices=["FedAvg", "FedProx", "SCAFFOLD"])
+    p.add_argument("--rounds", type=int, default=10)
+    cli, _ = p.parse_known_args()
+    CONFIG["train_args"]["federated_optimizer"] = cli.optimizer
+    CONFIG["train_args"]["comm_round"] = cli.rounds
+    # optimizer-specific keys (reference ml/trainer/fedprox_trainer.py:50
+    # args.fedprox_mu; sp/scaffold/scaffold_trainer.py:132 args.server_lr)
+    CONFIG["train_args"]["fedprox_mu"] = 0.1
+    CONFIG["train_args"]["server_lr"] = 1.0
+    # scaffold_trainer.py:62 requires this flag (no default in Arguments)
+    CONFIG["train_args"]["initialize_all_clients"] = False
+
+    os.makedirs(CACHE, exist_ok=True)
+    if not os.path.exists(os.path.join(CACHE, "MNIST", "train")):
+        sys.path.insert(0, HERE)
+        from gen_leaf_mnist import gen
+        print("generating LEAF mnist...", file=sys.stderr)
+        gen(CACHE, users=100, seed=42)
+    # Satisfy download_mnist's existence checks (zero-egress: no real zip).
+    zip_marker = os.path.join(CACHE, "MNIST.zip")
+    if not os.path.exists(zip_marker):
+        open(zip_marker, "wb").close()
+
+    cfg_path = os.path.join(CACHE, "fedml_config.yaml")
+    import yaml
+    with open(cfg_path, "w") as f:
+        yaml.safe_dump(CONFIG, f)
+    sys.argv = ["run_reference_sp.py", "--cf", cfg_path, "--rank", "0",
+                "--role", "server"]
+
+    import fedml  # noqa: E402  (resolved from /root/reference/python)
+
+    # capture the per-round eval stream the APIs emit via mlops.log
+    # (Test/Acc, Test/Loss with a round index) — enable_tracking is off so
+    # the hook is otherwise a no-op
+    per_round = {}
+    from fedml.core import mlops as _mlops
+
+    _orig_log = _mlops.log
+
+    def _capture(metrics, *a, **k):
+        if isinstance(metrics, dict) and "round" in metrics:
+            r = int(metrics["round"])
+            rec = per_round.setdefault(r, {})
+            for key, v in metrics.items():
+                if key != "round":
+                    rec[key] = float(v)
+        return _orig_log(metrics, *a, **k)
+
+    _mlops.log = _capture
+    fedml.mlops.log = _capture
+
+    t_setup = time.time()
+    args = fedml.init()
+    device = fedml.device.get_device(args)
+    dataset, output_dim = fedml.data.load(args)
+    model = fedml.model.create(args, output_dim)
+    setup_s = time.time() - t_setup
+
+    # export the exact initial weights so the fedml_tpu side can start from
+    # the SAME point (cross-framework init transfer for the parity audit)
+    import numpy as np
+    sd = model.state_dict()
+    np.savez(os.path.join(CACHE, "ref_init_lr.npz"),
+             **{k: v.cpu().numpy() for k, v in sd.items()})
+
+    from fedml.simulation.simulator import SimulatorSingleProcess
+
+    sim = SimulatorSingleProcess(args, device, dataset, model)
+    t0 = time.time()
+    sim.run()
+    train_s = time.time() - t0
+
+    last = per_round[max(per_round)] if per_round else {}
+    out = {
+        "what": f"reference_sp_{cli.optimizer.lower()}_mnist_lr_smoke",
+        "host": "cpu",
+        "users": args.client_num_in_total,
+        "comm_round": args.comm_round,
+        "setup_s": round(setup_s, 3),
+        "train_wall_s": round(train_s, 3),
+        "rounds_per_sec": round(args.comm_round / train_s, 4),
+        "test_acc": last.get("Test/Acc"),
+        "test_loss": last.get("Test/Loss"),
+        "train_acc": last.get("Train/Acc"),
+        "per_round": {str(r): per_round[r] for r in sorted(per_round)},
+    }
+    print("PARITY_JSON " + json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

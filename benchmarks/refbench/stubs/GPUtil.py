@@ -1,0 +1,5 @@
+"""Stub GPUtil: no GPUs on this host."""
+def getGPUs():
+    return []
+def getAvailable(*a, **k):
+    return []

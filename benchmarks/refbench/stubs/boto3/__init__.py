@@ -1,0 +1,10 @@
+"""Stub boto3: import-time only."""
+def client(*a, **k):
+    raise RuntimeError("boto3 stub: no S3 in this environment")
+def resource(*a, **k):
+    raise RuntimeError("boto3 stub: no S3 in this environment")
+def session(*a, **k):
+    raise RuntimeError("boto3 stub")
+class Session:
+    def __init__(self, *a, **k):
+        raise RuntimeError("boto3 stub")

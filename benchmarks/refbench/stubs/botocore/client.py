@@ -1,0 +1,5 @@
+class Config:
+    def __init__(self, *a, **k):
+        pass
+class BaseClient:
+    pass

@@ -1,0 +1,6 @@
+class ClientError(Exception):
+    pass
+class BotoCoreError(Exception):
+    pass
+class NoCredentialsError(BotoCoreError):
+    pass

@@ -1,0 +1,13 @@
+"""Stub docker SDK: import-time only."""
+class errors:
+    class DockerException(Exception):
+        pass
+    class APIError(Exception):
+        pass
+    class NotFound(Exception):
+        pass
+def from_env(*a, **k):
+    raise errors.DockerException("docker stub: no daemon")
+class DockerClient:
+    def __init__(self, *a, **k):
+        raise errors.DockerException("docker stub: no daemon")

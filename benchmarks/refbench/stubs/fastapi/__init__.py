@@ -1,0 +1,45 @@
+"""Stub FastAPI: import-time surface only."""
+class FastAPI:
+    def __init__(self, *a, **k):
+        pass
+    def _deco(self, *a, **k):
+        def wrap(fn):
+            return fn
+        return wrap
+    get = post = put = delete = api_route = middleware = on_event = _deco
+    def mount(self, *a, **k):
+        pass
+    def add_middleware(self, *a, **k):
+        pass
+class Request:
+    pass
+class Response:
+    def __init__(self, *a, **k):
+        pass
+class HTTPException(Exception):
+    def __init__(self, status_code=500, detail=""):
+        self.status_code = status_code
+        self.detail = detail
+class APIRouter(FastAPI):
+    pass
+def Depends(x=None):
+    return x
+def Body(*a, **k):
+    return None
+def Query(*a, **k):
+    return None
+def Header(*a, **k):
+    return None
+def File(*a, **k):
+    return None
+def Form(*a, **k):
+    return None
+class UploadFile:
+    pass
+class BackgroundTasks:
+    def add_task(self, *a, **k):
+        pass
+class status:
+    HTTP_200_OK = 200
+    HTTP_404_NOT_FOUND = 404
+    HTTP_500_INTERNAL_SERVER_ERROR = 500

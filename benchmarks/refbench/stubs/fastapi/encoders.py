@@ -1,0 +1,2 @@
+def jsonable_encoder(x, *a, **k):
+    return x

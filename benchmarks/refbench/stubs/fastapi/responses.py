@@ -1,0 +1,15 @@
+class Response:
+    def __init__(self, *a, **k):
+        pass
+class JSONResponse(Response):
+    pass
+class StreamingResponse(Response):
+    pass
+class FileResponse(Response):
+    pass
+class PlainTextResponse(Response):
+    pass
+class RedirectResponse(Response):
+    pass
+class HTMLResponse(Response):
+    pass

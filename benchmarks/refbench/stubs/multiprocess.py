@@ -1,0 +1,4 @@
+"""Stub: alias the stdlib multiprocessing as the 'multiprocess' package."""
+import multiprocessing as _mp
+import sys
+sys.modules[__name__] = _mp

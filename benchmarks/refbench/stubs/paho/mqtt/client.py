@@ -1,0 +1,45 @@
+"""Stub paho.mqtt.client: import-time only; connecting raises."""
+MQTTv311 = 4
+MQTTv5 = 5
+
+
+class MQTTMessage:
+    def __init__(self, topic=b"", payload=b""):
+        self.topic = topic
+        self.payload = payload
+
+
+class Client:
+    def __init__(self, *a, **k):
+        self.on_connect = None
+        self.on_disconnect = None
+        self.on_message = None
+        self.on_publish = None
+        self.on_subscribe = None
+
+    def username_pw_set(self, *a, **k):
+        pass
+
+    def will_set(self, *a, **k):
+        pass
+
+    def connect(self, *a, **k):
+        raise RuntimeError("paho stub: no broker in this environment")
+
+    def loop_start(self, *a, **k):
+        pass
+
+    def loop_stop(self, *a, **k):
+        pass
+
+    def loop_forever(self, *a, **k):
+        raise RuntimeError("paho stub: no broker in this environment")
+
+    def publish(self, *a, **k):
+        raise RuntimeError("paho stub: no broker in this environment")
+
+    def subscribe(self, *a, **k):
+        pass
+
+    def disconnect(self, *a, **k):
+        pass

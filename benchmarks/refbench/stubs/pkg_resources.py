@@ -1,0 +1,10 @@
+"""Stub pkg_resources (setuptools>=78 removed it)."""
+def parse_version(v):
+    import re
+    return tuple(int(x) if x.isdigit() else x for x in re.split(r"[.\-+]", str(v)))
+class DistributionNotFound(Exception):
+    pass
+def get_distribution(name):
+    raise DistributionNotFound(name)
+def iter_entry_points(*a, **k):
+    return []

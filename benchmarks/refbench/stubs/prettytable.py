@@ -1,0 +1,8 @@
+class PrettyTable:
+    def __init__(self, *a, **k):
+        self.rows = []
+        self.field_names = []
+    def add_row(self, row):
+        self.rows.append(row)
+    def __str__(self):
+        return "\n".join(str(r) for r in self.rows)

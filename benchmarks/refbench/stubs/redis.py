@@ -1,0 +1,15 @@
+"""Stub redis client: import-time only."""
+class ConnectionError(Exception):
+    pass
+class Redis:
+    def __init__(self, *a, **k):
+        pass
+    def ping(self):
+        raise ConnectionError("redis stub")
+    def __getattr__(self, name):
+        def _fail(*a, **k):
+            raise ConnectionError("redis stub")
+        return _fail
+class ConnectionPool:
+    def __init__(self, *a, **k):
+        pass

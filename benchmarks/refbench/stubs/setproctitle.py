@@ -1,0 +1,4 @@
+def setproctitle(title):
+    pass
+def getproctitle():
+    return ""

@@ -1,0 +1,17 @@
+"""Stub sqlalchemy: import-time surface only (reference uses it for run DBs)."""
+class _Placeholder:
+    def __init__(self, *a, **k):
+        pass
+    def __call__(self, *a, **k):
+        return _Placeholder()
+    def __getattr__(self, name):
+        return _Placeholder()
+Column = String = TEXT = Integer = Float = Boolean = DateTime = BigInteger = _Placeholder
+def create_engine(*a, **k):
+    return _Placeholder()
+def and_(*a, **k):
+    return None
+def or_(*a, **k):
+    return None
+def __getattr__(name):
+    return _Placeholder

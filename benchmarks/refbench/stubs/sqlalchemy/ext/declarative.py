@@ -1,0 +1,1 @@
+from ..orm import declarative_base

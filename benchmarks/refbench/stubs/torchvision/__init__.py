@@ -1,0 +1,2 @@
+"""Stub torchvision: enough surface for import-time use on the SP MNIST path."""
+from . import transforms, datasets, models, utils

@@ -1,0 +1,2 @@
+def __getattr__(name):
+    raise RuntimeError("torchvision.models stub: not available")

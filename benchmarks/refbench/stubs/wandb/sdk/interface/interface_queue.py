@@ -1,0 +1,3 @@
+class InterfaceQueue:
+    def __init__(self, *a, **k):
+        pass

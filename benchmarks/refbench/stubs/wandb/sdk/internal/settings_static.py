@@ -1,0 +1,3 @@
+class SettingsStatic:
+    def __init__(self, d=None):
+        self.__dict__.update(d or {})

@@ -1,0 +1,3 @@
+class SystemStats:
+    def __init__(self, *a, **k):
+        pass

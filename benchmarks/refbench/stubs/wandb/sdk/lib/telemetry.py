@@ -1,0 +1,11 @@
+class TelemetryRecord:
+    pass
+def context(*a, **k):
+    class _Ctx:
+        def __enter__(self):
+            return TelemetryRecord()
+        def __exit__(self, *a):
+            return False
+    return _Ctx()
+def __getattr__(name):
+    return None

@@ -1,0 +1,6 @@
+def apple_gpu_stats_binary():
+    raise RuntimeError("wandb stub")
+def __getattr__(name):
+    def _fail(*a, **k):
+        raise RuntimeError("wandb stub")
+    return _fail

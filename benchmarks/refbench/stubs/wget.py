@@ -1,0 +1,2 @@
+def download(url, out=None, bar=None):
+    raise RuntimeError("zero-egress environment: wget stub; pre-seed the cache dir")

@@ -32,6 +32,22 @@ _SHAKESPEARE_SNIPPET = (
 )
 
 
+#: single source of truth for label-space sizes (used by load_arrays AND
+#: the natural-partition path, so they can never drift apart)
+DATASET_CLASSES = {
+    "mnist": 10, "femnist": 62,
+    "cifar10": 10, "cifar100": 100, "cinic10": 10, "fed_cifar100": 100,
+    "shakespeare": 90, "fed_shakespeare": 90,
+    "stackoverflow_nwp": 10004, "stackoverflow_lr": 500,
+    "ilsvrc2012": 1000, "imagenet": 1000,
+    "gld23k": 203, "gld160k": 2028,
+}
+
+
+def dataset_class_num(dataset: str, default: int = 10) -> int:
+    return DATASET_CLASSES.get(dataset.lower(), default)
+
+
 def _try_npz(cache_dir: str, name: str) -> Optional[Arrays]:
     path = os.path.join(cache_dir, f"{name}.npz")
     if os.path.exists(path):
@@ -341,13 +357,13 @@ def load_arrays(dataset: str, cache_dir: str, seed: int = 0,
     sz = lambda n: max(int(n * scale), 64)
 
     if dataset in ("mnist", "femnist"):
-        classes = 10 if dataset == "mnist" else 62
+        classes = dataset_class_num(dataset)
         real = _try_npz(cache_dir, dataset) or _try_torchvision(cache_dir,
                                                                 dataset)
         return (real or _synthetic_images((28, 28, 1), classes, sz(6000),
                                           sz(1000), seed)), classes
     if dataset in ("cifar10", "cifar100", "cinic10", "fed_cifar100"):
-        classes = 100 if "100" in dataset else 10
+        classes = dataset_class_num(dataset)
         key = "cifar100" if "100" in dataset else "cifar10"
         real = _try_npz(cache_dir, key) or _try_torchvision(cache_dir, key)
         return (real or _synthetic_images((32, 32, 3), classes, sz(5000),

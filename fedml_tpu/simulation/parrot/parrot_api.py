@@ -77,6 +77,10 @@ class ParrotAPI:
         self.bs = bs
         max_n = max(self.local_num_dict.values())
         self.nb = max(1, -(-int(max_n) // bs))
+        #: hetero size-bucketing (reference `core/schedule` capability on the
+        #: vmapped hot path): >1 splits clients into size strata so per-round
+        #: compute tracks the size DISTRIBUTION, not the max client
+        self.n_buckets = max(1, int(getattr(args, "hetero_buckets", 1) or 1))
 
         # ---- device-resident dataset + per-client index matrix ------------
         x_all, y_all = self.train_global
@@ -132,10 +136,69 @@ class ParrotAPI:
             self.mesh = (build_hybrid_mesh(shape, dcn) if dcn
                          else build_mesh(shape))
 
+        self._build_buckets()
+        # the dataset/index arrays ride as EXPLICIT jit arguments — if the
+        # round step closed over them they would be lowered as embedded HLO
+        # constants (hundreds of MB at 50k-sample scale), which bloats the
+        # program beyond what remote-compile services accept
+        self.device_data = {"x": self.x_all, "y": self.y_all,
+                            "idx": self.idx_mat, "w": self.n_samples}
+        if self.buckets is not None:
+            self.device_data["bidx"] = [b["idx"] for b in self.buckets]
+            self.device_data["bgids"] = [b["gids"] for b in self.buckets]
         self.round_step = jax.jit(self._build_round_step(),
-                                  donate_argnums=(0, 1))
+                                  donate_argnums=(1, 2))
+        if self.n_buckets > 1:
+            self.bucketed_round_step = jax.jit(
+                self._build_bucketed_round_step(), donate_argnums=(1, 2))
         self.multi_round_step = None  # built lazily for the scan fast path
         self.metrics_history: List[Dict[str, Any]] = []
+
+    def _build_buckets(self) -> None:
+        """Split clients into size strata (equal client counts, stratum
+        count snapped to a divisor of k) with per-stratum batch capacity
+        nb_b = ceil(max_size_in_stratum / bs).  Per round each stratum
+        contributes exactly k/B clients (proportionate stratified sampling
+        — every client's inclusion probability is exactly k/N), so the
+        padded compute is Σ_b (k/B)·nb_b·bs ≈ k·mean_size instead of
+        k·max_size.
+
+        This is the reference heterogeneity-aware scheduler capability
+        (`core/schedule/seq_train_scheduler.py`, SURVEY §2.4 fedavg_seq)
+        re-expressed for the vmapped hot path: strata ARE the schedule,
+        chosen once from the static partition."""
+        if self.n_buckets <= 1:
+            self.buckets = None
+            return
+        # snap the stratum count to a DIVISOR of k (closest to the request,
+        # larger on ties): equal-count strata with equal integer quotas
+        # q = k/B make every client's inclusion probability exactly
+        # q/(N/B) = k/N — fixed unequal quotas would permanently
+        # over-sample one size class.  Residual bias only when B ∤ N
+        # (array_split sizes differ by 1 → |Δp| ≤ k/(N·(N/B−1))).
+        divisors = [d for d in range(1, self.k + 1)
+                    if self.k % d == 0 and d <= self.n_total]
+        b_eff = min(divisors, key=lambda d: (abs(d - self.n_buckets), -d))
+        if b_eff <= 1:
+            self.buckets = None
+            self.n_buckets = 1
+            return
+        self.n_buckets = b_eff
+        sizes = np.asarray([self.local_num_dict[c]
+                            for c in range(self.n_total)])
+        order = np.argsort(sizes, kind="stable")
+        groups = [g for g in np.array_split(order, b_eff) if len(g)]
+        q = self.k // len(groups)
+        idx_mat = np.asarray(self.idx_mat)
+        self.buckets = []
+        for g in groups:
+            nb_b = max(1, -(-int(sizes[g].max()) // self.bs))
+            self.buckets.append({
+                "gids": jnp.asarray(g.astype(np.int32)),
+                "idx": jnp.asarray(idx_mat[g, :nb_b * self.bs]),
+                "nb": nb_b,
+                "k": int(min(q, len(g))),
+            })
 
     def _find_rows(self, cid: int, n_i: int) -> np.ndarray:
         """Global row indices of client cid's samples (the partition index
@@ -153,10 +216,23 @@ class ParrotAPI:
             setattr(self.args, "client_row_map", rows_map)
         return rows_map[cid][:n_i]
 
+    def _gather_batches(self, data, client_ids, idx_mat, nb_b):
+        """Device-resident gather: padded per-client slots → [K, nb_b, bs]
+        batch grids with validity masks (shared by the uniform and
+        bucketed round steps).  ``data`` carries the traced dataset arrays
+        (explicit jit args, never closure constants)."""
+        bs = self.bs
+        idx = idx_mat[client_ids]                           # [K, cap]
+        safe = jnp.maximum(idx, 0)
+        x = data["x"][safe]                                 # [K, cap, ...]
+        y = data["y"][safe]
+        mask = (idx >= 0).astype(jnp.float32)
+        return {"x": x.reshape((x.shape[0], nb_b, bs) + x.shape[2:]),
+                "y": y.reshape((y.shape[0], nb_b, bs) + y.shape[2:]),
+                "mask": mask.reshape((mask.shape[0], nb_b, bs))}
+
     # ------------------------------------------------------------------
     def _build_round_step(self):
-        algo = self.algo
-        bs, nb, cap = self.bs, self.nb, self.nb * self.bs
         mesh = self.mesh
         # the client axis shards over EVERY mesh axis (clients is parrot's
         # only parallel dimension, so a DCN axis extends it across slices
@@ -164,39 +240,13 @@ class ParrotAPI:
         clients_sharding = (NamedSharding(mesh, P(tuple(mesh.axis_names)))
                             if mesh is not None else None)
 
-        def gather_batches(client_ids):
-            idx = self.idx_mat[client_ids]                  # [K, cap]
-            safe = jnp.maximum(idx, 0)
-            x = self.x_all[safe]                            # [K, cap, ...]
-            y = self.y_all[safe]
-            mask = (idx >= 0).astype(jnp.float32)
-            x = x.reshape((x.shape[0], nb, bs) + x.shape[2:])
-            y = y.reshape((y.shape[0], nb, bs) + y.shape[2:])
-            mask = mask.reshape((mask.shape[0], nb, bs))
-            return {"x": x, "y": y, "mask": mask}
+        per_client_algo_state = self._per_client_algo_state
+        in_axes_algo = self._in_axes_algo()
+        aggregate = self._build_aggregate()
 
-        def per_client_algo_state(server_state, client_ids):
-            if algo == FED_OPT_SCAFFOLD:
-                return {
-                    "c_global": server_state["c_global"],
-                    "c_local": jax.tree_util.tree_map(
-                        lambda t: t[client_ids], server_state["c_locals"]),
-                }
-            if algo == FED_OPT_FEDDYN:
-                return {"feddyn_lambda": jax.tree_util.tree_map(
-                    lambda t: t[client_ids], server_state["lambdas"])}
-            if algo == FED_OPT_MIME:
-                return {"server_momentum": server_state["momentum"]}
-            return {}
-
-        in_axes_algo = {
-            FED_OPT_SCAFFOLD: {"c_global": None, "c_local": 0},
-            FED_OPT_FEDDYN: {"feddyn_lambda": 0},
-            FED_OPT_MIME: {"server_momentum": None},
-        }.get(algo)
-
-        def round_step(global_vars, server_state, client_ids, rng):
-            batches = gather_batches(client_ids)
+        def round_step(data, global_vars, server_state, client_ids, rng):
+            batches = self._gather_batches(data, client_ids, data["idx"],
+                                           self.nb)
             if clients_sharding is not None:
                 batches = jax.lax.with_sharding_constraint(
                     batches, clients_sharding)
@@ -206,8 +256,42 @@ class ParrotAPI:
                 self.local_update,
                 in_axes=(None, 0, 0, in_axes_algo))(
                     global_vars, batches, rngs, algo_state or None)
+            weights = data["w"][client_ids]
+            return aggregate(global_vars, server_state, client_ids,
+                             new_vars, algo_out, metrics, weights)
 
-            weights = self.n_samples[client_ids]
+        return round_step
+
+    def _per_client_algo_state(self, server_state, client_ids):
+        algo = self.algo
+        if algo == FED_OPT_SCAFFOLD:
+            return {
+                "c_global": server_state["c_global"],
+                "c_local": jax.tree_util.tree_map(
+                    lambda t: t[client_ids], server_state["c_locals"]),
+            }
+        if algo == FED_OPT_FEDDYN:
+            return {"feddyn_lambda": jax.tree_util.tree_map(
+                lambda t: t[client_ids], server_state["lambdas"])}
+        if algo == FED_OPT_MIME:
+            return {"server_momentum": server_state["momentum"]}
+        return {}
+
+    def _in_axes_algo(self):
+        return {
+            FED_OPT_SCAFFOLD: {"c_global": None, "c_local": 0},
+            FED_OPT_FEDDYN: {"feddyn_lambda": 0},
+            FED_OPT_MIME: {"server_momentum": None},
+        }.get(self.algo)
+
+    def _build_aggregate(self):
+        """Shared post-vmap logic: weighted aggregation + per-algorithm
+        server-state update, operating on stacked per-client outputs
+        (uniform round and bucketed round feed the same contract)."""
+        algo = self.algo
+
+        def aggregate(global_vars, server_state, client_ids, new_vars,
+                      algo_out, metrics, weights):
             agg_vars = agg_stacked(new_vars, weights)
             new_state = dict(server_state)
 
@@ -263,8 +347,54 @@ class ParrotAPI:
                 / jnp.maximum(jnp.sum(weights), 1e-12),
                 "train_acc": jnp.sum(metrics["train_acc"] * weights)
                 / jnp.maximum(jnp.sum(weights), 1e-12),
+                "samples": jnp.sum(weights),
             }
             return agg_vars, new_state, round_metrics
+
+        return aggregate
+
+    def _build_bucketed_round_step(self):
+        """One round over size strata: each bucket vmaps its own quota of
+        clients at its own batch capacity (one compile total — the python
+        loop over buckets unrolls into one jit graph), then all buckets'
+        stacked outputs concatenate into the shared aggregation.  Client
+        sampling is proportionate-stratified ON DEVICE (inclusion
+        probability k/N per client; deviation from the reference's host
+        `np.random.seed(round)` draws is documented in run_rounds_fused)."""
+        per_client_algo_state = self._per_client_algo_state
+        in_axes_algo = self._in_axes_algo()
+        aggregate = self._build_aggregate()
+        buckets = self.buckets
+
+        def round_step(data, global_vars, server_state, rng):
+            outs = []
+            keys = jax.random.split(rng, 2 * len(buckets))
+            for i, b in enumerate(buckets):
+                rows = jax.random.permutation(
+                    keys[2 * i], b["gids"].shape[0])[:b["k"]]
+                gids = data["bgids"][i][rows]
+                batches = self._gather_batches(data, rows,
+                                               data["bidx"][i], b["nb"])
+                rngs = jax.random.split(keys[2 * i + 1], b["k"])
+                algo_state = per_client_algo_state(server_state, gids)
+                new_vars, algo_out, metrics = jax.vmap(
+                    self.local_update,
+                    in_axes=(None, 0, 0, in_axes_algo))(
+                        global_vars, batches, rngs, algo_state or None)
+                outs.append((new_vars, algo_out, metrics,
+                             data["w"][gids], gids))
+
+            def cat(trees):
+                return jax.tree_util.tree_map(
+                    lambda *xs: jnp.concatenate(xs, axis=0), *trees)
+
+            new_vars = cat([o[0] for o in outs])
+            algo_out = cat([o[1] for o in outs])
+            metrics = cat([o[2] for o in outs])
+            weights = jnp.concatenate([o[3] for o in outs])
+            client_ids = jnp.concatenate([o[4] for o in outs])
+            return aggregate(global_vars, server_state, client_ids,
+                             new_vars, algo_out, metrics, weights)
 
         return round_step
 
@@ -279,24 +409,37 @@ class ParrotAPI:
         distribution, different draws; the default per-round path keeps
         reference parity.
         """
-        round_step = self._build_round_step()
         k = self.k
         n_total = self.n_total
+        if self.n_buckets > 1:
+            bucketed = self._build_bucketed_round_step()
 
-        def multi(global_vars, server_state, rng, n_rounds_arr):
-            def body(carry, r):
-                gv, st, rng = carry
-                rng, k1, k2 = jax.random.split(rng, 3)
-                ids = jax.random.permutation(k1, n_total)[:k]
-                gv, st, rm = round_step(gv, st, ids, k2)
-                return (gv, st, rng), rm
+            def make_body(data):
+                def body(carry, r):
+                    gv, st, rng = carry
+                    rng, k2 = jax.random.split(rng)
+                    gv, st, rm = bucketed(data, gv, st, k2)
+                    return (gv, st, rng), rm
+                return body
+        else:
+            round_step = self._build_round_step()
 
+            def make_body(data):
+                def body(carry, r):
+                    gv, st, rng = carry
+                    rng, k1, k2 = jax.random.split(rng, 3)
+                    ids = jax.random.permutation(k1, n_total)[:k]
+                    gv, st, rm = round_step(data, gv, st, ids, k2)
+                    return (gv, st, rng), rm
+                return body
+
+        def multi(data, global_vars, server_state, rng, n_rounds_arr):
             (gv, st, _), rms = jax.lax.scan(
-                body, (global_vars, server_state, rng),
+                make_body(data), (global_vars, server_state, rng),
                 jnp.arange(n_rounds_arr.shape[0]))
             return gv, st, rms
 
-        return jax.jit(multi, donate_argnums=(0, 1))
+        return jax.jit(multi, donate_argnums=(1, 2))
 
     #: rounds per fused jit call — the scan length is part of the compiled
     #: shape, so a fixed chunk means ONE compile serves any total round
@@ -323,12 +466,13 @@ class ParrotAPI:
             # jitted step (it donates global_vars/server_state — running it
             # just to learn the metrics shape would delete the live state)
             return {"train_loss": np.zeros((0,), np.float32),
-                    "train_acc": np.zeros((0,), np.float32)}
+                    "train_acc": np.zeros((0,), np.float32),
+                    "samples": np.zeros((0,), np.float32)}
         while remaining > 0:
             step = min(chunk, remaining)
             rng, sub = jax.random.split(rng)
             self.global_vars, self.server_state, rms = self.multi_round_step(
-                self.global_vars, self.server_state, sub,
+                self.device_data, self.global_vars, self.server_state, sub,
                 jnp.zeros((step,)))
             out.append(rms)
             remaining -= step
@@ -377,10 +521,20 @@ class ParrotAPI:
         with ctx:
             for round_idx in range(start_round, comm_rounds):
                 t0 = time.time()
-                client_ids = jnp.asarray(self._client_sampling(round_idx))
                 rng, sub = jax.random.split(rng)
-                self.global_vars, self.server_state, rm = self.round_step(
-                    self.global_vars, self.server_state, client_ids, sub)
+                if self.n_buckets > 1:
+                    # stratified on-device sampling (documented deviation
+                    # from the reference's host np.random.seed(round) draws)
+                    (self.global_vars, self.server_state,
+                     rm) = self.bucketed_round_step(
+                        self.device_data, self.global_vars,
+                        self.server_state, sub)
+                else:
+                    client_ids = jnp.asarray(
+                        self._client_sampling(round_idx))
+                    self.global_vars, self.server_state, rm = self.round_step(
+                        self.device_data, self.global_vars,
+                        self.server_state, client_ids, sub)
                 freq = int(getattr(self.args, "frequency_of_the_test", 5) or 5)
                 if round_idx % freq == 0 or round_idx == comm_rounds - 1:
                     out = self.eval_step(self.global_vars, test_batches)
