@@ -248,6 +248,31 @@ def federate_run(job_yaml: str) -> None:
 
 
 @cli.group()
+def data() -> None:
+    """Dataset cache utilities (natural federated partitions)."""
+
+
+@data.command("import")
+@click.argument("src", type=click.Path(exists=True))
+@click.option("--dataset", required=True,
+              help="dataset name the loader will look up, e.g. femnist")
+@click.option("--cache-dir", required=True, type=click.Path(),
+              help="data_cache_dir the training config will point at")
+@click.option("--format", "fmt", default="auto",
+              type=click.Choice(["auto", "leaf", "h5", "npz"]),
+              help="source layout: LEAF JSON dir, client-keyed h5, or npz")
+def data_import(src: str, dataset: str, cache_dir: str, fmt: str) -> None:
+    """Convert a standard federated download (LEAF JSON train/+test/ dirs,
+    fed_shakespeare-style h5, or an npz) into the client-keyed npz cache
+    `partition_method: natural` loads."""
+    import json as _json
+
+    from ..data.natural import import_to_cache
+
+    click.echo(_json.dumps(import_to_cache(src, dataset, cache_dir, fmt)))
+
+
+@cli.group()
 def device() -> None:
     """Device utilities (reference `fedml device`)."""
 

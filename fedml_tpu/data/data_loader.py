@@ -33,6 +33,27 @@ def load(args: Any) -> DatasetTuple:
     alpha = float(getattr(args, "partition_alpha", 0.5) or 0.5)
     scale = float(getattr(args, "data_scale", 1.0) or 1.0)
 
+    # natural per-user partitions (LEAF family): client-keyed files beat
+    # the synthetic Dirichlet split, mirroring the reference loaders
+    # (`data/data_loader.py:287-375` always load femnist/shakespeare/
+    # stackoverflow by their real users).  partition_method "natural"
+    # REQUIRES them; the fed_* datasets use them opportunistically.
+    if method == "natural" or dataset.startswith("fed_") \
+            or dataset in ("femnist", "stackoverflow_nwp",
+                           "stackoverflow_lr"):
+        from .datasets import dataset_class_num
+        from .natural import load_natural
+
+        # unknown dataset names (default=0) derive class_num from labels
+        out = load_natural(args, dataset_class_num(dataset, default=0))
+        if out is not None:
+            return out
+        if method == "natural":
+            raise FileNotFoundError(
+                f"partition_method 'natural' needs client-keyed files for "
+                f"{dataset!r} under {cache_dir!r} (run `fedml_tpu data "
+                f"import` first); none found")
+
     (x_train, y_train, x_test, y_test), class_num = load_arrays(
         dataset, cache_dir, seed=seed, scale=scale)
 

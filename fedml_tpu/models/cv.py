@@ -30,12 +30,20 @@ ModuleDef = Any
 class LogisticRegression(nn.Module):
     num_classes: int
     dtype: Any = jnp.float32
+    #: reference-compat: the reference's lr model passes sigmoid outputs to
+    #: CrossEntropyLoss (`model/linear/lr.py:11` torch.sigmoid before CE) —
+    #: a quirk that bounds the "logits" to [0,1] and slows convergence.
+    #: Default False = plain logits (the deliberate fix, docs/PARITY.md);
+    #: parity audits set lr_sigmoid_outputs: true to reproduce the
+    #: reference curve.
+    sigmoid_output: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.reshape((x.shape[0], -1)).astype(self.dtype)
-        return nn.Dense(self.num_classes, dtype=self.dtype,
-                        param_dtype=jnp.float32)(x).astype(jnp.float32)
+        z = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=jnp.float32)(x).astype(jnp.float32)
+        return jax.nn.sigmoid(z) if self.sigmoid_output else z
 
 
 class FedAvgCNN(nn.Module):

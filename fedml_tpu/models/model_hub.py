@@ -88,7 +88,9 @@ def create(args: Any, output_dim: Optional[int] = None) -> ModelBundle:
     input_dtype = (jnp.int32 if task == TASK_LM else jnp.float32)
 
     if name == "lr":
-        module = LogisticRegression(num_classes, dtype=dtype)
+        module = LogisticRegression(
+            num_classes, dtype=dtype,
+            sigmoid_output=bool(getattr(args, "lr_sigmoid_outputs", False)))
         if task == TASK_LM:  # lr on text = bag-of-words; keep classification
             task = TASK_CLASSIFICATION
     elif name == "cnn":

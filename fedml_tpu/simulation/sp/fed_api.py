@@ -194,12 +194,37 @@ class FedSimAPI:
     def _server_update(self, round_idx: int, client_ids: List[int],
                        results: List[Tuple[float, Any]],
                        algo_outs: List[Tuple[int, float, Dict[str, Any]]]):
-        raw = self.aggregator.on_before_aggregation(results)
+        compat_scaffold = (self.algo == FED_OPT_SCAFFOLD and getattr(
+            self.args, "scaffold_ref_bug_compat", False))
+        # compat mode bypasses aggregation entirely — don't run the
+        # defense/filter hooks over results just to discard them
+        raw = (None if compat_scaffold
+               else self.aggregator.on_before_aggregation(results))
 
         if self.algo == FED_OPT_SCAFFOLD:
             for cid, _, out in algo_outs:
                 self.c_locals[cid] = out["c_local"]
             n_total = float(self.args.client_num_in_total)
+            if compat_scaffold:
+                # Reference-bug compatibility (parity audits only): the
+                # reference's SCAFFOLD aggregation computes a weighted sum
+                # and then OVERWRITES it with the LAST client's delta
+                # (`/root/reference/python/fedml/ml/aggregator/
+                # agg_operator.py:104-117` — `total_weights_delta[k] =
+                # weights_delta[k]` after the loop), so the server applies
+                # only the last-sampled client's update and
+                # c_global += c_delta_last / N.  Default path below is the
+                # deliberate FIX (true weighted average, summed c_deltas).
+                server_lr = float(getattr(self.args, "server_lr", 1.0)
+                                  or 1.0)
+                _, last_params = results[-1]
+                new_vars = jax.tree_util.tree_map(
+                    lambda g, w: g + (w - g) * server_lr,
+                    self.global_vars, last_params)
+                self.c_global = jax.tree_util.tree_map(
+                    lambda c, d: c + d / n_total, self.c_global,
+                    algo_outs[-1][2]["c_delta"])
+                return new_vars
             avg_vars = self.aggregator.aggregate(raw)
             if isinstance(avg_vars, tuple):  # not the SCAFFOLD pair path here
                 avg_vars = avg_vars[0]
