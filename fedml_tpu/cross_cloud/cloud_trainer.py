@@ -1,0 +1,103 @@
+"""Cloud-scale local trainer: one federated party = one TPU-slice mesh.
+
+The reference's cross-cloud plane ("Cheetah", `cross_cloud/` §2.7) points
+each party at a whole GPU cluster and delegates the heavy training to
+DeepSpeed (`train/llm/distributed.py`).  TPU redesign: each cloud owns a
+`jax.sharding.Mesh` over its DEVICE SLICE and trains the model
+fsdp/dp-sharded inside one jit (XLA collectives on ICI); only the round
+protocol crosses clouds.  This ClientTrainer is the bridge between the
+message-plane federation (cross-silo managers) and the sharded engine
+(`parallel/sharding.build_sharded_train_step`).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..constants import AXIS_DATA
+from ..core.alg_frame.client_trainer import ClientTrainer
+from ..parallel.sharding import build_sharded_train_step
+from jax.sharding import Mesh
+
+
+class CloudLMTrainer(ClientTrainer):
+    """Trains the bundle's model over this cloud's device slice with the
+    configured intra-cloud strategy (fsdp default — the ZeRO equivalent)."""
+
+    def __init__(self, bundle: Any, args: Any,
+                 devices: Optional[Sequence[Any]] = None,
+                 strategy: Optional[str] = None) -> None:
+        super().__init__(bundle, args)
+        self.bundle = bundle
+        devs = list(devices if devices is not None else jax.devices())
+        self.mesh = Mesh(np.asarray(devs), (AXIS_DATA,))
+        self.strategy = str(strategy
+                            or getattr(args, "cloud_strategy", "fsdp"))
+        self.train_step, self.init_shardings, self.tx = \
+            build_sharded_train_step(bundle, args, self.mesh, self.strategy)
+        self._jit_step = jax.jit(self.train_step,
+                                 donate_argnums=(0, 1))
+        self.last_loss = float("nan")
+
+    def set_num_batches(self, nb: int) -> None:
+        """Adapter hook (fixed-shape trainers pad to nb); the cloud trainer
+        batches dynamically over its slice, so nothing to pin."""
+
+    def train(self, train_data=None, device=None, args=None) -> None:
+        args = args or self.args
+        x, y = self.local_train_dataset
+        x = np.asarray(x)
+        y = np.asarray(y)
+        n_dev = int(np.prod(list(self.mesh.shape.values())))
+        bs = max(int(getattr(args, "batch_size", 8)), n_dev)
+        bs -= bs % n_dev          # batch must tile the data axis
+        if len(y) < bs:
+            # tiny cloud partition: tile up to one full device-aligned
+            # batch rather than silently training zero steps
+            reps = -(-bs // max(len(y), 1))
+            x = np.tile(x, (reps,) + (1,) * (x.ndim - 1))[:bs]
+            y = np.tile(y, (reps,) + (1,) * (y.ndim - 1))[:bs]
+        epochs = int(getattr(args, "epochs", 1))
+
+        with self.mesh:
+            shardings = self.init_shardings(self.params)
+            variables = jax.device_put(self.params, shardings)
+            opt_state = jax.jit(self.tx.init)(variables["params"])
+            rng = jax.random.PRNGKey(self.rng_seed + self.id)
+            from ..parallel.sharding import batch_sharding
+
+            bsh = batch_sharding(self.mesh)
+            loss = jnp.full((), jnp.nan)  # nan until a step actually ran
+            for _ in range(epochs):
+                for i in range(0, len(y) - bs + 1, bs):
+                    batch = {
+                        "x": jax.device_put(x[i:i + bs], bsh),
+                        "y": jax.device_put(y[i:i + bs], bsh),
+                    }
+                    rng, sub = jax.random.split(rng)
+                    variables, opt_state, m = self._jit_step(
+                        variables, opt_state, batch, sub)
+                    loss = m["loss"]
+            self.last_loss = float(loss)
+            # replicate back to host layout for the wire (the aggregation
+            # plane exchanges full pytrees, like cross-silo)
+            self.params = jax.device_get(variables)
+        logging.info("cloud %d (%s over %d devices): local loss %.4f",
+                     self.id, self.strategy, n_dev, self.last_loss)
+
+
+def cloud_device_slices(n_clouds: int,
+                        devices: Optional[List[Any]] = None
+                        ) -> List[List[Any]]:
+    """Partition the visible devices into equal contiguous slices, one per
+    cloud (contiguity keeps each slice's collectives on neighboring ICI
+    links under XLA's default device order)."""
+    devs = list(devices if devices is not None else jax.devices())
+    per = max(len(devs) // max(n_clouds, 1), 1)
+    slices = [devs[i * per:(i + 1) * per] for i in range(n_clouds)]
+    return [s if s else [devs[-1]] for s in slices]

@@ -67,7 +67,13 @@ def init_client(args: Any, dataset: Tuple, bundle: Any, rank: int,
 
 
 class LocalFederationRunner:
-    """Server + N clients over INPROC threads; returns final server metrics."""
+    """Server + N clients over INPROC threads; returns final server metrics.
+
+    ``client_trainer`` may be a single trainer instance (shared, the
+    default-trainer case) or a CALLABLE ``rank -> trainer`` for planes that
+    need one trainer per client (cross-cloud mesh slices)."""
+
+    JOIN_TIMEOUT_S = 30.0
 
     def __init__(self, args: Any, device: Any, dataset: Tuple, bundle: Any,
                  client_trainer: Optional[Any] = None,
@@ -78,13 +84,19 @@ class LocalFederationRunner:
         self.client_trainer = client_trainer
         self.server_aggregator = server_aggregator
 
+    def _trainer_for(self, rank: int):
+        if callable(self.client_trainer) and not hasattr(
+                self.client_trainer, "train"):
+            return self.client_trainer(rank)
+        return self.client_trainer
+
     def train(self):
         n = int(self.args.client_num_per_round)
         server = init_server(self.args, self.dataset, self.bundle,
                              self.server_aggregator, backend="INPROC")
         clients: List[ClientMasterManager] = [
             init_client(self.args, self.dataset, self.bundle, rank,
-                        self.client_trainer, backend="INPROC")
+                        self._trainer_for(rank), backend="INPROC")
             for rank in range(1, n + 1)
         ]
         threads = [threading.Thread(target=c.run, daemon=True,
@@ -93,7 +105,7 @@ class LocalFederationRunner:
             t.start()
         server.run()  # blocks until FINISH
         for t in threads:
-            t.join(timeout=30)
+            t.join(timeout=self.JOIN_TIMEOUT_S)
         hist = server.aggregator.metrics_history
         return hist[-1] if hist else {}
 
@@ -126,8 +138,12 @@ def build_cross_silo_runner(args: Any, device: Any, dataset: Tuple,
                             bundle: Any, client_trainer=None,
                             server_aggregator=None):
     backend = str(getattr(args, "backend", "INPROC")).upper()
-    role = str(getattr(args, "role", "simulated"))
-    if backend == "INPROC" and role in ("simulated", "local"):
+    if backend == "INPROC":
+        # the in-process bus cannot cross OS processes, so a single-role
+        # run over INPROC can never federate — it would block forever
+        # waiting for peers.  INPROC therefore ALWAYS means the local
+        # (simulated) federation; real deployments pick GRPC/MQTT_S3 and
+        # set role/rank per host.
         return LocalFederationRunner(args, device, dataset, bundle,
                                      client_trainer, server_aggregator)
     return SingleRoleRunner(args, device, dataset, bundle, client_trainer,
