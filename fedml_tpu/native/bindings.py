@@ -49,6 +49,15 @@ def load() -> ctypes.CDLL:
     lib.ft_eval_classifier.argtypes = [
         P(f32), P(ctypes.c_int32), i64, i64, i64, i64,
         P(f32), P(f32), P(f32), P(f32), P(f32)]
+    lib.ft_train_lenet.restype = f32
+    lib.ft_train_lenet.argtypes = [
+        P(f32), P(ctypes.c_int32), i64, i64, i64, i64, i64, i64, i64,
+        P(f32), P(f32), P(f32), P(f32), P(f32), P(f32),
+        i64, i64, f32, f32, u64, PROGRESS_CB]
+    lib.ft_eval_lenet.restype = f32
+    lib.ft_eval_lenet.argtypes = [
+        P(f32), P(ctypes.c_int32), i64, i64, i64, i64, i64, i64, i64,
+        P(f32), P(f32), P(f32), P(f32), P(f32), P(f32), P(f32)]
     lib.ft_lcc_encode.argtypes = [P(i64), i64, i64, P(i64), i64, P(i64), i64,
                                   P(i64)]
     lib.ft_lcc_decode.argtypes = [P(i64), i64, i64, P(i64), P(i64), i64,
@@ -134,6 +143,128 @@ def train_classifier(x: np.ndarray, y: np.ndarray, classes: int,
         _ptr(w1, f32) if hidden else None, _ptr(b1, f32) if hidden else None,
         _ptr(w2, f32), _ptr(b2, f32), epochs, batch, lr, momentum, seed, cb)
     return {"w1": w1, "b1": b1, "w2": w2, "b2": b2, "loss": float(loss)}
+
+
+def _lenet_shapes(d: int, c1: int, c2: int, classes: int
+                  ) -> Tuple[int, int, int, int]:
+    """(H, W, Cin, fc_in) for a flat feature dim d: square single-channel
+    (MNIST 784→28x28x1) or square 3-channel (CIFAR 3072→32x32x3)."""
+    side = int(round(d ** 0.5))
+    if side * side == d:
+        H = W = side
+        cin = 1
+    else:
+        side = int(round((d / 3) ** 0.5))
+        if side * side * 3 != d:
+            raise ValueError(f"cannot infer HxWxC from flat dim {d}")
+        H = W = side
+        cin = 3
+    hp1 = (H - 4) // 2
+    hp2 = (hp1 - 4) // 2
+    return H, W, cin, c2 * hp2 * hp2
+
+
+def init_lenet_weights(d: int, classes: int, c1: int = 8, c2: int = 16,
+                       seed: int = 0) -> dict:
+    """He-init conv kernels, zero fc — the canonical edge LeNet start."""
+    H, W, cin, fc_in = _lenet_shapes(d, c1, c2, classes)
+    rng = np.random.RandomState(seed)
+    return {
+        "k1": (rng.randn(c1, cin, 5, 5)
+               * np.sqrt(2.0 / (cin * 25))).astype(np.float32),
+        "bk1": np.zeros(c1, np.float32),
+        "k2": (rng.randn(c2, c1, 5, 5)
+               * np.sqrt(2.0 / (c1 * 25))).astype(np.float32),
+        "bk2": np.zeros(c2, np.float32),
+        "fw": np.zeros((fc_in, classes), np.float32),
+        "fb": np.zeros(classes, np.float32),
+    }
+
+
+def _lenet_input(x: np.ndarray, y: np.ndarray) -> Tuple[np.ndarray, int,
+                                                        int, int]:
+    """→ contiguous [n, Cin, H, W] float32 regardless of NHWC/flat input."""
+    x = np.asarray(x, np.float32)
+    if x.ndim == 4:          # NHWC → NCHW
+        x = np.transpose(x, (0, 3, 1, 2))
+        n, cin, H, W = x.shape
+    elif x.ndim == 3:        # NHW (single channel)
+        x = x[:, None, :, :]
+        n, cin, H, W = x.shape
+    else:
+        d = x.reshape(len(y), -1).shape[1]
+        H, W, cin, _ = _lenet_shapes(d, 8, 16, 10)
+        x = x.reshape(len(y), cin, H, W) if cin == 1 else \
+            np.transpose(x.reshape(len(y), H, W, cin), (0, 3, 1, 2))
+    return np.ascontiguousarray(x), int(x.shape[1]), int(x.shape[2]), \
+        int(x.shape[3])
+
+
+def _check_lenet_weights(ws: dict, cin: int, H: int, W: int, classes: int
+                         ) -> None:
+    """Shape-validate before handing raw pointers to C: a mismatched fc
+    weight would make the C loops index past the numpy buffers (heap
+    corruption instead of a Python error)."""
+    c1, k1_cin = ws["k1"].shape[0], ws["k1"].shape[1]
+    c2, k2_cin = ws["k2"].shape[0], ws["k2"].shape[1]
+    hp1 = (H - 4) // 2
+    hp2 = (hp1 - 4) // 2
+    fc_in = c2 * hp2 * hp2
+    if (k1_cin != cin or k2_cin != c1
+            or ws["k1"].shape[2:] != (5, 5) or ws["k2"].shape[2:] != (5, 5)
+            or ws["bk1"].shape != (c1,) or ws["bk2"].shape != (c2,)
+            or ws["fw"].shape != (fc_in, classes)
+            or ws["fb"].shape != (classes,)):
+        raise ValueError(
+            f"lenet weight shapes {({k: v.shape for k, v in ws.items()})} "
+            f"do not match input {H}x{W}x{cin} / {classes} classes "
+            f"(expected fw {(fc_in, classes)})")
+
+
+def train_lenet(x: np.ndarray, y: np.ndarray, classes: int, c1: int = 8,
+                c2: int = 16, epochs: int = 1, batch: int = 32,
+                lr: float = 0.05, momentum: float = 0.9, seed: int = 0,
+                weights: Optional[dict] = None,
+                progress: Optional[Callable] = None) -> dict:
+    """Train the native conv net in place; returns
+    {'k1','bk1','k2','bk2','fw','fb','loss'}."""
+    lib = load()
+    y = np.ascontiguousarray(y, np.int32)
+    x, cin, H, W = _lenet_input(x, y)
+    if weights is None:
+        weights = init_lenet_weights(cin * H * W, classes, c1, c2, seed)
+    ws = {k: np.ascontiguousarray(weights[k], np.float32)
+          for k in ("k1", "bk1", "k2", "bk2", "fw", "fb")}
+    _check_lenet_weights(ws, cin, H, W, classes)
+    c1 = ws["k1"].shape[0]
+    c2 = ws["k2"].shape[0]
+    cb = PROGRESS_CB(progress) if progress else PROGRESS_CB(0)
+    f32 = ctypes.c_float
+    loss = lib.ft_train_lenet(
+        _ptr(x, f32), _ptr(y, ctypes.c_int32), len(y), H, W, cin, c1, c2,
+        classes, _ptr(ws["k1"], f32), _ptr(ws["bk1"], f32),
+        _ptr(ws["k2"], f32), _ptr(ws["bk2"], f32), _ptr(ws["fw"], f32),
+        _ptr(ws["fb"], f32), epochs, batch, lr, momentum, seed, cb)
+    return dict(ws, loss=float(loss))
+
+
+def eval_lenet(x: np.ndarray, y: np.ndarray, classes: int, weights: dict
+               ) -> Tuple[float, float]:
+    lib = load()
+    y = np.ascontiguousarray(y, np.int32)
+    x, cin, H, W = _lenet_input(x, y)
+    ws = {k: np.ascontiguousarray(weights[k], np.float32)
+          for k in ("k1", "bk1", "k2", "bk2", "fw", "fb")}
+    _check_lenet_weights(ws, cin, H, W, classes)
+    f32 = ctypes.c_float
+    loss = ctypes.c_float(0.0)
+    acc = lib.ft_eval_lenet(
+        _ptr(x, f32), _ptr(y, ctypes.c_int32), len(y), H, W, cin,
+        ws["k1"].shape[0], ws["k2"].shape[0], classes,
+        _ptr(ws["k1"], f32), _ptr(ws["bk1"], f32), _ptr(ws["k2"], f32),
+        _ptr(ws["bk2"], f32), _ptr(ws["fw"], f32), _ptr(ws["fb"], f32),
+        ctypes.byref(loss))
+    return float(acc), float(loss.value)
 
 
 def eval_classifier(x: np.ndarray, y: np.ndarray, classes: int,

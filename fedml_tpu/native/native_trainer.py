@@ -23,10 +23,18 @@ class NativeClientTrainer(ClientTrainer):
         super().__init__(bundle, args)
         self.classes = int(getattr(bundle, "num_classes", 10))
         self.hidden = int(getattr(args, "native_hidden", 0) or 0)
+        #: "mlp" (linear / one-hidden-layer, trainer.cpp) or "lenet"
+        #: (conv-pool-conv-pool-fc, conv_trainer.cpp — the reference's
+        #: MNN CNN-on-device capability)
+        self.arch = str(getattr(args, "native_model", "mlp")).lower()
         self.batch_size = int(getattr(args, "batch_size", 32))
         self.epochs = int(getattr(args, "epochs", 1))
         self.lr = float(getattr(args, "learning_rate", 0.05))
-        self.momentum = float(getattr(args, "momentum", 0.0) or 0.0)
+        # explicit momentum (including 0.0) is honored for both archs; the
+        # DEFAULT differs (lenet wants 0.9 like the reference MNN trainer)
+        mom = getattr(args, "momentum", None)
+        self.momentum = (float(mom) if mom is not None
+                         else (0.9 if self.arch == "lenet" else 0.0))
         self.last_metrics: Dict[str, float] = {}
         self.algo_state: Dict[str, Any] = {}
         self.algo_out: Dict[str, Any] = {}
@@ -34,23 +42,37 @@ class NativeClientTrainer(ClientTrainer):
     def set_num_batches(self, nb: int) -> None:  # plane-compat no-op
         pass
 
+    def _carried_weights(self):
+        if not self.params:
+            return None
+        return {k: np.array(v, np.float32, copy=True)
+                for k, v in self.params.items() if k != "loss"}
+
     def train(self, train_data, device=None, args=None) -> Dict[str, float]:
         x, y = train_data
-        self.params = bindings.train_classifier(
-            np.asarray(x), np.asarray(y), self.classes, hidden=self.hidden,
-            epochs=self.epochs, batch=min(self.batch_size, max(len(y), 1)),
-            lr=self.lr, momentum=self.momentum,
-            seed=int(self.rng_seed) + self.id,
-            weights={k: np.array(v, np.float32, copy=True)
-                     for k, v in self.params.items() if k != "loss"}
-            if self.params else None)
+        kw = dict(epochs=self.epochs,
+                  batch=min(self.batch_size, max(len(y), 1)),
+                  lr=self.lr, seed=int(self.rng_seed) + self.id,
+                  weights=self._carried_weights())
+        if self.arch == "lenet":
+            self.params = bindings.train_lenet(
+                np.asarray(x), np.asarray(y), self.classes,
+                momentum=self.momentum, **kw)
+        else:
+            self.params = bindings.train_classifier(
+                np.asarray(x), np.asarray(y), self.classes,
+                hidden=self.hidden, momentum=self.momentum, **kw)
         self.last_metrics = {"train_loss": self.params["loss"]}
         return self.last_metrics
 
     def test(self, test_data, device=None, args=None) -> Dict[str, float]:
         x, y = test_data
-        acc, loss = bindings.eval_classifier(
-            np.asarray(x), np.asarray(y), self.classes, self.params,
-            hidden=self.hidden)
+        if self.arch == "lenet":
+            acc, loss = bindings.eval_lenet(
+                np.asarray(x), np.asarray(y), self.classes, self.params)
+        else:
+            acc, loss = bindings.eval_classifier(
+                np.asarray(x), np.asarray(y), self.classes, self.params,
+                hidden=self.hidden)
         return {"test_acc": acc, "test_loss": loss,
                 "test_total": float(len(y))}

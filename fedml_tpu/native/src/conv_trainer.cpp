@@ -1,0 +1,299 @@
+// Native on-device CONV trainer (LeNet-class) — C API.
+//
+// Capability parity: the reference's MobileNN trainer runs CNN-class models
+// (LeNet / resnet20-mobile) on-device via MNN
+// (android/fedmlsdk/MobileNN/src/train/FedMLMNNTrainer.cpp:3-179, mobile
+// models at python/fedml/model/model_hub.py:78-84).  This dependency-free
+// C++ implementation trains the same conv-pool-conv-pool-fc shape so the
+// cross-device plane can carry conv models, not just MLPs:
+//
+//   conv 5x5 (Cin->c1, valid) + relu -> maxpool 2x2
+//   conv 5x5 (c1->c2, valid) + relu -> maxpool 2x2
+//   fc (c2*h2*w2 -> classes), softmax cross-entropy, SGD(momentum).
+//
+// x layout: [n, Cin, H, W] row-major.  All weight buffers are in/out, the
+// federated round updates them in place (same contract as
+// ft_train_classifier in trainer.cpp).
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <random>
+#include <vector>
+
+namespace {
+
+constexpr int64_t KS = 5;   // conv kernel
+constexpr int64_t PS = 2;   // pool
+
+struct Dims {
+  int64_t H, W, Cin, c1, c2, classes;
+  int64_t hc1, wc1;  // conv1 out
+  int64_t hp1, wp1;  // pool1 out
+  int64_t hc2, wc2;  // conv2 out
+  int64_t hp2, wp2;  // pool2 out
+  int64_t fc_in;
+};
+
+Dims make_dims(int64_t H, int64_t W, int64_t Cin, int64_t c1, int64_t c2,
+               int64_t classes) {
+  Dims d{H, W, Cin, c1, c2, classes, 0, 0, 0, 0, 0, 0, 0, 0, 0};
+  d.hc1 = H - KS + 1;
+  d.wc1 = W - KS + 1;
+  d.hp1 = d.hc1 / PS;
+  d.wp1 = d.wc1 / PS;
+  d.hc2 = d.hp1 - KS + 1;
+  d.wc2 = d.wp1 - KS + 1;
+  d.hp2 = d.hc2 / PS;
+  d.wp2 = d.wc2 / PS;
+  d.fc_in = c2 * d.hp2 * d.wp2;
+  return d;
+}
+
+// valid conv + relu: in [Ci, h, w] -> out [Co, ho, wo]; k [Co, Ci, KS, KS]
+void conv_relu_fwd(const float* in, int64_t Ci, int64_t h, int64_t w,
+                   const float* k, const float* bias, int64_t Co,
+                   float* out, int64_t ho, int64_t wo) {
+  for (int64_t co = 0; co < Co; ++co) {
+    for (int64_t i = 0; i < ho; ++i) {
+      for (int64_t j = 0; j < wo; ++j) {
+        float acc = bias[co];
+        for (int64_t ci = 0; ci < Ci; ++ci) {
+          const float* inp = in + ci * h * w;
+          const float* kp = k + ((co * Ci + ci) * KS) * KS;
+          for (int64_t u = 0; u < KS; ++u)
+            for (int64_t v = 0; v < KS; ++v)
+              acc += inp[(i + u) * w + (j + v)] * kp[u * KS + v];
+        }
+        out[(co * ho + i) * wo + j] = acc > 0.f ? acc : 0.f;
+      }
+    }
+  }
+}
+
+// maxpool 2x2 with argmax capture: in [C, h, w] -> out [C, h/2, w/2]
+void pool_fwd(const float* in, int64_t C, int64_t h, int64_t w, float* out,
+              int32_t* arg, int64_t ho, int64_t wo) {
+  for (int64_t c = 0; c < C; ++c) {
+    for (int64_t i = 0; i < ho; ++i) {
+      for (int64_t j = 0; j < wo; ++j) {
+        int64_t best = ((c * h + i * PS) * w + j * PS);
+        float bv = in[best];
+        for (int64_t u = 0; u < PS; ++u) {
+          for (int64_t v = 0; v < PS; ++v) {
+            int64_t idx = (c * h + i * PS + u) * w + (j * PS + v);
+            if (in[idx] > bv) { bv = in[idx]; best = idx; }
+          }
+        }
+        out[(c * ho + i) * wo + j] = bv;
+        arg[(c * ho + i) * wo + j] = static_cast<int32_t>(best);
+      }
+    }
+  }
+}
+
+// grad through pool: g_out [C, ho, wo] scattered to g_in via argmax
+void pool_bwd(const float* g_out, const int32_t* arg, int64_t n_out,
+              float* g_in, int64_t n_in) {
+  std::memset(g_in, 0, sizeof(float) * n_in);
+  for (int64_t i = 0; i < n_out; ++i) g_in[arg[i]] += g_out[i];
+}
+
+// grad through conv+relu: accumulates dk/db over the batch element and
+// writes g_in (input gradient), given g_out already masked by relu.
+void conv_bwd(const float* in, int64_t Ci, int64_t h, int64_t w,
+              const float* k, int64_t Co, const float* g_out, int64_t ho,
+              int64_t wo, float* dk, float* db, float* g_in) {
+  if (g_in) std::memset(g_in, 0, sizeof(float) * Ci * h * w);
+  for (int64_t co = 0; co < Co; ++co) {
+    for (int64_t i = 0; i < ho; ++i) {
+      for (int64_t j = 0; j < wo; ++j) {
+        float g = g_out[(co * ho + i) * wo + j];
+        if (g == 0.f) continue;
+        db[co] += g;
+        for (int64_t ci = 0; ci < Ci; ++ci) {
+          const float* inp = in + ci * h * w;
+          float* dkp = dk + ((co * Ci + ci) * KS) * KS;
+          const float* kp = k + ((co * Ci + ci) * KS) * KS;
+          float* gip = g_in ? g_in + ci * h * w : nullptr;
+          for (int64_t u = 0; u < KS; ++u) {
+            for (int64_t v = 0; v < KS; ++v) {
+              dkp[u * KS + v] += inp[(i + u) * w + (j + v)] * g;
+              if (gip) gip[(i + u) * w + (j + v)] += kp[u * KS + v] * g;
+            }
+          }
+        }
+      }
+    }
+  }
+}
+
+void forward_sample(const Dims& d, const float* xi, const float* k1,
+                    const float* bk1, const float* k2, const float* bk2,
+                    const float* fw, const float* fb, float* a1, float* p1,
+                    int32_t* arg1, float* a2, float* p2, int32_t* arg2,
+                    float* logits) {
+  conv_relu_fwd(xi, d.Cin, d.H, d.W, k1, bk1, d.c1, a1, d.hc1, d.wc1);
+  pool_fwd(a1, d.c1, d.hc1, d.wc1, p1, arg1, d.hp1, d.wp1);
+  conv_relu_fwd(p1, d.c1, d.hp1, d.wp1, k2, bk2, d.c2, a2, d.hc2, d.wc2);
+  pool_fwd(a2, d.c2, d.hc2, d.wc2, p2, arg2, d.hp2, d.wp2);
+  for (int64_t c = 0; c < d.classes; ++c) {
+    float acc = fb[c];
+    for (int64_t k = 0; k < d.fc_in; ++k)
+      acc += p2[k] * fw[k * d.classes + c];
+    logits[c] = acc;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+typedef void (*ft_progress_cb)(int epoch, float loss, float acc);
+
+float ft_train_lenet(const float* x, const int32_t* y, int64_t n, int64_t H,
+                     int64_t W, int64_t Cin, int64_t c1, int64_t c2,
+                     int64_t classes, float* k1, float* bk1, float* k2,
+                     float* bk2, float* fw, float* fb, int64_t epochs,
+                     int64_t batch, float lr, float momentum, uint64_t seed,
+                     ft_progress_cb progress) {
+  const Dims d = make_dims(H, W, Cin, c1, c2, classes);
+  if (d.hp2 <= 0 || d.wp2 <= 0) return -1.f;
+
+  // activations / grads for one sample at a time; grads accumulate over
+  // the minibatch then one momentum-SGD step per batch
+  std::vector<float> a1(d.c1 * d.hc1 * d.wc1), p1(d.c1 * d.hp1 * d.wp1);
+  std::vector<float> a2(d.c2 * d.hc2 * d.wc2), p2(d.fc_in);
+  std::vector<int32_t> arg1(d.c1 * d.hp1 * d.wp1), arg2(d.fc_in);
+  std::vector<float> logits(classes), probs(classes);
+  std::vector<float> g_p2(d.fc_in), g_a2(d.c2 * d.hc2 * d.wc2);
+  std::vector<float> g_p1(d.c1 * d.hp1 * d.wp1),
+      g_a1(d.c1 * d.hc1 * d.wc1);
+  const int64_t nk1 = c1 * Cin * KS * KS, nk2 = c2 * c1 * KS * KS;
+  const int64_t nfw = d.fc_in * classes;
+  std::vector<float> dk1(nk1), dbk1(c1), dk2(nk2), dbk2(c2), dfw(nfw),
+      dfb(classes);
+  std::vector<float> vk1(nk1, 0.f), vbk1(c1, 0.f), vk2(nk2, 0.f),
+      vbk2(c2, 0.f), vfw(nfw, 0.f), vfb(classes, 0.f);
+
+  std::vector<int64_t> order(n);
+  for (int64_t i = 0; i < n; ++i) order[i] = i;
+  std::mt19937_64 rng(seed);
+  const int64_t sample_sz = Cin * H * W;
+
+  float epoch_loss = 0.f;
+  for (int64_t ep = 0; ep < epochs; ++ep) {
+    std::shuffle(order.begin(), order.end(), rng);
+    epoch_loss = 0.f;
+    int64_t correct = 0, seen = 0;
+    for (int64_t s = 0; s + batch <= n; s += batch) {
+      std::fill(dk1.begin(), dk1.end(), 0.f);
+      std::fill(dbk1.begin(), dbk1.end(), 0.f);
+      std::fill(dk2.begin(), dk2.end(), 0.f);
+      std::fill(dbk2.begin(), dbk2.end(), 0.f);
+      std::fill(dfw.begin(), dfw.end(), 0.f);
+      std::fill(dfb.begin(), dfb.end(), 0.f);
+
+      for (int64_t b = 0; b < batch; ++b) {
+        const float* xi = x + order[s + b] * sample_sz;
+        const int32_t yi = y[order[s + b]];
+        forward_sample(d, xi, k1, bk1, k2, bk2, fw, fb, a1.data(),
+                       p1.data(), arg1.data(), a2.data(), p2.data(),
+                       arg2.data(), logits.data());
+        float mx = logits[0];
+        for (int64_t c = 1; c < classes; ++c) mx = std::max(mx, logits[c]);
+        float z = 0.f;
+        for (int64_t c = 0; c < classes; ++c) {
+          probs[c] = std::exp(logits[c] - mx);
+          z += probs[c];
+        }
+        int64_t am = 0;
+        for (int64_t c = 0; c < classes; ++c) {
+          probs[c] /= z;
+          if (probs[c] > probs[am]) am = c;
+        }
+        epoch_loss += -std::log(std::max(probs[yi], 1e-12f));
+        if (am == yi) ++correct;
+        ++seen;
+
+        // fc backward (grad scaled by 1/batch)
+        for (int64_t k = 0; k < d.fc_in; ++k) g_p2[k] = 0.f;
+        for (int64_t c = 0; c < classes; ++c) {
+          float g = (probs[c] - (c == yi ? 1.f : 0.f)) / batch;
+          dfb[c] += g;
+          for (int64_t k = 0; k < d.fc_in; ++k) {
+            dfw[k * classes + c] += p2[k] * g;
+            g_p2[k] += fw[k * classes + c] * g;
+          }
+        }
+        // pool2 -> conv2 (relu mask: a2 == 0 means pre-relu <= 0)
+        pool_bwd(g_p2.data(), arg2.data(), d.fc_in, g_a2.data(),
+                 d.c2 * d.hc2 * d.wc2);
+        for (int64_t i = 0; i < d.c2 * d.hc2 * d.wc2; ++i)
+          if (a2[i] <= 0.f) g_a2[i] = 0.f;
+        conv_bwd(p1.data(), d.c1, d.hp1, d.wp1, k2, d.c2, g_a2.data(),
+                 d.hc2, d.wc2, dk2.data(), dbk2.data(), g_p1.data());
+        // pool1 -> conv1
+        pool_bwd(g_p1.data(), arg1.data(), d.c1 * d.hp1 * d.wp1,
+                 g_a1.data(), d.c1 * d.hc1 * d.wc1);
+        for (int64_t i = 0; i < d.c1 * d.hc1 * d.wc1; ++i)
+          if (a1[i] <= 0.f) g_a1[i] = 0.f;
+        conv_bwd(xi, Cin, H, W, k1, d.c1, g_a1.data(), d.hc1, d.wc1,
+                 dk1.data(), dbk1.data(), nullptr);
+      }
+
+      auto sgd = [lr, momentum](float* w, float* v, const float* g,
+                                int64_t m) {
+        for (int64_t i = 0; i < m; ++i) {
+          v[i] = momentum * v[i] + g[i];
+          w[i] -= lr * v[i];
+        }
+      };
+      sgd(k1, vk1.data(), dk1.data(), nk1);
+      sgd(bk1, vbk1.data(), dbk1.data(), c1);
+      sgd(k2, vk2.data(), dk2.data(), nk2);
+      sgd(bk2, vbk2.data(), dbk2.data(), c2);
+      sgd(fw, vfw.data(), dfw.data(), nfw);
+      sgd(fb, vfb.data(), dfb.data(), classes);
+    }
+    epoch_loss = seen > 0 ? epoch_loss / seen : 0.f;
+    if (progress)
+      progress(static_cast<int>(ep), epoch_loss,
+               seen > 0 ? static_cast<float>(correct) / seen : 0.f);
+  }
+  return epoch_loss;
+}
+
+float ft_eval_lenet(const float* x, const int32_t* y, int64_t n, int64_t H,
+                    int64_t W, int64_t Cin, int64_t c1, int64_t c2,
+                    int64_t classes, const float* k1, const float* bk1,
+                    const float* k2, const float* bk2, const float* fw,
+                    const float* fb, float* loss_out) {
+  const Dims d = make_dims(H, W, Cin, c1, c2, classes);
+  std::vector<float> a1(d.c1 * d.hc1 * d.wc1), p1(d.c1 * d.hp1 * d.wp1);
+  std::vector<float> a2(d.c2 * d.hc2 * d.wc2), p2(d.fc_in);
+  std::vector<int32_t> arg1(d.c1 * d.hp1 * d.wp1), arg2(d.fc_in);
+  std::vector<float> logits(classes);
+  const int64_t sample_sz = Cin * H * W;
+  int64_t correct = 0;
+  float loss = 0.f;
+  for (int64_t i = 0; i < n; ++i) {
+    forward_sample(d, x + i * sample_sz, k1, bk1, k2, bk2, fw, fb,
+                   a1.data(), p1.data(), arg1.data(), a2.data(), p2.data(),
+                   arg2.data(), logits.data());
+    float mx = logits[0];
+    for (int64_t c = 1; c < classes; ++c) mx = std::max(mx, logits[c]);
+    float z = 0.f;
+    for (int64_t c = 0; c < classes; ++c) z += std::exp(logits[c] - mx);
+    loss += -(logits[y[i]] - mx - std::log(z));
+    int64_t am = 0;
+    for (int64_t c = 1; c < classes; ++c)
+      if (logits[c] > logits[am]) am = c;
+    if (am == y[i]) ++correct;
+  }
+  if (loss_out) *loss_out = n > 0 ? loss / n : 0.f;
+  return n > 0 ? static_cast<float>(correct) / n : 0.f;
+}
+
+}  // extern "C"

@@ -64,3 +64,60 @@ def test_native_edge_clients_over_mqtt(args_factory, tmp_path):
     m = aggregator.metrics_history[-1]
     assert np.isfinite(m["test_loss"])
     assert m["test_acc"] > 0.3  # native LR on synthetic logistic data learns
+
+
+def test_native_conv_edge_clients_over_mqtt(args_factory, tmp_path):
+    """The same wire schema carries CONV models: native C++ LeNet clients
+    federate over MQTT+object-store (closes the round-1 gap where the
+    cross-device plane was MLP-only)."""
+    import fedml_tpu
+    from fedml_tpu.core.alg_frame.server_aggregator import ServerAggregator
+    from fedml_tpu.cross_device.edge_client import EdgeClientManager
+    from fedml_tpu.cross_silo.server.fedml_aggregator import FedMLAggregator
+    from fedml_tpu.cross_silo.server.fedml_server_manager import (
+        FedMLServerManager,
+    )
+    from fedml_tpu.native import bindings
+    from fedml_tpu.native.native_trainer import NativeClientTrainer
+
+    n_clients = 2
+    args = fedml_tpu.init(args_factory(
+        training_type="cross_device", dataset="mnist", model="cnn",
+        native_model="lenet", client_num_in_total=n_clients,
+        client_num_per_round=n_clients, comm_round=2, data_scale=0.1,
+        learning_rate=0.05, momentum=0.9, run_id="edge-conv",
+        object_store_dir=str(tmp_path)))
+    dataset = fedml_tpu.data.load(args)
+    bundle = fedml_tpu.model.create(args, dataset[-1])
+
+    class EdgeServerAggregator(ServerAggregator):
+        def __init__(self, bundle, args):
+            super().__init__(bundle, args)
+            self._t = NativeClientTrainer(bundle, args)
+
+        def test(self, test_data, device=None, args=None):
+            self._t.params = {k: np.asarray(v)
+                              for k, v in self.params.items()}
+            return self._t.test(test_data)
+
+    d = int(np.prod(dataset[2][0].shape[1:]))
+    agg_impl = EdgeServerAggregator(bundle, args)
+    agg_impl.set_model_params(
+        bindings.init_lenet_weights(d, dataset[-1], seed=0))
+    aggregator = FedMLAggregator(args, agg_impl, dataset[3])
+    server = FedMLServerManager(args, aggregator, rank=0,
+                                client_num=n_clients, backend="MQTT_S3")
+    clients = [EdgeClientManager(args, bundle, dataset, rank, n_clients + 1,
+                                 backend="MQTT_S3")
+               for rank in (1, 2)]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    server.run()
+    for t in threads:
+        t.join(timeout=30)
+    assert aggregator.metrics_history, "server never evaluated"
+    m = aggregator.metrics_history[-1]
+    assert np.isfinite(m["test_loss"])
+    # conv kernels really traveled the wire
+    assert "k1" in agg_impl.params and agg_impl.params["k1"].size > 0
