@@ -27,13 +27,19 @@ _STOP = object()
 
 class MpiCommManager(BaseCommunicationManager):
     def __init__(self, args: Any, rank: int = 0, size: int = 0) -> None:
-        try:
-            from mpi4py import MPI  # type: ignore
-        except ImportError as e:
-            raise NotImplementedError(
-                "MPI backend requires mpi4py (not in this image); use the "
-                "INPROC or GRPC backend, or register a custom backend") from e
-        self.comm = getattr(args, "comm", None) or MPI.COMM_WORLD
+        comm = getattr(args, "comm", None)
+        if comm is None:
+            # import gate: only reach for mpi4py when no communicator was
+            # injected (tests inject a fake comm; clusters pass COMM_WORLD)
+            try:
+                from mpi4py import MPI  # type: ignore
+            except ImportError as e:
+                raise NotImplementedError(
+                    "MPI backend requires mpi4py (not in this image); use "
+                    "the INPROC or GRPC backend, or register a custom "
+                    "backend") from e
+            comm = MPI.COMM_WORLD
+        self.comm = comm
         self.rank = int(rank or self.comm.Get_rank())
         self.size = int(size or self.comm.Get_size())
         self._observers: List[Observer] = []

@@ -1,0 +1,66 @@
+"""One OS process of a real cross-silo run over gRPC (server or client).
+
+Mirrors the reference's multi-process smoke
+(`/root/reference/python/tests/cross-silo/run_cross_silo.sh`: server + 2
+clients as separate local processes).  tests/test_multiprocess.py spawns
+``--rank 0`` (server) and ``--rank 1/2`` (clients); rank 0 prints
+``FINAL_METRICS {...}`` on success.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--rank", type=int, required=True)
+    p.add_argument("--port", type=int, default=21890)
+    p.add_argument("--rounds", type=int, default=2)
+    cli = p.parse_args()
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import fedml_tpu
+    from fedml_tpu.cross_silo.runner import init_client, init_server
+
+    args = fedml_tpu.init(fedml_tpu.Config(
+        training_type="cross_silo",
+        backend="GRPC",
+        dataset="mnist", model="lr",
+        data_scale=0.1,
+        client_num_in_total=2, client_num_per_round=2,
+        comm_round=cli.rounds, epochs=1, batch_size=16,
+        learning_rate=0.05, frequency_of_the_test=1,
+        grpc_base_port=cli.port,
+        run_id="multiproc_smoke",
+        random_seed=0,
+        enable_tracking=False,
+        compute_dtype="float32",
+    ))
+    dataset = fedml_tpu.data.load(args)
+    bundle = fedml_tpu.model.create(args, dataset[-1])
+
+    if cli.rank == 0:
+        server = init_server(args, dataset, bundle, backend="GRPC")
+        server.run()
+        m = server.aggregator.metrics_history[-1]
+        print("FINAL_METRICS " + json.dumps(
+            {k: float(v) for k, v in m.items()}), flush=True)
+    else:
+        client = init_client(args, dataset, bundle, cli.rank,
+                             backend="GRPC")
+        client.run()
+        print(f"CLIENT_DONE {cli.rank}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""One process of a 2-process jax.distributed mesh smoke (CPU backend).
+
+Each process contributes its local CPU device(s) to a global mesh; the test
+checks a cross-process psum sees every process's contribution — the
+multi-host bring-up path `fedml_tpu.init` uses on real TPU pods.
+"""
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--pid", type=int, required=True)
+    p.add_argument("--nprocs", type=int, default=2)
+    p.add_argument("--coord", default="127.0.0.1:21977")
+    cli = p.parse_args()
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=cli.coord,
+                               num_processes=cli.nprocs,
+                               process_id=cli.pid)
+
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    assert len(devs) >= cli.nprocs, devs
+    mesh = Mesh(devs[:cli.nprocs], ("hosts",))
+    sharding = NamedSharding(mesh, P("hosts"))
+
+    # each process owns one shard carrying (pid+1); global sum must see both
+    local = jnp.full((1,), float(cli.pid + 1))
+    garr = jax.make_array_from_single_device_arrays(
+        (cli.nprocs,), sharding,
+        [jax.device_put(local, jax.local_devices()[0])])
+
+    @jax.jit
+    def total(x):
+        return jnp.sum(x)
+
+    out = total(garr)
+    expect = sum(range(1, cli.nprocs + 1))
+    assert float(out) == float(expect), (float(out), expect)
+    print(f"JAXDIST_OK pid={cli.pid} sum={float(out)}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

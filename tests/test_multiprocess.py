@@ -1,0 +1,143 @@
+"""Real multi-process integration (VERDICT round-1 item 5): server + 2
+clients as OS subprocesses over gRPC (reference
+`tests/cross-silo/run_cross_silo.sh` capability), a 2-process
+jax.distributed mesh smoke, and the MPI comm manager's logic driven
+through an injected communicator (mpi4py absent in this image — the
+import gate stays)."""
+
+import json
+import os
+import queue
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def _spawn(script, extra, env_extra=None):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)   # single-device per process
+    env.update(env_extra or {})
+    return subprocess.Popen(
+        [sys.executable, os.path.join(HERE, "multiproc", script)] + extra,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env)
+
+
+@pytest.mark.slow
+def test_cross_silo_grpc_three_os_processes():
+    port = 21890
+    server = _spawn("cross_silo_node.py", ["--rank", "0",
+                                           "--port", str(port)])
+    time.sleep(2.0)  # server's gRPC endpoint up before clients dial
+    clients = [_spawn("cross_silo_node.py", ["--rank", str(r),
+                                             "--port", str(port)])
+               for r in (1, 2)]
+    outs = {}
+    try:
+        for name, proc in [("server", server), ("c1", clients[0]),
+                           ("c2", clients[1])]:
+            out, _ = proc.communicate(timeout=300)
+            outs[name] = out
+            assert proc.returncode == 0, f"{name} failed:\n{out[-3000:]}"
+    finally:
+        for proc in [server] + clients:
+            if proc.poll() is None:
+                proc.kill()
+    final = [ln for ln in outs["server"].splitlines()
+             if ln.startswith("FINAL_METRICS ")]
+    assert final, outs["server"][-2000:]
+    metrics = json.loads(final[-1].split(" ", 1)[1])
+    assert np.isfinite(metrics["test_loss"])
+    assert "CLIENT_DONE 1" in outs["c1"]
+    assert "CLIENT_DONE 2" in outs["c2"]
+
+
+@pytest.mark.slow
+def test_jax_distributed_two_process_mesh():
+    procs = [_spawn("jaxdist_node.py", ["--pid", str(i), "--nprocs", "2"])
+             for i in range(2)]
+    outs = []
+    try:
+        for proc in procs:
+            out, _ = proc.communicate(timeout=300)
+            outs.append(out)
+            assert proc.returncode == 0, out[-3000:]
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+    assert all("JAXDIST_OK" in o for o in outs), outs
+
+
+class _FakeComm:
+    """mpi4py-communicator shim backed by per-rank queues (send/recv only,
+    what MpiCommManager uses)."""
+
+    def __init__(self, queues, rank):
+        self.queues = queues
+        self.rank = rank
+
+    def Get_rank(self):
+        return self.rank
+
+    def Get_size(self):
+        return len(self.queues)
+
+    def send(self, obj, dest):
+        self.queues[dest].put(obj)
+
+    def recv(self):
+        return self.queues[self.rank].get()
+
+
+def test_mpi_comm_manager_logic_with_injected_comm(args_factory):
+    from fedml_tpu.core.distributed.communication.message import Message
+    from fedml_tpu.core.distributed.communication.mpi import MpiCommManager
+
+    queues = {0: queue.Queue(), 1: queue.Queue()}
+    args0 = args_factory()
+    args0.comm = _FakeComm(queues, 0)
+    args1 = args_factory()
+    args1.comm = _FakeComm(queues, 1)
+    m0 = MpiCommManager(args=args0, rank=0, size=2)
+    m1 = MpiCommManager(args=args1, rank=1, size=2)
+
+    got = []
+
+    class Obs:
+        def receive_message(self, msg_type, msg):
+            got.append((msg_type, msg.get_sender_id(),
+                        np.asarray(msg.get_params()["w"])))
+            m1.stop_receive_message()
+
+    m1.add_observer(Obs())
+    t = threading.Thread(target=m1.handle_receive_message, daemon=True)
+    t.start()
+
+    msg = Message(type="sync", sender_id=0, receiver_id=1)
+    msg.add_params("w", np.arange(4, dtype=np.float32))
+    m0.send_message(msg)
+    t.join(timeout=30)
+    assert got and got[0][0] == "sync"
+    np.testing.assert_array_equal(got[0][2], np.arange(4, dtype=np.float32))
+
+
+def test_mpi_import_gate_without_mpi4py(args_factory):
+    """Without an injected comm and without mpi4py, the gate names the
+    alternatives instead of crashing deep in construction."""
+    try:
+        import mpi4py  # noqa: F401
+        pytest.skip("mpi4py present; gate not reachable")
+    except ImportError:
+        pass
+    from fedml_tpu.core.distributed.communication.mpi import MpiCommManager
+
+    with pytest.raises(NotImplementedError, match="INPROC or GRPC"):
+        MpiCommManager(args=args_factory(), rank=0, size=2)
