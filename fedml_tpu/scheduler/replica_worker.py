@@ -1,0 +1,28 @@
+"""One serving replica process: load a model card, serve /predict + /ready.
+
+Spawned by `replica_manager.ReplicaProcessManager` (the reference launches
+containers from `device_model_deployment.py`; here a replica is a plain OS
+process, which is what a TPU host runs anyway).
+"""
+
+import argparse
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--card", required=True)
+    p.add_argument("--root", default=None)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, required=True)
+    cli = p.parse_args()
+
+    from .model_cards import ModelCardRegistry, _resolve_predictor
+    from ..serving.fedml_inference_runner import FedMLInferenceRunner
+
+    registry = ModelCardRegistry(root=cli.root)
+    predictor = _resolve_predictor(registry.get(cli.card))
+    FedMLInferenceRunner(predictor, host=cli.host, port=cli.port).run()
+
+
+if __name__ == "__main__":
+    main()
