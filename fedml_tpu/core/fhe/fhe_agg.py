@@ -45,7 +45,8 @@ class FedMLFHE:
 
     def __init__(self) -> None:
         self.is_enabled = False
-        self.codec: Optional[PaillierCodec] = None
+        self.codec = None          # PaillierCodec | RlweCodec
+        self.scheme = "rlwe"
         self._priv = None
         self._dec_cache = None  # (EncryptedTree, plaintext) identity cache
 
@@ -96,9 +97,16 @@ class FedMLFHE:
         cross_silo = str(getattr(args, "training_type", "simulation")
                          ).lower() == "cross_silo"
         seed = getattr(args, "fhe_key_seed", None)
+        self.scheme = str(getattr(args, "fhe_scheme", "rlwe")
+                          or "rlwe").lower()
+        if self.scheme not in ("rlwe", "paillier"):
+            # validate BEFORE the keyless-server early return so a typo'd
+            # scheme fails on every role, not just client silos
+            raise ValueError(
+                f"unknown fhe_scheme {self.scheme!r} (rlwe | paillier)")
         if cross_silo and str(getattr(args, "role", "server")) == "server":
-            # the aggregator works only under the modulus carried by each
-            # ciphertext — it must NOT derive (or be able to derive) the key
+            # the aggregator works only under the modulus/key-id carried by
+            # each ciphertext — it must NOT derive the key
             self.is_enabled = True
             return
         if cross_silo and seed is None:
@@ -106,14 +114,22 @@ class FedMLFHE:
                 "cross-silo FHE requires fhe_key_seed (a secret pre-shared "
                 "among silos, never given to the server) so all clients "
                 "derive the same keypair")
-        bits = int(getattr(args, "fhe_key_size", 1024) or 1024)
-        pub, priv = keygen(bits, seed=None if seed is None else int(seed))
-        self.codec = PaillierCodec(
-            pub,
-            frac_bits=int(getattr(args, "fhe_frac_bits", 16) or 16),
-            int_bits=int(getattr(args, "fhe_int_bits", 8) or 8),
-        )
-        self._priv = priv
+        frac = int(getattr(args, "fhe_frac_bits", 16) or 16)
+        ints = int(getattr(args, "fhe_int_bits", 8) or 8)
+        if self.scheme == "paillier":
+            bits = int(getattr(args, "fhe_key_size", 1024) or 1024)
+            pub, priv = keygen(bits, seed=None if seed is None else int(seed))
+            self.codec = PaillierCodec(pub, frac_bits=frac, int_bits=ints)
+            self._priv = priv
+        elif self.scheme == "rlwe":
+            # default: the lattice scheme — ~100x faster at model scale
+            # (benchmarks/fhe_bench.py); Paillier stays for audit parity
+            from .rlwe import RlweCodec
+            from .rlwe import keygen as rlwe_keygen
+
+            key = rlwe_keygen(int(seed) if seed is not None else 0xFED)
+            self.codec = RlweCodec(key, frac_bits=frac, int_bits=ints)
+            self._priv = key
         self.is_enabled = True
 
     def is_fhe_enabled(self) -> bool:
@@ -160,9 +176,17 @@ class FedMLFHE:
         first = raw_client_list[0][1]
         codec = self.codec
         if codec is None:
-            from .paillier import PaillierPublicKey
+            # keyless aggregator: rebuild a codec from the public material
+            # the ciphertexts carry (Paillier modulus / RLWE key id)
+            leaf0 = first.leaves[0]
+            if hasattr(leaf0, "key_id"):
+                from .rlwe import RlweCodec
 
-            codec = PaillierCodec(PaillierPublicKey(first.leaves[0].n))
+                codec = RlweCodec(key_id=leaf0.key_id)
+            else:
+                from .paillier import PaillierPublicKey
+
+                codec = PaillierCodec(PaillierPublicKey(leaf0.n))
         total = float(sum(n for n, _ in raw_client_list))
         w_int = [codec.quantize_weight(n / total)
                  for n, _ in raw_client_list]

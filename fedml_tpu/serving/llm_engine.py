@@ -19,7 +19,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, TimeoutError as FuturesTimeoutError
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -133,8 +133,16 @@ class BatchedLLMEngine:
     def generate(self, prompt_ids, max_new: int = 20,
                  temperature: float = 0.0, timeout: float = 120.0,
                  top_k: int = 0, top_p: float = 1.0) -> np.ndarray:
-        return self.submit(prompt_ids, max_new, temperature, top_k,
-                           top_p).result(timeout)
+        fut = self.submit(prompt_ids, max_new, temperature, top_k, top_p)
+        try:
+            return fut.result(timeout)
+        except (TimeoutError, FuturesTimeoutError):
+            # free the slot: a timed-out request must not keep generating
+            # into an orphaned future
+            req = getattr(fut, "request", None)
+            if req is not None:
+                req.cancel()
+            raise
 
     def stop(self) -> None:
         self._stop.set()
@@ -265,9 +273,10 @@ class LLMEnginePredictor:
         req = getattr(fut, "request", None)
         try:
             out = fut.result(timeout)
-        except TimeoutError:
+        except (TimeoutError, FuturesTimeoutError):
             # free the slot — otherwise timed-out requests keep generating
-            # into orphaned futures until they starve live traffic
+            # into orphaned futures until they starve live traffic.  Both
+            # names: futures.TimeoutError only aliases the builtin on 3.11+
             if req is not None:
                 req.cancel()
             raise
@@ -386,8 +395,16 @@ class KVCacheLLMEngine:
     def generate(self, prompt_ids, max_new: int = 20,
                  temperature: float = 0.0, timeout: float = 120.0,
                  top_k: int = 0, top_p: float = 1.0) -> np.ndarray:
-        return self.submit(prompt_ids, max_new, temperature, top_k,
-                           top_p).result(timeout)
+        fut = self.submit(prompt_ids, max_new, temperature, top_k, top_p)
+        try:
+            return fut.result(timeout)
+        except (TimeoutError, FuturesTimeoutError):
+            # free the slot: a timed-out request must not keep generating
+            # into an orphaned future
+            req = getattr(fut, "request", None)
+            if req is not None:
+                req.cancel()
+            raise
 
     def stop(self) -> None:
         self._stop.set()

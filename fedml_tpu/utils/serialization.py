@@ -58,12 +58,21 @@ def _encode_encrypted_tree(enc: Any, leaves: List[np.ndarray]) -> Any:
         "skel": _flatten_struct(skeleton, leaves),
         "shapes": [list(s) for s in enc.shapes],
         "dtypes": [str(np.dtype(d)) for d in enc.dtypes],
-        "leaves": [{
+        "leaves": [_encode_ct(ct, leaves) for ct in enc.leaves],
+    }
+
+
+def _encode_ct(ct: Any, leaves: List[np.ndarray]) -> Any:
+    if hasattr(ct, "key_id"):     # RLWE: two int64 arrays ride as leaves
+        leaves.append(np.asarray(ct.a))
+        leaves.append(np.asarray(ct.b))
+        return {"kind": "rlwe", "size": ct.size, "wt": ct.weight_total,
+                "kid": int(ct.key_id), "ai": len(leaves) - 2,
+                "bi": len(leaves) - 1}
+    return {"kind": "paillier",
             "size": ct.size, "sb": ct.slot_bits, "k": ct.slots_per_ct,
             "wt": ct.weight_total, "n": hex(ct.n),
-            "c": [hex(c) for c in ct.ciphertexts],
-        } for ct in enc.leaves],
-    }
+            "c": [hex(c) for c in ct.ciphertexts]}
 
 
 def _decode_encrypted_tree(spec: Any, leaves: List[np.ndarray]) -> Any:
@@ -74,10 +83,20 @@ def _decode_encrypted_tree(spec: Any, leaves: List[np.ndarray]) -> Any:
 
     skeleton = _unflatten_struct(spec["skel"], leaves)
     treedef = jax.tree_util.tree_structure(skeleton)
-    cts = [PackedCiphertext([int(c, 16) for c in m["c"]], int(m["size"]),
-                            int(m["sb"]), int(m["k"]), int(m["wt"]),
-                            int(m["n"], 16))
-           for m in spec["leaves"]]
+    cts = []
+    for m in spec["leaves"]:
+        if m.get("kind") == "rlwe":
+            from ..core.fhe.rlwe import RlwePackedCiphertext
+
+            cts.append(RlwePackedCiphertext(
+                np.asarray(leaves[int(m["ai"])], np.int64),
+                np.asarray(leaves[int(m["bi"])], np.int64),
+                int(m["size"]), int(m["wt"]), int(m["kid"])))
+        else:
+            cts.append(PackedCiphertext(
+                [int(c, 16) for c in m["c"]], int(m["size"]),
+                int(m["sb"]), int(m["k"]), int(m["wt"]),
+                int(m["n"], 16)))
     return EncryptedTree(treedef, [tuple(s) for s in spec["shapes"]],
                          [np.dtype(d) for d in spec["dtypes"]], cts)
 

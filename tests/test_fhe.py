@@ -138,3 +138,68 @@ def test_sp_simulation_with_fhe_end_to_end():
         assert metrics["test_acc"] >= 0.0
     finally:
         FedMLFHE.get_instance().init(fedml_tpu.Config())
+
+
+def test_rlwe_codec_weighted_sum_exact():
+    """RLWE weighted aggregation round-trips with fp32-level error and the
+    keyless-server contract (key-id mismatch raises)."""
+    from fedml_tpu.core.fhe.rlwe import RlweCodec, keygen
+
+    key = keygen(42)
+    codec = RlweCodec(key)
+    rng = np.random.RandomState(0)
+    v1 = rng.randn(10_000).astype(np.float32)
+    v2 = rng.randn(10_000).astype(np.float32)
+    e1, e2 = codec.encrypt(v1), codec.encrypt(v2)
+    w1 = codec.quantize_weight(0.25)
+    w2 = codec.quantize_weight(0.75)
+    agg = codec.weighted_sum([(w1, e1), (w2, e2)])
+    out = codec.decrypt(key, agg)
+    expect = (w1 * v1.astype(np.float64) + w2 * v2) / (w1 + w2)
+    np.testing.assert_allclose(out, expect, atol=1e-3)
+
+    other = keygen(43)
+    with pytest.raises(ValueError, match="fhe_key_seed"):
+        codec.decrypt(other, agg)
+    e_other = RlweCodec(other).encrypt(v1)
+    with pytest.raises(ValueError, match="different keys"):
+        RlweCodec.add(e1, e_other)
+
+
+def test_rlwe_scheme_end_to_end_sp_round(args_factory):
+    """enable_fhe with the default rlwe scheme trains through the SP plane
+    hooks (encrypted upload, ciphertext-only aggregation, decrypt-on-
+    download) and still converges."""
+    import fedml_tpu
+    from fedml_tpu.runner import FedMLRunner
+
+    args = fedml_tpu.init(args_factory(
+        enable_fhe=True, fhe_scheme="rlwe", backend="sp",
+        client_num_in_total=3, client_num_per_round=3, comm_round=3,
+        data_scale=0.3))
+    device = fedml_tpu.device.get_device(args)
+    dataset = fedml_tpu.data.load(args)
+    bundle = fedml_tpu.model.create(args, dataset[-1])
+    m = FedMLRunner(args, device, dataset, bundle).run()
+    assert np.isfinite(m["test_loss"])
+    assert m["test_acc"] > 0.2
+
+
+def test_rlwe_model_scale_speed():
+    """The practicality bar the VERDICT set: a 1M-param encrypted round
+    (enc + 3-client agg + dec) finishes in well under 60 s."""
+    import time
+
+    from fedml_tpu.core.fhe.rlwe import RlweCodec, keygen
+
+    key = keygen(7)
+    codec = RlweCodec(key)
+    vec = np.random.RandomState(1).randn(1_000_000).astype(np.float32) * 0.1
+    t0 = time.time()
+    encs = [codec.encrypt(vec) for _ in range(3)]
+    w = codec.quantize_weight(1 / 3)
+    agg = codec.weighted_sum([(w, e) for e in encs])
+    out = codec.decrypt(key, agg)
+    elapsed = time.time() - t0
+    assert np.abs(out - vec).max() < 1e-3
+    assert elapsed < 60, f"1M-param round took {elapsed:.1f}s"
