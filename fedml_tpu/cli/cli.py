@@ -333,6 +333,42 @@ def model_package(name: str, dest: str) -> None:
     click.echo(api.model_package(name, dest))
 
 
+@model.command("export")
+@click.argument("out_dir", type=click.Path())
+@click.option("--model", "model_name", required=True,
+              help="zoo architecture, e.g. resnet56")
+@click.option("--dataset", default="cifar10",
+              help="determines the input contract")
+@click.option("--checkpoint", default=None, type=click.Path(exists=True),
+              help="round checkpoint dir to export (default: fresh init)")
+@click.option("--batch-size", default=8)
+def model_export(out_dir: str, model_name: str, dataset: str,
+                 checkpoint: str, batch_size: int) -> None:
+    """Export a trained model to a portable StableHLO serving artifact
+    (the reference deploy pipeline's convert_model_to_onnx equivalent).
+    The artifact deploys via `fedml model create/deploy` with no model
+    code."""
+    import jax
+
+    import fedml_tpu
+    from ..serving.export import export_model
+
+    args = fedml_tpu.Config(model=model_name, dataset=dataset,
+                            compute_dtype="float32")
+    bundle = fedml_tpu.model.create(args)
+    variables = bundle.init_variables(jax.random.PRNGKey(0))
+    if checkpoint:
+        from ..utils.checkpoint import RoundCheckpointer
+
+        state = RoundCheckpointer(checkpoint).restore()
+        if state is None:
+            raise click.ClickException(f"no checkpoint under {checkpoint}")
+        variables = state["global_vars"]
+    path = export_model(bundle, variables, out_dir, batch_size=batch_size)
+    click.echo(json.dumps({"artifact": path,
+                           "files": sorted(os.listdir(path))}))
+
+
 @model.command("deploy")
 @click.argument("name")
 @click.option("--host", default="127.0.0.1")

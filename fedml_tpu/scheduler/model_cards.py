@@ -169,6 +169,7 @@ class ModelCardRegistry:
                predictor: Any = None) -> "Endpoint":
         """Bring up an HTTP endpoint serving this card. Predictor resolution
         order: explicit arg → `predictor.py` in the card (class `Predictor`)
+        → portable StableHLO artifact (`model.stablehlo`, `serving/export`)
         → default npz linear predictor (`model.npz`)."""
         from ..serving.fedml_inference_runner import serve_ephemeral
 
@@ -191,6 +192,13 @@ def _resolve_predictor(card: Dict[str, Any]):
         spec.loader.exec_module(mod)
         return mod.Predictor()
 
+    hlo = os.path.join(card["path"], "model.stablehlo")
+    if os.path.exists(hlo):
+        # portable compiled artifact (the ONNX-equivalent deploy format)
+        from ..serving.export import ExportedPredictor
+
+        return ExportedPredictor(card["path"])
+
     npz = os.path.join(card["path"], "model.npz")
     if os.path.exists(npz):
         from ..serving.fedml_predictor import LinearHeadPredictor
@@ -199,7 +207,8 @@ def _resolve_predictor(card: Dict[str, Any]):
             params = {k: z[k] for k in z.files}
         return LinearHeadPredictor(params)
     raise ValueError(
-        f"card {card['name']!r} has neither predictor.py nor model.npz")
+        f"card {card['name']!r} has none of predictor.py, model.stablehlo, "
+        f"or model.npz")
 
 
 class EndpointDB:

@@ -1,0 +1,102 @@
+"""Portable served-model export — the TPU-era `convert_model_to_onnx`.
+
+Capability parity: the reference's deploy pipeline exports models to ONNX
+for Triton bring-up (`model_scheduler/device_model_deployment.py:839`
+convert_model_to_onnx).  The XLA-native equivalent is a serialized
+StableHLO artifact (`jax.export`): the inference function is traced once
+with the trained params baked in, producing a single self-contained file
+any JAX runtime (CPU/TPU/GPU) can load and call WITHOUT the model's python
+code — exactly the deploy-time decoupling ONNX gives torch.
+
+Artifact layout (a directory, the model-card deploy format):
+    model.stablehlo   serialized jax.export blob (params baked in)
+    export.json       {"input_shape", "input_dtype", "task", "classes"}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from .fedml_predictor import FedMLPredictor
+
+ARTIFACT = "model.stablehlo"
+META = "export.json"
+
+
+def export_model(bundle: Any, variables: Dict[str, Any], out_dir: str,
+                 batch_size: int = 8,
+                 input_shape: Optional[Tuple[int, ...]] = None) -> str:
+    """Trace + serialize the bundle's inference forward with ``variables``
+    baked in; returns the artifact directory."""
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jexport
+
+    shape = tuple(input_shape
+                  or getattr(bundle, "input_shape", None) or ())
+    if not shape:
+        raise ValueError("bundle has no input_shape; pass input_shape=")
+    in_dtype = getattr(bundle, "input_dtype", jnp.float32)
+
+    def infer(x):
+        logits, _ = bundle.apply(variables, x, train=False)
+        return logits
+
+    spec = jax.ShapeDtypeStruct((batch_size,) + shape, in_dtype)
+    # lower for every deploy target, or the artifact only runs on the
+    # export-time backend (the portability contract of the format)
+    exp = jexport.export(jax.jit(infer),
+                         platforms=("cpu", "tpu"))(spec)
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, ARTIFACT), "wb") as f:
+        f.write(exp.serialize())
+    meta = {"input_shape": list(shape),
+            "batch_size": int(batch_size),
+            "input_dtype": str(np.dtype(in_dtype)),
+            "task": str(getattr(bundle, "task", "classification")),
+            "classes": int(getattr(bundle, "num_classes", 0))}
+    with open(os.path.join(out_dir, META), "w") as f:
+        json.dump(meta, f, indent=1)
+    return out_dir
+
+
+class ExportedPredictor(FedMLPredictor):
+    """Serve a StableHLO artifact: no model code, no flax — just the
+    compiled computation (the Triton-container role, in-process)."""
+
+    def __init__(self, artifact_dir: str) -> None:
+        from jax import export as jexport
+
+        with open(os.path.join(artifact_dir, ARTIFACT), "rb") as f:
+            self._exported = jexport.deserialize(bytearray(f.read()))
+        with open(os.path.join(artifact_dir, META)) as f:
+            self.meta = json.load(f)
+        self._batch = int(self.meta.get("batch_size", 8))
+
+    def predict(self, request: Any) -> Any:
+        import jax.numpy as jnp
+
+        x = np.asarray(request["inputs"],
+                       self.meta.get("input_dtype", "float32"))
+        n = x.shape[0]
+        if n == 0:
+            return {"predictions": [], "logits": []}
+        # the export is fixed-batch: short chunks pad up and slice back
+        outs = []
+        for i in range(0, len(x), self._batch):
+            chunk = x[i:i + self._batch]
+            if len(chunk) < self._batch:
+                fill = np.zeros((self._batch - len(chunk),) + x.shape[1:],
+                                x.dtype)
+                chunk = np.concatenate([chunk, fill])
+            outs.append(np.asarray(self._exported.call(jnp.asarray(chunk))))
+        logits = np.concatenate(outs)[:n]
+        return {"predictions": np.argmax(logits, -1).tolist(),
+                "logits": logits.tolist()}
+
+    def ready(self) -> bool:
+        return True
