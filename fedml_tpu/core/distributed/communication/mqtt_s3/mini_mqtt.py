@@ -1,0 +1,354 @@
+"""Minimal MQTT 3.1.1 over TCP — stdlib broker + paho-compatible client.
+
+Capability parity: the reference's MQTT plane runs against a hosted broker
+with paho (`communication/mqtt/mqtt_manager.py`); neither a broker nor
+paho-mqtt exists in this image, which round 1 left as "real-MQTT path
+untested".  This module implements the actual 3.1.1 wire protocol
+(CONNECT with last-will, SUBSCRIBE, PUBLISH QoS0/1 with PUBACK, PING,
+DISCONNECT) so the transport runs over REAL sockets:
+
+* ``MiniMqttBroker`` — in-process TCP broker for tests/single-host runs
+  (exact-match topic routing, per-session last-will fired on abnormal
+  disconnect — the liveness mechanism the reference builds on);
+* ``MiniMqttClient`` — the paho ``Client`` API subset PahoBroker uses
+  (connect / loop_start / subscribe / publish / unsubscribe / will_set /
+  on_message / disconnect), used automatically when paho-mqtt is absent.
+
+Interoperates with real brokers/clients: the frames are standard 3.1.1
+(QoS capped at 1).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import socketserver
+import struct
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+CONNECT, CONNACK, PUBLISH, PUBACK = 1, 2, 3, 4
+PUBREC, PUBREL, PUBCOMP = 5, 6, 7
+SUBSCRIBE, SUBACK, UNSUBSCRIBE, UNSUBACK = 8, 9, 10, 11
+PINGREQ, PINGRESP, DISCONNECT = 12, 13, 14
+
+
+def _encode_len(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        d = n % 128
+        n //= 128
+        out.append(d | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _read_packet(sock: socket.socket) -> Tuple[int, int, bytes]:
+    """→ (type, flags, body); blocks."""
+    h = _read_exact(sock, 1)[0]
+    mult, length = 1, 0
+    while True:
+        d = _read_exact(sock, 1)[0]
+        length += (d & 0x7F) * mult
+        if not (d & 0x80):
+            break
+        mult *= 128
+    body = _read_exact(sock, length) if length else b""
+    return h >> 4, h & 0x0F, body
+
+
+def _mk_packet(ptype: int, flags: int, body: bytes) -> bytes:
+    return bytes([(ptype << 4) | flags]) + _encode_len(len(body)) + body
+
+
+def _mqtt_str(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack(">H", len(b)) + b
+
+
+def _take_str(body: bytes, off: int) -> Tuple[str, int]:
+    n = struct.unpack_from(">H", body, off)[0]
+    return body[off + 2:off + 2 + n].decode(), off + 2 + n
+
+
+# --------------------------------------------------------------- broker
+class _Session:
+    def __init__(self, sock: socket.socket) -> None:
+        self.sock = sock
+        self.client_id = ""
+        self.subs: set = set()
+        self.will: Optional[Tuple[str, bytes]] = None
+        self.lock = threading.Lock()
+        self.graceful = False
+        self.inflight_qos2: Dict[int, Tuple[str, bytes]] = {}
+
+    def send(self, data: bytes) -> None:
+        with self.lock:
+            self.sock.sendall(data)
+
+
+class MiniMqttBroker:
+    """Exact-topic MQTT 3.1.1 broker on a background thread."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._sessions: List[_Session] = []
+        self._lock = threading.Lock()
+        broker = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                broker._serve(self.request)
+
+        self._srv = socketserver.ThreadingTCPServer((host, port), Handler,
+                                                    bind_and_activate=True)
+        self._srv.daemon_threads = True
+        self.host, self.port = self._srv.server_address
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True, name="mini-mqtt-broker")
+        self._thread.start()
+
+    def _serve(self, sock: socket.socket) -> None:
+        sess = _Session(sock)
+        with self._lock:
+            self._sessions.append(sess)
+        try:
+            while True:
+                ptype, flags, body = _read_packet(sock)
+                if ptype == CONNECT:
+                    self._on_connect(sess, body)
+                elif ptype == PUBLISH:
+                    self._on_publish(sess, flags, body)
+                elif ptype == SUBSCRIBE:
+                    self._on_subscribe(sess, body)
+                elif ptype == UNSUBSCRIBE:
+                    pid = struct.unpack_from(">H", body, 0)[0]
+                    off = 2
+                    while off < len(body):
+                        topic, off = _take_str(body, off)
+                        sess.subs.discard(topic)
+                    sess.send(_mk_packet(UNSUBACK, 0, struct.pack(">H", pid)))
+                elif ptype == PUBREL:
+                    # QoS2 completion: release the stashed message
+                    pid = struct.unpack_from(">H", body, 0)[0]
+                    stashed = sess.inflight_qos2.pop(pid, None)
+                    sess.send(_mk_packet(PUBCOMP, 0, struct.pack(">H", pid)))
+                    if stashed is not None:
+                        self._route(*stashed)
+                elif ptype == PINGREQ:
+                    sess.send(_mk_packet(PINGRESP, 0, b""))
+                elif ptype == DISCONNECT:
+                    sess.graceful = True
+                    break
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            with self._lock:
+                if sess in self._sessions:
+                    self._sessions.remove(sess)
+            if sess.will and not sess.graceful:
+                # abnormal drop → fire the last will (liveness signal)
+                self._route(sess.will[0], sess.will[1])
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _on_connect(self, sess: _Session, body: bytes) -> None:
+        off = 0
+        _, off = _take_str(body, off)          # protocol name
+        off += 1                               # level
+        cflags = body[off]
+        off += 1 + 2                           # keepalive
+        sess.client_id, off = _take_str(body, off)
+        if cflags & 0x04:                      # will flag
+            wt, off = _take_str(body, off)
+            n = struct.unpack_from(">H", body, off)[0]
+            wp = body[off + 2:off + 2 + n]
+            off += 2 + n
+            sess.will = (wt, wp)
+        sess.send(_mk_packet(CONNACK, 0, b"\x00\x00"))
+
+    def _on_publish(self, sess: _Session, flags: int, body: bytes) -> None:
+        qos = (flags >> 1) & 0x03
+        topic, off = _take_str(body, 0)
+        if qos == 2:
+            # full PUBREC/PUBREL/PUBCOMP handshake (real paho clients send
+            # QoS2 and stall if answered with a bare PUBACK)
+            pid = struct.unpack_from(">H", body, off)[0]
+            off += 2
+            sess.inflight_qos2[pid] = (topic, body[off:])
+            sess.send(_mk_packet(PUBREC, 0, struct.pack(">H", pid)))
+            return
+        if qos == 1:
+            pid = struct.unpack_from(">H", body, off)[0]
+            off += 2
+            sess.send(_mk_packet(PUBACK, 0, struct.pack(">H", pid)))
+        self._route(topic, body[off:])
+
+    def _on_subscribe(self, sess: _Session, body: bytes) -> None:
+        pid = struct.unpack_from(">H", body, 0)[0]
+        off = 2
+        granted = bytearray()
+        while off < len(body):
+            topic, off = _take_str(body, off)
+            off += 1                           # requested qos
+            sess.subs.add(topic)
+            granted.append(1)
+        sess.send(_mk_packet(SUBACK, 0, struct.pack(">H", pid) + granted))
+
+    def _route(self, topic: str, payload: bytes) -> None:
+        frame = _mk_packet(PUBLISH, 0, _mqtt_str(topic) + payload)  # qos0 out
+        with self._lock:
+            targets = [s for s in self._sessions if topic in s.subs]
+        for s in targets:
+            try:
+                s.send(frame)
+            except OSError:
+                logging.debug("mini-mqtt: drop to dead session %s",
+                              s.client_id)
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+# --------------------------------------------------------------- client
+class _Msg:
+    def __init__(self, topic: str, payload: bytes) -> None:
+        self.topic = topic
+        self.payload = payload
+
+
+class MiniMqttClient:
+    """The paho ``Client`` API subset the transport uses."""
+
+    def __init__(self, client_id: str = "", clean_session: bool = True
+                 ) -> None:
+        self.client_id = client_id or "mini"
+        self.on_message: Optional[Callable] = None
+        self._will: Optional[Tuple[str, bytes]] = None
+        self._sock: Optional[socket.socket] = None
+        self._pid = 0
+        self._lock = threading.Lock()
+        self._reader: Optional[threading.Thread] = None
+        self._keepalive = 60
+        self._closed = threading.Event()
+
+    def will_set(self, topic: str, payload: bytes = b"", qos: int = 0,
+                 retain: bool = False) -> None:
+        self._will = (topic, payload or b"")
+
+    def connect(self, host: str, port: int = 1883,
+                keepalive: int = 60) -> None:
+        self._keepalive = int(keepalive) or 60
+        self._sock = socket.create_connection((host, port), timeout=30)
+        flags = 0x02                                    # clean session
+        payload = _mqtt_str(self.client_id)
+        if self._will:
+            flags |= 0x04 | (1 << 3)                    # will, qos1
+            payload += _mqtt_str(self._will[0])
+            payload += struct.pack(">H", len(self._will[1])) + self._will[1]
+        vh = (_mqtt_str("MQTT") + bytes([4, flags])
+              + struct.pack(">H", keepalive))
+        self._sock.sendall(_mk_packet(CONNECT, 0, vh + payload))
+        ptype, _, body = _read_packet(self._sock)
+        if ptype != CONNACK or body[1] != 0:
+            raise ConnectionError(f"CONNACK refused: {body!r}")
+        self._sock.settimeout(None)
+
+    def loop_start(self) -> None:
+        self._reader = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"mini-mqtt-{self.client_id}")
+        self._reader.start()
+        # keepalive: spec-compliant brokers drop a connection idle past
+        # 1.5x keepalive AND fire its last will — ping at half the window
+        threading.Thread(target=self._ping_loop, daemon=True,
+                         name=f"mini-mqtt-ping-{self.client_id}").start()
+
+    def _ping_loop(self) -> None:
+        interval = max(self._keepalive / 2.0, 1.0)
+        while not self._closed.wait(interval):
+            try:
+                self._send(_mk_packet(PINGREQ, 0, b""))
+            except OSError:
+                return
+
+    def _loop(self) -> None:
+        try:
+            while True:
+                ptype, flags, body = _read_packet(self._sock)
+                if ptype == PUBLISH:
+                    qos = (flags >> 1) & 0x03
+                    topic, off = _take_str(body, 0)
+                    if qos:
+                        pid = struct.unpack_from(">H", body, off)[0]
+                        off += 2
+                        self._send(_mk_packet(PUBACK, 0,
+                                              struct.pack(">H", pid)))
+                    if self.on_message:
+                        self.on_message(self, None, _Msg(topic, body[off:]))
+                # SUBACK/UNSUBACK/PUBACK/PINGRESP need no action here
+        except (ConnectionError, OSError):
+            pass
+
+    def _send(self, data: bytes) -> None:
+        with self._lock:
+            self._sock.sendall(data)
+
+    def _next_pid(self) -> int:
+        self._pid = self._pid % 65535 + 1
+        return self._pid
+
+    def publish(self, topic: str, payload: bytes, qos: int = 0) -> None:
+        qos = min(int(qos), 1)                          # QoS2 → 1
+        body = _mqtt_str(topic)
+        if qos:
+            body += struct.pack(">H", self._next_pid())
+        if isinstance(payload, str):
+            payload = payload.encode()
+        self._send(_mk_packet(PUBLISH, qos << 1, body + bytes(payload)))
+
+    def subscribe(self, topic: str, qos: int = 0) -> None:
+        body = (struct.pack(">H", self._next_pid()) + _mqtt_str(topic)
+                + bytes([min(int(qos), 1)]))
+        self._send(_mk_packet(SUBSCRIBE, 0x02, body))
+
+    def unsubscribe(self, topic: str) -> None:
+        self._send(_mk_packet(UNSUBSCRIBE, 0x02,
+                              struct.pack(">H", self._next_pid())
+                              + _mqtt_str(topic)))
+
+    def loop_stop(self) -> None:
+        pass                                            # reader is daemon
+
+    def disconnect(self) -> None:
+        self._closed.set()
+        try:
+            self._send(_mk_packet(DISCONNECT, 0, b""))
+            self._sock.close()
+        except OSError:
+            pass
+
+    def kill(self) -> None:
+        """Abnormal drop (tests): no DISCONNECT → broker fires the will.
+        shutdown() forces the FIN out even while the reader thread is
+        blocked in recv on the same fd."""
+        self._closed.set()
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -88,23 +88,44 @@ class InProcBroker(Broker):
 
 
 class PahoBroker(Broker):
+    """Real-TCP MQTT transport: paho-mqtt when installed, else the
+    dependency-free `mini_mqtt.MiniMqttClient` (same API subset, standard
+    3.1.1 frames, QoS capped at 1) — so the wire path works in the
+    zero-dependency image too."""
+
     def __init__(self, host: str, port: int, client_id: str,
                  last_will_topic: Optional[str] = None,
                  last_will_payload: Optional[bytes] = None) -> None:
-        try:
-            import paho.mqtt.client as mqtt  # type: ignore
-        except ImportError as e:
-            raise NotImplementedError(
-                "PahoBroker requires paho-mqtt (not in this image); use the "
-                "INPROC broker or a custom Broker") from e
         self._cbs: Dict[str, Callable[[str, bytes], None]] = {}
-        self.client = mqtt.Client(client_id=client_id, clean_session=True)
+        self.client = self._make_client(client_id)
         if last_will_topic:
             self.client.will_set(last_will_topic, last_will_payload or b"",
                                  qos=2)
         self.client.on_message = self._on_message
         self.client.connect(host, port, keepalive=180)
         self.client.loop_start()
+
+    @staticmethod
+    def _make_client(client_id: str):
+        try:
+            import paho.mqtt.client as mqtt  # type: ignore
+        except ImportError:
+            import logging
+
+            from .mini_mqtt import MiniMqttClient
+
+            logging.info(
+                "paho-mqtt not installed: using the built-in MiniMqttClient "
+                "(standard 3.1.1 frames; QoS capped at 1, no auto-reconnect)")
+            return MiniMqttClient(client_id=client_id, clean_session=True)
+        try:
+            # paho-mqtt >= 2.0 requires the callback API version first
+            from paho.mqtt.enums import CallbackAPIVersion  # type: ignore
+
+            return mqtt.Client(CallbackAPIVersion.VERSION1,
+                               client_id=client_id, clean_session=True)
+        except ImportError:
+            return mqtt.Client(client_id=client_id, clean_session=True)
 
     def _on_message(self, client, userdata, msg) -> None:
         cb = self._cbs.get(msg.topic)

@@ -84,7 +84,14 @@ class ParrotAPI:
 
         # ---- device-resident dataset + per-client index matrix ------------
         x_all, y_all = self.train_global
-        self.x_all = jnp.asarray(np.asarray(x_all), bundle.input_dtype)
+        # data_dtype: bfloat16 halves the resident footprint AND the gather
+        # bandwidth for image data (models cast to their compute dtype
+        # anyway); default keeps the bundle's input dtype
+        store_dtype = bundle.input_dtype
+        if str(getattr(args, "data_dtype", "") or "") == "bfloat16" \
+                and bundle.input_dtype == jnp.float32:
+            store_dtype = jnp.bfloat16
+        self.x_all = jnp.asarray(np.asarray(x_all), store_dtype)
         self.y_all = jnp.asarray(np.asarray(y_all))
         cap = self.nb * bs
         idx_mat = np.full((self.n_total, cap), -1, np.int32)
