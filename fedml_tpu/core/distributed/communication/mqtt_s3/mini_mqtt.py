@@ -214,8 +214,8 @@ class MiniMqttBroker:
             try:
                 s.send(frame)
             except OSError:
-                logging.debug("mini-mqtt: drop to dead session %s",
-                              s.client_id)
+                logging.warning("mini-mqtt: dropped %s to dead session %s",
+                                topic, s.client_id)
 
     def stop(self) -> None:
         self._srv.shutdown()
@@ -296,7 +296,15 @@ class MiniMqttClient:
                         self._send(_mk_packet(PUBACK, 0,
                                               struct.pack(">H", pid)))
                     if self.on_message:
-                        self.on_message(self, None, _Msg(topic, body[off:]))
+                        try:
+                            self.on_message(self, None,
+                                            _Msg(topic, body[off:]))
+                        except Exception:  # noqa: BLE001
+                            # a consumer bug must not kill the transport
+                            # reader — later messages still need delivery
+                            logging.exception(
+                                "mini-mqtt %s: on_message raised",
+                                self.client_id)
                 # SUBACK/UNSUBACK/PUBACK/PINGRESP need no action here
         except (ConnectionError, OSError):
             pass

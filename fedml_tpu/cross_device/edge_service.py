@@ -1,0 +1,165 @@
+"""EdgeService — the always-on native edge daemon.
+
+Capability parity: the reference Android service layer
+(`android/fedmlsdk/src/main/java/ai/fedml/edge/service/EdgeService.java`
+foreground service + `ClientAgentManager.java`): a device binds its edge id
+to the control plane once, heartbeats, and whenever MLOps dispatches
+start_train it joins the federated run with the ON-DEVICE native trainer —
+no Python job package, no JAX.  stop_train aborts the run; the daemon
+outlives any number of runs.
+
+Control plane topics are the scheduler agent schema
+(`flserver_agent/{edge_id}/start_train` etc., `scheduler/agents.py`); the
+run itself rides the cross-device wire protocol (`edge_client.py` over
+MQTT+object-store — real TCP MQTT when configured).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from ..scheduler.agents import (
+    _make_broker,
+    _topic_active,
+    _topic_start,
+    _topic_status,
+    _topic_stop,
+)
+
+
+class EdgeService:
+    """Long-lived native-client daemon: bind → heartbeat → train on demand."""
+
+    def __init__(self, edge_id: str, channel: str = "edges",
+                 heartbeat_s: float = 5.0,
+                 dataset_provider: Optional[Callable[[Any], tuple]] = None
+                 ) -> None:
+        self.edge_id = str(edge_id)
+        self.broker = _make_broker(channel, f"edge-{edge_id}")
+        self.heartbeat_s = float(heartbeat_s)
+        #: on a real device the training data lives on the device; the
+        #: provider maps run config → dataset tuple (default: the standard
+        #: loader, which reads the local cache dir)
+        self.dataset_provider = dataset_provider
+        self._stop = threading.Event()
+        self._hb: Optional[threading.Thread] = None
+        self._runs: Dict[str, Any] = {}        # run_id → EdgeClientManager
+        self._threads: Dict[str, threading.Thread] = {}
+        # runs stopped before/while their client was still being built
+        # (the SlaveAgent _cancelled invariant: a stop_train landing in the
+        # setup window must still kill the run)
+        self._cancelled: set = set()
+        self._lock = threading.Lock()
+        self.completed: Dict[str, str] = {}    # run_id → final status
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "EdgeService":
+        self.broker.subscribe(_topic_start(self.edge_id), self._on_start)
+        self.broker.subscribe(_topic_stop(self.edge_id), self._on_stop)
+        self._send_active("ONLINE")
+        self._hb = threading.Thread(target=self._heartbeat, daemon=True,
+                                    name=f"edge-hb-{self.edge_id}")
+        self._hb.start()
+        logging.info("edge service %s online", self.edge_id)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for run_id in list(self._runs):
+            self._abort(run_id)
+        self._send_active("OFFLINE")
+        self.broker.unsubscribe(_topic_start(self.edge_id), self._on_start)
+        self.broker.unsubscribe(_topic_stop(self.edge_id), self._on_stop)
+        close = getattr(self.broker, "close", None)
+        if close:
+            close()                    # PahoBroker: socket + loop thread
+
+    def _heartbeat(self) -> None:
+        while not self._stop.wait(self.heartbeat_s):
+            self._send_active("ONLINE")
+
+    def _send_active(self, state: str) -> None:
+        self.broker.publish(_topic_active(self.edge_id), json.dumps(
+            {"edge_id": self.edge_id, "status": state,
+             "role": "native-edge"}).encode())
+
+    # -- train dispatch -----------------------------------------------------
+    def _on_start(self, topic: str, payload: bytes) -> None:
+        req = json.loads(payload.decode())
+        run_id = str(req.get("run_id", "0"))
+        with self._lock:
+            # dup-guard keys on _threads (populated synchronously HERE) —
+            # at-least-once delivery can redeliver start_train before the
+            # run thread has built its client
+            if run_id in self._threads:
+                return
+            self._cancelled.discard(run_id)
+            t = threading.Thread(target=self._run_round_loop,
+                                 args=(run_id, req), daemon=True,
+                                 name=f"edge-run-{self.edge_id}-{run_id}")
+            self._threads[run_id] = t
+        t.start()
+
+    def _on_stop(self, topic: str, payload: bytes) -> None:
+        req = json.loads(payload.decode())
+        self._abort(str(req.get("run_id", "0")))
+
+    def _abort(self, run_id: str) -> None:
+        with self._lock:
+            self._cancelled.add(run_id)
+            client = self._runs.pop(run_id, None)
+        if client is not None:
+            try:
+                client.finish()
+            except Exception:  # noqa: BLE001
+                logging.exception("edge %s: abort of run %s failed",
+                                  self.edge_id, run_id)
+            self._report(run_id, "KILLED")
+
+    def _report(self, run_id: str, status: str) -> None:
+        self.completed[run_id] = status
+        self.broker.publish(_topic_status(run_id), json.dumps(
+            {"edge_id": self.edge_id, "run_id": run_id,
+             "status": status}).encode())
+
+    def _run_round_loop(self, run_id: str, req: Dict[str, Any]) -> None:
+        """Join the federated run as a native-trainer client (the
+        TrainingExecutor role)."""
+        try:
+            import fedml_tpu
+            from .edge_client import EdgeClientManager
+
+            cfg = dict(req.get("config") or {})
+            cfg.setdefault("run_id", run_id)
+            args = fedml_tpu.Config(**cfg)
+            rank = int(req.get("rank", 1))
+            size = int(req.get("size", 2))
+            provider = self.dataset_provider or (
+                lambda a: fedml_tpu.data.load(a))
+            dataset = provider(args)
+            bundle = fedml_tpu.model.create(args, dataset[-1])
+            client = EdgeClientManager(args, bundle, dataset, rank, size,
+                                       backend=str(req.get("backend",
+                                                           "MQTT_S3")))
+            with self._lock:
+                if run_id in self._cancelled:
+                    # stop_train landed during setup — never join the run
+                    self._report(run_id, "KILLED")
+                    return
+                self._runs[run_id] = client
+            self._report(run_id, "TRAINING")
+            client.run()                 # blocks until server FINISH
+            with self._lock:
+                aborted = run_id not in self._runs  # _abort popped it
+            if not aborted:
+                self._report(run_id, "FINISHED")
+        except Exception:  # noqa: BLE001
+            logging.exception("edge %s: run %s failed", self.edge_id, run_id)
+            self._report(run_id, "FAILED")
+        finally:
+            with self._lock:
+                self._runs.pop(run_id, None)
+                self._threads.pop(run_id, None)

@@ -1,0 +1,157 @@
+"""Always-on native edge daemon (reference EdgeService/ClientAgentManager,
+closing the round-1 partial on component #27): devices bind once over REAL
+TCP MQTT, heartbeat, join a federated run when start_train is dispatched,
+and outlive the run."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fedml_tpu.core.distributed.communication.mqtt_s3.mini_mqtt import (
+    MiniMqttBroker,
+)
+
+
+@pytest.mark.slow
+def test_edge_service_full_dispatch_cycle(tmp_path, monkeypatch):
+    import fedml_tpu
+    from fedml_tpu.core.alg_frame.server_aggregator import ServerAggregator
+    from fedml_tpu.cross_device.edge_service import EdgeService
+    from fedml_tpu.cross_silo.server.fedml_aggregator import FedMLAggregator
+    from fedml_tpu.cross_silo.server.fedml_server_manager import (
+        FedMLServerManager,
+    )
+    from fedml_tpu.native.native_trainer import NativeClientTrainer
+    from fedml_tpu.scheduler.agents import _topic_start, _topic_status
+
+    broker = MiniMqttBroker()
+    monkeypatch.setenv("FEDML_MQTT_HOST", broker.host)
+    monkeypatch.setenv("FEDML_MQTT_PORT", str(broker.port))
+
+    run_id = "edgesvc1"
+    cfg = dict(
+        training_type="cross_device", dataset="synthetic", model="lr",
+        client_num_in_total=2, client_num_per_round=2, comm_round=2,
+        data_scale=0.2, batch_size=16, epochs=1, learning_rate=0.1,
+        momentum=0.9, frequency_of_the_test=1, run_id=run_id,
+        random_seed=0, enable_tracking=False, compute_dtype="float32",
+        mqtt_host=broker.host, mqtt_port=broker.port,
+        object_store_dir=str(tmp_path))
+
+    # control-plane status collector (the MLOps role)
+    from fedml_tpu.scheduler.agents import _make_broker
+
+    ctl = _make_broker("edges", "mlops")
+    statuses = []
+    ctl.subscribe(_topic_status(run_id),
+                  lambda t, p: statuses.append(json.loads(p.decode())))
+
+    # two always-on edge daemons come online BEFORE any run exists
+    services = [EdgeService(f"e{i}", channel="edges",
+                            heartbeat_s=1.0).start()
+                for i in (1, 2)]
+    try:
+        # server side (native weight layout, same wire as edge_client)
+        args = fedml_tpu.init(fedml_tpu.Config(**cfg))
+        dataset = fedml_tpu.data.load(args)
+        bundle = fedml_tpu.model.create(args, dataset[-1])
+
+        class EdgeServerAggregator(ServerAggregator):
+            def __init__(self, bundle, args):
+                super().__init__(bundle, args)
+                self._t = NativeClientTrainer(bundle, args)
+
+            def test(self, test_data, device=None, args=None):
+                self._t.params = {k: np.asarray(v)
+                                  for k, v in self.params.items()}
+                return self._t.test(test_data)
+
+        d = int(np.prod(dataset[2][0].shape[1:]))
+        agg_impl = EdgeServerAggregator(bundle, args)
+        agg_impl.set_model_params({
+            "w1": np.zeros(0, np.float32), "b1": np.zeros(0, np.float32),
+            "w2": np.zeros((d, dataset[-1]), np.float32),
+            "b2": np.zeros(dataset[-1], np.float32)})
+        aggregator = FedMLAggregator(args, agg_impl, dataset[3])
+        server = FedMLServerManager(args, aggregator, rank=0,
+                                    client_num=2, backend="MQTT_S3")
+
+        # MLOps dispatches start_train to the bound edges
+        for rank, svc in enumerate(services, start=1):
+            ctl.publish(_topic_start(svc.edge_id), json.dumps(
+                {"run_id": run_id, "rank": rank, "size": 3,
+                 "backend": "MQTT_S3", "config": cfg}).encode())
+
+        server.run()        # blocks until rounds complete + FINISH
+
+        deadline = time.time() + 60
+        while time.time() < deadline and not all(
+                s.completed.get(run_id) == "FINISHED" for s in services):
+            time.sleep(0.1)
+        assert all(s.completed.get(run_id) == "FINISHED"
+                   for s in services), [s.completed for s in services]
+        m = aggregator.metrics_history[-1]
+        assert np.isfinite(m["test_loss"])
+        assert m["test_acc"] > 0.3
+
+        # the daemons outlive the run (heartbeats still flowing)
+        assert all(not s._stop.is_set() for s in services)
+        # status stream saw TRAINING then FINISHED per edge
+        got = {(s["edge_id"], s["status"]) for s in statuses}
+        for i in (1, 2):
+            assert (f"e{i}", "TRAINING") in got
+            assert (f"e{i}", "FINISHED") in got
+    finally:
+        for s in services:
+            s.stop()
+        broker.stop()
+
+
+@pytest.mark.slow
+def test_edge_service_stop_during_setup_kills_run(tmp_path, monkeypatch):
+    """A stop_train landing in the setup window (before the client joins)
+    must kill the run, not let it train to completion."""
+    import fedml_tpu
+    from fedml_tpu.cross_device.edge_service import EdgeService
+
+    broker = MiniMqttBroker()
+    monkeypatch.setenv("FEDML_MQTT_HOST", broker.host)
+    monkeypatch.setenv("FEDML_MQTT_PORT", str(broker.port))
+    run_id = "edgesvc-cancel"
+
+    slow_gate = threading.Event()
+
+    def slow_provider(args):
+        slow_gate.wait(30)          # hold setup until stop_train lands
+        return fedml_tpu.data.load(args)
+
+    svc = EdgeService("e9", channel="edges",
+                      dataset_provider=slow_provider).start()
+    try:
+        from fedml_tpu.scheduler.agents import _make_broker, _topic_start
+
+        ctl = _make_broker("edges", "mlops2")
+        cfg = dict(dataset="synthetic", model="lr", data_scale=0.1,
+                   run_id=run_id, mqtt_host=broker.host,
+                   mqtt_port=broker.port, object_store_dir=str(tmp_path),
+                   enable_tracking=False)
+        ctl.publish(_topic_start("e9"), json.dumps(
+            {"run_id": run_id, "rank": 1, "size": 2,
+             "config": cfg}).encode())
+        deadline = time.time() + 20
+        while run_id not in svc._threads and time.time() < deadline:
+            time.sleep(0.05)
+        svc._on_stop("", json.dumps({"run_id": run_id}).encode())
+        slow_gate.set()             # setup resumes AFTER the stop
+        deadline = time.time() + 30
+        while svc.completed.get(run_id) != "KILLED" \
+                and time.time() < deadline:
+            time.sleep(0.05)
+        assert svc.completed.get(run_id) == "KILLED", svc.completed
+        assert run_id not in svc._runs
+    finally:
+        svc.stop()
+        broker.stop()
