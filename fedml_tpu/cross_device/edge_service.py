@@ -82,8 +82,12 @@ class EdgeService:
             self._send_active("ONLINE")
 
     def _send_active(self, state: str) -> None:
+        # SlaveAgent's active schema ('state' + 'ts') so one consumer
+        # serves both daemon kinds; native edges advertise no job slots
+        import time
+
         self.broker.publish(_topic_active(self.edge_id), json.dumps(
-            {"edge_id": self.edge_id, "status": state,
+            {"edge_id": self.edge_id, "state": state, "ts": time.time(),
              "role": "native-edge"}).encode())
 
     # -- train dispatch -----------------------------------------------------
@@ -96,7 +100,11 @@ class EdgeService:
             # run thread has built its client
             if run_id in self._threads:
                 return
-            self._cancelled.discard(run_id)
+            if run_id in self._cancelled:
+                # stop_train outran its start_train (topics guarantee no
+                # cross-topic ordering): refuse to start, like SlaveAgent
+                self._report(run_id, "KILLED")
+                return
             t = threading.Thread(target=self._run_round_loop,
                                  args=(run_id, req), daemon=True,
                                  name=f"edge-run-{self.edge_id}-{run_id}")
@@ -157,8 +165,17 @@ class EdgeService:
             if not aborted:
                 self._report(run_id, "FINISHED")
         except Exception:  # noqa: BLE001
-            logging.exception("edge %s: run %s failed", self.edge_id, run_id)
-            self._report(run_id, "FAILED")
+            with self._lock:
+                killed = run_id in self._cancelled
+            if killed:
+                # the abort tore the transport down under client.run() —
+                # that unwind is the KILL completing, not a failure
+                logging.info("edge %s: run %s unwound after stop",
+                             self.edge_id, run_id)
+            else:
+                logging.exception("edge %s: run %s failed", self.edge_id,
+                                  run_id)
+                self._report(run_id, "FAILED")
         finally:
             with self._lock:
                 self._runs.pop(run_id, None)

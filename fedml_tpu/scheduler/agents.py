@@ -95,6 +95,13 @@ def _topic_upgrade(edge_id: str) -> str:
     return f"flserver_agent/{edge_id}/upgrade"
 
 
+#: fleet-wide active stream: every slave ALSO publishes its heartbeat here
+#: so the master can build a resource registry without knowing edge ids in
+#: advance (the reference's backend-side GPU matching,
+#: `scheduler_entry/launch_manager.py` resource matching)
+TOPIC_FLEET = "flclient_agent/fleet/active"
+
+
 class SlaveAgent:
     """The edge daemon (`FedMLClientRunner` analog)."""
 
@@ -164,7 +171,10 @@ class SlaveAgent:
                                 self._on_upgrade)
         self._send_active("OFFLINE")
         # let in-flight _run_job threads finish their finally blocks
-        # (slot release + terminal status) before closing the shared db
+        # (slot release + terminal status) before closing the shared db —
+        # and the heartbeat too, which now reads the db per tick
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=self.heartbeat_s + 5.0)
         for t in list(self._job_threads.values()):
             t.join(timeout=15.0)
         self.resources.close()
@@ -176,9 +186,17 @@ class SlaveAgent:
             self._send_active("ACTIVE")
 
     def _send_active(self, state: str) -> None:
-        self.broker.publish(_topic_active(self.edge_id), json.dumps(
-            {"edge_id": self.edge_id, "state": state,
-             "ts": time.time()}).encode())
+        devices = self.resources.list_devices()
+        payload = json.dumps({
+            "edge_id": self.edge_id, "state": state, "ts": time.time(),
+            # resource advertisement for master-side matching
+            "slots": len(devices),
+            "free_slots": sum(1 for d in devices if not d.get("run_id")),
+            "device_kinds": sorted({str(d.get("kind", "")
+                                        ) for d in devices}),
+        }).encode()
+        self.broker.publish(_topic_active(self.edge_id), payload)
+        self.broker.publish(TOPIC_FLEET, payload)
 
     # -- start_train ---------------------------------------------------------
     def _on_start(self, topic: str, payload: bytes) -> None:
@@ -428,10 +446,68 @@ class MasterAgent:
         self._events: Dict[str, threading.Event] = {}
         self._edges: Dict[str, List[str]] = {}
         self._lock = threading.Lock()
+        #: fleet registry built from the shared active stream — the
+        #: backend-side resource matcher's view of the world
+        self._fleet: Dict[str, Dict[str, Any]] = {}
+        self.broker.subscribe(TOPIC_FLEET, self._on_fleet_active)
 
-    def create_run(self, job_yaml_path: str, edges: List[str],
+    def _on_fleet_active(self, topic: str, payload: bytes) -> None:
+        body = json.loads(payload.decode())
+        edge = str(body.get("edge_id", ""))
+        if edge:
+            with self._lock:
+                self._fleet[edge] = body
+
+    def match_edges(self, num_edges: int, min_free_slots: int = 1,
+                    device_kind: Optional[str] = None,
+                    max_age_s: float = 60.0) -> List[str]:
+        """Pick edges whose advertised resources satisfy the request
+        (reference `launch_manager` GPU matching, local-first): recently
+        active, enough free slots, optional device-kind filter.  Raises
+        when the fleet cannot satisfy the request."""
+        now = time.time()
+        with self._lock:
+            fleet = dict(self._fleet)
+        candidates = []
+        for edge, info in fleet.items():
+            if now - float(info.get("ts", 0)) > max_age_s:
+                continue
+            if info.get("state") == "OFFLINE":
+                continue
+            if int(info.get("free_slots", 0)) < min_free_slots:
+                continue
+            kinds = info.get("device_kinds") or []
+            if device_kind and not any(
+                    device_kind.lower() in str(k).lower() for k in kinds):
+                continue
+            candidates.append((int(info.get("free_slots", 0)), edge))
+        if len(candidates) < num_edges:
+            raise RuntimeError(
+                f"resource match failed: need {num_edges} edges with >= "
+                f"{min_free_slots} free slots"
+                + (f" of kind {device_kind!r}" if device_kind else "")
+                + f", fleet has {len(candidates)} candidates "
+                f"({sorted(fleet)})")
+        # most-free-first keeps load spread across the fleet
+        candidates.sort(reverse=True)
+        return [edge for _, edge in candidates[:num_edges]]
+
+    def create_run(self, job_yaml_path: str,
+                   edges: Optional[List[str]] = None,
                    config_overrides: Optional[Dict[str, Any]] = None,
-                   env: Optional[Dict[str, str]] = None) -> str:
+                   env: Optional[Dict[str, str]] = None,
+                   match: Optional[Dict[str, Any]] = None) -> str:
+        """Dispatch a run to explicit ``edges`` or to a resource-matched
+        set (``match={"num_edges": 2, "min_free_slots": 1,
+        "device_kind": "tpu"}``)."""
+        if edges is None:
+            if not match:
+                raise ValueError("pass edges=[...] or match={...}")
+            edges = self.match_edges(
+                int(match.get("num_edges", 1)),
+                int(match.get("min_free_slots", 1)),
+                match.get("device_kind"),
+                float(match.get("max_age_s", 60.0)))
         run_id = uuid.uuid4().hex[:12]
         zip_path = local_launcher.build_job_package(job_yaml_path)
         key = f"packages/{run_id}.zip"

@@ -362,3 +362,49 @@ def test_replica_autoscaler_scales_up_down_with_cooldown():
     assert a.observe(qps=1.0, latency_s=5.0) == 4
     # bounds respected
     assert all(1 <= r <= 4 for r in a.history)
+
+
+def test_master_matches_edges_by_advertised_resources(tmp_path):
+    """Cross-host resource matching (reference launch_manager GPU match):
+    the master picks dispatch targets from the fleet's advertised free
+    slots instead of an explicit edge list."""
+    import time as _time
+
+    from fedml_tpu.scheduler.agents import MasterAgent, SlaveAgent
+
+    channel = "match-test"
+    agents = [SlaveAgent(f"m{i}", channel=channel,
+                         store_dir=str(tmp_path), heartbeat_s=0.2).start()
+              for i in (1, 2, 3)]
+    master = MasterAgent(channel=channel, store_dir=str(tmp_path))
+    try:
+        deadline = _time.time() + 20
+        while len(master._fleet) < 3 and _time.time() < deadline:
+            _time.sleep(0.05)
+        assert set(master._fleet) >= {"m1", "m2", "m3"}
+
+        picked = master.match_edges(num_edges=2, min_free_slots=1)
+        assert len(picked) == 2 and set(picked) <= {"m1", "m2", "m3"}
+
+        # an impossible request fails loudly, naming the constraint
+        with pytest.raises(RuntimeError, match="resource match failed"):
+            master.match_edges(num_edges=2, min_free_slots=10_000)
+        with pytest.raises(RuntimeError, match="kind"):
+            master.match_edges(num_edges=1, device_kind="h100")
+
+        # end-to-end: create_run with match= instead of edges=
+        job = tmp_path / "job.yaml"
+        job.write_text(
+            "job_name: match-smoke\n"
+            "workspace: .\n"
+            "job: |\n  python -c \"print('hi from matched edge')\"\n")
+        run_id = master.create_run(str(job),
+                                   match={"num_edges": 2,
+                                          "min_free_slots": 1})
+        result = master.wait(run_id, timeout=60)
+        done = [e for e, s in result["edges"].items()
+                if s.get("status") == "FINISHED"]
+        assert len(done) == 2
+    finally:
+        for a in agents:
+            a.stop()
