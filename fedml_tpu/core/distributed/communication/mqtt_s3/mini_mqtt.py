@@ -15,7 +15,10 @@ DISCONNECT) so the transport runs over REAL sockets:
   on_message / disconnect), used automatically when paho-mqtt is absent.
 
 Interoperates with real brokers/clients: the frames are standard 3.1.1
-(QoS capped at 1).
+(QoS capped at 1).  QoS1 is REAL at-least-once on both hops: the client
+tracks PUBACKs and retransmits with the DUP flag, and the broker delivers
+QoS1 to QoS1 subscribers with per-session PUBACK tracking + retransmission
+(consumers keep their dup-guards — redelivery may duplicate).
 """
 
 from __future__ import annotations
@@ -25,12 +28,53 @@ import socket
 import socketserver
 import struct
 import threading
+from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
 
 CONNECT, CONNACK, PUBLISH, PUBACK = 1, 2, 3, 4
 PUBREC, PUBREL, PUBCOMP = 5, 6, 7
 SUBSCRIBE, SUBACK, UNSUBSCRIBE, UNSUBACK = 8, 9, 10, 11
 PINGREQ, PINGRESP, DISCONNECT = 12, 13, 14
+
+#: QoS1 retransmission cadence / cap (both client→broker and
+#: broker→subscriber hops); past the cap the message is dropped with a
+#: warning — the transport is at-least-once, not infinitely persistent
+RETRY_INTERVAL_S = 2.0
+MAX_RETRIES = 5
+#: bound on the recently-acked-pid LRUs used for DUP dedup
+ACKED_LRU_CAP = 512
+#: per-session broker send timeout: one stalled subscriber (full TCP
+#: buffers) must not wedge the shared retransmit loop for everyone
+SEND_TIMEOUT_S = 5.0
+
+
+def _scan_retransmits(inflight: Dict[int, list], now: float,
+                      owner: str) -> List[bytes]:
+    """Shared QoS1 in-flight scan (broker sessions and client publishes):
+    entries are [frame_sans_dup, deadline, tries].  Mutates ``inflight``
+    under the CALLER's lock; returns the DUP frames to send (outside it)."""
+    dups = []
+    for pid in list(inflight):
+        ent = inflight[pid]
+        if ent[1] > now:
+            continue
+        if ent[2] >= MAX_RETRIES:
+            logging.warning("mini-mqtt %s: dropping QoS1 pid=%d after %d "
+                            "retries", owner, pid, ent[2])
+            del inflight[pid]
+            continue
+        ent[1] = now + RETRY_INTERVAL_S
+        ent[2] += 1
+        dups.append(bytes([ent[0][0] | 0x08]) + ent[0][1:])
+    return dups
+
+
+def _remember_lru(lru: "OrderedDict[int, bool]", pid: int,
+                  cap: int = ACKED_LRU_CAP) -> None:
+    lru[pid] = True
+    lru.move_to_end(pid)
+    while len(lru) > cap:
+        lru.popitem(last=False)
 
 
 def _encode_len(n: int) -> bytes:
@@ -86,11 +130,38 @@ class _Session:
     def __init__(self, sock: socket.socket) -> None:
         self.sock = sock
         self.client_id = ""
-        self.subs: set = set()
+        self.subs: Dict[str, int] = {}      # topic → granted qos (0|1)
         self.will: Optional[Tuple[str, bytes]] = None
         self.lock = threading.Lock()
         self.graceful = False
         self.inflight_qos2: Dict[int, Tuple[str, bytes]] = {}
+        #: broker→subscriber QoS1 in flight: pid → [frame_sans_dup,
+        #: deadline, tries] — retransmitted with DUP until PUBACK
+        #: (guarded by ``lock``, as is pid allocation)
+        self.inflight_out: Dict[int, list] = {}
+        self._out_pid = 0
+        #: recently-acked INBOUND QoS1 pids from this client: a DUP
+        #: retransmission of an already-routed publish must not be routed
+        #: again (receiver-side dedup; bounded LRU)
+        self.acked_in: "OrderedDict[int, bool]" = OrderedDict()
+
+    def track_qos1_out(self, topic: str, payload: bytes,
+                       deadline: float) -> bytes:
+        """Allocate a pid + register the in-flight entry atomically; returns
+        the wire frame.  pid allocation and the insert share ``lock`` —
+        concurrent publisher serve threads route to one subscriber."""
+        with self.lock:
+            self._out_pid = self._out_pid % 65535 + 1
+            pid = self._out_pid
+            frame = _mk_packet(
+                PUBLISH, 1 << 1,
+                _mqtt_str(topic) + struct.pack(">H", pid) + payload)
+            self.inflight_out[pid] = [frame, deadline, 0]
+        return frame
+
+    def remember_acked_in(self, pid: int) -> None:
+        with self.lock:
+            _remember_lru(self.acked_in, pid)
 
     def send(self, data: bytes) -> None:
         with self.lock:
@@ -116,8 +187,43 @@ class MiniMqttBroker:
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         daemon=True, name="mini-mqtt-broker")
         self._thread.start()
+        self._stop_retx = threading.Event()
+        self._retx = threading.Thread(target=self._retransmit_loop,
+                                      daemon=True,
+                                      name="mini-mqtt-broker-retx")
+        self._retx.start()
+
+    def _retransmit_loop(self) -> None:
+        """Resend un-PUBACKed QoS1 deliveries with the DUP flag."""
+        import time
+
+        while not self._stop_retx.wait(RETRY_INTERVAL_S / 2.0):
+            now = time.monotonic()
+            with self._lock:
+                sessions = list(self._sessions)
+            for s in sessions:
+                with s.lock:
+                    dups = _scan_retransmits(s.inflight_out, now,
+                                             f"→{s.client_id}")
+                for dup in dups:
+                    try:
+                        s.send(dup)
+                    except OSError:
+                        pass
 
     def _serve(self, sock: socket.socket) -> None:
+        # bound SENDS only (recv must block indefinitely): one subscriber
+        # with full TCP buffers must not wedge _retransmit_loop / _route
+        # for every other session
+        import struct as _struct
+
+        try:
+            sock.setsockopt(
+                socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                _struct.pack("ll", int(SEND_TIMEOUT_S),
+                             int((SEND_TIMEOUT_S % 1) * 1e6)))
+        except OSError:
+            pass                          # platform without SO_SNDTIMEO
         sess = _Session(sock)
         with self._lock:
             self._sessions.append(sess)
@@ -130,12 +236,16 @@ class MiniMqttBroker:
                     self._on_publish(sess, flags, body)
                 elif ptype == SUBSCRIBE:
                     self._on_subscribe(sess, body)
+                elif ptype == PUBACK:
+                    pid = struct.unpack_from(">H", body, 0)[0]
+                    with sess.lock:
+                        sess.inflight_out.pop(pid, None)
                 elif ptype == UNSUBSCRIBE:
                     pid = struct.unpack_from(">H", body, 0)[0]
                     off = 2
                     while off < len(body):
                         topic, off = _take_str(body, off)
-                        sess.subs.discard(topic)
+                        sess.subs.pop(topic, None)
                     sess.send(_mk_packet(UNSUBACK, 0, struct.pack(">H", pid)))
                 elif ptype == PUBREL:
                     # QoS2 completion: release the stashed message
@@ -143,7 +253,8 @@ class MiniMqttBroker:
                     stashed = sess.inflight_qos2.pop(pid, None)
                     sess.send(_mk_packet(PUBCOMP, 0, struct.pack(">H", pid)))
                     if stashed is not None:
-                        self._route(*stashed)
+                        # QoS2 caps to QoS1 downstream (at-least-once)
+                        self._route(stashed[0], stashed[1], qos=1)
                 elif ptype == PINGREQ:
                     sess.send(_mk_packet(PINGRESP, 0, b""))
                 elif ptype == DISCONNECT:
@@ -156,8 +267,9 @@ class MiniMqttBroker:
                 if sess in self._sessions:
                     self._sessions.remove(sess)
             if sess.will and not sess.graceful:
-                # abnormal drop → fire the last will (liveness signal)
-                self._route(sess.will[0], sess.will[1])
+                # abnormal drop → fire the last will (liveness signal);
+                # wills ride at QoS1 so the signal survives a lost frame
+                self._route(sess.will[0], sess.will[1], qos=1)
             try:
                 sock.close()
             except OSError:
@@ -193,7 +305,14 @@ class MiniMqttBroker:
             pid = struct.unpack_from(">H", body, off)[0]
             off += 2
             sess.send(_mk_packet(PUBACK, 0, struct.pack(">H", pid)))
-        self._route(topic, body[off:])
+            with sess.lock:
+                already = pid in sess.acked_in
+            if (flags & 0x08) and already:
+                # DUP retransmit of a publish we already routed (our
+                # first PUBACK was lost in flight) — ack again, route once
+                return
+            sess.remember_acked_in(pid)
+        self._route(topic, body[off:], qos=qos)
 
     def _on_subscribe(self, sess: _Session, body: bytes) -> None:
         pid = struct.unpack_from(">H", body, 0)[0]
@@ -201,23 +320,36 @@ class MiniMqttBroker:
         granted = bytearray()
         while off < len(body):
             topic, off = _take_str(body, off)
-            off += 1                           # requested qos
-            sess.subs.add(topic)
-            granted.append(1)
+            rq = min(body[off], 1)             # requested qos (cap at 1)
+            off += 1
+            sess.subs[topic] = rq
+            granted.append(rq)
         sess.send(_mk_packet(SUBACK, 0, struct.pack(">H", pid) + granted))
 
-    def _route(self, topic: str, payload: bytes) -> None:
-        frame = _mk_packet(PUBLISH, 0, _mqtt_str(topic) + payload)  # qos0 out
+    def _route(self, topic: str, payload: bytes, qos: int = 0) -> None:
+        """Deliver to subscribers at min(publish qos, granted qos); QoS1
+        deliveries carry a per-session pid and are PUBACK-tracked."""
+        import time
+
+        frame0 = _mk_packet(PUBLISH, 0, _mqtt_str(topic) + payload)
         with self._lock:
             targets = [s for s in self._sessions if topic in s.subs]
         for s in targets:
+            dq = min(qos, s.subs.get(topic, 0))
             try:
-                s.send(frame)
+                if dq >= 1:
+                    frame = s.track_qos1_out(
+                        topic, payload,
+                        time.monotonic() + RETRY_INTERVAL_S)
+                    s.send(frame)
+                else:
+                    s.send(frame0)
             except OSError:
                 logging.warning("mini-mqtt: dropped %s to dead session %s",
                                 topic, s.client_id)
 
     def stop(self) -> None:
+        self._stop_retx.set()
         self._srv.shutdown()
         self._srv.server_close()
 
@@ -243,6 +375,16 @@ class MiniMqttClient:
         self._reader: Optional[threading.Thread] = None
         self._keepalive = 60
         self._closed = threading.Event()
+        #: client→broker QoS1 in flight: pid → [frame_sans_dup, deadline,
+        #: tries]; resent with DUP by _ping_loop until PUBACK
+        self._inflight_pub: Dict[int, list] = {}
+        self._inflight_lock = threading.Lock()
+        self._inflight_empty = threading.Event()
+        self._inflight_empty.set()
+        #: recently-acked inbound QoS1 pids (broker DUP redeliveries are
+        #: suppressed here so consumers without dup-guards stay correct)
+        self._acked_in: "OrderedDict[int, bool]" = OrderedDict()
+        self._reader_done = threading.Event()
 
     def will_set(self, topic: str, payload: bytes = b"", qos: int = 0,
                  retain: bool = False) -> None:
@@ -276,12 +418,30 @@ class MiniMqttClient:
                          name=f"mini-mqtt-ping-{self.client_id}").start()
 
     def _ping_loop(self) -> None:
-        interval = max(self._keepalive / 2.0, 1.0)
+        import time
+
+        interval = min(max(self._keepalive / 2.0, 1.0),
+                       RETRY_INTERVAL_S / 2.0)
+        next_ping = time.monotonic() + max(self._keepalive / 2.0, 1.0)
         while not self._closed.wait(interval):
+            now = time.monotonic()
             try:
-                self._send(_mk_packet(PINGREQ, 0, b""))
+                if now >= next_ping:
+                    self._send(_mk_packet(PINGREQ, 0, b""))
+                    next_ping = now + max(self._keepalive / 2.0, 1.0)
+                self._retransmit(now)
             except OSError:
                 return
+
+    def _retransmit(self, now: float) -> None:
+        """Resend un-PUBACKed QoS1 publishes with the DUP flag (state
+        mutated under the in-flight lock; frames sent outside it)."""
+        with self._inflight_lock:
+            dups = _scan_retransmits(self._inflight_pub, now, self.client_id)
+            if not self._inflight_pub:
+                self._inflight_empty.set()
+        for dup in dups:
+            self._send(dup)
 
     def _loop(self) -> None:
         try:
@@ -295,6 +455,9 @@ class MiniMqttClient:
                         off += 2
                         self._send(_mk_packet(PUBACK, 0,
                                               struct.pack(">H", pid)))
+                        if (flags & 0x08) and pid in self._acked_in:
+                            continue        # DUP redelivery: ack, no deliver
+                        _remember_lru(self._acked_in, pid)
                     if self.on_message:
                         try:
                             self.on_message(self, None,
@@ -305,44 +468,101 @@ class MiniMqttClient:
                             logging.exception(
                                 "mini-mqtt %s: on_message raised",
                                 self.client_id)
-                # SUBACK/UNSUBACK/PUBACK/PINGRESP need no action here
+                elif ptype == PUBACK:
+                    pid = struct.unpack_from(">H", body, 0)[0]
+                    with self._inflight_lock:
+                        self._inflight_pub.pop(pid, None)
+                        if not self._inflight_pub:
+                            self._inflight_empty.set()
+                # SUBACK/UNSUBACK/PINGRESP need no action here
         except (ConnectionError, OSError):
             pass
+        finally:
+            self._reader_done.set()
 
     def _send(self, data: bytes) -> None:
         with self._lock:
             self._sock.sendall(data)
 
     def _next_pid(self) -> int:
+        # caller holds _inflight_lock (pid allocation and the in-flight
+        # insert must be atomic: EdgeService publishes concurrently from
+        # heartbeat/run/reader threads on one shared client)
         self._pid = self._pid % 65535 + 1
         return self._pid
 
     def publish(self, topic: str, payload: bytes, qos: int = 0) -> None:
+        import time
+
         qos = min(int(qos), 1)                          # QoS2 → 1
-        body = _mqtt_str(topic)
-        if qos:
-            body += struct.pack(">H", self._next_pid())
         if isinstance(payload, str):
             payload = payload.encode()
-        self._send(_mk_packet(PUBLISH, qos << 1, body + bytes(payload)))
+        if qos:
+            with self._inflight_lock:
+                pid = self._next_pid()
+                frame = _mk_packet(
+                    PUBLISH, qos << 1,
+                    _mqtt_str(topic) + struct.pack(">H", pid)
+                    + bytes(payload))
+                self._inflight_pub[pid] = [
+                    frame, time.monotonic() + RETRY_INTERVAL_S, 0]
+                self._inflight_empty.clear()
+        else:
+            frame = _mk_packet(PUBLISH, 0, _mqtt_str(topic) + bytes(payload))
+        self._send(frame)
 
     def subscribe(self, topic: str, qos: int = 0) -> None:
-        body = (struct.pack(">H", self._next_pid()) + _mqtt_str(topic)
+        with self._inflight_lock:
+            pid = self._next_pid()
+        body = (struct.pack(">H", pid) + _mqtt_str(topic)
                 + bytes([min(int(qos), 1)]))
         self._send(_mk_packet(SUBSCRIBE, 0x02, body))
 
     def unsubscribe(self, topic: str) -> None:
+        with self._inflight_lock:
+            pid = self._next_pid()
         self._send(_mk_packet(UNSUBSCRIBE, 0x02,
-                              struct.pack(">H", self._next_pid())
-                              + _mqtt_str(topic)))
+                              struct.pack(">H", pid) + _mqtt_str(topic)))
 
     def loop_stop(self) -> None:
         pass                                            # reader is daemon
 
     def disconnect(self) -> None:
+        """Graceful close: flush un-PUBACKed QoS1 publishes, send
+        DISCONNECT, half-close, and DRAIN inbound until the broker closes.
+        Closing with unread frames in the receive buffer would RST the
+        connection and discard our still-queued publishes at the broker
+        (losing e.g. the last FINISH of a run)."""
+        # flush: the reader thread is still consuming PUBACKs; retransmit
+        # while waiting so a lost frame doesn't hang the flush window
+        # (no reader → nobody can process PUBACKs; skip the flush)
+        deadline = None
+        while (self._reader is not None
+               and not self._inflight_empty.wait(timeout=0.1)):
+            import time as _time
+
+            now = _time.monotonic()
+            deadline = deadline or now + 5.0
+            if now >= deadline or self._reader_done.is_set():
+                logging.warning("mini-mqtt %s: disconnect with %d QoS1 "
+                                "publishes still un-PUBACKed",
+                                self.client_id, len(self._inflight_pub))
+                break
+            try:
+                self._retransmit(now)
+            except OSError:
+                break
         self._closed.set()
         try:
             self._send(_mk_packet(DISCONNECT, 0, b""))
+            # half-close our write side; the reader keeps draining until
+            # the broker processes DISCONNECT and closes (EOF) — no RST
+            self._sock.shutdown(socket.SHUT_WR)
+        except OSError:
+            pass
+        if self._reader is not None:       # no reader → nothing to drain
+            self._reader_done.wait(timeout=5.0)
+        try:
             self._sock.close()
         except OSError:
             pass

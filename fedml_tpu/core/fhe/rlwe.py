@@ -18,8 +18,11 @@ Construction (symmetric-key RLWE, additive only):
     encrypt  m -> (a, b = a⊛s + e + m)  with fresh uniform a, small noise e
     add      (a1+a2, b1+b2)  /  scalar: (w·a, w·b)
     decrypt  m' = b - a⊛s = m + Σ w_i e_i   (noise divided out by the
-               fixed-point weight normalization → error ~2^-20, below the
-               fp32 quantization floor)
+               fixed-point weight normalization → worst-case error
+               Σw_i·e_i/(scale·weight_total) ≤ B/scale = 8/2^16 = 2^-13,
+               below meaningful fp32 weight precision; typical error is far
+               smaller.  `_Sha256Drbg.noise` carries a small modulo bias
+               (u8 % 17) — harmless for correctness, noted for honesty)
 
 Exactness: all arithmetic is int64 with headroom proofs — ternary s means
 a⊛s is a SIGNED SUM of ≤N coefficient rotations (no coefficient products),
@@ -151,9 +154,14 @@ class RlweCodec:
         self.scale = 1 << frac_bits
         self.weight_scale = 1 << (weight_bits - 2)
         import secrets as _secrets
+        import threading
 
         self._enc_seed = _secrets.token_bytes(32)
         self._enc_ctr = 0
+        # FedMLFHE is a process-wide singleton and INPROC clients encrypt
+        # from concurrent threads; two encrypts reusing one counter value
+        # would share (a, e) and leak the plaintext difference b1-b2
+        self._enc_lock = threading.Lock()
 
     # -- fixed point (same layout as Paillier: offset keeps slots >= 0) ----
     def _quantize(self, vec: np.ndarray) -> np.ndarray:
@@ -178,9 +186,10 @@ class RlweCodec:
         # distinguished known constant
         m = np.full((C, N_POLY), self.offset * int(weight), np.int64)
         m.ravel()[:size] = slots
-        drbg = _Sha256Drbg(self._enc_seed
-                           + self._enc_ctr.to_bytes(8, "little"))
-        self._enc_ctr += 1
+        with self._enc_lock:
+            ctr = self._enc_ctr
+            self._enc_ctr += 1
+        drbg = _Sha256Drbg(self._enc_seed + ctr.to_bytes(8, "little"))
         a = drbg.uniform_mod_q((C, N_POLY))
         e = drbg.noise((C, N_POLY))
         b = np.mod(_negacyclic_apply_s(a, self.key) + e + m, Q)

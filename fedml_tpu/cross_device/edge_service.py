@@ -100,6 +100,13 @@ class EdgeService:
             # run thread has built its client
             if run_id in self._threads:
                 return
+            done = self.completed.get(run_id)
+            if done in ("FINISHED", "KILLED", "FAILED"):
+                # redelivered start_train after the run already ended —
+                # re-publish the recorded terminal status instead of
+                # silently re-running the whole job
+                self._report(run_id, done)
+                return
             if run_id in self._cancelled:
                 # stop_train outran its start_train (topics guarantee no
                 # cross-topic ordering): refuse to start, like SlaveAgent

@@ -171,7 +171,18 @@ class ReplicaProcessManager:
                     continue
                 new.restarts = rep.restarts + 1
                 with self._lock:
-                    self.replicas[slot] = new
+                    # a concurrent scale_to shrink may have retired this
+                    # slot (set it None) or replaced it while we were
+                    # spawning; installing unconditionally would resurrect
+                    # the slot and exceed the requested replica count
+                    installed = (slot < len(self.replicas)
+                                 and self.replicas[slot] is rep)
+                    if installed:
+                        self.replicas[slot] = new
+                if not installed:
+                    logging.info("replica[%d] retired during restart — "
+                                 "discarding replacement", slot)
+                    self._kill(new)
             self._stop.wait(self.monitor_interval_s)
 
     # -- gateway ------------------------------------------------------------

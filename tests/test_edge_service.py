@@ -155,3 +155,38 @@ def test_edge_service_stop_during_setup_kills_run(tmp_path, monkeypatch):
     finally:
         svc.stop()
         broker.stop()
+
+
+def test_redelivered_start_train_after_finish_replays_status(monkeypatch):
+    """At-least-once delivery can replay start_train AFTER the run ended
+    and its thread entry was reaped; the daemon must re-publish the
+    recorded terminal status, not silently re-run the whole job."""
+    from fedml_tpu.cross_device import edge_service as es_mod
+
+    class _FakeBroker:
+        def __init__(self):
+            self.published = []
+
+        def publish(self, topic, payload):
+            self.published.append((topic, json.loads(payload.decode())))
+
+        def subscribe(self, *a):
+            pass
+
+        def unsubscribe(self, *a):
+            pass
+
+    monkeypatch.setattr(es_mod, "_make_broker",
+                        lambda channel, name: _FakeBroker())
+    svc = es_mod.EdgeService("e-dup", heartbeat_s=999.0)
+    svc.completed["r9"] = "FINISHED"
+
+    started = []
+    monkeypatch.setattr(svc, "_run_round_loop",
+                        lambda run_id, req: started.append(run_id))
+    svc._on_start("t", json.dumps({"run_id": "r9"}).encode())
+    time.sleep(0.2)
+    assert started == []                       # job NOT re-run
+    statuses = [p for t, p in svc.broker.published
+                if p.get("run_id") == "r9"]
+    assert statuses and statuses[-1]["status"] == "FINISHED"

@@ -168,3 +168,97 @@ def test_broker_qos2_handshake_raw_frames(broker):
     assert got == [b"exactly-once"]
     s.close()
     sub.disconnect()
+
+
+def test_qos1_broker_retransmits_until_puback(broker, monkeypatch):
+    """Broker→subscriber QoS1 is PUBACK-tracked: a subscriber that loses
+    its first PUBACK gets the message redelivered with the DUP flag
+    (at-least-once — the redelivery semantics EdgeService/SlaveAgent
+    dup-guards are written against)."""
+    from fedml_tpu.core.distributed.communication.mqtt_s3 import mini_mqtt
+
+    monkeypatch.setattr(mini_mqtt, "RETRY_INTERVAL_S", 0.3)
+
+    class _DropFirstPuback(MiniMqttClient):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self.dropped = 0
+            self.pubacks_sent = 0
+
+        def _send(self, data):
+            from fedml_tpu.core.distributed.communication.mqtt_s3.mini_mqtt import (  # noqa: E501
+                PUBACK,
+            )
+
+            if (data[0] >> 4) == PUBACK:
+                if self.dropped == 0:
+                    self.dropped += 1
+                    return                  # swallow the first PUBACK
+                self.pubacks_sent += 1
+            super()._send(data)
+
+    got = []
+    sub = _DropFirstPuback(client_id="flaky-sub")
+    sub.on_message = lambda c, u, m: got.append(m.payload)
+    sub.connect(broker.host, broker.port)
+    sub.loop_start()
+    sub.subscribe("rtx/a", qos=1)
+    time.sleep(0.2)
+
+    pub = MiniMqttClient(client_id="pub-rtx")
+    pub.connect(broker.host, broker.port)
+    pub.loop_start()
+    pub.publish("rtx/a", b"must-arrive", qos=1)
+
+    # broker must redeliver (DUP) until a PUBACK lands; the client's
+    # receiver-side dedup suppresses the duplicate from on_message
+    deadline = time.time() + 10
+    while sub.pubacks_sent < 1 and time.time() < deadline:
+        time.sleep(0.05)
+    assert sub.dropped == 1
+    assert sub.pubacks_sent >= 1            # a redelivery was acked
+    assert got == [b"must-arrive"]          # delivered exactly once
+    sub.disconnect()
+    pub.disconnect()
+
+
+def test_qos1_client_retransmits_until_puback(broker, monkeypatch):
+    """Client→broker QoS1: a publish whose handling is lost at the broker
+    is retransmitted (DUP) by the client until the broker PUBACKs."""
+    from fedml_tpu.core.distributed.communication.mqtt_s3 import mini_mqtt
+
+    monkeypatch.setattr(mini_mqtt, "RETRY_INTERVAL_S", 0.3)
+    orig = mini_mqtt.MiniMqttBroker._on_publish
+    state = {"dropped": 0}
+
+    def flaky_on_publish(self, sess, flags, body):
+        if ((flags >> 1) & 0x03) == 1 and state["dropped"] == 0:
+            state["dropped"] += 1
+            return                          # lose the first QoS1 publish
+        orig(self, sess, flags, body)
+
+    monkeypatch.setattr(mini_mqtt.MiniMqttBroker, "_on_publish",
+                        flaky_on_publish)
+
+    got = []
+    sub = MiniMqttClient(client_id="sub-crtx")
+    sub.on_message = lambda c, u, m: got.append(m.payload)
+    sub.connect(broker.host, broker.port)
+    sub.loop_start()
+    sub.subscribe("crtx/a", qos=0)
+    time.sleep(0.2)
+
+    pub = MiniMqttClient(client_id="pub-crtx")
+    pub.connect(broker.host, broker.port)
+    pub.loop_start()
+    pub.publish("crtx/a", b"retry-me", qos=1)
+
+    deadline = time.time() + 10
+    while not got and time.time() < deadline:
+        time.sleep(0.05)
+    assert got == [b"retry-me"]
+    assert state["dropped"] == 1
+    with pub._inflight_lock:
+        assert not pub._inflight_pub       # PUBACK cleared the in-flight slot
+    sub.disconnect()
+    pub.disconnect()

@@ -87,3 +87,62 @@ def test_autoscaler_drives_replica_processes(card):
         assert mgr.live_count() == scaler.replicas < n
     finally:
         mgr.shutdown()
+
+
+class _FakeProc:
+    def __init__(self, rc=None):
+        self.returncode = rc
+        self.killed = False
+
+    def poll(self):
+        return self.returncode
+
+    def terminate(self):
+        self.returncode = -15
+        self.killed = True
+
+    def wait(self, timeout=None):
+        return self.returncode
+
+    def kill(self):
+        self.returncode = -9
+        self.killed = True
+
+
+def test_monitor_restart_does_not_resurrect_retired_slot():
+    """Race: monitor sees replica[0] dead, spawns a replacement; meanwhile
+    scale_to shrink retires slot 0 (sets it None).  The replacement must be
+    discarded (and killed), not installed over the retirement."""
+    import threading as th
+
+    from fedml_tpu.scheduler import replica_manager as rm
+
+    mgr = rm.ReplicaProcessManager("x", monitor_interval_s=0.05)
+    dead = rm._Replica(_FakeProc(rc=1), port=1)
+    mgr.replicas = [dead]
+
+    spawning = th.Event()
+    retired = th.Event()
+    replacement = rm._Replica(_FakeProc(rc=None), port=2)
+
+    def slow_spawn(slot):
+        spawning.set()
+        assert retired.wait(timeout=10)
+        return replacement
+
+    mgr._spawn = slow_spawn
+    mon = th.Thread(target=mgr._monitor_loop, daemon=True)
+    mon.start()
+    try:
+        assert spawning.wait(timeout=10)
+        with mgr._lock:                  # shrink retires the slot mid-spawn
+            mgr.replicas[0] = None
+        retired.set()
+        deadline = time.time() + 10
+        while not replacement.proc.killed and time.time() < deadline:
+            time.sleep(0.02)
+        assert mgr.replicas[0] is None          # NOT resurrected
+        assert replacement.proc.killed          # replacement cleaned up
+    finally:
+        mgr._stop.set()
+        mon.join(timeout=5)
