@@ -1,0 +1,215 @@
+"""Round-0 parity oracle: pin down WHERE the reference-vs-fedml_tpu loss
+offset enters (VERDICT r3 item 3).
+
+Replays round 0 of the parity config (LEAF-MNIST LR, 2 clients, bs 10,
+lr 0.03, sigmoid-before-CE) three ways on IDENTICAL bytes and init:
+
+  torch  — the reference trainer semantics verbatim (Linear + sigmoid +
+           CrossEntropyLoss + SGD per batch, partial batch included;
+           `ml/trainer/my_model_trainer_classification.py:21-70`)
+  jax    — fedml_tpu's build_local_update on mask-padded batches
+  fp64   — a numpy float64 re-derivation (ground truth for float error)
+
+and compares the per-batch parameter trajectories and the post-aggregation
+test loss/acc.  Run on CPU:  JAX_PLATFORMS=cpu python benchmarks/parity_round0_oracle.py
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+CACHE = os.path.join(REPO, ".data_cache", "refbench")
+sys.path.insert(0, REPO)
+
+LR = 0.03
+BS = 10
+
+
+def leaf_clients():
+    """Same bytes + same shuffle as both parity runners."""
+    import fedml_tpu
+
+    args = fedml_tpu.init(fedml_tpu.Config(
+        dataset="mnist", data_cache_dir=CACHE, partition_method="natural",
+        model="lr", backend="sp", client_num_in_total=2,
+        client_num_per_round=2, comm_round=1, epochs=1, batch_size=BS,
+        client_optimizer="sgd", learning_rate=LR, weight_decay=0.0,
+        lr_sigmoid_outputs=True, frequency_of_the_test=1,
+        enable_tracking=False, compute_dtype="float32"))
+    dataset = fedml_tpu.data.load(args)
+    train_local = dataset[5]
+    for cid, (x, y) in list(train_local.items()):
+        x = np.array(x, copy=True)
+        y = np.array(y, copy=True)
+        np.random.seed(100)
+        st = np.random.get_state()
+        np.random.shuffle(x)
+        np.random.set_state(st)
+        np.random.shuffle(y)
+        train_local[cid] = (x, y)
+    return args, dataset, train_local
+
+
+def sampled_round0(n_total):
+    np.random.seed(0)
+    return np.random.choice(n_total, 2, replace=False)
+
+
+def batches_of(x, y):
+    return [(x[i:i + BS], y[i:i + BS]) for i in range(0, len(y), BS)]
+
+
+# ---------------------------------------------------------------- torch
+def torch_round(W0, b0, clients_data):
+    import torch
+
+    outs = []
+    for x, y in clients_data:
+        model = torch.nn.Linear(784, 10)
+        with torch.no_grad():
+            model.weight.copy_(torch.from_numpy(W0))
+            model.bias.copy_(torch.from_numpy(b0))
+        opt = torch.optim.SGD(model.parameters(), lr=LR)
+        crit = torch.nn.CrossEntropyLoss()
+        traj = []
+        for bx, by in batches_of(x, y):
+            model.zero_grad()
+            out = torch.sigmoid(model(torch.from_numpy(
+                np.asarray(bx, np.float32))))
+            loss = crit(out, torch.from_numpy(np.asarray(by)).long())
+            loss.backward()
+            opt.step()
+            traj.append(model.weight.detach().numpy().copy())
+        outs.append((model.weight.detach().numpy().copy(),
+                     model.bias.detach().numpy().copy(), traj))
+    return outs
+
+
+# ---------------------------------------------------------------- fp64
+def _softmax64(z):
+    z = z - z.max(axis=-1, keepdims=True)
+    e = np.exp(z)
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def fp64_round(W0, b0, clients_data):
+    outs = []
+    for x, y in clients_data:
+        W = W0.astype(np.float64).copy()
+        b = b0.astype(np.float64).copy()
+        traj = []
+        for bx, by in batches_of(x, y):
+            bx = np.asarray(bx, np.float64)
+            by = np.asarray(by, np.int64)
+            m = len(by)
+            z = bx @ W.T + b
+            s = 1.0 / (1.0 + np.exp(-z))          # sigmoid outputs
+            p = _softmax64(s)
+            g = p.copy()
+            g[np.arange(m), by] -= 1.0            # dCE/ds · m
+            g /= m
+            gz = g * s * (1.0 - s)                # through sigmoid
+            gW = gz.T @ bx
+            gb = gz.sum(axis=0)
+            W -= LR * gW
+            b -= LR * gb
+            traj.append(W.copy())
+        outs.append((W, b, traj))
+    return outs
+
+
+# ---------------------------------------------------------------- jax
+def jax_round(args, W0, b0, clients_data):
+    import jax
+    import jax.numpy as jnp
+
+    import fedml_tpu
+    from fedml_tpu.ml.engine.local_update import (
+        build_local_update,
+        make_batches,
+    )
+
+    bundle = fedml_tpu.model.create(args, 10)
+    variables = bundle.init_variables(jax.random.PRNGKey(0))
+    local_update = build_local_update(bundle, args)
+    step = jax.jit(local_update)
+    outs = []
+    for x, y in clients_data:
+        params = {"Dense_0": {"kernel": jnp.asarray(W0.T),
+                              "bias": jnp.asarray(b0)}}
+        v = dict(variables, params=params)
+        traj = []
+        # one batch per call → per-batch trajectory comparable to torch
+        for bx, by in batches_of(x, y):
+            batches = make_batches(np.asarray(bx, np.float32),
+                                   np.asarray(by), BS, 1)
+            v, _, _ = step(v, batches, jax.random.PRNGKey(0), None)
+            traj.append(np.asarray(v["params"]["Dense_0"]["kernel"]).T)
+        outs.append((np.asarray(v["params"]["Dense_0"]["kernel"]).T,
+                     np.asarray(v["params"]["Dense_0"]["bias"]), traj))
+    return outs
+
+
+def agg(outs, weights):
+    ws = np.asarray(weights, np.float64)
+    ws = ws / ws.sum()
+    W = sum(w * o[0].astype(np.float64) for w, o in zip(ws, outs))
+    b = sum(w * o[1].astype(np.float64) for w, o in zip(ws, outs))
+    return W, b
+
+
+def test_metrics(W, b, x_te, y_te):
+    z = np.asarray(x_te, np.float64) @ W.T + b
+    s = 1.0 / (1.0 + np.exp(-z))
+    p = _softmax64(s)
+    y = np.asarray(y_te, np.int64)
+    loss = -np.log(p[np.arange(len(y)), y]).mean()
+    acc = (p.argmax(axis=-1) == y).mean()
+    return float(loss), float(acc)
+
+
+def main():
+    z = np.load(os.path.join(CACHE, "ref_init_lr.npz"))
+    W0 = z["linear.weight"].astype(np.float32)
+    b0 = z["linear.bias"].astype(np.float32)
+
+    args, dataset, train_local = leaf_clients()
+    n_total = int(args.client_num_in_total)
+    cids = sampled_round0(n_total)
+    data = [train_local[int(c)] for c in cids]
+    weights = [len(d[1]) for d in data]
+    x_te, y_te = dataset[3]
+
+    t = torch_round(W0, b0, data)
+    f = fp64_round(W0, b0, data)
+    j = jax_round(args, W0, b0, data)
+
+    report = {"clients": [int(c) for c in cids], "weights": weights,
+              "per_batch_divergence": []}
+    for ci in range(len(data)):
+        for bi, (tw, fw, jw) in enumerate(zip(t[ci][2], f[ci][2],
+                                              j[ci][2])):
+            report["per_batch_divergence"].append({
+                "client": ci, "batch": bi,
+                "torch_vs_fp64": float(np.abs(tw - fw).max()),
+                "jax_vs_fp64": float(np.abs(jw - fw).max()),
+                "torch_vs_jax": float(np.abs(tw - jw).max()),
+            })
+            if bi > 3 and ci == 0:
+                break
+
+    for name, outs in (("torch", t), ("fp64", f), ("jax", j)):
+        W, b = agg(outs, weights)
+        loss, acc = test_metrics(W, b, x_te, y_te)
+        report[f"{name}_round0"] = {"test_loss": loss, "test_acc": acc}
+    d_tj = max(r["torch_vs_jax"] for r in report["per_batch_divergence"])
+    report["max_torch_vs_jax_param_diff"] = d_tj
+    print(json.dumps(report, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -28,6 +28,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -165,7 +166,19 @@ class _Session:
 
     def send(self, data: bytes) -> None:
         with self.lock:
-            self.sock.sendall(data)
+            try:
+                self.sock.sendall(data)
+            except OSError:
+                # a timed-out/failed sendall may have written a PARTIAL
+                # frame; the byte stream to this subscriber is now
+                # desynced — tear the session down rather than appending
+                # further frames to a corrupted stream (the serve thread's
+                # recv errors out and runs the normal cleanup/last-will)
+                try:
+                    self.sock.close()
+                except OSError:
+                    pass
+                raise
 
 
 class MiniMqttBroker:
@@ -195,8 +208,6 @@ class MiniMqttBroker:
 
     def _retransmit_loop(self) -> None:
         """Resend un-PUBACKed QoS1 deliveries with the DUP flag."""
-        import time
-
         while not self._stop_retx.wait(RETRY_INTERVAL_S / 2.0):
             now = time.monotonic()
             with self._lock:
@@ -214,14 +225,13 @@ class MiniMqttBroker:
     def _serve(self, sock: socket.socket) -> None:
         # bound SENDS only (recv must block indefinitely): one subscriber
         # with full TCP buffers must not wedge _retransmit_loop / _route
-        # for every other session
-        import struct as _struct
-
+        # for every other session.  _Session.send tears the session down
+        # on a timed-out send (partial frame = desynced stream).
         try:
             sock.setsockopt(
                 socket.SOL_SOCKET, socket.SO_SNDTIMEO,
-                _struct.pack("ll", int(SEND_TIMEOUT_S),
-                             int((SEND_TIMEOUT_S % 1) * 1e6)))
+                struct.pack("ll", int(SEND_TIMEOUT_S),
+                            int((SEND_TIMEOUT_S % 1) * 1e6)))
         except OSError:
             pass                          # platform without SO_SNDTIMEO
         sess = _Session(sock)
@@ -329,8 +339,6 @@ class MiniMqttBroker:
     def _route(self, topic: str, payload: bytes, qos: int = 0) -> None:
         """Deliver to subscribers at min(publish qos, granted qos); QoS1
         deliveries carry a per-session pid and are PUBACK-tracked."""
-        import time
-
         frame0 = _mk_packet(PUBLISH, 0, _mqtt_str(topic) + payload)
         with self._lock:
             targets = [s for s in self._sessions if topic in s.subs]
@@ -418,8 +426,6 @@ class MiniMqttClient:
                          name=f"mini-mqtt-ping-{self.client_id}").start()
 
     def _ping_loop(self) -> None:
-        import time
-
         interval = min(max(self._keepalive / 2.0, 1.0),
                        RETRY_INTERVAL_S / 2.0)
         next_ping = time.monotonic() + max(self._keepalive / 2.0, 1.0)
@@ -492,8 +498,6 @@ class MiniMqttClient:
         return self._pid
 
     def publish(self, topic: str, payload: bytes, qos: int = 0) -> None:
-        import time
-
         qos = min(int(qos), 1)                          # QoS2 → 1
         if isinstance(payload, str):
             payload = payload.encode()
@@ -539,9 +543,7 @@ class MiniMqttClient:
         deadline = None
         while (self._reader is not None
                and not self._inflight_empty.wait(timeout=0.1)):
-            import time as _time
-
-            now = _time.monotonic()
+            now = time.monotonic()
             deadline = deadline or now + 5.0
             if now >= deadline or self._reader_done.is_set():
                 logging.warning("mini-mqtt %s: disconnect with %d QoS1 "
