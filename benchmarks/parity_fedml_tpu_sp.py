@@ -28,6 +28,10 @@ def main() -> None:
     p.add_argument("--optimizer", default="FedAvg")
     p.add_argument("--rounds", type=int, default=30)
     p.add_argument("--scaffold-ref-bug-compat", action="store_true")
+    p.add_argument("--fedavg-ref-chain-compat", action="store_true",
+                   help="reproduce the reference's round-0 state_dict "
+                        "aliasing (sequential clients chain; see "
+                        "parity_round0_oracle.py)")
     cli = p.parse_args()
 
     if not os.path.exists(os.path.join(CACHE, "leaf_mnist_train.npz")):
@@ -63,6 +67,7 @@ def main() -> None:
         fedprox_mu=0.1,
         server_lr=1.0,
         scaffold_ref_bug_compat=cli.scaffold_ref_bug_compat,
+        fedavg_ref_chain_compat=cli.fedavg_ref_chain_compat,
         frequency_of_the_test=1,
         enable_tracking=False,
         compute_dtype="float32",

@@ -172,8 +172,13 @@ class _Session:
                 # a timed-out/failed sendall may have written a PARTIAL
                 # frame; the byte stream to this subscriber is now
                 # desynced — tear the session down rather than appending
-                # further frames to a corrupted stream (the serve thread's
-                # recv errors out and runs the normal cleanup/last-will)
+                # further frames to a corrupted stream.  shutdown() (not
+                # just close) is required to WAKE the serve thread blocked
+                # in recv on this fd so it runs the cleanup/last-will path.
+                try:
+                    self.sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
                 try:
                     self.sock.close()
                 except OSError:

@@ -40,8 +40,9 @@ class DefaultClientTrainer(ClientTrainer):
         self.algo_out: Dict[str, Any] = {}
         self._eval = jax.jit(build_eval_step(bundle))
 
-    def set_num_batches(self, nb: int) -> None:
-        self.num_batches = int(nb)
+    def set_num_batches(self, nb: Optional[int]) -> None:
+        """Fix the padded batch-grid length (None → derive from data)."""
+        self.num_batches = None if nb is None else int(nb)
 
     def train(self, train_data, device=None, args=None) -> Dict[str, Any]:
         args = args or self.args

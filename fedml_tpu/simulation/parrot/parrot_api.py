@@ -257,13 +257,20 @@ class ParrotAPI:
         msize = int(np.prod([mesh.shape[n] for n in names]))
         if msize <= 1:
             return None
+        # balanced layouts first (exact divisibility on either axis), then
+        # UNEVEN sharding (GSPMD pads the ragged shard) — never silently
+        # replicate while an axis is at least mesh-sized
         if k_b % msize == 0:
             return NamedSharding(mesh, P(names))
         if self.bs % msize == 0:
             return NamedSharding(mesh, P(None, None, names))
+        if k_b >= msize:
+            return NamedSharding(mesh, P(names))
+        if self.bs >= msize:
+            return NamedSharding(mesh, P(None, None, names))
         logging.warning(
-            "parrot mesh: neither clients-per-step %d nor batch_size %d "
-            "divides the %d-device mesh — running replicated", k_b,
+            "parrot mesh: clients-per-step %d and batch_size %d are both "
+            "smaller than the %d-device mesh — running replicated", k_b,
             self.bs, msize)
         return None
 

@@ -140,6 +140,29 @@ class FedSimAPI:
             self.trainer.local_train_dataset, self.device, self.args)
         return float(self.local_num_dict[cid]), self.trainer.get_model_params()
 
+    def _scaffold_leaked_start(self, first_cid: int):
+        """Reference-leak reproduction (parity audits only): w0 advanced by
+        ONE plain-SGD batch of the round's first client — the state the
+        reference's w_global freezes at when the scaffold c-correction
+        rebinds `param.data` after the first `optimizer.step()`
+        (`ml/trainer/scaffold_trainer.py:147-170`)."""
+        bs = int(getattr(self.args, "batch_size", 32))
+        x, y = self.train_data_local_dict[first_cid]
+        self.trainer.set_id(first_cid)
+        self.trainer.update_dataset((x[:bs], y[:bs]), None, min(len(y), bs))
+        self.trainer.set_model_params(self.global_vars)
+        # round-0 correction term is c_global - c_local = 0 either way,
+        # but pass fresh zero state for exactness
+        self.trainer.algo_state = self._algo_state_for(first_cid)
+        self.trainer.set_num_batches(1)
+        self.trainer.train(self.trainer.local_train_dataset, self.device,
+                           self.args)
+        # restore the plane's FIXED batch grid (one geometry → one compile
+        # for every client); None would re-derive nb per client and
+        # recompile for every distinct client size
+        self.trainer.set_num_batches(self.num_batches)
+        return self.trainer.get_model_params()
+
     def train(self) -> Dict[str, Any]:
         comm_rounds = int(self.args.comm_round)
         final_metrics: Dict[str, Any] = {}
@@ -149,9 +172,43 @@ class FedSimAPI:
             logging.info("round %d clients %s", round_idx, client_ids)
             results: List[Tuple[float, Any]] = []
             algo_outs: List[Tuple[int, float, Dict[str, Any]]] = []
+            # Reference-bug compatibility (parity audits only): the
+            # reference's round-0 `w_global = get_model_params()` returns a
+            # state_dict ALIASING the live model tensors, so each
+            # sequentially-trained client starts from the PREVIOUS client's
+            # trained weights instead of the round's global model
+            # (`simulation/sp/fedavg/fedavg_api.py:75-101`: deepcopy happens
+            # per client on the mutated dict; rounds >= 1 aggregate into a
+            # fresh dict, so only round 0 chains).  Root-caused in
+            # benchmarks/parity_round0_oracle.py; see docs/PARITY.md.
+            compat_scaffold = (self.algo == FED_OPT_SCAFFOLD and getattr(
+                self.args, "scaffold_ref_bug_compat", False))
+            chain_seq = (round_idx == 0 and bool(getattr(
+                self.args, "fedavg_ref_chain_compat", False)))
+            # SCAFFOLD's reference aliasing is different: its trainer's
+            # c-correction REBINDS param.data each batch
+            # (`ml/trainer/scaffold_trainer.py:166-170`), so w_global
+            # freezes after the FIRST client's FIRST plain-SGD step; all
+            # later round-0 clients start from w0 + that one step, and
+            # from round 1 on nothing aliases at all.
+            leaked: Any = None
+            if (compat_scaffold and round_idx == 0
+                    and len(client_ids) > 1):
+                leaked = self._scaffold_leaked_start(client_ids[0])
+            prev: Any = None
+            self._compat_last_start = None
             with mlops.span("train", round_idx):
-                for cid in client_ids:
-                    n_k, params = self._local_train(cid)
+                for i, cid in enumerate(client_ids):
+                    start: Any = None
+                    if chain_seq:
+                        start = prev
+                    elif leaked is not None and i > 0:
+                        start = leaked
+                    n_k, params = self._local_train(cid, global_vars=start)
+                    if chain_seq:
+                        prev = params
+                    self._compat_last_start = (start if start is not None
+                                               else self.global_vars)
                     results.append((n_k, params))
                     algo_outs.append((cid, n_k, self.trainer.algo_out))
 
@@ -202,29 +259,42 @@ class FedSimAPI:
                else self.aggregator.on_before_aggregation(results))
 
         if self.algo == FED_OPT_SCAFFOLD:
-            for cid, _, out in algo_outs:
-                self.c_locals[cid] = out["c_local"]
             n_total = float(self.args.client_num_in_total)
             if compat_scaffold:
-                # Reference-bug compatibility (parity audits only): the
-                # reference's SCAFFOLD aggregation computes a weighted sum
-                # and then OVERWRITES it with the LAST client's delta
-                # (`/root/reference/python/fedml/ml/aggregator/
-                # agg_operator.py:104-117` — `total_weights_delta[k] =
-                # weights_delta[k]` after the loop), so the server applies
-                # only the last-sampled client's update and
-                # c_global += c_delta_last / N.  Default path below is the
-                # deliberate FIX (true weighted average, summed c_deltas).
+                # Reference-bug compatibility (parity audits only), bit-
+                # exact reproduction of THREE reference defects at once:
+                # (a) aggregation computes a weighted sum then OVERWRITES
+                #     it with the LAST client's delta
+                #     (`ml/aggregator/agg_operator.py:100-118`), applying
+                #     w_next = w_base + server_lr·Δ_last and
+                #     c_global += c_delta_last / N;
+                # (b) the base is the frozen ALIASED w_global — round 0:
+                #     w0 + the first client's first SGD step (see
+                #     _scaffold_leaked_start); rounds >= 1: the round
+                #     start (`sp/scaffold/scaffold_trainer.py:81,131-137`);
+                # (c) c_model_local is NEVER written back
+                #     (`sp/scaffold/client.py:44-56` rebinds dict slots,
+                #     not module params), so c_locals stay 0 — compat
+                #     therefore skips the c_locals update.
+                # Default path below is the deliberate FIX.
                 server_lr = float(getattr(self.args, "server_lr", 1.0)
                                   or 1.0)
                 _, last_params = results[-1]
+                base = (self._compat_last_start
+                        if getattr(self, "_compat_last_start", None)
+                        is not None else self.global_vars)
+                # w_next = w_global_frozen + server_lr·Δ_last, where
+                # Δ_last = w_last_trained − start_last and start_last ==
+                # the frozen w_global (all post-leak clients share it)
                 new_vars = jax.tree_util.tree_map(
-                    lambda g, w: g + (w - g) * server_lr,
-                    self.global_vars, last_params)
+                    lambda s, w: s + (w - s) * server_lr,
+                    base, last_params)
                 self.c_global = jax.tree_util.tree_map(
                     lambda c, d: c + d / n_total, self.c_global,
                     algo_outs[-1][2]["c_delta"])
                 return new_vars
+            for cid, _, out in algo_outs:
+                self.c_locals[cid] = out["c_local"]
             avg_vars = self.aggregator.aggregate(raw)
             if isinstance(avg_vars, tuple):  # not the SCAFFOLD pair path here
                 avg_vars = avg_vars[0]
