@@ -55,7 +55,8 @@ def load(args: Any) -> DatasetTuple:
                 f"import` first); none found")
 
     (x_train, y_train, x_test, y_test), class_num = load_arrays(
-        dataset, cache_dir, seed=seed, scale=scale)
+        dataset, cache_dir, seed=seed, scale=scale,
+        hard=bool(getattr(args, "synthetic_hard", False)))
 
     def _per_sample_label(y: np.ndarray) -> np.ndarray:
         if y.ndim == 1:
