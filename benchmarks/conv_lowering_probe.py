@@ -57,11 +57,11 @@ def patches_conv(x, w):
 
 def bench(fn, *args, iters=50):
     out = fn(*args)
-    jax.block_until_ready(out)
+    float(jnp.sum(out))   # axon: force a scalar transfer (BENCH_NOTES r3)
     t0 = time.time()
     for _ in range(iters):
         out = fn(*args)
-    jax.block_until_ready(out)
+    float(jnp.sum(out))
     return (time.time() - t0) / iters
 
 

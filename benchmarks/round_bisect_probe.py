@@ -29,13 +29,21 @@ NPZ_DIR = os.path.join(REPO, ".data_cache", "northstar")
 ITERS = 8
 
 
+def _sync(out):
+    # axon: block_until_ready alone under-reports by up to 100x through
+    # the tunnel — force a scalar transfer (BENCH_NOTES round 3)
+    import jax.numpy as jnp
+
+    return float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))
+
+
 def timed(name, fn, *args):
     out = fn(*args)
-    jax.block_until_ready(jax.tree_util.tree_leaves(out)[0])
+    _sync(out)
     t0 = time.time()
     for _ in range(ITERS):
         out = fn(*args)
-    jax.block_until_ready(jax.tree_util.tree_leaves(out)[0])
+        _sync(out)
     ms = (time.time() - t0) / ITERS * 1e3
     print(json.dumps({"variant": name, "ms": round(ms, 1)}))
     return out

@@ -45,11 +45,11 @@ def main():
         rngs = jax.random.split(jax.random.PRNGKey(1), k)
         step = jax.jit(jax.vmap(local_update, in_axes=(None, 0, 0, None)))
         out = step(variables, batches, rngs, None)
-        jax.block_until_ready(out[0])
+        float(out[2]["train_loss"][0])   # axon: force scalar transfer
         t0 = time.time()
         for _ in range(ITERS):
             out = step(variables, batches, rngs, None)
-        jax.block_until_ready(out[0])
+            float(out[2]["train_loss"][0])
         ms = (time.time() - t0) / ITERS * 1e3
         print(json.dumps({"k_clients": k, "ms_per_step": round(ms, 2),
                           "ms_per_client_step": round(ms / k, 3),
@@ -77,12 +77,12 @@ def probe_nb(k=5, nb=8):
     rngs = jax.random.split(jax.random.PRNGKey(1), k)
     step = jax.jit(jax.vmap(local_update, in_axes=(None, 0, 0, None)))
     out = step(variables, batches, rngs, None)
-    jax.block_until_ready(out[0])
+    float(out[2]["train_loss"][0])       # axon: force scalar transfer
     t0 = time.time()
     iters = max(4, ITERS // nb)
     for _ in range(iters):
         out = step(variables, batches, rngs, None)
-    jax.block_until_ready(out[0])
+        float(out[2]["train_loss"][0])
     ms = (time.time() - t0) / iters * 1e3
     print(json.dumps({"k_clients": k, "nb": nb,
                       "ms_per_step": round(ms, 2),

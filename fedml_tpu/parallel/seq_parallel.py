@@ -62,10 +62,15 @@ def init_lm_params(key: jax.Array, vocab: int, dim: int = 64,
     return p
 
 
+#: LayerNorm epsilon — 1e-5 matches the HF GPT-2 default so imported
+#: checkpoints (`train/llm/weight_import.py`) reproduce reference logits
+LN_EPS = 1e-5
+
+
 def _ln(x, g):
     mu = jnp.mean(x, -1, keepdims=True)
     var = jnp.var(x, -1, keepdims=True)
-    return (x - mu) * jax.lax.rsqrt(var + 1e-6) * g["scale"] + g["bias"]
+    return (x - mu) * jax.lax.rsqrt(var + LN_EPS) * g["scale"] + g["bias"]
 
 
 def lm_forward(params: Dict[str, Any], tokens: jnp.ndarray, heads: int,
@@ -86,22 +91,40 @@ def lm_forward(params: Dict[str, Any], tokens: jnp.ndarray, heads: int,
     def block(h, blk):
         y = _ln(h, blk["ln1"])
 
-        def split_heads(w):
-            return (y @ w).reshape(b, t, heads, dh).transpose(0, 2, 1, 3)
+        def proj(w, bias_key):
+            z = y @ w
+            if bias_key in blk:        # optional biases (imported HF
+                z = z + blk[bias_key]  # checkpoints carry them; native
+            return z                   # init is bias-free)
 
-        q, k, v = split_heads(blk["wq"]), split_heads(blk["wk"]), \
-            split_heads(blk["wv"])
+        def split_heads(z):
+            return z.reshape(b, t, heads, dh).transpose(0, 2, 1, 3)
+
+        q = split_heads(proj(blk["wq"], "bq"))
+        k = split_heads(proj(blk["wk"], "bk"))
+        v = split_heads(proj(blk["wv"], "bv"))
         o = attn_fn(q, k, v)                       # [B, H, T, Dh]
         o = o.transpose(0, 2, 1, 3).reshape(b, t, dim)
-        h = h + o @ blk["wo"]
+        o = o @ blk["wo"]
+        if "bo" in blk:
+            o = o + blk["bo"]
+        h = h + o
         y = _ln(h, blk["ln2"])
-        return h + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"]
+        z = y @ blk["w1"]
+        if "b1" in blk:
+            z = z + blk["b1"]
+        z = jax.nn.gelu(z) @ blk["w2"]
+        if "b2" in blk:
+            z = z + blk["b2"]
+        return h + z
 
     if remat:
         block = jax.checkpoint(block)
     for blk in params["blocks"]:
         h = block(h, blk)
     h = _ln(h, params["ln_f"])
+    if "w_out" in params:                          # optional untied head
+        return h @ params["w_out"]
     return h @ params["embed"].T                   # tied output embedding
 
 

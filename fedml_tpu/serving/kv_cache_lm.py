@@ -38,6 +38,18 @@ def init_cache(params: Dict[str, Any], batch: int, max_len: int,
             for _ in params["blocks"]]
 
 
+def _with_bias(z, blk, bkey):
+    """Optional-bias add (imported HF checkpoints carry biases; native
+    init is bias-free — same convention as `seq_parallel.lm_forward`)."""
+    return z + blk[bkey] if bkey in blk else z
+
+
+def _head(h, params):
+    if "w_out" in params:                   # optional untied output head
+        return h @ params["w_out"]
+    return h @ params["embed"].T            # tied output embedding
+
+
 @partial(jax.jit, static_argnames=("heads", "max_len"))
 def prefill(params: Dict[str, Any], tokens: jnp.ndarray,
             length: jnp.ndarray, heads: int, max_len: int = 0
@@ -59,12 +71,15 @@ def prefill(params: Dict[str, Any], tokens: jnp.ndarray,
     for blk in params["blocks"]:
         y = _ln(h, blk["ln1"])
 
-        def heads_of(w):
-            return (y @ w).reshape(b, t, heads, dh)
+        def heads_of(w, bkey):
+            z = y @ w
+            if bkey in blk:      # optional biases (imported checkpoints)
+                z = z + blk[bkey]
+            return z.reshape(b, t, heads, dh)
 
-        q = heads_of(blk["wq"]).transpose(0, 2, 1, 3)
-        k = heads_of(blk["wk"])
-        v = heads_of(blk["wv"])
+        q = heads_of(blk["wq"], "bq").transpose(0, 2, 1, 3)
+        k = heads_of(blk["wk"], "bk")
+        v = heads_of(blk["wv"], "bv")
         if max_len and max_len > t:
             pad = ((0, 0), (0, max_len - t), (0, 0), (0, 0))
             cache.append({"k": jnp.pad(k, pad), "v": jnp.pad(v, pad)})
@@ -76,11 +91,15 @@ def prefill(params: Dict[str, Any], tokens: jnp.ndarray,
         causal = pos_ids[:, None] >= pos_ids[None, :]
         s = jnp.where(causal[None, None], s, -1e30)
         o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), vt)
-        h = h + o.transpose(0, 2, 1, 3).reshape(b, t, dim) @ blk["wo"]
+        h = h + _with_bias(
+            o.transpose(0, 2, 1, 3).reshape(b, t, dim) @ blk["wo"],
+            blk, "bo")
         y = _ln(h, blk["ln2"])
-        h = h + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"]
+        h = h + _with_bias(
+            jax.nn.gelu(_with_bias(y @ blk["w1"], blk, "b1")) @ blk["w2"],
+            blk, "b2")
     h = _ln(h, params["ln_f"])
-    logits = h @ params["embed"].T                       # [B, T, V]
+    logits = _head(h, params)                            # [B, T, V]
     last = jnp.take_along_axis(
         logits, (length - 1)[:, None, None], axis=1)[:, 0]
     return cache, last
@@ -101,9 +120,9 @@ def _decode_core(params: Dict[str, Any],
     rows = jnp.arange(b)
     for blk, layer in zip(params["blocks"], cache):
         y = _ln(h, blk["ln1"])
-        q = (y @ blk["wq"]).reshape(b, heads, dh)
-        k_new = (y @ blk["wk"]).reshape(b, heads, dh)
-        v_new = (y @ blk["wv"]).reshape(b, heads, dh)
+        q = _with_bias(y @ blk["wq"], blk, "bq").reshape(b, heads, dh)
+        k_new = _with_bias(y @ blk["wk"], blk, "bk").reshape(b, heads, dh)
+        v_new = _with_bias(y @ blk["wv"], blk, "bv").reshape(b, heads, dh)
         k_cache = layer["k"].at[rows, pos].set(k_new)
         v_cache = layer["v"].at[rows, pos].set(v_new)
         new_cache.append({"k": k_cache, "v": v_cache})
@@ -112,11 +131,13 @@ def _decode_core(params: Dict[str, Any],
         s = jnp.where(valid[:, None, :], s, -1e30)
         w = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bht,bthd->bhd", w, v_cache).reshape(b, dim)
-        h = h + o @ blk["wo"]
+        h = h + _with_bias(o @ blk["wo"], blk, "bo")
         y = _ln(h, blk["ln2"])
-        h = h + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"]
+        h = h + _with_bias(
+            jax.nn.gelu(_with_bias(y @ blk["w1"], blk, "b1")) @ blk["w2"],
+            blk, "b2")
     h = _ln(h, params["ln_f"])
-    return new_cache, h @ params["embed"].T               # [B, V]
+    return new_cache, _head(h, params)                    # [B, V]
 
 
 @partial(jax.jit, static_argnames=("heads",), donate_argnums=(1,))

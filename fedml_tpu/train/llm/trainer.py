@@ -57,6 +57,11 @@ class LLMTrainConfig:
     lr_schedule: str = "constant"
     warmup_steps: int = 0
     lr_decay_steps: int = 1000
+    #: npz/safetensors checkpoint to fine-tune FROM (reference
+    #: `train/llm/train_utils.py:196-244` from_pretrained); schema
+    #: auto-detected (native / gpt2) by weight_import
+    pretrained_path: Optional[str] = None
+    pretrained_schema: str = "auto"
 
 
 def pack_sequences(token_ids: np.ndarray, seq_len: int,
@@ -88,6 +93,18 @@ class LLMTrainer:
         self.cfg = config
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         self.variables = bundle.init_variables(rng, batch_size=2)
+        self.import_report: Optional[Dict[str, Any]] = None
+        if config.pretrained_path:
+            from .weight_import import load_pretrained_into
+
+            self.variables, self.import_report = load_pretrained_into(
+                self.variables, config.pretrained_path,
+                schema=config.pretrained_schema,
+                module=getattr(bundle, "module", None))
+            logging.info(
+                "loaded pretrained weights from %s: %d tensors mapped",
+                config.pretrained_path,
+                len(self.import_report["mapped"]))
         self.lora: Dict[str, Any] = {}
         if config.use_lora:
             self.lora = init_lora(self.variables["params"],
