@@ -32,13 +32,19 @@ _SHAKESPEARE_SNIPPET = (
 )
 
 
+from .tff_text import shakespeare_vocab_size, stackoverflow_vocab_size
+
 #: single source of truth for label-space sizes (used by load_arrays AND
-#: the natural-partition path, so they can never drift apart)
+#: the natural-partition path, so they can never drift apart); the text
+#: vocab sizes come from the TFF-exact preprocessing module so they can't
+#: diverge from the tokenizers
 DATASET_CLASSES = {
     "mnist": 10, "femnist": 62,
     "cifar10": 10, "cifar100": 100, "cinic10": 10, "fed_cifar100": 100,
-    "shakespeare": 90, "fed_shakespeare": 90,
-    "stackoverflow_nwp": 10004, "stackoverflow_lr": 500,
+    "shakespeare": shakespeare_vocab_size(),
+    "fed_shakespeare": shakespeare_vocab_size(),
+    "stackoverflow_nwp": stackoverflow_vocab_size(),
+    "stackoverflow_lr": 500,
     "ilsvrc2012": 1000, "imagenet": 1000,
     "gld23k": 203, "gld160k": 2028,
 }

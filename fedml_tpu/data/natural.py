@@ -71,21 +71,36 @@ def read_leaf_json_dir(split_dir: str) -> Optional[UserData]:
 
 
 #: field-name preference for client-keyed h5 layouts: fed_shakespeare uses
-#: snippets (sequence data, y=x: the trainer derives next-token targets);
+#: snippets (byte strings → TFF char preprocessing), stackoverflow_nwp
+#: uses tokens (byte sentences → word-vocab tokenization);
 #: fed_cifar100 uses image+label (label is coarse_label's sibling)
-_H5_X_FIELDS = ("snippets", "image", "pixels", "x")
+_H5_X_FIELDS = ("snippets", "tokens", "image", "pixels", "x")
 _H5_Y_FIELDS = ("label", "labels", "y")
 
 
 def read_h5_users(path: str, x_field: Optional[str] = None,
                   y_field: Optional[str] = None) -> Optional[UserData]:
-    """fed_shakespeare/fed_cifar100-style h5: ``examples/<user>/<field>``.
-    Field names are auto-detected from the first user (x: snippets/image/
-    pixels/x; y: label/labels/y).  Sequence layouts with no label field
-    return y=x (the trainer derives next-token targets)."""
+    """Reference-schema h5 (``examples/<user>/<field>``), TFF-exact:
+
+    * ``snippets`` (fed_shakespeare,
+      `data/fed_shakespeare/data_loader.py:24-47`): byte strings →
+      char-vocab sequences of length 81 → x = seq[:, :-1],
+      y = seq[:, 1:];
+    * ``tokens`` (stackoverflow_nwp, `data/stackoverflow_nwp/dataset.py`
+      + `utils.py:54-84`): byte sentences tokenized with the
+      ``stackoverflow.word_count`` vocab living next to the h5;
+    * ``image``/``pixels`` + ``label`` (fed_cifar100): arrays as-is.
+    """
     if not os.path.exists(path):
         return None
     import h5py
+
+    from .tff_text import (
+        shakespeare_preprocess,
+        split_next_token,
+        stackoverflow_tokenize,
+        stackoverflow_word_dict,
+    )
 
     out: UserData = {}
     with h5py.File(path, "r") as h:
@@ -103,11 +118,53 @@ def read_h5_users(path: str, x_field: Optional[str] = None,
             if y_field is None:
                 y_field = next((f for f in _H5_Y_FIELDS if f in fields),
                                None)
+        so_dict = None
+        if x_field == "tokens":
+            wc = os.path.join(os.path.dirname(path),
+                              "stackoverflow.word_count")
+            if not os.path.exists(wc):
+                raise FileNotFoundError(
+                    f"stackoverflow h5 needs the word-count vocab next to "
+                    f"it ({wc}) — the reference's DEFAULT_WORD_COUNT_FILE")
+            so_dict = stackoverflow_word_dict(wc)
         for u in users:
-            x = np.asarray(grp[u][x_field])
-            y = np.asarray(grp[u][y_field]) if y_field else x
+            raw = grp[u][x_field][()]
+            arr = np.asarray(raw)
+            numeric = np.issubdtype(arr.dtype, np.number)
+            if x_field == "snippets" and not numeric:
+                x, y = split_next_token(shakespeare_preprocess(raw))
+            elif x_field == "tokens" and not numeric:
+                x, y = split_next_token(
+                    stackoverflow_tokenize(raw, so_dict))
+            else:
+                # numeric snippets/tokens = already-tokenized layout:
+                # pass through (y=x → trainer derives next-token targets)
+                x = arr
+                y = np.asarray(grp[u][y_field]) if y_field else x
             out[u] = (x, y)
     return out or None
+
+
+#: reference TFF archive stems: these h5 file names don't carry the
+#: fedml dataset name (`fed_shakespeare/data_loader.py:15-16`,
+#: `stackoverflow_nwp/data_loader.py:16-17`).  stackoverflow_lr is
+#: deliberately ABSENT: its tag-prediction pipeline must not consume the
+#: next-word-prediction archive.
+_REFERENCE_H5_STEMS = {
+    "fed_shakespeare": "shakespeare",
+    "shakespeare": "shakespeare",
+    "stackoverflow_nwp": "stackoverflow",
+}
+
+
+def _h5_stems(dataset: str):
+    """Candidate file stems for <stem>_{train,test}.h5, most specific
+    first (single source of truth for the naming rule)."""
+    stems = [dataset, dataset.replace("fed_", "")]
+    ref = _REFERENCE_H5_STEMS.get(dataset)
+    if ref:
+        stems.append(ref)
+    return list(dict.fromkeys(stems))
 
 
 # ---------------------------------------------------------------- assembly
@@ -138,12 +195,13 @@ def load_user_splits(cache_dir: str, dataset: str
         test = read_leaf_json_dir(os.path.join(leaf_root, "test")) or {}
         return train, test
 
-    h5_tr = os.path.join(cache_dir, f"{dataset}_train.h5")
-    train = read_h5_users(h5_tr)
-    if train is not None:
-        test = read_h5_users(
-            os.path.join(cache_dir, f"{dataset}_test.h5")) or {}
-        return train, test
+    for stem in _h5_stems(dataset):
+        h5_tr = os.path.join(cache_dir, f"{stem}_train.h5")
+        train = read_h5_users(h5_tr)
+        if train is not None:
+            test = read_h5_users(
+                os.path.join(cache_dir, f"{stem}_test.h5")) or {}
+            return train, test
     return None
 
 
@@ -218,8 +276,14 @@ def import_to_cache(src: str, dataset: str, cache_dir: str,
         readers.append(("leaf", lambda split: read_leaf_json_dir(
             os.path.join(src, split))))
     if fmt in ("auto", "h5"):
-        readers.append(("h5", lambda split: read_h5_users(
-            os.path.join(src, f"{dataset}_{split}.h5"))))
+        def _h5(split):
+            for stem in _h5_stems(dataset):
+                got = read_h5_users(os.path.join(src, f"{stem}_{split}.h5"))
+                if got is not None:
+                    return got
+            return None
+
+        readers.append(("h5", _h5))
     if fmt in ("auto", "npz"):
         readers.append(("npz", lambda split: read_npz_users(
             os.path.join(src, f"{dataset}_{split}.npz"))))

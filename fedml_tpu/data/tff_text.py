@@ -1,0 +1,129 @@
+"""TFF-exact text preprocessing for the reference h5 schemas.
+
+Capability parity, byte-for-byte: the reference consumes the TFF archive
+layouts and tokenizations —
+
+* fed_shakespeare (`data/fed_shakespeare/utils.py:15-77`): h5 group
+  ``examples/<client>/snippets`` of byte strings; char vocab
+  ``[<pad>] + CHAR_VOCAB + [<bos>] + [<eos>]`` (+1 OOV bucket → 90 ids),
+  each snippet becomes bos+chars+eos padded to multiples of
+  SEQUENCE_LENGTH+1 and chunked; ``split`` yields x = seq[:, :-1],
+  y = seq[:, 1:].
+* stackoverflow_nwp (`data/stackoverflow_nwp/utils.py:27-84`): h5 group
+  ``examples/<client>/tokens`` of byte sentences plus a
+  ``stackoverflow.word_count`` file ("word count" per line); word vocab
+  ``[<pad>] + 10k most frequent + [<bos>] + [<eos>]`` with OOV hashed to
+  ``len(word_dict)`` (vocab 10004), sentences truncated to 20 words,
+  bos/eos/pad to length 21.
+
+These functions reproduce that preprocessing exactly (verified against
+the reference's own utils in tests/test_natural_partition.py) so a real
+TFF-schema archive dropped into ``data_cache_dir`` trains identically.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+#: the TFF shakespeare char vocabulary, verbatim
+#: (`fed_shakespeare/utils.py:18-20`)
+SHAKESPEARE_CHAR_VOCAB = list(
+    "dhlptx@DHLPTX $(,048cgkoswCGKOSW[_#'/37;?bfjnrvzBFJNRVZ\"&*.26:"
+    "\naeimquyAEIMQUY]!%)-159\r"
+)
+SHAKESPEARE_SEQ_LEN = 80          # McMahan et al. AISTATS 2017
+PAD, BOS, EOS = "<pad>", "<bos>", "<eos>"
+
+
+def shakespeare_word_dict() -> Dict[str, int]:
+    words = [PAD] + SHAKESPEARE_CHAR_VOCAB + [BOS] + [EOS]
+    return {w: i for i, w in enumerate(words)}
+
+
+def shakespeare_vocab_size() -> int:
+    return len(shakespeare_word_dict()) + 1          # +1 OOV bucket
+
+
+def shakespeare_preprocess(snippets: Iterable[bytes],
+                           max_seq_len: int = SHAKESPEARE_SEQ_LEN
+                           ) -> np.ndarray:
+    """Byte snippets → [N, max_seq_len+1] int sequences (TFF-exact)."""
+    wd = shakespeare_word_dict()
+    oov = len(wd)
+    bos, eos, pad = wd[BOS], wd[EOS], wd[PAD]
+    out: List[List[int]] = []
+    for sn in snippets:
+        text = sn.decode("utf8") if isinstance(sn, (bytes, bytearray)) \
+            else str(sn)
+        tokens = [bos] + [wd.get(c, oov) for c in text] + [eos]
+        if len(tokens) % (max_seq_len + 1) != 0:
+            tokens += [pad] * ((-len(tokens)) % (max_seq_len + 1))
+        out.extend(tokens[i:i + max_seq_len + 1]
+                   for i in range(0, len(tokens), max_seq_len + 1))
+    return np.asarray(out, np.int64).reshape(-1, max_seq_len + 1)
+
+
+def split_next_token(seqs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """x = seq[:, :-1], y = seq[:, 1:] (`fed_shakespeare/utils.py:80-84`)."""
+    ds = np.asarray(seqs)
+    return ds[:, :-1], ds[:, 1:]
+
+
+# ------------------------------------------------------------ stackoverflow
+SO_SEQ_LEN = 20
+SO_VOCAB_WORDS = 10_000
+
+
+def stackoverflow_word_dict(word_count_path: str,
+                            vocab_size: int = SO_VOCAB_WORDS
+                            ) -> Dict[str, int]:
+    """``stackoverflow.word_count`` ("word count" per line, frequency
+    order) → the reference's OrderedDict vocab.  (Deviation: a file
+    shorter than vocab_size yields a smaller vocab instead of the
+    reference's StopIteration crash — lets small fixtures work.)"""
+    frequent: List[str] = []
+    with open(word_count_path) as f:
+        for line in f:
+            if len(frequent) >= vocab_size:
+                break
+            if line.strip():
+                frequent.append(line.split()[0])
+    words = [PAD] + frequent + [BOS] + [EOS]
+    return {w: i for i, w in enumerate(words)}
+
+
+def stackoverflow_tokenize(sentences: Iterable[bytes],
+                           word_dict: Dict[str, int],
+                           max_seq_len: int = SO_SEQ_LEN,
+                           num_oov_buckets: int = 1) -> np.ndarray:
+    """Byte sentences → [N, max_seq_len+1] ids (TFF-exact: truncate to
+    max_seq_len words, bos prefix, eos only when short, pad to 21; OOV
+    hashes into buckets past the vocab)."""
+    n = len(word_dict)
+    bos, eos, pad = word_dict[BOS], word_dict[EOS], word_dict[PAD]
+
+    def wid(w: str) -> int:
+        if w in word_dict:
+            return word_dict[w]
+        return hash(w) % num_oov_buckets + n
+
+    out = []
+    for sn in sentences:
+        text = sn.decode("utf8") if isinstance(sn, (bytes, bytearray)) \
+            else str(sn)
+        words = text.split(" ")[:max_seq_len]
+        tokens = [wid(w) for w in words]
+        if len(tokens) < max_seq_len:
+            tokens = tokens + [eos]
+        tokens = [bos] + tokens
+        if len(tokens) < max_seq_len + 1:
+            tokens += [pad] * (max_seq_len + 1 - len(tokens))
+        out.append(tokens)
+    return np.asarray(out, np.int64).reshape(-1, max_seq_len + 1)
+
+
+def stackoverflow_vocab_size(vocab_size: int = SO_VOCAB_WORDS,
+                             num_oov_buckets: int = 1) -> int:
+    return vocab_size + 3 + num_oov_buckets          # pad/bos/eos + oov
