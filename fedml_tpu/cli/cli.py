@@ -89,9 +89,39 @@ def _launch_and_echo(job_yaml: str, job_type: str) -> None:
 
 @cli.command()
 @click.argument("job_yaml", type=click.Path(exists=True))
-def launch(job_yaml: str) -> None:
-    """Launch a job.yaml locally (reference `fedml launch`)."""
-    _launch_and_echo(job_yaml, "launch")
+@click.option("--remote", default=None, metavar="URL",
+              help="submit through a fleet control plane "
+                   "(http://host:port) instead of running locally")
+@click.option("--api-key", default=None, help="control-plane api key")
+@click.option("--edges", default=None,
+              help="comma-separated edge ids (default: resource match)")
+@click.option("--num-edges", default=1, help="edges to match when --edges "
+                                             "is not given")
+@click.option("--device-kind", default=None,
+              help="resource-match device kind filter")
+@click.option("--wait/--no-wait", "wait_done", default=True,
+              help="wait for the remote run to finish")
+def launch(job_yaml: str, remote: str, api_key: str, edges: str,
+           num_edges: int, device_kind: str, wait_done: bool) -> None:
+    """Launch a job.yaml locally, or remotely via the HTTP control plane
+    (reference `fedml launch` → REST backend → MQTT fleet)."""
+    if not remote:
+        _launch_and_echo(job_yaml, "launch")
+        return
+    from ..scheduler.control_plane import ControlPlaneClient
+
+    client = ControlPlaneClient(remote, api_key=api_key)
+    run_id = client.create_run(
+        job_yaml,
+        edges=[e for e in (edges or "").split(",") if e] or None,
+        match=(None if edges else {"num_edges": int(num_edges),
+                                   "device_kind": device_kind}))
+    click.echo(json.dumps({"run_id": run_id, "remote": remote}))
+    if wait_done:
+        result = client.wait(run_id)
+        click.echo(json.dumps(result))
+        if not (result.get("completed") and result.get("success")):
+            sys.exit(1)      # match the local path's nonzero-on-failure
 
 
 @cli.command()

@@ -500,6 +500,28 @@ class MasterAgent:
         """Dispatch a run to explicit ``edges`` or to a resource-matched
         set (``match={"num_edges": 2, "min_free_slots": 1,
         "device_kind": "tpu"}``)."""
+        zip_path = local_launcher.build_job_package(job_yaml_path)
+        with open(zip_path, "rb") as f:
+            package = f.read()
+        return self.create_run_from_package(
+            package, edges=edges, config_overrides=config_overrides,
+            env=env, match=match)
+
+    def fleet(self) -> Dict[str, Dict[str, Any]]:
+        """Current fleet registry snapshot (live heartbeats)."""
+        with self._lock:
+            return dict(self._fleet)
+
+    def create_run_from_package(self, package: bytes,
+                                edges: Optional[List[str]] = None,
+                                config_overrides: Optional[Dict[str, Any]]
+                                = None,
+                                env: Optional[Dict[str, str]] = None,
+                                match: Optional[Dict[str, Any]] = None
+                                ) -> str:
+        """Dispatch a PREBUILT job package (the HTTP control plane's
+        entry: the remote CLI builds and uploads the zip, like the
+        reference CLI uploads to S3 before `run_manager` dispatch)."""
         if edges is None:
             if not match:
                 raise ValueError("pass edges=[...] or match={...}")
@@ -509,10 +531,8 @@ class MasterAgent:
                 match.get("device_kind"),
                 float(match.get("max_age_s", 60.0)))
         run_id = uuid.uuid4().hex[:12]
-        zip_path = local_launcher.build_job_package(job_yaml_path)
         key = f"packages/{run_id}.zip"
-        with open(zip_path, "rb") as f:
-            self.store.write(key, f.read())
+        self.store.write(key, package)
         with self._lock:
             self._status[run_id] = {}
             self._events[run_id] = threading.Event()
@@ -562,5 +582,9 @@ class MasterAgent:
                     for s in statuses.values())}
 
     def status(self, run_id: str) -> Dict[str, Any]:
+        """Per-edge status for a known run; raises KeyError on an unknown
+        run id (a stale/typoed id must fail fast, not look idle)."""
         with self._lock:
-            return dict(self._status.get(run_id, {}))
+            if run_id not in self._status:
+                raise KeyError(run_id)
+            return dict(self._status[run_id])

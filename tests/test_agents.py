@@ -408,3 +408,77 @@ def test_master_matches_edges_by_advertised_resources(tmp_path):
     finally:
         for a in agents:
             a.stop()
+
+
+@pytest.mark.slow
+def test_http_control_plane_two_process(tmp_path, monkeypatch):
+    """VERDICT r3 item 7 end to end, across OS PROCESSES: the control
+    plane (MasterAgent + HTTP server) runs in its own process over a real
+    TCP MQTT broker; a slave agent joins the fleet in this process; the
+    CLI submits via --remote (build package → HTTP upload → MQTT
+    dispatch) and the run completes."""
+    import subprocess
+    import sys
+
+    from click.testing import CliRunner
+
+    from fedml_tpu.cli.cli import cli as cli_root
+    from fedml_tpu.core.distributed.communication.mqtt_s3.mini_mqtt import (
+        MiniMqttBroker,
+    )
+    from fedml_tpu.scheduler.agents import SlaveAgent
+
+    broker = MiniMqttBroker()
+    store = str(tmp_path / "store")
+    env = dict(os.environ, FEDML_MQTT_HOST=broker.host,
+               FEDML_MQTT_PORT=str(broker.port), JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "fedml_tpu.scheduler.control_plane",
+         "--port", "0", "--channel", "cp-agents", "--store-dir", store,
+         "--api-key", "sekrit"],
+        env=env, stdout=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline()
+        url = json.loads(line)["control_plane"]
+
+        monkeypatch.setenv("FEDML_MQTT_HOST", broker.host)
+        monkeypatch.setenv("FEDML_MQTT_PORT", str(broker.port))
+        agent = SlaveAgent("cp-e1", channel="cp-agents", store_dir=store,
+                           heartbeat_s=0.5).start()
+        try:
+            from fedml_tpu.scheduler.control_plane import ControlPlaneClient
+
+            client = ControlPlaneClient(url, api_key="sekrit")
+            assert client.health()["ok"]
+            # auth is enforced
+            with pytest.raises(RuntimeError, match="401"):
+                ControlPlaneClient(url, api_key="wrong").fleet()
+            # the heartbeat reaches the control plane's fleet registry
+            deadline = time.time() + 20
+            while "cp-e1" not in client.fleet() and time.time() < deadline:
+                time.sleep(0.3)
+            assert "cp-e1" in client.fleet()
+            assert client.match(1) == ["cp-e1"]
+
+            res = CliRunner().invoke(cli_root, [
+                "launch", _write_job(tmp_path), "--remote", url,
+                "--api-key", "sekrit", "--num-edges", "1"])
+            assert res.exit_code == 0, res.output
+            lines = [json.loads(x) for x in
+                     res.output.strip().splitlines()]
+            assert lines[0]["run_id"]
+            final = lines[1]
+            assert final["completed"] and final["success"], final
+            st = final["edges"]["cp-e1"]
+            assert st["status"] == "FINISHED"
+            assert "JOB_RAN" in open(st["log_path"]).read()
+
+            # stop + status surface over HTTP too
+            assert client.status(lines[0]["run_id"])["cp-e1"][
+                "status"] == "FINISHED"
+        finally:
+            agent.stop()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+        broker.stop()
