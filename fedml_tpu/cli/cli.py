@@ -125,6 +125,35 @@ def launch(job_yaml: str, remote: str, api_key: str, edges: str,
 
 
 @cli.command()
+@click.option("--card", required=True, help="model card to serve")
+@click.option("--registry-root", default=None)
+@click.option("--host", default="127.0.0.1")
+@click.option("--port", default=2345)
+@click.option("--replicas", default=1)
+@click.option("--db", default=None, help="endpoint metrics sqlite path")
+@click.option("--max-replicas", default=8)
+@click.option("--target-latency-s", default=1.0)
+def serve(card: str, registry_root: str, host: str, port: int,
+          replicas: int, db: str, max_replicas: int,
+          target_latency_s: float) -> None:
+    """Serve a model card: replica processes behind a gateway with
+    per-request metrics, metrics-driven autoscaling and version rollback
+    (reference `device_model_deployment.py` endpoint bring-up).  The
+    devops/ container assets call THIS entrypoint."""
+    from ..serving.serve_entry import main as serve_main
+
+    argv = ["--card", card, "--host", host, "--port", str(port),
+            "--replicas", str(replicas),
+            "--max-replicas", str(max_replicas),
+            "--target-latency-s", str(target_latency_s)]
+    if registry_root:
+        argv += ["--registry-root", registry_root]
+    if db:
+        argv += ["--db", db]
+    serve_main(argv)
+
+
+@cli.command()
 @click.argument("job_yaml", type=click.Path(exists=True))
 @click.option("--dest", default=None, help="output directory")
 def build(job_yaml: str, dest: str) -> None:

@@ -500,12 +500,22 @@ class MasterAgent:
         """Dispatch a run to explicit ``edges`` or to a resource-matched
         set (``match={"num_edges": 2, "min_free_slots": 1,
         "device_kind": "tpu"}``)."""
+        # validate/resolve the edge set BEFORE paying for the package
+        # build (an unsatisfiable launch should fail fast)
+        if edges is None:
+            if not match:
+                raise ValueError("pass edges=[...] or match={...}")
+            edges = self.match_edges(
+                int(match.get("num_edges", 1)),
+                int(match.get("min_free_slots", 1)),
+                match.get("device_kind"),
+                float(match.get("max_age_s", 60.0)))
         zip_path = local_launcher.build_job_package(job_yaml_path)
         with open(zip_path, "rb") as f:
             package = f.read()
         return self.create_run_from_package(
             package, edges=edges, config_overrides=config_overrides,
-            env=env, match=match)
+            env=env)
 
     def fleet(self) -> Dict[str, Dict[str, Any]]:
         """Current fleet registry snapshot (live heartbeats)."""
@@ -547,6 +557,8 @@ class MasterAgent:
         return run_id
 
     def stop_run(self, run_id: str) -> None:
+        if run_id not in self._edges:
+            raise KeyError(run_id)       # stale ids fail fast, like status
         for edge in self._edges.get(run_id, []):
             self.broker.publish(_topic_stop(edge), json.dumps(
                 {"run_id": run_id}).encode())

@@ -36,9 +36,10 @@ import re
 import threading
 import urllib.error
 import urllib.request
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
+from ..utils.http_json import BadRequest, JsonHandler
 from .agents import MasterAgent
 
 _RUN_PATH = re.compile(r"^/api/v1/runs/([0-9a-f]+)(/(wait|stop))?$")
@@ -51,26 +52,13 @@ class ControlPlaneServer:
         self.api_key = api_key or None
         plane = self
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):  # noqa: D102 — quiet server
-                pass
-
-            def _reply(self, code: int, body: Dict[str, Any]) -> None:
-                data = json.dumps(body).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
+        class Handler(JsonHandler):
+            _reply = JsonHandler.reply
 
             def _authed(self) -> bool:
                 if plane.api_key is None:
                     return True
                 return self.headers.get("X-Api-Key") == plane.api_key
-
-            def _body(self) -> Dict[str, Any]:
-                n = int(self.headers.get("Content-Length", 0) or 0)
-                return json.loads(self.rfile.read(n).decode()) if n else {}
 
             def do_GET(self) -> None:  # noqa: N802
                 if self.path == "/healthz":
@@ -88,13 +76,12 @@ class ControlPlaneServer:
                     except KeyError:
                         return self._reply(404, {"error": "unknown run"})
                 if m and m.group(3) == "wait":
-                    q = self.path.split("?", 1)
-                    timeout = 300.0
-                    if len(q) > 1 and q[1].startswith("timeout="):
-                        timeout = float(q[1].split("=", 1)[1])
                     try:
+                        timeout = self.query_float("timeout", 300.0)
                         return self._reply(200, plane.master.wait(
                             m.group(1), timeout=timeout))
+                    except BadRequest as e:
+                        return self._reply(400, {"error": str(e)})
                     except KeyError:
                         return self._reply(404, {"error": "unknown run"})
                 return self._reply(404, {"error": "not found"})
@@ -103,8 +90,8 @@ class ControlPlaneServer:
                 if not self._authed():
                     return self._reply(401, {"error": "bad api key"})
                 try:
-                    body = self._body()
-                except Exception:  # noqa: BLE001
+                    body = self.json_body()
+                except BadRequest:
                     return self._reply(400, {"error": "bad json"})
                 if self.path == "/api/v1/match":
                     try:
@@ -136,7 +123,10 @@ class ControlPlaneServer:
                         return self._reply(409, {"error": str(e)})
                 m = _RUN_PATH.match(self.path)
                 if m and m.group(3) == "stop":
-                    plane.master.stop_run(m.group(1))
+                    try:
+                        plane.master.stop_run(m.group(1))
+                    except KeyError:
+                        return self._reply(404, {"error": "unknown run"})
                     return self._reply(200, {"ok": True})
                 return self._reply(404, {"error": "not found"})
 

@@ -42,40 +42,88 @@ class ModelCardRegistry:
         with open(self.index_path, "w") as f:
             json.dump(idx, f, indent=1)
 
+    #: version-history retention per card (older version dirs are pruned)
+    KEEP_VERSIONS = 5
+
     # -- card ops (reference device_model_cards create/delete/list) ----------
     def create(self, name: str, model_path: str,
                metadata: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
-        """Register a model dir/file as a named card (copied into the
-        registry so later deploys are self-contained)."""
+        """Register a model dir/file as a NEW VERSION of a named card
+        (copied into the registry so later deploys are self-contained).
+        Prior versions are retained (up to KEEP_VERSIONS) so a bad deploy
+        can ``rollback`` — the reference's endpoint-update/rollback
+        capability (`model_scheduler/device_model_deployment.py` endpoint
+        replacement)."""
         if not os.path.exists(model_path):
             raise FileNotFoundError(model_path)
-        card_dir = os.path.join(self.root, name)
-        if os.path.abspath(model_path) != os.path.abspath(card_dir):
-            # stage into a temp dir BEFORE clearing the card dir: the source
-            # may live inside the current card dir (re-registering a pulled
-            # card's own file), and the card dir must still end up clean so a
-            # re-created card never serves stale files from an old version
-            tmp_dir = os.path.join(self.root,
-                                   f".tmp_{name}_{uuid.uuid4().hex[:6]}")
-            try:
-                if os.path.isdir(model_path):
-                    shutil.copytree(model_path, tmp_dir)
-                else:
-                    os.makedirs(tmp_dir, exist_ok=True)
-                    shutil.copy(model_path, tmp_dir)
-            except BaseException:
-                shutil.rmtree(tmp_dir, ignore_errors=True)
-                raise
-            shutil.rmtree(card_dir, ignore_errors=True)
-            os.rename(tmp_dir, card_dir)
+        version = uuid.uuid4().hex[:8]
+        version_dir = os.path.join(self.root, name, f"v_{version}")
+        # stage into a temp dir first: the source may live inside the
+        # current card dir (re-registering a pulled card's own file)
+        tmp_dir = os.path.join(self.root,
+                               f".tmp_{name}_{uuid.uuid4().hex[:6]}")
+        try:
+            if os.path.isdir(model_path):
+                shutil.copytree(model_path, tmp_dir)
+            else:
+                os.makedirs(tmp_dir, exist_ok=True)
+                shutil.copy(model_path, tmp_dir)
+        except BaseException:
+            shutil.rmtree(tmp_dir, ignore_errors=True)
+            raise
+        os.makedirs(os.path.dirname(version_dir), exist_ok=True)
+        os.rename(tmp_dir, version_dir)
+
+        idx = self._load()
+        prev = idx.get(name, {})
+        versions = list(prev.get("versions", []))
+        versions.append({"version": version, "path": version_dir,
+                         "created": time.time()})
+        # prune beyond retention (never the newly-current one)
+        while len(versions) > self.KEEP_VERSIONS:
+            dead = versions.pop(0)
+            shutil.rmtree(dead["path"], ignore_errors=True)
         card = {
             "name": name,
-            "version": uuid.uuid4().hex[:8],
-            "path": card_dir,
+            "version": version,
+            "path": version_dir,
+            "versions": versions,
             "metadata": metadata or {},
             "created": time.time(),
         }
+        idx[name] = card
+        self._save(idx)
+        return card
+
+    def rollback(self, name: str) -> Dict[str, Any]:
+        """Repoint the card to its PREVIOUS version (the endpoint-rollback
+        primitive; replicas pick it up on restart/rolling update)."""
         idx = self._load()
+        if name not in idx:
+            raise KeyError(f"unknown model card {name!r}")
+        card = idx[name]
+        versions = card.get("versions", [])
+        cur = card["version"]
+        pos = next((i for i, v in enumerate(versions)
+                    if v["version"] == cur), len(versions) - 1)
+        if pos <= 0:
+            raise RuntimeError(
+                f"card {name!r} has no earlier version to roll back to")
+        return self.repoint(name, versions[pos - 1]["version"])
+
+    def repoint(self, name: str, version: str) -> Dict[str, Any]:
+        """Point the card at a SPECIFIC retained version (rollback's
+        primitive; also the roll-forward/undo path)."""
+        idx = self._load()
+        if name not in idx:
+            raise KeyError(f"unknown model card {name!r}")
+        card = idx[name]
+        target = next((v for v in card.get("versions", [])
+                       if v["version"] == version), None)
+        if target is None:
+            raise KeyError(f"card {name!r} has no retained version "
+                           f"{version!r}")
+        card = dict(card, version=target["version"], path=target["path"])
         idx[name] = card
         self._save(idx)
         return card
@@ -94,7 +142,8 @@ class ModelCardRegistry:
         idx = self._load()
         if name not in idx:
             return False
-        shutil.rmtree(idx[name]["path"], ignore_errors=True)
+        # remove EVERY version (they all live under <root>/<name>/)
+        shutil.rmtree(os.path.join(self.root, name), ignore_errors=True)
         del idx[name]
         self._save(idx)
         return True
@@ -133,36 +182,35 @@ class ModelCardRegistry:
 
         store = store or create_store(object())
         tmp = os.path.join(self.root, f"_pull_{uuid.uuid4().hex[:6]}.zip")
+        stage = os.path.join(self.root, f"_pull_{uuid.uuid4().hex[:6]}")
         try:
             with open(tmp, "wb") as f:
                 f.write(store.read(key))
             with zipfile.ZipFile(tmp) as z:
                 card = json.loads(z.read("card.json").decode())
-                target = os.path.join(self.root, card["name"])
-                shutil.rmtree(target, ignore_errors=True)
-                target_abs = os.path.abspath(target)
+                stage_abs = os.path.abspath(stage)
                 for info in z.infolist():
                     if not info.filename.startswith("model/") or \
                             info.is_dir():
                         continue
                     rel = os.path.relpath(info.filename, "model")
-                    out = os.path.normpath(os.path.join(target, rel))
+                    out = os.path.normpath(os.path.join(stage, rel))
                     # zip-slip guard: refuse entries escaping the card dir
                     if not os.path.abspath(out).startswith(
-                            target_abs + os.sep):
+                            stage_abs + os.sep):
                         raise ValueError(
                             f"refusing unsafe zip entry {info.filename!r}")
                     os.makedirs(os.path.dirname(out), exist_ok=True)
                     with open(out, "wb") as g:
                         g.write(z.read(info))
+            # register as a NEW LOCAL VERSION: the zipped card's version
+            # paths belong to the pushing machine, not this one
+            return self.create(card["name"], stage,
+                               metadata=card.get("metadata"))
         finally:
             if os.path.exists(tmp):
                 os.remove(tmp)
-        card["path"] = target
-        idx = self._load()
-        idx[card["name"]] = card
-        self._save(idx)
-        return card
+            shutil.rmtree(stage, ignore_errors=True)
 
     # -- deploy (reference device_model_deployment + inference gateway) ------
     def deploy(self, name: str, host: str = "127.0.0.1", port: int = 0,
@@ -246,6 +294,25 @@ class EndpointDB:
         return {"requests": int(n or 0),
                 "avg_latency_ms": float(avg) if avg is not None else None,
                 "success": int(oks or 0)}
+
+    def window(self, endpoint: str, window_s: float = 30.0
+               ) -> Dict[str, Any]:
+        """Recent-window metrics — the autoscaler's observation input
+        (reference `device_model_monitor.py` rolling QPS/latency)."""
+        cutoff = time.time() - float(window_s)
+        conn = self._conn()
+        row = conn.execute(
+            "SELECT COUNT(*), AVG(latency_ms), SUM(1-ok) FROM requests "
+            "WHERE endpoint=? AND ts>=?", (endpoint, cutoff)).fetchone()
+        conn.close()
+        n, avg, errs = row
+        n = int(n or 0)
+        return {"qps": n / float(window_s),
+                "avg_latency_s": (float(avg) / 1000.0
+                                  if avg is not None else 0.0),
+                "errors": int(errs or 0),
+                "requests": n,
+                "window_s": float(window_s)}
 
 
 class Endpoint:

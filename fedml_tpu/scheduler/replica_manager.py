@@ -95,6 +95,9 @@ class ReplicaProcessManager:
                         return
             except Exception:  # noqa: BLE001 — still booting
                 time.sleep(0.1)
+        # kill the half-booted child: leaving it running would squat the
+        # slot's port and leak a process
+        self._kill(rep)
         raise TimeoutError(f"replica on :{rep.port} never became ready")
 
     def scale_to(self, n: int) -> int:
@@ -141,6 +144,33 @@ class ReplicaProcessManager:
     def live_count(self) -> int:
         return sum(1 for r in self.replicas
                    if r is not None and r.proc.poll() is None)
+
+    def rolling_restart(self) -> None:
+        """Restart replicas ONE AT A TIME (version rollout/rollback: each
+        respawn loads the card's now-current version; the other slots keep
+        serving).  The slot is retired (None) around the swap so the
+        monitor can't double-spawn it."""
+        with self._scale_lock:
+            for slot in range(len(self.replicas)):
+                with self._lock:
+                    rep = self.replicas[slot]
+                    if rep is None:
+                        continue
+                    self.replicas[slot] = None      # retire during swap
+                self._kill(rep)
+                try:
+                    new = self._spawn(slot)
+                except Exception:
+                    # reinstall the (dead) old replica: the monitor loop
+                    # retries DEAD slots every tick, so capacity heals
+                    # once the card becomes loadable again — a None slot
+                    # would be lost forever
+                    with self._lock:
+                        self.replicas[slot] = rep
+                    raise
+                new.restarts = rep.restarts + 1
+                with self._lock:
+                    self.replicas[slot] = new
 
     # -- self-healing monitor ----------------------------------------------
     def start_monitor(self) -> None:

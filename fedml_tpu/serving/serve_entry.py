@@ -1,0 +1,211 @@
+"""`fedml serve` — the config-driven serving entrypoint containers run.
+
+Capability parity: the reference brings endpoints up inside containers
+with health checks, records per-request metrics, and autoscales/replaces
+replicas (`model_scheduler/device_model_deployment.py:89-928`,
+`device_model_db.py`, `comm_utils/job_monitor.py`).  This module is the
+TPU-era, container-free core the Dockerfile/compose/k8s assets call:
+
+* a GATEWAY HTTP server (stdlib) fronting `ReplicaProcessManager`:
+  /predict (round-robin to replica processes, per-request metrics into
+  EndpointDB), /ready, /stats, /scale, /rollback;
+* an autoscale loop DRIVEN FROM THE METRICS STORE: every tick reads the
+  recent window (qps/latency) from EndpointDB and feeds
+  `ReplicaAutoscaler.observe`, whose apply_fn is `manager.scale_to`;
+* versioned-endpoint rollback: POST /rollback repoints the model card to
+  its previous version (`ModelCardRegistry.rollback`) and rolling-
+  restarts the replicas onto it.
+
+Entry: ``fedml serve --card NAME [--port ...]`` (cli.py) or
+``python -m fedml_tpu.serving.serve_entry``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from http.server import ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from ..utils.http_json import BadRequest, JsonHandler
+from ..scheduler.autoscaler import AutoscalePolicy, ReplicaAutoscaler
+from ..scheduler.model_cards import EndpointDB, ModelCardRegistry
+from ..scheduler.replica_manager import ReplicaProcessManager
+
+
+class ServeGateway:
+    def __init__(self, card_name: str,
+                 registry_root: Optional[str] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 replicas: int = 1,
+                 db_path: Optional[str] = None,
+                 policy: Optional[AutoscalePolicy] = None,
+                 autoscale_interval_s: float = 10.0) -> None:
+        self.card_name = card_name
+        self.registry = ModelCardRegistry(root=registry_root)
+        self.db = EndpointDB(path=db_path)
+        self.manager = ReplicaProcessManager(card_name,
+                                             registry_root=registry_root)
+        self.manager.scale_to(int(replicas))
+        self.manager.start_monitor()
+        self.policy = policy or AutoscalePolicy(
+            min_replicas=int(replicas))
+        self.autoscaler = ReplicaAutoscaler(
+            self.policy, apply_fn=self.manager.scale_to)
+        self.autoscaler.replicas = int(replicas)
+        self.autoscale_interval_s = float(autoscale_interval_s)
+        self._stop = threading.Event()
+        gw = self
+
+        class Handler(JsonHandler):
+            _reply = JsonHandler.reply
+
+            def do_GET(self) -> None:  # noqa: N802
+                if self.path == "/ready":
+                    return self._reply(200, {
+                        "ready": gw.manager.live_count() > 0})
+                if self.path == "/stats":
+                    return self._reply(200, gw.stats())
+                return self._reply(404, {"error": "not found"})
+
+            def do_POST(self) -> None:  # noqa: N802
+                try:
+                    body = self.json_body()
+                except BadRequest:
+                    return self._reply(400, {"error": "bad json"})
+                if self.path == "/predict":
+                    # record BEFORE replying: the metric must be visible
+                    # to a /stats request issued right after the response
+                    t0 = time.time()
+                    try:
+                        out = gw.manager.predict(body)
+                        err = None
+                    except RuntimeError as e:
+                        err = str(e)
+                    gw.db.record(gw.card_name,
+                                 (time.time() - t0) * 1000.0, err is None)
+                    if err is not None:
+                        return self._reply(503, {"error": err})
+                    return self._reply(200, out)
+                if self.path == "/scale":
+                    try:
+                        n_req = int(body["replicas"])
+                    except (KeyError, ValueError, TypeError):
+                        return self._reply(400,
+                                           {"error": "replicas: int"})
+                    gw.manager.scale_to(n_req)
+                    gw.autoscaler.replicas = n_req
+                    return self._reply(200, {"replicas": n_req})
+                if self.path == "/rollback":
+                    try:
+                        card = gw.rollback()
+                        return self._reply(200, {
+                            "version": card["version"]})
+                    except (KeyError, RuntimeError) as e:
+                        return self._reply(409, {"error": str(e)})
+                    except Exception as e:  # noqa: BLE001 — e.g. EROFS
+                        logging.exception("rollback failed")
+                        return self._reply(500, {"error": str(e)})
+                return self._reply(404, {"error": "not found"})
+
+        self._srv = ThreadingHTTPServer((host, port), Handler)
+        self._srv.daemon_threads = True
+        self.host, self.port = self._srv.server_address
+        self._http_thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True,
+            name="serve-gateway")
+        self._scale_thread = threading.Thread(
+            target=self._autoscale_loop, daemon=True,
+            name="serve-autoscale")
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "ServeGateway":
+        self._http_thread.start()
+        self._scale_thread.start()
+        return self
+
+    # -- metrics-driven autoscaling ----------------------------------------
+    def autoscale_tick(self) -> int:
+        """One observation from the REQUEST-METRICS STORE into the
+        autoscaler (exposed for tests and external schedulers)."""
+        w = self.db.window(self.card_name,
+                           window_s=max(self.autoscale_interval_s * 3,
+                                        30.0))
+        return self.autoscaler.observe(w["qps"], w["avg_latency_s"])
+
+    def _autoscale_loop(self) -> None:
+        while not self._stop.wait(self.autoscale_interval_s):
+            try:
+                self.autoscale_tick()
+            except Exception:  # noqa: BLE001 — keep the loop alive
+                import logging
+
+                logging.exception("autoscale tick failed")
+
+    # -- versioned rollback -------------------------------------------------
+    def rollback(self) -> Dict[str, Any]:
+        """Repoint the card to its previous version and rolling-restart
+        the replicas onto it.  If the restart fails (rolled-back version
+        won't load), the registry is repointed BACK so the index never
+        disagrees with what the surviving replicas actually serve."""
+        before = self.registry.get(self.card_name)["version"]
+        card = self.registry.rollback(self.card_name)
+        try:
+            self.manager.rolling_restart()
+        except Exception:
+            self.registry.repoint(self.card_name, before)
+            raise
+        return card
+
+    def stats(self) -> Dict[str, Any]:
+        card = self.registry.get(self.card_name)
+        return {
+            "card": self.card_name,
+            "version": card["version"],
+            "replicas": self.manager.stats(),
+            "endpoint": self.db.stats(self.card_name),
+            "window": self.db.window(self.card_name),
+        }
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._srv.shutdown()
+        self._srv.server_close()
+        self.manager.shutdown()
+
+
+def main(argv: Optional[list] = None) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(description="fedml_tpu serving gateway")
+    p.add_argument("--card", required=True)
+    p.add_argument("--registry-root", default=None)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=2345)
+    p.add_argument("--replicas", type=int, default=1)
+    p.add_argument("--db", default=None, help="endpoint metrics sqlite")
+    p.add_argument("--max-replicas", type=int, default=8)
+    p.add_argument("--target-latency-s", type=float, default=1.0)
+    cli = p.parse_args(argv)
+    gw = ServeGateway(
+        cli.card, registry_root=cli.registry_root, host=cli.host,
+        port=cli.port, replicas=cli.replicas, db_path=cli.db,
+        policy=AutoscalePolicy(min_replicas=cli.replicas,
+                               max_replicas=cli.max_replicas,
+                               target_latency_s=cli.target_latency_s),
+    ).start()
+    print(json.dumps({"serving": gw.url, "card": cli.card}), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        gw.stop()
+
+
+if __name__ == "__main__":
+    main()

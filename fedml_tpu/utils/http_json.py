@@ -1,0 +1,54 @@
+"""Shared stdlib JSON-over-HTTP handler scaffold.
+
+One place for the pattern every control/serving HTTP surface repeats
+(quiet logging, JSON replies with Content-Length, body parsing with a
+clean 400): subclass `JsonHandler` and implement do_GET/do_POST with
+`self.reply(code, dict)` and `self.json_body()`.
+"""
+
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler
+from typing import Any, Dict
+from urllib.parse import parse_qs, urlparse
+
+
+class BadRequest(Exception):
+    """Raise inside a handler to produce a clean 400 with a message."""
+
+
+class JsonHandler(BaseHTTPRequestHandler):
+    def log_message(self, *a):  # noqa: D102 — quiet server
+        pass
+
+    def reply(self, code: int, body: Dict[str, Any]) -> None:
+        data = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def json_body(self) -> Dict[str, Any]:
+        n = int(self.headers.get("Content-Length", 0) or 0)
+        if not n:
+            return {}
+        try:
+            return json.loads(self.rfile.read(n).decode())
+        except Exception as e:  # noqa: BLE001
+            raise BadRequest("bad json") from e
+
+    def query(self) -> Dict[str, str]:
+        """Last-wins flat query dict (order-independent, never raises)."""
+        q = parse_qs(urlparse(self.path).query)
+        return {k: v[-1] for k, v in q.items()}
+
+    def query_float(self, name: str, default: float) -> float:
+        raw = self.query().get(name)
+        if raw is None:
+            return default
+        try:
+            return float(raw)
+        except ValueError as e:
+            raise BadRequest(f"{name} must be a number") from e
