@@ -32,6 +32,14 @@ def main() -> None:
                    help="reproduce the reference's round-0 state_dict "
                         "aliasing (sequential clients chain; see "
                         "parity_round0_oracle.py)")
+    p.add_argument("--feddyn-ref-bug-compat", action="store_true",
+                   help="reproduce the reference FedDyn trainer's dead "
+                        "penalties + unweighted-sum server math "
+                        "(fed_api._server_update compat branch)")
+    p.add_argument("--mime-ref-compat", action="store_true",
+                   help="reproduce the reference Mime trainer: full-grad "
+                        "at trained params clipped to norm 1, torch-SGD "
+                        "server step, every-round client chaining")
     cli = p.parse_args()
 
     if not os.path.exists(os.path.join(CACHE, "leaf_mnist_train.npz")):
@@ -43,13 +51,18 @@ def main() -> None:
     import fedml_tpu
     from fedml_tpu.runner import FedMLRunner
 
+    # the FedDyn reference's regularization penalties are gradient-dead
+    # (param.data), so its LOCAL update is plain FedAvg SGD; the server
+    # math runs in the fed_api compat branch
+    local_opt = ("FedAvg" if cli.feddyn_ref_bug_compat
+                 else cli.optimizer)
     args = fedml_tpu.init(fedml_tpu.Config(
         dataset="mnist",
         data_cache_dir=CACHE,
         partition_method="natural",      # LEAF users, like the reference
         model="lr",
         backend="sp",
-        federated_optimizer=cli.optimizer,
+        federated_optimizer=local_opt,
         client_num_in_total=2,           # overridden by natural user count
         client_num_per_round=2,
         comm_round=cli.rounds,
@@ -57,10 +70,12 @@ def main() -> None:
         batch_size=10,
         client_optimizer="sgd",
         learning_rate=0.03,
-        # the reference's SGD branch IGNORES weight_decay (ml/trainer/
-        # my_model_trainer_classification.py:29-33 passes only lr) — match
-        # that effective behavior; deviation documented in docs/PARITY.md
-        weight_decay=0.0,
+        # the reference's FedAvg-family SGD branch IGNORES weight_decay
+        # (ml/trainer/my_model_trainer_classification.py:29-33 passes only
+        # lr) — but its FedDyn trainer DOES pass it (feddyn_trainer.py:
+        # 58-62), so the compat run matches the config's 0.001
+        weight_decay=(0.001 if (cli.feddyn_ref_bug_compat
+                                or cli.mime_ref_compat) else 0.0),
         # match the reference lr model exactly: sigmoid before CE
         # (`model/linear/lr.py:11`) — deviation docs in docs/PARITY.md
         lr_sigmoid_outputs=True,
@@ -68,6 +83,8 @@ def main() -> None:
         server_lr=1.0,
         scaffold_ref_bug_compat=cli.scaffold_ref_bug_compat,
         fedavg_ref_chain_compat=cli.fedavg_ref_chain_compat,
+        feddyn_ref_bug_compat=cli.feddyn_ref_bug_compat,
+        mime_ref_compat=cli.mime_ref_compat,
         frequency_of_the_test=1,
         enable_tracking=False,
         compute_dtype="float32",
@@ -89,6 +106,13 @@ def main() -> None:
         np.random.shuffle(y)
         train_local[cid] = (x, y)
 
+    if cli.mime_ref_compat:
+        # the reference Mime trainer evaluates ONLY client 0's local test
+        # split (its all-clients loop is commented out,
+        # `sp/mime/mime_trainer.py:_local_test_on_all_clients`)
+        ds = list(dataset)
+        ds[3] = ds[6][0]
+        dataset = tuple(ds)
     bundle = fedml_tpu.model.create(args, dataset[-1])
     runner = FedMLRunner(args, device, dataset, bundle)
 

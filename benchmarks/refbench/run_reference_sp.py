@@ -51,7 +51,8 @@ CONFIG = {
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--optimizer", default="FedAvg",
-                   choices=["FedAvg", "FedProx", "SCAFFOLD"])
+                   choices=["FedAvg", "FedProx", "SCAFFOLD",
+                            "FedNova", "FedDyn", "Mime"])
     p.add_argument("--rounds", type=int, default=10)
     cli, _ = p.parse_known_args()
     CONFIG["train_args"]["federated_optimizer"] = cli.optimizer
@@ -62,6 +63,32 @@ def main() -> None:
     CONFIG["train_args"]["server_lr"] = 1.0
     # scaffold_trainer.py:62 requires this flag (no default in Arguments)
     CONFIG["train_args"]["initialize_all_clients"] = False
+    # FedNova (sp/fednova/client.py:84-93 custom optimizer knobs): plain
+    # SGD semantics for the parity run
+    CONFIG["train_args"]["gmf"] = 0
+    CONFIG["train_args"]["mu"] = 0
+    CONFIG["train_args"]["momentum"] = 0.0
+    CONFIG["train_args"]["dampening"] = 0.0
+    CONFIG["train_args"]["wd"] = 0.0
+    CONFIG["train_args"]["nesterov"] = False
+    # FedDyn (ml/trainer/feddyn_trainer.py alpha)
+    CONFIG["train_args"]["feddyn_alpha"] = 0.01
+    # Mime (sp/mime/mime_trainer.py server opt + mimelite flag)
+    CONFIG["train_args"]["server_optimizer"] = "sgd"
+    CONFIG["train_args"]["server_momentum"] = 0.9
+    CONFIG["train_args"]["mimelite"] = True
+    if cli.optimizer in ("FedNova", "Mime"):
+        # fednova_trainer.py / mime_trainer.py log Test/Acc ONLY through
+        # wandb (no mlops.log); enable it against the refbench stub
+        CONFIG["tracking_args"]["enable_wandb"] = True
+        CONFIG["tracking_args"]["wandb_project"] = "refbench"
+        CONFIG["tracking_args"]["wandb_name"] = "refbench"
+        CONFIG["tracking_args"]["wandb_key"] = "stub"
+        CONFIG["tracking_args"]["run_name"] = "refbench"
+        CONFIG["tracking_args"]["ci"] = False
+        CONFIG["tracking_args"]["wandb_entity"] = None
+        CONFIG["tracking_args"]["wandb_group"] = None
+        CONFIG["tracking_args"]["wandb_offline"] = True
 
     os.makedirs(CACHE, exist_ok=True)
     if not os.path.exists(os.path.join(CACHE, "MNIST", "train")):
@@ -102,6 +129,9 @@ def main() -> None:
 
     _mlops.log = _capture
     fedml.mlops.log = _capture
+    import wandb as _wandb  # the refbench stub
+
+    _wandb.log = lambda metrics, *a, **k: _capture(metrics)
 
     t_setup = time.time()
     args = fedml.init()

@@ -127,29 +127,31 @@ def launch(job_yaml: str, remote: str, api_key: str, edges: str,
 @cli.command()
 @click.option("--card", required=True, help="model card to serve")
 @click.option("--registry-root", default=None)
-@click.option("--host", default="127.0.0.1")
-@click.option("--port", default=2345)
-@click.option("--replicas", default=1)
+@click.option("--host", default=None)
+@click.option("--port", default=None, type=int)
+@click.option("--replicas", default=None, type=int)
 @click.option("--db", default=None, help="endpoint metrics sqlite path")
-@click.option("--max-replicas", default=8)
-@click.option("--target-latency-s", default=1.0)
+@click.option("--max-replicas", default=None, type=int)
+@click.option("--target-latency-s", default=None, type=float)
 def serve(card: str, registry_root: str, host: str, port: int,
           replicas: int, db: str, max_replicas: int,
           target_latency_s: float) -> None:
     """Serve a model card: replica processes behind a gateway with
     per-request metrics, metrics-driven autoscaling and version rollback
     (reference `device_model_deployment.py` endpoint bring-up).  The
-    devops/ container assets call THIS entrypoint."""
+    devops/ container assets call THIS entrypoint.  Defaults live in ONE
+    place — serve_entry.main's argparse — so `fedml serve` and
+    `python -m fedml_tpu.serving.serve_entry` can never diverge."""
     from ..serving.serve_entry import main as serve_main
 
-    argv = ["--card", card, "--host", host, "--port", str(port),
-            "--replicas", str(replicas),
-            "--max-replicas", str(max_replicas),
-            "--target-latency-s", str(target_latency_s)]
-    if registry_root:
-        argv += ["--registry-root", registry_root]
-    if db:
-        argv += ["--db", db]
+    argv = ["--card", card]
+    for flag, val in (("--registry-root", registry_root),
+                      ("--host", host), ("--port", port),
+                      ("--replicas", replicas), ("--db", db),
+                      ("--max-replicas", max_replicas),
+                      ("--target-latency-s", target_latency_s)):
+        if val is not None:
+            argv += [flag, str(val)]
     serve_main(argv)
 
 

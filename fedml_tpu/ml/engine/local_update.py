@@ -102,6 +102,12 @@ class LocalUpdateSpec:
     feddyn_alpha: float = 0.0
     mime_beta: float = 0.9
     compute_dtype: Any = None
+    #: reference-Mime compatibility (parity audits): local steps are
+    #: plain SGD (the parity config's client momentum is 0, so the
+    #: server-state blend vanishes — `ml/trainer/mime_trainer.py:40-47`)
+    #: and full_grad is the SUM of batch-mean grads at the FINAL params,
+    #: clipped to global norm 1 (`accumulate_data_grad` + `clip_norm`)
+    mime_ref_compat: bool = False
 
 
 def build_local_update(bundle: ModelBundle, cfg: Any) -> Callable:
@@ -114,6 +120,7 @@ def build_local_update(bundle: ModelBundle, cfg: Any) -> Callable:
         fedprox_mu=float(getattr(cfg, "fedprox_mu", 0.1) or 0.0),
         feddyn_alpha=float(getattr(cfg, "feddyn_alpha", 0.01) or 0.0),
         mime_beta=float(getattr(cfg, "server_momentum", 0.9) or 0.9),
+        mime_ref_compat=bool(getattr(cfg, "mime_ref_compat", False)),
     )
     tx = build_client_optimizer(cfg)
 
@@ -157,7 +164,7 @@ def build_local_update(bundle: ModelBundle, cfg: Any) -> Callable:
                 grads = jax.tree_util.tree_map(
                     lambda g, c, ci: g + c - ci,
                     grads, algo_state["c_global"], algo_state["c_local"])
-            elif spec.algorithm == FED_OPT_MIME:
+            elif spec.algorithm == FED_OPT_MIME and not spec.mime_ref_compat:
                 s = algo_state["server_momentum"]
                 b = spec.mime_beta
                 grads = jax.tree_util.tree_map(
@@ -222,21 +229,36 @@ def build_local_update(bundle: ModelBundle, cfg: Any) -> Callable:
                 lambda g, l: (g - l) * inv, global_params, params)
             algo_out["tau"] = tau
         elif spec.algorithm == FED_OPT_MIME:
-            # mean minibatch gradient at w_global for server momentum update
-            def grad_at_global(carry, batch_idx):
+            # anchor for the full-dataset gradient: the published
+            # algorithm evaluates at w_global; the reference implementation
+            # accumulates at the TRAINED params (`accumulate_data_grad`)
+            anchor_p = params if spec.mime_ref_compat else global_params
+            anchor_s = model_state if spec.mime_ref_compat else model_state0
+
+            def grad_at_anchor(carry, batch_idx):
                 acc, cnt, rng = carry
                 rng, sub = jax.random.split(rng)
                 batch = jax.tree_util.tree_map(lambda b: b[batch_idx], batches)
                 valid = jnp.any(batch["mask"] > 0)
-                (_, _), g = grad_fn(global_params, model_state0, batch, sub,
+                (_, _), g = grad_fn(anchor_p, anchor_s, batch, sub,
                                     global_params, algo_state)
                 return (_tree_add(acc, g),
                         cnt + jnp.where(valid, 1.0, 0.0), rng), None
 
             zero = _tree_scale(global_params, 0.0)
             (gsum, cnt, _), _ = jax.lax.scan(
-                grad_at_global, (zero, jnp.zeros(()), rng), jnp.arange(nb))
-            algo_out["full_grad"] = _tree_scale(gsum, 1.0 / jnp.maximum(cnt, 1.0))
+                grad_at_anchor, (zero, jnp.zeros(()), rng), jnp.arange(nb))
+            if spec.mime_ref_compat:
+                # reference semantics: SUM of batch-mean grads (one
+                # zero_grad, accumulated backward) clipped to norm 1
+                norm = jnp.sqrt(sum(
+                    jnp.sum(jnp.square(x))
+                    for x in jax.tree_util.tree_leaves(gsum)))
+                coef = jnp.minimum(1.0 / (norm + 1e-6), 1.0)
+                algo_out["full_grad"] = _tree_scale(gsum, coef)
+            else:
+                algo_out["full_grad"] = _tree_scale(
+                    gsum, 1.0 / jnp.maximum(cnt, 1.0))
         return new_variables, algo_out, metrics
 
     return local_update

@@ -502,20 +502,27 @@ class MasterAgent:
         "device_kind": "tpu"}``)."""
         # validate/resolve the edge set BEFORE paying for the package
         # build (an unsatisfiable launch should fail fast)
-        if edges is None:
-            if not match:
-                raise ValueError("pass edges=[...] or match={...}")
-            edges = self.match_edges(
-                int(match.get("num_edges", 1)),
-                int(match.get("min_free_slots", 1)),
-                match.get("device_kind"),
-                float(match.get("max_age_s", 60.0)))
+        edges = self._resolve_edges(edges, match)
         zip_path = local_launcher.build_job_package(job_yaml_path)
         with open(zip_path, "rb") as f:
             package = f.read()
         return self.create_run_from_package(
             package, edges=edges, config_overrides=config_overrides,
             env=env)
+
+    def _resolve_edges(self, edges: Optional[List[str]],
+                       match: Optional[Dict[str, Any]]) -> List[str]:
+        """Explicit edges, or the resource-matched set (single source of
+        the match-dict contract)."""
+        if edges is not None:
+            return list(edges)
+        if not match:
+            raise ValueError("pass edges=[...] or match={...}")
+        return self.match_edges(
+            int(match.get("num_edges", 1)),
+            int(match.get("min_free_slots", 1)),
+            match.get("device_kind"),
+            float(match.get("max_age_s", 60.0)))
 
     def fleet(self) -> Dict[str, Dict[str, Any]]:
         """Current fleet registry snapshot (live heartbeats)."""
@@ -532,14 +539,7 @@ class MasterAgent:
         """Dispatch a PREBUILT job package (the HTTP control plane's
         entry: the remote CLI builds and uploads the zip, like the
         reference CLI uploads to S3 before `run_manager` dispatch)."""
-        if edges is None:
-            if not match:
-                raise ValueError("pass edges=[...] or match={...}")
-            edges = self.match_edges(
-                int(match.get("num_edges", 1)),
-                int(match.get("min_free_slots", 1)),
-                match.get("device_kind"),
-                float(match.get("max_age_s", 60.0)))
+        edges = self._resolve_edges(edges, match)
         run_id = uuid.uuid4().hex[:12]
         key = f"packages/{run_id}.zip"
         self.store.write(key, package)

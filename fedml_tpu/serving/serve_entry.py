@@ -48,8 +48,6 @@ class ServeGateway:
         self.db = EndpointDB(path=db_path)
         self.manager = ReplicaProcessManager(card_name,
                                              registry_root=registry_root)
-        self.manager.scale_to(int(replicas))
-        self.manager.start_monitor()
         self.policy = policy or AutoscalePolicy(
             min_replicas=int(replicas))
         self.autoscaler = ReplicaAutoscaler(
@@ -92,12 +90,20 @@ class ServeGateway:
                 if self.path == "/scale":
                     try:
                         n_req = int(body["replicas"])
+                        if n_req < 0:
+                            raise ValueError
                     except (KeyError, ValueError, TypeError):
-                        return self._reply(400,
-                                           {"error": "replicas: int"})
-                    gw.manager.scale_to(n_req)
-                    gw.autoscaler.replicas = n_req
-                    return self._reply(200, {"replicas": n_req})
+                        return self._reply(
+                            400, {"error": "replicas: non-negative int"})
+                    try:
+                        n_now = gw.manager.scale_to(n_req)
+                    except Exception as e:  # noqa: BLE001 — boot failure
+                        logging.exception("scale failed")
+                        gw.autoscaler.replicas = gw.manager.live_count()
+                        return self._reply(500, {"error": str(e)})
+                    # report/track the ACTUAL count, not the request
+                    gw.autoscaler.replicas = n_now
+                    return self._reply(200, {"replicas": n_now})
                 if self.path == "/rollback":
                     try:
                         card = gw.rollback()
@@ -110,9 +116,18 @@ class ServeGateway:
                         return self._reply(500, {"error": str(e)})
                 return self._reply(404, {"error": "not found"})
 
+        # bind the HTTP port BEFORE booting replica processes: a bind
+        # failure must not leak orphaned replica_worker children
         self._srv = ThreadingHTTPServer((host, port), Handler)
         self._srv.daemon_threads = True
         self.host, self.port = self._srv.server_address
+        try:
+            self.manager.scale_to(int(replicas))
+            self.manager.start_monitor()
+        except BaseException:
+            self.manager.shutdown()
+            self._srv.server_close()
+            raise
         self._http_thread = threading.Thread(
             target=self._srv.serve_forever, daemon=True,
             name="serve-gateway")
@@ -158,7 +173,14 @@ class ServeGateway:
         try:
             self.manager.rolling_restart()
         except Exception:
+            # repoint BACK, then best-effort restart so slots that already
+            # swapped to the rolled-back version return to the current one
+            # (otherwise they'd serve mixed versions silently)
             self.registry.repoint(self.card_name, before)
+            try:
+                self.manager.rolling_restart()
+            except Exception:  # noqa: BLE001 — monitor keeps healing
+                logging.exception("post-failure restore restart failed")
             raise
         return card
 

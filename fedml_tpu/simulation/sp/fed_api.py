@@ -185,6 +185,12 @@ class FedSimAPI:
                 self.args, "scaffold_ref_bug_compat", False))
             chain_seq = (round_idx == 0 and bool(getattr(
                 self.args, "fedavg_ref_chain_compat", False)))
+            # Mime's reference re-aliases w_global to the shared model
+            # EVERY round (`sp/mime/mime_trainer.py:123` rebinds w_global
+            # to get_model_params() after the server step), so its
+            # sequential clients chain in every round, not just round 0
+            if getattr(self.args, "mime_ref_compat", False):
+                chain_seq = True
             # SCAFFOLD's reference aliasing is different: its trainer's
             # c-correction REBINDS param.data each batch
             # (`ml/trainer/scaffold_trainer.py:166-170`), so w_global
@@ -251,6 +257,37 @@ class FedSimAPI:
     def _server_update(self, round_idx: int, client_ids: List[int],
                        results: List[Tuple[float, Any]],
                        algo_outs: List[Tuple[int, float, Dict[str, Any]]]):
+        if getattr(self.args, "feddyn_ref_bug_compat", False):
+            # Reference-bug compatibility (parity audits only) for FedDyn's
+            # SP trainer, reproducing THREE defects at once:
+            # (a) the dynamic-regularization penalties are computed on
+            #     `param.data` (`ml/trainer/feddyn_trainer.py:45-60`) so
+            #     they contribute ZERO gradient — local training is plain
+            #     SGD (run this compat with federated_optimizer=FedAvg);
+            # (b) aggregation is an UNWEIGHTED SUM of client params
+            #     (`ml/aggregator/agg_operator.py:68-78`), later divided
+            #     by K, i.e. a uniform (not sample-weighted) average;
+            # (c) `old_w_global = get_model_params()` at aggregation time
+            #     ALIASES the shared model = the LAST client's trained
+            #     weights (`sp/feddyn/feddyn_trainer.py:119-130`), not the
+            #     round's start, so the h-state tracks a biased delta.
+            # Server math verbatim: h -= a*(w_sum - w_last*K)/N;
+            # w_next = w_sum/K - h.  Default FedDyn implements the paper.
+            alpha = float(getattr(self.args, "feddyn_alpha", 0.01) or 0.01)
+            k_count = float(len(results))
+            n_total = float(self.args.client_num_in_total)
+            if not hasattr(self, "_feddyn_ref_h"):
+                self._feddyn_ref_h = jax.tree_util.tree_map(
+                    jnp.zeros_like, self.global_vars)
+            w_sum = jax.tree_util.tree_map(
+                lambda *xs: sum(xs), *[p for _, p in results])
+            w_last = results[-1][1]
+            self._feddyn_ref_h = jax.tree_util.tree_map(
+                lambda h, s, l: h - alpha * (s - l * k_count) / n_total,
+                self._feddyn_ref_h, w_sum, w_last)
+            return jax.tree_util.tree_map(
+                lambda s, h: s / k_count - h, w_sum, self._feddyn_ref_h)
+
         compat_scaffold = (self.algo == FED_OPT_SCAFFOLD and getattr(
             self.args, "scaffold_ref_bug_compat", False))
         # compat mode bypasses aggregation entirely — don't run the
@@ -324,10 +361,29 @@ class FedSimAPI:
             from ...ml.aggregator.agg_operator import weighted_average
             g = weighted_average(grads)
             beta = float(getattr(self.args, "server_momentum", 0.9) or 0.9)
-            self.mime_momentum = jax.tree_util.tree_map(
-                lambda m, gg: beta * m + (1.0 - beta) * gg,
-                self.mime_momentum, g)
-            new_vars = avg_vars
+            if getattr(self.args, "mime_ref_compat", False):
+                # Reference-Mime server step (`sp/mime/mime_trainer.py:
+                # 119-125` + OptRepo SGD): torch-SGD momentum on the
+                # AVERAGED params with the averaged clipped full grads —
+                # d = g + wd*w_avg; B <- sm*B + d; w <- w_avg -
+                # server_lr*B.  (The published MimeLite keeps w = avg and
+                # only updates the momentum state — the default below.)
+                wd = float(getattr(self.args, "weight_decay", 0.0) or 0.0)
+                server_lr = float(getattr(self.args, "server_lr", 1.0)
+                                  or 1.0)
+                d = jax.tree_util.tree_map(
+                    lambda gg, w: gg + wd * w, g, avg_vars["params"])
+                self.mime_momentum = jax.tree_util.tree_map(
+                    lambda m, dd: beta * m + dd, self.mime_momentum, d)
+                params = jax.tree_util.tree_map(
+                    lambda w, m: w - server_lr * m,
+                    avg_vars["params"], self.mime_momentum)
+                new_vars = dict(avg_vars, params=params)
+            else:
+                self.mime_momentum = jax.tree_util.tree_map(
+                    lambda m, gg: beta * m + (1.0 - beta) * gg,
+                    self.mime_momentum, g)
+                new_vars = avg_vars
         elif self.algo == FED_OPT_FEDDYN:
             for cid, _, out in algo_outs:
                 self.feddyn_lambdas[cid] = out["feddyn_lambda"]

@@ -342,3 +342,35 @@ def test_bucketed_mesh_compiles_collectives(args_factory, mesh_clients,
         api.device_data, api.global_vars, api.server_state,
         jax.random.PRNGKey(0)).compile()
     assert "all-reduce" in compiled.as_text()
+
+
+@pytest.mark.slow
+def test_bucketed_vs_uniform_statistical_equivalence(args_factory):
+    """VERDICT r3 item 9: size-bucketed hetero rounds are a SCHEDULING
+    optimization, not an algorithm change — over >=3 seeds the final
+    accuracy distribution must match the uniform path (same budget)."""
+    def final_acc(buckets, seed):
+        args = fedml_tpu.init(args_factory(
+            backend="parrot", dataset="mnist", model="lr",
+            partition_method="hetero", partition_alpha=0.3,
+            client_num_in_total=12, client_num_per_round=6,
+            comm_round=25, data_scale=0.3, batch_size=16,
+            learning_rate=0.1, random_seed=seed,
+            hetero_buckets=buckets, frequency_of_the_test=100))
+        device = fedml_tpu.device.get_device(args)
+        dataset = fedml_tpu.data.load(args)
+        bundle = fedml_tpu.model.create(args, dataset[-1])
+        api = FedMLRunner(args, device, dataset, bundle).runner
+        api.run_rounds_fused(25)
+        tb = api._make_test_batches()
+        out = api.eval_step(api.global_vars, tb)
+        return float(out["correct"]) / max(float(out["n"]), 1.0)
+
+    seeds = (0, 1, 2)
+    uniform = [final_acc(1, s) for s in seeds]
+    bucketed = [final_acc(3, s) for s in seeds]
+    mu_u, mu_b = float(np.mean(uniform)), float(np.mean(bucketed))
+    # same-convergence criterion: mean finals within 5pp and every run
+    # lands in the learned regime (not chance)
+    assert abs(mu_u - mu_b) < 0.05, (uniform, bucketed)
+    assert min(uniform + bucketed) > 0.5, (uniform, bucketed)
