@@ -20,8 +20,10 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 sys.path.insert(0, REPO)
 
-N_BUCKETS = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+_pos = [a for a in sys.argv[1:] if not a.startswith("--")]
+N_BUCKETS = int(_pos[0]) if _pos else 4
 USE_CACHE = "--no-cache" not in sys.argv
+CONV_IMPL = "patches" if "--patches" in sys.argv else "lax"
 NPZ_DIR = os.path.join(REPO, ".data_cache", "northstar")
 
 import jax  # noqa: E402
@@ -48,7 +50,8 @@ def main() -> None:
         client_num_in_total=100, client_num_per_round=10, comm_round=512,
         epochs=1, batch_size=32, learning_rate=0.05,
         frequency_of_the_test=1000, enable_tracking=False,
-        compute_dtype="bfloat16", hetero_buckets=N_BUCKETS))
+        compute_dtype="bfloat16", hetero_buckets=N_BUCKETS,
+        conv_impl=CONV_IMPL))
     device = fedml_tpu.device.get_device(args)
     dataset = fedml_tpu.data.load(args)
     bundle = fedml_tpu.model.create(args, dataset[-1])
@@ -80,6 +83,7 @@ def main() -> None:
     flops_round = padded * RESNET56_FWD_FLOPS * TRAIN_MULT
     print(json.dumps({
         "buckets_requested": N_BUCKETS,
+        "conv_impl": CONV_IMPL,
         "buckets_effective": len(eff_b),
         "clients_per_bucket": eff_b,
         "cache": USE_CACHE,

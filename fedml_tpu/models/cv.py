@@ -102,27 +102,78 @@ def _norm(norm: str, train: bool, dtype) -> Callable:
                    epsilon=1e-5, dtype=dtype, param_dtype=jnp.float32)
 
 
+class PatchesConv(nn.Module):
+    """3x3/1x1 SAME conv expressed as im2col + matmul.
+
+    Identical math to ``nn.Conv(use_bias=False)`` (same kernel param
+    name/shape, verified equal in tests), but the contraction is a plain
+    matmul — under ``vmap`` with per-client weights it lowers to a
+    BATCHED MATMUL instead of XLA's feature_group_count grouped
+    convolution (the lowering the Parrot bucket sweep measured as the
+    multi-client penalty, `benchmarks/BENCH_NOTES.md` round 3)."""
+
+    features: int
+    kernel_size: tuple = (3, 3)
+    strides: tuple = (1, 1)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = self.kernel_size
+        cin = x.shape[-1]
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (kh, kw, cin, self.features), jnp.float32)
+        x = x.astype(self.dtype)       # match nn.Conv's dtype promotion
+        k = kernel.astype(self.dtype)
+        if (kh, kw) == (1, 1):
+            sh, sw = self.strides
+            return jnp.einsum("nhwc,cf->nhwf", x[:, ::sh, ::sw, :],
+                              k[0, 0])
+        p = jax.lax.conv_general_dilated_patches(
+            x, (kh, kw), self.strides, "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        # patches features are ordered cin-major (C x H x W)
+        w2d = k.transpose(2, 0, 1, 3).reshape(cin * kh * kw,
+                                              self.features)
+        return jnp.einsum("nhwp,pf->nhwf", p, w2d)
+
+
+def _conv_cls(conv_impl: str):
+    if conv_impl == "patches":
+        def make(features, kernel_size, strides=(1, 1), dtype=jnp.float32):
+            return PatchesConv(features, tuple(kernel_size),
+                               tuple(strides), dtype)
+        return make
+
+    def make(features, kernel_size, strides=(1, 1), dtype=jnp.float32):
+        return nn.Conv(features, kernel_size, strides=strides,
+                       padding="SAME", use_bias=False, dtype=dtype)
+    return make
+
+
 class BasicBlock(nn.Module):
     filters: int
     stride: int = 1
     norm: str = "bn"
     dtype: Any = jnp.float32
+    conv_impl: str = "lax"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         norm = _norm(self.norm, train, self.dtype)
+        conv = _conv_cls(self.conv_impl)
         residual = x
-        y = nn.Conv(self.filters, (3, 3), strides=(self.stride, self.stride),
-                    padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        y = conv(self.filters, (3, 3), (self.stride, self.stride),
+                 self.dtype)(x)
         y = norm()(y)
         y = nn.relu(y)
-        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False,
-                    dtype=self.dtype)(y)
+        y = conv(self.filters, (3, 3), dtype=self.dtype)(y)
         y = norm()(y)
         if residual.shape != y.shape:
-            residual = nn.Conv(self.filters, (1, 1),
-                               strides=(self.stride, self.stride),
-                               use_bias=False, dtype=self.dtype)(residual)
+            residual = conv(self.filters, (1, 1),
+                            (self.stride, self.stride),
+                            self.dtype)(residual)
             residual = norm()(residual)
         return nn.relu(residual + y)
 
@@ -135,21 +186,23 @@ class CIFARResNet(nn.Module):
     num_classes: int = 10
     norm: str = "bn"
     dtype: Any = jnp.float32
+    #: "lax" (XLA conv) | "patches" (im2col+matmul — batched-matmul
+    #: lowering under vmapped per-client weights)
+    conv_impl: str = "lax"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         n = (self.depth - 2) // 6
         norm = _norm(self.norm, train, self.dtype)
         x = x.astype(self.dtype)
-        x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False,
-                    dtype=self.dtype)(x)
+        x = _conv_cls(self.conv_impl)(16, (3, 3), dtype=self.dtype)(x)
         x = norm()(x)
         x = nn.relu(x)
         for stage, filters in enumerate((16, 32, 64)):
             for block in range(n):
                 stride = 2 if (stage > 0 and block == 0) else 1
-                x = BasicBlock(filters, stride, self.norm, self.dtype)(
-                    x, train=train)
+                x = BasicBlock(filters, stride, self.norm, self.dtype,
+                               self.conv_impl)(x, train=train)
         x = jnp.mean(x, axis=(1, 2))
         return nn.Dense(self.num_classes, dtype=self.dtype,
                         param_dtype=jnp.float32)(x).astype(jnp.float32)

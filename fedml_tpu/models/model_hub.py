@@ -100,8 +100,10 @@ def create(args: Any, output_dim: Optional[int] = None) -> ModelBundle:
             module = FedAvgCNN(num_classes, dtype=dtype)
     elif name in ("resnet56", "resnet20", "resnet32"):
         depth = int(name.replace("resnet", ""))
-        module = CIFARResNet(depth=depth, num_classes=num_classes, dtype=dtype,
-                             norm=str(getattr(args, "norm", "bn")))
+        module = CIFARResNet(
+            depth=depth, num_classes=num_classes, dtype=dtype,
+            norm=str(getattr(args, "norm", "bn")),
+            conv_impl=str(getattr(args, "conv_impl", "lax") or "lax"))
     elif name in ("resnet18", "resnet18_gn"):
         module = ResNet18(num_classes=num_classes, dtype=dtype,
                           norm="gn" if name.endswith("gn") else "bn")

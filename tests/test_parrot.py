@@ -374,3 +374,29 @@ def test_bucketed_vs_uniform_statistical_equivalence(args_factory):
     # lands in the learned regime (not chance)
     assert abs(mu_u - mu_b) < 0.05, (uniform, bucketed)
     assert min(uniform + bucketed) > 0.5, (uniform, bucketed)
+
+
+def test_patches_conv_matches_lax_conv():
+    """PatchesConv (im2col+matmul) must be numerically identical to
+    nn.Conv for 3x3/1x1, strided and not — it's a lowering choice, not a
+    model change."""
+    import jax
+    import jax.numpy as jnp
+    from flax import linen as nn
+
+    from fedml_tpu.models.cv import PatchesConv
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 8, 8, 5), jnp.float32)
+    for kernel, strides in (((3, 3), (1, 1)), ((3, 3), (2, 2)),
+                            ((1, 1), (1, 1)), ((1, 1), (2, 2))):
+        ref = nn.Conv(7, kernel, strides=strides, padding="SAME",
+                      use_bias=False)
+        mine = PatchesConv(7, kernel, strides)
+        v = ref.init(jax.random.PRNGKey(0), x)
+        out_ref = ref.apply(v, x)
+        out_mine = mine.apply(v, x)          # same param name/shape
+        np.testing.assert_allclose(np.asarray(out_mine),
+                                   np.asarray(out_ref),
+                                   atol=2e-5, rtol=1e-5,
+                                   err_msg=f"{kernel} {strides}")
