@@ -20,7 +20,7 @@ math; parity-tested token-for-token against the non-cached forward).
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -262,3 +262,24 @@ class KVCacheLM:
 
         return lm_forward(self.params, tokens, self.heads,
                           partial(reference_attention, causal=True))
+
+
+def kv_lm_from_checkpoint(path: str, heads: int,
+                          max_len: Optional[int] = None,
+                          schema: str = "auto") -> "KVCacheLM":
+    """Serve an imported checkpoint (npz/safetensors, native or GPT-2
+    naming) through the KV-cache engine — the deploy half of the
+    reference's fine-tune → checkpoint → serve path
+    (`train/llm/train_utils.py:196-244` + `device_model_deployment.py`).
+    Heads are validated against the checkpoint dims; ``max_len`` defaults
+    to the checkpoint's position-table length."""
+    from ..train.llm.weight_import import (
+        import_lm_weights,
+        validate_lm_shapes,
+    )
+
+    params, _report = import_lm_weights(path, schema=schema)
+    validate_lm_shapes(params, heads=heads)
+    if max_len is None:
+        max_len = int(params["pos"].shape[0])
+    return KVCacheLM(params, heads, int(max_len))

@@ -215,3 +215,63 @@ def test_biasfree_gpt2_schema_passes_strict_and_mismatch_raises():
         validate_lm_shapes(loaded, heads=3)
     with pytest.raises(ValueError, match="max_len"):
         validate_lm_shapes(loaded, min_len=999)
+
+
+@pytest.mark.slow
+def test_openai_serving_from_imported_gpt2_checkpoint(tmp_path):
+    """Deploy half of the import loop: GPT-2-format checkpoint FILE →
+    kv_lm_from_checkpoint → continuous-batching engine → OpenAI chat API.
+    Greedy first token must equal transformers' own argmax next token."""
+    import urllib.request
+
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from fedml_tpu.serving.kv_cache_lm import kv_lm_from_checkpoint
+    from fedml_tpu.serving.llm_engine import (
+        KVCacheLLMEngine,
+        LLMEnginePredictor,
+    )
+    from fedml_tpu.serving.openai_api import OpenAIServer
+
+    cfg = transformers.GPT2Config(
+        vocab_size=90, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    model = transformers.GPT2LMHeadModel(cfg).eval()
+    path = str(tmp_path / "gpt2_tiny.npz")
+    np.savez(path, **{k: v.detach().cpu().numpy()
+                      for k, v in model.state_dict().items()})
+
+    lm = kv_lm_from_checkpoint(path, heads=4)
+    assert lm.vocab == 90 and lm.max_len == 64
+    engine = KVCacheLLMEngine(lm, max_batch=2)
+    predictor = LLMEnginePredictor(engine)      # char codec, vocab 90
+    server = OpenAIServer(predictor, model_name="gpt2-tiny", port=0)
+    try:
+        server.run(block=False)
+        body = json.dumps({"model": "gpt2-tiny", "max_tokens": 4,
+                            "temperature": 0,
+                            "messages": [{"role": "user",
+                                          "content": "hello"}]}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/chat/completions",
+            data=body, headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            out = json.loads(resp.read())
+        text = out["choices"][0]["message"]["content"]
+        assert len(text) == 4
+
+        # greedy first token must sit at (or within float tolerance of)
+        # transformers' argmax — random-init logits can tie to ~1e-4, so
+        # exact-id equality would flake on tie-breaks
+        # the server wraps messages in its chat template — compare on the
+        # exact prompt the engine saw
+        ids = predictor.encode("user: hello\nassistant:")
+        with torch.no_grad():
+            ref_logits = model(torch.tensor([ids])).logits[0, -1].numpy()
+        ours = predictor.encode(text[0])[0]
+        assert ref_logits[ours] >= ref_logits.max() - 1e-3, (
+            text[0], float(ref_logits[ours]), float(ref_logits.max()))
+    finally:
+        server.stop()
+        engine.stop()
