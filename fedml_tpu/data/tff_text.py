@@ -25,6 +25,8 @@ from __future__ import annotations
 
 from typing import Dict, Iterable, List, Optional, Tuple
 
+import zlib
+
 import numpy as np
 
 #: the TFF shakespeare char vocabulary, verbatim
@@ -107,7 +109,11 @@ def stackoverflow_tokenize(sentences: Iterable[bytes],
     def wid(w: str) -> int:
         if w in word_dict:
             return word_dict[w]
-        return hash(w) % num_oov_buckets + n
+        # Deterministic OOV bucketing (crc32, not Python's salted hash()):
+        # token ids must not vary with PYTHONHASHSEED across processes.
+        # Deviation from TFF's fingerprint64 — bucket ASSIGNMENT may differ
+        # from TFF's, but is stable across runs, which TFF also guarantees.
+        return zlib.crc32(w.encode("utf8")) % num_oov_buckets + n
 
     out = []
     for sn in sentences:

@@ -79,9 +79,18 @@ class ModelCardRegistry:
         versions = list(prev.get("versions", []))
         versions.append({"version": version, "path": version_dir,
                          "created": time.time()})
-        # prune beyond retention (never the newly-current one)
+        # prune beyond retention — never the newly-current one, and never
+        # the version live replicas may still be serving (after a rollback
+        # the card's current version can sit anywhere in the list, not at
+        # the tail, so "pop the front" alone could delete it from under a
+        # running endpoint)
+        live = {version, prev.get("version")}
         while len(versions) > self.KEEP_VERSIONS:
-            dead = versions.pop(0)
+            dead_i = next((i for i, v in enumerate(versions)
+                           if v["version"] not in live), None)
+            if dead_i is None:
+                break
+            dead = versions.pop(dead_i)
             shutil.rmtree(dead["path"], ignore_errors=True)
         card = {
             "name": name,
@@ -268,28 +277,39 @@ class EndpointDB:
                                          ".fedml_tpu", "endpoints.db")
         os.makedirs(os.path.dirname(self.path), exist_ok=True)
         conn = self._conn()
-        conn.execute(
-            "CREATE TABLE IF NOT EXISTS requests (endpoint TEXT, ts REAL, "
-            "latency_ms REAL, ok INTEGER)")
-        conn.commit()
-        conn.close()
+        try:
+            # WAL is persistent in the db file: set it ONCE here so
+            # concurrent /predict handlers append without serializing on
+            # the whole-db write lock (readers never block the writer)
+            conn.execute("PRAGMA journal_mode=WAL")
+            conn.execute(
+                "CREATE TABLE IF NOT EXISTS requests (endpoint TEXT, "
+                "ts REAL, latency_ms REAL, ok INTEGER)")
+            conn.commit()
+        finally:
+            conn.close()
 
     def _conn(self) -> sqlite3.Connection:
-        return sqlite3.connect(self.path)
+        # timeout doubles as the busy handler — lock waits up to 30s
+        return sqlite3.connect(self.path, timeout=30.0)
 
     def record(self, endpoint: str, latency_ms: float, ok: bool) -> None:
         conn = self._conn()
-        conn.execute("INSERT INTO requests VALUES (?,?,?,?)",
-                     (endpoint, time.time(), latency_ms, int(ok)))
-        conn.commit()
-        conn.close()
+        try:
+            conn.execute("INSERT INTO requests VALUES (?,?,?,?)",
+                         (endpoint, time.time(), latency_ms, int(ok)))
+            conn.commit()
+        finally:
+            conn.close()
 
     def stats(self, endpoint: str) -> Dict[str, Any]:
         conn = self._conn()
-        row = conn.execute(
-            "SELECT COUNT(*), AVG(latency_ms), SUM(ok) FROM requests "
-            "WHERE endpoint=?", (endpoint,)).fetchone()
-        conn.close()
+        try:
+            row = conn.execute(
+                "SELECT COUNT(*), AVG(latency_ms), SUM(ok) FROM requests "
+                "WHERE endpoint=?", (endpoint,)).fetchone()
+        finally:
+            conn.close()
         n, avg, oks = row
         return {"requests": int(n or 0),
                 "avg_latency_ms": float(avg) if avg is not None else None,
@@ -301,10 +321,12 @@ class EndpointDB:
         (reference `device_model_monitor.py` rolling QPS/latency)."""
         cutoff = time.time() - float(window_s)
         conn = self._conn()
-        row = conn.execute(
-            "SELECT COUNT(*), AVG(latency_ms), SUM(1-ok) FROM requests "
-            "WHERE endpoint=? AND ts>=?", (endpoint, cutoff)).fetchone()
-        conn.close()
+        try:
+            row = conn.execute(
+                "SELECT COUNT(*), AVG(latency_ms), SUM(1-ok) FROM requests "
+                "WHERE endpoint=? AND ts>=?", (endpoint, cutoff)).fetchone()
+        finally:
+            conn.close()
         n, avg, errs = row
         n = int(n or 0)
         return {"qps": n / float(window_s),

@@ -82,8 +82,14 @@ class ServeGateway:
                         err = None
                     except RuntimeError as e:
                         err = str(e)
-                    gw.db.record(gw.card_name,
-                                 (time.time() - t0) * 1000.0, err is None)
+                    try:
+                        gw.db.record(gw.card_name,
+                                     (time.time() - t0) * 1000.0,
+                                     err is None)
+                    except Exception:  # noqa: BLE001 — sqlite lock under
+                        # concurrent load; losing one metric sample must
+                        # not drop the client's HTTP response
+                        logging.exception("metrics record failed")
                     if err is not None:
                         return self._reply(503, {"error": err})
                     return self._reply(200, out)

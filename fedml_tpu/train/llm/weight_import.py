@@ -267,6 +267,16 @@ def import_lm_weights(src: Any, schema: str = "auto", strict: bool = True,
         raise ValueError(
             f"checkpoint is missing {len(report['missing'])} required "
             f"parameters: {report['missing'][:8]}...")
+    # Core tensors are mandatory even under strict=False: a pytree without
+    # the embeddings can never run, and letting it through produces a
+    # far-away KeyError in validate_lm_shapes instead of a usable message.
+    # Non-strict only forgives optional/per-block tensors.
+    core_absent = [k for k in ("embed", "pos") if k not in params]
+    if core_absent:
+        raise ValueError(
+            f"checkpoint is unusable: core tensors {core_absent} are absent "
+            f"(schema={schema!r}); strict=False only relaxes optional/extra "
+            f"tensors, not the embeddings")
     import jax.numpy as jnp
 
     cast = (lambda a: jnp.asarray(a, dtype)) if dtype is not None \
