@@ -14,6 +14,10 @@ from .advanced_defenses import (
     WBCDefense,
 )
 from .defense_base import BaseDefenseMethod
+from .three_sigma import (
+    ThreeSigmaFoolsGoldDefense,
+    ThreeSigmaGeoMedianDefense,
+)
 from .robust_aggregation import (
     BulyanDefense,
     CClipDefense,
@@ -43,10 +47,8 @@ DEFENSE_REGISTRY = {
     "slsgd": SLSGDDefense,
     "foolsgold": FoolsGoldDefense,
     "three_sigma": ThreeSigmaDefense,
-    "three_sigma_geomedian": lambda cfg: ThreeSigmaDefense(
-        _with(cfg, three_sigma_geomedian=True)),
-    "three_sigma_foolsgold": lambda cfg: ThreeSigmaDefense(
-        _with(cfg, three_sigma_foolsgold=True)),
+    "three_sigma_geomedian": ThreeSigmaGeoMedianDefense,
+    "three_sigma_foolsgold": ThreeSigmaFoolsGoldDefense,
     "crossround": CrossRoundDefense,
     "crfl": CRFLDefense,
     "soteria": SoteriaDefense,

@@ -228,12 +228,20 @@ class WeakDPDefense(BaseDefenseMethod):
         return vector_to_tree(noised, global_model)
 
 
-def foolsgold_credibility(m: jnp.ndarray) -> jnp.ndarray:
+def foolsgold_credibility(m: jnp.ndarray, clip: bool = True) -> jnp.ndarray:
     """FoolsGold (Fung et al.) alg. 1 per-client credibility weights from a
     stacked [N, D] update (or history-sum) matrix: max pairwise cosine →
-    pardoning → renormalize → logit squash."""
+    pardoning → renormalize → logit squash.
+
+    ``clip=True`` bounds the logit to [0,1] for use as aggregation weights;
+    ``clip=False`` returns the raw logit (reference
+    `three_sigma_defense_foolsgold.py:191` keeps it unbounded — sybils sit
+    ~-30, which is what the three-sigma score distribution needs to see)."""
     norms = jnp.sqrt(jnp.maximum(jnp.sum(m * m, axis=1, keepdims=True), 1e-12))
-    cs = (m / norms) @ (m / norms).T
+    nm = m / norms
+    # full-precision dot: the default (bf16-ish) matmul rounds identical
+    # vectors to cosine ≈0.9975, which destroys the 1-vs-0.99 sybil signal
+    cs = jnp.matmul(nm, nm.T, precision=jax.lax.Precision.HIGHEST)
     n = m.shape[0]
     cs = cs - jnp.eye(n)
     maxcs = jnp.maximum(jnp.max(cs, axis=1), 1e-12)
@@ -242,9 +250,11 @@ def foolsgold_credibility(m: jnp.ndarray) -> jnp.ndarray:
     ratio = maxcs[:, None] / maxcs[None, :]
     adj = jnp.where(maxcs[:, None] < maxcs[None, :], cs * ratio, cs)
     wv = 1.0 - jnp.max(adj, axis=1)
-    wv = jnp.clip(wv, 1e-6, 1.0)
+    wv = jnp.clip(wv, 1e-15, 1.0)
     wv = wv / jnp.max(wv)
-    return jnp.clip(jnp.log(wv / (1.0 - wv + 1e-12)) + 0.5, 0.0, 1.0)
+    wv = jnp.minimum(wv, 0.999999)
+    logit = jnp.log(wv / (1.0 - wv)) + 0.5
+    return jnp.clip(logit, 0.0, 1.0) if clip else logit
 
 
 class FoolsGoldDefense(BaseDefenseMethod):
@@ -277,34 +287,17 @@ class FoolsGoldDefense(BaseDefenseMethod):
 class ThreeSigmaDefense(BaseDefenseMethod):
     """Three-sigma outlier filtering: score = distance to the coordinate-wise
     median aggregate; drop clients beyond mean+3σ of scores (reference
-    `three_sigma_defense.py`; geomedian variant uses RFA center)."""
-
-    def __init__(self, config: Any) -> None:
-        super().__init__(config)
-        self.use_geomedian = bool(getattr(config, "three_sigma_geomedian", False))
-        self.use_foolsgold = bool(getattr(config, "three_sigma_foolsgold", False))
+    `three_sigma_defense.py`). The FoolsGold-scored and frozen-geomedian
+    variants live in `three_sigma.py`."""
 
     def defend_before_aggregation(self, raw_client_grad_list, extra_auxiliary_info=None):
         mat, weights, template = grad_list_to_matrix(raw_client_grad_list)
-        if self.use_geomedian:
-            center = RFADefense(self.config).defend_on_aggregation(
-                raw_client_grad_list)
-            center = tree_to_vector(center)
-        else:
-            center = jnp.median(mat, axis=0)
+        center = jnp.median(mat, axis=0)
         scores = jnp.sqrt(jnp.sum(jnp.square(mat - center[None, :]), axis=1))
         mu, sd = jnp.mean(scores), jnp.std(scores)
         keep = np.asarray(scores <= mu + 3.0 * sd)
         kept = [raw_client_grad_list[i] for i in range(len(keep)) if keep[i]]
-        kept = kept if kept else raw_client_grad_list
-        if self.use_foolsgold and len(kept) > 1:
-            # foolsgold variant: reweight survivors by similarity credibility
-            # (full alg. 1 incl. pardoning + logit, shared with FoolsGold)
-            kmat, _, _ = grad_list_to_matrix(kept)
-            wv = foolsgold_credibility(kmat)
-            kept = [(float(n_k) * float(w), g)
-                    for (n_k, g), w in zip(kept, list(wv))]
-        return kept
+        return kept if kept else raw_client_grad_list
 
 
 def _round_client_ids(n: int):
