@@ -25,7 +25,10 @@ ATTACK_REGISTRY = {
 
 
 def create_attacker(attack_type: str, config: Any) -> BaseAttackMethod:
-    if attack_type in ("dlg", "invert_gradient", "revealing_labels"):
+    if attack_type == "dlg":
+        from .gradient_inversion import DLGAttack
+        return DLGAttack(config)
+    if attack_type in ("invert_gradient", "revealing_labels"):
         from .gradient_inversion import InvertGradientAttack
         return InvertGradientAttack(config)
     try:

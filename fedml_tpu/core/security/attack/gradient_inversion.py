@@ -1,14 +1,19 @@
 """Gradient-inversion reconstruction attacks.
 
 Capability parity: reference `core/security/attack/dlg_attack.py`,
-`invert_gradient_attack.py` (755 LoC), `revealing_labels_from_gradients.py` —
-reconstruct training data from a client's gradient by optimizing dummy inputs
-whose gradients match.
+`invert_gradient_attack.py` (755 LoC: cosine matching, total-variation
+regularization, BN-statistic priors, multi-restart trials, label recovery),
+`revealing_labels_from_gradients.py` — reconstruct training data from a
+client's gradient by optimizing dummy inputs whose gradients match.
 
-TPU-first: the inner reconstruction loop is a jit-compiled
-``lax.fori_loop`` over optax-adam steps on the dummy batch; gradient matching
-uses cosine distance (invert-gradient) or L2 (DLG).  Label inference uses the
-sign trick on the final-layer bias gradient (iDLG / revealing-labels).
+TPU-first: one restart's reconstruction loop is a jit-compiled
+``lax.fori_loop`` over optax-adam steps; the reference's sequential
+multi-restart trials become ONE ``vmap`` over restart seeds, so all trials
+run as a single batched program on the chip and the best trial is picked by
+final matching loss.  Label inference uses the sign trick on the final-layer
+bias gradient (iDLG / revealing-labels); fixed labels turn the y-search into
+a pure x-search, which is the reference's `invert_gradient_attack.py`
+config ``optim='ours'`` behavior.
 """
 
 from __future__ import annotations
@@ -32,8 +37,43 @@ def infer_labels_from_gradients(last_layer_grad: jnp.ndarray,
     return order[:batch_size]
 
 
+def psnr(reconstruction: jnp.ndarray, truth: jnp.ndarray,
+         fit_affine: bool = True) -> float:
+    """Peak signal-to-noise ratio in dB against ``truth``'s dynamic range.
+
+    ``fit_affine`` first least-squares-fits a*x+b — cosine-distance
+    matching is scale-invariant, so reconstructions are recovered up to an
+    affine transform (the reference evaluates the same way when its
+    renormalization is on)."""
+    x = jnp.ravel(reconstruction).astype(jnp.float32)
+    t = jnp.ravel(truth).astype(jnp.float32)
+    if fit_affine:
+        xm, tm = jnp.mean(x), jnp.mean(t)
+        cov = jnp.mean((x - xm) * (t - tm))
+        var = jnp.maximum(jnp.mean((x - xm) ** 2), 1e-12)
+        x = (x - xm) * (cov / var) + tm
+    mse = jnp.maximum(jnp.mean((x - t) ** 2), 1e-12)
+    peak = jnp.maximum(jnp.max(t) - jnp.min(t), 1e-6)
+    return float(10.0 * jnp.log10(peak * peak / mse))
+
+
 class InvertGradientAttack(BaseAttackMethod):
-    """Optimize dummy (x, y_prob) to match an observed gradient."""
+    """Optimize dummy (x, y) to match an observed gradient.
+
+    ``extra_auxiliary_info`` is either the positional tuple
+    ``(loss_grad_fn, x_shape, num_classes)`` or a dict with keys:
+
+    - ``loss_grad_fn(x, y_onehot) -> grad pytree``  (required)
+    - ``x_shape``, ``num_classes``                  (required)
+    - ``bias_grad``: output-layer bias gradient — enables iDLG label
+      recovery; labels are then FIXED one-hots instead of optimized
+    - ``labels``: known labels (overrides ``bias_grad``)
+    - ``feature_fn(x) -> [B, F]``, ``feat_mean``, ``feat_var``: deep-
+      inversion style BN/statistic prior — penalize the distance between
+      the dummy batch's feature statistics and the supplied running stats
+      (reference `invert_gradient_attack.py` BN-loss hooks)
+    - ``x_bounds``: (lo, hi) box prior on the input
+    """
 
     def __init__(self, config: Any) -> None:
         super().__init__(config)
@@ -41,57 +81,151 @@ class InvertGradientAttack(BaseAttackMethod):
         self.lr = float(getattr(config, "inversion_lr", 0.1))
         self.distance = str(getattr(config, "inversion_distance", "cosine"))
         self.tv_weight = float(getattr(config, "inversion_tv_weight", 1e-4))
+        self.bn_weight = float(getattr(config, "inversion_bn_weight", 1e-3))
+        self.restarts = int(getattr(config, "inversion_restarts", 4))
         self.seed = int(getattr(config, "random_seed", 0) or 0)
 
     def reconstruct_data(self, a_gradient: Any, extra_auxiliary_info: Any = None):
-        """``a_gradient``: target gradient pytree.
-        ``extra_auxiliary_info``: (loss_grad_fn, x_shape, num_classes) where
-        loss_grad_fn(x, y_onehot) -> gradient pytree of the model loss."""
-        loss_grad_fn, x_shape, num_classes = extra_auxiliary_info
-        return _reconstruct(
-            loss_grad_fn, a_gradient, tuple(x_shape), int(num_classes),
+        """Returns ``(x, labels)`` of the best restart."""
+        x, labels, _ = self.reconstruct_with_score(
+            a_gradient, extra_auxiliary_info)
+        return x, labels
+
+    def reconstruct_with_score(self, a_gradient: Any,
+                               extra_auxiliary_info: Any):
+        """(x, labels, final matching loss of the winning restart)."""
+        aux = extra_auxiliary_info
+        if not isinstance(aux, dict):
+            loss_grad_fn, x_shape, num_classes = aux
+            aux = {"loss_grad_fn": loss_grad_fn, "x_shape": x_shape,
+                   "num_classes": num_classes}
+        x_shape = tuple(aux["x_shape"])
+        num_classes = int(aux["num_classes"])
+
+        labels = aux.get("labels")
+        if labels is None and aux.get("bias_grad") is not None:
+            labels = infer_labels_from_gradients(
+                jnp.asarray(aux["bias_grad"]), x_shape[0])
+        fixed_labels = (jnp.asarray(labels, jnp.int32)
+                        if labels is not None else None)
+
+        feature_fn = aux.get("feature_fn")
+        feat_mean = aux.get("feat_mean")
+        feat_var = aux.get("feat_var")
+        x_bounds = aux.get("x_bounds")
+
+        keys = jax.random.split(jax.random.PRNGKey(self.seed),
+                                max(self.restarts, 1))
+        xs, ys, losses = _reconstruct_restarts(
+            aux["loss_grad_fn"], a_gradient, fixed_labels, feature_fn,
+            feat_mean, feat_var, x_bounds, keys, x_shape, num_classes,
             self.iters, self.lr, self.distance == "cosine", self.tv_weight,
-            self.seed)
+            self.bn_weight)
+        best = int(jnp.argmin(losses))
+        x = xs[best]
+        out_labels = (fixed_labels if fixed_labels is not None
+                      else jnp.argmax(ys[best], axis=-1))
+        return x, out_labels, float(losses[best])
 
 
-@partial(jax.jit, static_argnums=(0, 2, 3, 4, 6, 7, 8))
-def _reconstruct(loss_grad_fn: Callable, target_grad: Any,
-                 x_shape: Tuple[int, ...], num_classes: int, iters: int,
-                 lr: float, use_cosine: bool, tv_weight: float, seed: int):
-    key = jax.random.PRNGKey(seed)
-    kx, ky = jax.random.split(key)
-    dummy_x = jax.random.normal(kx, x_shape)
-    dummy_y = jax.random.normal(ky, (x_shape[0], num_classes)) * 0.1
-
+@partial(jax.jit,
+         static_argnums=(0, 3, 8, 9, 10, 11, 12, 13, 14))
+def _reconstruct_restarts(loss_grad_fn: Callable, target_grad: Any,
+                          fixed_labels: Optional[jnp.ndarray],
+                          feature_fn: Optional[Callable],
+                          feat_mean: Optional[jnp.ndarray],
+                          feat_var: Optional[jnp.ndarray],
+                          x_bounds: Optional[Tuple[float, float]],
+                          keys: jnp.ndarray,
+                          x_shape: Tuple[int, ...], num_classes: int,
+                          iters: int, lr: float, use_cosine: bool,
+                          tv_weight: float, bn_weight: float):
+    """All restarts as one vmapped program: [R] keys → ([R]+x_shape x,
+    [R, B, C] y-logits, [R] final matching losses)."""
     tgt_leaves = jax.tree_util.tree_leaves(target_grad)
 
-    def match_loss(state):
-        x, y_logits = state
-        y = jax.nn.softmax(y_logits, axis=-1)
-        g = loss_grad_fn(x, y)
-        g_leaves = jax.tree_util.tree_leaves(g)
+    def grad_match(x, y):
+        g_leaves = jax.tree_util.tree_leaves(loss_grad_fn(x, y))
         if use_cosine:
             dot = sum(jnp.sum(a * b) for a, b in zip(g_leaves, tgt_leaves))
             na = jnp.sqrt(sum(jnp.sum(a * a) for a in g_leaves))
             nb = jnp.sqrt(sum(jnp.sum(b * b) for b in tgt_leaves))
-            loss = 1.0 - dot / jnp.maximum(na * nb, 1e-12)
-        else:
-            loss = sum(jnp.sum((a - b) ** 2) for a, b in zip(g_leaves, tgt_leaves))
+            return 1.0 - dot / jnp.maximum(na * nb, 1e-12)
+        return sum(jnp.sum((a - b) ** 2)
+                   for a, b in zip(g_leaves, tgt_leaves))
+
+    def regularizers(x):
+        loss = 0.0
         if tv_weight and len(x_shape) >= 3:
             tv = (jnp.sum(jnp.abs(x[:, 1:] - x[:, :-1]))
                   + jnp.sum(jnp.abs(x[:, :, 1:] - x[:, :, :-1])))
             loss = loss + tv_weight * tv
+        if bn_weight and feature_fn is not None and feat_mean is not None:
+            feats = feature_fn(x)
+            feats = feats.reshape(-1, feats.shape[-1])
+            m = jnp.mean(feats, axis=0)
+            loss = loss + bn_weight * jnp.sum((m - feat_mean) ** 2)
+            if feat_var is not None:
+                v = jnp.var(feats, axis=0)
+                loss = loss + bn_weight * jnp.sum((v - feat_var) ** 2)
+        if x_bounds is not None:
+            lo, hi = x_bounds
+            loss = loss + jnp.sum(jnp.square(jnp.maximum(x - hi, 0.0))
+                                  + jnp.square(jnp.maximum(lo - x, 0.0)))
         return loss
 
-    opt = optax.adam(lr)
-    state = (dummy_x, dummy_y)
-    opt_state = opt.init(state)
+    def one_restart(key):
+        kx, ky = jax.random.split(key)
+        dummy_x = jax.random.normal(kx, x_shape)
+        opt = optax.adam(lr)
 
-    def body(_, carry):
-        state, opt_state = carry
-        grads = jax.grad(match_loss)(state)
-        updates, opt_state = opt.update(grads, opt_state, state)
-        return optax.apply_updates(state, updates), opt_state
+        if fixed_labels is not None:
+            # iDLG path: labels are known, so the search is x-only — no
+            # dead y parameter or Adam moments riding along
+            y_fixed = jax.nn.one_hot(fixed_labels, num_classes)
 
-    (x, y_logits), _ = jax.lax.fori_loop(0, iters, body, (state, opt_state))
-    return x, jnp.argmax(y_logits, axis=-1)
+            def total_loss(x):
+                return grad_match(x, y_fixed) + regularizers(x)
+
+            state, opt_state = dummy_x, opt.init(dummy_x)
+        else:
+            dummy_y = jax.random.normal(
+                ky, (x_shape[0], num_classes)) * 0.1
+
+            def total_loss(state):
+                x, y_logits = state
+                return (grad_match(x, jax.nn.softmax(y_logits, axis=-1))
+                        + regularizers(x))
+
+            state = (dummy_x, dummy_y)
+            opt_state = opt.init(state)
+
+        def body(_, carry):
+            state, opt_state = carry
+            grads = jax.grad(total_loss)(state)
+            updates, opt_state = opt.update(grads, opt_state, state)
+            return optax.apply_updates(state, updates), opt_state
+
+        state, _ = jax.lax.fori_loop(0, iters, body, (state, opt_state))
+        if fixed_labels is not None:
+            x = state
+            y_logits = jnp.zeros((x_shape[0], num_classes))
+            y_final = y_fixed
+        else:
+            x, y_logits = state
+            y_final = jax.nn.softmax(y_logits, axis=-1)
+        if x_bounds is not None:
+            x = jnp.clip(x, x_bounds[0], x_bounds[1])
+        # score restarts on the pure gradient match, not the priors
+        return x, y_logits, grad_match(x, y_final)
+
+    return jax.vmap(one_restart)(keys)
+
+
+class DLGAttack(InvertGradientAttack):
+    """Deep-leakage-from-gradients (`dlg_attack.py`): L2 matching, no TV."""
+
+    def __init__(self, config: Any) -> None:
+        super().__init__(config)
+        self.distance = "l2"
+        self.tv_weight = 0.0

@@ -109,6 +109,20 @@ class _ScoreDistribution:
         self.lower_bound = mu - self.bound_param * sigma
         self.iteration_num += 1
 
+    def filter(self, items: List[Tuple[float, Any]], scores: List[float],
+               keep_higher: bool) -> List[Tuple[float, Any]]:
+        """Observe this round's scores, then drop outliers: scores below
+        the lower bound (keep_higher) or above the upper bound; never
+        return an empty round."""
+        self.observe(scores)
+        if keep_higher:
+            kept = [g for g, s in zip(items, scores)
+                    if s >= self.lower_bound]
+        else:
+            kept = [g for g, s in zip(items, scores)
+                    if s <= self.upper_bound]
+        return kept or list(items)
+
 
 class ThreeSigmaFoolsGoldDefense(BaseDefenseMethod):
     """Reference `three_sigma_defense_foolsgold.py`: FoolsGold-scored
@@ -140,15 +154,9 @@ class ThreeSigmaFoolsGoldDefense(BaseDefenseMethod):
 
     def defend_before_aggregation(self, raw_client_grad_list,
                                   extra_auxiliary_info=None):
-        scores = self._scores(raw_client_grad_list)
-        self.dist.observe(scores)
-        if self.keep_higher:
-            kept = [g for g, s in zip(raw_client_grad_list, scores)
-                    if s >= self.dist.lower_bound]
-        else:
-            kept = [g for g, s in zip(raw_client_grad_list, scores)
-                    if s <= self.dist.upper_bound]
-        kept = kept or list(raw_client_grad_list)
+        kept = self.dist.filter(raw_client_grad_list,
+                                self._scores(raw_client_grad_list),
+                                self.keep_higher)
         return bucketize(kept, self.batch_size)
 
 
@@ -187,12 +195,6 @@ class ThreeSigmaGeoMedianDefense(BaseDefenseMethod):
 
     def defend_before_aggregation(self, raw_client_grad_list,
                                   extra_auxiliary_info=None):
-        scores = self._scores(raw_client_grad_list)
-        self.dist.observe(scores)
-        if self.keep_higher:
-            kept = [g for g, s in zip(raw_client_grad_list, scores)
-                    if s >= self.dist.lower_bound]
-        else:
-            kept = [g for g, s in zip(raw_client_grad_list, scores)
-                    if s <= self.dist.upper_bound]
-        return kept or list(raw_client_grad_list)
+        return self.dist.filter(raw_client_grad_list,
+                                self._scores(raw_client_grad_list),
+                                self.keep_higher)
