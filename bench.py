@@ -211,6 +211,43 @@ def main() -> None:
     result["est_mfu"] = round(mfu, 4)
     result["flops_per_round"] = round(flops_per_round, 1)
     result["padded_samples_per_round"] = int(padded_per_round)
+
+    # ---- LLM plane (VERDICT r3 item 1): SFT MFU + absolute serving ------
+    # run in a subprocess so its device state can't perturb the main
+    # bench; on any failure fall back to the committed last-good results
+    llm = None
+    try:
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(HERE, "benchmarks", "llm_bench.py"), "--quick"],
+            capture_output=True, text=True, timeout=900)
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                llm = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+        result["llm_guard"] = "ok" if proc.returncode == 0 else "failed"
+    except Exception as e:
+        result["llm_guard"] = f"error: {e}"
+    if llm is None:
+        try:
+            with open(os.path.join(HERE, "benchmarks",
+                                   "llm_bench_results.json")) as f:
+                d = json.load(f)
+            llm = {"llm_sft_mfu": d["train"]["mfu"],
+                   "llm_sft_tokens_per_sec": d["train"]["tokens_per_sec"],
+                   "llm_ttft_ms": d["serving"]["ttft_ms_b1_p512"],
+                   "llm_decode_tokens_per_sec":
+                       d["serving"]["best_decode_tokens_per_sec"]}
+            result["llm_guard"] = "stale (committed results)"
+        except Exception:
+            llm = {}
+    for k in ("llm_sft_mfu", "llm_sft_tokens_per_sec", "llm_ttft_ms",
+              "llm_decode_tokens_per_sec"):
+        if k in llm:
+            result[k] = llm[k]
+
     print(json.dumps(result))
     if acc < TARGET_TEST_ACC:
         print(f"ACCURACY GUARD FAILED: {acc:.4f} < {TARGET_TEST_ACC}",

@@ -1,0 +1,312 @@
+"""Absolute LLM-plane performance on the real TPU (VERDICT r3 item 1).
+
+Two measurements, both on a GPT-2-small-class transformer (dim 768,
+12 layers, 12 heads, vocab 50257 — the size class the reference's HF
+trainer fine-tunes, `train/llm/hf_trainer.py`, and its scalellm wrapper
+serves, `scalellm/__init__.py`):
+
+* **SFT train step** — the functional LM (`parallel/seq_parallel.py`)
+  under one jitted AdamW step, bf16 matmuls / fp32 optimizer, seq 1024.
+  Reports tokens/s and analytic MFU against the chip's bf16 peak.
+  FLOP accounting counts what the program EXECUTES (full T x T attention
+  scores -- the einsum materializes both triangles), so MFU is never
+  flattered by a causal discount the hardware doesn't take:
+      fwd/token  = L*(24*D^2 + 4*T*D) + 2*D*V
+      train/token = 3x fwd (no remat) or 4x fwd (remat re-runs the fwd)
+* **Serving** — `KVCacheLM` prefill/decode at the same size, bf16:
+  TTFT (prefill + first decode dispatch, batch 1) and steady-state decode
+  tokens/s vs batch size via the on-device multi-token sampler
+  (`decode_multi`), replacing round 3's relative "15.7x" with absolute
+  numbers.
+
+MEASUREMENT NOTE (axon tunnel): `jax.block_until_ready` is a NO-OP on
+the remote-TPU plugin (verified: an 8-matmul chain "completes" in 0.1 ms
+by block_until_ready but takes real time to fetch), so every timed window
+here syncs by fetching a SCALAR to the host (~90 ms round-trip, measured
+and subtracted).  The tunneled chip also sees BURSTY INTERFERENCE from
+other tenants — long windows absorb multi-second stalls (observed: the
+same decode step measuring 3.9 ms and 57 ms minutes apart) — so every
+metric is the BEST of N short windows, which converges on the
+uncontended rate.
+
+Prints ONE JSON line and writes `benchmarks/llm_bench_results.json`.
+Regression guard: if `benchmarks/llm_bench_floor.json` exists (committed
+after the first accepted run), the script exits 1 when any guarded metric
+falls below floor * 0.8 — same contract as the north-star accuracy guard.
+
+Usage: python benchmarks/llm_bench.py [--quick] [--bs N] [--remat]
+  --quick  skip the batch-size sweeps (used from bench.py: one train bs,
+           decode batches 8/32 only)
+"""
+
+import json
+import os
+import sys
+import time
+from functools import partial
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+QUICK = "--quick" in sys.argv
+REMAT = "--remat" in sys.argv
+_bs = [a for i, a in enumerate(sys.argv) if sys.argv[i - 1] == "--bs"]
+FORCE_BS = int(_bs[0]) if _bs else 0
+
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(REPO, ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+from fedml_tpu.parallel.ring_attention import reference_attention  # noqa: E402
+from fedml_tpu.parallel.seq_parallel import (  # noqa: E402
+    init_lm_params,
+    lm_loss,
+)
+from fedml_tpu.serving.kv_cache_lm import KVCacheLM  # noqa: E402
+
+# GPT-2 small class
+VOCAB, DIM, LAYERS, HEADS, SEQ = 50257, 768, 12, 12, 1024
+
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v4": 275e12,
+    "TPU v5p": 459e12, "TPU v6 lite": 918e12,
+}
+
+RESULTS_PATH = os.path.join(HERE, "llm_bench_results.json")
+FLOOR_PATH = os.path.join(HERE, "llm_bench_floor.json")
+
+
+def tree_size(tree) -> int:
+    return sum(int(np.prod(x.shape))
+               for x in jax.tree_util.tree_leaves(tree))
+
+
+def sync(x) -> float:
+    """Real device sync: fetch a scalar to the host (block_until_ready is
+    a no-op on the axon remote platform — see module docstring)."""
+    return float(jnp.sum(jnp.ravel(x)[:1]))
+
+
+def measure_rtt() -> float:
+    one = jnp.ones(())
+    sync(one)
+    ts = []
+    for _ in range(5):
+        t0 = time.time()
+        sync(one + 0.0)
+        ts.append(time.time() - t0)
+    return min(ts)
+
+
+def train_flops_per_token(remat: bool) -> float:
+    fwd = LAYERS * (24 * DIM * DIM + 4 * SEQ * DIM) + 2 * DIM * VOCAB
+    return fwd * (4.0 if remat else 3.0)
+
+
+def bench_train(peak: float, remat: bool, rtt: float):
+    """One jitted AdamW SFT step; returns best (bs, tokens/s, mfu)."""
+    rng = jax.random.PRNGKey(0)
+    params = init_lm_params(rng, VOCAB, dim=DIM, layers=LAYERS,
+                            heads=HEADS, max_len=SEQ)
+    n_params = tree_size(params)
+    tx = optax.adamw(3e-4)
+    opt_state = tx.init(params)
+    attn = partial(reference_attention, causal=True)
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        def loss_fn(p):
+            p16 = jax.tree_util.tree_map(
+                lambda x: x.astype(jnp.bfloat16), p)
+            return lm_loss(p16, tokens, HEADS, attn, remat=remat)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    candidates = [FORCE_BS] if FORCE_BS else ([4] if QUICK else [4, 8, 16])
+    per_bs = {}
+    for bs in candidates:
+        tokens = jnp.asarray(
+            np.random.default_rng(1).integers(0, VOCAB, (bs, SEQ)),
+            jnp.int32)
+        try:
+            t0 = time.time()
+            p, o, loss = step(params, opt_state, tokens)
+            sync(loss)
+            compile_s = time.time() - t0
+            for _ in range(2):                       # warmup steady state
+                p, o, loss = step(p, o, tokens)
+            sync(loss)
+            # best-of-N 2-step windows (see module docstring: the tunnel
+            # sees bursty interference; min converges on the true rate)
+            n_win, spw = (4, 2) if QUICK else (8, 2)
+            dt = float("inf")
+            for _ in range(n_win):
+                t0 = time.time()
+                for _ in range(spw):
+                    p, o, loss = step(p, o, tokens)
+                sync(loss)               # ONE host fetch syncs the window
+                dt = min(dt, (time.time() - t0 - rtt) / spw)
+        except Exception as e:                       # OOM at this bs
+            per_bs[bs] = {"error": str(e)[:200]}
+            continue
+        tok_s = bs * SEQ / dt
+        per_bs[bs] = {
+            "step_ms": round(dt * 1e3, 1),
+            "tokens_per_sec": round(tok_s, 0),
+            "mfu": round(tok_s * train_flops_per_token(remat) / peak, 4),
+            "compile_s": round(compile_s, 1),
+        }
+        del p, o
+    ok = {b: r for b, r in per_bs.items() if "error" not in r}
+    if not ok:
+        raise RuntimeError(f"all train batch sizes failed: {per_bs}")
+    best = max(ok, key=lambda b: ok[b]["tokens_per_sec"])
+    return {"model": f"gpt2-small-class d{DIM} L{LAYERS} T{SEQ}",
+            "n_params": n_params, "remat": remat, "best_bs": best,
+            **ok[best], "per_bs": per_bs}
+
+
+def bench_serving(peak: float, rtt: float):
+    """KVCacheLM in bf16: TTFT (bs1) + decode tokens/s vs batch."""
+    rng = jax.random.PRNGKey(2)
+    params = init_lm_params(rng, VOCAB, dim=DIM, layers=LAYERS,
+                            heads=HEADS, max_len=SEQ)
+    params = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16), params)
+    lm = KVCacheLM(params, HEADS, SEQ)
+    gen = np.random.default_rng(3)
+
+    def mk_prompts(bs, width):
+        toks = jnp.asarray(gen.integers(0, VOCAB, (bs, width)), jnp.int32)
+        return toks, jnp.full((bs,), width, jnp.int32)
+
+    # ---- TTFT: prompt 512, batch 1 — prefill + argmax of last logits ----
+    toks, length = mk_prompts(1, 512)
+    cache, last = lm.prefill(toks, length)           # compile
+    sync(last)
+    ttfts = []
+    for _ in range(5 if QUICK else 8):
+        t0 = time.time()
+        cache, last = lm.prefill(toks, length)
+        first_tok = jnp.argmax(last, -1)
+        sync(first_tok)                  # the fetch IS the "token arrives"
+        ttfts.append(time.time() - t0)
+    # raw wall includes one ~90ms tunnel round-trip (a local host would
+    # not pay it).  The device-side prefill cost is too small to recover
+    # from a single dispatch minus noisy RTT, so measure it by chaining N
+    # back-to-back prefill dispatches under one sync (in-order execution)
+    ttft_ms = 1e3 * min(ttfts)
+    n_chain = 8
+    best_pref = float("inf")
+    for _ in range(3):
+        t0 = time.time()
+        for _ in range(n_chain):
+            cache, last = lm.prefill(toks, length)
+        sync(last)
+        best_pref = min(best_pref, (time.time() - t0 - rtt) / n_chain)
+    prefill_ms = 1e3 * best_pref
+    prefill_tok_s = 512 / best_pref
+
+    # ---- steady-state decode tokens/s vs batch ----
+    # decode FLOPs/token ~ 2*n_params + cache attention reads; the engine
+    # is HBM-bound here (reads all params per k-chunk step), so we also
+    # report the bandwidth-model ceiling for context.
+    decode = {}
+    batches = [8, 128] if QUICK else [1, 8, 32, 64, 128]
+    K = 64                                           # tokens per dispatch
+    n_win = 4 if QUICK else 8
+    for bs in batches:
+        toks, length = mk_prompts(bs, 128)
+        cache, last = lm.prefill(toks, length)
+        first = jnp.argmax(last, -1)
+        prompt_buf = jnp.zeros((bs, K), jnp.int32).at[:, 0].set(first)
+        prompt_n = jnp.ones((bs,), jnp.int32)
+        temps = jnp.zeros((bs,), jnp.float32)        # greedy
+        top_k = jnp.zeros((bs,), jnp.int32)
+        top_p = jnp.ones((bs,), jnp.float32)
+        key = jax.random.PRNGKey(4)
+        pos = length
+        # compile + warm
+        cache, emitted = lm.decode_multi(cache, prompt_buf, prompt_n, pos,
+                                         temps, top_k, top_p, key, K)
+        sync(emitted)
+        pos = pos + K
+        # best-of-N one-chunk windows, each chained on-device through
+        # emitted[:, -1] and synced by one scalar fetch
+        assert 128 + K * (2 + n_win) <= lm.max_len, \
+            "decode windows overrun the cache; lower K or n_win"
+        best = float("inf")
+        for _ in range(n_win):
+            nxt = emitted[:, -1]
+            prompt_buf = prompt_buf.at[:, 0].set(nxt)
+            t0 = time.time()
+            cache, emitted = lm.decode_multi(cache, prompt_buf, prompt_n,
+                                             pos, temps, top_k, top_p,
+                                             key, K)
+            sync(emitted)
+            best = min(best, time.time() - t0 - rtt)
+            pos = pos + K
+        decode[bs] = {
+            "tokens_per_sec": round(bs * K / best, 0),
+            "ms_per_token_per_seq": round(1e3 * best / K, 3),
+        }
+        del cache, emitted
+    best_bs = max(decode, key=lambda b: decode[b]["tokens_per_sec"])
+    return {"ttft_ms_b1_p512": round(ttft_ms, 1),
+            "prefill_ms_device_b1_p512": round(prefill_ms, 1),
+            "prefill_tokens_per_sec": round(prefill_tok_s, 0),
+            "decode": decode,
+            "best_decode_bs": best_bs,
+            "best_decode_tokens_per_sec":
+                decode[best_bs]["tokens_per_sec"]}
+
+
+def main() -> None:
+    kind = jax.devices()[0].device_kind
+    peak = PEAK_FLOPS.get(kind, 197e12)
+    rtt = measure_rtt()
+    out = {"device": kind, "peak_bf16_flops": peak, "quick": QUICK,
+           "host_rtt_ms": round(1e3 * rtt, 1)}
+    t0 = time.time()
+    out["train"] = bench_train(peak, REMAT, rtt)
+    out["serving"] = bench_serving(peak, rtt)
+    out["wall_s"] = round(time.time() - t0, 1)
+
+    with open(RESULTS_PATH, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({
+        "llm_sft_mfu": out["train"]["mfu"],
+        "llm_sft_tokens_per_sec": out["train"]["tokens_per_sec"],
+        "llm_ttft_ms": out["serving"]["ttft_ms_b1_p512"],
+        "llm_decode_tokens_per_sec":
+            out["serving"]["best_decode_tokens_per_sec"],
+        "detail": RESULTS_PATH,
+    }))
+
+    if os.path.exists(FLOOR_PATH):
+        with open(FLOOR_PATH) as f:
+            floor = json.load(f)
+        checks = {
+            "llm_sft_mfu": out["train"]["mfu"],
+            "llm_sft_tokens_per_sec": out["train"]["tokens_per_sec"],
+            "llm_decode_tokens_per_sec":
+                out["serving"]["best_decode_tokens_per_sec"],
+        }
+        bad = {k: (v, floor[k]) for k, v in checks.items()
+               if k in floor and v < 0.8 * floor[k]}
+        if bad:
+            print(f"LLM PERF GUARD FAILED: {bad}", file=sys.stderr)
+            sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -33,8 +33,11 @@ def init_cache(params: Dict[str, Any], batch: int, max_len: int,
                heads: int) -> List[Dict[str, jnp.ndarray]]:
     dim = params["embed"].shape[1]
     dh = dim // heads
-    return [{"k": jnp.zeros((batch, max_len, heads, dh)),
-             "v": jnp.zeros((batch, max_len, heads, dh))}
+    dt = params["embed"].dtype        # bf16 params -> bf16 cache (an fp32
+    # zero cache would silently promote every where-update to fp32,
+    # doubling decode HBM traffic)
+    return [{"k": jnp.zeros((batch, max_len, heads, dh), dt),
+             "v": jnp.zeros((batch, max_len, heads, dh), dt)}
             for _ in params["blocks"]]
 
 
@@ -110,24 +113,33 @@ def _decode_core(params: Dict[str, Any],
                  token: jnp.ndarray, pos: jnp.ndarray, heads: int
                  ) -> Tuple[List[Dict[str, jnp.ndarray]], jnp.ndarray]:
     """One token per row (traced body shared by the single- and multi-token
-    dispatch entry points)."""
+    dispatch entry points).
+
+    The cache update is a broadcast-compare SELECT, not a scatter: a
+    per-row ``.at[rows, pos].set`` lowers to an XLA scatter that measured
+    2.9x slower than the select on v5e (21.9 vs 7.5 ms/step at B=32
+    T=1024; a per-row dynamic_update_slice chain was just as slow —
+    benchmarks/BENCH_NOTES.md round 4)."""
     b = token.shape[0]
     dim = params["embed"].shape[1]
     dh = dim // heads
     t_cache = cache[0]["k"].shape[1]
     h = params["embed"][token] + params["pos"][pos]       # [B, D]
     new_cache = []
-    rows = jnp.arange(b)
+    iota = jnp.arange(t_cache)
+    hit = (iota[None, :] == pos[:, None])                 # [B, T]
     for blk, layer in zip(params["blocks"], cache):
         y = _ln(h, blk["ln1"])
         q = _with_bias(y @ blk["wq"], blk, "bq").reshape(b, heads, dh)
         k_new = _with_bias(y @ blk["wk"], blk, "bk").reshape(b, heads, dh)
         v_new = _with_bias(y @ blk["wv"], blk, "bv").reshape(b, heads, dh)
-        k_cache = layer["k"].at[rows, pos].set(k_new)
-        v_cache = layer["v"].at[rows, pos].set(v_new)
+        k_cache = jnp.where(hit[:, :, None, None], k_new[:, None],
+                            layer["k"])
+        v_cache = jnp.where(hit[:, :, None, None], v_new[:, None],
+                            layer["v"])
         new_cache.append({"k": k_cache, "v": v_cache})
         s = jnp.einsum("bhd,bthd->bht", q, k_cache) / np.sqrt(dh)
-        valid = (jnp.arange(t_cache)[None] <= pos[:, None])  # [B, T]
+        valid = (iota[None] <= pos[:, None])              # [B, T]
         s = jnp.where(valid[:, None, :], s, -1e30)
         w = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bht,bthd->bhd", w, v_cache).reshape(b, dim)
@@ -140,6 +152,58 @@ def _decode_core(params: Dict[str, Any],
     return new_cache, _head(h, params)                    # [B, V]
 
 
+def _decode_core_chunked(params: Dict[str, Any],
+                         cache: List[Dict[str, jnp.ndarray]],
+                         kc: jnp.ndarray, vc: jnp.ndarray,
+                         token: jnp.ndarray, pos0: jnp.ndarray,
+                         j: jnp.ndarray, heads: int
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One token per row against a READ-ONLY full cache plus a small
+    per-chunk K/V buffer (``kc``/``vc`` [L, B, K, H, Dh], written at inner
+    step ``j``) — the flash-decoding split that lets `decode_multi` avoid
+    rewriting the [B, T] cache every token.  Row i's absolute position is
+    ``pos0[i] + j``; full-cache entries are valid strictly below ``pos0``
+    (everything newer lives in the chunk buffer).  Returns the updated
+    chunk buffers and the logits."""
+    b = token.shape[0]
+    dim = params["embed"].shape[1]
+    dh = dim // heads
+    t_cache = cache[0]["k"].shape[1]
+    kcap = kc.shape[2]
+    pos = pos0 + j
+    h = params["embed"][token] + params["pos"][pos]       # [B, D]
+    iota_t = jnp.arange(t_cache)
+    iota_k = jnp.arange(kcap)
+    valid_full = (iota_t[None] < pos0[:, None])           # [B, T]
+    valid_chunk = (iota_k <= j)                           # [K]
+    for li, (blk, layer) in enumerate(zip(params["blocks"], cache)):
+        y = _ln(h, blk["ln1"])
+        q = _with_bias(y @ blk["wq"], blk, "bq").reshape(b, heads, dh)
+        k_new = _with_bias(y @ blk["wk"], blk, "bk").reshape(b, heads, dh)
+        v_new = _with_bias(y @ blk["wv"], blk, "bv").reshape(b, heads, dh)
+        # uniform-position write: every row writes chunk slot j (cheap
+        # contiguous dynamic_update_slice, no per-row scatter)
+        kc = jax.lax.dynamic_update_slice(
+            kc, k_new[None, :, None].astype(kc.dtype), (li, 0, j, 0, 0))
+        vc = jax.lax.dynamic_update_slice(
+            vc, v_new[None, :, None].astype(vc.dtype), (li, 0, j, 0, 0))
+        s_full = jnp.einsum("bhd,bthd->bht", q, layer["k"]) / np.sqrt(dh)
+        s_full = jnp.where(valid_full[:, None, :], s_full, -1e30)
+        s_chunk = jnp.einsum("bhd,bkhd->bhk", q, kc[li]) / np.sqrt(dh)
+        s_chunk = jnp.where(valid_chunk[None, None, :], s_chunk, -1e30)
+        s = jnp.concatenate([s_full, s_chunk], axis=-1)   # [B, H, T+K]
+        w = jax.nn.softmax(s, axis=-1)
+        o = (jnp.einsum("bht,bthd->bhd", w[..., :t_cache], layer["v"])
+             + jnp.einsum("bhk,bkhd->bhd", w[..., t_cache:], vc[li]))
+        h = h + _with_bias(o.reshape(b, dim) @ blk["wo"], blk, "bo")
+        y = _ln(h, blk["ln2"])
+        h = h + _with_bias(
+            jax.nn.gelu(_with_bias(y @ blk["w1"], blk, "b1")) @ blk["w2"],
+            blk, "b2")
+    h = _ln(h, params["ln_f"])
+    return kc, vc, _head(h, params)                       # [B, V]
+
+
 @partial(jax.jit, static_argnames=("heads",), donate_argnums=(1,))
 def decode_step(params: Dict[str, Any],
                 cache: List[Dict[str, jnp.ndarray]],
@@ -150,35 +214,55 @@ def decode_step(params: Dict[str, Any],
     return _decode_core(params, cache, token, pos, heads)
 
 
+#: sampler candidate cap: top-k / nucleus filtering runs over the top
+#: FILTER_CAP logits via `lax.top_k` instead of two full-vocab sorts (a
+#: 50k-wide bitonic sort per token was a measurable share of the decode
+#: step).  Vocabs <= the cap (all tests) are handled EXACTLY; for larger
+#: vocabs, top_k is clamped to the cap and nucleus probabilities are
+#: exact (full-vocab logsumexp) but the nucleus can keep at most the cap's
+#: candidates — the same truncation every capped TPU sampler makes.
+FILTER_CAP = 128
+
+
 def _filter_sample(logits: jnp.ndarray, temps: jnp.ndarray,
                    top_k: jnp.ndarray, top_p: jnp.ndarray,
                    key: jax.Array) -> jnp.ndarray:
     """Per-row greedy / temperature sampling with on-device top-k and
     nucleus filtering ([B, V] logits; top_k 0 = off, top_p 1 = off)."""
     b, v = logits.shape
+    cap = min(FILTER_CAP, v)
     greedy = jnp.argmax(logits, axis=-1)
     temp = jnp.maximum(temps, 1e-6)[:, None]
     scaled = logits.astype(jnp.float32) / temp
-    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
-    # top-k: keep logits >= the k-th largest (k=0/off → threshold -inf)
-    k_idx = jnp.clip(top_k - 1, 0, v - 1)
-    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)
-    kth = jnp.where((top_k > 0)[:, None], kth, -jnp.inf)
-    scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
-    # nucleus: smallest prefix of the sorted dist with mass >= top_p
-    sorted_f = jnp.sort(scaled, axis=-1)[:, ::-1]
-    probs = jax.nn.softmax(sorted_f, axis=-1)
-    csum = jnp.cumsum(probs, axis=-1)
-    # a position stays iff the mass BEFORE it is < top_p; the top token
-    # always stays, so top_p<=0 degenerates to keep-top-token exactly like
-    # the host sampler (_sample_token)
-    keep_sorted = (csum - probs) < jnp.minimum(top_p, 1.0)[:, None]
-    keep_sorted = keep_sorted.at[:, 0].set(True)
-    cutoff = jnp.min(jnp.where(keep_sorted, sorted_f, jnp.inf), axis=-1)
-    active = (top_p < 1.0)[:, None]
-    scaled = jnp.where(active & (scaled < cutoff[:, None]), -jnp.inf,
-                       scaled)
-    sampled = jax.random.categorical(key, scaled, axis=-1)
+    vals, idxs = jax.lax.top_k(scaled, cap)          # [B, cap] desc
+    # exact per-candidate log-probs: normalize against the FULL vocab
+    logz = jax.nn.logsumexp(scaled, axis=-1, keepdims=True)
+    probs = jnp.exp(vals - logz)
+    slot = jnp.arange(cap)[None]                     # [1, cap]
+    # top-k: keep the first top_k slots (0 = off; clamped to the cap)
+    k_active = top_k > 0
+    kk = jnp.where(k_active, jnp.minimum(top_k, cap), cap)[:, None]
+    keep = slot < kk
+    # nucleus AFTER top-k, over the top-k-renormalized distribution (the
+    # sequential-warper order of the host sampler / HF): a slot stays iff
+    # the renormalized mass BEFORE it is < top_p; slot 0 always stays, so
+    # top_p<=0 degenerates to keep-top-token exactly like _sample_token.
+    # With top-k off, the below-cap tail mass still counts in the
+    # denominator, so kept nucleus prefixes are exact (never too small).
+    probs_k = probs * keep
+    tail = jnp.where(k_active, 0.0,
+                     jnp.maximum(1.0 - jnp.sum(probs, axis=-1), 0.0))
+    z_k = jnp.sum(probs_k, axis=-1) + tail
+    csum_before = (jnp.cumsum(probs_k, axis=-1) - probs_k) \
+        / jnp.maximum(z_k, 1e-20)[:, None]
+    p_active = (top_p < 1.0)[:, None]
+    keep &= jnp.where(p_active,
+                      (csum_before < jnp.minimum(top_p, 1.0)[:, None])
+                      | (slot == 0),
+                      True)
+    masked = jnp.where(keep, vals, -jnp.inf)
+    choice = jax.random.categorical(key, masked, axis=-1)    # [B] in slots
+    sampled = jnp.take_along_axis(idxs, choice[:, None], axis=-1)[:, 0]
     return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
 
 
@@ -199,13 +283,25 @@ def decode_multi(params: Dict[str, Any],
     samples.  ``temps`` [B]: 0 → greedy, else temperature sampling with
     per-row on-device top-k / nucleus filtering (`_filter_sample`).
     Returns (cache, emitted [B, k]) where emitted[i, j] is the model output
-    after feeding inner token j — new tokens from j = prompt_n[i]-1 on."""
-    b = prompt_buf.shape[0]
+    after feeding inner token j — new tokens from j = prompt_n[i]-1 on.
 
-    # scan carries the "next token to feed" per row
+    The inner scan never rewrites the [B, T] cache: new K/V land in a
+    [L, B, k] chunk buffer (`_decode_core_chunked`) and are written back
+    ONCE after the scan — without this the per-token full-cache rewrite
+    made the step ~3x slower than its HBM read floor (BENCH_NOTES r4)."""
+    b = prompt_buf.shape[0]
+    nl = len(params["blocks"])
+    dim = params["embed"].shape[1]
+    dh = dim // heads
+    dt = cache[0]["k"].dtype
+    kc0 = jnp.zeros((nl, b, k, heads, dh), dt)
+    vc0 = jnp.zeros((nl, b, k, heads, dh), dt)
+
+    # scan carries the "next token to feed" per row + the chunk buffers
     def step(carry, j):
-        cache, tok, pos, rng = carry
-        cache, logits = _decode_core(params, cache, tok, pos, heads)
+        kc, vc, tok, rng = carry
+        kc, vc, logits = _decode_core_chunked(params, cache, kc, vc, tok,
+                                              pos0, j, heads)
         rng, sub = jax.random.split(rng)
         out_tok = _filter_sample(logits, temps, top_k, top_p, sub)
         # next inner step feeds the prompt while any remains, else out_tok
@@ -213,11 +309,27 @@ def decode_multi(params: Dict[str, Any],
                         prompt_buf[jnp.arange(b),
                                    jnp.minimum(j + 1, k - 1)],
                         out_tok)
-        return (cache, nxt, pos + 1, rng), out_tok
+        return (kc, vc, nxt, rng), out_tok
 
-    carry0 = (cache, prompt_buf[:, 0], pos0, rng)
-    (cache, _, _, _), emitted = jax.lax.scan(step, carry0, jnp.arange(k))
-    return cache, emitted.T                                # [B, k]
+    carry0 = (kc0, vc0, prompt_buf[:, 0], rng)
+    (kc, vc, _, _), emitted = jax.lax.scan(step, carry0, jnp.arange(k))
+
+    # write the chunk back into the persistent cache: full-cache position
+    # iota maps to chunk slot iota - pos0[i] for iota in [pos0, pos0+k)
+    t_cache = cache[0]["k"].shape[1]
+    iota = jnp.arange(t_cache)
+    hit = ((iota[None] >= pos0[:, None])
+           & (iota[None] < pos0[:, None] + k))            # [B, T]
+    slot = jnp.clip(iota[None] - pos0[:, None], 0, k - 1)  # [B, T]
+    out_cache = []
+    for li, layer in enumerate(cache):
+        kf = jnp.take_along_axis(kc[li], slot[:, :, None, None], axis=1)
+        vf = jnp.take_along_axis(vc[li], slot[:, :, None, None], axis=1)
+        out_cache.append({
+            "k": jnp.where(hit[:, :, None, None], kf, layer["k"]),
+            "v": jnp.where(hit[:, :, None, None], vf, layer["v"]),
+        })
+    return out_cache, emitted.T                            # [B, k]
 
 
 class KVCacheLM:
