@@ -40,14 +40,8 @@ NPZ_DIR = os.path.join(HERE, ".data_cache", "northstar")
 TARGET_TEST_ACC = 0.85
 MAX_ROUNDS = 512
 
-#: bf16 peak FLOP/s per chip by device_kind (MXU peak, public specs)
-PEAK_FLOPS = {
-    "TPU v5 lite": 197e12,   # v5e
-    "TPU v5e": 197e12,
-    "TPU v4": 275e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,   # v6e/Trillium
-}
+# bf16 peak FLOP/s table lives in fedml_tpu.constants (single source of
+# truth with benchmarks/llm_bench.py); imported in main() after jax init
 
 
 def _npz_is_current() -> bool:
@@ -161,8 +155,13 @@ def main() -> None:
     else:
         padded_per_round = api.k * api.nb * api.bs
     flops_per_round = padded_per_round * RESNET56_FWD_FLOPS * TRAIN_MULT
+    from fedml_tpu.constants import (
+        TPU_PEAK_BF16_DEFAULT,
+        TPU_PEAK_BF16_FLOPS,
+    )
+
     kind = jax.devices()[0].device_kind
-    peak = PEAK_FLOPS.get(kind, 197e12)
+    peak = TPU_PEAK_BF16_FLOPS.get(kind, TPU_PEAK_BF16_DEFAULT)
     mfu = flops_per_round * rounds_per_sec / peak
 
     # ---- train to the accuracy target (wall-clock-to-accuracy) ------------

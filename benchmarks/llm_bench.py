@@ -35,8 +35,9 @@ after the first accepted run), the script exits 1 when any guarded metric
 falls below floor * 0.8 — same contract as the north-star accuracy guard.
 
 Usage: python benchmarks/llm_bench.py [--quick] [--bs N] [--remat]
-  --quick  skip the batch-size sweeps (used from bench.py: one train bs,
-           decode batches 8/32 only)
+  --quick  skip the batch-size sweeps (used from bench.py: train bs 4
+           only, decode batches 8/128 only; results go to
+           llm_bench_results_quick.json)
 """
 
 import json
@@ -71,15 +72,20 @@ from fedml_tpu.parallel.seq_parallel import (  # noqa: E402
 )
 from fedml_tpu.serving.kv_cache_lm import KVCacheLM  # noqa: E402
 
+from fedml_tpu.constants import (  # noqa: E402
+    TPU_PEAK_BF16_DEFAULT,
+    TPU_PEAK_BF16_FLOPS,
+)
+
 # GPT-2 small class
 VOCAB, DIM, LAYERS, HEADS, SEQ = 50257, 768, 12, 12, 1024
 
-PEAK_FLOPS = {
-    "TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v4": 275e12,
-    "TPU v5p": 459e12, "TPU v6 lite": 918e12,
-}
-
-RESULTS_PATH = os.path.join(HERE, "llm_bench_results.json")
+#: quick mode writes its (reduced-sweep) results to a separate file so it
+#: never clobbers the committed full-sweep artifact that bench.py's
+#: fallback and BENCH_NOTES.md reference
+RESULTS_PATH = os.path.join(
+    HERE, "llm_bench_results_quick.json" if QUICK
+    else "llm_bench_results.json")
 FLOOR_PATH = os.path.join(HERE, "llm_bench_floor.json")
 
 
@@ -272,7 +278,7 @@ def bench_serving(peak: float, rtt: float):
 
 def main() -> None:
     kind = jax.devices()[0].device_kind
-    peak = PEAK_FLOPS.get(kind, 197e12)
+    peak = TPU_PEAK_BF16_FLOPS.get(kind, TPU_PEAK_BF16_DEFAULT)
     rtt = measure_rtt()
     out = {"device": kind, "peak_bf16_flops": peak, "quick": QUICK,
            "host_rtt_ms": round(1e3 * rtt, 1)}

@@ -84,3 +84,17 @@ AXIS_MODEL = "model"       # tensor parallelism
 AXIS_SEQ = "seq"           # sequence/context parallelism (ring attention)
 AXIS_EXPERT = "expert"     # expert parallelism (MoE)
 AXIS_PIPE = "pipe"         # pipeline parallelism
+
+# ---------------------------------------------------------------------------
+# TPU chip peak bf16 FLOP/s by jax device_kind (public specs; MXU peak).
+# Single source of truth for every MFU computation (bench.py,
+# benchmarks/llm_bench.py, probes).
+# ---------------------------------------------------------------------------
+TPU_PEAK_BF16_FLOPS = {
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v4": 275e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,   # v6e/Trillium
+}
+TPU_PEAK_BF16_DEFAULT = 197e12

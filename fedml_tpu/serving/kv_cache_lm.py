@@ -53,6 +53,25 @@ def _head(h, params):
     return h @ params["embed"].T            # tied output embedding
 
 
+def _qkv(y, blk, b, heads, dh):
+    """Single-position q/k/v projections [B, H, Dh] (shared by both decode
+    cores — keep the transformer math in ONE place)."""
+    q = _with_bias(y @ blk["wq"], blk, "bq").reshape(b, heads, dh)
+    k = _with_bias(y @ blk["wk"], blk, "bk").reshape(b, heads, dh)
+    v = _with_bias(y @ blk["wv"], blk, "bv").reshape(b, heads, dh)
+    return q, k, v
+
+
+def _post_attention(h, o, blk, b, dim):
+    """Output projection + residual + MLP half of a block (shared by both
+    decode cores)."""
+    h = h + _with_bias(o.reshape(b, dim) @ blk["wo"], blk, "bo")
+    y = _ln(h, blk["ln2"])
+    return h + _with_bias(
+        jax.nn.gelu(_with_bias(y @ blk["w1"], blk, "b1")) @ blk["w2"],
+        blk, "b2")
+
+
 @partial(jax.jit, static_argnames=("heads", "max_len"))
 def prefill(params: Dict[str, Any], tokens: jnp.ndarray,
             length: jnp.ndarray, heads: int, max_len: int = 0
@@ -130,9 +149,7 @@ def _decode_core(params: Dict[str, Any],
     hit = (iota[None, :] == pos[:, None])                 # [B, T]
     for blk, layer in zip(params["blocks"], cache):
         y = _ln(h, blk["ln1"])
-        q = _with_bias(y @ blk["wq"], blk, "bq").reshape(b, heads, dh)
-        k_new = _with_bias(y @ blk["wk"], blk, "bk").reshape(b, heads, dh)
-        v_new = _with_bias(y @ blk["wv"], blk, "bv").reshape(b, heads, dh)
+        q, k_new, v_new = _qkv(y, blk, b, heads, dh)
         k_cache = jnp.where(hit[:, :, None, None], k_new[:, None],
                             layer["k"])
         v_cache = jnp.where(hit[:, :, None, None], v_new[:, None],
@@ -142,12 +159,8 @@ def _decode_core(params: Dict[str, Any],
         valid = (iota[None] <= pos[:, None])              # [B, T]
         s = jnp.where(valid[:, None, :], s, -1e30)
         w = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bht,bthd->bhd", w, v_cache).reshape(b, dim)
-        h = h + _with_bias(o @ blk["wo"], blk, "bo")
-        y = _ln(h, blk["ln2"])
-        h = h + _with_bias(
-            jax.nn.gelu(_with_bias(y @ blk["w1"], blk, "b1")) @ blk["w2"],
-            blk, "b2")
+        o = jnp.einsum("bht,bthd->bhd", w, v_cache)
+        h = _post_attention(h, o, blk, b, dim)
     h = _ln(h, params["ln_f"])
     return new_cache, _head(h, params)                    # [B, V]
 
@@ -178,9 +191,7 @@ def _decode_core_chunked(params: Dict[str, Any],
     valid_chunk = (iota_k <= j)                           # [K]
     for li, (blk, layer) in enumerate(zip(params["blocks"], cache)):
         y = _ln(h, blk["ln1"])
-        q = _with_bias(y @ blk["wq"], blk, "bq").reshape(b, heads, dh)
-        k_new = _with_bias(y @ blk["wk"], blk, "bk").reshape(b, heads, dh)
-        v_new = _with_bias(y @ blk["wv"], blk, "bv").reshape(b, heads, dh)
+        q, k_new, v_new = _qkv(y, blk, b, heads, dh)
         # uniform-position write: every row writes chunk slot j (cheap
         # contiguous dynamic_update_slice, no per-row scatter)
         kc = jax.lax.dynamic_update_slice(
@@ -195,11 +206,7 @@ def _decode_core_chunked(params: Dict[str, Any],
         w = jax.nn.softmax(s, axis=-1)
         o = (jnp.einsum("bht,bthd->bhd", w[..., :t_cache], layer["v"])
              + jnp.einsum("bhk,bkhd->bhd", w[..., t_cache:], vc[li]))
-        h = h + _with_bias(o.reshape(b, dim) @ blk["wo"], blk, "bo")
-        y = _ln(h, blk["ln2"])
-        h = h + _with_bias(
-            jax.nn.gelu(_with_bias(y @ blk["w1"], blk, "b1")) @ blk["w2"],
-            blk, "b2")
+        h = _post_attention(h, o, blk, b, dim)
     h = _ln(h, params["ln_f"])
     return kc, vc, _head(h, params)                       # [B, V]
 
@@ -221,6 +228,8 @@ def decode_step(params: Dict[str, Any],
 #: vocabs, top_k is clamped to the cap and nucleus probabilities are
 #: exact (full-vocab logsumexp) but the nucleus can keep at most the cap's
 #: candidates — the same truncation every capped TPU sampler makes.
+#: Rows with NO active filter (top_k=0, top_p>=1) bypass the cap entirely
+#: and sample the full vocab.
 FILTER_CAP = 128
 
 
@@ -262,7 +271,13 @@ def _filter_sample(logits: jnp.ndarray, temps: jnp.ndarray,
                       True)
     masked = jnp.where(keep, vals, -jnp.inf)
     choice = jax.random.categorical(key, masked, axis=-1)    # [B] in slots
-    sampled = jnp.take_along_axis(idxs, choice[:, None], axis=-1)[:, 0]
+    filtered = jnp.take_along_axis(idxs, choice[:, None], axis=-1)[:, 0]
+    # rows with BOTH filters off sample the FULL vocab (no cap): plain
+    # temperature sampling must match the host sampler's distribution,
+    # tail included — the cap only applies when a filter is active
+    plain = jax.random.categorical(key, scaled, axis=-1)
+    filters_off = (~k_active) & (top_p >= 1.0)
+    sampled = jnp.where(filters_off, plain, filtered)
     return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
 
 

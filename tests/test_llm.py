@@ -443,6 +443,29 @@ def test_on_device_sampler_top_p_zero_keeps_top_token():
     np.testing.assert_array_equal(np.asarray(out), [1, 2])
 
 
+def test_on_device_sampler_no_filters_reaches_full_vocab():
+    """With top_k=0 and top_p=1 (both off), plain temperature sampling must
+    cover the FULL vocab, not just the top-FILTER_CAP candidates — the
+    capped fast path only applies when a filter is active."""
+    from fedml_tpu.serving.kv_cache_lm import FILTER_CAP, _filter_sample
+
+    v = FILTER_CAP + 72
+    logits = jnp.zeros((1, v))             # uniform: every token likely
+    temps = jnp.asarray([1.0])
+    off_k = jnp.asarray([0])
+    off_p = jnp.asarray([1.0])
+    top128 = set(np.argsort(np.asarray(logits[0]))[::-1][:FILTER_CAP])
+    seen_outside = False
+    for seed in range(64):
+        tok = int(_filter_sample(logits, temps, off_k, off_p,
+                                 jax.random.PRNGKey(seed))[0])
+        assert 0 <= tok < v
+        if tok not in top128:
+            seen_outside = True
+            break
+    assert seen_outside  # P(miss 64x) = (128/200)^64 ~ 4e-13
+
+
 def test_kv_engine_stats_feed_the_autoscaler():
     from fedml_tpu.scheduler.autoscaler import (
         AutoscalePolicy,
