@@ -36,11 +36,19 @@ if USE_CACHE:
 import numpy as np  # noqa: E402
 
 import fedml_tpu  # noqa: E402
+from fedml_tpu.constants import (  # noqa: E402
+    TPU_PEAK_BF16_DEFAULT,
+    TPU_PEAK_BF16_FLOPS,
+)
 from fedml_tpu.runner import FedMLRunner  # noqa: E402
+
+
+def _peak() -> float:
+    kind = jax.devices()[0].device_kind
+    return TPU_PEAK_BF16_FLOPS.get(kind, TPU_PEAK_BF16_DEFAULT)
 
 RESNET56_FWD_FLOPS = 2 * 126.5e6
 TRAIN_MULT = 3.0
-PEAK = 197e12
 
 
 def main() -> None:
@@ -93,7 +101,7 @@ def main() -> None:
         "samples_per_sec": round(
             float(np.sum(np.asarray(rms["samples"]))) / dt, 1),
         "padded_samples_per_sec": round(padded * rps, 1),
-        "est_mfu": round(flops_round * rps / PEAK, 4),
+        "est_mfu": round(flops_round * rps / _peak(), 4),
     }))
 
 

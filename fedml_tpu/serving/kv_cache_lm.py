@@ -270,12 +270,17 @@ def _filter_sample(logits: jnp.ndarray, temps: jnp.ndarray,
                       | (slot == 0),
                       True)
     masked = jnp.where(keep, vals, -jnp.inf)
-    choice = jax.random.categorical(key, masked, axis=-1)    # [B] in slots
+    # ONE gumbel draw serves both paths (categorical == gumbel-argmax):
+    # rows with BOTH filters off sample the FULL vocab (the cap only
+    # applies when a filter is active — plain temperature sampling must
+    # match the host sampler's distribution, tail included), filtered
+    # rows argmax over the kept candidates using the SAME noise gathered
+    # at their vocab positions
+    gumbel = jax.random.gumbel(key, scaled.shape, scaled.dtype)
+    plain = jnp.argmax(scaled + gumbel, axis=-1)
+    g_at = jnp.take_along_axis(gumbel, idxs, axis=-1)        # [B, cap]
+    choice = jnp.argmax(masked + g_at, axis=-1)              # [B] in slots
     filtered = jnp.take_along_axis(idxs, choice[:, None], axis=-1)[:, 0]
-    # rows with BOTH filters off sample the FULL vocab (no cap): plain
-    # temperature sampling must match the host sampler's distribution,
-    # tail included — the cap only applies when a filter is active
-    plain = jax.random.categorical(key, scaled, axis=-1)
     filters_off = (~k_active) & (top_p >= 1.0)
     sampled = jnp.where(filters_off, plain, filtered)
     return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)

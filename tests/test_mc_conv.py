@@ -1,0 +1,62 @@
+"""Multi-client pallas conv kernels (ops/pallas_mc_conv.py) — interpret
+mode off-TPU; the on-chip perf verdict lives in benchmarks/BENCH_NOTES.md
+round 4 (negative result: XLA's grouped conv wins on v5e)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.ops.pallas_mc_conv import conv_for_clients, mc_conv
+
+
+def _ref(x, w, stride):
+    return jax.vmap(lambda xk, wk: jax.lax.conv_general_dilated(
+        xk, wk, window_strides=stride, padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC")))(x, w)
+
+
+CASES = [
+    (3, 4, 8, 8, 16, 16, 3, 3, (1, 1)),     # resnet stage-1 class
+    (2, 4, 8, 8, 16, 32, 3, 3, (2, 2)),     # stage transition
+    (2, 4, 8, 8, 16, 32, 1, 1, (2, 2)),     # 1x1 strided shortcut
+    (2, 2, 5, 7, 8, 8, 3, 3, (1, 1)),       # odd spatial dims
+]
+
+
+@pytest.mark.parametrize("k,b,h,w_,ci,co,kh,kw,stride", CASES)
+def test_mc_conv_forward_matches_lax(k, b, h, w_, ci, co, kh, kw, stride):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((k, b, h, w_, ci)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((k, kh, kw, ci, co)) * 0.1,
+                    jnp.float32)
+    out = mc_conv(x, w, stride, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(
+        _ref(x, w, stride)), atol=1e-4)
+
+
+@pytest.mark.parametrize("k,b,h,w_,ci,co,kh,kw,stride", CASES)
+def test_mc_conv_grads_match_lax(k, b, h, w_, ci, co, kh, kw, stride):
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((k, b, h, w_, ci)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((k, kh, kw, ci, co)) * 0.1,
+                    jnp.float32)
+    oh, ow = -(-h // stride[0]), -(-w_ // stride[1])
+    g = jnp.asarray(rng.standard_normal((k, b, oh, ow, co)), jnp.float32)
+    dxp, dwp = jax.grad(
+        lambda x, w: jnp.sum(mc_conv(x, w, stride, True) * g),
+        argnums=(0, 1))(x, w)
+    dxr, dwr = jax.grad(
+        lambda x, w: jnp.sum(_ref(x, w, stride) * g), argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(dxp), np.asarray(dxr), atol=1e-3)
+    np.testing.assert_allclose(np.asarray(dwp), np.asarray(dwr), atol=1e-3)
+
+
+def test_dispatcher_xla_path_matches_interpret():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((2, 2, 6, 6, 8)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((2, 3, 3, 8, 8)) * 0.1,
+                    jnp.float32)
+    a = conv_for_clients(x, w, impl="xla")
+    b = conv_for_clients(x, w, impl="interpret")
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
