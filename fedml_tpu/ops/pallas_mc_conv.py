@@ -219,18 +219,16 @@ def _mc_bwd_rule(stride, interpret, res, g):
     g = g.astype(x.dtype)
     dw = _mc_conv_wgrad(x, g, kh, kw, stride=stride,
                         interpret=interpret).astype(w.dtype)
-    if stride == (1, 1):
-        # dx = conv(g, flip(w)^T) — same kernel, flipped taps, Ci<->Co
+    if stride == (1, 1) and kh % 2 == 1 and kw % 2 == 1:
+        # dx = conv(g, flip(w)^T) — same kernel, flipped taps, Ci<->Co.
+        # SAME forward/backward paddings only coincide for odd stride-1
+        # kernels (3x3, 1x1 — all of the zoo's stride-1 convs)
         w_flip = jnp.flip(w, axis=(1, 2)).transpose(0, 1, 2, 4, 3)
         dx = _mc_conv_fwd(g, w_flip, stride=(1, 1),
                           interpret=interpret).astype(x.dtype)
-        # SAME forward/backward paddings only coincide for odd kernels
-        # (3x3, 1x1 — all of the zoo's stride-1 convs); guard the
-        # assumption rather than silently corrupting gradients
-        assert kh % 2 == 1 and kw % 2 == 1, "even kernels: XLA fallback"
     else:
-        # strided transposed conv: let XLA handle the 3 rare cases via
-        # gradient of the equivalent grouped conv formulation
+        # strided or even-kernel transposed conv: let XLA derive it from
+        # the equivalent per-client conv formulation (rare cases)
         dx = jax.vmap(
             lambda xk, wk, gk: jax.vjp(
                 lambda xx: jax.lax.conv_general_dilated(

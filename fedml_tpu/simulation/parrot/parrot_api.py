@@ -447,7 +447,8 @@ class ParrotAPI:
 
     # ------------------------------------------------------------------
     def _build_multi_round_step(self):
-        """Scan-rounds fast path: R rounds inside ONE jit dispatch.
+        """Scan-rounds fast path: up to FUSED_CHUNK_ROUNDS rounds inside
+        ONE jit dispatch.
 
         Amortizes per-call dispatch/transfer overhead (dominant when client
         models are small or the device is remote).  Client sampling moves
@@ -455,53 +456,203 @@ class ParrotAPI:
         from the reference's host `np.random.seed(round)` stream — same
         distribution, different draws; the default per-round path keeps
         reference parity.
-        """
+
+        The scan length is ALWAYS the full chunk; a traced ``n_active``
+        scalar masks the tail via per-round `lax.cond` (idle rounds pass
+        the carry through at ~zero cost).  One compiled program therefore
+        serves EVERY round count — which is what makes the AOT export
+        cache (`_ensure_multi_round_step`) a single artifact instead of
+        one per remainder shape."""
         k = self.k
         n_total = self.n_total
+        chunk = self.FUSED_CHUNK_ROUNDS
+        #: stable metrics contract of `_build_aggregate`
+        idle_rm = {"train_loss": jnp.zeros((), jnp.float32),
+                   "train_acc": jnp.zeros((), jnp.float32),
+                   "samples": jnp.zeros((), jnp.float32)}
         if self.n_buckets > 1:
             bucketed = self._build_bucketed_round_step()
 
-            def make_body(data):
+            def make_body(data, n_active):
                 def body(carry, r):
                     gv, st, rng = carry
                     rng, k2 = jax.random.split(rng)
-                    gv, st, rm = bucketed(data, gv, st, k2)
+                    gv, st, rm = jax.lax.cond(
+                        r < n_active,
+                        lambda op: bucketed(data, op[0], op[1], k2),
+                        lambda op: (op[0], op[1], dict(idle_rm)),
+                        (gv, st))
                     return (gv, st, rng), rm
                 return body
         else:
             round_step = self._build_round_step()
 
-            def make_body(data):
+            def make_body(data, n_active):
                 def body(carry, r):
                     gv, st, rng = carry
                     rng, k1, k2 = jax.random.split(rng, 3)
-                    ids = jax.random.permutation(k1, n_total)[:k]
-                    gv, st, rm = round_step(data, gv, st, ids, k2)
+
+                    def run(op):
+                        ids = jax.random.permutation(k1, n_total)[:k]
+                        return round_step(data, op[0], op[1], ids, k2)
+
+                    gv, st, rm = jax.lax.cond(
+                        r < n_active, run,
+                        lambda op: (op[0], op[1], dict(idle_rm)), (gv, st))
                     return (gv, st, rng), rm
                 return body
 
-        def multi(data, global_vars, server_state, rng, n_rounds_arr):
+        def multi(data, global_vars, server_state, rng, n_active):
             (gv, st, _), rms = jax.lax.scan(
-                make_body(data), (global_vars, server_state, rng),
-                jnp.arange(n_rounds_arr.shape[0]))
+                make_body(data, n_active),
+                (global_vars, server_state, rng), jnp.arange(chunk))
             return gv, st, rms
 
         return jax.jit(multi, donate_argnums=(1, 2))
 
-    #: rounds per fused jit call — the scan length is part of the compiled
-    #: shape, so a fixed chunk means ONE compile serves any total round
-    #: count (only a final remainder < chunk triggers a second, smaller
-    #: compile).  Measured on v5e through the remote-TPU tunnel
-    #: (~115 ms/dispatch): chunk 8 → 27 rounds/s, 32 → 38, 64 → 41 on the
-    #: north-star ResNet-56 config; compile time stays ~30 s at every
-    #: chunk size, so take the 64-round plateau.
+    # ------------------------------------------------------------------
+    def _aot_cache_path(self) -> Optional[str]:
+        """Disk path for the serialized multi-round program, or None when
+        AOT caching is off.  The key digests everything the traced
+        program depends on — config knobs, data/model shapes, bucket
+        layout, device topology, jax version, AND the source files that
+        build the trace — so a stale artifact can never be replayed."""
+        if not bool(getattr(self.args, "parrot_aot_cache", True)):
+            return None
+        base = (getattr(self.args, "aot_cache_dir", None)
+                or jax.config.jax_compilation_cache_dir)
+        if not base:
+            return None
+        import hashlib
+        import os
+
+        h = hashlib.sha256()
+        h.update(jax.__version__.encode())
+        devs = jax.devices()
+        h.update(f"{devs[0].platform}:{devs[0].device_kind}:"
+                 f"{len(devs)}".encode())
+        if self.mesh is not None:
+            h.update(repr(tuple(zip(self.mesh.axis_names,
+                                    self.mesh.devices.shape))).encode())
+        cfg = [str(getattr(self.args, f, None)) for f in (
+            "model", "dataset", "federated_optimizer", "client_optimizer",
+            "learning_rate", "momentum", "weight_decay", "epochs",
+            "batch_size", "client_num_in_total", "client_num_per_round",
+            "compute_dtype", "data_dtype", "hetero_buckets", "conv_impl",
+            "server_lr", "server_momentum", "feddyn_alpha", "fedprox_mu",
+            "random_seed")]
+        h.update("|".join(cfg).encode())
+        h.update(repr((self.x_all.shape, str(self.x_all.dtype),
+                       self.y_all.shape, self.nb, self.bs,
+                       self.FUSED_CHUNK_ROUNDS)).encode())
+        if self.buckets is not None:
+            h.update(repr([(b["k"], b["nb"]) for b in self.buckets])
+                     .encode())
+        pkg = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        for rel in ("simulation/parrot/parrot_api.py",
+                    "ml/engine/local_update.py",
+                    "ml/engine/model_bundle.py",
+                    "ml/engine/optimizers.py",
+                    "ml/aggregator/agg_operator.py"):
+            try:
+                with open(os.path.join(pkg, rel), "rb") as f:
+                    h.update(f.read())
+            except OSError:
+                h.update(rel.encode())
+        try:
+            for mod in sorted(os.listdir(os.path.join(pkg, "models"))):
+                if mod.endswith(".py"):
+                    with open(os.path.join(pkg, "models", mod), "rb") as f:
+                        h.update(f.read())
+            os.makedirs(base, exist_ok=True)
+        except OSError as e:  # unwritable cache dir degrades, never aborts
+            logging.warning("parrot: AOT cache dir unusable (%s); caching "
+                            "off", e)
+            return None
+        return os.path.join(base, f"parrot_mrs_{h.hexdigest()[:24]}.jaxexp")
+
+    def _ensure_multi_round_step(self) -> None:
+        """Build (or load) the fused program.  With a cache dir
+        configured, the COMPILED EXECUTABLE round-trips through
+        `jax.experimental.serialize_executable`: a warm process skips the
+        ~40 s retrace, ~5-20 s lowering AND the XLA compile entirely
+        (~29 s executable upload through the tunnel; 94 s → 29 s warm
+        start, VERDICT r3 item 3).  `jax.export` was tried first and
+        REJECTED: its deserialized StableHLO recompiles into a program
+        that executes the chunk 2.4x slower than the jit path (44.8 s vs
+        18.9 s measured on the north star — BENCH_NOTES round 4); the
+        serialized executable is bit-identical to what jit ran.
+
+        The artifact is a pickle (executable bytes + arg trees) keyed by
+        `_aot_cache_path`'s config+code digest, loaded only from the
+        local cache dir this process also writes — same trust domain as
+        jax's own persistent compilation cache."""
+        if self.multi_round_step is not None:
+            return
+        import os
+        import pickle
+
+        fn = self._build_multi_round_step()
+        path = self._aot_cache_path()
+        if path and os.path.exists(path):
+            try:
+                from jax.experimental import serialize_executable
+
+                with open(path, "rb") as f:
+                    blob = pickle.load(f)
+                self.multi_round_step = \
+                    serialize_executable.deserialize_and_load(*blob)
+                logging.info("parrot: fused executable loaded from "
+                             "AOT cache %s", path)
+                return
+            except Exception as e:  # stale/corrupt → rebuild
+                logging.warning("parrot: AOT cache load failed (%s); "
+                                "recompiling", e)
+        # compile EAGERLY even without a cache dir: readiness then always
+        # includes the compile, so callers timing "program ready" vs
+        # "first chunk" (bench.py) measure the same thing on every path
+        try:
+            spec = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                (self.device_data, self.global_vars,
+                 self.server_state, jax.random.PRNGKey(0),
+                 jnp.zeros((), jnp.int32)))
+            compiled = fn.trace(*spec).lower().compile()
+        except Exception as e:
+            logging.warning("parrot: AOT compile failed (%s); using plain "
+                            "jit", e)
+            self.multi_round_step = fn
+            return
+        self.multi_round_step = compiled
+        if path:
+            # persistence failures must not discard the live executable
+            try:
+                from jax.experimental import serialize_executable
+
+                blob = serialize_executable.serialize(compiled)
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    pickle.dump(blob, f)
+                os.replace(tmp, path)
+                logging.info("parrot: fused executable cached to %s", path)
+            except Exception as e:
+                logging.warning("parrot: AOT cache write failed (%s); "
+                                "executable kept in-memory only", e)
+
+    #: rounds per fused call — the scan ALWAYS runs this many iterations
+    #: and a traced ``n_active`` masks the tail, so exactly ONE compiled
+    #: program (and one AOT-cache artifact) serves every total round
+    #: count, remainders included.  Measured on v5e through the
+    #: remote-TPU tunnel (~115 ms/dispatch): chunk 8 → 27 rounds/s,
+    #: 32 → 38, 64 → 41 on the north-star ResNet-56 config; compile time
+    #: stays ~30 s at every chunk size, so take the 64-round plateau.
     FUSED_CHUNK_ROUNDS = 64
 
     def run_rounds_fused(self, n_rounds: int, rng: Optional[jax.Array] = None):
         """Public fast path: run n_rounds fused in fixed-size scan chunks;
         returns stacked per-round metrics (concatenated across chunks)."""
-        if self.multi_round_step is None:
-            self.multi_round_step = self._build_multi_round_step()
+        self._ensure_multi_round_step()
         if rng is None:
             rng = jax.random.PRNGKey(
                 int(getattr(self.args, "random_seed", 0) or 0) + 23)
@@ -518,9 +669,14 @@ class ParrotAPI:
         while remaining > 0:
             step = min(chunk, remaining)
             rng, sub = jax.random.split(rng)
+            # the scan always runs the full chunk; n_active masks the tail
+            # (idle rounds pass the carry through), so one compiled
+            # program serves every round count
             self.global_vars, self.server_state, rms = self.multi_round_step(
                 self.device_data, self.global_vars, self.server_state, sub,
-                jnp.zeros((step,)))
+                jnp.asarray(step, jnp.int32))
+            if step < chunk:
+                rms = jax.tree_util.tree_map(lambda a: a[:step], rms)
             out.append(rms)
             remaining -= step
         if len(out) == 1:

@@ -21,6 +21,7 @@ CASES = [
     (2, 4, 8, 8, 16, 32, 3, 3, (2, 2)),     # stage transition
     (2, 4, 8, 8, 16, 32, 1, 1, (2, 2)),     # 1x1 strided shortcut
     (2, 2, 5, 7, 8, 8, 3, 3, (1, 1)),       # odd spatial dims
+    (2, 2, 6, 6, 8, 8, 2, 2, (1, 1)),       # even kernel -> XLA dx path
 ]
 
 
