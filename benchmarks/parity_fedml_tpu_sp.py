@@ -27,6 +27,10 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--optimizer", default="FedAvg")
     p.add_argument("--rounds", type=int, default=30)
+    p.add_argument("--model", default="lr", choices=["lr", "cnn"],
+                   help="cnn = conv parity plane (reference CNN_DropOut; "
+                        "my cnn_dropout module, dropout zeroed both "
+                        "sides)")
     p.add_argument("--scaffold-ref-bug-compat", action="store_true")
     p.add_argument("--fedavg-ref-chain-compat", action="store_true",
                    help="reproduce the reference's round-0 state_dict "
@@ -60,7 +64,8 @@ def main() -> None:
         dataset="mnist",
         data_cache_dir=CACHE,
         partition_method="natural",      # LEAF users, like the reference
-        model="lr",
+        model=("cnn_dropout" if cli.model == "cnn" else "lr"),
+        cnn_dropout_rates=(0.0, 0.0),    # parity: dropout zeroed both sides
         backend="sp",
         federated_optimizer=local_opt,
         client_num_in_total=2,           # overridden by natural user count
@@ -117,17 +122,33 @@ def main() -> None:
     runner = FedMLRunner(args, device, dataset, bundle)
 
     # start from the reference's exact initial weights when its runner has
-    # exported them (torch Linear [out,in] → flax Dense kernel [in,out])
-    init_path = os.path.join(CACHE, "ref_init_lr.npz")
+    # exported them (torch Linear [out,in] → flax Dense kernel [in,out];
+    # torch Conv OIHW → flax HWIO; the cnn_dropout module flattens
+    # channel-major so torch Linear weights transfer as a plain .T)
+    init_path = os.path.join(CACHE, f"ref_init_{cli.model}.npz")
     if os.path.exists(init_path):
         import jax.numpy as jnp
         z = np.load(init_path)
         api = runner.runner
         params = dict(api.global_vars["params"])
-        dense = dict(params["Dense_0"])
-        dense["kernel"] = jnp.asarray(z["linear.weight"].T)
-        dense["bias"] = jnp.asarray(z["linear.bias"])
-        params["Dense_0"] = dense
+        if cli.model == "cnn":
+            mapping = {
+                "Conv_0": ("conv2d_1", True), "Conv_1": ("conv2d_2", True),
+                "Dense_0": ("linear_1", False),
+                "Dense_1": ("linear_2", False),
+            }
+            for mine, (ref, is_conv) in mapping.items():
+                w = z[f"{ref}.weight"]
+                layer = dict(params[mine])
+                layer["kernel"] = jnp.asarray(
+                    w.transpose(2, 3, 1, 0) if is_conv else w.T)
+                layer["bias"] = jnp.asarray(z[f"{ref}.bias"])
+                params[mine] = layer
+        else:
+            dense = dict(params["Dense_0"])
+            dense["kernel"] = jnp.asarray(z["linear.weight"].T)
+            dense["bias"] = jnp.asarray(z["linear.bias"])
+            params["Dense_0"] = dense
         api.global_vars = dict(api.global_vars, params=params)
         print("loaded reference init", file=sys.stderr)
 
@@ -144,7 +165,8 @@ def main() -> None:
         }
     last = per_round[str(cli.rounds - 1)] if per_round else {}
     print("PARITY_JSON " + json.dumps({
-        "what": f"fedml_tpu_sp_{cli.optimizer.lower()}_mnist_lr_smoke",
+        "what": f"fedml_tpu_sp_{cli.optimizer.lower()}_mnist_"
+                f"{cli.model}_smoke",
         "users": int(args.client_num_in_total),
         "comm_round": cli.rounds,
         "train_wall_s": round(wall, 3),

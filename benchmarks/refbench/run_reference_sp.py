@@ -54,9 +54,13 @@ def main() -> None:
                    choices=["FedAvg", "FedProx", "SCAFFOLD",
                             "FedNova", "FedDyn", "Mime"])
     p.add_argument("--rounds", type=int, default=10)
+    p.add_argument("--model", default="lr", choices=["lr", "cnn"],
+                   help="cnn = the reference CNN_DropOut conv model "
+                        "(model_hub.py:32-37) for the conv parity plane")
     cli, _ = p.parse_known_args()
     CONFIG["train_args"]["federated_optimizer"] = cli.optimizer
     CONFIG["train_args"]["comm_round"] = cli.rounds
+    CONFIG["model_args"]["model"] = cli.model
     # optimizer-specific keys (reference ml/trainer/fedprox_trainer.py:50
     # args.fedprox_mu; sp/scaffold/scaffold_trainer.py:132 args.server_lr)
     CONFIG["train_args"]["fedprox_mu"] = 0.1
@@ -137,6 +141,13 @@ def main() -> None:
     args = fedml.init()
     device = fedml.device.get_device(args)
     dataset, output_dim = fedml.data.load(args)
+    if cli.model == "cnn":
+        # dropout RNG is framework-specific (torch vs jax), so the parity
+        # run zeroes it on BOTH sides: patch nn.Dropout to Identity before
+        # model creation (CNN_DropOut builds its Dropout modules in
+        # __init__, cnn.py:118-123); documented in docs/PARITY.md
+        import torch.nn as _nn
+        _nn.Dropout = lambda *a, **k: _nn.Identity()
     model = fedml.model.create(args, output_dim)
     setup_s = time.time() - t_setup
 
@@ -144,7 +155,7 @@ def main() -> None:
     # the SAME point (cross-framework init transfer for the parity audit)
     import numpy as np
     sd = model.state_dict()
-    np.savez(os.path.join(CACHE, "ref_init_lr.npz"),
+    np.savez(os.path.join(CACHE, f"ref_init_{cli.model}.npz"),
              **{k: v.cpu().numpy() for k, v in sd.items()})
 
     from fedml.simulation.simulator import SimulatorSingleProcess
@@ -156,7 +167,8 @@ def main() -> None:
 
     last = per_round[max(per_round)] if per_round else {}
     out = {
-        "what": f"reference_sp_{cli.optimizer.lower()}_mnist_lr_smoke",
+        "what": f"reference_sp_{cli.optimizer.lower()}_mnist_"
+                f"{cli.model}_smoke",
         "host": "cpu",
         "users": args.client_num_in_total,
         "comm_round": args.comm_round,

@@ -74,6 +74,41 @@ class FedAvgCNN(nn.Module):
                         param_dtype=jnp.float32)(x).astype(jnp.float32)
 
 
+class CNNDropOut(nn.Module):
+    """Reference `model/cv/cnn.py:74-142` CNN_DropOut (the "Adaptive
+    Federated Optimization" EMNIST model), matched op-for-op for the
+    conv-plane parity audit: two 3x3 VALID convs (26→24), one 2x2 pool,
+    dropout, dense 128, dropout, head.  The reference flattens NCHW; this
+    module transposes to channel-major before flattening so imported
+    torch Linear weights transfer as a plain ``.T``.  Reference
+    `model_hub.py:32-37` instantiates it with ``only_digits=False`` (62
+    heads) even for mnist — mirrored by the registry."""
+
+    num_classes: int = 62
+    rate1: float = 0.25
+    rate2: float = 0.5
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 2:                       # flat LEAF rows [B, 784]
+            x = x.reshape((-1, 28, 28))
+        if x.ndim == 3:
+            x = x[..., None]
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID",
+                            dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID",
+                            dtype=self.dtype)(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Dropout(self.rate1, deterministic=not train)(x)
+        x = x.transpose(0, 3, 1, 2).reshape((x.shape[0], -1))  # NCHW flat
+        x = nn.relu(nn.Dense(128, dtype=self.dtype)(x))
+        x = nn.Dropout(self.rate2, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        param_dtype=jnp.float32)(x).astype(jnp.float32)
+
+
 class CIFARCNN(nn.Module):
     """3-block CIFAR CNN (reference `model/cv/cnn.py` CNN_WEB / simple-cnn)."""
 

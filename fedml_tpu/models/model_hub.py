@@ -22,6 +22,7 @@ from .cv import (
     CIFARCNN,
     CIFARResNet,
     EfficientNetB0,
+    CNNDropOut,
     FedAvgCNN,
     LogisticRegression,
     MobileNetV1,
@@ -98,6 +99,15 @@ def create(args: Any, output_dim: Optional[int] = None) -> ModelBundle:
             module = CIFARCNN(num_classes, dtype=dtype)
         else:
             module = FedAvgCNN(num_classes, dtype=dtype)
+    elif name == "cnn_dropout":
+        # reference `model_hub.py:32-37`: mnist/femnist "cnn" builds
+        # CNN_DropOut(only_digits=False) — 62 heads even on mnist; exact
+        # arch for the conv parity audit, dropout rates overridable
+        # (parity zeroes them: dropout RNG is framework-specific)
+        r1, r2 = (getattr(args, "cnn_dropout_rates", None)
+                  or (0.25, 0.5))
+        module = CNNDropOut(num_classes=62, rate1=float(r1),
+                            rate2=float(r2), dtype=dtype)
     elif name in ("resnet56", "resnet20", "resnet32"):
         depth = int(name.replace("resnet", ""))
         module = CIFARResNet(
