@@ -1,0 +1,148 @@
+"""Bisect the conv-parity loss drift: same init, same bytes, both forwards.
+
+Loads `.data_cache/refbench/ref_init_cnn.npz` into (a) the reference's
+torch CNN_DropOut (`/root/reference/python/fedml/model/cv/cnn.py:101-150`,
+dropout zeroed) and (b) fedml_tpu's flax CNNDropOut with the parity
+weight-transfer mapping, runs both on the same LEAF-MNIST test batch, and
+prints max |Δlogits|, per-side CE loss, and per-side one-SGD-step weight
+delta so the drift can be attributed to forward / loss / training math.
+
+Usage: PYTHONPATH=/root/repo python benchmarks/conv_parity_probe.py
+"""
+
+import os
+import sys
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+CACHE = os.path.join(REPO, ".data_cache", "refbench")
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def torch_model(z):
+    import torch
+    import torch.nn as nn
+
+    class RefCNN(nn.Module):
+        """Op-for-op copy of the reference forward (cnn.py:126-142),
+        dropout omitted (the parity run patches Dropout -> Identity)."""
+
+        def __init__(self):
+            super().__init__()
+            self.conv2d_1 = nn.Conv2d(1, 32, kernel_size=3)
+            self.max_pooling = nn.MaxPool2d(2, stride=2)
+            self.conv2d_2 = nn.Conv2d(32, 64, kernel_size=3)
+            self.flatten = nn.Flatten()
+            self.linear_1 = nn.Linear(9216, 128)
+            self.linear_2 = nn.Linear(128, 62)
+            self.relu = nn.ReLU()
+
+        def forward(self, x):
+            x = torch.unsqueeze(x, 1)
+            x = self.relu(self.conv2d_1(x))
+            x = self.relu(self.conv2d_2(x))
+            x = self.max_pooling(x)
+            x = self.flatten(x)
+            x = self.relu(self.linear_1(x))
+            return self.linear_2(x)
+
+    m = RefCNN()
+    sd = {k: torch.from_numpy(np.asarray(v)) for k, v in z.items()}
+    m.load_state_dict(sd)
+    return m
+
+
+def flax_model(z):
+    import jax.numpy as jnp
+    from fedml_tpu.models.cv import CNNDropOut
+
+    module = CNNDropOut(num_classes=62, rate1=0.0, rate2=0.0)
+    import jax
+    params = module.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1, 28, 28)))["params"]
+    mapping = {"Conv_0": ("conv2d_1", True), "Conv_1": ("conv2d_2", True),
+               "Dense_0": ("linear_1", False),
+               "Dense_1": ("linear_2", False)}
+    params = dict(params)
+    for mine, (ref, is_conv) in mapping.items():
+        w = np.asarray(z[f"{ref}.weight"])
+        layer = dict(params[mine])
+        layer["kernel"] = jnp.asarray(
+            w.transpose(2, 3, 1, 0) if is_conv else w.T)
+        layer["bias"] = jnp.asarray(np.asarray(z[f"{ref}.bias"]))
+        params[mine] = layer
+    return module, params
+
+
+def main() -> None:
+    import torch
+    import torch.nn as nn
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    z = np.load(os.path.join(CACHE, "ref_init_cnn.npz"))
+    test = np.load(os.path.join(CACHE, "leaf_mnist_test.npz"),
+                   allow_pickle=True)
+    users = sorted(k[2:] for k in test.files if k.startswith("x_"))
+    x = np.concatenate([test[f"x_{u}"] for u in users[:5]])[:64]
+    y = np.concatenate([test[f"y_{u}"] for u in users[:5]])[:64]
+    print(f"batch: x{x.shape} y{y.shape}", file=sys.stderr)
+
+    tm = torch_model(z)
+    tm.eval()
+    tx = torch.from_numpy(x).float().reshape(-1, 28, 28)
+    ty = torch.from_numpy(y).long()
+    with torch.no_grad():
+        tlogits = tm(tx).numpy()
+        tloss = float(nn.CrossEntropyLoss()(torch.from_numpy(tlogits),
+                                            ty))
+
+    module, params = flax_model(z)
+    jx = jnp.asarray(x, jnp.float32)
+    jlogits = np.asarray(module.apply({"params": params}, jx))
+    jloss = float(optax.softmax_cross_entropy_with_integer_labels(
+        jnp.asarray(jlogits), jnp.asarray(y, jnp.int32)).mean())
+
+    dlog = np.abs(tlogits - jlogits).max()
+    print(f"FORWARD  max|dlogits|={dlog:.3e}  "
+          f"torch_loss={tloss:.6f} jax_loss={jloss:.6f} "
+          f"dloss={abs(tloss - jloss):.3e}")
+
+    # one SGD step on one batch, then diff the updated weights
+    crit = nn.CrossEntropyLoss()
+    tm.train()
+    opt = torch.optim.SGD(tm.parameters(), lr=0.03)
+    opt.zero_grad()
+    crit(tm(tx[:10]), ty[:10]).backward()
+    opt.step()
+    sd_after = {k: v.detach().numpy() for k, v in tm.state_dict().items()}
+
+    def loss_fn(p):
+        lg = module.apply({"params": p}, jx[:10])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            lg, jnp.asarray(y[:10], jnp.int32)).mean()
+
+    g = jax.grad(loss_fn)(params)
+    jparams = jax.tree.map(lambda p, gg: p - 0.03 * gg, params, g)
+
+    mapping = {"Conv_0": ("conv2d_1", True), "Conv_1": ("conv2d_2", True),
+               "Dense_0": ("linear_1", False),
+               "Dense_1": ("linear_2", False)}
+    worst = 0.0
+    for mine, (ref, is_conv) in mapping.items():
+        tw = sd_after[f"{ref}.weight"]
+        tw = tw.transpose(2, 3, 1, 0) if is_conv else tw.T
+        dw = np.abs(tw - np.asarray(jparams[mine]["kernel"])).max()
+        db = np.abs(sd_after[f"{ref}.bias"]
+                    - np.asarray(jparams[mine]["bias"])).max()
+        print(f"STEP     {mine}: max|dW|={dw:.3e} max|db|={db:.3e}")
+        worst = max(worst, dw, db)
+    print(f"RESULT   forward_dlogits={dlog:.3e} step_dw={worst:.3e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -37,14 +37,28 @@ TOL_ROUND = 0.12        # any round: gross-divergence bound
 TOL_FINAL = 0.02        # final-round |Δ test_acc|
 OPTIMIZERS = ["FedAvg", "FedProx", "SCAFFOLD", "FedNova", "FedDyn",
               "Mime"]
+#: conv-plane rounds: the reference CNN_DropOut on CPU costs ~50 s/round
+#: (100-client eval each round), so the conv audit runs a shorter window
+#: by default; PARITY_CNN_ROUNDS=30 reproduces the full lr-plane window.
+CNN_ROUNDS = int(os.environ.get("PARITY_CNN_ROUNDS", "8"))
+#: (optimizer, model) planes: every optimizer on lr, plus the conv plane
+#: (reference CNN_DropOut, model_hub.py:32-37) on FedAvg.  The conv plane
+#: MUST run with the same round-0 chain-compat flag as lr-FedAvg — without
+#: it the round-0 sequential-chaining deviation (docs/PARITY.md item 1)
+#: shows up as a ~0.1 early-window loss drift that decays by round 3 while
+#: accuracy stays identical (root-caused round 5: that drift is the
+#: chain-compat flag missing, not conv semantics; with the flag the curves
+#: match to 1e-4).
+PLANES = [(opt, "lr", ROUNDS) for opt in OPTIMIZERS] + [
+    ("FedAvg", "cnn", CNN_ROUNDS)]
 
 
-def _run(cmd, env=None):
+def _run(cmd, env=None, timeout=900):
     e = dict(os.environ)
     if env:
         e.update(env)
     out = subprocess.run(cmd, capture_output=True, text=True, env=e,
-                         timeout=900)
+                         timeout=timeout)
     for line in (out.stdout + out.stderr).splitlines():
         if line.startswith("PARITY_JSON ") or " PARITY_JSON " in line:
             return json.loads(line.split("PARITY_JSON ", 1)[1])
@@ -54,15 +68,20 @@ def _run(cmd, env=None):
 def main() -> None:
     results = {}
     failures = []
-    for opt in OPTIMIZERS:
+    for opt, model, rounds in PLANES:
+        plane = opt if model == "lr" else f"{opt}_{model}"
         ref = _run([sys.executable,
                     os.path.join(HERE, "refbench", "run_reference_sp.py"),
-                    "--optimizer", opt, "--rounds", str(ROUNDS)],
+                    "--optimizer", opt, "--rounds", str(rounds),
+                    "--model", model],
                    env={"PYTHONPATH":
-                        f"{STUBS}:/root/reference/python"})
+                        f"{STUBS}:/root/reference/python"},
+                   # the reference CNN costs ~50 s/round on CPU
+                   timeout=(900 if model == "lr" else 120 * rounds))
         mine_cmd = [sys.executable,
                     os.path.join(HERE, "parity_fedml_tpu_sp.py"),
-                    "--optimizer", opt, "--rounds", str(ROUNDS)]
+                    "--optimizer", opt, "--rounds", str(rounds),
+                    "--model", model]
         # per-optimizer reference-bug compat flags (each reproduces the
         # reference's OWN implementation exactly; docs/PARITY.md lists
         # what each flag stands in for)
@@ -80,10 +99,11 @@ def main() -> None:
             # (state_dict aliasing — root-caused in parity_round0_oracle.py)
             mine_cmd.append("--fedavg-ref-chain-compat")
         mine = _run(mine_cmd, env={"JAX_PLATFORMS": "cpu",
-                                   "PYTHONPATH": REPO})
+                                   "PYTHONPATH": REPO},
+                    timeout=(900 if model == "lr" else 120 * rounds))
         rows = []
         max_d = 0.0
-        for r in range(ROUNDS):
+        for r in range(rounds):
             ra = ref["per_round"].get(str(r), {})
             ma = mine["per_round"].get(str(r), {})
             if "Test/Acc" not in ra or "Test/Acc" not in ma:
@@ -102,28 +122,29 @@ def main() -> None:
              and r.get("ref_loss") is not None
              and r.get("tpu_loss") is not None), default=0.0)
         final_d = abs(ref.get("test_acc", 0) - mine.get("test_acc", 0))
-        results[opt] = {"rounds": rows, "max_abs_acc_diff": max_d,
+        results[plane] = {"rounds": rows, "max_abs_acc_diff": max_d,
                         "early_window_diff": early_d,
                         "early_window_loss_diff": early_loss_d,
                         "final_abs_diff": final_d,
                         "final_ref_acc": ref.get("test_acc"),
                         "final_tpu_acc": mine.get("test_acc")}
         if early_d > TOL_EARLY:
-            failures.append(f"{opt}: early-window diff {early_d:.4f}")
+            failures.append(f"{plane}: early-window diff {early_d:.4f}")
         if early_loss_d > TOL_EARLY_LOSS:
             failures.append(
-                f"{opt}: early-window LOSS diff {early_loss_d:.4f}")
+                f"{plane}: early-window LOSS diff {early_loss_d:.4f}")
         if max_d > TOL_ROUND:
-            failures.append(f"{opt}: per-round diff {max_d:.4f}")
+            failures.append(f"{plane}: per-round diff {max_d:.4f}")
         if final_d > TOL_FINAL:
-            failures.append(f"{opt}: final diff {final_d:.4f}")
-        print(f"{opt}: early |d| = {early_d:.4f} "
+            failures.append(f"{plane}: final diff {final_d:.4f}")
+        print(f"{plane}: early |d| = {early_d:.4f} "
               f"(loss {early_loss_d:.4f}), max |d| = {max_d:.4f}, "
               f"final ref={ref.get('test_acc'):.4f} "
               f"tpu={mine.get('test_acc'):.4f}")
 
     with open(os.path.join(HERE, "parity_results.json"), "w") as f:
         json.dump({"rounds": ROUNDS,
+                   "cnn_rounds": CNN_ROUNDS,
                    "tolerances": {"early": TOL_EARLY,
                                   "early_rounds": EARLY_ROUNDS,
                                   "per_round": TOL_ROUND,
@@ -160,8 +181,9 @@ def _write_doc(results) -> None:
                   "",
                   "| round | reference acc | fedml_tpu acc | abs diff |",
                   "|---|---|---|---|"]
+        last_round = max((row["round"] for row in r["rounds"]), default=0)
         for row in r["rounds"]:
-            if row["round"] % 3 == 0 or row["round"] == ROUNDS - 1:
+            if row["round"] % 3 == 0 or row["round"] == last_round:
                 lines.append(
                     f"| {row['round']} | {row['ref_acc']:.4f} | "
                     f"{row['tpu_acc']:.4f} | {row['abs_diff']:.4f} |")
@@ -254,6 +276,21 @@ def _write_doc(results) -> None:
         "the model per client (no aliasing) and its normalized-gradient "
         "aggregation is algebraically identical to fedml_tpu's "
         "(the learning rate cancels); measured equality to float noise.",
+        "10. **Conv plane (FedAvg_cnn, reference CNN_DropOut) needs the "
+        "same round-0 chain-compat flag as lr-FedAvg** — running "
+        "`parity_fedml_tpu_sp.py --model cnn` WITHOUT "
+        "`--fedavg-ref-chain-compat` reproduces deviation 1's signature "
+        "on the conv plane: early-window test-loss drift ~0.105 at round "
+        "0 decaying to ~0.009 by round 3 while per-round accuracy stays "
+        "identical (the chained extra SGD steps barely move argmax on a "
+        "62-class head whose 52 non-digit logits dominate the loss). "
+        "With the flag (what this audit runs), curves match to 1e-4: "
+        "conv/pool/dropout/flatten semantics, the OIHW→HWIO / NCHW-flat "
+        "weight transfer, and the eval loss reduction are all exact "
+        "(bisected by `benchmarks/conv_parity_probe.py`: forward "
+        "|Δlogits| ≤ 3e-4, one-SGD-step |ΔW| ≤ 7e-5; dropout is zeroed "
+        "on both sides — torch patches Dropout→Identity, flax rates "
+        "(0,0) — because dropout RNG is framework-specific).",
         "",
     ]
     os.makedirs(os.path.join(REPO, "docs"), exist_ok=True)
