@@ -209,6 +209,11 @@ def main() -> None:
         "samples_per_sec_vs_baseline": round(
             samples_per_sec / float(anchor["samples_per_sec"]), 2),
         "compile_s": round(compile_s, 1),
+        # True ⇒ compile_s is the WARM path (executable deserialized from
+        # the AOT cache, no trace/lower/compile) — the driver-visible
+        # warm-start datum VERDICT r4 item 2 asked for; cross-process
+        # correctness proof lives in tests/test_aot_cache.py
+        "aot_cache_hit": bool(getattr(api, "aot_cache_hit", False)),
         "first_chunk_s": round(first_chunk_s, 1),
         "rounds_to_report": rounds_done,
         "final_test_acc": round(acc, 4),
@@ -229,13 +234,19 @@ def main() -> None:
             [sys.executable,
              os.path.join(HERE, "benchmarks", "llm_bench.py"), "--quick"],
             capture_output=True, text=True, timeout=900)
-        for line in reversed(proc.stdout.strip().splitlines()):
-            try:
-                llm = json.loads(line)
-                break
-            except json.JSONDecodeError:
-                continue
-        result["llm_guard"] = "ok" if proc.returncode == 0 else "failed"
+        if proc.returncode == 0:
+            for line in reversed(proc.stdout.strip().splitlines()):
+                try:
+                    llm = json.loads(line)
+                    break
+                except json.JSONDecodeError:
+                    continue
+            result["llm_guard"] = "ok"
+        else:
+            # a guard-tripped run may still print its summary JSON; do NOT
+            # merge its metrics under the good-run keys — fall through to
+            # the committed last-good results (marked stale below)
+            result["llm_guard"] = "failed"
     except Exception as e:
         result["llm_guard"] = f"error: {e}"
     if llm is None:
@@ -248,7 +259,14 @@ def main() -> None:
                    "llm_ttft_ms": d["serving"]["ttft_ms_b1_p512"],
                    "llm_decode_tokens_per_sec":
                        d["serving"]["best_decode_tokens_per_sec"]}
-            result["llm_guard"] = "stale (committed results)"
+            # keep the failure signal visible: a guard-tripped run must not
+            # masquerade as a benign skip just because last-good metrics
+            # exist to show
+            if result.get("llm_guard") == "failed":
+                result["llm_guard"] = \
+                    "failed (showing committed last-good metrics)"
+            else:
+                result["llm_guard"] = "stale (committed results)"
         except Exception:
             llm = {}
     for k in ("llm_sft_mfu", "llm_sft_tokens_per_sec", "llm_ttft_ms",

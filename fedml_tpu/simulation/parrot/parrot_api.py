@@ -159,6 +159,11 @@ class ParrotAPI:
             self.bucketed_round_step = jax.jit(
                 self._build_bucketed_round_step(), donate_argnums=(1, 2))
         self.multi_round_step = None  # built lazily for the scan fast path
+        #: True when the fused executable was deserialized from the AOT
+        #: cache instead of compiled — the committed cross-process proof
+        #: (tests/test_aot_cache.py) and bench.py's warm/cold marker
+        self.aot_cache_hit = False
+        self._fused_is_plain_jit = False
         self.metrics_history: List[Dict[str, Any]] = []
 
     def _build_buckets(self) -> None:
@@ -565,7 +570,27 @@ class ParrotAPI:
                 if mod.endswith(".py"):
                     with open(os.path.join(pkg, "models", mod), "rb") as f:
                         h.update(f.read())
-            os.makedirs(base, exist_ok=True)
+            # the artifact is a pickle, so the cache dir must be a private
+            # trust domain: create 0o700, refuse dirs owned by another
+            # uid, and strip group/other permissions from pre-existing
+            # dirs (makedirs only applies the mode on creation) — an
+            # attacker able to write here gets code execution in the
+            # training process
+            os.makedirs(base, mode=0o700, exist_ok=True)
+            if hasattr(os, "getuid"):
+                st = os.stat(base)
+                if st.st_uid != os.getuid():
+                    logging.warning(
+                        "parrot: AOT cache dir %s owned by uid %d (not "
+                        "ours); caching off", base, st.st_uid)
+                    return None
+                if st.st_mode & 0o077:
+                    os.chmod(base, 0o700)
+                    if os.stat(base).st_mode & 0o022:
+                        logging.warning(
+                            "parrot: AOT cache dir %s stays group/world "
+                            "writable; caching off", base)
+                        return None
         except OSError as e:  # unwritable cache dir degrades, never aborts
             logging.warning("parrot: AOT cache dir unusable (%s); caching "
                             "off", e)
@@ -600,9 +625,21 @@ class ParrotAPI:
                 from jax.experimental import serialize_executable
 
                 with open(path, "rb") as f:
+                    # fstat the OPEN fd (not the path) so a symlink swap
+                    # between check and read can't redirect the unpickle
+                    if hasattr(os, "getuid"):
+                        import stat as _stat
+
+                        st = os.fstat(f.fileno())
+                        if (st.st_uid != os.getuid()
+                                or not _stat.S_ISREG(st.st_mode)):
+                            raise PermissionError(
+                                f"{path} not a regular file owned by us; "
+                                "refusing to unpickle")
                     blob = pickle.load(f)
                 self.multi_round_step = \
                     serialize_executable.deserialize_and_load(*blob)
+                self.aot_cache_hit = True
                 logging.info("parrot: fused executable loaded from "
                              "AOT cache %s", path)
                 return
@@ -613,8 +650,23 @@ class ParrotAPI:
         # includes the compile, so callers timing "program ready" vs
         # "first chunk" (bench.py) measure the same thing on every path
         try:
+            def _spec(a):
+                # carry the committed arrays' shardings into the traced
+                # specs so the compiled executable binds the same input
+                # layouts jit would infer — specs from shape/dtype alone
+                # can compile a program that reshards (or fails) at call
+                # time on a multi-chip mesh
+                sh = getattr(a, "sharding", None)
+                if sh is not None:
+                    try:
+                        return jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                                    sharding=sh)
+                    except TypeError:
+                        pass
+                return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
             spec = jax.tree_util.tree_map(
-                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                _spec,
                 (self.device_data, self.global_vars,
                  self.server_state, jax.random.PRNGKey(0),
                  jnp.zeros((), jnp.int32)))
@@ -623,6 +675,7 @@ class ParrotAPI:
             logging.warning("parrot: AOT compile failed (%s); using plain "
                             "jit", e)
             self.multi_round_step = fn
+            self._fused_is_plain_jit = True
             return
         self.multi_round_step = compiled
         if path:
@@ -672,9 +725,54 @@ class ParrotAPI:
             # the scan always runs the full chunk; n_active masks the tail
             # (idle rounds pass the carry through), so one compiled
             # program serves every round count
-            self.global_vars, self.server_state, rms = self.multi_round_step(
-                self.device_data, self.global_vars, self.server_state, sub,
-                jnp.asarray(step, jnp.int32))
+            try:
+                self.global_vars, self.server_state, rms = \
+                    self.multi_round_step(
+                        self.device_data, self.global_vars,
+                        self.server_state, sub,
+                        jnp.asarray(step, jnp.int32))
+            except Exception as e:
+                # an AOT/deserialized executable can still reject its args
+                # at bind time (input layout/sharding mismatch vs what jit
+                # would have inferred); bind-time failures leave the donated
+                # buffers intact, so fall back to the plain jit fn once.
+                # An EXECUTION-time failure has already consumed the donated
+                # state — detect that (deleted leaves) and re-raise the
+                # root cause instead of crashing later on dead arrays.
+                if self._fused_is_plain_jit:
+                    raise
+
+                def _live(tree):
+                    return all(
+                        not (hasattr(leaf, "is_deleted")
+                             and leaf.is_deleted())
+                        for leaf in jax.tree_util.tree_leaves(tree))
+
+                if not (_live(self.global_vars)
+                        and _live(self.server_state)):
+                    raise
+                logging.warning("parrot: compiled fused step rejected its "
+                                "args (%s); falling back to plain jit", e)
+                if self.aot_cache_hit:
+                    # the artifact produced a bind-incompatible executable;
+                    # drop it so later processes recompile+rewrite instead
+                    # of paying load→bind-fail→retrace forever
+                    import os
+
+                    stale = self._aot_cache_path()
+                    if stale:
+                        try:
+                            os.remove(stale)
+                        except OSError:
+                            pass
+                self.multi_round_step = self._build_multi_round_step()
+                self._fused_is_plain_jit = True
+                self.aot_cache_hit = False
+                self.global_vars, self.server_state, rms = \
+                    self.multi_round_step(
+                        self.device_data, self.global_vars,
+                        self.server_state, sub,
+                        jnp.asarray(step, jnp.int32))
             if step < chunk:
                 rms = jax.tree_util.tree_map(lambda a: a[:step], rms)
             out.append(rms)
