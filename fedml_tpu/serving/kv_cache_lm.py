@@ -286,13 +286,100 @@ def _filter_sample(logits: jnp.ndarray, temps: jnp.ndarray,
     return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnames=("heads", "k"), donate_argnums=(1,))
+#: bisection depth for the exact sampler: threshold resolution is
+#: (max-min scaled logit)/2^ITERS per row — 1e-6-ish for sane
+#: temperatures, i.e. below float32 spacing of the log-probs involved
+EXACT_FILTER_ITERS = 30
+
+
+def _exact_filter_sample(logits: jnp.ndarray, temps: jnp.ndarray,
+                         top_k: jnp.ndarray, top_p: jnp.ndarray,
+                         key: jax.Array) -> jnp.ndarray:
+    """EXACT full-vocab top-k / nucleus filtering (VERDICT r4 item 7).
+
+    Instead of sorting the vocab (a 50k-wide bitonic sort per token) or
+    truncating candidates at FILTER_CAP, find the per-row keep THRESHOLDS
+    by bisection — each iteration is one [B, V] compare+reduce, so the
+    cost is ~2*EXACT_FILTER_ITERS cheap passes and no sort at all:
+
+    - top-k keeps ``logp >= t_k`` where t_k is the largest threshold with
+      ``count(logp >= t_k) >= k`` (== the k-th largest value, exactly);
+    - nucleus (after top-k renormalization, HF sequential-warper order)
+      keeps ``logp >= t_p`` where t_p is the largest threshold whose kept
+      mass reaches ``top_p`` — the minimal sorted prefix crossing top_p,
+      i.e. the token that crosses the boundary is kept, like the capped
+      path's ``csum_before < p`` rule.
+
+    Deviation from a sorted implementation: EXACT float ties at either
+    boundary are all kept (a sort would keep only the first by sort
+    order) — measure-zero for real logits.  Rows with filters off sample
+    the full vocab with the SAME gumbel draw as `_filter_sample`, so the
+    two samplers are distribution-identical wherever both are exact.
+    Tested against a numpy sorted-nucleus oracle at vocab 50257
+    (tests/test_llm.py::test_exact_topp_*)."""
+    keep, scaled, greedy = _exact_filter_keep(logits, temps, top_k, top_p)
+    gumbel = jax.random.gumbel(key, scaled.shape, scaled.dtype)
+    choice = jnp.argmax(jnp.where(keep, scaled + gumbel, -jnp.inf),
+                        axis=-1)
+    return jnp.where(temps > 0, choice, greedy).astype(jnp.int32)
+
+
+def _exact_filter_keep(logits: jnp.ndarray, temps: jnp.ndarray,
+                       top_k: jnp.ndarray, top_p: jnp.ndarray):
+    """Bisected per-row keep mask for `_exact_filter_sample` (split out so
+    tests can diff the SET against a numpy sorted-nucleus oracle)."""
+    b, v = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+    temp = jnp.maximum(temps, 1e-6)[:, None]
+    scaled = logits.astype(jnp.float32) / temp
+    logz = jax.nn.logsumexp(scaled, axis=-1, keepdims=True)
+    logp = scaled - logz                                   # [B, V]
+    hi0 = jnp.max(logp, axis=-1) + 1e-3
+    lo0 = jnp.min(logp, axis=-1) - 1e-3
+
+    k_active = top_k > 0
+    kk = jnp.where(k_active, top_k, v).astype(jnp.float32)
+
+    # invariant: count{>=lo} >= k >= count{>=hi} (hi above the max keeps
+    # nothing; lo below the min keeps everything)
+    def kbody(_, lh):
+        lo, hi = lh
+        mid = 0.5 * (lo + hi)
+        cnt = jnp.sum(logp >= mid[:, None], axis=-1).astype(jnp.float32)
+        ge = cnt >= kk
+        return jnp.where(ge, mid, lo), jnp.where(ge, hi, mid)
+
+    t_k, _ = jax.lax.fori_loop(0, EXACT_FILTER_ITERS, kbody, (lo0, hi0))
+    keep = jnp.where(k_active[:, None], logp >= t_k[:, None], True)
+
+    probs_k = jnp.where(keep, jnp.exp(logp), 0.0)          # [B, V]
+    target = jnp.clip(top_p, 0.0, 1.0) * jnp.sum(probs_k, axis=-1)
+
+    def pbody(_, lh):
+        lo, hi = lh
+        mid = 0.5 * (lo + hi)
+        mass = jnp.sum(jnp.where(logp >= mid[:, None], probs_k, 0.0),
+                       axis=-1)
+        ge = mass >= target
+        return jnp.where(ge, mid, lo), jnp.where(ge, hi, mid)
+
+    t_p, _ = jax.lax.fori_loop(0, EXACT_FILTER_ITERS, pbody, (lo0, hi0))
+    p_active = top_p < 1.0
+    keep &= jnp.where(p_active[:, None], logp >= t_p[:, None], True)
+    # the argmax token can never be filtered out (top_p <= 0 degenerates
+    # to keep-top-token, matching _filter_sample's slot-0 rule)
+    keep |= jax.nn.one_hot(greedy, v, dtype=bool)
+    return keep, scaled, greedy
+
+
+@partial(jax.jit, static_argnames=("heads", "k", "exact_filters"),
+         donate_argnums=(1,))
 def decode_multi(params: Dict[str, Any],
                  cache: List[Dict[str, jnp.ndarray]],
                  prompt_buf: jnp.ndarray, prompt_n: jnp.ndarray,
                  pos0: jnp.ndarray, temps: jnp.ndarray,
                  top_k: jnp.ndarray, top_p: jnp.ndarray, rng: jax.Array,
-                 heads: int, k: int):
+                 heads: int, k: int, exact_filters: bool = False):
     """k tokens per row in ONE dispatch, sampling on-device — the
     autoregressive loop never returns to the host mid-chunk (a ~k×
     dispatch-latency win on remote/tunneled accelerators, and no per-token
@@ -323,7 +410,12 @@ def decode_multi(params: Dict[str, Any],
         kc, vc, logits = _decode_core_chunked(params, cache, kc, vc, tok,
                                               pos0, j, heads)
         rng, sub = jax.random.split(rng)
-        out_tok = _filter_sample(logits, temps, top_k, top_p, sub)
+        # static switch: exact_filters=True routes through the full-vocab
+        # bisection sampler (needed only when vocab > FILTER_CAP and a
+        # request's nucleus/top-k could exceed the cap; the engine picks
+        # per dispatch, so unfiltered batches never pay for it)
+        sampler = _exact_filter_sample if exact_filters else _filter_sample
+        out_tok = sampler(logits, temps, top_k, top_p, sub)
         # next inner step feeds the prompt while any remains, else out_tok
         nxt = jnp.where(j + 1 < prompt_n,
                         prompt_buf[jnp.arange(b),
@@ -384,9 +476,11 @@ class KVCacheLM:
         return decode_step(self.params, cache, token, pos, self.heads)
 
     def decode_multi(self, cache, prompt_buf, prompt_n, pos0, temps,
-                     top_k, top_p, rng, k: int):
+                     top_k, top_p, rng, k: int,
+                     exact_filters: bool = False):
         return decode_multi(self.params, cache, prompt_buf, prompt_n, pos0,
-                            temps, top_k, top_p, rng, self.heads, k)
+                            temps, top_k, top_p, rng, self.heads, k,
+                            exact_filters)
 
     def full_logits(self, tokens):
         """Non-cached forward (parity reference / tests)."""

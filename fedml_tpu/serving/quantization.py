@@ -84,10 +84,11 @@ class QuantizedKVCacheLM(KVCacheLM):
         return _q_decode(self.params, cache, token, pos, self.heads)
 
     def decode_multi(self, cache, prompt_buf, prompt_n, pos0, temps,
-                     top_k, top_p, rng, k: int):
+                     top_k, top_p, rng, k: int,
+                     exact_filters: bool = False):
         return _q_decode_multi(self.params, cache, prompt_buf, prompt_n,
                                pos0, temps, top_k, top_p, rng, self.heads,
-                               k)
+                               k, exact_filters)
 
     def full_logits(self, tokens):
         return KVCacheLM(_dequant_blocks(self.params), self.heads,
@@ -110,11 +111,13 @@ def _q_decode(params, cache, token, pos, heads):
                                       pos, heads)
 
 
-@partial(jax.jit, static_argnames=("heads", "k"), donate_argnums=(1,))
+@partial(jax.jit, static_argnames=("heads", "k", "exact_filters"),
+         donate_argnums=(1,))
 def _q_decode_multi(params, cache, prompt_buf, prompt_n, pos0, temps,
-                    top_k, top_p, rng, heads, k):
+                    top_k, top_p, rng, heads, k, exact_filters=False):
     from . import kv_cache_lm as _k
 
     return _k.decode_multi.__wrapped__(_dequant_blocks(params), cache,
                                        prompt_buf, prompt_n, pos0, temps,
-                                       top_k, top_p, rng, heads, k)
+                                       top_k, top_p, rng, heads, k,
+                                       exact_filters)
