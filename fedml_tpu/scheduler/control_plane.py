@@ -36,10 +36,9 @@ import re
 import threading
 import urllib.error
 import urllib.request
-from http.server import ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
-from ..utils.http_json import BadRequest, JsonHandler
+from ..utils.http_json import DeepBacklogHTTPServer, BadRequest, JsonHandler
 from .agents import MasterAgent
 
 _RUN_PATH = re.compile(r"^/api/v1/runs/([0-9a-f]+)(/(wait|stop))?$")
@@ -130,7 +129,7 @@ class ControlPlaneServer:
                     return self._reply(200, {"ok": True})
                 return self._reply(404, {"error": "not found"})
 
-        self._srv = ThreadingHTTPServer((host, port), Handler)
+        self._srv = DeepBacklogHTTPServer((host, port), Handler)
         self._srv.daemon_threads = True
         self.host, self.port = self._srv.server_address
         self._thread = threading.Thread(target=self._srv.serve_forever,

@@ -54,7 +54,9 @@ class FedMLInferenceRunner:
 
     # -- stdlib fallback -----------------------------------------------------
     def _serve_stdlib(self, block: bool) -> None:
-        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        from http.server import BaseHTTPRequestHandler
+
+        from ..utils.http_json import DeepBacklogHTTPServer
 
         predictor = self.predictor
 
@@ -103,7 +105,7 @@ class FedMLInferenceRunner:
                 self.end_headers()
                 self.wfile.write(data)
 
-        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self._server = DeepBacklogHTTPServer((self.host, self.port), Handler)
         # port 0 → OS-assigned; resolve so callers see the bound port
         self.port = self._server.server_address[1]
         logging.info("inference endpoint on %s:%d", self.host, self.port)

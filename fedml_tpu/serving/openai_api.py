@@ -74,7 +74,7 @@ class OpenAIServer:
         self._server = None
 
     def run(self, block: bool = True) -> None:
-        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        from http.server import BaseHTTPRequestHandler
 
         predictor = self.predictor
         model_name = self.model_name
@@ -170,7 +170,10 @@ class OpenAIServer:
                 self.end_headers()
                 self.wfile.write(data)
 
-        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        from ..utils.http_json import DeepBacklogHTTPServer
+
+        self._server = DeepBacklogHTTPServer((self.host, self.port),
+                                             Handler)
         # port 0 → OS-assigned; resolve so callers see the bound port
         self.port = self._server.server_address[1]
         logging.info("openai-compatible endpoint on %s:%d (model=%s)",

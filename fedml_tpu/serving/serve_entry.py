@@ -26,10 +26,9 @@ import json
 import logging
 import threading
 import time
-from http.server import ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
-from ..utils.http_json import BadRequest, JsonHandler
+from ..utils.http_json import DeepBacklogHTTPServer, BadRequest, JsonHandler
 from ..scheduler.autoscaler import AutoscalePolicy, ReplicaAutoscaler
 from ..scheduler.model_cards import EndpointDB, ModelCardRegistry
 from ..scheduler.replica_manager import ReplicaProcessManager
@@ -124,7 +123,7 @@ class ServeGateway:
 
         # bind the HTTP port BEFORE booting replica processes: a bind
         # failure must not leak orphaned replica_worker children
-        self._srv = ThreadingHTTPServer((host, port), Handler)
+        self._srv = DeepBacklogHTTPServer((host, port), Handler)
         self._srv.daemon_threads = True
         self.host, self.port = self._srv.server_address
         try:

@@ -9,7 +9,7 @@ clean 400): subclass `JsonHandler` and implement do_GET/do_POST with
 from __future__ import annotations
 
 import json
-from http.server import BaseHTTPRequestHandler
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict
 from urllib.parse import parse_qs, urlparse
 
@@ -52,3 +52,16 @@ class JsonHandler(BaseHTTPRequestHandler):
             return float(raw)
         except ValueError as e:
             raise BadRequest(f"{name} must be a number") from e
+
+
+class DeepBacklogHTTPServer(ThreadingHTTPServer):
+    """`ThreadingHTTPServer` with a real listen backlog.
+
+    The stdlib default ``request_queue_size`` is 5: any burst of
+    concurrent clients beyond that overflows the kernel accept queue and
+    the excess connections are RESET (measured: 48 simultaneous clients
+    against the OpenAI endpoint dropped requests).  Every HTTP surface in
+    this framework (serving gateway, OpenAI API, inference runner,
+    control plane) should build its server through this class."""
+
+    request_queue_size = 128
