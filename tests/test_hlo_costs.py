@@ -1,0 +1,100 @@
+"""HLO collective-cost extraction (VERDICT r4 item 3 / r3 #6): the parser
+must find the collectives XLA inserts for known sharded programs, with
+correct payload bytes, and the summaries must catch structure changes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.ml.engine.mesh import build_mesh
+from fedml_tpu.utils.hlo_costs import (
+    ici_seconds,
+    parse_collectives,
+    summarize,
+    summarize_compiled,
+)
+
+
+def _compile_psum(n):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = build_mesh({"data": n})
+    sh = NamedSharding(mesh, P("data"))
+
+    @jax.jit
+    def f(x):
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P()))
+
+    x = jax.device_put(jnp.arange(8 * 1024, dtype=jnp.float32)
+                       .reshape(8, 1024), sh)
+    return jax.jit(lambda a: jnp.sum(a, axis=0)).lower(x).compile()
+
+
+def test_parse_finds_allreduce_with_bytes():
+    compiled = _compile_psum(8)
+    s = summarize_compiled(compiled)
+    assert s["counts"].get("all-reduce", 0) >= 1, s
+    # the reduced row is [1024] f32 = 4096 bytes
+    assert s["bytes"]["all-reduce"] >= 4096, s
+
+
+def test_parse_collectives_from_text():
+    txt = """
+  %ar = f32[128,256]{1,0} all-reduce(f32[128,256]{1,0} %p), replica_groups={{0,1,2,3}}, to_apply=%add
+  %ag.1 = bf16[64]{0} all-gather(bf16[16]{0} %q), replica_groups={{0,1,2,3}}, dimensions={0}
+  %cp = f32[4]{0} collective-permute(f32[4]{0} %r), source_target_pairs={{0,1}}
+  %add.5 = f32[4]{0} add(f32[4]{0} %a, f32[4]{0} %b)
+"""
+    recs = parse_collectives(txt)
+    ops = sorted(r["op"] for r in recs)
+    assert ops == ["all-gather", "all-reduce", "collective-permute"]
+    ar = next(r for r in recs if r["op"] == "all-reduce")
+    assert ar["bytes"] == 128 * 256 * 4
+    assert ar["group_size"] == 4
+    ag = next(r for r in recs if r["op"] == "all-gather")
+    assert ag["bytes"] == 64 * 2
+    s = summarize(txt)
+    assert s["total_ops"] == 3
+    assert s["total_bytes"] == 128 * 256 * 4 + 128 + 16
+
+
+def test_sharded_train_step_carries_allreduce():
+    """The dp train step's gradient sync must show up as all-reduce bytes
+    on the order of the model size — the CI tripwire for collective-
+    structure regressions."""
+    import fedml_tpu
+    from fedml_tpu.parallel.sharding import (
+        batch_sharding,
+        build_sharded_train_step,
+    )
+
+    args = fedml_tpu.Config(model="lr", dataset="mnist", batch_size=16,
+                            compute_dtype="float32", learning_rate=0.05)
+    bundle = fedml_tpu.model.create(args, 10)
+    variables = bundle.init_variables(jax.random.PRNGKey(0))
+    mesh = build_mesh({"data": 8})
+    train_step, init_shardings, tx = build_sharded_train_step(
+        bundle, args, mesh, "dp")
+    v = jax.device_put(variables, init_shardings(variables))
+    opt_state = tx.init(v["params"])
+    batch = {"x": jax.device_put(jnp.zeros((16, 784)),
+                                 batch_sharding(mesh)),
+             "y": jax.device_put(jnp.zeros((16,), jnp.int32),
+                                 batch_sharding(mesh)),
+             "mask": None}
+    with mesh:
+        compiled = jax.jit(train_step).lower(
+            v, opt_state, batch, jax.random.PRNGKey(1)).compile()
+    s = summarize_compiled(compiled)
+    assert s["counts"].get("all-reduce", 0) >= 1, s
+    # lr model: 784*10 w + 10 b = 7850 f32 params → grad allreduce ≥ 31 KB
+    assert s["bytes"]["all-reduce"] >= 7850 * 4, s
+
+
+def test_ici_seconds_model():
+    # 1 GB ring allreduce over 64 chips at 45 GB/s ≈ 2*(63/64)/45 s
+    t = ici_seconds(1e9, 64, "all-reduce")
+    assert t == pytest.approx(2 * (63 / 64) * 1e9 / 45e9, rel=1e-6)
+    assert ici_seconds(1e9, 1) == 0.0
+    assert ici_seconds(1e9, 64, "all-gather") < t
