@@ -1,0 +1,187 @@
+"""ONE test that runs the whole product lifecycle (VERDICT r4 item 5 /
+r3 #8): import GPT-2 → LoRA SFT → quantize → versioned model card →
+replica-process serving behind the gateway → OpenAI-API load →
+EndpointDB-metrics-driven scale-up → POST /rollback.
+
+Every stage already has its own unit tests; this is the stitched flow the
+reference runs as card→push→deploy→infer→monitor
+(`model_scheduler/device_model_cards.py`, `device_model_deployment.py:
+89-928`, `comm_utils/job_monitor.py`) — exercised here as one chain with
+real subprocess replicas and real HTTP at every hop.
+"""
+
+import json
+import os
+import textwrap
+import urllib.request
+
+import numpy as np
+import pytest
+
+#: the card's replica-side predictor: loads the card's checkpoint, int8-
+#: quantizes it, and serves through the continuous-batching KV engine.
+#: Written into each card version so replica PROCESSES (spawned by
+#: ReplicaProcessManager) resolve it via predictor.py → class Predictor.
+_PREDICTOR_PY = textwrap.dedent("""
+    import os
+
+    from fedml_tpu.serving.kv_cache_lm import kv_lm_from_checkpoint
+    from fedml_tpu.serving.llm_engine import (
+        KVCacheLLMEngine,
+        LLMEnginePredictor,
+    )
+    from fedml_tpu.serving.quantization import QuantizedKVCacheLM
+
+
+    class Predictor(LLMEnginePredictor):
+        def __init__(self):
+            lm = kv_lm_from_checkpoint(
+                os.path.join(os.path.dirname(__file__), "model.npz"),
+                heads=4)
+            qlm = QuantizedKVCacheLM.from_lm(lm)   # int8 weights
+            super().__init__(KVCacheLLMEngine(qlm, max_batch=4,
+                                              tokens_per_dispatch=4))
+""")
+
+
+def _post(url, body, timeout=120):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _chat(port, text, max_tokens=6):
+    out = _post(f"http://127.0.0.1:{port}/v1/chat/completions",
+                {"model": "lifecycle", "max_tokens": max_tokens,
+                 "temperature": 0,
+                 "messages": [{"role": "user", "content": text}]})
+    return out["choices"][0]["message"]["content"]
+
+
+@pytest.mark.slow
+def test_one_command_lifecycle(tmp_path):
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    import fedml_tpu
+    from fedml_tpu.scheduler.autoscaler import AutoscalePolicy
+    from fedml_tpu.scheduler.model_cards import (
+        ModelCardRegistry,
+        _resolve_predictor,
+    )
+    from fedml_tpu.serving.quantization import QuantizedKVCacheLM
+    from fedml_tpu.serving.serve_entry import ServeGateway
+    from fedml_tpu.train.llm.lora import apply_lora
+    from fedml_tpu.train.llm.trainer import LLMTrainConfig, LLMTrainer
+    from fedml_tpu.train.llm.weight_import import save_lm_checkpoint
+
+    # ---- 1. IMPORT: a real HF-format GPT-2 checkpoint file -------------
+    cfg = transformers.GPT2Config(
+        vocab_size=90, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    hf = transformers.GPT2LMHeadModel(cfg).eval()
+    v1_dir = tmp_path / "v1"
+    v1_dir.mkdir()
+    np.savez(v1_dir / "model.npz",
+             **{k: v.detach().cpu().numpy()
+                for k, v in hf.state_dict().items()})
+    (v1_dir / "predictor.py").write_text(_PREDICTOR_PY)
+
+    # ---- 2. LoRA SFT from the imported checkpoint ----------------------
+    args = fedml_tpu.Config(model="functional_lm", dataset="shakespeare",
+                            lm_dim=32, lm_layers=2, lm_heads=4,
+                            lm_max_len=64, compute_dtype="float32")
+    bundle = fedml_tpu.model.create(args, 90)
+    tcfg = LLMTrainConfig(seq_len=32, batch_size=4, epochs=2,
+                          learning_rate=3e-3, use_lora=True, lora_rank=4,
+                          pretrained_path=str(v1_dir / "model.npz"))
+    trainer = LLMTrainer(bundle, tcfg)
+    assert trainer.import_report and not trainer.import_report["missing"]
+    rng = np.random.RandomState(0)
+    out = trainer.train(rng.randint(0, 90, 4 * 4 * 33 * 2))
+    assert out["loss_history"][-1] < out["loss_history"][0]
+
+    # merged (base + LoRA) weights become version 2 of the SAME card
+    merged = apply_lora(trainer.variables["params"], trainer.lora,
+                        tcfg.lora_alpha)
+    v2_dir = tmp_path / "v2"
+    v2_dir.mkdir()
+    save_lm_checkpoint(merged, str(v2_dir / "model.npz"))
+    (v2_dir / "predictor.py").write_text(_PREDICTOR_PY)
+
+    # ---- 3. QUANTIZE is part of the card's serving path; prove the
+    # resolved predictor actually serves int8 weights -------------------
+    reg = ModelCardRegistry(root=str(tmp_path / "registry"))
+    card_v1 = reg.create("lifecycle", str(v1_dir))
+    in_proc = _resolve_predictor(reg.get("lifecycle"))
+    assert isinstance(in_proc.engine.lm, QuantizedKVCacheLM)
+    in_proc.engine.stop()
+    card_v2 = reg.create("lifecycle", str(v2_dir))
+    assert card_v2["version"] != card_v1["version"]
+
+    # ---- 4. SERVE: gateway + replica process on the v2 card ------------
+    gw = ServeGateway(
+        "lifecycle", registry_root=reg.root, replicas=1,
+        db_path=str(tmp_path / "metrics.sqlite"),
+        policy=AutoscalePolicy(min_replicas=1, max_replicas=2,
+                               target_qps_per_replica=0.01,
+                               cooldown_s=0.0),
+        autoscale_interval_s=3600.0).start()
+    try:
+        from fedml_tpu.serving.openai_api import OpenAIServer
+
+        # OpenAI-compatible front door FOR THE GATEWAY: chat requests
+        # flatten to a prompt and flow through /predict → replica →
+        # quantized KV engine, with per-request metrics into EndpointDB
+        class GatewayPredictor:
+            def predict(self, request):
+                out = _post(f"{gw.url}/predict", dict(request),
+                            timeout=300)
+                # replicas return the predictor's value directly (a str);
+                # dict-shaped predictors return {"text": ...}
+                return out["text"] if isinstance(out, dict) else out
+
+            def ready(self):
+                return True
+
+        api = OpenAIServer(GatewayPredictor(), model_name="lifecycle",
+                           port=0)
+        api.run(block=False)
+
+        # ---- 5. OpenAI-API load (v2 = SFT'd weights serve) -------------
+        sft_text = _chat(api.port, "hello there")
+        assert isinstance(sft_text, str) and len(sft_text) == 6
+        for _ in range(5):
+            _chat(api.port, "hello there")
+
+        # ---- 6. EndpointDB-driven scale-up -----------------------------
+        w = gw.db.window("lifecycle", window_s=300.0)
+        assert w["qps"] > 0                       # load was recorded
+        n = gw.autoscale_tick()                   # metrics → autoscaler
+        assert n == 2
+        assert gw.manager.live_count() == 2
+
+        # ---- 7. POST /rollback: v1 bytes serve again -------------------
+        rb = _post(f"{gw.url}/rollback", {})
+        assert rb["version"] == card_v1["version"]
+        base_text = _chat(api.port, "hello there")
+        assert len(base_text) == 6
+        api.stop()
+    finally:
+        gw.stop()
+
+    # the two versions are genuinely different FUNCTIONS (SFT moved the
+    # weights): compare full-precision logits — greedy TEXT can coincide
+    # on a tiny random model whose int8 serving flattens the LoRA delta
+    import jax.numpy as jnp
+
+    from fedml_tpu.serving.kv_cache_lm import kv_lm_from_checkpoint
+
+    ids = jnp.asarray([[1, 2, 3, 4]])
+    lg1 = kv_lm_from_checkpoint(str(v1_dir / "model.npz"),
+                                heads=4).full_logits(ids)
+    lg2 = kv_lm_from_checkpoint(str(v2_dir / "model.npz"),
+                                heads=4).full_logits(ids)
+    assert float(np.abs(np.asarray(lg1) - np.asarray(lg2)).max()) > 1e-4
