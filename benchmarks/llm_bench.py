@@ -126,20 +126,43 @@ def bench_train(peak: float, remat: bool, rtt: float):
     opt_state = tx.init(params)
     attn = partial(reference_attention, causal=True)
 
-    @jax.jit
-    def step(params, opt_state, tokens):
-        def loss_fn(p):
-            p16 = jax.tree_util.tree_map(
-                lambda x: x.astype(jnp.bfloat16), p)
-            return lm_loss(p16, tokens, HEADS, attn, remat=remat)
+    def loss_fn(p, t):
+        p16 = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16), p)
+        return lm_loss(p16, t, HEADS, attn, remat=remat)
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
+    def make_step(accum: int):
+        @jax.jit
+        def step(params, opt_state, tokens):
+            if accum == 1:
+                loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+            else:
+                # scan-accumulated microbatches: activation memory = ONE
+                # microbatch → less HBM pressure than the single-shot
+                # batch (measured best config, BENCH_NOTES round 5)
+                mb = tokens.reshape(accum, -1, SEQ)
 
-    candidates = [FORCE_BS] if FORCE_BS else ([4] if QUICK else [4, 8, 16])
+                def body(g_acc, t):
+                    l, g = jax.value_and_grad(loss_fn)(params, t)
+                    return jax.tree_util.tree_map(jnp.add, g_acc, g), l
+
+                g0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+                grads, losses = jax.lax.scan(body, g0, mb)
+                grads = jax.tree_util.tree_map(
+                    lambda g: g / accum, grads)
+                loss = jnp.mean(losses)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+        return step
+
+    #: (batch, accum) sweep — 16x4 = 4 scan-accumulated bs4 microbatches
+    candidates = ([(FORCE_BS, 1)] if FORCE_BS
+                  else ([(4, 1)] if QUICK
+                        else [(4, 1), (8, 1), (16, 1), (16, 4)]))
     per_bs = {}
-    for bs in candidates:
+    for bs, accum in candidates:
+        key = f"{bs}x{accum}" if accum > 1 else str(bs)
+        step = make_step(accum)
         tokens = jnp.asarray(
             np.random.default_rng(1).integers(0, VOCAB, (bs, SEQ)),
             jnp.int32)
@@ -162,10 +185,10 @@ def bench_train(peak: float, remat: bool, rtt: float):
                 sync(loss)               # ONE host fetch syncs the window
                 dt = min(dt, (time.time() - t0 - rtt) / spw)
         except Exception as e:                       # OOM at this bs
-            per_bs[bs] = {"error": str(e)[:200]}
+            per_bs[key] = {"error": str(e)[:200]}
             continue
         tok_s = bs * SEQ / dt
-        per_bs[bs] = {
+        per_bs[key] = {
             "step_ms": round(dt * 1e3, 1),
             "tokens_per_sec": round(tok_s, 0),
             "mfu": round(tok_s * train_flops_per_token(remat) / peak, 4),
