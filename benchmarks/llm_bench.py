@@ -199,8 +199,12 @@ def bench_train(peak: float, remat: bool, rtt: float):
     if not ok:
         raise RuntimeError(f"all train batch sizes failed: {per_bs}")
     best = max(ok, key=lambda b: ok[b]["tokens_per_sec"])
+    # typed best-config fields: per_bs keys are strings ("16x4"), so keep
+    # numeric consumers working via best_bs (int batch) + best_accum
+    b_bs, _, b_acc = best.partition("x")
     return {"model": f"gpt2-small-class d{DIM} L{LAYERS} T{SEQ}",
-            "n_params": n_params, "remat": remat, "best_bs": best,
+            "n_params": n_params, "remat": remat,
+            "best_bs": int(b_bs), "best_accum": int(b_acc or 1),
             **ok[best], "per_bs": per_bs}
 
 

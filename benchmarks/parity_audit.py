@@ -22,6 +22,12 @@ import sys
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 STUBS = os.path.join(HERE, "refbench", "stubs")
+#: where parity_results.json / PARITY.md land — tests override this to a
+#: tmp dir so a shortened-horizon CI run never clobbers the committed
+#: full-horizon artifacts
+OUT_DIR = os.environ.get("PARITY_OUT_DIR", HERE)
+DOC_DIR = os.environ.get("PARITY_OUT_DIR",
+                         os.path.join(REPO, "docs"))
 ROUNDS = int(os.environ.get("PARITY_ROUNDS", "30"))
 #: three-tier criterion: the early window must match numerically (identical
 #: init + identical batches + identical math ⇒ identical evals before
@@ -142,7 +148,8 @@ def main() -> None:
               f"final ref={ref.get('test_acc'):.4f} "
               f"tpu={mine.get('test_acc'):.4f}")
 
-    with open(os.path.join(HERE, "parity_results.json"), "w") as f:
+    os.makedirs(OUT_DIR, exist_ok=True)
+    with open(os.path.join(OUT_DIR, "parity_results.json"), "w") as f:
         json.dump({"rounds": ROUNDS,
                    "cnn_rounds": CNN_ROUNDS,
                    "tolerances": {"early": TOL_EARLY,
@@ -293,8 +300,8 @@ def _write_doc(results) -> None:
         "(0,0) — because dropout RNG is framework-specific).",
         "",
     ]
-    os.makedirs(os.path.join(REPO, "docs"), exist_ok=True)
-    with open(os.path.join(REPO, "docs", "PARITY.md"), "w") as f:
+    os.makedirs(DOC_DIR, exist_ok=True)
+    with open(os.path.join(DOC_DIR, "PARITY.md"), "w") as f:
         f.write("\n".join(lines))
 
 

@@ -17,7 +17,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def test_three_optimizer_parity_vs_reference():
     if not os.path.isdir("/root/reference/python/fedml"):
         pytest.skip("reference checkout not available")
-    env = dict(os.environ, PARITY_ROUNDS="12")
+    tmp = os.path.join(REPO, ".data_cache", "parity_ci_out")
+    env = dict(os.environ, PARITY_ROUNDS="12", PARITY_CNN_ROUNDS="4",
+               PARITY_OUT_DIR=tmp)
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "benchmarks",
                                       "parity_audit.py")],
