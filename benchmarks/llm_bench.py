@@ -178,12 +178,15 @@ def bench_train(peak: float, remat: bool, rtt: float):
             # sees bursty interference; min converges on the true rate)
             n_win, spw = (4, 2) if QUICK else (8, 2)
             dt = float("inf")
+            windows_ms = []
             for _ in range(n_win):
                 t0 = time.time()
                 for _ in range(spw):
                     p, o, loss = step(p, o, tokens)
                 sync(loss)               # ONE host fetch syncs the window
-                dt = min(dt, (time.time() - t0 - rtt) / spw)
+                w = (time.time() - t0 - rtt) / spw
+                windows_ms.append(round(w * 1e3, 1))
+                dt = min(dt, w)
         except Exception as e:                       # OOM at this bs
             per_bs[key] = {"error": str(e)[:200]}
             continue
@@ -193,6 +196,10 @@ def bench_train(peak: float, remat: bool, rtt: float):
             "tokens_per_sec": round(tok_s, 0),
             "mfu": round(tok_s * train_flops_per_token(remat) / peak, 4),
             "compile_s": round(compile_s, 1),
+            # every window, not just the best: makes shared-chip
+            # interference VISIBLE in the committed artifact (a floor
+            # trip can be diagnosed as variance vs regression)
+            "windows_ms": windows_ms,
         }
         del p, o
     ok = {b: r for b, r in per_bs.items() if "error" not in r}

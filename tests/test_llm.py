@@ -653,6 +653,10 @@ def _numpy_nucleus_oracle(logits, temp, top_k, top_p):
 @pytest.mark.parametrize("top_k,top_p,temp", [
     (0, 0.9, 1.0), (0, 0.5, 0.7), (0, 0.99, 1.3), (500, 0.95, 1.0),
     (500, 1.0, 1.0), (0, 0.1, 1.0), (40, 0.9, 0.8),
+    # low temperature stretches the scaled-logit range the bisection
+    # operates over; resolution (range/2^30) must stay below the kept/
+    # dropped gap
+    (0, 0.9, 0.3), (0, 0.9, 0.1),
 ])
 def test_exact_topp_keep_set_matches_numpy_oracle_gpt2_vocab(
         top_k, top_p, temp):
@@ -735,4 +739,87 @@ def test_engine_routes_big_vocab_nucleus_through_exact_filters():
         assert all(0 <= int(t) < 200 for t in out)
         assert any(calls), "no dispatch used exact_filters"
     finally:
+        eng.stop()
+
+
+def test_admission_prefill_edge_prompts_match_uncached():
+    """Admission-prefill edge cases: prompt shorter than the dispatch
+    chunk (skip path), prompt crossing a bucket boundary, and a prompt
+    near max_len — greedy output must equal the non-cached forward."""
+    from fedml_tpu.serving.kv_cache_lm import KVCacheLM
+    from fedml_tpu.serving.llm_engine import KVCacheLLMEngine
+
+    lm = KVCacheLM.create(jax.random.PRNGKey(2), vocab=60, dim=32,
+                          layers=2, heads=4, max_len=72)
+    rng = np.random.RandomState(1)
+    prompts = [list(rng.randint(0, 60, n))
+               for n in (3,      # < tokens_per_dispatch: skip prefill
+                         33,     # crosses the 32-bucket boundary
+                         65)]    # > biggest fitting bucket (64):
+                                 # exercises the tp=max_len fallback
+    eng = KVCacheLLMEngine(lm, max_batch=2, tokens_per_dispatch=8)
+    try:
+        for ids in prompts:
+            out = eng.generate(ids, max_new=5, temperature=0.0,
+                               timeout=300)
+            ref = list(ids)
+            for _ in range(len(out) - len(ids)):
+                logits = lm.full_logits(jnp.asarray([ref]))
+                ref.append(int(jnp.argmax(logits[0, -1])))
+            np.testing.assert_array_equal(np.asarray(out), ref,
+                                          err_msg=f"prompt len {len(ids)}")
+    finally:
+        eng.stop()
+
+
+def test_openai_server_survives_concurrent_burst():
+    """The DeepBacklogHTTPServer fix: a 50-client simultaneous burst must
+    not get kernel-reset (stdlib default backlog is 5)."""
+    import json as _json
+    import threading
+    import urllib.request
+
+    from fedml_tpu.serving.kv_cache_lm import KVCacheLM
+    from fedml_tpu.serving.llm_engine import (
+        KVCacheLLMEngine,
+        LLMEnginePredictor,
+    )
+    from fedml_tpu.serving.openai_api import OpenAIServer
+
+    lm = KVCacheLM.create(jax.random.PRNGKey(0), vocab=90, dim=16,
+                          layers=1, heads=2, max_len=48)
+    eng = KVCacheLLMEngine(lm, max_batch=8, tokens_per_dispatch=4)
+    srv = OpenAIServer(LLMEnginePredictor(eng), model_name="burst",
+                       port=0)
+    srv.run(block=False)
+    ok, errs = [], []
+    lock = threading.Lock()
+
+    def client():
+        body = _json.dumps({"model": "burst", "max_tokens": 3,
+                            "temperature": 0,
+                            "messages": [{"role": "user",
+                                          "content": "x"}]}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/chat/completions",
+            data=body, headers={"Content-Type": "application/json"})
+        try:
+            r = _json.loads(urllib.request.urlopen(req, timeout=300)
+                            .read())
+            with lock:
+                ok.append(r["choices"][0]["message"]["content"])
+        except Exception as e:  # noqa: BLE001
+            with lock:
+                errs.append(repr(e))
+
+    try:
+        threads = [threading.Thread(target=client) for _ in range(50)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs[:5]
+        assert len(ok) == 50
+    finally:
+        srv.stop()
         eng.stop()
