@@ -274,6 +274,21 @@ def main() -> None:
         if k in llm:
             result[k] = llm[k]
 
+    # served (HTTP-level) numbers from the committed serve_bench artifact
+    # (benchmarks/serve_bench.py measures them on-chip; re-running the
+    # 48-client load inside bench would double the chip time, so the
+    # driver-visible line carries the committed values, source-marked)
+    try:
+        with open(os.path.join(HERE, "benchmarks",
+                               "serve_bench_results.json")) as f:
+            served = json.load(f)
+        result["llm_served_tokens_per_sec"] = \
+            served["served_tokens_per_sec"]
+        result["llm_served_ttft_ms"] = served["ttft_ms_idle"]
+        result["llm_served_source"] = "committed serve_bench_results.json"
+    except Exception:  # noqa: BLE001 — optional artifact
+        pass
+
     print(json.dumps(result))
     if acc < TARGET_TEST_ACC:
         print(f"ACCURACY GUARD FAILED: {acc:.4f} < {TARGET_TEST_ACC}",

@@ -106,6 +106,9 @@ def main() -> None:
     else:
         vocab, dim, layers, heads, max_len = 50257, 768, 12, 12, 640
         max_batch, k, clients, max_tokens = 64, 16, 48, 64
+        # dispatch-length sweep knob (latency/throughput tradeoff: shorter
+        # dispatches admit new requests sooner → lower loaded TTFT)
+        k = int(os.environ.get("SERVE_BENCH_K", k))
 
     lm = KVCacheLM.create(jax.random.PRNGKey(0), vocab=vocab, dim=dim,
                           layers=layers, heads=heads, max_len=max_len)
