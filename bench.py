@@ -282,9 +282,12 @@ def main() -> None:
         with open(os.path.join(HERE, "benchmarks",
                                "serve_bench_results.json")) as f:
             served = json.load(f)
-        result["llm_served_tokens_per_sec"] = \
-            served["served_tokens_per_sec"]
-        result["llm_served_ttft_ms"] = served["ttft_ms_idle"]
+        # read all keys BEFORE mutating result: a partial schema must not
+        # leave an unsourced served number in the output
+        tps, ttft = (served["served_tokens_per_sec"],
+                     served["ttft_ms_idle"])
+        result["llm_served_tokens_per_sec"] = tps
+        result["llm_served_ttft_ms"] = ttft
         result["llm_served_source"] = "committed serve_bench_results.json"
     except Exception:  # noqa: BLE001 — optional artifact
         pass

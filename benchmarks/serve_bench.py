@@ -206,7 +206,13 @@ def main() -> None:
     guard_fail = None
     if errors:
         guard_fail = f"{len(errors)} dropped requests: {errors[:3]}"
-    if not cli.quick:
+    # sweep runs (SERVE_BENCH_K != default 16) must not overwrite the
+    # canonical k=16 headline artifact bench.py reads, nor its floor
+    is_sweep = not cli.quick and k != 16
+    if is_sweep:
+        result["note"] = (f"k={k} sweep run: results NOT written to the "
+                          "canonical artifact")
+    if not cli.quick and not is_sweep:
         with open(RESULTS, "w") as f:
             json.dump(result, f, indent=1)
         if cli.update_floor or not os.path.exists(FLOOR):
