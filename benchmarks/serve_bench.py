@@ -108,6 +108,7 @@ def main() -> None:
         max_batch, k, clients, max_tokens = 64, 16, 48, 64
         # dispatch-length sweep knob (latency/throughput tradeoff: shorter
         # dispatches admit new requests sooner → lower loaded TTFT)
+        default_k = k
         k = int(os.environ.get("SERVE_BENCH_K", k))
 
     lm = KVCacheLM.create(jax.random.PRNGKey(0), vocab=vocab, dim=dim,
@@ -208,7 +209,7 @@ def main() -> None:
         guard_fail = f"{len(errors)} dropped requests: {errors[:3]}"
     # sweep runs (SERVE_BENCH_K != default 16) must not overwrite the
     # canonical k=16 headline artifact bench.py reads, nor its floor
-    is_sweep = not cli.quick and k != 16
+    is_sweep = not cli.quick and k != default_k
     if is_sweep:
         result["note"] = (f"k={k} sweep run: results NOT written to the "
                           "canonical artifact")
