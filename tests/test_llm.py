@@ -823,3 +823,39 @@ def test_openai_server_survives_concurrent_burst():
     finally:
         srv.stop()
         eng.stop()
+
+
+def test_admission_turbo_short_first_dispatch():
+    """After admitting an admission-prefilled prompt, the FIRST dispatch
+    must be the short ADMIT_TURBO_K one (fast first token), then resume
+    full-length dispatches; short prompts (chunk-prefill path) must NOT
+    trigger turbo — it would delay their first token by a dispatch."""
+    from fedml_tpu.serving.kv_cache_lm import KVCacheLM
+    from fedml_tpu.serving.llm_engine import KVCacheLLMEngine
+
+    lm = KVCacheLM.create(jax.random.PRNGKey(0), vocab=60, dim=16,
+                          layers=1, heads=2, max_len=96)
+    ks = []
+    orig = lm.decode_multi
+
+    def spy(cache, pb, pn, pos0, temps, tk, tp, rng, k, **kw):
+        ks.append(k)
+        return orig(cache, pb, pn, pos0, temps, tk, tp, rng, k, **kw)
+
+    lm.decode_multi = spy
+    eng = KVCacheLLMEngine(lm, max_batch=2, tokens_per_dispatch=8)
+    try:
+        long_prompt = list(np.random.RandomState(0).randint(0, 60, 40))
+        out = eng.generate(long_prompt, max_new=12, temperature=0.0,
+                           timeout=300)
+        assert len(out) == 52
+        assert ks[0] == eng.ADMIT_TURBO_K, ks   # turbo first dispatch
+        assert eng.tokens_per_dispatch in ks[1:], ks  # then full length
+
+        ks.clear()
+        short = [1, 2, 3]                       # below-chunk: no prefill
+        out = eng.generate(short, max_new=4, temperature=0.0, timeout=300)
+        assert len(out) == 7
+        assert ks and ks[0] == eng.tokens_per_dispatch, ks  # NO turbo
+    finally:
+        eng.stop()
