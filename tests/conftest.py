@@ -18,9 +18,10 @@ assert len(jax.devices()) == 8, jax.devices()
 
 import pytest  # noqa: E402
 
-#: the fast CI tier (`pytest -m smoke`, CI target < 3 min): one
+#: the fast CI tier (`pytest -m smoke`, CI target ~3-4 min): one
 #: representative file per major subsystem; everything in these files is
-#: smoke unless explicitly marked slow.  Measured ~2.5 min on a 1-core box.
+#: smoke unless explicitly marked slow.  Measured 3:09-3:37 on this box
+#: (141 tests; varies with background load).
 _SMOKE_FILES = {
     "test_algorithms.py", "test_sp_simulation.py", "test_parrot.py",
     "test_transports.py", "test_security.py", "test_mpc.py",
