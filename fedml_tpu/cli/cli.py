@@ -237,6 +237,59 @@ def job_logs(run_id: str, tail: int) -> None:
     click.echo(api.run_logs(run_id, tail), nl=False)
 
 
+@cli.command()
+@click.option("--url", default=None, metavar="URL",
+              help="control-plane base URL to scrape "
+                   "(e.g. http://127.0.0.1:8899); default: this process's "
+                   "local registry")
+def metrics(url: str) -> None:
+    """Dump Prometheus-format metrics — from a running control plane's
+    GET /metrics when --url is given, else the local typed registry."""
+    if url:
+        from ..scheduler.control_plane import ControlPlaneClient
+
+        click.echo(ControlPlaneClient(url).metrics_text(), nl=False)
+        return
+    from ..core.mlops import metrics as m
+
+    click.echo(m.render_prometheus(), nl=False)
+
+
+@cli.group()
+def trace() -> None:
+    """Distributed-trace utilities over a run's spans.jsonl."""
+
+
+@trace.command("summarize")
+@click.option("--log-dir", required=True, type=click.Path(exists=True),
+              help="run log directory containing spans.jsonl")
+@click.option("--trace-id", default=None,
+              help="trace to render (default: the largest)")
+def trace_summarize(log_dir: str, trace_id: str) -> None:
+    """Render a per-round timeline of one trace: each round's parent span
+    with client trainings, aggregation and eval nested under it."""
+    from ..core.mlops import tracing
+
+    records = tracing.load_spans(log_dir)
+    if not records:
+        raise click.ClickException(f"no spans.jsonl under {log_dir}")
+    click.echo(tracing.summarize(records, trace_id=trace_id))
+
+
+@trace.command("list")
+@click.option("--log-dir", required=True, type=click.Path(exists=True))
+def trace_list(log_dir: str) -> None:
+    """List trace ids in a run's spans.jsonl with span counts."""
+    from collections import Counter
+
+    from ..core.mlops import tracing
+
+    counts = Counter(str(r.get("trace_id"))
+                     for r in tracing.load_spans(log_dir))
+    for tid, n in counts.most_common():
+        click.echo(json.dumps({"trace_id": tid, "spans": n}))
+
+
 @cli.group()
 def cluster() -> None:
     """Named reusable edge groups (reference `fedml cluster`)."""

@@ -30,6 +30,7 @@ _state: Dict[str, Any] = {
 
 
 def init(args: Any) -> None:
+    reset()  # back-to-back runs must not inherit open files or sinks
     log_dir = getattr(args, "log_file_dir", None) or os.path.join(
         os.path.expanduser("~"), ".fedml_tpu", "logs",
         str(getattr(args, "run_id", "0")))
@@ -38,9 +39,29 @@ def init(args: Any) -> None:
         _state["enabled"] = bool(getattr(args, "enable_tracking", True))
         _state["log_dir"] = log_dir
         _state["run_id"] = str(getattr(args, "run_id", "0"))
-        _state["files"] = {}
     if getattr(args, "enable_wandb", False):
         _try_add_wandb(args)
+
+
+def reset() -> None:
+    """Flush+close per-kind files, clear sinks, disable emission — so
+    back-to-back `init()` calls (and tests) can't cross-pollute runs."""
+    with _lock:
+        for f in _state["files"].values():
+            try:
+                f.flush()
+                f.close()
+            except Exception:  # noqa: BLE001 — a wedged fd can't block reset
+                pass
+        _state["files"] = {}
+        _state["sinks"] = []
+        _state["enabled"] = False
+
+
+def shutdown() -> None:
+    """End-of-run lifecycle hook: flush and release everything `init`
+    opened.  Safe to call multiple times."""
+    reset()
 
 
 def add_sink(sink: Callable[[str, Dict[str, Any]], None]) -> None:
@@ -123,15 +144,24 @@ def event(event_name: str, event_started: bool = True,
 
 
 class _Span:
+    """Legacy span API, now backed by `tracing.Span`: keeps emitting the
+    started/ended event pair and the ``span/<name>`` metric the reference's
+    MLOpsProfilerEvent consumers expect, while ALSO producing a real traced
+    span (trace/span ids, thread-local nesting, jax annotation)."""
+
     def __init__(self, name: str, value: Any = None) -> None:
         self.name, self.value = name, value
 
     def __enter__(self):
         event(self.name, True, self.value)
         self.t0 = time.time()
+        attrs = {} if self.value is None else {"value": self.value}
+        self._span = tracing.Span(self.name, attrs=attrs)
+        self._span.__enter__()
         return self
 
     def __exit__(self, *exc):
+        self._span.__exit__(*exc)
         event(self.name, False, self.value)
         _emit("metrics", {"metrics": {f"span/{self.name}": time.time() - self.t0}})
         return False
@@ -140,6 +170,11 @@ class _Span:
 def span(name: str, value: Any = None) -> _Span:
     """Context-manager span — the TPU build's ergonomic profiler API."""
     return _Span(name, value)
+
+
+def log_dir() -> Optional[str]:
+    """The active run's log directory (None before the first init)."""
+    return _state["log_dir"]
 
 
 def _try_add_wandb(args: Any) -> None:
@@ -156,3 +191,10 @@ def _try_add_wandb(args: Any) -> None:
         add_sink(_sink)
     except Exception:
         pass
+
+
+# observability plane submodules (imported last — tracing/metrics call back
+# into this module's _emit at runtime): `mlops.tracing.span(...)`,
+# `mlops.metrics.counter(...)`
+from . import metrics  # noqa: E402,F401
+from . import tracing  # noqa: E402,F401

@@ -111,12 +111,18 @@ class MLOpsRuntimeLogDaemon:
                     if not raw:
                         break
                     f.seek(-len(last), os.SEEK_CUR)
-                lines = [b.decode("utf-8", errors="replace") for b in raw]
-                for i in range(0, len(lines), self.chunk_lines):
+                # advance the cursor per CHUNK, not per readlines batch: a
+                # daemon killed between chunk uploads must resume at the
+                # first unshipped chunk with no duplicated or dropped lines
+                pos = f.tell() - sum(len(b) for b in raw)
+                for i in range(0, len(raw), self.chunk_lines):
+                    chunk = raw[i:i + self.chunk_lines]
                     self.uploader(self.run_id,
-                                  lines[i:i + self.chunk_lines])
-                    shipped += min(self.chunk_lines, len(lines) - i)
-                self._save_cursor(f.tell())
+                                  [b.decode("utf-8", errors="replace")
+                                   for b in chunk])
+                    pos += sum(len(b) for b in chunk)
+                    self._save_cursor(pos)
+                    shipped += len(chunk)
         self.shipped_lines += shipped
         return shipped
 

@@ -1,0 +1,292 @@
+"""Typed metrics plane — Counter/Gauge/Histogram with Prometheus exposition.
+
+The serving engine, trainers, round managers and the scheduler all need
+queryable numeric state ("tokens/s now", "p95 round time"), not just JSONL
+event logs.  This module is a small, stdlib-only, thread-safe metrics
+registry in the Prometheus data model:
+
+* ``Counter`` — monotonically increasing totals;
+* ``Gauge``   — set/inc/dec instantaneous values;
+* ``Histogram`` — cumulative buckets + sum + count, with a ``time()``
+  context manager for latency measurement;
+* labels via ``metric.labels(key=value)`` returning a cached child;
+* ``render_prometheus()`` — text exposition format v0.0.4, served from the
+  scheduler control plane at ``GET /metrics`` and dumped by
+  ``fedml metrics``.
+
+A process-wide default ``REGISTRY`` backs the module-level ``counter`` /
+``gauge`` / ``histogram`` get-or-create helpers; tests build private
+``MetricsRegistry`` instances.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Iterable, Optional, Sequence, Tuple
+
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                   2.5, 5.0, 10.0)
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample value: integers render bare, +Inf as +Inf."""
+    if v == float("inf"):
+        return "+Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _escape_label(v: Any) -> str:
+    return (str(v).replace("\\", r"\\").replace("\n", r"\n")
+            .replace('"', r'\"'))
+
+
+class _Child:
+    """One labelset's sample storage (lock shared with the parent)."""
+
+    def __init__(self, metric: "_Metric") -> None:
+        self._metric = metric
+        self._lock = metric._lock
+
+
+class _CounterChild(_Child):
+    def __init__(self, metric: "_Metric") -> None:
+        super().__init__(metric)
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters can only increase")
+        with self._lock:
+            self.value += amount
+
+
+class _GaugeChild(_Child):
+    def __init__(self, metric: "_Metric") -> None:
+        super().__init__(metric)
+        self.value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self.value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+
+class _Timer:
+    def __init__(self, child: "_HistogramChild") -> None:
+        self._child = child
+
+    def __enter__(self) -> "_Timer":
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._child.observe(time.monotonic() - self._t0)
+        return False
+
+
+class _HistogramChild(_Child):
+    def __init__(self, metric: "_Metric") -> None:
+        super().__init__(metric)
+        self.buckets = metric.buckets
+        self.bucket_counts = [0] * len(self.buckets)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self.sum += value
+            self.count += 1
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    self.bucket_counts[i] += 1
+                    break
+
+    def time(self) -> _Timer:
+        return _Timer(self)
+
+    def snapshot(self) -> Tuple[Iterable[Tuple[float, int]], float, int]:
+        """One LOCKED snapshot of (cumulative bucket pairs, sum, count) —
+        exposition must render all three from the same snapshot or a
+        concurrent observe() can break count == the +Inf bucket."""
+        with self._lock:
+            counts = list(self.bucket_counts)
+            total = self.count
+            s = self.sum
+        acc = 0
+        out = []
+        for bound, c in zip(self.buckets, counts):
+            acc += c
+            out.append((bound, acc))
+        out.append((float("inf"), total))
+        return out, s, total
+
+    def cumulative(self) -> Iterable[Tuple[float, int]]:
+        """(upper_bound, cumulative_count) pairs ending with (+Inf, count)."""
+        return self.snapshot()[0]
+
+
+_CHILD_TYPES = {"counter": _CounterChild, "gauge": _GaugeChild,
+                "histogram": _HistogramChild}
+
+
+class _Metric:
+    def __init__(self, name: str, help: str, kind: str,
+                 label_names: Sequence[str] = (),
+                 buckets: Sequence[float] = DEFAULT_BUCKETS) -> None:
+        self.name = name
+        self.help = help
+        self.kind = kind
+        self.label_names = tuple(label_names)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], _Child] = {}
+
+    def labels(self, **labels: Any) -> Any:
+        if set(labels) != set(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected labels {self.label_names}, "
+                f"got {tuple(labels)}")
+        key = tuple(str(labels[n]) for n in self.label_names)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = _CHILD_TYPES[self.kind](self)
+        return child
+
+    def _default_child(self) -> Any:
+        """The no-labels child, for unlabelled metrics' direct methods."""
+        if self.label_names:
+            raise ValueError(f"{self.name} has labels "
+                             f"{self.label_names}; use .labels(...)")
+        return self.labels()
+
+    # unlabelled convenience: counter.inc(), gauge.set(v), hist.observe(v)
+    def inc(self, amount: float = 1.0) -> None:
+        self._default_child().inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._default_child().dec(amount)
+
+    def set(self, value: float) -> None:
+        self._default_child().set(value)
+
+    def observe(self, value: float) -> None:
+        self._default_child().observe(value)
+
+    def time(self) -> _Timer:
+        return self._default_child().time()
+
+    # -- exposition ----------------------------------------------------------
+    def _label_str(self, key: Tuple[str, ...],
+                   extra: Tuple[Tuple[str, str], ...] = ()) -> str:
+        pairs = [f'{n}="{_escape_label(v)}"'
+                 for n, v in zip(self.label_names, key)]
+        pairs += [f'{n}="{_escape_label(v)}"' for n, v in extra]
+        return "{" + ",".join(pairs) + "}" if pairs else ""
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            children = dict(self._children)
+        for key in sorted(children):
+            child = children[key]
+            if self.kind == "histogram":
+                pairs, h_sum, h_count = child.snapshot()
+                for bound, cum in pairs:
+                    lines.append(
+                        f"{self.name}_bucket"
+                        f"{self._label_str(key, (('le', _fmt(bound)),))}"
+                        f" {cum}")
+                lines.append(f"{self.name}_sum{self._label_str(key)} "
+                             f"{_fmt(h_sum)}")
+                lines.append(f"{self.name}_count{self._label_str(key)} "
+                             f"{h_count}")
+            else:
+                lines.append(f"{self.name}{self._label_str(key)} "
+                             f"{_fmt(child.value)}")
+        return "\n".join(lines)
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get_or_create(self, name: str, help: str, kind: str,
+                       labels: Sequence[str],
+                       buckets: Sequence[float]) -> _Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if m.kind != kind or m.label_names != tuple(labels):
+                    raise ValueError(
+                        f"metric {name} already registered as {m.kind}"
+                        f"{m.label_names}")
+                return m
+            m = _Metric(name, help, kind, labels, buckets)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "",
+                labels: Sequence[str] = ()) -> _Metric:
+        return self._get_or_create(name, help, "counter", labels,
+                                   DEFAULT_BUCKETS)
+
+    def gauge(self, name: str, help: str = "",
+              labels: Sequence[str] = ()) -> _Metric:
+        return self._get_or_create(name, help, "gauge", labels,
+                                   DEFAULT_BUCKETS)
+
+    def histogram(self, name: str, help: str = "",
+                  labels: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> _Metric:
+        return self._get_or_create(name, help, "histogram", labels, buckets)
+
+    def render_prometheus(self) -> str:
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
+        body = "\n".join(m.render() for m in metrics)
+        return body + "\n" if body else ""
+
+    def collect(self) -> Dict[str, _Metric]:
+        with self._lock:
+            return dict(self._metrics)
+
+    def reset(self) -> None:
+        """Drop all metrics — test isolation only; cached metric handles in
+        long-lived objects keep working but stop being exported."""
+        with self._lock:
+            self._metrics.clear()
+
+
+#: process-wide default registry (what the control plane exports)
+REGISTRY = MetricsRegistry()
+
+
+def counter(name: str, help: str = "",
+            labels: Sequence[str] = ()) -> _Metric:
+    return REGISTRY.counter(name, help, labels)
+
+
+def gauge(name: str, help: str = "", labels: Sequence[str] = ()) -> _Metric:
+    return REGISTRY.gauge(name, help, labels)
+
+
+def histogram(name: str, help: str = "", labels: Sequence[str] = (),
+              buckets: Sequence[float] = DEFAULT_BUCKETS) -> _Metric:
+    return REGISTRY.histogram(name, help, labels, buckets)
+
+
+def render_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
+    return (registry or REGISTRY).render_prometheus()

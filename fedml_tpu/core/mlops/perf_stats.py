@@ -19,10 +19,40 @@ import time
 from typing import Any, Dict, List, Optional
 
 
+_process = None  # cached psutil.Process — cpu_percent deltas live on the
+# INSTANCE, so priming and sampling must hit the same object
+
+
+def _own_process():
+    global _process
+    if _process is None:
+        import psutil
+
+        _process = psutil.Process()
+    return _process
+
+
+def prime_cpu_counters() -> None:
+    """psutil's ``cpu_percent(interval=None)`` measures SINCE THE LAST
+    CALL and returns a meaningless 0.0 on the first one — prime both the
+    system-wide and per-process counters so the first real snapshot has a
+    measurement window behind it.  Safe to call without psutil."""
+    try:
+        import psutil
+
+        psutil.cpu_percent(interval=None)
+        _own_process().cpu_percent(interval=None)
+    except Exception:  # noqa: BLE001
+        pass
+
+
 def system_snapshot() -> Dict[str, Any]:
     """One sample of host + accelerator utilization (reference
-    `system_stats.py` SysStats)."""
-    snap: Dict[str, Any] = {"pid": os.getpid()}
+    `system_stats.py` SysStats).  ``ts_mono`` is a monotonic timestamp —
+    rate computations over consecutive snapshots must use it, never the
+    (NTP-adjustable) wall-clock ``ts`` the mlops emitter stamps."""
+    snap: Dict[str, Any] = {"pid": os.getpid(),
+                            "ts_mono": time.monotonic()}
     try:
         import psutil
 
@@ -39,7 +69,7 @@ def system_snapshot() -> Dict[str, Any]:
                         net_recv_mb=round(io.bytes_recv / 2 ** 20, 2))
         except Exception:
             pass
-        proc = psutil.Process()
+        proc = _own_process()
         snap.update(proc_rss_gb=round(proc.memory_info().rss / 2 ** 30, 3),
                     proc_cpu_percent=proc.cpu_percent(interval=None))
     except Exception as e:  # noqa: BLE001
@@ -97,6 +127,10 @@ class PerfStatsDaemon:
     def _loop(self) -> None:
         from . import _emit
 
+        # prime the cpu_percent deltas, give them a short (stop-aware)
+        # window, THEN sample — otherwise the first sample reports 0.0 cpu
+        prime_cpu_counters()
+        self._stop.wait(min(self.interval_s, 0.1))
         while True:
             # sample FIRST so even sub-interval jobs record at least one
             snap = system_snapshot()

@@ -11,6 +11,7 @@ import logging
 from typing import Any
 
 from ...core import mlops
+from ...core.mlops import tracing
 from ...core.distributed.communication.message import Message
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
 from ..message_define import MyMessage
@@ -55,29 +56,46 @@ class ClientMasterManager(FedMLCommManager):
         client_index = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX)
         self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND, 0))
         mlops.log_training_status("RUNNING")
-        self._train_and_upload(global_model, client_index)
+        self._train_and_upload(
+            global_model, client_index,
+            tracing.extract(msg.get(MyMessage.MSG_ARG_KEY_TRACE_CTX)))
 
     def handle_message_receive_model_from_server(self, msg: Message) -> None:
         global_model = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
         client_index = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX)
         self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND,
                                      self.round_idx + 1))
-        self._train_and_upload(global_model, client_index)
+        self._train_and_upload(
+            global_model, client_index,
+            tracing.extract(msg.get(MyMessage.MSG_ARG_KEY_TRACE_CTX)))
 
     def handle_message_finish(self, msg: Message) -> None:
         logging.info("client %d: finish", self.rank)
         mlops.log_training_status("FINISHED")
         self.finish()
 
-    def _train_and_upload(self, global_model: Any, client_index: int) -> None:
+    def _train_and_upload(self, global_model: Any, client_index: int,
+                          trace_ctx: Any = None) -> None:
         self.trainer_dist_adapter.update_dataset(int(client_index))
         self.trainer_dist_adapter.update_model(global_model)
-        with mlops.span("train", self.round_idx):
-            weights, n_samples = self.trainer_dist_adapter.train(
-                self.round_idx)
+        # attach the server's round-span context so this client's train span
+        # (and everything the trainer opens inside it) joins the round trace
+        with tracing.use_ctx(trace_ctx):
+            with tracing.span("client.train", round=self.round_idx,
+                              rank=self.rank,
+                              client_index=int(client_index)):
+                mlops.event("train", True, self.round_idx)
+                weights, n_samples = self.trainer_dist_adapter.train(
+                    self.round_idx)
+                mlops.event("train", False, self.round_idx)
         msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
                       self.get_sender_id(), 0)
         msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)
+        if trace_ctx is not None:
+            # echo the round context on the upload: the server (and any
+            # relay hop) can stitch receive-side spans without local state
+            msg.add_params(MyMessage.MSG_ARG_KEY_TRACE_CTX,
+                           tracing.inject(trace_ctx))
         if getattr(self.args, "enable_compression", False):
             # sparse delta upload (reference utils/compression.py TopK/EF):
             # only top-k(|Δ|) entries travel; the server reconstructs

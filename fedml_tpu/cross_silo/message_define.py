@@ -26,6 +26,11 @@ class MyMessage:
     MSG_ARG_KEY_CLIENT_OS = "client_os"
     MSG_ARG_KEY_TRAIN_METRICS = "train_metrics"
     MSG_ARG_KEY_COMPRESSED_UPDATE = "compressed_update"
+    # distributed-tracing context ({trace_id, span_id}, `mlops.tracing`):
+    # injected by the server into every round broadcast and echoed back on
+    # uploads, so one round's spans across server/clients/aggregator stitch
+    # into a single trace
+    MSG_ARG_KEY_TRACE_CTX = "trace_ctx"
 
     CLIENT_STATUS_ONLINE = "ONLINE"
     CLIENT_STATUS_IDLE = "IDLE"

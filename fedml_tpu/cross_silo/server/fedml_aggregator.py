@@ -13,6 +13,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ...core import mlops
+from ...core.mlops import tracing
 from ...core.alg_frame.context import Context
 
 
@@ -56,10 +57,13 @@ class FedMLAggregator:
         idxs = sorted(self._received_this_round)
         self._received_this_round = set()
         raw = [(self.sample_num_dict[i], self.model_dict[i]) for i in idxs]
-        with mlops.span("server.agg"):
-            raw = self.aggregator.on_before_aggregation(raw)
-            agg = self.aggregator.aggregate(raw)
-            agg = self.aggregator.on_after_aggregation(agg)
+        # nests under the server manager's round span via use_ctx; the
+        # legacy "server.agg" event pair rides along inside mlops.span
+        with tracing.span("server.aggregate", n_clients=len(idxs)):
+            with mlops.span("server.agg"):
+                raw = self.aggregator.on_before_aggregation(raw)
+                agg = self.aggregator.aggregate(raw)
+                agg = self.aggregator.on_after_aggregation(agg)
         self.aggregator.set_model_params(agg)
         return agg
 
@@ -82,7 +86,8 @@ class FedMLAggregator:
             replace=True)]
 
     def test_on_server_for_all_clients(self, round_idx: int) -> Dict[str, Any]:
-        metrics = self.aggregator.test(self.test_global, None, self.args)
+        with tracing.span("server.eval", round=round_idx):
+            metrics = self.aggregator.test(self.test_global, None, self.args)
         metrics["round"] = round_idx
         self.metrics_history.append(metrics)
         mlops.log(metrics)

@@ -12,10 +12,26 @@ import threading
 from typing import Any, Dict, List, Optional
 
 from ...core import mlops
+from ...core.mlops import metrics, tracing
 from ...core.distributed.communication.message import Message
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
 from ..message_define import MyMessage
 from .fedml_aggregator import FedMLAggregator
+
+_rounds_total = metrics.counter(
+    "fedml_rounds_completed_total", "Federated rounds completed",
+    labels=("run_id",))
+_round_seconds = metrics.histogram(
+    "fedml_round_seconds", "Wall-clock duration of a federated round",
+    labels=("run_id",),
+    buckets=(0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 300.0, 1800.0))
+_clients_reported = metrics.gauge(
+    "fedml_round_clients_reported",
+    "Client results aggregated in the last completed round",
+    labels=("run_id",))
+_current_round = metrics.gauge(
+    "fedml_current_round", "Round index the server is currently on",
+    labels=("run_id",))
 
 
 class FedMLServerManager(FedMLCommManager):
@@ -44,6 +60,12 @@ class FedMLServerManager(FedMLCommManager):
         self._round_timer: Optional[threading.Timer] = None
         self._init_timer: Optional[threading.Timer] = None
         self._caught_up_this_round: set = set()
+        # distributed tracing: one root span per run, one parent span per
+        # round; the round span's context travels on every broadcast so
+        # client + aggregator spans stitch under it
+        self._run_span: Optional[tracing.Span] = None
+        self._round_span: Optional[tracing.Span] = None
+        self._run_label = str(getattr(args, "run_id", "0"))
 
     def run(self) -> None:
         super().run()
@@ -115,8 +137,17 @@ class FedMLServerManager(FedMLCommManager):
 
     def _start_training(self) -> None:
         mlops.log_aggregation_status("RUNNING")
+        self._run_span = tracing.start_span(
+            "fed_run", run_id=self._run_label, rounds=self.round_num)
         self.is_initialized = True
         self.send_init_msg()
+
+    def _open_round_span(self) -> None:
+        parent = self._run_span.ctx if self._run_span else None
+        self._round_span = tracing.start_span(
+            "train_round", parent=parent, round=int(self.args.round_idx))
+        _current_round.labels(run_id=self._run_label).set(
+            int(self.args.round_idx))
 
     def send_init_msg(self) -> None:
         self.client_id_list_in_this_round = self.aggregator.client_sampling(
@@ -125,6 +156,7 @@ class FedMLServerManager(FedMLCommManager):
         self.data_silo_index_of_client = self.aggregator.data_silo_selection(
             self.args.round_idx, int(self.args.client_num_in_total),
             len(self.client_id_list_in_this_round))
+        self._open_round_span()
         self._broadcast_round()
         self._arm_round_timer()
 
@@ -145,6 +177,9 @@ class FedMLServerManager(FedMLCommManager):
             msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
                            self.client_id_list_in_this_round[i])
             msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.args.round_idx)
+            if self._round_span is not None:
+                msg.add_params(MyMessage.MSG_ARG_KEY_TRACE_CTX,
+                               tracing.inject(self._round_span.ctx))
             self.send_message(msg)
 
     # -- elastic round timeout ----------------------------------------------
@@ -250,16 +285,32 @@ class FedMLServerManager(FedMLCommManager):
         if self._round_timer is not None:
             self._round_timer.cancel()
         mlops.event("server.wait", False, self.args.round_idx)
-        self.aggregator.aggregate()
-        freq = int(getattr(self.args, "frequency_of_the_test", 1) or 1)
-        if (self.args.round_idx % freq == 0
-                or self.args.round_idx == self.round_num - 1):
-            self.aggregator.test_on_server_for_all_clients(self.args.round_idx)
+        n_reported = self.aggregator.receive_count()
+        # aggregation + eval run UNDER the round span's context so the
+        # aggregator's own spans nest into this round's trace subtree
+        with tracing.use_ctx(
+                self._round_span.ctx if self._round_span else None):
+            self.aggregator.aggregate()
+            freq = int(getattr(self.args, "frequency_of_the_test", 1) or 1)
+            if (self.args.round_idx % freq == 0
+                    or self.args.round_idx == self.round_num - 1):
+                self.aggregator.test_on_server_for_all_clients(
+                    self.args.round_idx)
+        _clients_reported.labels(run_id=self._run_label).set(n_reported)
+        _rounds_total.labels(run_id=self._run_label).inc()
+        if self._round_span is not None:
+            self._round_span.set_attr("clients_reported", n_reported)
+            _round_seconds.labels(run_id=self._run_label).observe(
+                self._round_span.end())
+            self._round_span = None
 
         self.args.round_idx += 1
         if self.args.round_idx >= self.round_num:
             self.send_finish_to_all()
             mlops.log_aggregation_status("FINISHED")
+            if self._run_span is not None:
+                self._run_span.end()
+                self._run_span = None
             self.finish()
             return
         # next round
@@ -268,6 +319,7 @@ class FedMLServerManager(FedMLCommManager):
             self.args.round_idx, int(self.args.client_num_in_total),
             int(self.args.client_num_per_round))
         mlops.event("server.wait", True, self.args.round_idx)
+        self._open_round_span()
         self._broadcast_round()
         self._arm_round_timer()
 

@@ -7,6 +7,9 @@ implementation serves every task because loss/metrics live in ModelBundle.
 
 from __future__ import annotations
 
+import contextlib
+import logging
+import threading
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -15,8 +18,55 @@ import numpy as np
 from ...core.alg_frame.client_trainer import ClientTrainer
 from ...core.alg_frame.server_aggregator import ServerAggregator
 from ...core.fhe import FedMLFHE
+from ...core.mlops import metrics, tracing
 from ..engine.local_update import build_eval_step, build_local_update, make_batches
 from ..engine.model_bundle import ModelBundle
+
+_local_update_seconds = metrics.histogram(
+    "fedml_trainer_local_update_seconds",
+    "Wall-clock duration of one client local update (all local epochs)",
+    labels=("model",),
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0, 300.0))
+_local_updates_total = metrics.counter(
+    "fedml_trainer_local_updates_total", "Client local updates run",
+    labels=("model",))
+
+# at most one jax.profiler capture may be live per process; serialize
+# opt-in captures across concurrently-training client threads
+_profiler_lock = threading.Lock()
+
+
+@contextlib.contextmanager
+def _maybe_jax_profile(args: Any, state: Dict[str, int]):
+    """Opt-in XLA-level step trace: with ``profile_trace_dir`` set, the
+    first ``profile_trace_steps`` (default 1) local updates of this trainer
+    run inside ``jax.profiler.trace`` — open the capture with TensorBoard
+    or Perfetto (docs/OBSERVABILITY.md)."""
+    trace_dir = getattr(args, "profile_trace_dir", None)
+    budget = int(getattr(args, "profile_trace_steps", 1) or 1)
+    if not trace_dir or state.get("captured", 0) >= budget \
+            or not _profiler_lock.acquire(blocking=False):
+        yield
+        return
+    try:
+        prof = jax.profiler.trace(str(trace_dir))
+        prof.__enter__()
+        # budget is consumed only by a capture that actually STARTED — a
+        # transient failure (bad dir, busy profiler) must not burn it
+        state["captured"] = state.get("captured", 0) + 1
+    except Exception:  # noqa: BLE001 — profiling must never kill training
+        logging.exception("jax.profiler capture failed; continuing "
+                          "without a trace")
+        prof = None
+    try:
+        yield
+    finally:
+        if prof is not None:
+            try:
+                prof.__exit__(None, None, None)
+            except Exception:  # noqa: BLE001
+                logging.exception("jax.profiler capture close failed")
+        _profiler_lock.release()
 
 
 def batches_for(data: Tuple[np.ndarray, np.ndarray], batch_size: int,
@@ -39,6 +89,8 @@ class DefaultClientTrainer(ClientTrainer):
         self.last_metrics: Dict[str, Any] = {}
         self.algo_out: Dict[str, Any] = {}
         self._eval = jax.jit(build_eval_step(bundle))
+        self._model_label = str(getattr(args, "model", "unknown"))
+        self._profile_state: Dict[str, int] = {}
 
     def set_num_batches(self, nb: Optional[int]) -> None:
         """Fix the padded batch-grid length (None → derive from data)."""
@@ -52,11 +104,21 @@ class DefaultClientTrainer(ClientTrainer):
                               self.bundle.input_dtype)
         rng = jax.random.fold_in(
             jax.random.PRNGKey(self.rng_seed), self.id)
-        new_vars, algo_out, metrics = self.local_update(
-            self.params, batches, rng, self.algo_state or None)
+        with tracing.span("trainer.local_update", client_id=self.id,
+                          num_batches=nb) as sp, \
+                _local_update_seconds.labels(
+                    model=self._model_label).time(), \
+                _maybe_jax_profile(args, self._profile_state):
+            new_vars, algo_out, step_metrics = self.local_update(
+                self.params, batches, rng, self.algo_state or None)
+            # block so the span/histogram measure the real device work,
+            # not the async dispatch
+            new_vars = jax.block_until_ready(new_vars)
+            self.last_metrics = {k: float(v) for k, v in step_metrics.items()}
+            sp.set_attr("loss", self.last_metrics.get("train_loss"))
+        _local_updates_total.labels(model=self._model_label).inc()
         self.params = new_vars
         self.algo_out = algo_out
-        self.last_metrics = {k: float(v) for k, v in metrics.items()}
         return self.last_metrics
 
     def test(self, test_data, device=None, args=None) -> Dict[str, Any]:

@@ -38,6 +38,7 @@ import urllib.error
 import urllib.request
 from typing import Any, Dict, List, Optional
 
+from ..core.mlops import metrics as _metrics
 from ..utils.http_json import DeepBacklogHTTPServer, BadRequest, JsonHandler
 from .agents import MasterAgent
 
@@ -62,6 +63,19 @@ class ControlPlaneServer:
             def do_GET(self) -> None:  # noqa: N802
                 if self.path == "/healthz":
                     return self._reply(200, {"ok": True})
+                if self.path == "/metrics":
+                    # Prometheus text exposition of the process-wide typed
+                    # registry (round/trainer/serving/jobmon series) —
+                    # unauthenticated like /healthz, it's a scrape target
+                    body = _metrics.render_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4; "
+                                     "charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return None
                 if not self._authed():
                     return self._reply(401, {"error": "bad api key"})
                 if self.path == "/api/v1/fleet":
@@ -182,6 +196,12 @@ class ControlPlaneClient:
 
     def health(self) -> Dict[str, Any]:
         return self._call("GET", "/healthz")
+
+    def metrics_text(self) -> str:
+        """Raw Prometheus exposition from GET /metrics (not JSON)."""
+        req = urllib.request.Request(self.base + "/metrics")
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return r.read().decode()
 
     def fleet(self) -> Dict[str, Any]:
         return self._call("GET", "/api/v1/fleet")["edges"]

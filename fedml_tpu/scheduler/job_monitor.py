@@ -15,7 +15,17 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from ..core.mlops import metrics, tracing
 from . import local_launcher
+
+_checks_total = metrics.counter(
+    "fedml_jobmon_checks_total", "Job-monitor reconciliation passes")
+_dead_runs_total = metrics.counter(
+    "fedml_jobmon_dead_runs_total",
+    "RUNNING runs whose process was found dead and flipped to FAILED")
+_endpoint_unhealthy_total = metrics.counter(
+    "fedml_jobmon_endpoint_unhealthy_total",
+    "Endpoint health-probe failures", labels=("endpoint",))
 
 
 def _pid_alive(pid: int) -> bool:
@@ -62,7 +72,13 @@ class JobMonitor:
 
     def check_once(self) -> List[Dict[str, Any]]:
         """One reconciliation pass; returns runs flipped to FAILED."""
+        _checks_total.inc()
         flipped = []
+        with tracing.span("jobmon.check"):
+            return self._check_once_inner(flipped)
+
+    def _check_once_inner(self, flipped: List[Dict[str, Any]]
+                          ) -> List[Dict[str, Any]]:
         for run in local_launcher.list_runs(limit=200):
             if run["status"] != "RUNNING":
                 continue
@@ -73,6 +89,7 @@ class JobMonitor:
                     run["run_id"], "FAILED", returncode=-1)
                 logging.warning("job monitor: run %s (pid %s) died; "
                                 "marked FAILED", run["run_id"], pid)
+                _dead_runs_total.inc()
                 flipped.append(full)
                 if self.on_dead_run:
                     try:
@@ -86,6 +103,7 @@ class JobMonitor:
                 healthy = False
             if not healthy:
                 logging.warning("job monitor: endpoint %s unhealthy", name)
+                _endpoint_unhealthy_total.labels(endpoint=name).inc()
                 reset = self.endpoint_resets.get(name)
                 if reset:
                     try:
