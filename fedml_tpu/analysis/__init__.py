@@ -1,0 +1,97 @@
+"""fedml lint — JAX-aware static analysis for the federated control plane.
+
+Rule families (docs/STATIC_ANALYSIS.md has the full catalog):
+
+* JAX001-JAX004 — recompilation, PRNG-key reuse, host-sync-in-hot-loop and
+  static/donate hazards that tests don't catch until a long run degrades
+* PROTO001      — sender/receiver drift across message_define contracts
+* CONC001       — unlocked shared-state mutation in threaded modules
+
+Entry points: ``run_lint`` (library), ``run_cli`` (the `fedml lint`
+command body; exit codes 0 = clean, 1 = new findings, 2 = internal error).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import traceback
+from pathlib import Path
+from typing import Optional, Sequence
+
+from .baseline import (
+    DEFAULT_BASELINE_NAME,
+    load_baseline,
+    partition,
+    write_baseline,
+)
+from .engine import LintResult, default_root, run_lint
+from .findings import Finding, fingerprints
+from .rules import rule_catalog
+
+__all__ = ["run_lint", "run_cli", "Finding", "LintResult", "rule_catalog",
+           "DEFAULT_BASELINE_NAME"]
+
+EXIT_CLEAN = 0
+EXIT_NEW_FINDINGS = 1
+EXIT_INTERNAL_ERROR = 2
+
+
+def run_cli(root: Optional[str] = None,
+            paths: Optional[Sequence[str]] = None,
+            fmt: str = "text",
+            baseline: Optional[str] = None,
+            update_baseline: bool = False,
+            rule_ids: Optional[Sequence[str]] = None,
+            echo=print) -> int:
+    """Body of ``fedml lint``; returns the process exit code."""
+    try:
+        if update_baseline and (paths or rule_ids):
+            # a partial scan would REPLACE the whole baseline, deleting
+            # every entry outside the scanned subset
+            echo("fedml lint: refusing --update-baseline with --paths/"
+                 "--rules — the baseline must come from a full scan")
+            return EXIT_INTERNAL_ERROR
+        root_p = Path(root) if root else default_root()
+        result = run_lint(root_p, paths=paths or None, rule_ids=rule_ids)
+        baseline_p = (Path(baseline) if baseline
+                      else root_p / DEFAULT_BASELINE_NAME)
+        if update_baseline:
+            n = write_baseline(baseline_p, result.findings)
+            echo(f"fedml lint: baseline written to {baseline_p} "
+                 f"({n} findings)")
+            return EXIT_CLEAN
+        known = load_baseline(baseline_p) if baseline_p.is_file() else {}
+        new, old = partition(result.findings, known)
+        if fmt == "json":
+            echo(json.dumps(_json_report(result, new, old), indent=2))
+        else:
+            for f, _fp in new:
+                echo(f.render())
+            echo(f"fedml lint: {result.files_scanned} files, "
+                 f"{len(new)} new finding(s), {len(old)} baselined, "
+                 f"{result.suppressed} suppressed "
+                 f"({result.duration_s:.1f}s)")
+        return EXIT_NEW_FINDINGS if new else EXIT_CLEAN
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return EXIT_INTERNAL_ERROR
+
+
+def _json_report(result: LintResult, new, old) -> dict:
+    findings = (
+        [dict(f.to_dict(), fingerprint=fp, baselined=False)
+         for f, fp in new]
+        + [dict(f.to_dict(), fingerprint=fp, baselined=True)
+           for f, fp in old])
+    findings.sort(key=lambda d: (d["path"], d["line"], d["col"]))
+    return {
+        "version": 1,
+        "tool": "fedml-lint",
+        "files_scanned": result.files_scanned,
+        "duration_s": round(result.duration_s, 3),
+        "new_count": len(new),
+        "baselined_count": len(old),
+        "suppressed_count": result.suppressed,
+        "findings": findings,
+    }

@@ -1,0 +1,91 @@
+"""Shared AST helpers for the lint rules: parent links, import-alias
+resolution to canonical dotted names, and loop/function enclosure queries."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional
+
+LOOP_NODES = (ast.For, ast.AsyncFor, ast.While)
+COMPREHENSION_NODES = (ast.ListComp, ast.SetComp, ast.DictComp,
+                       ast.GeneratorExp)
+FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def build_parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def ancestors(node: ast.AST,
+              parents: Dict[ast.AST, ast.AST]) -> Iterator[ast.AST]:
+    cur = parents.get(node)
+    while cur is not None:
+        yield cur
+        cur = parents.get(cur)
+
+
+def import_aliases(tree: ast.AST) -> Dict[str, str]:
+    """Map local names to canonical dotted prefixes.
+
+    ``import jax.numpy as jnp``      -> {"jnp": "jax.numpy"}
+    ``from jax import jit``          -> {"jit": "jax.jit"}
+    ``from jax import random as jr`` -> {"jr": "jax.random"}
+    """
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.level == 0:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def dotted_name(node: ast.AST,
+                aliases: Optional[Dict[str, str]] = None) -> str:
+    """Canonical dotted name of a Name/Attribute chain ("" if not one)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return ""
+    parts.append(node.id)
+    parts.reverse()
+    if aliases and parts[0] in aliases:
+        parts[0] = aliases[parts[0]]
+    return ".".join(parts)
+
+
+def call_name(node: ast.Call,
+              aliases: Optional[Dict[str, str]] = None) -> str:
+    return dotted_name(node.func, aliases)
+
+
+def enclosing_function(node: ast.AST, parents: Dict[ast.AST, ast.AST]
+                       ) -> Optional[ast.AST]:
+    for a in ancestors(node, parents):
+        if isinstance(a, FUNC_NODES):
+            return a
+    return None
+
+
+def enclosing_loop(node: ast.AST, parents: Dict[ast.AST, ast.AST],
+                   include_comprehensions: bool = True
+                   ) -> Optional[ast.AST]:
+    """Nearest loop around ``node`` within the same function scope."""
+    for a in ancestors(node, parents):
+        if isinstance(a, FUNC_NODES):
+            return None
+        if isinstance(a, LOOP_NODES):
+            return a
+        if include_comprehensions and isinstance(a, COMPREHENSION_NODES):
+            return a
+    return None

@@ -1,0 +1,42 @@
+"""Baseline ratchet: CI fails only on findings NOT in the committed
+baseline, so the rule set can land on a brownfield codebase and tighten
+over time (fix a finding → delete its entry → it can never come back)."""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+from .findings import Finding, fingerprints
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE_NAME = ".fedml-lint-baseline.json"
+
+
+def load_baseline(path: Path) -> Dict[str, dict]:
+    data = json.loads(Path(path).read_text(encoding="utf-8"))
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(f"unsupported baseline version "
+                         f"{data.get('version')!r} in {path}")
+    return {e["fingerprint"]: e for e in data.get("findings", [])}
+
+
+def write_baseline(path: Path, findings: List[Finding]) -> int:
+    entries = [{"fingerprint": fp, "rule": f.rule_id, "path": f.path,
+                "message": f.message}
+               for f, fp in fingerprints(findings)]
+    payload = {"version": BASELINE_VERSION, "tool": "fedml-lint",
+               "findings": entries}
+    Path(path).write_text(json.dumps(payload, indent=1) + "\n",
+                          encoding="utf-8")
+    return len(entries)
+
+
+def partition(findings: List[Finding], baseline: Dict[str, dict]
+              ) -> Tuple[List[Tuple[Finding, str]], List[Tuple[Finding, str]]]:
+    """Split into (new, baselined) keeping each finding's fingerprint."""
+    new, known = [], []
+    for f, fp in fingerprints(findings):
+        (known if fp in baseline else new).append((f, fp))
+    return new, known

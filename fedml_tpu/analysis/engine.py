@@ -1,0 +1,160 @@
+"""Lint engine: file collection, parsing, rule dispatch, noqa suppression.
+
+The engine is deliberately dependency-free (stdlib ``ast`` only) so the
+linter runs in CI images that don't carry jax — it reads source, it never
+imports the code under analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+import time
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from . import astutil
+from .findings import SEV_ERROR, Finding
+
+#: inline suppression: ``# fedml: noqa[JAX001]`` (one or more comma-separated
+#: rule ids, justification text after an em-dash or any trailing prose) or a
+#: bare ``# fedml: noqa`` that silences every rule on the line.
+NOQA_RE = re.compile(r"#\s*fedml:\s*noqa(?:\[([A-Za-z0-9_,\s-]+)\])?", re.I)
+
+
+@dataclasses.dataclass
+class FileContext:
+    path: str                    # posix relpath from the lint root
+    source: str
+    tree: ast.AST
+    lines: List[str]
+    _parents: Optional[Dict[ast.AST, ast.AST]] = None
+    _aliases: Optional[Dict[str, str]] = None
+
+    @property
+    def parents(self) -> Dict[ast.AST, ast.AST]:
+        if self._parents is None:
+            self._parents = astutil.build_parent_map(self.tree)
+        return self._parents
+
+    @property
+    def aliases(self) -> Dict[str, str]:
+        if self._aliases is None:
+            self._aliases = astutil.import_aliases(self.tree)
+        return self._aliases
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]
+    files_scanned: int
+    suppressed: int
+    duration_s: float
+
+
+def default_root() -> Path:
+    """Checkout root: the directory containing the fedml_tpu package."""
+    return Path(__file__).resolve().parent.parent.parent
+
+
+def collect_files(root: Path,
+                  paths: Optional[Sequence[str]] = None) -> List[Path]:
+    """Python files under ``root`` — default scope is the fedml_tpu package;
+    ``paths`` (files or directories, relative to root) narrows the scan."""
+    targets = [root / p for p in paths] if paths else [root / "fedml_tpu"]
+    out: Set[Path] = set()
+    for t in targets:
+        if t.is_file() and t.suffix == ".py":
+            out.add(t)
+        elif t.is_dir():
+            out.update(p for p in t.rglob("*.py")
+                       if "__pycache__" not in p.parts)
+        else:
+            # a typo'd --paths must not silently scan nothing and pass —
+            # that would disable the gate with exit 0
+            raise FileNotFoundError(
+                f"lint target {t} is not a .py file or directory")
+    return sorted(out)
+
+
+def _noqa_rules_for_line(line: str) -> Optional[Set[str]]:
+    """None → no suppression; empty set → suppress all; else rule ids."""
+    m = NOQA_RE.search(line)
+    if not m:
+        return None
+    if not m.group(1):
+        return set()
+    return {r.strip().upper() for r in m.group(1).split(",") if r.strip()}
+
+
+def _apply_noqa(findings: List[Finding],
+                ctx: FileContext) -> Tuple[List[Finding], int]:
+    kept, suppressed = [], 0
+    for f in findings:
+        line = ctx.lines[f.line - 1] if 0 < f.line <= len(ctx.lines) else ""
+        rules = _noqa_rules_for_line(line)
+        if rules is not None and (not rules or f.rule_id.upper() in rules):
+            suppressed += 1
+        else:
+            kept.append(f)
+    return kept, suppressed
+
+
+def run_lint(root: Optional[Path] = None,
+             paths: Optional[Sequence[str]] = None,
+             rule_ids: Optional[Sequence[str]] = None) -> LintResult:
+    from .rules import make_rules
+
+    t0 = time.monotonic()
+    root = Path(root) if root else default_root()
+    wanted = {r.strip().upper() for r in rule_ids} if rule_ids else None
+    all_rules = make_rules()
+    if wanted is not None:
+        known = {r.id.upper() for r in all_rules}
+        unknown = sorted(wanted - known)
+        if unknown:
+            raise ValueError(f"unknown rule id(s) {unknown}; "
+                             f"known: {sorted(known)}")
+    rules = [r for r in all_rules
+             if wanted is None or r.id.upper() in wanted]
+    findings: List[Finding] = []
+    suppressed = 0
+    files = collect_files(root, paths)
+    contexts: List[FileContext] = []
+    for fp in files:
+        rel = fp.relative_to(root).as_posix()
+        try:
+            source = fp.read_text(encoding="utf-8")
+            tree = ast.parse(source, filename=rel)
+        except (SyntaxError, UnicodeDecodeError, OSError) as exc:
+            findings.append(Finding(
+                "LINT001", SEV_ERROR, rel,
+                getattr(exc, "lineno", 1) or 1, 0,
+                f"file cannot be parsed: {exc.__class__.__name__}"))
+            continue
+        ctx = FileContext(rel, source, tree, source.splitlines())
+        contexts.append(ctx)
+        file_findings: List[Finding] = []
+        for rule in rules:
+            file_findings.extend(rule.check_file(ctx))
+        kept, n_sup = _apply_noqa(file_findings, ctx)
+        findings.extend(kept)
+        suppressed += n_sup
+    # project-level rules (cross-file: protocol drift) emit after the scan
+    ctx_by_path = {c.path: c for c in contexts}
+    for rule in rules:
+        project_findings = list(rule.finish())
+        by_file: Dict[str, List[Finding]] = {}
+        for f in project_findings:
+            by_file.setdefault(f.path, []).append(f)
+        for path, fl in by_file.items():
+            if path in ctx_by_path:
+                kept, n_sup = _apply_noqa(fl, ctx_by_path[path])
+                findings.extend(kept)
+                suppressed += n_sup
+            else:
+                findings.extend(fl)
+    findings.sort(key=Finding.sort_key)
+    return LintResult(findings, len(files), suppressed,
+                      time.monotonic() - t0)

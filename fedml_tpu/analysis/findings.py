@@ -1,0 +1,56 @@
+"""Finding model + stable fingerprints for the lint baseline ratchet.
+
+A fingerprint deliberately excludes the line number: editing an unrelated
+part of a file must not churn the committed baseline.  Findings that share
+(rule, path, message) are disambiguated by occurrence index in file order,
+so two identical violations in one file stay distinct entries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Dict, Iterable, List, Tuple
+
+SEV_ERROR = "error"
+SEV_WARNING = "warning"
+SEV_INFO = "info"
+
+_SEV_ORDER = {SEV_ERROR: 0, SEV_WARNING: 1, SEV_INFO: 2}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule_id: str
+    severity: str
+    path: str          # posix-style path relative to the lint root
+    line: int          # 1-based
+    col: int           # 0-based, matching ast
+    message: str
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"rule": self.rule_id, "severity": self.severity,
+                "path": self.path, "line": self.line, "col": self.col,
+                "message": self.message}
+
+    def sort_key(self) -> Tuple:
+        return (self.path, self.line, self.col,
+                _SEV_ORDER.get(self.severity, 9), self.rule_id)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule_id} [{self.severity}] {self.message}")
+
+
+def fingerprints(findings: Iterable[Finding]) -> List[Tuple[Finding, str]]:
+    """Pair each finding with its stable fingerprint (occurrence-indexed)."""
+    ordered = sorted(findings, key=Finding.sort_key)
+    seen: Dict[Tuple[str, str, str], int] = {}
+    out: List[Tuple[Finding, str]] = []
+    for f in ordered:
+        key = (f.rule_id, f.path, f.message)
+        idx = seen.get(key, 0)
+        seen[key] = idx + 1
+        raw = f"{f.rule_id}::{f.path}::{f.message}::{idx}"
+        out.append((f, hashlib.sha1(raw.encode()).hexdigest()[:16]))
+    return out

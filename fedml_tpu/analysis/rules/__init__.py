@@ -1,0 +1,41 @@
+"""Rule registry.  A rule sees every file once (``check_file``) and may emit
+more findings after the whole scan (``finish``, for cross-file rules like
+PROTO001).  ``make_rules`` builds FRESH instances per run — rules are allowed
+to accumulate state across files."""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Type
+
+from ..findings import Finding
+
+_REGISTRY: List[Type["Rule"]] = []
+
+
+class Rule:
+    id: str = ""
+    severity: str = "warning"
+    title: str = ""
+
+    def check_file(self, ctx) -> Iterable[Finding]:
+        return ()
+
+    def finish(self) -> Iterable[Finding]:
+        return ()
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    _REGISTRY.append(cls)
+    return cls
+
+
+def make_rules() -> List[Rule]:
+    # importing the rule modules populates the registry
+    from . import conc_rules, jax_rules, proto_rules  # noqa: F401
+
+    return [cls() for cls in _REGISTRY]
+
+
+def rule_catalog() -> List[dict]:
+    return [{"id": r.id, "severity": r.severity, "title": r.title}
+            for r in make_rules()]

@@ -1,0 +1,148 @@
+"""CONC001 — unlocked shared-state mutation in thread-spawning modules.
+
+Scope is the threaded control plane (scheduler/, serving/, and the
+cross-silo runner): in a module that starts ``threading.Thread`` /
+``threading.Timer``, an IN-PLACE mutation of shared state (``self.x[k]=v``,
+``self.items.append(…)``, ``count += 1`` on a module global) that is not
+lexically inside a ``with <lock>:`` block is a data-race candidate.  Plain
+attribute rebinds are deliberately not flagged (atomic under the GIL and
+idiomatic for status flags); container mutation is where corruption lives.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from .. import astutil
+from ..findings import SEV_WARNING, Finding
+from . import Rule, register
+
+TARGET_PREFIXES = ("fedml_tpu/scheduler/", "fedml_tpu/serving/")
+TARGET_FILES = ("fedml_tpu/cross_silo/runner.py",)
+
+THREAD_CTORS = {"threading.Thread", "threading.Timer"}
+LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition",
+              "threading.Semaphore", "threading.BoundedSemaphore"}
+MUTATORS = {"append", "extend", "insert", "add", "update", "pop", "popitem",
+            "remove", "discard", "clear", "setdefault", "appendleft"}
+MUTABLE_LITERALS = (ast.Dict, ast.List, ast.Set, ast.DictComp, ast.ListComp,
+                    ast.SetComp)
+MUTABLE_CTORS = {"dict", "list", "set", "collections.defaultdict",
+                 "collections.deque", "collections.OrderedDict",
+                 "collections.Counter"}
+
+
+def _applies(path: str) -> bool:
+    return path.startswith(TARGET_PREFIXES) or path in TARGET_FILES
+
+
+@register
+class Conc001UnlockedSharedMutation(Rule):
+    id = "CONC001"
+    severity = SEV_WARNING
+    title = "shared state mutated without a lock in a threaded module"
+
+    def check_file(self, ctx) -> Iterable[Finding]:
+        if not _applies(ctx.path):
+            return ()
+        if not any(isinstance(n, ast.Call)
+                   and astutil.call_name(n, ctx.aliases) in THREAD_CTORS
+                   for n in ast.walk(ctx.tree)):
+            return ()
+        lock_names = self._lock_names(ctx)
+        globals_ = self._module_mutables(ctx)
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            target = self._mutation_target(node, globals_, ctx)
+            if target is None:
+                continue
+            fn = astutil.enclosing_function(node, ctx.parents)
+            if fn is None or fn.name in ("__init__", "__new__"):
+                continue
+            if self._lock_held(node, ctx, lock_names):
+                continue
+            out.append(Finding(
+                self.id, self.severity, ctx.path, node.lineno,
+                node.col_offset,
+                f"'{target}' is mutated in-place in a module that spawns "
+                f"threads, outside any 'with <lock>:' block — wrap the "
+                f"mutation in the owning lock or confine it to one thread"))
+        return out
+
+    # -- what counts as shared state ----------------------------------------
+    def _module_mutables(self, ctx) -> Set[str]:
+        names: Set[str] = set()
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                v = stmt.value
+                if isinstance(v, MUTABLE_LITERALS) or (
+                        isinstance(v, ast.Call)
+                        and astutil.call_name(v, ctx.aliases)
+                        in MUTABLE_CTORS):
+                    names.add(stmt.targets[0].id)
+        return names
+
+    def _lock_names(self, ctx) -> Set[str]:
+        names: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and astutil.call_name(node.value, ctx.aliases) \
+                    in LOCK_CTORS:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+                    elif isinstance(t, ast.Attribute):
+                        names.add(t.attr)
+        return names
+
+    # -- mutation detection ---------------------------------------------------
+    @staticmethod
+    def _shared_base(expr, globals_: Set[str]) -> str:
+        """'self.x' / tracked module global behind an expression, or ''."""
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self":
+            return f"self.{expr.attr}"
+        if isinstance(expr, ast.Name) and expr.id in globals_:
+            return expr.id
+        return ""
+
+    def _mutation_target(self, node, globals_: Set[str], ctx):
+        if isinstance(node, ast.AugAssign):
+            base = self._shared_base(node.target, globals_)
+            if base:
+                return base
+            if isinstance(node.target, ast.Subscript):
+                return self._shared_base(node.target.value, globals_) or None
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    base = self._shared_base(t.value, globals_)
+                    if base:
+                        return base
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in MUTATORS:
+            base = self._shared_base(node.func.value, globals_)
+            if base:
+                return f"{base}.{node.func.attr}()"
+        return None
+
+    def _lock_held(self, node, ctx, lock_names: Set[str]) -> bool:
+        for anc in astutil.ancestors(node, ctx.parents):
+            if isinstance(anc, astutil.FUNC_NODES):
+                return False
+            if isinstance(anc, (ast.With, ast.AsyncWith)):
+                for item in anc.items:
+                    name = astutil.dotted_name(item.context_expr)
+                    if not name and isinstance(item.context_expr, ast.Call):
+                        name = astutil.dotted_name(item.context_expr.func)
+                    last = name.rsplit(".", 1)[-1] if name else ""
+                    lowered = name.lower()
+                    if last in lock_names or "lock" in lowered \
+                            or "mutex" in lowered or "cond" in lowered:
+                        return True
+        return False

@@ -1,0 +1,382 @@
+"""JAX discipline rules.
+
+JAX001 — jit/pjit wrapped inside a loop or round-scoped function: every
+         call re-traces and re-compiles, the classic silent multi-hour
+         degradation (wrap once at init, call many times).
+JAX002 — a PRNG key consumed by ≥2 calls (or across loop iterations)
+         without an intervening split/fold_in: correlated randomness.
+JAX003 — host-device sync (.item()/float()/np.asarray/block_until_ready)
+         inside a loop on a trainer/engine hot path: stalls the dispatch
+         pipeline every iteration.
+JAX004 — static_argnums positions fed non-hashable literals, and donated
+         buffers referenced after the donating call.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .. import astutil
+from ..findings import SEV_ERROR, SEV_WARNING, Finding
+from . import Rule, register
+
+JIT_NAMES = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+KEY_SOURCES = {"jax.random.PRNGKey", "jax.random.key"}
+KEY_DERIVERS = {"jax.random.split", "jax.random.fold_in"}
+
+
+def _resolved(call: ast.Call, ctx) -> str:
+    return astutil.call_name(call, ctx.aliases)
+
+
+def _is_jit(name: str) -> bool:
+    return name in JIT_NAMES or name.endswith(".pjit.pjit")
+
+
+def _scopes(tree: ast.AST):
+    """Yield (scope_node, body) for the module and every function."""
+    yield tree, tree.body
+    for node in ast.walk(tree):
+        if isinstance(node, astutil.FUNC_NODES):
+            yield node, node.body
+
+
+def _walk_scope(body, loop_stack: Tuple[int, ...] = (),
+                branch_stack: Tuple[Tuple[int, int], ...] = ()):
+    """Yield (stmt, loop_stack, branch_stack) for one scope, entering loop
+    bodies but NOT nested function/class/lambda scopes.  ``branch_stack``
+    carries (if_or_try_id, branch_index) so callers can tell that two
+    statements live on mutually exclusive paths."""
+    for stmt in body:
+        yield stmt, loop_stack, branch_stack
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            inner = loop_stack + (id(stmt),)
+            yield from _walk_scope(stmt.body, inner, branch_stack)
+            yield from _walk_scope(stmt.orelse, loop_stack, branch_stack)
+        elif isinstance(stmt, ast.If):
+            yield from _walk_scope(stmt.body, loop_stack,
+                                   branch_stack + ((id(stmt), 0),))
+            yield from _walk_scope(stmt.orelse, loop_stack,
+                                   branch_stack + ((id(stmt), 1),))
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            yield from _walk_scope(stmt.body, loop_stack, branch_stack)
+        elif isinstance(stmt, ast.Try):
+            # try-body vs handlers count as exclusive: the handler path is
+            # a RETRY of the body, and re-consuming the same key there is a
+            # deliberate replay, not correlated randomness
+            for part in (stmt.body, stmt.orelse):
+                yield from _walk_scope(part, loop_stack,
+                                       branch_stack + ((id(stmt), 0),))
+            for i, h in enumerate(stmt.handlers):
+                yield from _walk_scope(h.body, loop_stack,
+                                       branch_stack + ((id(stmt), 1 + i),))
+            yield from _walk_scope(stmt.finalbody, loop_stack, branch_stack)
+
+
+def _stmt_exprs(stmt: ast.stmt) -> List[ast.AST]:
+    """Expressions OWNED by this statement: compound statements contribute
+    only their header (iter/test/with-items) — their bodies are walked as
+    separate statements by ``_walk_scope``, so scanning the whole subtree
+    here would double-count every call."""
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.While, ast.If)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in stmt.items]
+    if isinstance(stmt, (ast.Try, ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return []
+    return [stmt]
+
+
+def _calls_in(node: ast.AST) -> Iterable[ast.Call]:
+    """Calls inside one statement's own expressions, not nested defs."""
+    for expr in _stmt_exprs(node) if isinstance(node, ast.stmt) else [node]:
+        stack = [expr]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)) and n is not expr:
+                continue  # different scope — do not descend
+            if isinstance(n, ast.Call):
+                yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+
+@register
+class Jax001RecompileInLoop(Rule):
+    id = "JAX001"
+    severity = SEV_WARNING
+    title = "jit/pjit wrapped inside a loop or per-round function"
+
+    def check_file(self, ctx) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and _is_jit(_resolved(node, ctx))):
+                continue
+            loop = astutil.enclosing_loop(node, ctx.parents)
+            if loop is not None:
+                out.append(Finding(
+                    self.id, self.severity, ctx.path, node.lineno,
+                    node.col_offset,
+                    "jit/pjit called inside a loop — every iteration "
+                    "re-traces and re-compiles; wrap once outside"))
+                continue
+            fn = astutil.enclosing_function(node, ctx.parents)
+            # builder/factory functions (build_*, make_*, create_*) wrap
+            # once by design — only flag handler-style per-round functions
+            if fn is not None and "round" in fn.name.lower() \
+                    and not fn.name.lstrip("_").startswith(
+                        ("build", "make", "create", "init")):
+                out.append(Finding(
+                    self.id, self.severity, ctx.path, node.lineno,
+                    node.col_offset,
+                    f"jit/pjit wrapped inside per-round function "
+                    f"'{fn.name}' — recompiles every round; hoist to init"))
+        return out
+
+
+@register
+class Jax002KeyReuse(Rule):
+    id = "JAX002"
+    severity = SEV_ERROR
+    title = "PRNG key reused without split/fold_in"
+
+    def check_file(self, ctx) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for _scope, body in _scopes(ctx.tree):
+            out.extend(self._check_scope(body, ctx))
+        return out
+
+    # -- event model: defs (PRNGKey / split results) + consuming uses -------
+    def _events(self, body, ctx):
+        events = []  # (lineno, col, kind, name, loop_stack, branch_stack)
+        for stmt, loops, branches in _walk_scope(body):
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                value = stmt.value
+                targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                names: List[str] = []
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        names.append(t.id)
+                    elif isinstance(t, (ast.Tuple, ast.List)):
+                        names.extend(e.id for e in t.elts
+                                     if isinstance(e, ast.Name))
+                if value is not None and names:
+                    kinds = {_resolved(c, ctx) for c in _calls_in(value)}
+                    if kinds & KEY_DERIVERS:
+                        for n in names:
+                            events.append((stmt.lineno, stmt.col_offset,
+                                           "def_split", n, loops, branches))
+                        continue
+                    if kinds & KEY_SOURCES:
+                        for n in names:
+                            events.append((stmt.lineno, stmt.col_offset,
+                                           "def_key", n, loops, branches))
+                        continue
+            for call in _calls_in(stmt):
+                name = _resolved(call, ctx)
+                if name in KEY_DERIVERS or name in KEY_SOURCES:
+                    continue
+                args = list(call.args) + [kw.value for kw in call.keywords]
+                for a in args:
+                    if isinstance(a, ast.Name):
+                        events.append((a.lineno, a.col_offset, "consume",
+                                       a.id, loops, branches))
+        events.sort(key=lambda e: (e[0], e[1]))
+        return events
+
+    @staticmethod
+    def _exclusive(p1, p2) -> bool:
+        """True when two branch paths can never both execute."""
+        forks1 = dict(p1)
+        return any(sid in forks1 and forks1[sid] != idx for sid, idx in p2)
+
+    def _check_scope(self, body, ctx) -> Iterable[Finding]:
+        events = self._events(body, ctx)
+        resplit_loops: Dict[str, Set[int]] = {}
+        for _, _, kind, name, loops, _branches in events:
+            if kind == "def_split":
+                resplit_loops.setdefault(name, set()).update(loops)
+        keys: Dict[str, Dict] = {}
+        out: List[Finding] = []
+        flagged_loops: Set[Tuple[str, int]] = set()
+        for lineno, col, kind, name, loops, branches in events:
+            if kind in ("def_key", "def_split"):
+                keys[name] = {"consumed": [], "def_loops": set(loops)}
+                continue
+            info = keys.get(name)
+            if info is None:
+                continue
+            # consumptions on mutually exclusive branches don't compound
+            if not info.get("flagged") \
+                    and any(not self._exclusive(branches, prev)
+                            for prev in info["consumed"]):
+                info["flagged"] = True
+                out.append(Finding(
+                    self.id, self.severity, ctx.path, lineno, col,
+                    f"PRNG key '{name}' consumed by more than one call "
+                    f"without an intervening jax.random.split — "
+                    f"correlated randomness"))
+            info["consumed"].append(branches)
+            for loop_id in loops:
+                if (loop_id not in info["def_loops"]
+                        and loop_id not in resplit_loops.get(name, ())
+                        and (name, loop_id) not in flagged_loops):
+                    flagged_loops.add((name, loop_id))
+                    out.append(Finding(
+                        self.id, self.severity, ctx.path, lineno, col,
+                        f"PRNG key '{name}' defined outside the loop is "
+                        f"consumed every iteration without being split — "
+                        f"identical randomness each pass"))
+        return out
+
+
+#: trainer/engine hot paths where a per-iteration host sync stalls the
+#: device dispatch pipeline.  One-shot modules (weight_import, mesh
+#: construction) stay out — a sync at init time is not a hazard.
+HOT_PATH_PREFIXES = ("fedml_tpu/ml/trainer/",)
+HOT_PATH_FILES = ("fedml_tpu/serving/llm_engine.py",
+                  "fedml_tpu/train/llm/trainer.py")
+
+SYNC_FREE_FUNCS = {"float", "int", "bool"}
+SYNC_CALLS = {"numpy.asarray", "numpy.array", "jax.device_get",
+              "jax.block_until_ready"}
+
+
+@register
+class Jax003HostSyncInHotLoop(Rule):
+    id = "JAX003"
+    severity = SEV_WARNING
+    title = "host-device sync inside a hot-path loop"
+
+    def _applies(self, path: str) -> bool:
+        return path.startswith(HOT_PATH_PREFIXES) or path in HOT_PATH_FILES
+
+    def check_file(self, ctx) -> Iterable[Finding]:
+        if not self._applies(ctx.path):
+            return ()
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            what = self._sync_kind(node, ctx)
+            if what is None:
+                continue
+            if astutil.enclosing_loop(node, ctx.parents) is None:
+                continue
+            out.append(Finding(
+                self.id, self.severity, ctx.path, node.lineno,
+                node.col_offset,
+                f"{what} inside a hot-path loop forces a host-device "
+                f"sync every iteration — hoist it after the loop "
+                f"(device_get once) or record via the metrics plane"))
+        return out
+
+    def _sync_kind(self, call: ast.Call, ctx) -> Optional[str]:
+        name = _resolved(call, ctx)
+        if name in SYNC_CALLS:
+            return f"{name}()"
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in ("item", "block_until_ready") \
+                and not call.args:
+            return f".{call.func.attr}()"
+        if name in SYNC_FREE_FUNCS and call.args \
+                and not isinstance(call.args[0], ast.Constant):
+            return f"{name}() on a device value"
+        return None
+
+
+@register
+class Jax004StaticDonateHazards(Rule):
+    id = "JAX004"
+    severity = SEV_ERROR
+    title = "non-hashable static arg / donated buffer reused"
+
+    def check_file(self, ctx) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for _scope, body in _scopes(ctx.tree):
+            out.extend(self._check_scope(body, ctx))
+        return out
+
+    @staticmethod
+    def _int_positions(node) -> List[int]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return [node.value]
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return [e.value for e in node.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)]
+        return []
+
+    def _check_scope(self, body, ctx) -> Iterable[Finding]:
+        jitted: Dict[str, Dict[str, List[int]]] = {}
+        donated: List[Tuple[str, int, str]] = []  # (var, call line, fn name)
+        out: List[Finding] = []
+        for stmt, _loops, _branches in _walk_scope(body):
+            if isinstance(stmt, ast.Assign) \
+                    and isinstance(stmt.value, ast.Call) \
+                    and _is_jit(_resolved(stmt.value, ctx)) \
+                    and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                cfg = {"static": [], "donate": []}
+                for kw in stmt.value.keywords:
+                    if kw.arg == "static_argnums":
+                        cfg["static"] = self._int_positions(kw.value)
+                    elif kw.arg == "donate_argnums":
+                        cfg["donate"] = self._int_positions(kw.value)
+                if cfg["static"] or cfg["donate"]:
+                    jitted[stmt.targets[0].id] = cfg
+                continue
+            rebound: Set[str] = set()
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        rebound.add(t.id)
+                    elif isinstance(t, (ast.Tuple, ast.List)):
+                        rebound.update(e.id for e in t.elts
+                                       if isinstance(e, ast.Name))
+            for call in _calls_in(stmt):
+                if isinstance(call.func, ast.Name) \
+                        and call.func.id in jitted:
+                    cfg = jitted[call.func.id]
+                    for pos in cfg["static"]:
+                        if pos < len(call.args) and isinstance(
+                                call.args[pos],
+                                (ast.List, ast.Dict, ast.Set)):
+                            out.append(Finding(
+                                self.id, self.severity, ctx.path,
+                                call.lineno, call.col_offset,
+                                f"argument {pos} of '{call.func.id}' is "
+                                f"static_argnums but receives a "
+                                f"non-hashable literal — TypeError at "
+                                f"trace time"))
+                    for pos in cfg["donate"]:
+                        if pos < len(call.args) and isinstance(
+                                call.args[pos], ast.Name) \
+                                and call.args[pos].id not in rebound:
+                            donated.append((call.args[pos].id, call.lineno,
+                                            call.func.id))
+            for expr in _stmt_exprs(stmt):
+                for node in ast.walk(expr):
+                    if not (isinstance(node, ast.Name)
+                            and isinstance(node.ctx, ast.Load)):
+                        continue
+                    for var, line, fn in donated:
+                        if node.id == var and node.lineno > line:
+                            out.append(Finding(
+                                self.id, self.severity, ctx.path,
+                                node.lineno, node.col_offset,
+                                f"'{var}' was donated to '{fn}' (donate_"
+                                f"argnums) and is used after the call — "
+                                f"its buffer is invalid"))
+                            donated.remove((var, line, fn))
+                            break
+        return out

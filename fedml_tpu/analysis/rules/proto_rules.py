@@ -1,0 +1,137 @@
+"""PROTO001 — message-key drift across the federation protocol.
+
+The wire contract lives in ``*message_define*.py`` constant classes
+(MSG_TYPE_* / MSG_ARG_KEY_* / ARG_*).  Sender and receiver agree only by
+convention, so a key written by the server but never read by any client
+(or vice versa) silently drops data.  This rule cross-checks every define
+constant against actual call sites, aggregated by WIRE VALUE (two classes
+may alias the same string — that's a legal shared contract):
+
+* write sites: ``msg.add_params(KEY, …)`` / ``msg.add(KEY, …)`` and
+  ``Message(TYPE, …)`` construction
+* read sites: ``msg.get(KEY…)`` and
+  ``register_message_receive_handler(TYPE, …)``
+* any other reference (stored in a variable, compared, forwarded) counts
+  as BOTH — direction unknown, so only pure one-sided drift is flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from .. import astutil
+from ..findings import SEV_WARNING, Finding
+from . import Rule, register
+
+CONST_PREFIXES = ("MSG_TYPE_", "MSG_ARG_KEY_", "ARG_")
+WRITE_METHODS = {"add_params", "add"}
+READ_METHODS = {"get"}
+REGISTER_FUNCS = {"register_message_receive_handler"}
+
+
+def _is_define_file(path: str) -> bool:
+    return "message_define" in path.rsplit("/", 1)[-1]
+
+
+@register
+class Proto001KeyDrift(Rule):
+    id = "PROTO001"
+    severity = SEV_WARNING
+    title = "protocol constant written but never read (or vice versa)"
+
+    def __init__(self) -> None:
+        # (class, const) -> (path, line, value)
+        self.defines: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+        self.writes: Set[Tuple[str, str]] = set()   # (class, const)
+        self.reads: Set[Tuple[str, str]] = set()
+        self.others: Set[Tuple[str, str]] = set()
+
+    def check_file(self, ctx) -> Iterable[Finding]:
+        if _is_define_file(ctx.path):
+            self._collect_defines(ctx)
+        self._collect_usage(ctx)
+        return ()
+
+    def _collect_defines(self, ctx) -> None:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for stmt in node.body:
+                if (isinstance(stmt, ast.Assign)
+                        and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and stmt.targets[0].id.startswith(CONST_PREFIXES)
+                        and isinstance(stmt.value, ast.Constant)
+                        and isinstance(stmt.value.value, str)):
+                    key = (node.name, stmt.targets[0].id)
+                    self.defines[key] = (ctx.path, stmt.lineno,
+                                         stmt.value.value)
+
+    @staticmethod
+    def _const_ref(node) -> Tuple[str, str]:
+        """(class, const) of a ``Cls.CONST`` reference, else ("", "")."""
+        if (isinstance(node, ast.Attribute)
+                and node.attr.startswith(CONST_PREFIXES)
+                and isinstance(node.value, ast.Name)):
+            return (node.value.id, node.attr)
+        return ("", "")
+
+    def _collect_usage(self, ctx) -> None:
+        classified = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            ref = self._const_ref(node.args[0])
+            if not ref[0]:
+                continue
+            fn = node.func
+            attr = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else "")
+            if attr in WRITE_METHODS or attr == "Message":
+                self.writes.add(ref)
+                classified.add(id(node.args[0]))
+            elif attr in READ_METHODS or attr in REGISTER_FUNCS:
+                self.reads.add(ref)
+                classified.add(id(node.args[0]))
+        for node in ast.walk(ctx.tree):
+            ref = self._const_ref(node)
+            if ref[0] and id(node) not in classified \
+                    and not _is_define_file(ctx.path):
+                self.others.add(ref)
+
+    def finish(self) -> Iterable[Finding]:
+        # aggregate per wire value: a key written via MyMessage.X and read
+        # via LSAMessage.Y with the same string is a consistent contract
+        written: Set[str] = set()
+        read: Set[str] = set()
+        both: Set[str] = set()
+        value_of = {k: v[2] for k, v in self.defines.items()}
+        for ref in self.writes:
+            written.add(value_of.get(ref, f"?{ref}"))
+        for ref in self.reads:
+            read.add(value_of.get(ref, f"?{ref}"))
+        for ref in self.others:
+            both.add(value_of.get(ref, f"?{ref}"))
+        out: List[Finding] = []
+        for (cls, const), (path, line, value) in sorted(
+                self.defines.items(), key=lambda kv: (kv[1][0], kv[1][1])):
+            is_type = const.startswith("MSG_TYPE_")
+            w = value in written or value in both
+            r = value in read or value in both
+            if w and r:
+                continue
+            role_w = "sent" if is_type else "written by a sender"
+            role_r = ("handled by a receiver" if is_type
+                      else "read by any receiver")
+            if w and not r:
+                msg = (f"{cls}.{const} ({value!r}) is {role_w} but never "
+                       f"{role_r} — the payload is silently dropped")
+            elif r and not w:
+                msg = (f"{cls}.{const} ({value!r}) is expected by a "
+                       f"receiver but no sender ever emits it")
+            else:
+                msg = (f"{cls}.{const} ({value!r}) is defined but never "
+                       f"used anywhere in the protocol")
+            out.append(Finding(self.id, self.severity, path, line, 0, msg))
+        return out

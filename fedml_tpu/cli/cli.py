@@ -238,6 +238,37 @@ def job_logs(run_id: str, tail: int) -> None:
 
 
 @cli.command()
+@click.option("--format", "fmt", default="text",
+              type=click.Choice(["text", "json"]))
+@click.option("--baseline", default=None, type=click.Path(),
+              help="baseline file for the ratchet (default: "
+                   "<root>/.fedml-lint-baseline.json when present)")
+@click.option("--update-baseline", is_flag=True,
+              help="rewrite the baseline with the current findings")
+@click.option("--paths", multiple=True, metavar="PATH",
+              help="restrict the scan to these files/dirs (relative to "
+                   "the root; cheap enough for pre-commit)")
+@click.option("--rules", default=None,
+              help="comma-separated rule ids to run (default: all)")
+@click.option("--root", default=None, type=click.Path(exists=True),
+              help="checkout root (default: the directory containing the "
+                   "fedml_tpu package)")
+def lint(fmt: str, baseline: str, update_baseline: bool, paths,
+         rules: str, root: str) -> None:
+    """JAX-aware static analysis with a CI ratchet (docs/STATIC_ANALYSIS.md).
+
+    Exit codes: 0 clean, 1 new (unbaselined) findings, 2 internal error."""
+    from ..analysis import run_cli
+
+    rule_ids = [r.strip() for r in (rules or "").split(",")
+                if r.strip()] or None
+    raise SystemExit(run_cli(
+        root=root, paths=list(paths) or None, fmt=fmt, baseline=baseline,
+        update_baseline=update_baseline, rule_ids=rule_ids,
+        echo=click.echo))
+
+
+@cli.command()
 @click.option("--url", default=None, metavar="URL",
               help="control-plane base URL to scrape "
                    "(e.g. http://127.0.0.1:8899); default: this process's "

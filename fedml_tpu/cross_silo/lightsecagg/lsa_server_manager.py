@@ -60,6 +60,10 @@ class LSAServerManager(FedMLCommManager):
 
     # -- handshake -----------------------------------------------------------
     def handle_status(self, msg: Message) -> None:
+        status = msg.get(LSAMessage.ARG_CLIENT_STATUS,
+                         LSAMessage.CLIENT_STATUS_ONLINE)
+        if status != LSAMessage.CLIENT_STATUS_ONLINE:
+            return
         self.online[msg.get_sender_id()] = True
         if len(self.online) == self.client_num:
             self._send_round_start(LSAMessage.MSG_TYPE_S2C_INIT_CONFIG)

@@ -8,7 +8,9 @@ init-config broadcast, model upload, sync, finish.
 
 class MyMessage:
     # handshake / liveness (reference MSG_TYPE_CONNECTION_IS_READY + status)
-    MSG_TYPE_CONNECTION_IS_READY = "CONNECTION_IS_READY"
+    # reference-parity constant: emitted by the hosted MLOps broker on MQTT
+    # bring-up; reserved here so configs/payloads stay wire-compatible
+    MSG_TYPE_CONNECTION_IS_READY = "CONNECTION_IS_READY"  # fedml: noqa[PROTO001]
     MSG_TYPE_C2S_CLIENT_STATUS = "C2S_CLIENT_STATUS"
 
     # training round-trip

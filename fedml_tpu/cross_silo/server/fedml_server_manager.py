@@ -60,6 +60,9 @@ class FedMLServerManager(FedMLCommManager):
         self._round_timer: Optional[threading.Timer] = None
         self._init_timer: Optional[threading.Timer] = None
         self._caught_up_this_round: set = set()
+        # client-reported training metrics for the round in flight, keyed
+        # by sender rank; summarized onto the round span at completion
+        self._round_train_metrics: Dict[int, Dict] = {}
         # distributed tracing: one root span per run, one parent span per
         # round; the round span's context travels on every broadcast so
         # client + aggregator spans stitch under it
@@ -82,14 +85,15 @@ class FedMLServerManager(FedMLCommManager):
     def handle_message_client_status_update(self, msg: Message) -> None:
         sender = msg.get_sender_id()
         status = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_STATUS)
+        client_os = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_OS, "unknown")
         with self._round_lock:
             # status dict is read by the init-timer thread under the lock;
             # writing it under the lock too avoids mutating during iteration
             if status == MyMessage.CLIENT_STATUS_ONLINE:
                 self.client_online_status[sender] = True
             n_online = sum(self.client_online_status.values())
-        logging.info("server: client %d status %s (%d/%d online)", sender,
-                     status, n_online, self.client_num)
+        logging.info("server: client %d (%s) status %s (%d/%d online)",
+                     sender, client_os, status, n_online, self.client_num)
         with self._round_lock:
             if not self.is_initialized:
                 if len(self.client_online_status) == self.client_num:
@@ -255,6 +259,9 @@ class FedMLServerManager(FedMLCommManager):
                     compressed, tree_spec(global_model))
                 model_params = jax.tree_util.tree_map(
                     lambda g, d: g + d, global_model, delta)
+            train_metrics = msg.get(MyMessage.MSG_ARG_KEY_TRAIN_METRICS)
+            if isinstance(train_metrics, dict) and train_metrics:
+                self._round_train_metrics[sender] = train_metrics
             self.aggregator.add_local_trained_result(
                 sender - 1, model_params, local_sample_number)
             if self.aggregator.check_whether_all_receive():
@@ -298,7 +305,14 @@ class FedMLServerManager(FedMLCommManager):
                     self.args.round_idx)
         _clients_reported.labels(run_id=self._run_label).set(n_reported)
         _rounds_total.labels(run_id=self._run_label).inc()
+        losses = [m.get("train_loss")
+                  for m in self._round_train_metrics.values()
+                  if isinstance(m.get("train_loss"), (int, float))]
+        self._round_train_metrics = {}
         if self._round_span is not None:
+            if losses:
+                self._round_span.set_attr(
+                    "mean_client_train_loss", sum(losses) / len(losses))
             self._round_span.set_attr("clients_reported", n_reported)
             _round_seconds.labels(run_id=self._run_label).observe(
                 self._round_span.end())

@@ -30,6 +30,9 @@ _local_update_seconds = metrics.histogram(
 _local_updates_total = metrics.counter(
     "fedml_trainer_local_updates_total", "Client local updates run",
     labels=("model",))
+_train_loss_last = metrics.gauge(
+    "fedml_trainer_train_loss", "Train loss of the last local update",
+    labels=("model",))
 
 # at most one jax.profiler capture may be live per process; serialize
 # opt-in captures across concurrently-training client threads
@@ -114,9 +117,17 @@ class DefaultClientTrainer(ClientTrainer):
             # block so the span/histogram measure the real device work,
             # not the async dispatch
             new_vars = jax.block_until_ready(new_vars)
-            self.last_metrics = {k: float(v) for k, v in step_metrics.items()}
+            # ONE device→host transfer for every scalar; float() per metric
+            # here was a separate blocking sync per value (JAX003)
+            host_metrics = jax.device_get(step_metrics)
+            self.last_metrics = {
+                k: float(v)  # fedml: noqa[JAX003] — host numpy after get
+                for k, v in host_metrics.items()}
             sp.set_attr("loss", self.last_metrics.get("train_loss"))
         _local_updates_total.labels(model=self._model_label).inc()
+        if "train_loss" in self.last_metrics:
+            _train_loss_last.labels(model=self._model_label).set(
+                self.last_metrics["train_loss"])
         self.params = new_vars
         self.algo_out = algo_out
         return self.last_metrics
@@ -125,7 +136,7 @@ class DefaultClientTrainer(ClientTrainer):
         nb = max(1, -(-len(test_data[1]) // self.batch_size))
         batches = batches_for(test_data, self.batch_size, nb,
                               self.bundle.input_dtype)
-        out = self._eval(self.params, batches)
+        out = jax.device_get(self._eval(self.params, batches))
         n = max(float(out["n"]), 1.0)
         return {"test_loss": float(out["loss_sum"]) / n,
                 "test_acc": float(out["correct"]) / n,
@@ -150,7 +161,7 @@ class DefaultServerAggregator(ServerAggregator):  # noqa: D101
             # keypair so server-side eval can decrypt; a real deployment's
             # server cannot (the reference's FHE mode evaluates client-side)
             params = fhe.fhe_dec(params)
-        out = self._eval(params, batches)
+        out = jax.device_get(self._eval(params, batches))
         n = max(float(out["n"]), 1.0)
         return {"test_loss": float(out["loss_sum"]) / n,
                 "test_acc": float(out["correct"]) / n,

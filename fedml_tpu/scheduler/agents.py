@@ -128,6 +128,10 @@ class SlaveAgent:
         # stop_train that landed during an OTA upgrade)
         self._cancelled: set = set()
         self._job_threads: Dict[str, threading.Thread] = {}
+        # guards _cancelled/_job_threads/_procs: the broker callback
+        # thread (_on_start/_on_stop) races every _run_job thread's
+        # check-then-act on them (CONC001)
+        self._state_lock = threading.Lock()
         # OTA state (reference client_runner.py:852 OTA upgrade + :1436
         # message replay after upgrade); _ota_lock serializes the
         # buffered-vs-replay decision against concurrent _on_start calls
@@ -208,14 +212,17 @@ class SlaveAgent:
                 return
         req = json.loads(payload.decode())
         run_id = str(req["run_id"])
-        if run_id in self._cancelled:
+        with self._state_lock:
+            was_cancelled = run_id in self._cancelled
             self._cancelled.discard(run_id)
+        if was_cancelled:
             self._report(run_id, ClientConstants.STATUS_KILLED,
                          error="cancelled before start")
             return
         t = threading.Thread(target=self._run_job, args=(run_id, req),
                              daemon=True, name=f"agent-run-{run_id}")
-        self._job_threads[run_id] = t
+        with self._state_lock:
+            self._job_threads[run_id] = t
         t.start()
 
     # -- OTA upgrade (reference client_runner.py:852) ------------------------
@@ -257,8 +264,9 @@ class SlaveAgent:
         finally:
             # every exit path (incl. early returns) must unregister the
             # thread and bound the cancel set
-            self._job_threads.pop(run_id, None)
-            self._cancelled.discard(run_id)
+            with self._state_lock:
+                self._job_threads.pop(run_id, None)
+                self._cancelled.discard(run_id)
 
     def _run_job_impl(self, run_id: str, req: Dict[str, Any]) -> None:
         self._report(run_id, ClientConstants.STATUS_INITIALIZING)
@@ -297,9 +305,11 @@ class SlaveAgent:
             return
         env["FEDML_DEVICE_SLOTS"] = ",".join(map(str, slots))
 
-        if run_id in self._cancelled:
-            # stop_train landed during package setup, before Popen existed
+        with self._state_lock:
+            was_cancelled = run_id in self._cancelled
             self._cancelled.discard(run_id)
+        if was_cancelled:
+            # stop_train landed during package setup, before Popen existed
             resources.release(run_id)
             local_launcher.update_run_status(run_id, "KILLED", returncode=-1)
             self._report(run_id, ClientConstants.STATUS_KILLED,
@@ -331,7 +341,14 @@ class SlaveAgent:
                         env=env, stdout=subprocess.PIPE,
                         stderr=subprocess.STDOUT, text=True,
                         errors="replace", start_new_session=True)
-                    self._procs[run_id] = proc
+                    with self._state_lock:
+                        self._procs[run_id] = proc
+                        # a stop_train that landed between the setup-time
+                        # cancel check and this registration found no proc
+                        # to kill; honor it now that one exists
+                        cancel_pending = run_id in self._cancelled
+                    if cancel_pending:
+                        self._kill_run(run_id)
                     local_launcher.update_run_status(
                         run_id, "RUNNING", pid=proc.pid)
                     for line in proc.stdout:  # live log capture
@@ -348,7 +365,8 @@ class SlaveAgent:
         finally:
             # slots, daemons, and a terminal status must be released/
             # reported no matter how the job died
-            self._procs.pop(run_id, None)
+            with self._state_lock:
+                self._procs.pop(run_id, None)
             perf.stop()
             shipper.stop(flush=True)
             resources.release(run_id)
@@ -418,7 +436,8 @@ class SlaveAgent:
         # remember the cancellation even if the run hasn't started yet
         # (e.g. its start_train is buffered behind an OTA upgrade) so the
         # replay path doesn't launch a cancelled job
-        self._cancelled.add(run_id)
+        with self._state_lock:
+            self._cancelled.add(run_id)
         self._kill_run(run_id)
 
     def _kill_run(self, run_id: str) -> None:

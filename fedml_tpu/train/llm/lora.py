@@ -36,9 +36,15 @@ def _is_target(path: str, shape, targets: Sequence[str]) -> bool:
 
 def init_lora(params: Any, rank: int = 8, targets: Sequence[str] = None,
               rng: jax.Array = None, dtype=jnp.float32) -> Dict[str, Any]:
-    """→ {path: {"a": [d_in, r], "b": [r, d_out]}} for each targeted kernel."""
+    """→ {path: {"a": [d_in, r], "b": [r, d_out]}} for each targeted kernel.
+
+    ``rng`` should be a dedicated split of the caller's key (LLMTrainer
+    threads one through) so the factors never correlate with the base-param
+    init; the PRNGKey(0) fallback is for standalone deterministic use only.
+    """
     targets = tuple(targets or DEFAULT_TARGETS)
-    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
     lora: Dict[str, Any] = {}
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
     for i, (path, leaf) in enumerate(flat):

@@ -92,7 +92,10 @@ class LLMTrainer:
         self.bundle = bundle
         self.cfg = config
         rng = rng if rng is not None else jax.random.PRNGKey(0)
-        self.variables = bundle.init_variables(rng, batch_size=2)
+        # one consumer per split: base-param init and LoRA factors must not
+        # draw from the same key (JAX002 — correlated init)
+        init_rng, lora_rng = jax.random.split(rng)
+        self.variables = bundle.init_variables(init_rng, batch_size=2)
         self.import_report: Optional[Dict[str, Any]] = None
         if config.pretrained_path:
             from .weight_import import load_pretrained_into
@@ -108,7 +111,7 @@ class LLMTrainer:
         self.lora: Dict[str, Any] = {}
         if config.use_lora:
             self.lora = init_lora(self.variables["params"],
-                                  rank=config.lora_rank, rng=rng)
+                                  rank=config.lora_rank, rng=lora_rng)
             logging.info("LoRA: %d trainable params",
                          count_trainable(self.lora))
         from ...ml.engine.optimizers import make_lr
@@ -206,8 +209,11 @@ class LLMTrainer:
                 trainable, opt_state, loss = self._train_epoch(
                     trainable, opt_state, base_params, model_state, batches,
                     sub)
-            history.append(float(loss))
-            logging.info("llm epoch %d: loss %.4f (%.1fs)", ep, float(loss),
+            # one deliberate sync per EPOCH (not per step): the scalar gates
+            # logging/checkpointing, and the scan above has already retired
+            loss_host = float(loss)  # fedml: noqa[JAX003] — epoch boundary
+            history.append(loss_host)
+            logging.info("llm epoch %d: loss %.4f (%.1fs)", ep, loss_host,
                          time.time() - t0)
             if ckpt is not None:
                 ckpt.save(ep, {"round_idx": ep, "trainable": trainable})
@@ -233,9 +239,12 @@ class LLMTrainer:
             last = logits[0, -1]
             if temperature > 0:
                 rng, k = jax.random.split(rng)
-                nxt = int(jax.random.categorical(k, last / temperature))
+                # token-by-token sampling is host-driven by design: the next
+                # feed depends on this token, so the sync is the algorithm
+                nxt = int(jax.random.categorical(  # fedml: noqa[JAX003]
+                    k, last / temperature))
             else:
-                nxt = int(jnp.argmax(last))
+                nxt = int(jnp.argmax(last))  # fedml: noqa[JAX003] — as above
             ids.append(nxt)
         return np.asarray(ids)
 

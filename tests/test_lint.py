@@ -1,0 +1,458 @@
+"""fedml lint: rule engine, rules, suppressions, baseline ratchet, CLI."""
+
+from __future__ import annotations
+
+import json
+import textwrap
+
+import pytest
+
+from fedml_tpu.analysis import run_cli, run_lint
+from fedml_tpu.analysis.baseline import load_baseline, write_baseline
+from fedml_tpu.analysis.engine import default_root
+from fedml_tpu.analysis.findings import fingerprints
+
+
+def _write(tmp_path, relpath: str, source: str):
+    f = tmp_path / relpath
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(source))
+    return f
+
+
+def _lint(tmp_path, rules=None):
+    return run_lint(root=tmp_path, rule_ids=rules).findings
+
+
+def _ids(findings):
+    return [f.rule_id for f in findings]
+
+
+# -- JAX001: jit in loop / per-round function --------------------------------
+
+def test_jax001_fires_on_jit_in_loop(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", """\
+        import jax
+
+        def train(fn, xs):
+            for x in xs:
+                f = jax.jit(fn)
+                f(x)
+    """)
+    assert _ids(_lint(tmp_path)) == ["JAX001"]
+
+
+def test_jax001_fires_in_round_function_not_builder(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", """\
+        import jax
+
+        def handle_round(fn):
+            return jax.jit(fn)
+
+        def build_round_step(fn):
+            return jax.jit(fn)
+    """)
+    found = _lint(tmp_path)
+    assert _ids(found) == ["JAX001"]
+    assert found[0].line == 4
+
+
+def test_jax001_silent_when_hoisted(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", """\
+        import jax
+
+        def train(fn, xs):
+            f = jax.jit(fn)
+            for x in xs:
+                f(x)
+    """)
+    assert _lint(tmp_path) == []
+
+
+def test_jax001_noqa_suppresses(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", """\
+        import jax
+
+        def train(fn, xs):
+            for x in xs:
+                f = jax.jit(fn)  # fedml: noqa[JAX001] — compile cache hit
+                f(x)
+    """)
+    res = run_lint(root=tmp_path)
+    assert res.findings == [] and res.suppressed == 1
+
+
+# -- JAX002: PRNG key reuse ---------------------------------------------------
+
+def test_jax002_fires_on_double_consume(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", """\
+        import jax
+
+        def f():
+            k = jax.random.PRNGKey(0)
+            a = jax.random.normal(k, (2,))
+            b = jax.random.uniform(k, (2,))
+            return a + b
+    """)
+    assert _ids(_lint(tmp_path)) == ["JAX002"]
+
+
+def test_jax002_silent_with_split(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", """\
+        import jax
+
+        def f():
+            k = jax.random.PRNGKey(0)
+            k, sub = jax.random.split(k)
+            a = jax.random.normal(sub, (2,))
+            k, sub = jax.random.split(k)
+            b = jax.random.uniform(sub, (2,))
+            return a + b
+    """)
+    assert _lint(tmp_path) == []
+
+
+def test_jax002_fires_on_loop_reuse(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", """\
+        import jax
+
+        def g(xs):
+            k = jax.random.PRNGKey(0)
+            out = []
+            for x in xs:
+                out.append(jax.random.normal(k, (2,)))
+            return out
+    """)
+    assert "JAX002" in _ids(_lint(tmp_path))
+
+
+def test_jax002_silent_when_resplit_in_loop(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", """\
+        import jax
+
+        def g(xs):
+            k = jax.random.PRNGKey(0)
+            out = []
+            for x in xs:
+                k, sub = jax.random.split(k)
+                out.append(jax.random.normal(sub, (2,)))
+            return out
+    """)
+    assert _lint(tmp_path) == []
+
+
+def test_jax002_exclusive_branches_dont_compound(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", """\
+        import jax
+
+        def h(flag):
+            k = jax.random.PRNGKey(0)
+            if flag:
+                return jax.random.normal(k, (2,))
+            else:
+                return jax.random.uniform(k, (2,))
+    """)
+    assert _lint(tmp_path) == []
+
+
+# -- JAX003: host sync in hot-path loop --------------------------------------
+
+def test_jax003_fires_only_on_hot_paths(tmp_path):
+    src = """\
+        def train(batches, step):
+            losses = []
+            for b in batches:
+                losses.append(float(step(b)))
+            return losses
+    """
+    _write(tmp_path, "fedml_tpu/ml/trainer/hot.py", src)
+    _write(tmp_path, "fedml_tpu/data/cold.py", src)
+    found = _lint(tmp_path)
+    assert _ids(found) == ["JAX003"]
+    assert found[0].path == "fedml_tpu/ml/trainer/hot.py"
+
+
+def test_jax003_silent_when_hoisted_and_noqa(tmp_path):
+    _write(tmp_path, "fedml_tpu/ml/trainer/hot.py", """\
+        import jax
+
+        def train(batches, step):
+            losses = []
+            for b in batches:
+                losses.append(step(b))
+            host = jax.device_get(losses)
+            total = float(sum(host))  # fedml: noqa[JAX003] — host numpy
+            return total
+    """)
+    assert _lint(tmp_path) == []
+
+
+# -- JAX004: static/donate hazards --------------------------------------------
+
+def test_jax004_fires_on_nonhashable_static_arg(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", """\
+        import jax
+
+        def f(fn, x):
+            g = jax.jit(fn, static_argnums=(1,))
+            return g(x, [1, 2])
+    """)
+    assert _ids(_lint(tmp_path)) == ["JAX004"]
+
+
+def test_jax004_fires_on_donated_buffer_reuse(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", """\
+        import jax
+
+        def f(fn, x):
+            g = jax.jit(fn, donate_argnums=(0,))
+            y = g(x)
+            return x + y
+    """)
+    assert _ids(_lint(tmp_path)) == ["JAX004"]
+
+
+def test_jax004_silent_on_rebind_and_hashable_static(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", """\
+        import jax
+
+        def f(fn, x):
+            g = jax.jit(fn, static_argnums=(1,), donate_argnums=(0,))
+            x = g(x, 4)
+            return x
+    """)
+    assert _lint(tmp_path) == []
+
+
+# -- PROTO001: message-key drift ----------------------------------------------
+
+PROTO_DEFINE = """\
+    class MyMessage:
+        MSG_TYPE_S2C_GO = "S2C_GO"
+        MSG_ARG_KEY_USED = "used"
+        MSG_ARG_KEY_DROPPED = "dropped"
+"""
+
+PROTO_USER = """\
+    from .message_define import MyMessage
+
+    def send(Message, receiver):
+        msg = Message(MyMessage.MSG_TYPE_S2C_GO, 0, receiver)
+        msg.add_params(MyMessage.MSG_ARG_KEY_USED, 1)
+        msg.add_params(MyMessage.MSG_ARG_KEY_DROPPED, 2)
+        return msg
+
+    def receive(comm, msg, handler):
+        comm.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_GO, handler)
+        return msg.get(MyMessage.MSG_ARG_KEY_USED)
+"""
+
+
+def test_proto001_flags_write_only_key(tmp_path):
+    _write(tmp_path, "fedml_tpu/proto/message_define.py", PROTO_DEFINE)
+    _write(tmp_path, "fedml_tpu/proto/user.py", PROTO_USER)
+    found = _lint(tmp_path)
+    assert _ids(found) == ["PROTO001"]
+    assert "MSG_ARG_KEY_DROPPED" in found[0].message
+    assert found[0].path == "fedml_tpu/proto/message_define.py"
+
+
+def test_proto001_flags_dead_and_read_only_constants(tmp_path):
+    _write(tmp_path, "fedml_tpu/proto/message_define.py", """\
+        class MyMessage:
+            MSG_ARG_KEY_DEAD = "dead"
+            MSG_ARG_KEY_EXPECTED = "expected"
+    """)
+    _write(tmp_path, "fedml_tpu/proto/user.py", """\
+        from .message_define import MyMessage
+
+        def receive(msg):
+            return msg.get(MyMessage.MSG_ARG_KEY_EXPECTED)
+    """)
+    msgs = " | ".join(f.message for f in _lint(tmp_path))
+    assert "never used" in msgs and "no sender ever emits" in msgs
+
+
+def test_proto001_noqa_on_define_line(tmp_path):
+    _write(tmp_path, "fedml_tpu/proto/message_define.py", """\
+        class MyMessage:
+            MSG_ARG_KEY_RESERVED = "rsv"  # fedml: noqa[PROTO001] — parity
+    """)
+    res = run_lint(root=tmp_path)
+    assert res.findings == [] and res.suppressed == 1
+
+
+# -- CONC001: unlocked shared mutation ---------------------------------------
+
+CONC_SRC = """\
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self.items = {}
+            self._lock = threading.Lock()
+
+        def start(self):
+            threading.Thread(target=self.run, daemon=True).start()
+
+        def run(self):
+            self.items["a"] = 1
+
+        def locked_update(self, k):
+            with self._lock:
+                self.items[k] = 2
+"""
+
+
+def test_conc001_fires_in_scheduler_not_elsewhere(tmp_path):
+    _write(tmp_path, "fedml_tpu/scheduler/w.py", CONC_SRC)
+    _write(tmp_path, "fedml_tpu/data/w.py", CONC_SRC)
+    found = _lint(tmp_path)
+    assert _ids(found) == ["CONC001"]
+    assert found[0].path == "fedml_tpu/scheduler/w.py"
+    assert found[0].line == 12  # the unlocked store, not the locked one
+
+
+def test_conc001_silent_without_threads_or_with_lock(tmp_path):
+    _write(tmp_path, "fedml_tpu/scheduler/w.py", """\
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.items = {}
+                self._lock = threading.Lock()
+
+            def start(self):
+                threading.Thread(target=self.run, daemon=True).start()
+
+            def run(self):
+                with self._lock:
+                    self.items["a"] = 1
+    """)
+    assert _lint(tmp_path) == []
+
+
+# -- engine: output, baseline ratchet, exit codes, --paths --------------------
+
+BAD_JAX = """\
+    import jax
+
+    def f():
+        k = jax.random.PRNGKey(0)
+        a = jax.random.normal(k, (2,))
+        b = jax.random.uniform(k, (2,))
+        return a + b
+"""
+
+
+def test_json_output_schema(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", BAD_JAX)
+    lines = []
+    code = run_cli(root=str(tmp_path), fmt="json", echo=lines.append)
+    assert code == 1
+    report = json.loads("\n".join(lines))
+    assert report["version"] == 1 and report["tool"] == "fedml-lint"
+    assert report["new_count"] == 1 and report["baselined_count"] == 0
+    assert {"files_scanned", "duration_s", "suppressed_count",
+            "findings"} <= set(report)
+    (f,) = report["findings"]
+    assert {"rule", "severity", "path", "line", "col", "message",
+            "fingerprint", "baselined"} <= set(f)
+    assert f["rule"] == "JAX002" and f["baselined"] is False
+
+
+def test_baseline_ratchet_add_and_fail_on_new(tmp_path):
+    _write(tmp_path, "fedml_tpu/old.py", BAD_JAX)
+    lines = []
+    assert run_cli(root=str(tmp_path), update_baseline=True,
+                   echo=lines.append) == 0
+    assert (tmp_path / ".fedml-lint-baseline.json").is_file()
+    # baselined finding no longer fails the run
+    assert run_cli(root=str(tmp_path), echo=lines.append) == 0
+    # a NEW finding fails with exit 1 and only the new one is reported
+    _write(tmp_path, "fedml_tpu/new.py", BAD_JAX)
+    out = []
+    assert run_cli(root=str(tmp_path), echo=out.append) == 1
+    rendered = "\n".join(out)
+    assert "fedml_tpu/new.py" in rendered
+    assert "fedml_tpu/old.py" not in rendered
+
+
+def test_fingerprints_stable_under_line_drift(tmp_path):
+    f = _write(tmp_path, "fedml_tpu/mod.py", BAD_JAX)
+    before = dict((fp, fi.rule_id)
+                  for fi, fp in fingerprints(_lint(tmp_path)))
+    f.write_text("# a new header comment\n\n" + f.read_text())
+    after = dict((fp, fi.rule_id)
+                 for fi, fp in fingerprints(_lint(tmp_path)))
+    assert before == after
+
+
+def test_exit_code_2_on_internal_error(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", "x = 1\n")
+    bad = tmp_path / "broken-baseline.json"
+    bad.write_text("{\"version\": 999}")
+    assert run_cli(root=str(tmp_path), baseline=str(bad),
+                   echo=lambda *_: None) == 2
+
+
+def test_paths_filter_restricts_scan(tmp_path):
+    _write(tmp_path, "fedml_tpu/a.py", BAD_JAX)
+    _write(tmp_path, "fedml_tpu/b.py", BAD_JAX)
+    res = run_lint(root=tmp_path, paths=["fedml_tpu/a.py"])
+    assert res.files_scanned == 1
+    assert [f.path for f in res.findings] == ["fedml_tpu/a.py"]
+
+
+def test_nonexistent_path_is_an_error_not_a_clean_pass(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", BAD_JAX)
+    assert run_cli(root=str(tmp_path), paths=["fedml_tpu/tariner"],
+                   echo=lambda *_: None) == 2
+
+
+def test_unknown_rule_id_is_an_error(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", "x = 1\n")
+    assert run_cli(root=str(tmp_path), rule_ids=["NOPE999"],
+                   echo=lambda *_: None) == 2
+
+
+def test_whitespace_padded_rule_ids_still_select(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", BAD_JAX)
+    res = run_lint(root=tmp_path, rule_ids=[" jax002 "])
+    assert _ids(res.findings) == ["JAX002"]
+
+
+def test_update_baseline_refused_on_partial_scan(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", BAD_JAX)
+    assert run_cli(root=str(tmp_path), paths=["fedml_tpu/mod.py"],
+                   update_baseline=True, echo=lambda *_: None) == 2
+    assert not (tmp_path / ".fedml-lint-baseline.json").exists()
+
+
+def test_unparseable_file_is_a_finding_not_a_crash(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", "def broken(:\n")
+    assert _ids(_lint(tmp_path)) == ["LINT001"]
+
+
+def test_write_and_load_baseline_roundtrip(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", BAD_JAX)
+    findings = _lint(tmp_path)
+    path = tmp_path / "bl.json"
+    assert write_baseline(path, findings) == 1
+    loaded = load_baseline(path)
+    (fp,) = loaded
+    assert loaded[fp]["rule"] == "JAX002"
+
+
+# -- the repo itself is lint-clean against the committed baseline -------------
+
+def test_repo_runs_clean_under_budget():
+    root = default_root()
+    assert (root / ".fedml-lint-baseline.json").is_file(), \
+        "committed baseline missing"
+    code = run_cli(root=str(root), echo=lambda *_: None)
+    assert code == 0, "new unbaselined lint findings in the repo"
+    res = run_lint(root=root)
+    assert res.duration_s < 30.0
+    assert res.files_scanned > 150
