@@ -6,6 +6,11 @@ Rule families (docs/STATIC_ANALYSIS.md has the full catalog):
   static/donate hazards that tests don't catch until a long run degrades
 * PROTO001      — sender/receiver drift across message_define contracts
 * CONC001       — unlocked shared-state mutation in threaded modules
+* whole-program pass (``--whole-program``, ``analysis.wholeprogram``):
+  PROTO002 orphan sends/handlers across every manager pair, FLOW001
+  protocol liveness over the send/handle FSM, SHARD001 PartitionSpec/mesh
+  contracts, RES001 thread + receive-loop lifecycle; ``--graph dot|json``
+  exports the send/handle graph
 
 Entry points: ``run_lint`` (library), ``run_cli`` (the `fedml lint`
 command body; exit codes 0 = clean, 1 = new findings, 2 = internal error).
@@ -43,20 +48,65 @@ def run_cli(root: Optional[str] = None,
             baseline: Optional[str] = None,
             update_baseline: bool = False,
             rule_ids: Optional[Sequence[str]] = None,
+            whole_program: bool = False,
+            graph: Optional[str] = None,
             echo=print) -> int:
     """Body of ``fedml lint``; returns the process exit code."""
     try:
+        if graph:
+            if graph not in ("dot", "json"):
+                echo(f"fedml lint: unknown --graph format {graph!r} "
+                     f"(want dot or json)")
+                return EXIT_INTERNAL_ERROR
+            if update_baseline or rule_ids or fmt != "text":
+                # silently ignoring these would e.g. skip a requested
+                # baseline rewrite — make the contract explicit
+                echo("fedml lint: --graph cannot be combined with "
+                     "--update-baseline/--rules/--format (use --graph "
+                     "json for machine-readable output)")
+                return EXIT_INTERNAL_ERROR
+            from .engine import collect_files
+            from .wholeprogram import build_graph, filter_graph, \
+                index_package, to_dot, to_json
+            root_p = Path(root) if root else default_root()
+            # the graph is only truthful over the WHOLE package — a subset
+            # index would misresolve every contract; --paths narrows what
+            # is DISPLAYED, not what is analyzed
+            g = build_graph(index_package(root_p))
+            if paths:
+                # a typo'd --paths must not silently render an empty
+                # digraph (same guard as the lint scan) — raises here
+                collect_files(root_p, paths)
+                g = filter_graph(g, paths)
+            echo(to_dot(g) if graph == "dot" else to_json(g))
+            return EXIT_CLEAN
         if update_baseline and (paths or rule_ids):
             # a partial scan would REPLACE the whole baseline, deleting
             # every entry outside the scanned subset
             echo("fedml lint: refusing --update-baseline with --paths/"
                  "--rules — the baseline must come from a full scan")
             return EXIT_INTERNAL_ERROR
+        if update_baseline:
+            # the baseline file is SHARED by the per-file and whole-program
+            # CI gates; rewriting it from a per-file-only scan would drop
+            # every baselined cross-file entry, so always take the fullest
+            # scan when rewriting
+            whole_program = True
         root_p = Path(root) if root else default_root()
-        result = run_lint(root_p, paths=paths or None, rule_ids=rule_ids)
+        result = run_lint(root_p, paths=paths or None, rule_ids=rule_ids,
+                          whole_program=whole_program)
         baseline_p = (Path(baseline) if baseline
                       else root_p / DEFAULT_BASELINE_NAME)
         if update_baseline:
+            if result.notes:
+                # a skipped cross-file pass would rewrite the SHARED
+                # baseline without its cross-file entries — refuse rather
+                # than silently weaken it
+                for note in result.notes:
+                    echo(f"fedml lint: note: {note}")
+                echo("fedml lint: refusing --update-baseline — the scan "
+                     "was incomplete; fix the parse errors first")
+                return EXIT_INTERNAL_ERROR
             n = write_baseline(baseline_p, result.findings)
             echo(f"fedml lint: baseline written to {baseline_p} "
                  f"({n} findings)")
@@ -68,6 +118,8 @@ def run_cli(root: Optional[str] = None,
         else:
             for f, _fp in new:
                 echo(f.render())
+            for note in result.notes:
+                echo(f"fedml lint: note: {note}")
             echo(f"fedml lint: {result.files_scanned} files, "
                  f"{len(new)} new finding(s), {len(old)} baselined, "
                  f"{result.suppressed} suppressed "
@@ -93,5 +145,6 @@ def _json_report(result: LintResult, new, old) -> dict:
         "new_count": len(new),
         "baselined_count": len(old),
         "suppressed_count": result.suppressed,
+        "notes": list(result.notes),
         "findings": findings,
     }

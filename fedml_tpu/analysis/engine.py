@@ -51,6 +51,10 @@ class LintResult:
     files_scanned: int
     suppressed: int
     duration_s: float
+    #: surfaced caveats — e.g. "cross-file rules skipped because a file
+    #: does not parse"; run_cli echoes these so a clean exit is never
+    #: silently weaker than requested
+    notes: List[str] = dataclasses.field(default_factory=list)
 
 
 def default_root() -> Path:
@@ -78,6 +82,29 @@ def collect_files(root: Path,
     return sorted(out)
 
 
+def parse_contexts(root: Path, paths: Optional[Sequence[str]] = None,
+                   skip: Optional[Set[str]] = None
+                   ) -> Tuple[List[FileContext], List[Tuple[str, Exception]]]:
+    """Collect + parse into FileContexts; unparsable files come back as
+    (relpath, exception) pairs for the caller to surface (run_lint turns
+    them into LINT001 findings; the index builder skips them).  ``skip``
+    short-circuits relpaths already parsed elsewhere."""
+    contexts: List[FileContext] = []
+    errors: List[Tuple[str, Exception]] = []
+    for fp in collect_files(root, paths):
+        rel = fp.relative_to(root).as_posix()
+        if skip and rel in skip:
+            continue
+        try:
+            source = fp.read_text(encoding="utf-8")
+            tree = ast.parse(source, filename=rel)
+        except (SyntaxError, UnicodeDecodeError, OSError) as exc:
+            errors.append((rel, exc))
+            continue
+        contexts.append(FileContext(rel, source, tree, source.splitlines()))
+    return contexts, errors
+
+
 def _noqa_rules_for_line(line: str) -> Optional[Set[str]]:
     """None → no suppression; empty set → suppress all; else rule ids."""
     m = NOQA_RE.search(line)
@@ -103,38 +130,39 @@ def _apply_noqa(findings: List[Finding],
 
 def run_lint(root: Optional[Path] = None,
              paths: Optional[Sequence[str]] = None,
-             rule_ids: Optional[Sequence[str]] = None) -> LintResult:
-    from .rules import make_rules
+             rule_ids: Optional[Sequence[str]] = None,
+             whole_program: bool = False) -> LintResult:
+    from .rules import make_program_rules, make_rules
 
     t0 = time.monotonic()
     root = Path(root) if root else default_root()
     wanted = {r.strip().upper() for r in rule_ids} if rule_ids else None
     all_rules = make_rules()
+    all_prog_rules = make_program_rules()
+    prog_ids = {r.id.upper() for r in all_prog_rules}
     if wanted is not None:
-        known = {r.id.upper() for r in all_rules}
+        known = {r.id.upper() for r in all_rules} | prog_ids
         unknown = sorted(wanted - known)
         if unknown:
             raise ValueError(f"unknown rule id(s) {unknown}; "
                              f"known: {sorted(known)}")
+        # asking for a whole-program rule by id implies the full pass
+        whole_program = whole_program or bool(wanted & prog_ids)
     rules = [r for r in all_rules
              if wanted is None or r.id.upper() in wanted]
+    prog_rules = ([r for r in all_prog_rules
+                   if wanted is None or r.id.upper() in wanted]
+                  if whole_program else [])
     findings: List[Finding] = []
     suppressed = 0
-    files = collect_files(root, paths)
-    contexts: List[FileContext] = []
-    for fp in files:
-        rel = fp.relative_to(root).as_posix()
-        try:
-            source = fp.read_text(encoding="utf-8")
-            tree = ast.parse(source, filename=rel)
-        except (SyntaxError, UnicodeDecodeError, OSError) as exc:
-            findings.append(Finding(
-                "LINT001", SEV_ERROR, rel,
-                getattr(exc, "lineno", 1) or 1, 0,
-                f"file cannot be parsed: {exc.__class__.__name__}"))
-            continue
-        ctx = FileContext(rel, source, tree, source.splitlines())
-        contexts.append(ctx)
+    contexts, parse_errors = parse_contexts(root, paths)
+    n_files = len(contexts) + len(parse_errors)
+    for rel, exc in parse_errors:
+        findings.append(Finding(
+            "LINT001", SEV_ERROR, rel,
+            getattr(exc, "lineno", 1) or 1, 0,
+            f"file cannot be parsed: {exc.__class__.__name__}"))
+    for ctx in contexts:
         file_findings: List[Finding] = []
         for rule in rules:
             file_findings.extend(rule.check_file(ctx))
@@ -143,8 +171,9 @@ def run_lint(root: Optional[Path] = None,
         suppressed += n_sup
     # project-level rules (cross-file: protocol drift) emit after the scan
     ctx_by_path = {c.path: c for c in contexts}
-    for rule in rules:
-        project_findings = list(rule.finish())
+
+    def _emit_project(project_findings: List[Finding]) -> None:
+        nonlocal suppressed
         by_file: Dict[str, List[Finding]] = {}
         for f in project_findings:
             by_file.setdefault(f.path, []).append(f)
@@ -155,6 +184,47 @@ def run_lint(root: Optional[Path] = None,
                 suppressed += n_sup
             else:
                 findings.extend(fl)
+
+    for rule in rules:
+        _emit_project(list(rule.finish()))
+    notes: List[str] = []
+    if prog_rules:
+        from .wholeprogram import build_index
+
+        # cross-file verdicts are only sound when EVERY file parses: an
+        # invisible counterpart (its handlers/sends unindexed) would turn
+        # healthy traffic into orphans/stalls.  Skip — never guess — and
+        # say so; on a full scan the LINT001 finding fails the run anyway.
+        skip_reason = None
+        subset = None
+        rest: List[FileContext] = []
+        if parse_errors:
+            skip_reason = (
+                f"cross-file rules skipped: {len(parse_errors)} file(s) "
+                f"cannot be parsed (see LINT001) — cross-file verdicts "
+                f"would be guesses")
+        elif paths:
+            # subset scans still index the WHOLE package (a subset index
+            # would misreport the counterpart role's traffic) and emit
+            # findings only for the requested files — clang-tidy
+            # header-filter semantics, so pre-commit runs stay quiet.
+            subset = {c.path for c in contexts}
+            rest, rest_errors = parse_contexts(root, None, skip=subset)
+            if rest_errors:
+                skip_reason = (
+                    f"cross-file rules skipped: {len(rest_errors)} "
+                    f"file(s) outside --paths cannot be parsed — run a "
+                    f"full `fedml lint --whole-program` for the verdicts")
+        if skip_reason is not None:
+            notes.append(skip_reason)
+        else:
+            index = build_index(contexts + rest)
+            for rule in prog_rules:
+                prog_findings = list(rule.check_program(index))
+                if subset is not None:
+                    prog_findings = [f for f in prog_findings
+                                     if f.path in subset]
+                _emit_project(prog_findings)
     findings.sort(key=Finding.sort_key)
-    return LintResult(findings, len(files), suppressed,
-                      time.monotonic() - t0)
+    return LintResult(findings, n_files, suppressed,
+                      time.monotonic() - t0, notes)

@@ -1,7 +1,9 @@
-"""Rule registry.  A rule sees every file once (``check_file``) and may emit
-more findings after the whole scan (``finish``, for cross-file rules like
-PROTO001).  ``make_rules`` builds FRESH instances per run — rules are allowed
-to accumulate state across files."""
+"""Rule registry.  A per-file rule sees every file once (``check_file``) and
+may emit more findings after the whole scan (``finish``, for cross-file rules
+like PROTO001).  A PROGRAM rule (``ProgramRule``) instead queries the
+package index built by ``analysis.wholeprogram`` and only runs under
+``fedml lint --whole-program``.  ``make_rules``/``make_program_rules`` build
+FRESH instances per run — rules are allowed to accumulate state."""
 
 from __future__ import annotations
 
@@ -10,12 +12,14 @@ from typing import Iterable, List, Type
 from ..findings import Finding
 
 _REGISTRY: List[Type["Rule"]] = []
+_PROGRAM_REGISTRY: List[Type["ProgramRule"]] = []
 
 
 class Rule:
     id: str = ""
     severity: str = "warning"
     title: str = ""
+    whole_program = False
 
     def check_file(self, ctx) -> Iterable[Finding]:
         return ()
@@ -24,8 +28,22 @@ class Rule:
         return ()
 
 
+class ProgramRule(Rule):
+    """Cross-file rule over the whole-program PackageIndex."""
+
+    whole_program = True
+
+    def check_program(self, index) -> Iterable[Finding]:
+        return ()
+
+
 def register(cls: Type[Rule]) -> Type[Rule]:
     _REGISTRY.append(cls)
+    return cls
+
+
+def register_program(cls: Type[ProgramRule]) -> Type[ProgramRule]:
+    _PROGRAM_REGISTRY.append(cls)
     return cls
 
 
@@ -36,6 +54,14 @@ def make_rules() -> List[Rule]:
     return [cls() for cls in _REGISTRY]
 
 
+def make_program_rules() -> List[ProgramRule]:
+    from ..wholeprogram import protocol_rules, structure_rules  # noqa: F401
+
+    return [cls() for cls in _PROGRAM_REGISTRY]
+
+
 def rule_catalog() -> List[dict]:
-    return [{"id": r.id, "severity": r.severity, "title": r.title}
-            for r in make_rules()]
+    return ([{"id": r.id, "severity": r.severity, "title": r.title,
+              "whole_program": False} for r in make_rules()]
+            + [{"id": r.id, "severity": r.severity, "title": r.title,
+                "whole_program": True} for r in make_program_rules()])

@@ -1,0 +1,28 @@
+"""Whole-program analysis: package index + cross-file rules + graph export.
+
+Run via ``fedml lint --whole-program`` (rules PROTO002/FLOW001/SHARD001/
+RES001, sharing the per-file engine's noqa/fingerprint/baseline machinery)
+or ``fedml lint --graph dot|json`` (the send/handle graph the rules reason
+over).  See docs/STATIC_ANALYSIS.md for the catalog and the FSM model's
+known approximations.
+"""
+
+from .graph import build_graph, filter_graph, to_dot, to_json
+from .index import PackageIndex, build_index
+
+__all__ = ["PackageIndex", "build_index", "build_graph", "filter_graph",
+           "to_dot", "to_json", "index_package"]
+
+
+def index_package(root=None, paths=None) -> PackageIndex:
+    """Parse the package and build a PackageIndex directly (the --graph
+    entry point).  Unparsable files are skipped, not fatal — but they are
+    recorded on the index so absence-based consumers (the graph's orphan
+    lists) can go conservative instead of claiming healthy traffic is
+    orphaned."""
+    from ..engine import default_root, parse_contexts
+
+    contexts, errors = parse_contexts(root or default_root(), paths)
+    index = build_index(contexts)
+    index.parse_errors = [rel for rel, _exc in errors]
+    return index

@@ -1,0 +1,129 @@
+"""Send/handle graph export — ``fedml lint --graph dot|json``.
+
+The graph the protocol rules reason over, made visible: one node per
+manager class (labelled with its module and server/client/peer role), one
+edge per (sender class → handler class) pair carrying the wire value.
+Orphan traffic (sends with no handler, handlers with no sender) is listed
+separately so the DOT rendering doubles as a PROTO002 debugging aid.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Set, Tuple
+
+from .index import PackageIndex
+
+_ROLE_SHAPE = {"server": "box", "client": "ellipse", "peer": "hexagon"}
+
+
+def build_graph(index: PackageIndex) -> Dict:
+    # the SAME aggregation PROTO002 consumes — the drawing cannot drift
+    # from the rule's verdicts
+    t = index.aggregate_traffic()
+    nodes: List[Dict] = []
+    seen: Set[str] = set()
+    role_of = {c.name: c.role for m in index.modules.values()
+               for c in m.classes}
+    for cls in index.managers:
+        if cls.name not in seen:
+            seen.add(cls.name)
+            nodes.append({"name": cls.name, "module": cls.path,
+                          "role": cls.role})
+    for table in (t.sends, t.handlers):
+        for sites in table.values():
+            for owner, path, _member, _lineno in sites:
+                if owner not in seen:
+                    seen.add(owner)
+                    nodes.append({"name": owner, "module": path,
+                                  "role": role_of.get(owner, "peer")})
+    sends = {v: {s[0] for s in sites} for v, sites in t.sends.items()}
+    handles = {v: {s[0] for s in sites} for v, sites in t.handlers.items()}
+    handler_names: Dict[Tuple[str, str], str] = {
+        (v, s[0]): s[2] for v, sites in t.handlers.items() for s in sites}
+    edges: List[Dict] = []
+    for value in sorted(set(sends) & set(handles)):
+        for src in sorted(sends[value]):
+            for dst in sorted(handles[value]):
+                edges.append({"value": value, "from": src, "to": dst,
+                              "handler": handler_names[(value, dst)]})
+    # orphan lists mirror PROTO002's conservatism exactly: one dynamic
+    # registration could accept anything (no orphan-send verdict), one
+    # dynamic send could emit anything (no orphan-handler verdict), and an
+    # unparsable file hides ALL its traffic (no orphan verdicts at all) —
+    # the drawing must never show red traffic the rule will not flag
+    notes = []
+    if index.parse_errors:
+        notes.append(f"{len(index.parse_errors)} file(s) could not be "
+                     f"parsed — orphan detection disabled")
+    suppress_orphans = bool(index.parse_errors)
+    return {
+        "version": 1,
+        "tool": "fedml-lint-graph",
+        "nodes": sorted(nodes, key=lambda n: n["name"]),
+        "edges": edges,
+        "notes": notes,
+        "orphan_sends": ([] if t.dynamic_handlers or suppress_orphans
+                         else sorted(set(sends) - set(handles))),
+        "orphan_handlers": ([] if t.dynamic_sends or suppress_orphans
+                            else sorted(set(handles) - set(sends))),
+    }
+
+
+def filter_graph(graph: Dict, path_prefixes) -> Dict:
+    """Narrow a WHOLE-PACKAGE graph to the nodes defined under the given
+    paths plus their direct counterparts — the graph must always be built
+    from the full index (a subset index would misresolve every contract),
+    then filtered for display.  Orphan lists stay global: they mirror
+    PROTO002, which is a whole-program verdict."""
+    # normalize ("./x", "x/") so the match can't silently miss everything
+    from pathlib import PurePosixPath
+
+    prefixes = [PurePosixPath(str(p)).as_posix() for p in path_prefixes]
+
+    def in_subset(module: str) -> bool:
+        return any(module == p or module.startswith(p + "/")
+                   for p in prefixes)
+
+    primary = {n["name"] for n in graph["nodes"] if in_subset(n["module"])}
+    edges = [e for e in graph["edges"]
+             if e["from"] in primary or e["to"] in primary]
+    keep = primary | {e["from"] for e in edges} | {e["to"] for e in edges}
+    return dict(graph,
+                nodes=[n for n in graph["nodes"] if n["name"] in keep],
+                edges=edges)
+
+
+def _q(s: str) -> str:
+    return '"' + s.replace('"', r'\"') + '"'
+
+
+def to_dot(graph: Dict) -> str:
+    lines = ["digraph send_handle {", "  rankdir=LR;",
+             "  node [fontsize=10]; edge [fontsize=9];"]
+    for note in graph.get("notes", ()):
+        lines.append(f"  // {note}")
+    for n in graph["nodes"]:
+        shape = _ROLE_SHAPE.get(n["role"], "ellipse")
+        label = f"{n['name']}\\n{n['module']}"
+        lines.append(f"  {_q(n['name'])} [shape={shape}, "
+                     f"label={_q(label)}];")
+    for e in graph["edges"]:
+        lines.append(f"  {_q(e['from'])} -> {_q(e['to'])} "
+                     f"[label={_q(e['value'])}];")
+    # orphan traffic renders red against a sink/source placeholder so a
+    # glance at the drawing shows exactly what PROTO002 will flag
+    if graph["orphan_sends"] or graph["orphan_handlers"]:
+        lines.append('  "(none)" [shape=plaintext, fontcolor=red];')
+    for v in graph["orphan_sends"]:
+        lines.append(f'  {_q(v)} -> "(none)" '
+                     f'[color=red, label="no handler"];')
+    for v in graph["orphan_handlers"]:
+        lines.append(f'  "(none)" -> {_q(v)} '
+                     f'[color=red, label="no sender"];')
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def to_json(graph: Dict) -> str:
+    return json.dumps(graph, indent=2)

@@ -1,0 +1,583 @@
+"""Package-wide index for the whole-program lint pass.
+
+Built ONCE per run from the already-parsed per-file ASTs, then queried by
+every cross-file rule (PROTO002/FLOW001/SHARD001/RES001) and by the
+``fedml lint --graph`` exporter.  It records, per module: import aliases,
+module-level string constants; per class: the constant table, the methods,
+and — for comm-manager classes — the protocol surface:
+
+* **registrations** — ``register_message_receive_handler(TYPE, self.h)``
+  call sites, with TYPE resolved to its wire value through the
+  ``message_define`` constant classes (or module constants, or literals);
+* **emissions** — ``Message(TYPE, …)`` constructions, resolved the same
+  way.  A TYPE that is a local variable is resolved through the method's
+  assignments (both arms of a conditional count); a TYPE that is a method
+  PARAMETER is left symbolic and bound at each intra-class call site that
+  passes a resolvable constant (the ``self._send_round_start(MSG_TYPE_X)``
+  idiom);
+* **self-references** — every ``self.<method>`` mention, call or not, so
+  callbacks handed to ``threading.Timer(…, self._on_timeout)`` count as
+  reachable in the liveness FSM;
+* **raises** — ``raise`` statements outside any ``try``, for the
+  resource-lifecycle rule's receive-loop-exit check.
+
+Like the per-file engine, the index never imports the code under analysis —
+stdlib ``ast`` only, so the whole-program pass stays fast and jax-free.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .. import astutil
+
+#: methods treated as protocol entry points: emissions reachable from these
+#: form the init handshake the FLOW001 FSM starts from.
+INIT_METHODS = ("run", "run_flow", "run_async", "start", "__init__")
+
+REGISTER_METHOD = "register_message_receive_handler"
+
+
+@dataclasses.dataclass
+class Emission:
+    value: str
+    lineno: int
+    method: str            # method the Message(...) construction sits in
+
+
+@dataclasses.dataclass
+class Registration:
+    value: Optional[str]   # None → type expression was not resolvable
+    handler: str           # name of the bound self.<handler> method
+    lineno: int
+    method: str
+
+
+@dataclasses.dataclass
+class MethodInfo:
+    name: str
+    lineno: int
+    node: ast.AST
+    params: List[str] = dataclasses.field(default_factory=list)
+    self_refs: Set[str] = dataclasses.field(default_factory=set)
+    emissions: List[Emission] = dataclasses.field(default_factory=list)
+    #: (param name, lineno) of Message(<param>, ...) constructions awaiting
+    #: binding from call sites
+    param_emissions: List[Tuple[str, int]] = dataclasses.field(
+        default_factory=list)
+    #: the subset no call site could bind — THIS method can send types the
+    #: analysis cannot name (a call site that passes an unresolvable arg
+    #: next to a resolvable one is treated as bound: approximation)
+    unbound_param_sites: List[Tuple[str, int]] = dataclasses.field(
+        default_factory=list)
+    registrations: List[Registration] = dataclasses.field(
+        default_factory=list)
+    unresolved_emissions: int = 0
+    raises_outside_try: List[int] = dataclasses.field(default_factory=list)
+    self_calls: List[ast.Call] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    path: str
+    lineno: int
+    bases: List[str]
+    consts: Dict[str, str] = dataclasses.field(default_factory=dict)
+    methods: Dict[str, MethodInfo] = dataclasses.field(default_factory=dict)
+    #: Message(<param>) sites no call site could bind — the class can send
+    #: types the analysis cannot name, so orphan-handler verdicts that
+    #: depend on "nothing sends X" must be withheld
+    unbound_param_emissions: int = 0
+
+    @property
+    def registrations(self) -> List[Registration]:
+        return [r for m in self.methods.values() for r in m.registrations]
+
+    @property
+    def emissions(self) -> List[Emission]:
+        return [e for m in self.methods.values() for e in m.emissions]
+
+    @property
+    def is_manager(self) -> bool:
+        """A protocol participant: registers at least one typed handler."""
+        return bool(self.registrations)
+
+    @property
+    def role(self) -> str:
+        n = self.name.lower()
+        if "server" in n or "aggregat" in n:
+            return "server"
+        if "client" in n or "edge" in n:
+            return "client"
+        return "peer"
+
+    def calls_finish(self) -> bool:
+        for m in self.methods.values():
+            for node in ast.walk(m.node):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "finish"
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "self"):
+                    return True
+        return False
+
+
+#: aggregation site: (owner label — class name or "fn()", path, member,
+#: lineno)
+Site = Tuple[str, str, str, int]
+
+
+@dataclasses.dataclass
+class Traffic:
+    sends: Dict[str, List[Site]] = dataclasses.field(default_factory=dict)
+    handlers: Dict[str, List[Site]] = dataclasses.field(
+        default_factory=dict)
+    dynamic_sends: int = 0
+    dynamic_handlers: int = 0
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    path: str
+    tree: ast.AST
+    aliases: Dict[str, str]
+    constants: Dict[str, str] = dataclasses.field(default_factory=dict)
+    classes: List[ClassInfo] = dataclasses.field(default_factory=list)
+    #: top-level functions — drivers/helpers that may send or register
+    #: protocol traffic outside any manager class
+    functions: List[MethodInfo] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class PackageIndex:
+    modules: Dict[str, ModuleInfo] = dataclasses.field(default_factory=dict)
+    #: the engine's FileContext list, for rules that re-walk raw ASTs
+    contexts: List = dataclasses.field(default_factory=list)
+    #: relpaths the builder's caller could not parse — consumers that make
+    #: absence-based claims (orphan lists) must go conservative when set
+    parse_errors: List[str] = dataclasses.field(default_factory=list)
+    #: class name → {CONST: wire value}, merged across modules (two classes
+    #: aliasing one string is a legal shared contract, same as PROTO001)
+    class_consts: Dict[str, Dict[str, str]] = dataclasses.field(
+        default_factory=dict)
+    #: module-level NAME → set of values seen package-wide (for resolving
+    #: bare-name message types imported from another module)
+    global_consts: Dict[str, Set[str]] = dataclasses.field(
+        default_factory=dict)
+
+    @property
+    def managers(self) -> List[ClassInfo]:
+        return [c for m in self.modules.values() for c in m.classes
+                if c.is_manager]
+
+    def outside_senders(self) -> List[Tuple[str, str, "MethodInfo", int]]:
+        """Emission-bearing code OUTSIDE the manager classes — pure-sender
+        classes and top-level driver functions.  Their traffic must count,
+        or handlers fed by them would be falsely reported dead.  Returns
+        (owner label, path, method-info, dynamic-site count) — the count
+        is the number of sends the analysis cannot name: for class methods
+        the call-site binding already ran, so only UNBOUND sites are
+        dynamic; free functions are never bound, so every parametric site
+        is."""
+        out: List[Tuple[str, str, MethodInfo, int]] = []
+        for m in self.modules.values():
+            for cls in m.classes:
+                if cls.is_manager:
+                    continue
+                for mi in cls.methods.values():
+                    if mi.emissions or mi.param_emissions \
+                            or mi.unresolved_emissions:
+                        dyn = len(mi.unbound_param_sites) \
+                            + mi.unresolved_emissions
+                        out.append((cls.name, m.path, mi, dyn))
+            for fn in m.functions:
+                if fn.emissions or fn.param_emissions \
+                        or fn.unresolved_emissions:
+                    dyn = len(fn.param_emissions) + fn.unresolved_emissions
+                    out.append((f"{fn.name}()", m.path, fn, dyn))
+        return out
+
+    def outside_registrations(self) -> List[Tuple[str, "Registration"]]:
+        """Handler registrations in top-level functions (a driver wiring a
+        manager) — they count toward "someone handles this value"."""
+        return [(m.path, r) for m in self.modules.values()
+                for fn in m.functions for r in fn.registrations]
+
+    def aggregate_traffic(self) -> "Traffic":
+        """ONE canonical send/handler aggregation, shared by PROTO002 and
+        the graph exporter so the drawing can never disagree with the
+        rule: every emission and registration across managers, pure-sender
+        classes and top-level drivers, plus the dynamic-site counts that
+        gate absence-based verdicts."""
+        t = Traffic()
+        for cls in self.managers:
+            t.dynamic_sends += cls.unbound_param_emissions
+            for m in cls.methods.values():
+                t.dynamic_sends += m.unresolved_emissions
+            for e in cls.emissions:
+                t.sends.setdefault(e.value, []).append(
+                    (cls.name, cls.path, e.method, e.lineno))
+            for r in cls.registrations:
+                if r.value is None:
+                    t.dynamic_handlers += 1
+                else:
+                    t.handlers.setdefault(r.value, []).append(
+                        (cls.name, cls.path, r.handler, r.lineno))
+        for owner, path, mi, dyn in self.outside_senders():
+            t.dynamic_sends += dyn
+            for e in mi.emissions:
+                t.sends.setdefault(e.value, []).append(
+                    (owner, path, e.method, e.lineno))
+        for path, r in self.outside_registrations():
+            if r.value is None:
+                t.dynamic_handlers += 1
+            else:
+                t.handlers.setdefault(r.value, []).append(
+                    (r.method + "()", path, r.handler, r.lineno))
+        return t
+
+    def comm_bases(self) -> List[ClassInfo]:
+        """Classes that look like the comm-manager runtime base: they define
+        BOTH the handler registry setter and the dispatch entry point."""
+        return [c for m in self.modules.values() for c in m.classes
+                if REGISTER_METHOD in c.methods
+                and "receive_message" in c.methods]
+
+    def dispatch_guarded(self) -> Optional[bool]:
+        """True/False: does every comm base wrap handler dispatch in a
+        try that reaches finish()/stop_receive_message() on error?
+        None when the scanned package has no comm base at all."""
+        bases = self.comm_bases()
+        if not bases:
+            return None
+        return all(_receive_message_guarded(c.methods["receive_message"])
+                   for c in bases)
+
+
+def class_closure(cls: ClassInfo, roots) -> Set[str]:
+    """Transitive ``self.*`` reference closure over a class's methods —
+    the reachability model shared by FLOW001 and RES001 (handler bindings
+    are already excluded at index-build time)."""
+    seen: Set[str] = set()
+    stack = [r for r in roots if r in cls.methods]
+    while stack:
+        name = stack.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        for ref in cls.methods[name].self_refs:
+            if ref in cls.methods and ref not in seen:
+                stack.append(ref)
+    return seen
+
+
+def _receive_message_guarded(method: MethodInfo) -> bool:
+    for node in ast.walk(method.node):
+        if not isinstance(node, ast.Try):
+            continue
+        cleanup = list(node.finalbody)
+        for h in node.handlers:
+            cleanup.extend(h.body)
+        for stmt in cleanup:
+            for sub in ast.walk(stmt):
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr in ("finish",
+                                              "stop_receive_message")):
+                    return True
+    return False
+
+
+# -- wire-value resolution ----------------------------------------------------
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def resolve_type_expr(node: ast.AST, index: PackageIndex, module: ModuleInfo,
+                      method_node: Optional[ast.AST] = None,
+                      params: Sequence[str] = (),
+                      _depth: int = 0) -> Tuple[Set[str], Set[str]]:
+    """Resolve a message-type expression → (wire values, unbound params).
+
+    Handles string literals, ``Cls.CONST`` references, bare module-constant
+    names (local module first, then a package-wide unique name), local
+    variables assigned within the method (every arm of an ``a if c else b``
+    counts), and function parameters (returned symbolically for call-site
+    binding).  Anything else resolves to nothing — callers decide how
+    conservative to be about unresolved sites.
+    """
+    if _depth > 6:
+        return set(), set()
+    v = _const_str(node)
+    if v is not None:
+        return {v}, set()
+    if isinstance(node, ast.IfExp):
+        bv, bp = resolve_type_expr(node.body, index, module, method_node,
+                                   params, _depth + 1)
+        ov, op = resolve_type_expr(node.orelse, index, module, method_node,
+                                   params, _depth + 1)
+        return bv | ov, bp | op
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        table = index.class_consts.get(node.value.id, {})
+        val = table.get(node.attr)
+        return ({val} if val is not None else set()), set()
+    if isinstance(node, ast.Name):
+        name = node.id
+        values: Set[str] = set()
+        if method_node is not None:
+            for stmt in ast.walk(method_node):
+                if (isinstance(stmt, ast.Assign)
+                        and any(isinstance(t, ast.Name) and t.id == name
+                                for t in stmt.targets)):
+                    sv, _ = resolve_type_expr(stmt.value, index, module,
+                                              method_node, (), _depth + 1)
+                    values |= sv
+        if values:
+            return values, set()
+        if name in params:
+            return set(), {name}
+        if name in module.constants:
+            return {module.constants[name]}, set()
+        glob = index.global_consts.get(name, set())
+        if len(glob) == 1:
+            return set(glob), set()
+    return set(), set()
+
+
+# -- builders ----------------------------------------------------------------
+
+def _collect_class_consts(cls: ast.ClassDef) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for stmt in cls.body:
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)):
+            v = _const_str(stmt.value)
+            if v is not None and stmt.targets[0].id.isupper():
+                out[stmt.targets[0].id] = v
+    return out
+
+
+def _collect_module_consts(tree: ast.AST) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for stmt in getattr(tree, "body", []):
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id.isupper()):
+            v = _const_str(stmt.value)
+            if v is not None:
+                out[stmt.targets[0].id] = v
+    return out
+
+
+def _register_handler_arg(call: ast.Call) -> Optional[ast.AST]:
+    """The handler expression of a register_message_receive_handler call —
+    positional or keyword-bound (``handler=self.h``)."""
+    if len(call.args) >= 2:
+        return call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "handler":
+            return kw.value
+    return None
+
+
+def _register_type_arg(call: ast.Call) -> Optional[ast.AST]:
+    """The message-type expression — positional or ``msg_type=`` keyword."""
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "msg_type":
+            return kw.value
+    return None
+
+
+def _message_type_arg(call: ast.Call) -> Optional[ast.AST]:
+    """The type expression of a Message construction — positional or
+    keyword (``Message(type=X, …)`` is legal against the runtime ctor)."""
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg in ("type", "msg_type", "mtype"):
+            return kw.value
+    return None
+
+
+def _is_message_ctor(call: ast.Call) -> bool:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id == "Message"
+    if isinstance(fn, ast.Attribute):
+        return fn.attr == "Message"
+    return False
+
+
+def _raise_outside_try(node: ast.Raise, parents, method_node) -> bool:
+    for a in astutil.ancestors(node, parents):
+        if isinstance(a, ast.Try):
+            return False
+        if a is method_node:
+            break
+    return True
+
+
+def _build_method(fn: ast.AST, index: PackageIndex, module: ModuleInfo,
+                  parents) -> MethodInfo:
+    params = [a.arg for a in fn.args.args if a.arg != "self"]
+    info = MethodInfo(fn.name, fn.lineno, fn, params=params)
+    # a handler BOUND via register_message_receive_handler(TYPE, self.h)
+    # must not count as a self-reference: it runs when its message arrives,
+    # not when the registering method does — counting it would fold every
+    # handler into the init closure and blind the liveness FSM
+    binding_ids = set()
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == REGISTER_METHOD):
+            h = _register_handler_arg(node)
+            if h is not None:
+                binding_ids.add(id(h))
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and id(node) not in binding_ids):
+            info.self_refs.add(node.attr)
+        if isinstance(node, ast.Raise) and _raise_outside_try(
+                node, parents, fn):
+            info.raises_outside_try.append(node.lineno)
+        if not isinstance(node, ast.Call):
+            continue
+        if (isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"):
+            info.self_calls.append(node)
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr == REGISTER_METHOD
+                and (node.args or node.keywords)):
+            targ = _register_type_arg(node)
+            values, _ = (resolve_type_expr(targ, index, module, fn, ())
+                         if targ is not None else (set(), set()))
+            handler = ""
+            h = _register_handler_arg(node)
+            if isinstance(h, ast.Attribute):
+                handler = h.attr
+            elif isinstance(h, ast.Name):
+                handler = h.id
+            if values:
+                for v in sorted(values):
+                    info.registrations.append(
+                        Registration(v, handler, node.lineno, fn.name))
+            else:
+                info.registrations.append(
+                    Registration(None, handler, node.lineno, fn.name))
+        elif _is_message_ctor(node) and (node.args or node.keywords):
+            # bare Message() is the transports' payload-reconstruction
+            # idiom, not a protocol send — anything else must resolve or
+            # count as a dynamic send
+            targ = _message_type_arg(node)
+            if targ is None:
+                info.unresolved_emissions += 1
+                continue
+            values, unbound = resolve_type_expr(targ, index, module,
+                                                fn, params)
+            for v in sorted(values):
+                info.emissions.append(Emission(v, node.lineno, fn.name))
+            for p in sorted(unbound):
+                info.param_emissions.append((p, node.lineno))
+            if not values and not unbound:
+                info.unresolved_emissions += 1
+    return info
+
+
+def _bind_param_emissions(cls: ClassInfo, index: PackageIndex,
+                          module: ModuleInfo) -> int:
+    """Bind ``Message(<param>, …)`` emissions to the constants passed at
+    intra-class call sites; returns the number of params left unbound."""
+    unbound = 0
+    # a callee with several Message(<param>) sites for the same param must
+    # not multiply the bound emissions — one per (caller, value, call site)
+    bound_seen: set = set()
+    for callee in cls.methods.values():
+        if not callee.param_emissions:
+            continue
+        for pname, lineno in callee.param_emissions:
+            try:
+                pidx = callee.params.index(pname)
+            except ValueError:
+                unbound += 1
+                continue
+            bound_here = False
+            for caller in cls.methods.values():
+                for call in caller.self_calls:
+                    if call.func.attr != callee.name:
+                        continue
+                    arg: Optional[ast.AST] = None
+                    if pidx < len(call.args):
+                        arg = call.args[pidx]
+                    else:
+                        for kw in call.keywords:
+                            if kw.arg == pname:
+                                arg = kw.value
+                    if arg is None:
+                        continue
+                    values, _ = resolve_type_expr(
+                        arg, index, module, caller.node, caller.params)
+                    for v in sorted(values):
+                        key = (caller.name, v, call.lineno)
+                        if key not in bound_seen:
+                            bound_seen.add(key)
+                            caller.emissions.append(
+                                Emission(v, call.lineno, caller.name))
+                        bound_here = True
+            if not bound_here:
+                callee.unbound_param_sites.append((pname, lineno))
+                unbound += 1
+    return unbound
+
+
+def build_index(contexts) -> PackageIndex:
+    """``contexts`` — the engine's FileContext list (path/tree/lines)."""
+    index = PackageIndex(contexts=list(contexts))
+    # pass 1: constant tables (needed before any type expression resolves)
+    for ctx in contexts:
+        module = ModuleInfo(ctx.path, ctx.tree, ctx.aliases,
+                            _collect_module_consts(ctx.tree))
+        for name, value in module.constants.items():
+            index.global_consts.setdefault(name, set()).add(value)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                consts = _collect_class_consts(node)
+                if consts:
+                    index.class_consts.setdefault(node.name, {}).update(
+                        consts)
+        index.modules[ctx.path] = module
+    # pass 2: classes, methods, top-level functions, protocol surface
+    for ctx in contexts:
+        module = index.modules[ctx.path]
+        parents = ctx.parents
+        for node in ctx.tree.body if hasattr(ctx.tree, "body") else []:
+            if isinstance(node, astutil.FUNC_NODES):
+                # a free function cannot be call-site-bound, so any
+                # Message(<param>) site in it stays symbolic (dynamic)
+                module.functions.append(
+                    _build_method(node, index, module, parents))
+            if not isinstance(node, ast.ClassDef):
+                continue
+            cls = ClassInfo(node.name, ctx.path, node.lineno,
+                            [astutil.dotted_name(b, ctx.aliases)
+                             for b in node.bases],
+                            consts=index.class_consts.get(node.name, {}))
+            for stmt in node.body:
+                if isinstance(stmt, astutil.FUNC_NODES):
+                    cls.methods[stmt.name] = _build_method(
+                        stmt, index, module, parents)
+            cls.unbound_param_emissions = _bind_param_emissions(
+                cls, index, module)
+            module.classes.append(cls)
+    return index

@@ -1,0 +1,180 @@
+"""PROTO002 + FLOW001 — cross-file protocol rules over the package index.
+
+**PROTO002 (orphan wire traffic).**  Aggregated by WIRE VALUE across every
+manager class and comm backend — plus pure-sender helper classes and
+top-level driver functions, whose traffic counts even though they register
+nothing: a ``Message(TYPE, …)`` construction whose type no manager anywhere
+registers a handler for is dropped on arrival; a registered handler whose
+type no code path ever sends waits forever.  Conservatism: an unresolvable
+registration suppresses orphan-SEND verdicts (the dynamic handler could
+accept anything) and an unbindable parametric send suppresses
+orphan-HANDLER verdicts (the dynamic send could emit anything) — only
+provable one-sided traffic is flagged.
+
+**FLOW001 (protocol liveness).**  The manager fleet is modelled as a
+message-passing FSM: registered handlers are the transitions, ``Message``
+constructions the emissions, and the init states are the emissions
+reachable from each manager's entry methods (``run``/``start``/…) through
+intra-class ``self.*`` references (plain references count, so timer
+callbacks are reachable).  A fixpoint walk activates a handler when any
+reachable site emits its wire value, and activating a handler makes ITS
+emissions reachable.  Two liveness defects fall out:
+
+* a handler that stays inactive at fixpoint even though send sites for its
+  type exist — every send is itself unreachable from the init handshake,
+  so the protocol stalls before that state;
+* a ``*FINISH*`` wire value whose handler exists but whose every emission
+  is unreachable — rounds can run but never terminate.
+
+Known approximations (documented in docs/STATIC_ANALYSIS.md): reachability
+is per-class-closure (no cross-class data flow beyond the message graph),
+conditions on emissions are ignored (any branch counts as sendable), and
+all manager classes share one graph (a value aliased across two protocol
+families links them — the same wire-value aggregation PROTO001 uses).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Set, Tuple
+
+from ..findings import SEV_ERROR, Finding
+from ..rules import ProgramRule, register_program
+from .index import INIT_METHODS, ClassInfo, PackageIndex, class_closure
+
+
+@register_program
+class Proto002OrphanWire(ProgramRule):
+    id = "PROTO002"
+    severity = SEV_ERROR
+    title = "wire value sent with no registered handler (or vice versa)"
+
+    def check_program(self, index: PackageIndex) -> Iterable[Finding]:
+        t = index.aggregate_traffic()
+        sends, handlers = t.sends, t.handlers
+        out: List[Finding] = []
+        if not t.dynamic_handlers:
+            for value, sites in sorted(sends.items()):
+                if value in handlers:
+                    continue
+                for owner, path, method, lineno in sites:
+                    where = owner if owner.endswith("()") \
+                        else f"{owner}.{method}"
+                    out.append(Finding(
+                        self.id, self.severity, path, lineno, 0,
+                        f"{where} sends {value!r} but no "
+                        f"manager registers a handler for it — the message "
+                        f"is dropped on arrival"))
+        if not t.dynamic_sends:
+            for value, sites in sorted(handlers.items()):
+                if value in sends:
+                    continue
+                for owner, path, handler, lineno in sites:
+                    where = owner if owner.endswith("()") \
+                        else f"{owner}.{handler}"
+                    out.append(Finding(
+                        self.id, self.severity, path, lineno, 0,
+                        f"{where} handles {value!r} but no "
+                        f"code path ever sends it — the handler is dead "
+                        f"and any state waiting on it stalls"))
+        return out
+
+
+@register_program
+class Flow001ProtocolLiveness(ProgramRule):
+    id = "FLOW001"
+    severity = SEV_ERROR
+    title = "protocol state unreachable from the init handshake"
+
+    def check_program(self, index: PackageIndex) -> Iterable[Finding]:
+        managers = index.managers
+        # handler table: value → [(class, handler method)]
+        handler_table: Dict[str, List[Tuple[ClassInfo, str]]] = {}
+        for cls in managers:
+            for r in cls.registrations:
+                if r.value is not None:
+                    handler_table.setdefault(r.value, []).append(
+                        (cls, r.handler))
+        # all raw send sites by value (reachable or not)
+        send_sites: Dict[str, int] = {}
+        for cls in managers:
+            for e in cls.emissions:
+                send_sites[e.value] = send_sites.get(e.value, 0) + 1
+
+        # fixpoint: active methods per class + the set of sendable values
+        active: Set[Tuple[str, str]] = set()   # (class name, method)
+        sent: Set[str] = set()
+        # code outside the manager classes has no modelled entry point —
+        # assume it runs: its sends are init-reachable, and any symbolic
+        # send there could emit anything, which voids liveness verdicts
+        dynamic_reachable = False
+        for _owner, _path, mi, dyn in index.outside_senders():
+            for e in mi.emissions:
+                sent.add(e.value)
+                send_sites[e.value] = send_sites.get(e.value, 0) + 1
+            if dyn:
+                dynamic_reachable = True
+
+        def activate(cls: ClassInfo, roots: Iterable[str]) -> bool:
+            changed = False
+            for name in class_closure(cls, roots):
+                key = (cls.name, name)
+                if key in active:
+                    continue
+                active.add(key)
+                changed = True
+                for e in cls.methods[name].emissions:
+                    if e.value not in sent:
+                        sent.add(e.value)
+            return changed
+
+        for cls in managers:
+            activate(cls, INIT_METHODS)
+        changed = True
+        while changed:
+            changed = False
+            for value in list(sent):
+                for cls, handler in handler_table.get(value, ()):
+                    if (cls.name, handler) not in active:
+                        changed |= activate(cls, [handler])
+            # handlers with unresolvable types could fire on anything —
+            # treat them as reachable so downstream states stay live
+            for cls in managers:
+                for r in cls.registrations:
+                    if r.value is None and (cls.name, r.handler) not in active:
+                        changed |= activate(cls, [r.handler])
+
+        # a symbolic Message(<param>/<unresolvable>) site inside an ACTIVE
+        # method could emit any value — every "unreachable" verdict would
+        # be a guess (an unbound site in a method that never activates is
+        # itself unreachable and harmless)
+        for cls in managers:
+            for name, mi in cls.methods.items():
+                if (cls.name, name) in active and (
+                        mi.unresolved_emissions or mi.unbound_param_sites):
+                    dynamic_reachable = True
+        if dynamic_reachable:
+            return ()
+
+        out: List[Finding] = []
+        for cls in managers:
+            for r in cls.registrations:
+                # the verdict keys on the WIRE VALUE being reachably sent,
+                # not on handler activation — a handler inherited from a
+                # base class never appears in cls.methods, so activation
+                # would misreport it even when its message flows fine
+                if r.value is None or r.value in sent:
+                    continue
+                if not send_sites.get(r.value):
+                    continue  # nothing sends it at all → PROTO002's verdict
+                if "FINISH" in r.value:
+                    msg = (f"{cls.name} waits for {r.value!r} to terminate, "
+                           f"but every send of it is unreachable from the "
+                           f"init handshake — rounds can never finish")
+                else:
+                    msg = (f"{cls.name}.{r.handler} waits on {r.value!r}, "
+                           f"but every send of it is itself unreachable "
+                           f"from the init handshake — the protocol stalls "
+                           f"before this state")
+                out.append(Finding(self.id, self.severity, cls.path,
+                                   r.lineno, 0, msg))
+        return out

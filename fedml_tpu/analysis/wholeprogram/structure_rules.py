@@ -1,0 +1,363 @@
+"""SHARD001 + RES001 — sharding-spec and resource-lifecycle program rules.
+
+**SHARD001 (PartitionSpec/mesh consistency).**  Mesh axis declarations are
+collected PACKAGE-WIDE (``AXIS_*`` constants, literal ``Mesh(...,
+axis_names=…)`` tuples, ``build_mesh({...})`` dict keys); spec usage is
+checked in the sharded subsystems (``parallel/``, ``train/llm/``,
+``ml/engine/``) where a typo'd axis only explodes at trace time on real
+hardware:
+
+* a string literal (or a name resolving to one) inside ``PartitionSpec``/
+  ``P(...)`` that names no declared mesh axis;
+* ``shard_map(..., in_specs=…)`` whose literal spec tuple's arity differs
+  from the wrapped function's positional arity;
+* ``jax.jit(..., donate_argnums=…, in_shardings=…)`` donating an argument
+  index past the end of the ``in_shardings`` tuple.
+
+**RES001 (resource lifecycle).**
+
+* a ``threading.Thread`` that is neither daemonized nor joined anywhere in
+  its module outlives shutdown and leaks;
+* a comm-manager class that registers handlers but never calls
+  ``finish()`` — its receive loop cannot exit;
+* a ``raise`` (outside any ``try``) in handler-reachable code when the
+  comm base's ``receive_message`` dispatch is NOT guarded by a
+  try/finish — the exception strands every peer blocked on this node.
+  With the guarded dispatch in ``FedMLCommManager.receive_message`` the
+  check stays quiet; remove the guard and every raising handler lights up.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .. import astutil
+from ..findings import SEV_ERROR, SEV_WARNING, Finding
+from ..rules import ProgramRule, register_program
+from .index import PackageIndex, class_closure
+
+SHARD_SCOPES = ("parallel/", "train/llm/", "ml/engine/")
+
+
+def _in_shard_scope(path: str) -> bool:
+    return any(s in path for s in SHARD_SCOPES)
+
+
+def _literal_strs(node: ast.AST) -> List[str]:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return []
+
+
+def _dotted(node: ast.Call, ctx) -> str:
+    return astutil.call_name(node, ctx.aliases)
+
+
+def collect_declared_axes(index: PackageIndex, contexts) -> Set[str]:
+    axes: Set[str] = set()
+    for module in index.modules.values():
+        for name, value in module.constants.items():
+            if name.startswith("AXIS_"):
+                axes.add(value)
+    for ctx in contexts:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node, ctx)
+            tail = name.rsplit(".", 1)[-1]
+            if tail == "Mesh":
+                for kw in node.keywords:
+                    if kw.arg == "axis_names":
+                        axes.update(_literal_strs(kw.value))
+                if len(node.args) >= 2:
+                    axes.update(_literal_strs(node.args[1]))
+            elif tail in ("build_mesh", "build_hybrid_mesh"):
+                for arg in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    if isinstance(arg, ast.Dict):
+                        axes.update(k.value for k in arg.keys
+                                    if isinstance(k, ast.Constant)
+                                    and isinstance(k.value, str))
+    return axes
+
+
+def _resolve_str_name(name: str, ctx, enclosing: Optional[ast.AST],
+                      global_consts: Dict[str, Set[str]]) -> Optional[str]:
+    """Best-effort: a bare name → the string it denotes, else None."""
+    if enclosing is not None:
+        args = enclosing.args
+        pos = args.args
+        defaults = args.defaults
+        for a, d in zip(pos[len(pos) - len(defaults):], defaults):
+            if a.arg == name and isinstance(d, ast.Constant) \
+                    and isinstance(d.value, str):
+                return d.value
+        for stmt in ast.walk(enclosing):
+            if (isinstance(stmt, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == name
+                            for t in stmt.targets)
+                    and isinstance(stmt.value, ast.Constant)
+                    and isinstance(stmt.value.value, str)):
+                return stmt.value.value
+    vals = global_consts.get(name, set())
+    if len(vals) == 1:
+        return next(iter(vals))
+    return None
+
+
+@register_program
+class Shard001SpecMeshConsistency(ProgramRule):
+    id = "SHARD001"
+    severity = SEV_ERROR
+    title = "PartitionSpec/mesh contract violation in the sharded layers"
+
+    def check_program(self, index: PackageIndex) -> Iterable[Finding]:
+        contexts = getattr(index, "contexts", [])
+        axes = collect_declared_axes(index, contexts)
+        out: List[Finding] = []
+        for ctx in contexts:
+            if not _in_shard_scope(ctx.path):
+                continue
+            out.extend(self._check_specs(ctx, axes, index))
+            out.extend(self._check_shard_map_arity(ctx))
+            out.extend(self._check_donate(ctx))
+        return out
+
+    # -- undeclared axis names in P(...) -------------------------------------
+    def _check_specs(self, ctx, axes: Set[str],
+                     index: PackageIndex) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not _dotted(node, ctx).endswith("PartitionSpec"):
+                continue
+            enclosing = astutil.enclosing_function(node, ctx.parents)
+            for arg in node.args:
+                name: Optional[str] = None
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, str):
+                    name = arg.value
+                elif isinstance(arg, ast.Name):
+                    name = _resolve_str_name(arg.id, ctx, enclosing,
+                                             index.global_consts)
+                if name is not None and name not in axes:
+                    # NB: the declared-axes set must stay OUT of the
+                    # message — it feeds the baseline fingerprint, and an
+                    # unrelated module declaring a new axis would churn it
+                    yield Finding(
+                        self.id, self.severity, ctx.path, node.lineno, 0,
+                        f"PartitionSpec names mesh axis {name!r}, but no "
+                        f"mesh in the package declares it — this fails at "
+                        f"trace time on hardware (run `fedml lint --graph "
+                        f"json` or grep AXIS_*/Mesh(axis_names=...) for "
+                        f"the declared set)")
+
+    # -- shard_map in_specs arity --------------------------------------------
+    @staticmethod
+    def _spec_len(node: ast.AST, ctx,
+                  enclosing: Optional[ast.AST]) -> Optional[int]:
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return len(node.elts)
+        if isinstance(node, ast.Call):
+            # a bare P(...) is a legal pytree PREFIX that broadcasts over
+            # every positional arg — no arity can be concluded from it
+            return None
+        if isinstance(node, ast.Name):
+            scopes: List[ast.AST] = []
+            if enclosing is not None:
+                scopes.append(enclosing)
+            scopes.append(ctx.tree)
+            lens: Set[int] = set()
+            for scope in scopes:
+                for stmt in ast.walk(scope):
+                    if (isinstance(stmt, ast.Assign)
+                            and any(isinstance(t, ast.Name)
+                                    and t.id == node.id
+                                    for t in stmt.targets)
+                            and isinstance(stmt.value,
+                                           (ast.Tuple, ast.List))):
+                        lens.add(len(stmt.value.elts))
+                if lens:
+                    break
+            if len(lens) == 1:
+                return lens.pop()
+        return None
+
+    @staticmethod
+    def _fn_arity(fn: ast.AST) -> Optional[int]:
+        a = fn.args
+        if a.vararg is not None or a.kwonlyargs:
+            return None
+        pos = list(a.posonlyargs) + list(a.args)
+        return len([x for x in pos if x.arg != "self"])
+
+    def _check_shard_map_arity(self, ctx) -> Iterable[Finding]:
+        module_fns = {n.name: n for n in ast.walk(ctx.tree)
+                      if isinstance(n, astutil.FUNC_NODES)}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, astutil.FUNC_NODES):
+                continue
+            for dec in node.decorator_list:
+                if not (isinstance(dec, ast.Call) and dec.args):
+                    continue
+                dname = _dotted(dec, ctx)
+                inner = astutil.dotted_name(dec.args[0], ctx.aliases)
+                if not (dname.rsplit(".", 1)[-1] == "partial"
+                        and inner.endswith("shard_map")):
+                    continue
+                yield from self._arity_check(dec, node, ctx)
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Call)
+                    and _dotted(node, ctx).endswith("shard_map")
+                    and node.args
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in module_fns):
+                yield from self._arity_check(
+                    node, module_fns[node.args[0].id], ctx)
+
+    def _arity_check(self, call: ast.Call, fn: ast.AST,
+                     ctx) -> Iterable[Finding]:
+        in_specs = next((kw.value for kw in call.keywords
+                         if kw.arg == "in_specs"), None)
+        if in_specs is None:
+            return
+        enclosing = astutil.enclosing_function(call, ctx.parents)
+        n_specs = self._spec_len(in_specs, ctx, enclosing)
+        arity = self._fn_arity(fn)
+        if n_specs is not None and arity is not None and n_specs != arity:
+            yield Finding(
+                self.id, self.severity, ctx.path, call.lineno, 0,
+                f"shard_map in_specs has {n_specs} entries but "
+                f"{fn.name}() takes {arity} positional arguments — "
+                f"the spec/argument zip fails at trace time")
+
+    # -- donate_argnums past in_shardings ------------------------------------
+    def _check_donate(self, ctx) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = _dotted(node, ctx).rsplit(".", 1)[-1]
+            if tail not in ("jit", "pjit"):
+                continue
+            donate = next((kw.value for kw in node.keywords
+                           if kw.arg == "donate_argnums"), None)
+            shardings = next((kw.value for kw in node.keywords
+                              if kw.arg == "in_shardings"), None)
+            if donate is None or not isinstance(shardings,
+                                                (ast.Tuple, ast.List)):
+                continue
+            idxs = ([donate] if isinstance(donate, ast.Constant)
+                    else list(donate.elts)
+                    if isinstance(donate, (ast.Tuple, ast.List)) else [])
+            for idx in idxs:
+                if (isinstance(idx, ast.Constant)
+                        and isinstance(idx.value, int)
+                        and idx.value >= len(shardings.elts)):
+                    yield Finding(
+                        self.id, self.severity, ctx.path, node.lineno, 0,
+                        f"donate_argnums={idx.value} is past the end of "
+                        f"the {len(shardings.elts)}-entry in_shardings — "
+                        f"the donated buffer has no sharding spec")
+
+
+@register_program
+class Res001ResourceLifecycle(ProgramRule):
+    id = "RES001"
+    severity = SEV_WARNING
+    title = "leaked thread / receive loop that cannot exit"
+
+    def check_program(self, index: PackageIndex) -> Iterable[Finding]:
+        contexts = getattr(index, "contexts", [])
+        out: List[Finding] = []
+        for ctx in contexts:
+            out.extend(self._check_threads(ctx))
+        out.extend(self._check_managers(index))
+        return out
+
+    # -- thread lifecycle ----------------------------------------------------
+    @staticmethod
+    def _terminal(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Attribute):
+            return node.attr
+        return None
+
+    def _check_threads(self, ctx) -> Iterable[Finding]:
+        daemonized: Set[str] = set()
+        joined: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Attribute)
+                    and node.targets[0].attr == "daemon"
+                    and isinstance(node.value, ast.Constant)
+                    and node.value.value is True):
+                t = self._terminal(node.targets[0].value)
+                if t:
+                    daemonized.add(t)
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "join"):
+                t = self._terminal(node.func.value)
+                if t:
+                    joined.add(t)
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and _dotted(node, ctx).endswith("threading.Thread")):
+                continue
+            daemon_kw = next((kw.value for kw in node.keywords
+                              if kw.arg == "daemon"), None)
+            if daemon_kw is not None:
+                if not (isinstance(daemon_kw, ast.Constant)
+                        and daemon_kw.value is False):
+                    continue  # daemon=True, or dynamic — give it the benefit
+            parent = ctx.parents.get(node)
+            target: Optional[str] = None
+            if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+                target = self._terminal(parent.targets[0])
+            elif isinstance(parent, (ast.Tuple, ast.List)):
+                # comprehension/list element: can't track the binding; the
+                # collection is usually iterated for join — skip
+                continue
+            elif isinstance(parent, ast.ListComp):
+                continue
+            if target is not None and (target in daemonized
+                                       or target in joined):
+                continue
+            yield Finding(
+                self.id, self.severity, ctx.path, node.lineno, 0,
+                "threading.Thread is neither daemonized nor joined "
+                "anywhere in this module — it outlives shutdown and "
+                "leaks at interpreter exit")
+
+    # -- comm-manager lifecycle ----------------------------------------------
+    def _check_managers(self, index: PackageIndex) -> Iterable[Finding]:
+        guarded = index.dispatch_guarded()
+        for cls in index.managers:
+            if not cls.calls_finish():
+                yield Finding(
+                    self.id, self.severity, cls.path, cls.lineno, 0,
+                    f"{cls.name} registers message handlers but never "
+                    f"calls finish() — its receive loop cannot exit and "
+                    f"the node leaks its transport")
+            if guarded is not False:
+                # True → the base's dispatch provably cleans up; None → no
+                # comm base in scan scope (a --paths subset), where flagging
+                # would be a guess — only a PROVABLY unguarded base fires
+                continue
+            handler_roots = {r.handler for r in cls.registrations}
+            reachable = class_closure(cls, handler_roots)
+            for mname in sorted(reachable):
+                m = cls.methods.get(mname)
+                if m is None:
+                    continue
+                for lineno in m.raises_outside_try:
+                    yield Finding(
+                        self.id, self.severity, cls.path, lineno, 0,
+                        f"{cls.name}.{mname} can raise out of a message "
+                        f"handler and the comm base's receive_message "
+                        f"dispatch is not guarded — the receive loop dies "
+                        f"without finish() and peers stall forever")
+

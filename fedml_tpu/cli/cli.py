@@ -250,11 +250,18 @@ def job_logs(run_id: str, tail: int) -> None:
                    "the root; cheap enough for pre-commit)")
 @click.option("--rules", default=None,
               help="comma-separated rule ids to run (default: all)")
+@click.option("--whole-program", is_flag=True,
+              help="also run the cross-file pass (PROTO002 orphan wire "
+                   "traffic, FLOW001 protocol liveness, SHARD001 spec/mesh "
+                   "contracts, RES001 resource lifecycle)")
+@click.option("--graph", default=None,
+              type=click.Choice(["dot", "json"]),
+              help="emit the send/handle graph instead of linting")
 @click.option("--root", default=None, type=click.Path(exists=True),
               help="checkout root (default: the directory containing the "
                    "fedml_tpu package)")
 def lint(fmt: str, baseline: str, update_baseline: bool, paths,
-         rules: str, root: str) -> None:
+         rules: str, whole_program: bool, graph: str, root: str) -> None:
     """JAX-aware static analysis with a CI ratchet (docs/STATIC_ANALYSIS.md).
 
     Exit codes: 0 clean, 1 new (unbaselined) findings, 2 internal error."""
@@ -265,6 +272,7 @@ def lint(fmt: str, baseline: str, update_baseline: bool, paths,
     raise SystemExit(run_cli(
         root=root, paths=list(paths) or None, fmt=fmt, baseline=baseline,
         update_baseline=update_baseline, rule_ids=rule_ids,
+        whole_program=whole_program, graph=graph,
         echo=click.echo))
 
 

@@ -82,7 +82,20 @@ class FedMLCommManager(Observer):
             logging.warning("rank %d: no handler for msg_type %s",
                             self.rank, msg_type)
             return
-        handler(msg_params)
+        try:
+            handler(msg_params)
+        except Exception:
+            # a crashing handler must not strand the fleet: release THIS
+            # node's receive loop (and its transport) before propagating,
+            # or every peer blocked on a reply from us hangs forever
+            logging.exception("rank %d: handler for %s raised — closing "
+                              "the receive loop", self.rank, msg_type)
+            try:
+                self.finish()
+            except Exception:
+                logging.debug("rank %d: finish() during handler-failure "
+                              "cleanup also failed", self.rank)
+            raise
 
     # -- backend factory (reference :131-209) --------------------------------
     def _init_manager(self) -> None:

@@ -110,6 +110,37 @@ class LSAServerManager(FedMLCommManager):
             msg.get(LSAMessage.ARG_SHARE), np.int64)
         if len(self.agg_shares) < self.u:
             return
+        try:
+            self._reconstruct_and_advance()
+        except Exception:
+            # LCC decode failure is unrecoverable for the round — release
+            # the clients (they'd otherwise block on the next sync) before
+            # surfacing the error
+            logging.exception("LSA server: aggregate-mask reconstruction "
+                              "failed in round %s — aborting the run",
+                              self.args.round_idx)
+            self._abort_run()
+            raise
+
+    def _abort_run(self) -> None:
+        try:
+            self._broadcast_finish()
+        finally:
+            mlops.log_aggregation_status("FAILED")
+            self.finish()
+
+    def _broadcast_finish(self) -> None:
+        for r in range(1, self.client_num + 1):
+            try:
+                self.send_message(Message(LSAMessage.MSG_TYPE_S2C_FINISH,
+                                          self.get_sender_id(), r))
+            except Exception:
+                # best-effort: one dead transport must not strand the
+                # remaining clients without their FINISH
+                logging.exception("LSA server: FINISH to rank %d failed",
+                                  r)
+
+    def _reconstruct_and_advance(self) -> None:
         from ...core.mpc.secagg import FIELD_PRIME
 
         survivors = sorted(self.masked.keys())
@@ -133,9 +164,7 @@ class LSAServerManager(FedMLCommManager):
         self.agg_shares.clear()
         self.args.round_idx += 1
         if self.args.round_idx >= self.round_num:
-            for r in range(1, self.client_num + 1):
-                self.send_message(Message(LSAMessage.MSG_TYPE_S2C_FINISH,
-                                          self.get_sender_id(), r))
+            self._broadcast_finish()
             mlops.log_aggregation_status("FINISHED")
             self.finish()
             return

@@ -155,7 +155,34 @@ class SAServerManager(FedMLCommManager):
         self.reconstruction_replies += 1
         if self.reconstruction_replies < len(self.masked):
             return
-        self._unmask_and_advance()
+        try:
+            self._unmask_and_advance()
+        except Exception:
+            # reconstruction failure (below-threshold survivors, corrupt
+            # shares) is unrecoverable for the round — tell the clients to
+            # exit instead of leaving them blocked on a next-round sync
+            # that will never come, then surface the error
+            logging.exception("SA server: reconstruction failed in round "
+                              "%s — aborting the run", self.args.round_idx)
+            self._abort_run()
+            raise
+
+    def _abort_run(self) -> None:
+        try:
+            self._broadcast_finish()
+        finally:
+            mlops.log_aggregation_status("FAILED")
+            self.finish()
+
+    def _broadcast_finish(self) -> None:
+        for r in range(1, self.client_num + 1):
+            try:
+                self.send_message(Message(SAMessage.MSG_TYPE_S2C_FINISH,
+                                          self.get_sender_id(), r))
+            except Exception:
+                # best-effort: one dead transport must not strand the
+                # remaining clients without their FINISH
+                logging.exception("SA server: FINISH to rank %d failed", r)
 
     def _unmask_and_advance(self) -> None:
         active = sorted(self.masked.keys())
@@ -200,9 +227,7 @@ class SAServerManager(FedMLCommManager):
         self.reconstruction_replies = 0
         self.args.round_idx += 1
         if self.args.round_idx >= self.round_num:
-            for r in range(1, self.client_num + 1):
-                self.send_message(Message(SAMessage.MSG_TYPE_S2C_FINISH,
-                                          self.get_sender_id(), r))
+            self._broadcast_finish()
             mlops.log_aggregation_status("FINISHED")
             self.finish()
             return

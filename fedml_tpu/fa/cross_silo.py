@@ -132,8 +132,27 @@ def run_cross_silo_fa(args: Any, client_datasets: Dict[int, Sequence],
                                backend=backend)
                for rank, (_, data) in enumerate(
                    sorted(client_datasets.items()), start=1)]
-    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    threads = [threading.Thread(target=c.run, daemon=True,
+                                name=f"fa-client-{c.rank}") for c in clients]
     for t in threads:
         t.start()
-    server.run()
+    try:
+        server.run()
+    finally:
+        # reap the client loops instead of abandoning daemon threads (they
+        # hold comm queues that would otherwise outlive this call).  On the
+        # error path — the comm base's dispatch guard re-raises a handler
+        # crash out of run() — the clients never saw FA_FINISH, so stop
+        # their receive loops explicitly or the joins would time out
+        for c, t in zip(clients, threads):
+            if t.is_alive():
+                try:
+                    c.finish()
+                except Exception:
+                    # one client's broken transport must not abort the
+                    # sweep (or mask the original error from run())
+                    logging.exception("FA client %d: finish() during "
+                                      "teardown failed", c.rank)
+        for t in threads:
+            t.join(timeout=30)
     return server.result

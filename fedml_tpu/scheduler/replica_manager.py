@@ -82,7 +82,7 @@ class ReplicaProcessManager:
 
     def _wait_ready(self, rep: _Replica) -> None:
         deadline = time.time() + self.ready_timeout_s
-        while time.time() < deadline:
+        while time.time() < deadline and not self._stop.is_set():
             if rep.proc.poll() is not None:
                 raise RuntimeError(
                     f"replica on :{rep.port} exited rc={rep.proc.returncode}"
@@ -96,9 +96,12 @@ class ReplicaProcessManager:
             except Exception:  # noqa: BLE001 — still booting
                 time.sleep(0.1)
         # kill the half-booted child: leaving it running would squat the
-        # slot's port and leak a process
+        # slot's port and leak a process (shutdown mid-boot lands here too,
+        # so a closing manager never waits out the full ready timeout)
         self._kill(rep)
-        raise TimeoutError(f"replica on :{rep.port} never became ready")
+        raise TimeoutError(
+            f"replica on :{rep.port} never became ready"
+            + (" (shutdown requested)" if self._stop.is_set() else ""))
 
     def scale_to(self, n: int) -> int:
         """Grow/shrink to n replicas (the autoscaler's apply_fn).  Spawning
@@ -249,8 +252,18 @@ class ReplicaProcessManager:
         self._stop.set()
         if self._monitor is not None:
             self._monitor.join(timeout=5)
-        with self._lock:
-            for rep in self.replicas:
-                if rep is not None:
-                    self._kill(rep)
-            self.replicas = []
+            if self._monitor.is_alive():
+                logging.warning("replica monitor did not stop within 5s "
+                                "(mid-spawn); it will exit on its next "
+                                "tick")
+            self._monitor = None
+        # serialize with any in-flight scale_to/rolling_restart: their
+        # _wait_ready aborts promptly on _stop, and killing/clearing the
+        # slots under them would leak the replica they are about to
+        # install
+        with self._scale_lock:
+            with self._lock:
+                for rep in self.replicas:
+                    if rep is not None:
+                        self._kill(rep)
+                self.replicas = []

@@ -24,6 +24,7 @@ class FedMLInferenceRunner:
         self.host = host
         self.port = port
         self._server = None
+        self._serve_thread: Optional[threading.Thread] = None
 
     # -- fastapi path --------------------------------------------------------
     def _try_fastapi(self) -> bool:
@@ -112,8 +113,10 @@ class FedMLInferenceRunner:
         if block:
             self._server.serve_forever()
         else:
-            threading.Thread(target=self._server.serve_forever,
-                             daemon=True).start()
+            self._serve_thread = threading.Thread(
+                target=self._server.serve_forever, daemon=True,
+                name=f"inference-endpoint-{self.port}")
+            self._serve_thread.start()
 
     def run(self, block: bool = True, prefer_fastapi: bool = True) -> None:
         if prefer_fastapi and block and self._try_fastapi():
@@ -123,6 +126,14 @@ class FedMLInferenceRunner:
     def stop(self) -> None:
         if self._server is not None:
             self._server.shutdown()
+            if self._serve_thread is not None:
+                # reap the serve thread so stop() really means stopped —
+                # callers rebind the port right after
+                self._serve_thread.join(timeout=5)
+                self._serve_thread = None
+            # shutdown() only stops the accept loop; the listening socket
+            # stays bound until server_close() releases it
+            self._server.server_close()
 
 
 def serve_ephemeral(predictor: FedMLPredictor, host: str = "127.0.0.1",

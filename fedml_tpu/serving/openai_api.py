@@ -72,6 +72,7 @@ class OpenAIServer:
         self.host = host
         self.port = port
         self._server = None
+        self._serve_thread: Optional[threading.Thread] = None
 
     def run(self, block: bool = True) -> None:
         from http.server import BaseHTTPRequestHandler
@@ -181,9 +182,18 @@ class OpenAIServer:
         if block:
             self._server.serve_forever()
         else:
-            threading.Thread(target=self._server.serve_forever,
-                             daemon=True).start()
+            self._serve_thread = threading.Thread(
+                target=self._server.serve_forever, daemon=True,
+                name=f"openai-api-{self.port}")
+            self._serve_thread.start()
 
     def stop(self) -> None:
         if self._server is not None:
             self._server.shutdown()
+            if self._serve_thread is not None:
+                # reap the serve thread so stop() really means stopped
+                self._serve_thread.join(timeout=5)
+                self._serve_thread = None
+            # shutdown() only stops the accept loop; the listening socket
+            # stays bound until server_close() releases it
+            self._server.server_close()

@@ -185,3 +185,27 @@ def test_one_command_lifecycle(tmp_path):
     lg2 = kv_lm_from_checkpoint(str(v2_dir / "model.npz"),
                                 heads=4).full_logits(ids)
     assert float(np.abs(np.asarray(lg1) - np.asarray(lg2)).max()) > 1e-4
+
+
+def test_inference_runner_stop_releases_port():
+    """stop() must release the listening socket (shutdown + join is not
+    enough — only server_close() frees the fd), so the port can be
+    rebound immediately."""
+    import socket
+
+    from fedml_tpu.serving.fedml_inference_runner import serve_ephemeral
+    from fedml_tpu.serving.fedml_predictor import FedMLPredictor
+
+    class Echo(FedMLPredictor):
+        def predict(self, request):
+            return {"echo": request}
+
+    runner = serve_ephemeral(Echo())
+    port = runner.port
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/ready",
+                                timeout=5) as r:
+        assert json.loads(r.read())["ready"] is True
+    runner.stop()
+    with socket.socket() as s:  # rebinding the exact port must succeed
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", port))

@@ -1,0 +1,837 @@
+"""Whole-program lint: PROTO002/FLOW001/SHARD001/RES001, the package
+index, the send/handle graph export, baseline/fingerprint integration."""
+
+from __future__ import annotations
+
+import json
+import textwrap
+
+from fedml_tpu.analysis import run_cli, run_lint
+from fedml_tpu.analysis.engine import default_root
+from fedml_tpu.analysis.findings import fingerprints
+from fedml_tpu.analysis.wholeprogram import (
+    build_graph,
+    index_package,
+    to_dot,
+    to_json,
+)
+
+
+def _write(tmp_path, relpath: str, source: str):
+    f = tmp_path / relpath
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(source))
+    return f
+
+
+def _lint(tmp_path, rules):
+    return run_lint(root=tmp_path, rule_ids=rules,
+                    whole_program=True).findings
+
+
+def _ids(findings):
+    return [f.rule_id for f in findings]
+
+
+# -- fixture mini-package: a clean two-role protocol --------------------------
+
+BASE_GUARDED = """\
+    class Message:
+        def __init__(self, mtype, sender, receiver):
+            self.mtype = mtype
+
+    class BaseCommManager:
+        def __init__(self):
+            self.handlers = {}
+
+        def register_message_receive_handler(self, mtype, handler):
+            self.handlers[str(mtype)] = handler
+
+        def receive_message(self, mtype, msg):
+            handler = self.handlers.get(str(mtype))
+            try:
+                handler(msg)
+            except Exception:
+                self.finish()
+                raise
+
+        def send_message(self, msg):
+            pass
+
+        def finish(self):
+            pass
+"""
+
+BASE_UNGUARDED = """\
+    class Message:
+        def __init__(self, mtype, sender, receiver):
+            self.mtype = mtype
+
+    class BaseCommManager:
+        def __init__(self):
+            self.handlers = {}
+
+        def register_message_receive_handler(self, mtype, handler):
+            self.handlers[str(mtype)] = handler
+
+        def receive_message(self, mtype, msg):
+            self.handlers[str(mtype)](msg)
+
+        def send_message(self, msg):
+            pass
+
+        def finish(self):
+            pass
+"""
+
+DEFINE = """\
+    class MyMessage:
+        MSG_TYPE_C2S_HELLO = "C2S_HELLO"
+        MSG_TYPE_S2C_INIT = "S2C_INIT"
+        MSG_TYPE_C2S_UPLOAD = "C2S_UPLOAD"
+        MSG_TYPE_S2C_FINISH = "S2C_FINISH"
+"""
+
+SERVER = """\
+    from .base import BaseCommManager, Message
+    from .message_define import MyMessage
+
+    class ServerManager(BaseCommManager):
+        def register_message_receive_handlers(self):
+            self.register_message_receive_handler(
+                MyMessage.MSG_TYPE_C2S_HELLO, self.handle_hello)
+            self.register_message_receive_handler(
+                MyMessage.MSG_TYPE_C2S_UPLOAD, self.handle_upload)
+
+        def run(self):
+            self.register_message_receive_handlers()
+
+        def handle_hello(self, msg):
+            self._send_round(MyMessage.MSG_TYPE_S2C_INIT)
+
+        def _send_round(self, mtype):
+            self.send_message(Message(mtype, 0, 1))
+
+        def handle_upload(self, msg):
+            self.send_message(Message(MyMessage.MSG_TYPE_S2C_FINISH, 0, 1))
+            self.finish()
+"""
+
+CLIENT = """\
+    from .base import BaseCommManager, Message
+    from .message_define import MyMessage
+
+    class ClientManager(BaseCommManager):
+        def register_message_receive_handlers(self):
+            self.register_message_receive_handler(
+                MyMessage.MSG_TYPE_S2C_INIT, self.handle_init)
+            self.register_message_receive_handler(
+                MyMessage.MSG_TYPE_S2C_FINISH, self.handle_finish)
+
+        def run(self):
+            self.register_message_receive_handlers()
+            self.send_message(Message(MyMessage.MSG_TYPE_C2S_HELLO, 1, 0))
+
+        def handle_init(self, msg):
+            self.send_message(Message(MyMessage.MSG_TYPE_C2S_UPLOAD, 1, 0))
+
+        def handle_finish(self, msg):
+            self.finish()
+"""
+
+
+def _write_protocol(tmp_path, base=BASE_GUARDED, server=SERVER,
+                    client=CLIENT, define=DEFINE):
+    _write(tmp_path, "fedml_tpu/proto/__init__.py", "")
+    _write(tmp_path, "fedml_tpu/proto/base.py", base)
+    _write(tmp_path, "fedml_tpu/proto/message_define.py", define)
+    _write(tmp_path, "fedml_tpu/proto/server.py", server)
+    _write(tmp_path, "fedml_tpu/proto/client.py", client)
+
+
+# -- PROTO002: orphan wire traffic --------------------------------------------
+
+def test_proto002_clean_protocol_is_silent(tmp_path):
+    _write_protocol(tmp_path)
+    assert _lint(tmp_path, ["PROTO002", "FLOW001", "RES001"]) == []
+
+
+def test_proto002_flags_orphan_send(tmp_path):
+    server = SERVER.replace(
+        "self.send_message(Message(MyMessage.MSG_TYPE_S2C_FINISH, 0, 1))",
+        "self.send_message(Message(MyMessage.MSG_TYPE_S2C_FINISH, 0, 1))\n"
+        "            self.send_message(Message('S2C_EXTRA', 0, 1))")
+    _write_protocol(tmp_path, server=server)
+    found = _lint(tmp_path, ["PROTO002"])
+    assert _ids(found) == ["PROTO002"]
+    assert "'S2C_EXTRA'" in found[0].message
+    assert "dropped on arrival" in found[0].message
+    assert found[0].path == "fedml_tpu/proto/server.py"
+
+
+def test_proto002_flags_orphan_handler(tmp_path):
+    client = CLIENT.replace(
+        "self.register_message_receive_handler(\n"
+        "                MyMessage.MSG_TYPE_S2C_FINISH, self.handle_finish)",
+        "self.register_message_receive_handler(\n"
+        "                MyMessage.MSG_TYPE_S2C_FINISH, self.handle_finish)\n"
+        "            self.register_message_receive_handler(\n"
+        "                'S2C_NEVER_SENT', self.handle_finish)")
+    _write_protocol(tmp_path, client=client)
+    found = _lint(tmp_path, ["PROTO002"])
+    assert _ids(found) == ["PROTO002"]
+    assert "'S2C_NEVER_SENT'" in found[0].message
+    assert "no code path ever sends" in found[0].message
+
+
+def test_proto002_noqa_on_send_line(tmp_path):
+    server = SERVER.replace(
+        "self.send_message(Message(MyMessage.MSG_TYPE_S2C_FINISH, 0, 1))",
+        "self.send_message(Message(MyMessage.MSG_TYPE_S2C_FINISH, 0, 1))\n"
+        "            self.send_message(Message('S2C_EXTRA', 0, 1))"
+        "  # fedml: noqa[PROTO002] — consumed by an external native client")
+    _write_protocol(tmp_path, server=server)
+    res = run_lint(root=tmp_path, rule_ids=["PROTO002"], whole_program=True)
+    assert res.findings == [] and res.suppressed == 1
+
+
+def test_proto002_dynamic_registration_withholds_orphan_send(tmp_path):
+    # a handler registered with an unresolvable type could accept anything:
+    # the orphan-send verdict must be withheld, not guessed
+    client = CLIENT.replace(
+        "def run(self):",
+        "def register_dynamic(self, mtype):\n"
+        "            self.register_message_receive_handler(mtype, "
+        "self.handle_finish)\n\n"
+        "        def run(self):")
+    server = SERVER.replace(
+        "self.finish()",
+        "self.finish()\n"
+        "            self.send_message(Message('S2C_MYSTERY', 0, 1))")
+    _write_protocol(tmp_path, server=server, client=client)
+    assert _lint(tmp_path, ["PROTO002"]) == []
+
+
+def test_proto002_counts_sends_from_pure_sender_code(tmp_path):
+    # a helper class with no registrations and a top-level driver function
+    # both feed handlers — neither may leave the handler "dead"
+    _write(tmp_path, "fedml_tpu/proto/driver.py", """\
+        from .base import Message
+
+        class Announcer:
+            def announce(self, mgr):
+                mgr.send_message(Message("S2C_INIT", 0, 1))
+
+        def kick_off(mgr):
+            mgr.send_message(Message("S2C_FINISH", 0, 1))
+    """)
+    server = SERVER.replace(
+        "self._send_round(MyMessage.MSG_TYPE_S2C_INIT)", "pass").replace(
+        "self.send_message(Message(MyMessage.MSG_TYPE_S2C_FINISH, 0, 1))",
+        "pass")
+    _write_protocol(tmp_path, server=server)
+    # the client's S2C_INIT/S2C_FINISH handlers are fed only by the
+    # pure-sender module — no orphan-handler (or liveness) false positive
+    assert _lint(tmp_path, ["PROTO002", "FLOW001"]) == []
+
+
+def test_param_bound_sends_are_not_duplicated(tmp_path):
+    # two Message(<param>) sites in one helper, one call site: the bound
+    # emission must appear once, not once per site
+    server = SERVER.replace(
+        "def _send_round(self, mtype):\n"
+        "            self.send_message(Message(mtype, 0, 1))",
+        "def _send_round(self, mtype):\n"
+        "            self.send_message(Message(mtype, 0, 1))\n"
+        "            self.send_message(Message(mtype, 0, 2))")
+    orphan = server.replace(
+        "self._send_round(MyMessage.MSG_TYPE_S2C_INIT)",
+        "self._send_round('S2C_ORPHANED')")
+    _write_protocol(tmp_path, server=orphan)
+    found = [f for f in _lint(tmp_path, ["PROTO002"])
+             if "S2C_ORPHANED" in f.message]
+    assert len(found) == 1
+
+
+def test_bound_helper_in_pure_sender_class_keeps_verdicts(tmp_path):
+    # a NON-manager helper class using the bound Message(<param>) idiom is
+    # fully resolvable — it must not count as a dynamic send and disable
+    # orphan-handler verdicts package-wide
+    _write(tmp_path, "fedml_tpu/proto/helper.py", """\
+        from .base import Message
+
+        class Pinger:
+            def start(self, mgr):
+                self._send(mgr, "C2S_HELLO")
+
+            def _send(self, mgr, mtype):
+                mgr.send_message(Message(mtype, 0, 1))
+    """)
+    client = CLIENT.replace(
+        "self.register_message_receive_handler(\n"
+        "                MyMessage.MSG_TYPE_S2C_FINISH, self.handle_finish)",
+        "self.register_message_receive_handler(\n"
+        "                MyMessage.MSG_TYPE_S2C_FINISH, self.handle_finish)\n"
+        "            self.register_message_receive_handler(\n"
+        "                'S2C_DEAD', self.handle_finish)")
+    _write_protocol(tmp_path, client=client)
+    found = _lint(tmp_path, ["PROTO002"])
+    assert _ids(found) == ["PROTO002"]
+    assert "'S2C_DEAD'" in found[0].message
+
+
+def test_paths_subset_uses_full_package_index(tmp_path):
+    # cross-file verdicts need the whole program: linting ONE role of a
+    # clean protocol must not call its counterpart's traffic orphaned
+    _write_protocol(tmp_path)
+    res = run_lint(root=tmp_path, paths=["fedml_tpu/proto/server.py"],
+                   rule_ids=["PROTO002", "FLOW001"])
+    assert res.findings == []
+    assert res.files_scanned == 1
+    # and findings elsewhere in the package are filtered to the subset
+    server = SERVER.replace(
+        "self.finish()",
+        "self.finish()\n"
+        "            self.send_message(Message('S2C_EXTRA', 0, 1))")
+    _write(tmp_path, "fedml_tpu/proto/server.py", textwrap.dedent(server))
+    hit = run_lint(root=tmp_path, paths=["fedml_tpu/proto/server.py"],
+                   rule_ids=["PROTO002"]).findings
+    assert _ids(hit) == ["PROTO002"]
+    quiet = run_lint(root=tmp_path, paths=["fedml_tpu/proto/client.py"],
+                     rule_ids=["PROTO002"]).findings
+    assert quiet == []
+
+
+def test_full_scan_skips_crossfile_verdicts_when_a_file_breaks(tmp_path):
+    # full scan with a syntax-broken counterpart: the LINT001 fails the
+    # run, but NO false cross-file verdicts may appear (they would even
+    # poison the baseline via --update-baseline), and the skip is said
+    _write_protocol(tmp_path)
+    _write(tmp_path, "fedml_tpu/proto/client.py", "def broken(:\n")
+    res = run_lint(root=tmp_path, whole_program=True,
+                   rule_ids=["PROTO002", "FLOW001", "RES001"])
+    assert _ids(res.findings) == ["LINT001"]
+    assert any("cross-file rules skipped" in n for n in res.notes)
+    lines = []
+    assert run_cli(root=str(tmp_path), whole_program=True, fmt="json",
+                   echo=lines.append) == 1
+    report = json.loads("\n".join(lines))
+    assert any("cross-file rules skipped" in n for n in report["notes"])
+    assert not any(f["rule"].startswith(("PROTO002", "FLOW001"))
+                   for f in report["findings"])
+
+
+def test_update_baseline_refused_when_scan_is_incomplete(tmp_path):
+    # rewriting the SHARED baseline from a scan whose cross-file pass was
+    # skipped would silently drop every cross-file entry
+    _write_protocol(tmp_path)
+    _write(tmp_path, "fedml_tpu/proto/broken.py", "def broken(:\n")
+    lines = []
+    assert run_cli(root=str(tmp_path), whole_program=True,
+                   update_baseline=True, echo=lines.append) == 2
+    assert not (tmp_path / ".fedml-lint-baseline.json").exists()
+    assert any("incomplete" in line for line in lines)
+
+
+def test_graph_goes_conservative_on_unparsable_files(tmp_path):
+    # a broken file hides its handlers; the graph must not paint the
+    # now-unmatched traffic red (PROTO002 withholds those verdicts too)
+    server = SERVER.replace(
+        "self.finish()",
+        "self.finish()\n"
+        "            self.send_message(Message('S2C_EXTRA', 0, 1))")
+    _write_protocol(tmp_path, server=server)
+    _write(tmp_path, "fedml_tpu/proto/broken.py", "def broken(:\n")
+    g = build_graph(index_package(tmp_path))
+    assert g["orphan_sends"] == [] and g["orphan_handlers"] == []
+    assert any("could not be parsed" in n for n in g["notes"])
+    assert "// 1 file(s) could not be parsed" in to_dot(g)
+
+
+def test_paths_subset_stays_silent_when_counterpart_is_unparsable(tmp_path):
+    # a syntax-broken counterpart file hides its handlers from the index;
+    # emitting orphan verdicts for the subset would be guessing — the
+    # full scan reports the LINT001 and the cross-file findings together
+    _write_protocol(tmp_path)
+    _write(tmp_path, "fedml_tpu/proto/client.py", "def broken(:\n")
+    res = run_lint(root=tmp_path, paths=["fedml_tpu/proto/server.py"],
+                   rule_ids=["PROTO002", "FLOW001"])
+    assert res.findings == []
+
+
+def test_wp_rule_id_auto_enables_whole_program(tmp_path):
+    server = SERVER.replace(
+        "self.finish()",
+        "self.finish()\n"
+        "            self.send_message(Message('S2C_EXTRA', 0, 1))")
+    _write_protocol(tmp_path, server=server)
+    found = run_lint(root=tmp_path, rule_ids=["PROTO002"]).findings
+    assert _ids(found) == ["PROTO002"]
+
+
+def test_default_run_skips_whole_program_rules(tmp_path):
+    server = SERVER.replace(
+        "self.finish()",
+        "self.finish()\n"
+        "            self.send_message(Message('S2C_EXTRA', 0, 1))")
+    _write_protocol(tmp_path, server=server)
+    found = run_lint(root=tmp_path).findings
+    assert "PROTO002" not in _ids(found)
+
+
+# -- FLOW001: protocol liveness -----------------------------------------------
+
+def test_flow001_clean_handshake_through_param_binding(tmp_path):
+    # S2C_INIT is only ever sent as Message(<param>) inside _send_round;
+    # liveness must bind it at the handle_hello call site, or the clean
+    # protocol would be a false positive
+    _write_protocol(tmp_path)
+    assert _lint(tmp_path, ["FLOW001"]) == []
+
+
+STALLED_SERVER = """\
+    from .base import BaseCommManager, Message
+
+    class ServerManager(BaseCommManager):
+        def register_message_receive_handlers(self):
+            self.register_message_receive_handler("C2S_DONE", self.on_done)
+
+        def run(self):
+            self.register_message_receive_handlers()
+
+        def on_done(self, msg):
+            self.send_message(Message("S2C_GO", 0, 1))
+            self.finish()
+"""
+
+STALLED_CLIENT = """\
+    from .base import BaseCommManager, Message
+
+    class ClientManager(BaseCommManager):
+        def register_message_receive_handlers(self):
+            self.register_message_receive_handler("S2C_GO", self.on_go)
+
+        def run(self):
+            self.register_message_receive_handlers()
+
+        def on_go(self, msg):
+            self.send_message(Message("C2S_DONE", 1, 0))
+            self.finish()
+"""
+
+
+def test_flow001_flags_deadlocked_init(tmp_path):
+    # each side waits for the other to move first: every send site exists,
+    # none is reachable from run() — the classic stalled handshake
+    _write_protocol(tmp_path, server=STALLED_SERVER, client=STALLED_CLIENT)
+    found = _lint(tmp_path, ["FLOW001"])
+    assert _ids(found) == ["FLOW001", "FLOW001"]
+    assert all("unreachable from the init handshake" in f.message
+               for f in found)
+
+
+def test_flow001_finish_unreachable_gets_termination_message(tmp_path):
+    # nothing ever sends S2C_INIT, so the client's upload (and with it the
+    # server's FINISH broadcast) can never happen
+    client = CLIENT.replace(
+        'self.send_message(Message(MyMessage.MSG_TYPE_C2S_HELLO, 1, 0))',
+        "pass")
+    server = SERVER.replace(
+        "self._send_round(MyMessage.MSG_TYPE_S2C_INIT)", "pass")
+    _write_protocol(tmp_path, server=server, client=client)
+    found = _lint(tmp_path, ["FLOW001"])
+    msgs = " | ".join(f.message for f in found)
+    assert "rounds can never finish" in msgs
+    assert any(f.rule_id == "FLOW001" for f in found)
+
+
+def test_flow001_inherited_handler_is_not_a_stall(tmp_path):
+    # the FINISH handler method lives on the BASE class, so it never
+    # appears in the subclass's method table; the verdict must key on the
+    # wire value being reachably sent, not on handler activation
+    base = BASE_GUARDED.replace(
+        "        def finish(self):\n            pass",
+        "        def finish(self):\n            pass\n\n"
+        "        def on_finish_msg(self, msg):\n            self.finish()")
+    client = CLIENT.replace(
+        "MyMessage.MSG_TYPE_S2C_FINISH, self.handle_finish)",
+        "MyMessage.MSG_TYPE_S2C_FINISH, self.on_finish_msg)")
+    _write_protocol(tmp_path, base=base, client=client)
+    assert _lint(tmp_path, ["FLOW001"]) == []
+
+
+def test_keyword_bound_handler_registration_counts(tmp_path):
+    client = CLIENT.replace(
+        "self.register_message_receive_handler(\n"
+        "                MyMessage.MSG_TYPE_S2C_INIT, self.handle_init)",
+        "self.register_message_receive_handler(\n"
+        "                MyMessage.MSG_TYPE_S2C_INIT, "
+        "handler=self.handle_init)")
+    _write_protocol(tmp_path, client=client)
+    # S2C_INIT is handled (keyword-bound) — no orphan send, no stall, and
+    # the class still counts as a manager for the lifecycle checks
+    assert _lint(tmp_path, ["PROTO002", "FLOW001", "RES001"]) == []
+
+
+def test_keyword_message_construction_counts_as_send(tmp_path):
+    # Message(type=X, ...) is legal against the runtime ctor — it must
+    # feed the handler, not leave it "dead"
+    client = CLIENT.replace(
+        "self.send_message(Message(MyMessage.MSG_TYPE_C2S_UPLOAD, 1, 0))",
+        "self.send_message(Message(type=MyMessage.MSG_TYPE_C2S_UPLOAD, "
+        "sender_id=1, receiver_id=0))")
+    _write_protocol(tmp_path, client=client)
+    assert _lint(tmp_path, ["PROTO002", "FLOW001"]) == []
+
+
+def test_fully_keyword_bound_registration_counts(tmp_path):
+    client = CLIENT.replace(
+        "self.register_message_receive_handler(\n"
+        "                MyMessage.MSG_TYPE_S2C_INIT, self.handle_init)",
+        "self.register_message_receive_handler(\n"
+        "                msg_type=MyMessage.MSG_TYPE_S2C_INIT, "
+        "handler=self.handle_init)")
+    _write_protocol(tmp_path, client=client)
+    assert _lint(tmp_path, ["PROTO002", "FLOW001", "RES001"]) == []
+
+
+def test_flow001_noqa(tmp_path):
+    _write_protocol(tmp_path, server=STALLED_SERVER,
+                    client=STALLED_CLIENT.replace(
+                        'self.register_message_receive_handler('
+                        '"S2C_GO", self.on_go)',
+                        'self.register_message_receive_handler('
+                        '"S2C_GO", self.on_go)'
+                        '  # fedml: noqa[FLOW001] — driven by an ops tool'))
+    found = _lint(tmp_path, ["FLOW001"])
+    # only the server-side registration is still flagged
+    assert len(found) == 1 and found[0].path.endswith("server.py")
+
+
+# -- SHARD001: PartitionSpec/mesh contracts -----------------------------------
+
+SHARD_OK = """\
+    from functools import partial
+
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    AXIS_MODEL = "model"
+
+    def build(devs):
+        return Mesh(devs, axis_names=("data", "model"))
+
+    def good_spec():
+        return P(None, "model")
+
+    def wrap(mesh):
+        spec = P("data")
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                 out_specs=spec)
+        def attn(q, k, v):
+            return q
+        return attn
+"""
+
+
+def test_shard001_clean_module_is_silent(tmp_path):
+    _write(tmp_path, "fedml_tpu/parallel/mod.py", SHARD_OK)
+    assert _lint(tmp_path, ["SHARD001"]) == []
+
+
+def test_shard001_flags_undeclared_axis(tmp_path):
+    _write(tmp_path, "fedml_tpu/parallel/mod.py",
+           SHARD_OK.replace('P(None, "model")', 'P(None, "modle")'))
+    found = _lint(tmp_path, ["SHARD001"])
+    assert _ids(found) == ["SHARD001"]
+    assert "'modle'" in found[0].message
+
+
+def test_shard001_axis_check_scoped_to_sharded_layers(tmp_path):
+    # the same typo outside parallel//train/llm//ml/engine is not scanned
+    _write(tmp_path, "fedml_tpu/data/mod.py",
+           SHARD_OK.replace('P(None, "model")', 'P(None, "modle")'))
+    assert _lint(tmp_path, ["SHARD001"]) == []
+
+
+def test_shard001_flags_in_specs_arity_mismatch(tmp_path):
+    _write(tmp_path, "fedml_tpu/parallel/mod.py",
+           SHARD_OK.replace("in_specs=(spec, spec, spec)",
+                            "in_specs=(spec, spec)"))
+    found = _lint(tmp_path, ["SHARD001"])
+    assert _ids(found) == ["SHARD001"]
+    assert "2 entries" in found[0].message and "3 positional" \
+        in found[0].message
+
+
+def test_shard001_single_spec_broadcast_is_legal(tmp_path):
+    # in_specs=P(...) is a pytree PREFIX that broadcasts over all args —
+    # no arity conclusion may be drawn from it
+    _write(tmp_path, "fedml_tpu/parallel/mod.py",
+           SHARD_OK.replace("in_specs=(spec, spec, spec)",
+                            'in_specs=P("data")'))
+    assert _lint(tmp_path, ["SHARD001"]) == []
+
+
+def test_shard001_flags_donate_past_in_shardings(tmp_path):
+    _write(tmp_path, "fedml_tpu/train/llm/mod.py", """\
+        import jax
+
+        def jit_it(fn, x_sh):
+            return jax.jit(fn, donate_argnums=(2,),
+                           in_shardings=(x_sh, x_sh))
+    """)
+    found = _lint(tmp_path, ["SHARD001"])
+    assert _ids(found) == ["SHARD001"]
+    assert "donate_argnums=2" in found[0].message
+
+
+def test_shard001_noqa(tmp_path):
+    _write(tmp_path, "fedml_tpu/parallel/mod.py",
+           SHARD_OK.replace(
+               'P(None, "model")',
+               'P(None, "modle")  # fedml: noqa[SHARD001] — axis added '
+               'by the caller\'s mesh'))
+    res = run_lint(root=tmp_path, rule_ids=["SHARD001"], whole_program=True)
+    assert res.findings == [] and res.suppressed == 1
+
+
+# -- RES001: resource lifecycle -----------------------------------------------
+
+def test_res001_flags_unjoined_nondaemon_thread(tmp_path):
+    _write(tmp_path, "fedml_tpu/svc.py", """\
+        import threading
+
+        def leak():
+            t = threading.Thread(target=print)
+            t.start()
+    """)
+    found = _lint(tmp_path, ["RES001"])
+    assert _ids(found) == ["RES001"]
+    assert "neither daemonized nor joined" in found[0].message
+
+
+def test_res001_silent_when_daemonized_or_joined(tmp_path):
+    _write(tmp_path, "fedml_tpu/svc.py", """\
+        import threading
+
+        def ok_daemon():
+            t = threading.Thread(target=print, daemon=True)
+            t.start()
+
+        def ok_joined():
+            t2 = threading.Thread(target=print)
+            t2.start()
+            t2.join()
+
+        def ok_attr():
+            worker = threading.Thread(target=print)
+            worker.daemon = True
+            worker.start()
+    """)
+    assert _lint(tmp_path, ["RES001"]) == []
+
+
+def test_res001_flags_manager_without_finish(tmp_path):
+    _write(tmp_path, "fedml_tpu/mgr.py", """\
+        class NoExitManager:
+            def register_message_receive_handlers(self):
+                self.register_message_receive_handler("GO", self.on_go)
+
+            def on_go(self, msg):
+                pass
+    """)
+    found = _lint(tmp_path, ["RES001"])
+    assert _ids(found) == ["RES001"]
+    assert "never calls finish()" in found[0].message
+
+
+def test_res001_flags_handler_raise_with_unguarded_base(tmp_path):
+    server = SERVER.replace(
+        "def handle_upload(self, msg):",
+        "def handle_upload(self, msg):\n"
+        "            if msg is None:\n"
+        "                raise RuntimeError('bad upload')")
+    _write_protocol(tmp_path, base=BASE_UNGUARDED, server=server)
+    found = _lint(tmp_path, ["RES001"])
+    assert _ids(found) == ["RES001"]
+    assert "receive_message" in found[0].message
+    assert found[0].path == "fedml_tpu/proto/server.py"
+
+
+def test_res001_guarded_base_silences_handler_raises(tmp_path):
+    # with the comm base's dispatch wrapped in try→finish, a raising
+    # handler no longer strands peers — the finding must disappear
+    server = SERVER.replace(
+        "def handle_upload(self, msg):",
+        "def handle_upload(self, msg):\n"
+        "            if msg is None:\n"
+        "                raise RuntimeError('bad upload')")
+    _write_protocol(tmp_path, base=BASE_GUARDED, server=server)
+    assert _lint(tmp_path, ["RES001"]) == []
+
+
+def test_res001_noqa(tmp_path):
+    _write(tmp_path, "fedml_tpu/svc.py", """\
+        import threading
+
+        def leak():
+            t = threading.Thread(target=print)  # fedml: noqa[RES001] — ref
+            t.start()
+    """)
+    res = run_lint(root=tmp_path, rule_ids=["RES001"], whole_program=True)
+    assert res.findings == [] and res.suppressed == 1
+
+
+# -- baseline ratchet + fingerprint stability ---------------------------------
+
+def test_whole_program_findings_share_the_baseline_ratchet(tmp_path):
+    server = SERVER.replace(
+        "self.finish()",
+        "self.finish()\n"
+        "            self.send_message(Message('S2C_EXTRA', 0, 1))")
+    _write_protocol(tmp_path, server=server)
+    quiet = lambda *_: None  # noqa: E731
+    assert run_cli(root=str(tmp_path), whole_program=True,
+                   update_baseline=True, echo=quiet) == 0
+    assert run_cli(root=str(tmp_path), whole_program=True, echo=quiet) == 0
+    # a NEW orphan fails the ratchet; the baselined one stays quiet
+    client = CLIENT.replace(
+        "def run(self):",
+        "def run_extra(self):\n"
+        "            self.send_message(Message('C2S_SURPRISE', 1, 0))\n\n"
+        "        def run(self):")
+    _write(tmp_path, "fedml_tpu/proto/client.py", client)
+    out = []
+    assert run_cli(root=str(tmp_path), whole_program=True,
+                   echo=out.append) == 1
+    rendered = "\n".join(out)
+    assert "C2S_SURPRISE" in rendered and "S2C_EXTRA" not in rendered
+
+
+def test_crossfile_fingerprints_stable_under_unrelated_churn(tmp_path):
+    server = SERVER.replace(
+        "self.finish()",
+        "self.finish()\n"
+        "            self.send_message(Message('S2C_EXTRA', 0, 1))")
+    _write_protocol(tmp_path, server=server)
+    _write(tmp_path, "fedml_tpu/parallel/mod.py",
+           SHARD_OK.replace('P(None, "model")', 'P(None, "modle")'))
+    rules = ["PROTO002", "FLOW001", "SHARD001"]
+    before = {fp for _, fp in fingerprints(_lint(tmp_path, rules))}
+    assert len(before) == 2  # the orphan send + the bad axis
+    # line drift in the flagged file + a brand-new unrelated module (which
+    # even declares a NEW mesh axis) must not churn a single fingerprint —
+    # the committed baseline would break
+    sf = tmp_path / "fedml_tpu/proto/server.py"
+    sf.write_text("# an unrelated header comment\n\n" + sf.read_text())
+    _write(tmp_path, "fedml_tpu/unrelated.py",
+           "AXIS_EXTRA = \"extra_axis\"\n\n\ndef helper():\n    return 1\n")
+    after = {fp for _, fp in fingerprints(_lint(tmp_path, rules))}
+    assert before == after
+
+
+# -- graph export --------------------------------------------------------------
+
+def test_graph_dot_renders_cross_silo_topology():
+    index = index_package(default_root())
+    dot = to_dot(build_graph(index))
+    assert dot.startswith("digraph send_handle {") and dot.endswith("}")
+    assert '"FedMLServerManager"' in dot and '"ClientMasterManager"' in dot
+    assert ('"FedMLServerManager" -> "ClientMasterManager" '
+            '[label="S2C_INIT_CONFIG"]') in dot
+    assert ('"ClientMasterManager" -> "FedMLServerManager" '
+            '[label="C2S_SEND_MODEL_TO_SERVER"]') in dot
+    # the repo protocol is orphan-free: no red dangling traffic
+    assert "no handler" not in dot and "no sender" not in dot
+
+
+def test_graph_json_schema_and_orphans(tmp_path):
+    server = SERVER.replace(
+        "self.finish()",
+        "self.finish()\n"
+        "            self.send_message(Message('S2C_EXTRA', 0, 1))")
+    _write_protocol(tmp_path, server=server)
+    g = build_graph(index_package(tmp_path))
+    assert g["version"] == 1 and g["tool"] == "fedml-lint-graph"
+    names = {n["name"] for n in g["nodes"]}
+    assert {"ServerManager", "ClientManager"} <= names
+    roles = {n["name"]: n["role"] for n in g["nodes"]}
+    assert roles["ServerManager"] == "server"
+    assert roles["ClientManager"] == "client"
+    assert g["orphan_sends"] == ["S2C_EXTRA"]
+    assert ("no handler" in to_dot(g))
+    json.loads(to_json(g))  # round-trips
+
+
+def test_graph_with_paths_still_indexes_whole_package(tmp_path):
+    # --paths narrows what is DISPLAYED, not what is analyzed: the server
+    # subset must still show resolved contracts and its counterpart
+    _write_protocol(tmp_path)
+    lines = []
+    assert run_cli(root=str(tmp_path), graph="json",
+                   paths=["fedml_tpu/proto/server.py"],
+                   echo=lines.append) == 0
+    g = json.loads("\n".join(lines))
+    names = {n["name"] for n in g["nodes"]}
+    assert "ServerManager" in names
+    assert "ClientManager" in names  # counterpart of a displayed edge
+    assert any(e["value"] == "C2S_HELLO" for e in g["edges"])
+    assert g["orphan_sends"] == [] and g["orphan_handlers"] == []
+
+
+def test_graph_orphans_mirror_proto002_conservatism(tmp_path):
+    # one dynamic registration withholds PROTO002's orphan-send verdicts;
+    # the graph must not render red traffic the rule will never flag
+    client = CLIENT.replace(
+        "def run(self):",
+        "def register_dynamic(self, mtype):\n"
+        "            self.register_message_receive_handler(mtype, "
+        "self.handle_finish)\n\n"
+        "        def run(self):")
+    server = SERVER.replace(
+        "self.finish()",
+        "self.finish()\n"
+        "            self.send_message(Message('S2C_MYSTERY', 0, 1))")
+    _write_protocol(tmp_path, server=server, client=client)
+    g = build_graph(index_package(tmp_path))
+    assert g["orphan_sends"] == []  # matches the withheld PROTO002 verdict
+
+
+def test_graph_cli_modes(tmp_path):
+    _write_protocol(tmp_path)
+    lines = []
+    assert run_cli(root=str(tmp_path), graph="dot",
+                   echo=lines.append) == 0
+    assert lines and lines[0].startswith("digraph send_handle")
+    lines = []
+    assert run_cli(root=str(tmp_path), graph="json",
+                   echo=lines.append) == 0
+    parsed = json.loads("\n".join(lines))
+    assert parsed["tool"] == "fedml-lint-graph"
+    # a typo'd --paths must error out, not render an empty digraph
+    assert run_cli(root=str(tmp_path), graph="dot",
+                   paths=["fedml_tpu/tpyo"], echo=lambda *_: None) == 2
+    # a './'-prefixed path must match after normalization, not go empty
+    lines = []
+    assert run_cli(root=str(tmp_path), graph="json",
+                   paths=["./fedml_tpu/proto/server.py"],
+                   echo=lines.append) == 0
+    assert "ServerManager" in {n["name"] for n in
+                               json.loads("\n".join(lines))["nodes"]}
+    # flags the graph mode would silently ignore are refused instead
+    assert run_cli(root=str(tmp_path), graph="dot", update_baseline=True,
+                   echo=lambda *_: None) == 2
+
+
+# -- the repo itself: clean under the committed baseline, inside budget -------
+
+def test_repo_whole_program_clean_under_budget():
+    root = default_root()
+    code = run_cli(root=str(root), whole_program=True,
+                   echo=lambda *_: None)
+    assert code == 0, "new unbaselined whole-program findings in the repo"
+    res = run_lint(root=root, whole_program=True)
+    assert res.duration_s < 60.0
+    assert res.files_scanned > 150
