@@ -69,6 +69,24 @@ _DEFAULTS: Dict[str, Any] = {
     "backend": SIMULATION_BACKEND_SP,
     "grpc_ipconfig_path": None,
     "grpc_base_port": 8890,
+    "grpc_send_retries": 3,
+    "grpc_retry_backoff_s": 0.5,
+    "grpc_send_timeout_s": 600.0,
+    # robustness: reliability runtime (ACK/retransmit/dedup above any
+    # backend), heartbeat failure detection, crash-resume (docs/ROBUSTNESS.md)
+    "reliable": False,
+    "reliable_retx_initial_s": 0.1,
+    "reliable_retx_max_s": 2.0,
+    "reliable_deadline_s": 30.0,
+    "reliable_flush_s": 5.0,
+    "reliable_dedup_window": 1024,
+    "heartbeat_interval_s": 0.0,     # 0 disables the failure detector
+    "heartbeat_miss_threshold": 3,
+    "lsa_share_wait_s": 30.0,        # LSA share-holder give-up deadline
+    "checkpoint_dir": None,          # enables per-round crash-resume state
+    "resume_from": None,             # "latest" or a round index
+    "round_timeout_s": 0.0,          # elastic round timer (0 disables)
+    "min_clients_per_round": 1,
     # tracking_args
     "enable_tracking": True,
     "log_file_dir": None,

@@ -60,13 +60,33 @@ def diagnosis(config, checks) -> None:
               help="fedml_config.yaml")
 @click.option("--rank", default=0)
 @click.option("--role", default=None)
-def run(config: str, rank: int, role: str) -> None:
+@click.option("--reliable/--no-reliable", "reliable", default=None,
+              help="wrap the comm backend in the reliability runtime "
+                   "(ACK/retransmit/dedup — effectively-once delivery)")
+@click.option("--heartbeat-interval-s", default=None, type=float,
+              help="client heartbeat period; enables the server's "
+                   "failure detector (0 = off)")
+@click.option("--checkpoint-dir", default=None,
+              help="directory for per-round crash-resume checkpoints")
+@click.option("--resume-from", default=None,
+              help="resume the server from checkpoint state: 'latest' or "
+                   "a round index (requires --checkpoint-dir)")
+def run(config: str, rank: int, role: str, reliable, heartbeat_interval_s,
+        checkpoint_dir, resume_from) -> None:
     """Run a training config (reference `fedml run` / launchers)."""
     import fedml_tpu
 
     overrides = {"rank": rank}
     if role:
         overrides["role"] = role
+    if reliable is not None:
+        overrides["reliable"] = reliable
+    if heartbeat_interval_s is not None:
+        overrides["heartbeat_interval_s"] = heartbeat_interval_s
+    if checkpoint_dir is not None:
+        overrides["checkpoint_dir"] = checkpoint_dir
+    if resume_from is not None:
+        overrides["resume_from"] = resume_from
     args = fedml_tpu.init(fedml_tpu.Config.from_yaml(config, overrides))
     device = fedml_tpu.device.get_device(args)
     dataset = fedml_tpu.data.load(args)

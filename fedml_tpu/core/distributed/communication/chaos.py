@@ -50,29 +50,53 @@ class ChaosCommManager(BaseCommunicationManager):
 
     # -- chaos on the SEND side ---------------------------------------------
     def send_message(self, msg: Message) -> None:
-        self.stats["sent"] += 1
+        # stats are mutated from every concurrent sender thread (handlers,
+        # retransmit loops, timers) — ``_rng_lock`` guards them alongside
+        # the RNG so counts stay exact under contention
+        with self._rng_lock:
+            self.stats["sent"] += 1
         if str(msg.get_type()) in self.protect_types:
             self.inner.send_message(msg)
             return
         with self._rng_lock:
-            roll_drop = self.rng.rand()
-            roll_dup = self.rng.rand()
-            roll_delay = self.rng.rand()
-            delay = self.rng.rand() * self.max_delay_s
-        if roll_drop < self.drop_p:
-            self.stats["dropped"] += 1
+            duplicated = self.rng.rand() < self.dup_p
+            if duplicated:
+                self.stats["duplicated"] += 1
+        self._chaos_send(msg)
+        if duplicated:
+            # the copy rolls its OWN drop/delay, so a duplicate can arrive
+            # before, after, or instead of the original — real-network
+            # reordering, not a deterministic immediate echo
+            self._chaos_send(msg)
+
+    def _chaos_send(self, msg: Message) -> None:
+        """One delivery attempt through the drop → delay pipeline."""
+        with self._rng_lock:
+            dropped = self.rng.rand() < self.drop_p
+            delayed = (not dropped) and self.rng.rand() < self.delay_p
+            delay_s = self.rng.rand() * self.max_delay_s
+            if dropped:
+                self.stats["dropped"] += 1
+            elif delayed:
+                self.stats["delayed"] += 1
+        if dropped:
             logging.debug("chaos: DROP %s", msg.get_type())
             return
-        if roll_delay < self.delay_p:
-            self.stats["delayed"] += 1
-            t = threading.Timer(delay, self.inner.send_message, args=(msg,))
+        if delayed:
+            t = threading.Timer(delay_s, self._timer_send, args=(msg,))
             t.daemon = True
             t.start()
         else:
             self.inner.send_message(msg)
-        if roll_dup < self.dup_p:
-            self.stats["duplicated"] += 1
+
+    def _timer_send(self, msg: Message) -> None:
+        try:
             self.inner.send_message(msg)
+        except Exception:  # noqa: BLE001 — a dead transport on a timer
+            # thread has no caller to propagate to; the message is lost,
+            # which is exactly what chaos models
+            logging.debug("chaos: delayed send of %s failed",
+                          msg.get_type(), exc_info=True)
 
     # -- passthrough ---------------------------------------------------------
     def add_observer(self, observer: Observer) -> None:

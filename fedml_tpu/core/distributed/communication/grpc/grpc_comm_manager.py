@@ -16,16 +16,23 @@ import csv
 import logging
 import os
 import queue
+import random
 import threading
+import time
 from concurrent import futures
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 import grpc
 
+from ....mlops import metrics
 from .....utils.serialization import message_from_wire, message_to_wire
 from ..base_com_manager import BaseCommunicationManager
 from ..message import Message
 from ..observer import Observer
+
+_send_retries_total = metrics.counter(
+    "fedml_grpc_send_retries_total",
+    "gRPC unary sends retried after a channel error", labels=("rank",))
 
 _SERVICE = "fedml_tpu.Comm"
 _METHOD = "Send"
@@ -55,6 +62,17 @@ class GRPCCommManager(BaseCommunicationManager):
         self._observers: List[Observer] = []
         self._q: "queue.Queue" = queue.Queue()
         self._running = False
+        # transient-failure policy: one blocking unary with no retry (the
+        # reference behavior) turns a TCP blip into a dead round — a failed
+        # send raises inside the handler thread and the comm base tears the
+        # node down.  Retry channel errors with exponential backoff + jitter
+        # before surfacing; permanent failures still raise.
+        self.send_retries = int(getattr(args, "grpc_send_retries", 3) or 0)
+        self.retry_backoff_s = float(
+            getattr(args, "grpc_retry_backoff_s", 0.5) or 0.5)
+        self.send_timeout_s = float(
+            getattr(args, "grpc_send_timeout_s", 600) or 600)
+        self._chan_lock = threading.Lock()
 
         handler = grpc.method_handlers_generic_handler(_SERVICE, {
             _METHOD: grpc.unary_unary_rpc_method_handler(
@@ -69,6 +87,7 @@ class GRPCCommManager(BaseCommunicationManager):
         self.server.add_insecure_port(f"{host}:{self.port}")
         self.server.start()
         self._channels: Dict[int, grpc.Channel] = {}
+        self._stubs: Dict[int, Any] = {}
         logging.info("gRPC rank %d serving on port %d", self.rank, self.port)
 
     @staticmethod
@@ -95,18 +114,68 @@ class GRPCCommManager(BaseCommunicationManager):
         self._q.put(msg)
         return b"ok"
 
+    def _stub_for(self, receiver: int) -> Any:
+        """Per-channel cached callable — rebuilding the ``unary_unary``
+        stub on every send costs an allocation + method registration per
+        message for no benefit."""
+        with self._chan_lock:
+            stub = self._stubs.get(receiver)
+            if stub is None:
+                ch = grpc.insecure_channel(self._addr_for(receiver),
+                                           options=_CHANNEL_OPTIONS)
+                self._channels[receiver] = ch
+                stub = ch.unary_unary(f"/{_SERVICE}/{_METHOD}",
+                                      request_serializer=_ident,
+                                      response_deserializer=_ident)
+                self._stubs[receiver] = stub
+            return stub
+
+    def _drop_channel(self, receiver: int) -> None:
+        with self._chan_lock:
+            self._stubs.pop(receiver, None)
+            ch = self._channels.pop(receiver, None)
+        if ch is not None:
+            try:
+                ch.close()
+            except Exception:  # noqa: BLE001 — already broken
+                pass
+
+    #: codes worth a reconnect-and-retry.  CANCELLED is included because a
+    #: concurrent sender's _drop_channel can close the shared channel out
+    #: from under an in-flight RPC.  Everything else — including
+    #: DEADLINE_EXCEEDED (the 600 s default would stack into an hours-long
+    #: handler stall) and deterministic failures like INVALID_ARGUMENT or
+    #: RESOURCE_EXHAUSTED (message too large) — surfaces immediately
+    _RETRYABLE_CODES = (grpc.StatusCode.UNAVAILABLE,
+                        grpc.StatusCode.UNKNOWN,
+                        grpc.StatusCode.CANCELLED)
+
     # -- BaseCommunicationManager -------------------------------------------
     def send_message(self, msg: Message) -> None:
         receiver = msg.get_receiver_id()
-        ch = self._channels.get(receiver)
-        if ch is None:
-            ch = grpc.insecure_channel(self._addr_for(receiver),
-                                       options=_CHANNEL_OPTIONS)
-            self._channels[receiver] = ch
-        stub = ch.unary_unary(f"/{_SERVICE}/{_METHOD}",
-                              request_serializer=_ident,
-                              response_deserializer=_ident)
-        stub(message_to_wire(msg.get_params()), timeout=600)
+        payload = message_to_wire(msg.get_params())
+        attempt = 0
+        while True:
+            try:
+                self._stub_for(receiver)(payload, timeout=self.send_timeout_s)
+                return
+            except grpc.RpcError as e:
+                attempt += 1
+                code = e.code() if hasattr(e, "code") else None
+                if (attempt > self.send_retries
+                        or code not in self._RETRYABLE_CODES):
+                    raise
+                _send_retries_total.labels(rank=str(self.rank)).inc()
+                # a failed unary may leave the cached channel wedged
+                # (TRANSIENT_FAILURE) — rebuild it for the retry
+                self._drop_channel(receiver)
+                delay = min(8.0, self.retry_backoff_s * (2 ** (attempt - 1)))
+                delay *= 0.5 + random.random() / 2.0
+                logging.warning(
+                    "gRPC rank %d: send %s → %d failed (%s); retry %d/%d "
+                    "in %.2fs", self.rank, msg.get_type(), receiver, code,
+                    attempt, self.send_retries, delay)
+                time.sleep(delay)
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)
@@ -128,3 +197,15 @@ class GRPCCommManager(BaseCommunicationManager):
     def stop_receive_message(self) -> None:
         self._running = False
         self._q.put(None)
+        # release every client channel so the sockets are returned to the
+        # OS (mirrors the server_close() fixes: a long-lived process that
+        # cycles runs must not accumulate half-open HTTP/2 connections)
+        with self._chan_lock:
+            channels = list(self._channels.values())
+            self._channels.clear()
+            self._stubs.clear()
+        for ch in channels:
+            try:
+                ch.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass

@@ -49,6 +49,19 @@ class InProcHub:
             else:
                 cls._hubs.pop(channel, None)
 
+    @classmethod
+    def release(cls, channel: str, hub: "InProcHub") -> None:
+        """Identity-guarded reset: drop ``channel`` from the registry only
+        if it still maps to ``hub``.  Finishing nodes call this on run
+        teardown so a run's queued stale messages can't leak into a later
+        same-process run with the same run_id — while a NEW run that
+        already re-created the channel is left untouched (every node of
+        the finishing run holds a direct ``hub`` reference, so in-flight
+        delivery within that run is unaffected by the registry drop)."""
+        with cls._lock:
+            if cls._hubs.get(channel) is hub:
+                cls._hubs.pop(channel, None)
+
     def queue_for(self, rank: int) -> "queue.Queue":
         with self._qlock:
             q = self.queues.get(rank)
@@ -61,6 +74,7 @@ class InProcCommManager(BaseCommunicationManager):
     def __init__(self, rank: int, size: int, channel: str = "default") -> None:
         self.rank = int(rank)
         self.size = int(size)
+        self.channel = str(channel)
         self.hub = InProcHub.get(channel)
         self._observers: List[Observer] = []
         self._running = False

@@ -1,0 +1,336 @@
+"""Reliability runtime — effectively-once delivery above any backend.
+
+NEW capability (SURVEY §5: the reference inherits FedML's weakest property —
+one dropped, duplicated or delayed control message strands a federated run).
+``ReliableCommManager`` wraps any ``BaseCommunicationManager`` (INPROC, GRPC,
+MQTT_S3, chaos, custom) and turns at-most-once / at-least-once transports
+into *effectively-once* delivery, uniformly and above the backend — the same
+ACK/retransmit/dedup triangle ``mini_mqtt.py`` implements inside the MQTT
+wire protocol for QoS1, lifted to the framework's Message envelope:
+
+* every outgoing data message is stamped with a monotonically increasing
+  ``rel_seq`` and the sender's ``rel_epoch`` (rolled at construction, so a
+  restarted sender never collides with its previous incarnation);
+* the receiving wrapper ACKs on delivery (before observer dispatch, so a
+  slow handler never causes spurious retransmits);
+* un-ACKed messages are retransmitted with exponential backoff + jitter
+  until a configurable deadline, then dropped with a warning — the elastic
+  round timer / failure detector is the recovery layer past that point;
+* duplicates (retransmits whose original survived, or transport-level dups)
+  are suppressed by a per-peer LRU dedup window keyed on (epoch, seq); a
+  duplicate is re-ACKed — the first ACK may be the frame that was lost.
+
+Messages carrying ``rel_volatile`` (heartbeats) and messages from peers
+without the wrapper pass through untouched, so mixed deployments interop.
+
+Shutdown is *drain-aware*: ``stop_receive_message()`` flags the manager as
+closing but defers stopping the inner transport to the retransmit thread
+until the in-flight window is empty (or a flush deadline passes).  This
+matters because ``finish()`` is typically called from inside a handler — on
+the very thread that runs the receive loop — so blocking there would
+deadlock the ACK path; deferring keeps the loop alive to absorb the final
+ACKs (e.g. for the FINISH broadcast) and only then releases it.
+
+Composition order is the test harness's adversary seam::
+
+    ReliableCommManager(ChaosCommManager(InProcCommManager(...)))
+
+puts the fault injector *under* the reliability plane, so ACKs and
+retransmits traverse the lossy link too — the chaos plane proves the
+reliability plane correct (see tests/test_reliability.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+from ...mlops import metrics
+from .base_com_manager import BaseCommunicationManager
+from .message import Message
+from .observer import Observer
+
+#: wire type of the delivery acknowledgement (consumed by the wrapper, never
+#: dispatched to observers; fire-and-forget — a lost ACK is repaired by the
+#: data retransmit → re-ACK cycle)
+MSG_TYPE_RELIABLE_ACK = "REL_ACK"
+
+#: envelope keys stamped onto data messages
+ARG_SEQ = "rel_seq"
+ARG_EPOCH = "rel_epoch"
+ARG_ACK_SEQ = "rel_ack_seq"
+ARG_ACK_EPOCH = "rel_ack_epoch"
+#: senders set this param to opt a message out of ACK/retransmit/dedup
+#: (periodic signals like heartbeats, where the next one supersedes a loss)
+ARG_VOLATILE = "rel_volatile"
+
+def envelope_key(msg: Message) -> Optional[tuple]:
+    """(sender, epoch, seq) of a stamped message, or None when unstamped.
+    Used by the comm base to dedup retransmits even on nodes running
+    WITHOUT the wrapper (a --reliable peer keeps retransmitting until its
+    deadline when nobody ACKs; without receiver-side dedup each copy would
+    re-trigger the handler — e.g. a full redundant training pass)."""
+    seq = msg.get(ARG_SEQ)
+    if seq is None:
+        return None
+    return (msg.get_sender_id(), int(msg.get(ARG_EPOCH, 0)), int(seq))
+
+
+_sent_total = metrics.counter(
+    "fedml_reliable_sent_total",
+    "Data messages stamped and tracked by the reliability runtime",
+    labels=("rank",))
+_retransmits_total = metrics.counter(
+    "fedml_reliable_retransmits_total",
+    "Un-ACKed messages retransmitted by the reliability runtime",
+    labels=("rank",))
+_dup_suppressed_total = metrics.counter(
+    "fedml_reliable_dup_suppressed_total",
+    "Duplicate deliveries suppressed by the per-peer dedup window",
+    labels=("rank",))
+_expired_total = metrics.counter(
+    "fedml_reliable_expired_total",
+    "Messages dropped after exhausting the retransmit deadline",
+    labels=("rank",))
+_acks_sent_total = metrics.counter(
+    "fedml_reliable_acks_sent_total", "Delivery ACKs sent",
+    labels=("rank",))
+_inflight_gauge = metrics.gauge(
+    "fedml_reliable_inflight", "Messages awaiting ACK right now",
+    labels=("rank",))
+
+
+class ReliableCommManager(BaseCommunicationManager, Observer):
+    def __init__(self, inner: BaseCommunicationManager, rank: int = 0,
+                 retx_initial_s: float = 0.1, retx_max_s: float = 2.0,
+                 retx_deadline_s: float = 30.0, flush_timeout_s: float = 5.0,
+                 dedup_window: int = 1024, jitter: float = 0.25,
+                 seed: Optional[int] = None) -> None:
+        self.inner = inner
+        self.rank = int(rank)
+        # epoch distinguishes THIS incarnation of the sender from a crashed
+        # predecessor: a restarted peer starts seq over, and stale ACKs /
+        # dedup hits from the previous life must not apply to the new one
+        self.epoch = time.time_ns() % (1 << 31)
+        self.retx_initial_s = float(retx_initial_s)
+        self.retx_max_s = float(retx_max_s)
+        self.retx_deadline_s = float(retx_deadline_s)
+        self.flush_timeout_s = float(flush_timeout_s)
+        self.dedup_window = int(dedup_window)
+        self.jitter = float(jitter)
+        self._rng = random.Random(self.rank if seed is None else seed)
+        self._lock = threading.Lock()
+        self._seq = 0
+        #: seq → [msg, next_retx_at, attempts, expire_at]
+        self._inflight: Dict[int, list] = {}
+        #: sender rank → LRU{(epoch, seq): True}
+        self._seen: Dict[int, "OrderedDict"] = {}
+        self._observers: List[Observer] = []
+        self._retx_thread: Optional[threading.Thread] = None
+        self._wake = threading.Event()
+        self._closing = False
+        self._close_at: Optional[float] = None
+        self._stopped = False
+        self.stats = {"sent": 0, "retransmits": 0, "dup_suppressed": 0,
+                      "expired": 0, "acks_sent": 0}
+        self._rank_label = str(self.rank)
+        self.inner.add_observer(self)
+
+    @classmethod
+    def from_args(cls, inner: BaseCommunicationManager, args: Any,
+                  rank: int = 0) -> "ReliableCommManager":
+        """Build from the flat config namespace (``--reliable`` knobs)."""
+        return cls(
+            inner, rank=rank,
+            retx_initial_s=float(
+                getattr(args, "reliable_retx_initial_s", 0.1) or 0.1),
+            retx_max_s=float(
+                getattr(args, "reliable_retx_max_s", 2.0) or 2.0),
+            retx_deadline_s=float(
+                getattr(args, "reliable_deadline_s", 30.0) or 30.0),
+            flush_timeout_s=float(
+                getattr(args, "reliable_flush_s", 5.0) or 5.0),
+            dedup_window=int(
+                getattr(args, "reliable_dedup_window", 1024) or 1024))
+
+    # -- send path -----------------------------------------------------------
+    def send_message(self, msg: Message) -> None:
+        if (str(msg.get_type()) == MSG_TYPE_RELIABLE_ACK
+                or msg.get(ARG_VOLATILE)):
+            self.inner.send_message(msg)
+            return
+        with self._lock:
+            if msg.get(ARG_SEQ) is None:
+                self._seq += 1
+                msg.add_params(ARG_SEQ, self._seq)
+                msg.add_params(ARG_EPOCH, self.epoch)
+            seq = int(msg.get(ARG_SEQ))
+            now = time.monotonic()
+            self._inflight[seq] = [msg, now + self._delay_for(0), 0,
+                                   now + self.retx_deadline_s]
+            self.stats["sent"] += 1
+            n_inflight = len(self._inflight)
+            self._ensure_retx_thread()
+        _sent_total.labels(rank=self._rank_label).inc()
+        _inflight_gauge.labels(rank=self._rank_label).set(n_inflight)
+        try:
+            self.inner.send_message(msg)
+        except Exception:
+            # transient transport failure: the message is already in the
+            # in-flight window, so the retransmit loop owns recovery
+            logging.warning(
+                "reliable[%d]: initial send of seq=%d (%s) failed; "
+                "retransmitting", self.rank, seq, msg.get_type(),
+                exc_info=True)
+
+    def _delay_for(self, attempt: int) -> float:
+        base = min(self.retx_max_s, self.retx_initial_s * (2 ** attempt))
+        return base * (1.0 + self.jitter * self._rng.random())
+
+    def _ensure_retx_thread(self) -> None:
+        """Caller holds ``_lock``."""
+        if self._retx_thread is None or not self._retx_thread.is_alive():
+            self._retx_thread = threading.Thread(
+                target=self._retx_loop, daemon=True,
+                name=f"reliable-retx-{self.rank}")
+            self._retx_thread.start()
+
+    def _retx_loop(self) -> None:
+        tick = max(self.retx_initial_s / 2.0, 0.01)
+        while True:
+            self._wake.wait(timeout=tick)
+            self._wake.clear()
+            now = time.monotonic()
+            resend, expired = [], []
+            with self._lock:
+                for seq, ent in list(self._inflight.items()):
+                    if now >= ent[3]:
+                        expired.append((seq, ent[0]))
+                        del self._inflight[seq]
+                        self.stats["expired"] += 1
+                    elif now >= ent[1]:
+                        ent[2] += 1
+                        ent[1] = now + self._delay_for(ent[2])
+                        resend.append(ent[0])
+                        self.stats["retransmits"] += 1
+                n_inflight = len(self._inflight)
+                close_now = self._closing and (
+                    not self._inflight
+                    or (self._close_at is not None and now >= self._close_at))
+            _inflight_gauge.labels(rank=self._rank_label).set(n_inflight)
+            for seq, msg in expired:
+                _expired_total.labels(rank=self._rank_label).inc()
+                logging.warning(
+                    "reliable[%d]: giving up on seq=%d (%s → %d) after %.1fs "
+                    "without ACK — recovery is now the round timer / failure "
+                    "detector's job", self.rank, seq, msg.get_type(),
+                    msg.get_receiver_id(), self.retx_deadline_s)
+            for msg in resend:
+                _retransmits_total.labels(rank=self._rank_label).inc()
+                try:
+                    self.inner.send_message(msg)
+                except Exception:
+                    logging.debug("reliable[%d]: retransmit of %s failed; "
+                                  "will retry", self.rank, msg.get_type(),
+                                  exc_info=True)
+            if close_now:
+                if n_inflight:
+                    logging.warning(
+                        "reliable[%d]: closing with %d messages still "
+                        "un-ACKed (flush window exhausted)", self.rank,
+                        n_inflight)
+                self._stop_inner()
+                return
+
+    # -- receive path (observer of the inner transport) ----------------------
+    def receive_message(self, msg_type: str, msg: Message) -> None:
+        if str(msg_type) == MSG_TYPE_RELIABLE_ACK:
+            if int(msg.get(ARG_ACK_EPOCH, -1)) == self.epoch:
+                with self._lock:
+                    self._inflight.pop(int(msg.get(ARG_ACK_SEQ, -1)), None)
+                    n_inflight = len(self._inflight)
+                _inflight_gauge.labels(rank=self._rank_label).set(n_inflight)
+                if n_inflight == 0:
+                    self._wake.set()     # may unblock a draining close
+            return
+        seq = msg.get(ARG_SEQ)
+        if seq is None:
+            # volatile or sent by a peer without the wrapper: pass through
+            self._dispatch(msg_type, msg)
+            return
+        sender = msg.get_sender_id()
+        key = (int(msg.get(ARG_EPOCH, 0)), int(seq))
+        # ACK first — even for duplicates: a re-delivery means the sender
+        # never saw our previous ACK
+        self._send_ack(sender, key[0], key[1])
+        with self._lock:
+            lru = self._seen.setdefault(sender, OrderedDict())
+            duplicate = key in lru
+            lru[key] = True
+            lru.move_to_end(key)
+            while len(lru) > self.dedup_window:
+                lru.popitem(last=False)
+            if duplicate:
+                self.stats["dup_suppressed"] += 1
+        if duplicate:
+            _dup_suppressed_total.labels(rank=self._rank_label).inc()
+            logging.debug("reliable[%d]: suppressed duplicate %s from %d "
+                          "(epoch=%d seq=%d)", self.rank, msg_type, sender,
+                          key[0], key[1])
+            return
+        self._dispatch(msg_type, msg)
+
+    def _send_ack(self, sender: int, epoch: int, seq: int) -> None:
+        ack = Message(MSG_TYPE_RELIABLE_ACK, self.rank, sender)
+        ack.add_params(ARG_ACK_EPOCH, epoch)
+        ack.add_params(ARG_ACK_SEQ, seq)
+        with self._lock:
+            self.stats["acks_sent"] += 1
+        _acks_sent_total.labels(rank=self._rank_label).inc()
+        try:
+            self.inner.send_message(ack)
+        except Exception:
+            # a lost ACK costs one retransmit round-trip, nothing more
+            logging.debug("reliable[%d]: ACK to %d failed", self.rank,
+                          sender, exc_info=True)
+
+    def _dispatch(self, msg_type: str, msg: Message) -> None:
+        for obs in list(self._observers):
+            obs.receive_message(msg_type, msg)
+
+    # -- BaseCommunicationManager --------------------------------------------
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        if observer in self._observers:
+            self._observers.remove(observer)
+
+    def handle_receive_message(self) -> None:
+        self.inner.handle_receive_message()
+
+    def stop_receive_message(self) -> None:
+        with self._lock:
+            if self._stopped or self._closing:
+                return
+            self._closing = True
+            self._close_at = time.monotonic() + self.flush_timeout_s
+            drain = (bool(self._inflight) and self._retx_thread is not None
+                     and self._retx_thread.is_alive())
+        if drain:
+            # the retransmit thread keeps the inner loop alive until the
+            # window drains (absorbing the final ACKs), then stops it
+            self._wake.set()
+        else:
+            self._stop_inner()
+
+    def _stop_inner(self) -> None:
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+        self.inner.stop_receive_message()

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import logging
 import threading
+from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional
 
 from .communication.base_com_manager import BaseCommunicationManager
@@ -42,7 +43,19 @@ class FedMLCommManager(Observer):
         self.comm = comm
         self.com_manager: Optional[BaseCommunicationManager] = None
         self.message_handler_dict: Dict[str, Callable[[Message], None]] = {}
+        self._seen_envelopes: "OrderedDict" = OrderedDict()
         self._init_manager()
+        # mixed-deployment interop: when a PEER runs --reliable and this
+        # node doesn't, the peer's delivery ACKs reach the dispatch layer;
+        # they carry no payload for us, but each would log a
+        # missing-handler warning — swallow them explicitly.  Registered
+        # here (not in run()) because several managers inline their own
+        # run loop.  (With the local wrapper active, ACKs are consumed
+        # below and never get here.)
+        from .communication.reliable import MSG_TYPE_RELIABLE_ACK
+
+        self.register_message_receive_handler(
+            MSG_TYPE_RELIABLE_ACK, self._handle_stray_reliable_ack)
 
     # -- lifecycle -----------------------------------------------------------
     def run(self) -> None:
@@ -61,6 +74,22 @@ class FedMLCommManager(Observer):
     def finish(self) -> None:
         logging.debug("rank %d finishing", self.rank)
         self.com_manager.stop_receive_message()
+        self._release_inproc_channel()
+
+    def _release_inproc_channel(self) -> None:
+        """INPROC teardown: drop this run's channel from the hub registry so
+        queued stale messages can't leak into a later same-process run that
+        reuses the run_id.  Identity-guarded — a new run that already
+        re-created the channel is untouched; wrappers (reliable/chaos) are
+        unwound via their ``inner`` chain."""
+        from .communication.inprocess import InProcCommManager, InProcHub
+
+        cm: Any = self.com_manager
+        while cm is not None:
+            if isinstance(cm, InProcCommManager):
+                InProcHub.release(cm.channel, cm.hub)
+                return
+            cm = getattr(cm, "inner", None)
 
     # -- messaging -----------------------------------------------------------
     def get_sender_id(self) -> int:
@@ -76,7 +105,30 @@ class FedMLCommManager(Observer):
     def register_message_receive_handlers(self) -> None:
         """Subclasses register their typed handlers here."""
 
+    def _handle_stray_reliable_ack(self, msg: Message) -> None:
+        logging.debug("rank %d: dropping reliability ACK from %d (peer "
+                      "runs --reliable, this node does not)", self.rank,
+                      msg.get_sender_id())
+
     def receive_message(self, msg_type: str, msg_params: Message) -> None:
+        from .communication.reliable import envelope_key
+
+        key = envelope_key(msg_params)
+        if key is not None:
+            # reliability-envelope dedup for nodes running WITHOUT the
+            # wrapper: a --reliable peer retransmits until its deadline
+            # when nobody ACKs; each copy reaching the handler would redo
+            # real work (retrain, re-upload).  With the local wrapper
+            # active duplicates are consumed below and this LRU never hits.
+            if key in self._seen_envelopes:
+                logging.debug("rank %d: dropping duplicate %s from %d "
+                              "(reliability envelope %s)", self.rank,
+                              msg_type, msg_params.get_sender_id(), key)
+                return
+            self._seen_envelopes[key] = True
+            self._seen_envelopes.move_to_end(key)
+            while len(self._seen_envelopes) > 1024:
+                self._seen_envelopes.popitem(last=False)
         handler = self.message_handler_dict.get(str(msg_type))
         if handler is None:
             logging.warning("rank %d: no handler for msg_type %s",
@@ -141,4 +193,12 @@ class FedMLCommManager(Observer):
             raise ValueError(
                 f"unknown comm backend {self.backend!r}; register custom "
                 f"backends via register_comm_backend()")
+        if getattr(self.args, "reliable", False):
+            # reliability runtime (--reliable): ACK/retransmit/dedup above
+            # whichever backend was just built, custom ones included —
+            # every transport becomes effectively-once
+            from .communication.reliable import ReliableCommManager
+
+            self.com_manager = ReliableCommManager.from_args(
+                self.com_manager, self.args, rank=self.rank)
         self.com_manager.add_observer(self)

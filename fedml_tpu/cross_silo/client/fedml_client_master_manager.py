@@ -8,11 +8,14 @@ training via TrainerDistAdapter, uploads (weights, n_samples).
 from __future__ import annotations
 
 import logging
+import threading
+import time
 from typing import Any
 
 from ...core import mlops
 from ...core.mlops import tracing
 from ...core.distributed.communication.message import Message
+from ...core.distributed.communication.reliable import ARG_VOLATILE
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
 from ..message_define import MyMessage
 from .trainer_dist_adapter import TrainerDistAdapter
@@ -27,6 +30,7 @@ class ClientMasterManager(FedMLCommManager):
         self.num_rounds = int(args.comm_round)
         self._compressor = None  # built lazily when enable_compression
         self.round_idx = 0
+        self._hb_stop = threading.Event()
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -40,7 +44,38 @@ class ClientMasterManager(FedMLCommManager):
     def run(self) -> None:
         self.register_message_receive_handlers()
         self.send_client_status(0)
+        self._start_heartbeat()
         self.com_manager.handle_receive_message()
+
+    def finish(self) -> None:
+        self._hb_stop.set()
+        super().finish()
+
+    # -- liveness ------------------------------------------------------------
+    def _start_heartbeat(self) -> None:
+        """Periodic heartbeat to the server's failure detector.  Volatile
+        on the reliable plane: the next beat supersedes a lost one, so
+        retransmitting a stale heartbeat would only add noise."""
+        interval = float(getattr(self.args, "heartbeat_interval_s", 0) or 0)
+        if interval <= 0:
+            return
+
+        def _loop() -> None:
+            while not self._hb_stop.wait(interval):
+                try:
+                    msg = Message(MyMessage.MSG_TYPE_HEARTBEAT,
+                                  self.get_sender_id(), 0)
+                    msg.add_params(MyMessage.MSG_ARG_KEY_HEARTBEAT_TS,
+                                   time.time())
+                    msg.add_params(ARG_VOLATILE, True)
+                    self.send_message(msg)
+                except Exception:  # noqa: BLE001 — a failed beat is a
+                    # missed beat, nothing to escalate from here
+                    logging.debug("client %d: heartbeat send failed",
+                                  self.rank, exc_info=True)
+
+        threading.Thread(target=_loop, daemon=True,
+                         name=f"heartbeat-{self.rank}").start()
 
     # -- protocol ------------------------------------------------------------
     def send_client_status(self, receiver_id: int,

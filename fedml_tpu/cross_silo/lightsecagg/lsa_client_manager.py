@@ -9,7 +9,8 @@ held shares for the surviving set.
 from __future__ import annotations
 
 import logging
-from typing import Any, Dict
+import threading
+from typing import Any, Dict, Optional
 
 import numpy as np
 
@@ -30,7 +31,22 @@ class LSAClientManager(FedMLCommManager):
         self.adapter = trainer_dist_adapter
         self.round_idx = 0
         self.proto: Dict[str, int] = {}
-        self.received_shares: Dict[int, np.ndarray] = {}  # sender rank → share
+        #: round → {sender rank → encoded share}.  Keyed by ROUND: on a
+        #: reordering transport a fast peer's next-round share can overtake
+        #: this client's own sync, and a flat table would wipe it
+        self.received_shares: Dict[int, Dict[int, np.ndarray]] = {}
+        # deferred server request (round, survivors): the agg-mask request
+        # can overtake the last peer's C2C share — answer only once every
+        # survivor's share is held.  Bounded: if a share never arrives
+        # (lost past the reliable plane's retransmit deadline), a timer
+        # sends the server an explicit "unavailable" reply so it can ask
+        # the next share-holder instead of deadlocking the cohort.  The
+        # lock covers the timer thread racing the receive-loop thread.
+        self._pending_agg_request = None
+        self._req_lock = threading.Lock()
+        self._req_timer: Optional[threading.Timer] = None
+        self._share_wait_s = float(
+            getattr(args, "lsa_share_wait_s", 30.0) or 30.0)
         self._rng = np.random.RandomState(
             int(getattr(args, "random_seed", 0) or 0) * 1000 + rank)
 
@@ -61,12 +77,19 @@ class LSAClientManager(FedMLCommManager):
         self._train_mask_upload(msg)
 
     def handle_sync(self, msg: Message) -> None:
-        self.received_shares = {}
         self._train_mask_upload(msg)
 
     def _train_mask_upload(self, msg: Message) -> None:
         client_index = msg.get(LSAMessage.ARG_CLIENT_INDEX)
         self.round_idx = int(msg.get(LSAMessage.ARG_ROUND, 0))
+        # retire state from completed rounds (early-arrived shares for the
+        # current/future rounds are kept)
+        self.received_shares = {r: v for r, v in self.received_shares.items()
+                                if r >= self.round_idx}
+        with self._req_lock:
+            if (self._pending_agg_request is not None
+                    and self._pending_agg_request[0] < self.round_idx):
+                self._clear_pending_request()
         self.adapter.update_dataset(int(client_index))
         self.adapter.update_model(msg.get(LSAMessage.ARG_MODEL_PARAMS))
         weights, n_samples = self.adapter.train(self.round_idx)
@@ -86,11 +109,14 @@ class LSAClientManager(FedMLCommManager):
         for j in range(n):
             peer_rank = j + 1
             if peer_rank == self.rank:
-                self.received_shares[self.rank] = shares[j]
+                self.received_shares.setdefault(
+                    self.round_idx, {})[self.rank] = shares[j]
+                self._maybe_answer_agg_request()
                 continue
             share_msg = Message(LSAMessage.MSG_TYPE_C2C_ENCODED_MASK_SHARE,
                                 self.get_sender_id(), peer_rank)
             share_msg.add_params(LSAMessage.ARG_SHARE, shares[j])
+            share_msg.add_params(LSAMessage.ARG_ROUND, self.round_idx)
             self.send_message(share_msg)
 
         masked = mask_field_vector(qvec, local_mask)
@@ -101,18 +127,83 @@ class LSAClientManager(FedMLCommManager):
         self.send_message(up)
 
     def handle_share(self, msg: Message) -> None:
-        self.received_shares[msg.get_sender_id()] = np.asarray(
-            msg.get(LSAMessage.ARG_SHARE), np.int64)
+        rnd = int(msg.get(LSAMessage.ARG_ROUND, self.round_idx))
+        self.received_shares.setdefault(rnd, {})[msg.get_sender_id()] = \
+            np.asarray(msg.get(LSAMessage.ARG_SHARE), np.int64)
+        self._maybe_answer_agg_request()
 
     def handle_agg_request(self, msg: Message) -> None:
-        survivors = [int(s) for s in msg.get(LSAMessage.ARG_SURVIVORS)]
-        have = [self.received_shares[r] for r in survivors
-                if r in self.received_shares]
-        agg_share = aggregate_encoded_masks(have)
+        rnd = int(msg.get(LSAMessage.ARG_ROUND, self.round_idx))
+        with self._req_lock:
+            self._clear_pending_request()
+            self._pending_agg_request = (
+                rnd, [int(s) for s in msg.get(LSAMessage.ARG_SURVIVORS)])
+            self._req_timer = threading.Timer(
+                self._share_wait_s, self._give_up_agg_request, args=(rnd,))
+            self._req_timer.daemon = True
+            self._req_timer.start()
+        self._maybe_answer_agg_request()
+
+    def _clear_pending_request(self) -> None:
+        """Caller holds ``_req_lock``."""
+        self._pending_agg_request = None
+        if self._req_timer is not None:
+            self._req_timer.cancel()
+            self._req_timer = None
+
+    def _maybe_answer_agg_request(self) -> None:
+        """Answer the server's aggregate-mask request once every
+        survivor's encoded share for that round is held.  Summing a
+        PARTIAL set would silently LCC-decode the wrong aggregate mask
+        and poison the global model — a share that is merely delayed must
+        be waited out (the reliable plane retransmits it); one lost for
+        good is handled by the give-up timer below."""
+        with self._req_lock:
+            if self._pending_agg_request is None:
+                return
+            rnd, survivors = self._pending_agg_request
+            held = self.received_shares.get(rnd, {})
+            missing = [r for r in survivors if r not in held]
+            if missing:
+                logging.debug(
+                    "LSA client %d: round-%d agg-mask request waiting on "
+                    "shares from %s", self.rank, rnd, missing)
+                return
+            self._clear_pending_request()
+            agg_share = aggregate_encoded_masks(
+                [held[r] for r in survivors])
         reply = Message(LSAMessage.MSG_TYPE_C2S_AGG_MASK_SHARE,
                         self.get_sender_id(), 0)
         reply.add_params(LSAMessage.ARG_SHARE, agg_share)
-        reply.add_params(LSAMessage.ARG_ROUND, self.round_idx)
+        reply.add_params(LSAMessage.ARG_ROUND, rnd)
+        self.send_message(reply)
+
+    def _give_up_agg_request(self, rnd: int) -> None:
+        """A survivor's share never arrived (lost past the reliable
+        plane's deadline): tell the server this holder can't serve the
+        round so it can ask the next one — an explicit refusal keeps the
+        protocol live where silence would deadlock the whole cohort."""
+        with self._req_lock:
+            if (self._pending_agg_request is None
+                    or self._pending_agg_request[0] != rnd):
+                return
+            _, survivors = self._pending_agg_request
+            held = self.received_shares.get(rnd, {})
+            missing = [r for r in survivors if r not in held]
+            if not missing:
+                pass      # last share raced the timer — answer normally
+            else:
+                self._clear_pending_request()
+        if not missing:
+            self._maybe_answer_agg_request()
+            return
+        logging.warning(
+            "LSA client %d: giving up on round-%d agg-mask request — "
+            "shares from %s never arrived", self.rank, rnd, missing)
+        reply = Message(LSAMessage.MSG_TYPE_C2S_AGG_MASK_SHARE,
+                        self.get_sender_id(), 0)
+        reply.add_params(LSAMessage.ARG_SHARE_UNAVAILABLE, True)
+        reply.add_params(LSAMessage.ARG_ROUND, rnd)
         self.send_message(reply)
 
     def handle_finish(self, msg: Message) -> None:

@@ -18,6 +18,10 @@ class LSAMessage:
     ARG_NUM_SAMPLES = "num_samples"
     ARG_ROUND = "round_idx"
     ARG_SHARE = "mask_share"
+    # set on a C2S_AGG_MASK_SHARE reply when the client gave up waiting
+    # for a survivor's C2C share (lost past the reliable plane's
+    # retransmit deadline) — the server then asks the next share-holder
+    ARG_SHARE_UNAVAILABLE = "mask_share_unavailable"
     ARG_SURVIVORS = "survivors"
     ARG_CLIENT_STATUS = "client_status"
     ARG_PROTO = "lsa_proto"  # dict(d, n, u, t, scale)

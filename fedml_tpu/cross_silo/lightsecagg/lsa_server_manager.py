@@ -47,6 +47,16 @@ class LSAServerManager(FedMLCommManager):
         self.masked: Dict[int, np.ndarray] = {}
         self.sample_nums: Dict[int, float] = {}
         self.agg_shares: Dict[int, np.ndarray] = {}
+        # idempotent stage transition: a duplicated masked upload arriving
+        # after the cohort is complete must not re-broadcast the share
+        # request (see the SecAgg manager's matching guard)
+        self._shares_requested = False
+        # reconstruction fallback bookkeeping: ANY u survivors' aggregate
+        # shares open the mask, so when a requested holder replies
+        # "unavailable" (its C2C shares were lost for good) the server
+        # asks the next survivor instead of stalling
+        self._share_survivors: list = []
+        self._share_req_sent: set = set()
         self.d = None
         self._template = None
 
@@ -93,19 +103,61 @@ class LSAServerManager(FedMLCommManager):
             msg.get(LSAMessage.ARG_MASKED_VECTOR), np.int64)
         self.sample_nums[sender] = float(
             msg.get(LSAMessage.ARG_NUM_SAMPLES, 1.0))
-        if len(self.masked) == self.client_num:
-            survivors = sorted(self.masked.keys())
-            req_targets = survivors[: self.u] if len(survivors) >= self.u \
-                else survivors
-            for r in req_targets:
-                req = Message(LSAMessage.MSG_TYPE_S2C_AGG_MASK_REQUEST,
-                              self.get_sender_id(), r)
-                req.add_params(LSAMessage.ARG_SURVIVORS, survivors)
-                self.send_message(req)
+        # dropout emulation hook for tests (mirrors the SecAgg manager's
+        # ``sa_simulate_dropout_ranks``): ranks listed here "die after the
+        # masking commitment" — their upload never arrives
+        drop = set(getattr(self.args, "lsa_simulate_dropout_ranks", [])
+                   or [])
+        if sender in drop:
+            del self.masked[sender]
+            self.sample_nums.pop(sender, None)
+            return
+        expected = self.client_num - len(drop)
+        if len(self.masked) >= expected and not self._shares_requested:
+            self._shares_requested = True
+            self._share_survivors = sorted(self.masked.keys())
+            self._share_req_sent = set()
+            targets = self._share_survivors[: self.u] \
+                if len(self._share_survivors) >= self.u \
+                else list(self._share_survivors)
+            for r in targets:
+                self._request_share_from(r)
+
+    def _request_share_from(self, rank: int) -> None:
+        self._share_req_sent.add(rank)
+        req = Message(LSAMessage.MSG_TYPE_S2C_AGG_MASK_REQUEST,
+                      self.get_sender_id(), rank)
+        req.add_params(LSAMessage.ARG_SURVIVORS, self._share_survivors)
+        req.add_params(LSAMessage.ARG_ROUND, self.args.round_idx)
+        self.send_message(req)
 
     # -- reconstruction ------------------------------------------------------
     def handle_agg_share(self, msg: Message) -> None:
         sender = msg.get_sender_id()
+        rnd = int(msg.get(LSAMessage.ARG_ROUND, self.args.round_idx))
+        if rnd != int(self.args.round_idx):
+            # a reply delayed past the round boundary must not pollute the
+            # next round's share set (LCC would decode the wrong mask)
+            logging.warning("LSA server: dropping stale round-%d agg share "
+                            "from client %d (now round %d)", rnd, sender,
+                            self.args.round_idx)
+            return
+        if msg.get(LSAMessage.ARG_SHARE_UNAVAILABLE):
+            remaining = [r for r in self._share_survivors
+                         if r not in self._share_req_sent]
+            if remaining:
+                logging.warning(
+                    "LSA server: client %d cannot serve round-%d agg "
+                    "shares — asking client %d instead", sender, rnd,
+                    remaining[0])
+                self._request_share_from(remaining[0])
+                return
+            logging.error(
+                "LSA server: no share-holder left for round %d (%d/%d "
+                "replies) — aborting the run", rnd, len(self.agg_shares),
+                self.u)
+            self._abort_run()
+            return
         self.agg_shares[sender - 1] = np.asarray(
             msg.get(LSAMessage.ARG_SHARE), np.int64)
         if len(self.agg_shares) < self.u:
@@ -162,6 +214,9 @@ class LSAServerManager(FedMLCommManager):
 
         self.masked.clear()
         self.agg_shares.clear()
+        self._shares_requested = False
+        self._share_survivors = []
+        self._share_req_sent = set()
         self.args.round_idx += 1
         if self.args.round_idx >= self.round_num:
             self._broadcast_finish()

@@ -12,6 +12,14 @@ class MyMessage:
     # bring-up; reserved here so configs/payloads stay wire-compatible
     MSG_TYPE_CONNECTION_IS_READY = "CONNECTION_IS_READY"  # fedml: noqa[PROTO001]
     MSG_TYPE_C2S_CLIENT_STATUS = "C2S_CLIENT_STATUS"
+    # heartbeat failure detection (PR 4): clients emit this every
+    # ``heartbeat_interval_s``; the server's phi-accrual-lite detector
+    # declares a peer dead after ``heartbeat_miss_threshold`` silent
+    # intervals and drops it from the round immediately (instead of
+    # waiting out the full elastic round timer).  Heartbeats ride the
+    # reliable plane as VOLATILE messages — never retransmitted, the next
+    # beat supersedes a lost one.
+    MSG_TYPE_HEARTBEAT = "C2S_HEARTBEAT"
 
     # training round-trip
     MSG_TYPE_S2C_INIT_CONFIG = "S2C_INIT_CONFIG"
@@ -33,6 +41,7 @@ class MyMessage:
     # uploads, so one round's spans across server/clients/aggregator stitch
     # into a single trace
     MSG_ARG_KEY_TRACE_CTX = "trace_ctx"
+    MSG_ARG_KEY_HEARTBEAT_TS = "hb_ts"
 
     CLIENT_STATUS_ONLINE = "ONLINE"
     CLIENT_STATUS_IDLE = "IDLE"

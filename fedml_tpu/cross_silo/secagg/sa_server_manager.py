@@ -64,7 +64,13 @@ class SAServerManager(FedMLCommManager):
         # reconstruction shares: owner rank -> {share index -> share}
         self.b_shares: Dict[int, Dict[int, np.ndarray]] = {}
         self.sk_shares: Dict[int, Dict[int, np.ndarray]] = {}
-        self.reconstruction_replies = 0
+        # replies are keyed by SENDER, not counted: a transport-duplicated
+        # reconstruction reply must not trip the threshold early
+        self.reconstruction_repliers: set = set()
+        # stage transitions are idempotent: a duplicated masked upload
+        # arriving after the cohort is complete must not re-broadcast the
+        # unmask request (clients would reply twice, corrupting the count)
+        self._unmask_requested = False
         self.d = None
         self._template = None
 
@@ -132,7 +138,8 @@ class SAServerManager(FedMLCommManager):
             del self.masked[sender]
             self.sample_nums.pop(sender, None)
             return
-        if len(self.masked) >= expected:
+        if len(self.masked) >= expected and not self._unmask_requested:
+            self._unmask_requested = True
             active = sorted(self.masked.keys())
             dropped = sorted(set(range(1, self.client_num + 1)) - set(active))
             for r in active:
@@ -152,8 +159,8 @@ class SAServerManager(FedMLCommManager):
         for owner, share in dict(msg.get(SAMessage.ARG_SK_SHARES, {})).items():
             self.sk_shares.setdefault(int(owner), {})[sender - 1] = \
                 np.asarray(share, np.int64)
-        self.reconstruction_replies += 1
-        if self.reconstruction_replies < len(self.masked):
+        self.reconstruction_repliers.add(sender)
+        if len(self.reconstruction_repliers) < len(self.masked):
             return
         try:
             self._unmask_and_advance()
@@ -224,7 +231,8 @@ class SAServerManager(FedMLCommManager):
         self.sample_nums.clear()
         self.b_shares.clear()
         self.sk_shares.clear()
-        self.reconstruction_replies = 0
+        self.reconstruction_repliers = set()
+        self._unmask_requested = False
         self.args.round_idx += 1
         if self.args.round_idx >= self.round_num:
             self._broadcast_finish()

@@ -13,8 +13,14 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ...core import mlops
-from ...core.mlops import tracing
+from ...core.mlops import metrics, tracing
 from ...core.alg_frame.context import Context
+
+_dup_uploads_total = metrics.counter(
+    "fedml_round_duplicate_uploads_total",
+    "Uploads that arrived for a client index already counted this round "
+    "(should stay 0 when the reliable plane dedups the transport)",
+    labels=("run_id",))
 
 
 class FedMLAggregator:
@@ -27,6 +33,11 @@ class FedMLAggregator:
         self.sample_num_dict: Dict[int, float] = {}
         self._received_this_round: set = set()
         self.metrics_history: List[Dict[str, Any]] = []
+        #: transport-level duplicate accounting: a second upload counted
+        #: for the SAME index in the SAME round.  Re-solicited re-uploads
+        #: never hit this (re-solicitation targets only missing indices)
+        self.duplicate_uploads = 0
+        self._run_label = str(getattr(args, "run_id", "0"))
 
     def get_global_model_params(self):
         return self.aggregator.get_model_params()
@@ -36,6 +47,9 @@ class FedMLAggregator:
 
     def add_local_trained_result(self, index: int, model_params,
                                  sample_num) -> None:
+        if index in self._received_this_round:
+            self.duplicate_uploads += 1
+            _dup_uploads_total.labels(run_id=self._run_label).inc()
         self.model_dict[index] = model_params
         self.sample_num_dict[index] = float(sample_num)
         self._received_this_round.add(index)
@@ -48,6 +62,30 @@ class FedMLAggregator:
 
     def check_whether_all_receive(self) -> bool:
         return len(self._received_this_round) >= self.client_num
+
+    # -- crash-resume state (PR 4: RoundCheckpointer wiring) -----------------
+    def export_round_state(self) -> Dict[str, Any]:
+        """The in-flight round's received results, keyed by stringified
+        client index (checkpoint codecs want string keys).  Empty dicts are
+        omitted entirely — a round-boundary checkpoint carries no models."""
+        idxs = sorted(self._received_this_round)
+        if not idxs:
+            return {}
+        return {
+            "models": {str(i): self.model_dict[i] for i in idxs},
+            "num_samples": {str(i): float(self.sample_num_dict[i])
+                            for i in idxs},
+        }
+
+    def restore_round_state(self, state: Dict[str, Any]) -> None:
+        models = state.get("models") or {}
+        num_samples = state.get("num_samples") or {}
+        for key, tree in models.items():
+            index = int(key)
+            self.model_dict[index] = tree
+            self.sample_num_dict[index] = float(
+                np.asarray(num_samples.get(key, 1.0)))
+            self._received_this_round.add(index)
 
     def aggregate(self) -> Any:
         """Aggregates over the clients that reported THIS round — a partial

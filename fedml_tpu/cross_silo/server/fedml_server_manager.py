@@ -9,7 +9,10 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Any, Dict, List, Optional
+
+import numpy as np
 
 from ...core import mlops
 from ...core.mlops import metrics, tracing
@@ -32,6 +35,14 @@ _clients_reported = metrics.gauge(
 _current_round = metrics.gauge(
     "fedml_current_round", "Round index the server is currently on",
     labels=("run_id",))
+_hb_misses = metrics.counter(
+    "fedml_heartbeat_misses_total",
+    "Clients declared dead by the heartbeat failure detector",
+    labels=("run_id",))
+_resumed_round = metrics.gauge(
+    "fedml_resumed_from_round",
+    "Round index this server restored from a crash-resume checkpoint "
+    "(absent when the run started fresh)", labels=("run_id",))
 
 
 class FedMLServerManager(FedMLCommManager):
@@ -69,9 +80,194 @@ class FedMLServerManager(FedMLCommManager):
         self._run_span: Optional[tracing.Span] = None
         self._round_span: Optional[tracing.Span] = None
         self._run_label = str(getattr(args, "run_id", "0"))
+        # heartbeat failure detector (phi-accrual-lite): a client silent
+        # for miss_threshold × interval is declared dead and dropped from
+        # the round immediately — no waiting out the full round timer; a
+        # rejoining client is re-admitted with the current global model
+        # through the late-join catch-up path
+        self._hb_interval = float(
+            getattr(args, "heartbeat_interval_s", 0) or 0)
+        self._hb_miss_threshold = int(
+            getattr(args, "heartbeat_miss_threshold", 3) or 3)
+        self._last_seen: Dict[int, float] = {}
+        # only ranks that have actually emitted a heartbeat are judged by
+        # the detector: a client launched WITHOUT --heartbeat-interval-s is
+        # silent between uploads by design, and declaring it dead off a
+        # stale status/upload sighting would shrink every round to the
+        # fastest clients
+        self._hb_peers: set = set()
+        self._hb_stop = threading.Event()
+        # crash-resume (RoundCheckpointer wiring): round index, global
+        # params and the received-results set persist per round; a
+        # restarted server picks up at round k and re-solicits only the
+        # missing clients
+        self._ckpt = None
+        self._ckpt_writer = None
+        self._resumed = False
+        self._finishing = False
+        ckpt_dir = getattr(args, "checkpoint_dir", None)
+        if ckpt_dir:
+            from concurrent.futures import ThreadPoolExecutor
+
+            from ...utils.checkpoint import RoundCheckpointer
+
+            self._ckpt = RoundCheckpointer(str(ckpt_dir))
+            # writes happen OFF the receive-loop thread: a multi-second
+            # orbax save under _round_lock would block heartbeat dispatch
+            # long enough for the failure detector to falsely declare live
+            # clients dead.  One worker keeps writes ordered.
+            self._ckpt_writer = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="round-ckpt-writer")
+            resume = getattr(args, "resume_from", None)
+            if resume is not None and resume is not False and resume != "":
+                self._try_resume(resume)
 
     def run(self) -> None:
+        self._start_hb_monitor()
+        if self._resumed:
+            with self._round_lock:
+                self._resume_training()
         super().run()
+
+    def finish(self) -> None:
+        self._hb_stop.set()
+        with self._round_lock:
+            self._finishing = True
+            for timer in (self._round_timer, self._init_timer):
+                if timer is not None:
+                    timer.cancel()
+        super().finish()
+        if self._ckpt_writer is not None:
+            # drain queued round-state writes (each is small and bounded);
+            # the worker never takes _round_lock so this cannot deadlock
+            self._ckpt_writer.shutdown(wait=True)
+            self._ckpt_writer = None
+
+    # -- crash-resume --------------------------------------------------------
+    def _try_resume(self, resume: Any) -> None:
+        # "latest" (or a bare true flag) → newest step; anything numeric
+        # is an explicit round index
+        if resume is True or str(resume).strip().lower() in (
+                "latest", "true", "yes"):
+            step = None
+        else:
+            step = int(resume)
+        state = self._ckpt.restore(step)
+        if state is None:
+            logging.warning(
+                "server: resume_from=%r but no usable checkpoint in %s — "
+                "starting fresh", resume, self._ckpt.dir)
+            return
+        self.args.round_idx = int(np.asarray(state["round_idx"]))
+        self.aggregator.set_global_model_params(state["global_model"])
+        self.aggregator.restore_round_state(state)
+        self._resumed = True
+        _resumed_round.labels(run_id=self._run_label).set(
+            int(self.args.round_idx))
+        logging.warning(
+            "server: resumed at round %d with %d/%d results already "
+            "received", self.args.round_idx, self.aggregator.receive_count(),
+            self.client_num)
+
+    def _resume_training(self) -> None:
+        """Re-enter round k from checkpointed state.  Caller holds
+        ``_round_lock``.  No blanket broadcast here: already-received
+        clients must not retrain, and the missing ones are re-solicited
+        individually as they re-announce (status/heartbeat → late-join
+        catch-up) or by the elastic round timer for silent survivors."""
+        if self.args.round_idx >= self.round_num:
+            logging.warning(
+                "server: checkpoint says the run already completed "
+                "(round %d/%d) — broadcasting FINISH and exiting",
+                self.args.round_idx, self.round_num)
+            self.send_finish_to_all()
+            mlops.log_aggregation_status("FINISHED")
+            self.finish()
+            return
+        mlops.log_aggregation_status("RUNNING")
+        self._run_span = tracing.start_span(
+            "fed_run", run_id=self._run_label, rounds=self.round_num,
+            resumed_at=int(self.args.round_idx))
+        self.is_initialized = True
+        self.client_id_list_in_this_round = self.aggregator.client_sampling(
+            self.args.round_idx, int(self.args.client_num_in_total),
+            int(self.args.client_num_per_round))
+        self.data_silo_index_of_client = self.aggregator.data_silo_selection(
+            self.args.round_idx, int(self.args.client_num_in_total),
+            len(self.client_id_list_in_this_round))
+        self._open_round_span()
+        self._arm_round_timer()
+        if self.aggregator.check_whether_all_receive():
+            # the crash hit AFTER the last upload was persisted but BEFORE
+            # aggregation: no client is missing, so no upload will ever
+            # re-trigger completion — aggregate now
+            logging.warning("server: resumed round %d already has every "
+                            "result — aggregating immediately",
+                            self.args.round_idx)
+            self._complete_round()
+
+    def _persist_round_state(self) -> None:
+        """Checkpoint the in-flight round (called on every accepted upload
+        and at each round boundary; caller holds ``_round_lock``).  The
+        snapshot is taken under the lock — cheap reference captures, the
+        pytrees are never mutated in place — and the write runs on the
+        single-worker checkpoint thread so the lock is released while the
+        bytes land."""
+        if self._ckpt is None or self._ckpt_writer is None:
+            return
+        state = {"round_idx": int(self.args.round_idx),
+                 "global_model": self.aggregator.get_global_model_params()}
+        state.update(self.aggregator.export_round_state())
+        self._ckpt_writer.submit(
+            self._write_round_state, int(self.args.round_idx), state)
+
+    def _write_round_state(self, round_idx: int, state: Dict) -> None:
+        try:
+            self._ckpt.save(round_idx, state, force=True)
+        except Exception:  # noqa: BLE001 — a failed checkpoint write must
+            # not kill the round it is trying to protect
+            logging.exception("server: round checkpoint save failed "
+                              "(continuing without it)")
+
+    # -- heartbeat failure detection -----------------------------------------
+    def _start_hb_monitor(self) -> None:
+        if self._hb_interval <= 0:
+            return
+        t = threading.Thread(target=self._hb_monitor_loop, daemon=True,
+                             name="hb-monitor")
+        t.start()
+
+    def _hb_monitor_loop(self) -> None:
+        deadline = self._hb_miss_threshold * self._hb_interval
+        while not self._hb_stop.wait(self._hb_interval):
+            now = time.monotonic()
+            with self._round_lock:
+                dead = [rank for rank, last in self._last_seen.items()
+                        if rank in self._hb_peers
+                        and self.client_online_status.get(rank)
+                        and now - last > deadline]
+                for rank in dead:
+                    self.client_online_status[rank] = False
+                    _hb_misses.labels(run_id=self._run_label).inc()
+                if dead:
+                    logging.warning(
+                        "server: clients %s silent for > %d heartbeat "
+                        "intervals — declared dead, dropped from round %d",
+                        dead, self._hb_miss_threshold, self.args.round_idx)
+                    if self.is_initialized:
+                        self._maybe_complete_early()
+
+    def handle_message_heartbeat(self, msg: Message) -> None:
+        sent_at = msg.get(MyMessage.MSG_ARG_KEY_HEARTBEAT_TS)
+        if sent_at is not None:
+            # wall-clock transit age: coarse (cross-host clock skew) but a
+            # consistently large value flags a congested/backlogged link
+            # before the detector ever fires
+            logging.debug("server: heartbeat from %d aged %.3fs in transit",
+                          msg.get_sender_id(), time.time() - float(sent_at))
+        with self._round_lock:
+            self._hb_peers.add(msg.get_sender_id())
+            self._mark_alive(msg.get_sender_id())
 
     # -- protocol ------------------------------------------------------------
     def register_message_receive_handlers(self) -> None:
@@ -81,46 +277,73 @@ class FedMLServerManager(FedMLCommManager):
         self.register_message_receive_handler(
             MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
             self.handle_message_receive_model_from_client)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_HEARTBEAT, self.handle_message_heartbeat)
 
     def handle_message_client_status_update(self, msg: Message) -> None:
         sender = msg.get_sender_id()
         status = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_STATUS)
         client_os = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_OS, "unknown")
         with self._round_lock:
-            # status dict is read by the init-timer thread under the lock;
-            # writing it under the lock too avoids mutating during iteration
             if status == MyMessage.CLIENT_STATUS_ONLINE:
-                self.client_online_status[sender] = True
+                self._mark_alive(sender, announce=True)
             n_online = sum(self.client_online_status.values())
         logging.info("server: client %d (%s) status %s (%d/%d online)",
                      sender, client_os, status, n_online, self.client_num)
-        with self._round_lock:
-            if not self.is_initialized:
-                if len(self.client_online_status) == self.client_num:
-                    self._start_training()
-                elif (self.round_timeout_s > 0
-                      and self._init_timer is None):
-                    # elastic init: don't block forever on a client that
-                    # never comes online — force-start after the timeout
-                    # once ≥ min clients are here
-                    self._init_timer = threading.Timer(
-                        self.round_timeout_s, self._maybe_force_init)
-                    self._init_timer.daemon = True
-                    self._init_timer.start()
-            elif status == MyMessage.CLIENT_STATUS_ONLINE:
-                # elastic late join: a (re)connecting client that hasn't
-                # uploaded this round is caught up with the round's model —
-                # at most ONCE per round (a duplicated ONLINE re-announce
-                # must not trigger a redundant full training pass; lost
-                # syncs are covered by the timeout's re-solicitation)
-                if (sender in self._ranks_for(
-                        self.client_id_list_in_this_round)
-                        and sender not in self._caught_up_this_round
-                        and not self.aggregator.has_received(sender - 1)):
-                    logging.info("server: late-joining client %d caught up "
-                                 "into round %d", sender, self.args.round_idx)
-                    self._caught_up_this_round.add(sender)
-                    self._broadcast_round(only_rank=sender)
+
+    def _mark_alive(self, sender: int, announce: bool = False) -> None:
+        """Liveness sighting: refresh the failure detector, (re-)admit the
+        client, and drive init/late-join membership.  Caller holds
+        ``_round_lock`` — the status dict is read by the init-timer and
+        heartbeat-monitor threads under it.
+
+        ``announce`` marks an explicit (re)connect (an ONLINE status).
+        Catch-up below must fire only on a liveness TRANSITION — an
+        announce, a heartbeat from a client previously declared dead, or
+        the FIRST sighting of a rank this server has no record of (a
+        restarted server inherits live clients that will never re-announce
+        — their first heartbeat is what re-solicits them).  A plain
+        heartbeat from a client already known online and merely still
+        training must NOT re-send it the round model (that would cost a
+        redundant full training pass per client per round)."""
+        if self._finishing:
+            # the run is over: a late (re)announce — e.g. after a resumed
+            # server found the checkpointed run already complete — must not
+            # restart training or solicit dead peers
+            return
+        self._last_seen[sender] = time.monotonic()
+        was_online = self.client_online_status.get(sender)
+        self.client_online_status[sender] = True
+        if was_online is False:
+            logging.warning("server: client %d rejoined after being "
+                            "declared dead", sender)
+        if not (announce or was_online is not True):
+            return
+        if not self.is_initialized:
+            if len(self.client_online_status) == self.client_num:
+                self._start_training()
+            elif self.round_timeout_s > 0 and self._init_timer is None:
+                # elastic init: don't block forever on a client that
+                # never comes online — force-start after the timeout
+                # once ≥ min clients are here
+                self._init_timer = threading.Timer(
+                    self.round_timeout_s, self._maybe_force_init)
+                self._init_timer.daemon = True
+                self._init_timer.start()
+        else:
+            # elastic late join / rejoin: a (re)connecting client that
+            # hasn't uploaded this round is re-admitted with the round's
+            # current global model — at most ONCE per round (a duplicated
+            # re-announce must not trigger a redundant full training pass;
+            # lost syncs are covered by the timeout's re-solicitation)
+            if (sender in self._ranks_for(
+                    self.client_id_list_in_this_round)
+                    and sender not in self._caught_up_this_round
+                    and not self.aggregator.has_received(sender - 1)):
+                logging.info("server: late-joining client %d caught up "
+                             "into round %d", sender, self.args.round_idx)
+                self._caught_up_this_round.add(sender)
+                self._broadcast_round(only_rank=sender)
 
     def _maybe_force_init(self) -> None:
         with self._round_lock:
@@ -144,6 +367,7 @@ class FedMLServerManager(FedMLCommManager):
         self._run_span = tracing.start_span(
             "fed_run", run_id=self._run_label, rounds=self.round_num)
         self.is_initialized = True
+        self._persist_round_state()   # round-0 anchor for crash-resume
         self.send_init_msg()
 
     def _open_round_span(self) -> None:
@@ -262,29 +486,33 @@ class FedMLServerManager(FedMLCommManager):
             train_metrics = msg.get(MyMessage.MSG_ARG_KEY_TRAIN_METRICS)
             if isinstance(train_metrics, dict) and train_metrics:
                 self._round_train_metrics[sender] = train_metrics
+            self._last_seen[sender] = time.monotonic()
+            self.client_online_status[sender] = True
             self.aggregator.add_local_trained_result(
                 sender - 1, model_params, local_sample_number)
+            self._persist_round_state()
             if self.aggregator.check_whether_all_receive():
                 self._complete_round()
                 return
-            # elastic early completion: when every ONLINE participant has
-            # reported, don't idle out the full timeout waiting for ranks
-            # the server already knows are absent
-            if self.round_timeout_s > 0:
-                ranks = set(self._ranks_for(self.client_id_list_in_this_round))
-                online = {r for r in ranks
-                          if self.client_online_status.get(r)}
-                if (online
-                        and all(self.aggregator.has_received(r - 1)
-                                for r in online)
-                        and self.aggregator.receive_count()
-                        >= self.min_clients):
-                    logging.info(
-                        "server: round %d — all %d online participants "
-                        "reported; completing without waiting for %d "
-                        "offline", self.args.round_idx, len(online),
-                        len(ranks - online))
-                    self._complete_round()
+            self._maybe_complete_early()
+
+    def _maybe_complete_early(self) -> None:
+        """Elastic early completion: when every ONLINE participant has
+        reported, don't idle out the full timeout waiting for ranks the
+        server already knows are absent (round timer OR heartbeat detector
+        supplies the liveness signal).  Caller holds ``_round_lock``."""
+        if self.round_timeout_s <= 0 and self._hb_interval <= 0:
+            return
+        ranks = set(self._ranks_for(self.client_id_list_in_this_round))
+        online = {r for r in ranks if self.client_online_status.get(r)}
+        if (online
+                and all(self.aggregator.has_received(r - 1) for r in online)
+                and self.aggregator.receive_count() >= self.min_clients):
+            logging.info(
+                "server: round %d — all %d online participants reported; "
+                "completing without waiting for %d offline",
+                self.args.round_idx, len(online), len(ranks - online))
+            self._complete_round()
 
     def _complete_round(self) -> None:
         """Aggregate (possibly a partial set), test, advance or finish.
@@ -319,6 +547,9 @@ class FedMLServerManager(FedMLCommManager):
             self._round_span = None
 
         self.args.round_idx += 1
+        # boundary checkpoint: next round index + freshly aggregated global
+        # params, received set cleared by aggregate()
+        self._persist_round_state()
         if self.args.round_idx >= self.round_num:
             self.send_finish_to_all()
             mlops.log_aggregation_status("FINISHED")

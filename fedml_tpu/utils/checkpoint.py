@@ -15,6 +15,14 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import numpy as np
 
+#: orbax steps are version-encoded as ``round_idx * _VSCALE + version`` so a
+#: re-save of the same round WRITES FIRST and deletes the old version after
+#: the new one has committed — a crash anywhere in between always leaves a
+#: restorable step.  (A delete-then-save overwrite would open a window where
+#: the newest — possibly only — checkpoint is gone.)  4096 versions per
+#: round is far beyond the one-save-per-accepted-upload cadence.
+_VSCALE = 4096
+
 
 class RoundCheckpointer:
     def __init__(self, ckpt_dir: str, keep: int = 3) -> None:
@@ -33,12 +41,36 @@ class RoundCheckpointer:
             self._ocp = None
 
     # -- save ----------------------------------------------------------------
-    def save(self, round_idx: int, state: Dict[str, Any]) -> None:
+    def save(self, round_idx: int, state: Dict[str, Any],
+             force: bool = False) -> None:
+        """``force=True`` allows re-saving an existing round — the
+        cross-silo server persists the in-flight round's partial
+        received-results set on every accepted upload, re-saving the same
+        round index as the set grows (crash-resume then re-solicits only
+        the missing clients).  On the orbax path every save lands on a
+        fresh version-encoded step and stale versions are pruned only
+        after the new step commits, so there is no window without a
+        restorable checkpoint; the npz fallback's ``os.replace`` is
+        atomic on its own."""
         state = jax.tree_util.tree_map(np.asarray, state)
         if self._mgr is not None:
-            self._mgr.save(round_idx,
+            existing = [s for s in self._mgr.all_steps()
+                        if s // _VSCALE == round_idx]
+            if existing and not force:
+                raise ValueError(
+                    f"round {round_idx} already checkpointed; pass "
+                    "force=True to re-save it")
+            version = (max(existing) % _VSCALE + 1) if existing else 0
+            self._mgr.save(round_idx * _VSCALE + version,
                            args=self._ocp.args.StandardSave(state))
             self._mgr.wait_until_finished()
+            for stale in existing:
+                try:
+                    self._mgr.delete(stale)
+                except Exception:  # noqa: BLE001 — leftover versions are
+                    # harmless (restore always picks the newest) and the
+                    # max_to_keep GC sweeps them eventually
+                    pass
             return
         from .serialization import dumps_pytree
 
@@ -57,7 +89,7 @@ class RoundCheckpointer:
     def latest_round(self) -> Optional[int]:
         if self._mgr is not None:
             step = self._mgr.latest_step()
-            return None if step is None else int(step)
+            return None if step is None else int(step) // _VSCALE
         files = sorted(f for f in os.listdir(self.dir) if f.endswith(".ckpt"))
         if not files:
             return None
@@ -69,7 +101,19 @@ class RoundCheckpointer:
         if step is None:
             return None
         if self._mgr is not None:
-            return self._mgr.restore(step)
+            versions = [s for s in self._mgr.all_steps()
+                        if s // _VSCALE == step]
+            if not versions:
+                return None
+            try:
+                # StandardRestore (no target) restores the tree as saved —
+                # required when restoring from a FRESH manager (the crash-
+                # restart path), where orbax has no registered handler to
+                # infer the item type from
+                return self._mgr.restore(
+                    max(versions), args=self._ocp.args.StandardRestore())
+            except Exception:
+                return self._mgr.restore(max(versions))
         from .serialization import loads_pytree
 
         path = os.path.join(self.dir, f"round_{step:08d}.ckpt")

@@ -304,3 +304,203 @@ def test_elastic_init_force_start_without_all_clients(args_factory):
     assert done.wait(60), "server never finished — init blocked"
     m = server.aggregator.metrics_history[-1]
     assert np.isfinite(m["test_loss"])
+
+
+def _chaos_reliable_cross_silo(args_factory, backend_name, run_id, **kw):
+    """Secure-aggregation run over CHAOS(INPROC) with the reliability
+    runtime above it (reliability recovers what chaos loses — without it,
+    SA/LSA stage gates that wait on the full cohort would stall forever
+    on one dropped message)."""
+    import threading
+
+    import fedml_tpu
+    from fedml_tpu.core.distributed.communication.chaos import (
+        ChaosCommManager,
+    )
+    from fedml_tpu.core.distributed.communication.inprocess import (
+        InProcCommManager,
+    )
+    from fedml_tpu.core.distributed.fedml_comm_manager import (
+        register_comm_backend,
+    )
+    from fedml_tpu.cross_silo.runner import init_client, init_server
+
+    chaos_instances = []
+
+    def factory(args, rank=0, size=0):
+        mgr = ChaosCommManager(
+            InProcCommManager(rank, size, str(args.run_id)),
+            drop_p=0.15, dup_p=0.1, delay_p=0.2, max_delay_s=0.05,
+            seed=300 + rank)
+        chaos_instances.append(mgr)
+        return mgr
+
+    register_comm_backend(backend_name, factory)
+    args = fedml_tpu.init(args_factory(
+        training_type="cross_silo", client_num_in_total=4,
+        client_num_per_round=4, comm_round=2, data_scale=0.3,
+        learning_rate=0.1, run_id=run_id, reliable=True,
+        reliable_retx_initial_s=0.05, reliable_retx_max_s=0.5, **kw))
+    dataset = fedml_tpu.data.load(args)
+    bundle = fedml_tpu.model.create(args, dataset[-1])
+    server = init_server(args, dataset, bundle, backend=backend_name)
+    clients = [init_client(args, dataset, bundle, rank,
+                           backend=backend_name) for rank in range(1, 5)]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    return server, threads, chaos_instances
+
+
+def test_secagg_dropout_recovery_under_chaos(args_factory):
+    """SecAgg with a client dying after its masking commitment, on a lossy
+    link: survivors' sk-shares still reconstruct the dropped client's
+    pairwise masks, and the reliable plane keeps every stage gate fed."""
+    server, threads, chaos = _chaos_reliable_cross_silo(
+        args_factory, "CHAOS_REL_SA", "sa_chaos",
+        federated_optimizer="SA", sa_simulate_dropout_ranks=[2])
+    server.run()
+    for t in threads:
+        t.join(timeout=30)
+    m = server.aggregator.metrics_history[-1]
+    assert np.isfinite(m["test_loss"])
+    assert m["test_loss"] < 50.0        # unmasked garbage would be huge
+    assert sum(c.stats["dropped"] + c.stats["duplicated"]
+               for c in chaos) > 0, "chaos never fired"
+
+
+def test_lightsecagg_dropout_recovery_under_chaos(args_factory):
+    """LightSecAgg counterpart: ≥u survivors reconstruct the aggregate
+    mask after a post-commitment dropout, under seeded chaos."""
+    server, threads, chaos = _chaos_reliable_cross_silo(
+        args_factory, "CHAOS_REL_LSA", "lsa_chaos",
+        federated_optimizer="LSA", lsa_simulate_dropout_ranks=[3])
+    server.run()
+    for t in threads:
+        t.join(timeout=30)
+    m = server.aggregator.metrics_history[-1]
+    assert np.isfinite(m["test_loss"])
+    assert m["test_loss"] < 50.0
+    assert sum(c.stats["dropped"] + c.stats["duplicated"]
+               for c in chaos) > 0, "chaos never fired"
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_secagg_below_threshold_aborts_cleanly(args_factory):
+    """Dropout beyond the Shamir threshold is unrecoverable: the server
+    must abort via _abort_run — broadcast FINISH so every client exits —
+    instead of stranding the cohort on a sync that never comes."""
+    import threading
+
+    import fedml_tpu
+    from fedml_tpu.cross_silo.runner import init_client, init_server
+
+    # client_num=4 → t=2: dropping 2 clients leaves 2 survivors < t+1=3
+    args = fedml_tpu.init(args_factory(
+        training_type="cross_silo", client_num_in_total=4,
+        client_num_per_round=4, comm_round=2, data_scale=0.3,
+        learning_rate=0.1, run_id="sa_abort", federated_optimizer="SA",
+        sa_simulate_dropout_ranks=[2, 3]))
+    dataset = fedml_tpu.data.load(args)
+    bundle = fedml_tpu.model.create(args, dataset[-1])
+    server = init_server(args, dataset, bundle, backend="INPROC")
+    clients = [init_client(args, dataset, bundle, rank, backend="INPROC")
+               for rank in range(1, 5)]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    with pytest.raises(RuntimeError, match="cannot be opened"):
+        server.run()
+    # _abort_run released every client: all threads exit instead of
+    # blocking on the next round's sync
+    for t in threads:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in threads), \
+        "abort left clients stranded"
+
+
+def test_lsa_client_gives_up_on_permanently_lost_share(args_factory):
+    """A survivor's C2C share lost for good (past the reliable plane's
+    retransmit deadline) must NOT deadlock the client on the server's
+    agg-mask request: after lsa_share_wait_s it replies 'unavailable'."""
+    import queue
+    import time
+
+    from fedml_tpu.core.distributed.communication.inprocess import InProcHub
+    from fedml_tpu.core.distributed.communication.message import Message
+    from fedml_tpu.cross_silo.lightsecagg.lsa_client_manager import (
+        LSAClientManager,
+    )
+    from fedml_tpu.cross_silo.lightsecagg.lsa_message_define import LSAMessage
+
+    args = args_factory(run_id="lsa_giveup", lsa_share_wait_s=0.2)
+    client = LSAClientManager(args, None, rank=1, size=4, backend="INPROC")
+    # the client holds only its OWN share; survivor 2's share never comes
+    client.received_shares = {0: {1: np.zeros(4, np.int64)}}
+    req = Message(LSAMessage.MSG_TYPE_S2C_AGG_MASK_REQUEST, 0, 1)
+    req.add_params(LSAMessage.ARG_SURVIVORS, [1, 2])
+    req.add_params(LSAMessage.ARG_ROUND, 0)
+    client.handle_agg_request(req)
+
+    server_q = InProcHub.get("lsa_giveup").queue_for(0)
+    deadline = time.time() + 5
+    reply = None
+    while time.time() < deadline:
+        try:
+            reply = server_q.get(timeout=0.1)
+            break
+        except queue.Empty:
+            continue
+    assert reply is not None, "client never gave up — cohort would deadlock"
+    assert reply.get_type() == LSAMessage.MSG_TYPE_C2S_AGG_MASK_SHARE
+    assert reply.get(LSAMessage.ARG_SHARE_UNAVAILABLE) is True
+    assert int(reply.get(LSAMessage.ARG_ROUND)) == 0
+
+
+def test_lsa_server_asks_next_holder_on_unavailable(args_factory):
+    """On an 'unavailable' agg-share reply the server asks the next
+    survivor; when none remain it aborts the run (FINISH to everyone)
+    instead of waiting forever."""
+    from fedml_tpu.core.distributed.communication.inprocess import InProcHub
+    from fedml_tpu.core.distributed.communication.message import Message
+    from fedml_tpu.cross_silo.lightsecagg.lsa_message_define import LSAMessage
+    from fedml_tpu.cross_silo.lightsecagg.lsa_server_manager import (
+        LSAServerManager,
+    )
+
+    class _DummyAgg:
+        metrics_history = []
+
+    args = args_factory(run_id="lsa_nextholder", comm_round=2)
+    server = LSAServerManager(args, _DummyAgg(), rank=0, client_num=3,
+                              backend="INPROC")
+    server._share_survivors = [1, 2, 3]
+    server._share_req_sent = {1, 2}
+    hub = InProcHub.get("lsa_nextholder")
+
+    bad = Message(LSAMessage.MSG_TYPE_C2S_AGG_MASK_SHARE, 1, 0)
+    bad.add_params(LSAMessage.ARG_SHARE_UNAVAILABLE, True)
+    bad.add_params(LSAMessage.ARG_ROUND, 0)
+    server.handle_agg_share(bad)
+    # the untried survivor (rank 3) got a fresh request
+    nxt = hub.queue_for(3).get(timeout=2)
+    assert nxt.get_type() == LSAMessage.MSG_TYPE_S2C_AGG_MASK_REQUEST
+    assert 3 in server._share_req_sent
+
+    # no survivors left → clean abort: FINISH broadcast to all ranks
+    bad2 = Message(LSAMessage.MSG_TYPE_C2S_AGG_MASK_SHARE, 2, 0)
+    bad2.add_params(LSAMessage.ARG_SHARE_UNAVAILABLE, True)
+    bad2.add_params(LSAMessage.ARG_ROUND, 0)
+    bad3 = Message(LSAMessage.MSG_TYPE_C2S_AGG_MASK_SHARE, 3, 0)
+    bad3.add_params(LSAMessage.ARG_SHARE_UNAVAILABLE, True)
+    bad3.add_params(LSAMessage.ARG_ROUND, 0)
+    server.handle_agg_share(bad2)
+    server.handle_agg_share(bad3)
+    for rank in (1, 2, 3):
+        q = hub.queue_for(rank)
+        types = []
+        while not q.empty():
+            types.append(q.get().get_type())
+        assert LSAMessage.MSG_TYPE_S2C_FINISH in types, \
+            f"rank {rank} never released on abort"

@@ -1,0 +1,454 @@
+"""Fault-tolerant round runtime: the reliability plane (ACK / retransmit /
+dedup), heartbeat failure detection, and server crash-resume — proven
+correct against the chaos plane (seeded drop/dup/delay injection)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fedml_tpu.core.distributed.communication.chaos import ChaosCommManager
+from fedml_tpu.core.distributed.communication.inprocess import (
+    InProcCommManager,
+    InProcHub,
+)
+from fedml_tpu.core.distributed.communication.message import Message
+from fedml_tpu.core.distributed.communication.reliable import (
+    ARG_SEQ,
+    ARG_VOLATILE,
+    ReliableCommManager,
+)
+
+
+class _Collector:
+    def __init__(self):
+        self.got = []
+        self.event = threading.Event()
+
+    def receive_message(self, msg_type, msg):
+        self.got.append((msg_type, msg))
+        self.event.set()
+
+
+class _Blackhole:
+    """Inner transport that loses every send — exercises retransmit/expiry."""
+
+    def __init__(self):
+        self.sends = 0
+        self._observers = []
+
+    def send_message(self, msg):
+        self.sends += 1
+
+    def add_observer(self, obs):
+        self._observers.append(obs)
+
+    def remove_observer(self, obs):
+        pass
+
+    def handle_receive_message(self):
+        pass
+
+    def stop_receive_message(self):
+        pass
+
+
+def _reliable_pair(channel, **kw):
+    r0 = ReliableCommManager(InProcCommManager(0, 2, channel), rank=0, **kw)
+    r1 = ReliableCommManager(InProcCommManager(1, 2, channel), rank=1, **kw)
+    for r in (r0, r1):
+        threading.Thread(target=r.handle_receive_message, daemon=True).start()
+    return r0, r1
+
+
+# --------------------------------------------------------------- unit tier
+def test_reliable_stamps_acks_and_drains():
+    r0, r1 = _reliable_pair("rel_ack", retx_initial_s=0.05)
+    c1 = _Collector()
+    r1.add_observer(c1)
+    msg = Message("PING", 0, 1)
+    msg.add_params("x", 7)
+    r0.send_message(msg)
+    assert c1.event.wait(5)
+    assert c1.got[0][1].get("x") == 7
+    assert c1.got[0][1].get(ARG_SEQ) == 1      # envelope stamped
+    deadline = time.time() + 5
+    while time.time() < deadline and r0._inflight:
+        time.sleep(0.02)
+    assert not r0._inflight, "ACK never cleared the in-flight window"
+    assert r1.stats["acks_sent"] == 1
+    r0.stop_receive_message()
+    r1.stop_receive_message()
+
+
+def test_reliable_dedup_suppresses_duplicate_delivery():
+    r1 = ReliableCommManager(InProcCommManager(1, 2, "rel_dedup"), rank=1)
+    c1 = _Collector()
+    r1.add_observer(c1)
+    msg = Message("UPLOAD", 0, 1)
+    msg.add_params(ARG_SEQ, 5)
+    msg.add_params("rel_epoch", 42)
+    r1.receive_message("UPLOAD", msg)
+    r1.receive_message("UPLOAD", msg)          # transport-level duplicate
+    assert len(c1.got) == 1
+    assert r1.stats["dup_suppressed"] == 1
+    # both deliveries were ACKed — the first ACK may be the lost frame
+    assert r1.stats["acks_sent"] == 2
+
+
+def test_reliable_retransmits_then_expires():
+    hole = _Blackhole()
+    r = ReliableCommManager(hole, rank=0, retx_initial_s=0.02,
+                            retx_max_s=0.04, retx_deadline_s=0.2)
+    r.send_message(Message("DOOMED", 0, 1))
+    deadline = time.time() + 3
+    while time.time() < deadline and r._inflight:
+        time.sleep(0.02)
+    assert not r._inflight
+    assert r.stats["retransmits"] >= 1
+    assert r.stats["expired"] == 1
+    assert hole.sends >= 2                      # original + retransmits
+
+
+def test_reliable_volatile_and_unwrapped_passthrough():
+    r1 = ReliableCommManager(InProcCommManager(1, 2, "rel_vol"), rank=1)
+    c1 = _Collector()
+    r1.add_observer(c1)
+    # volatile send: no envelope, no in-flight tracking
+    r0 = ReliableCommManager(InProcCommManager(0, 2, "rel_vol"), rank=0)
+    hb = Message("HB", 0, 1)
+    hb.add_params(ARG_VOLATILE, True)
+    r0.send_message(hb)
+    assert not r0._inflight
+    # unwrapped-peer receive: no envelope → dispatched, never ACKed
+    plain = Message("LEGACY", 0, 1)
+    r1.receive_message("LEGACY", plain)
+    assert [t for t, _ in c1.got] == ["LEGACY"]
+    assert r1.stats["acks_sent"] == 0
+
+
+def test_reliable_close_drains_inflight_before_stopping_inner():
+    """stop_receive_message() must keep the inner loop alive until the
+    in-flight window drains (the FINISH broadcast's ACKs), then stop it."""
+    chan = "rel_drain"
+    lossy0 = ChaosCommManager(InProcCommManager(0, 2, chan), drop_p=0.5,
+                              seed=7)
+    r0 = ReliableCommManager(lossy0, rank=0, retx_initial_s=0.03,
+                             flush_timeout_s=5.0)
+    r1 = ReliableCommManager(InProcCommManager(1, 2, chan), rank=1,
+                             retx_initial_s=0.03)
+    c1 = _Collector()
+    r1.add_observer(c1)
+    t0 = threading.Thread(target=r0.handle_receive_message, daemon=True)
+    t1 = threading.Thread(target=r1.handle_receive_message, daemon=True)
+    t0.start()
+    t1.start()
+    for i in range(10):
+        r0.send_message(Message("FINAL", 0, 1))
+    r0.stop_receive_message()                   # close while lossy
+    t0.join(timeout=10)
+    assert not t0.is_alive(), "receive loop never released after drain"
+    assert len([1 for t, _ in c1.got if t == "FINAL"]) == 10
+    assert not r0._inflight
+    r1.stop_receive_message()
+
+
+# ------------------------------------------------- chaos-plane satellites
+def test_chaos_stats_exact_under_concurrent_senders():
+    class _Sink:
+        def send_message(self, msg):
+            pass
+
+        def add_observer(self, o):
+            pass
+
+        def remove_observer(self, o):
+            pass
+
+        def handle_receive_message(self):
+            pass
+
+        def stop_receive_message(self):
+            pass
+
+    chaos = ChaosCommManager(_Sink(), drop_p=0.3, dup_p=0.3, delay_p=0.3,
+                             max_delay_s=0.0, seed=0)
+    n_threads, n_msgs = 8, 200
+
+    def _spam():
+        for i in range(n_msgs):
+            chaos.send_message(Message("SPAM", 0, 1))
+
+    threads = [threading.Thread(target=_spam) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert chaos.stats["sent"] == n_threads * n_msgs
+
+
+def test_chaos_duplicate_rolls_its_own_drop_and_delay():
+    """The dup copy goes through the same drop/delay pipeline as the
+    original — it is not an unconditional immediate echo."""
+    hub_chan = "chaos_dup"
+    chaos = ChaosCommManager(InProcCommManager(0, 2, hub_chan),
+                             dup_p=1.0, delay_p=1.0, max_delay_s=0.05,
+                             seed=3)
+    q = InProcHub.get(hub_chan).queue_for(1)
+    for _ in range(20):
+        chaos.send_message(Message("D", 0, 1))
+    deadline = time.time() + 5
+    while time.time() < deadline and q.qsize() < 40:
+        time.sleep(0.02)
+    assert q.qsize() == 40                      # 20 originals + 20 dups
+    assert chaos.stats["duplicated"] == 20
+    assert chaos.stats["delayed"] == 40         # every copy rolled delay
+
+
+def test_inproc_channel_release_is_identity_guarded(args_factory):
+    from fedml_tpu.core.distributed.fedml_comm_manager import FedMLCommManager
+
+    args = args_factory(run_id="rel_release")
+    m1 = FedMLCommManager(args, rank=0, size=1, backend="INPROC")
+    old_hub = m1.com_manager.hub
+    old_hub.queue_for(0).put("stale-msg")
+    m1.finish()                                 # releases the channel
+    # a new same-process run with the same run_id gets a FRESH hub: the
+    # stale message cannot leak forward
+    m2 = FedMLCommManager(args, rank=0, size=1, backend="INPROC")
+    assert m2.com_manager.hub is not old_hub
+    assert m2.com_manager.hub.queue_for(0).qsize() == 0
+    # finishing the OLD manager again must NOT drop the new run's channel
+    m1.finish()
+    assert InProcHub.get("rel_release") is m2.com_manager.hub
+    m2.finish()
+
+
+def test_round_checkpointer_force_overwrites_growing_round_state(tmp_path):
+    from fedml_tpu.utils.checkpoint import RoundCheckpointer
+
+    for use_fallback in (False, True):
+        ck = RoundCheckpointer(str(tmp_path / f"ck{use_fallback}"))
+        if use_fallback:
+            ck._mgr = None                      # exercise the npz path too
+        state = {"round_idx": 2,
+                 "global_model": {"w": np.arange(4.0)},
+                 "models": {"0": {"w": np.ones(4)}},
+                 "num_samples": {"0": 5.0}}
+        ck.save(2, state, force=True)
+        state["models"]["1"] = {"w": np.zeros(4)}
+        state["num_samples"]["1"] = 2.0
+        ck.save(2, state, force=True)           # same step, grown set
+        back = ck.restore(2)
+        assert sorted(back["models"]) == ["0", "1"]
+        assert int(np.asarray(back["round_idx"])) == 2
+
+
+# --------------------------------------------------- protocol-level tier
+def _register_chaos_reliable_backend(name, instances, *, drop_p=0.15,
+                                     dup_p=0.1, delay_p=0.2,
+                                     max_delay_s=0.05, seed0=100):
+    """CHAOS backend factory; args.reliable=True makes the comm base wrap
+    it in the reliability runtime (reliability ABOVE chaos, so ACKs and
+    retransmits cross the lossy link too)."""
+    from fedml_tpu.core.distributed.fedml_comm_manager import (
+        register_comm_backend,
+    )
+
+    def factory(args, rank=0, size=0):
+        mgr = ChaosCommManager(
+            InProcCommManager(rank, size, str(args.run_id)),
+            drop_p=drop_p, dup_p=dup_p, delay_p=delay_p,
+            max_delay_s=max_delay_s, seed=seed0 + rank)
+        instances.append(mgr)
+        return mgr
+
+    register_comm_backend(name, factory)
+
+
+def test_chaos_soak_reliable_completes_all_rounds_exactly_once(args_factory):
+    """Acceptance soak: 5 clients × 10 rounds under seeded chaos
+    (drop_p=0.15, dup_p=0.1, delay_p=0.2) with the reliability runtime —
+    every round completes with the full cohort, NO round timer needed, and
+    zero duplicate-counted uploads (the dedup window absorbs every
+    transport duplicate)."""
+    import fedml_tpu
+    from fedml_tpu.core.mlops import metrics
+    from fedml_tpu.cross_silo.runner import init_client, init_server
+
+    chaos_instances = []
+    _register_chaos_reliable_backend("CHAOS_REL_SOAK", chaos_instances)
+    args = fedml_tpu.init(args_factory(
+        training_type="cross_silo", client_num_in_total=5,
+        client_num_per_round=5, comm_round=10, data_scale=0.2,
+        learning_rate=0.1, frequency_of_the_test=5, run_id="rel_soak",
+        reliable=True, reliable_retx_initial_s=0.05,
+        reliable_retx_max_s=0.5))
+    dataset = fedml_tpu.data.load(args)
+    bundle = fedml_tpu.model.create(args, dataset[-1])
+    server = init_server(args, dataset, bundle, backend="CHAOS_REL_SOAK")
+    clients = [init_client(args, dataset, bundle, rank,
+                           backend="CHAOS_REL_SOAK")
+               for rank in range(1, 6)]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    server.run()
+    for t in threads:
+        t.join(timeout=30)
+
+    assert int(args.round_idx) == 10, "not every round completed"
+    assert np.isfinite(server.aggregator.metrics_history[-1]["test_loss"])
+    # the adversary actually fired ...
+    dropped = sum(c.stats["dropped"] for c in chaos_instances)
+    duplicated = sum(c.stats["duplicated"] for c in chaos_instances)
+    assert dropped > 0 and duplicated > 0, "chaos never fired"
+    # ... and the reliability plane absorbed it: losses were retransmitted,
+    # duplicates suppressed, and not one upload was double-counted
+    rel = [m.com_manager for m in [server] + clients]
+    retx = sum(r.stats["retransmits"] for r in rel)
+    dups = sum(r.stats["dup_suppressed"] for r in rel)
+    assert retx > 0, "drops happened but nothing was retransmitted"
+    assert dups > 0, "duplicates happened but none were suppressed"
+    assert all(r.stats["expired"] == 0 for r in rel)
+    assert server.aggregator.duplicate_uploads == 0
+    # counters are live on the Prometheus exposition surface
+    exposition = metrics.render_prometheus()
+    for name in ("fedml_reliable_retransmits_total",
+                 "fedml_reliable_dup_suppressed_total",
+                 "fedml_round_duplicate_uploads_total"):
+        assert name in exposition
+
+
+def test_server_crash_resume_mid_round(args_factory, tmp_path):
+    """Kill the server mid-round: a restarted server resumes from
+    RoundCheckpointer state at the SAME round index, re-solicits only the
+    missing clients, and finishes without re-aggregating completed
+    rounds."""
+    import fedml_tpu
+    from fedml_tpu.cross_silo.runner import init_client
+    from fedml_tpu.cross_silo.server.fedml_aggregator import FedMLAggregator
+    from fedml_tpu.cross_silo.server.fedml_server_manager import (
+        FedMLServerManager,
+    )
+    from fedml_tpu.ml.trainer.default_trainer import DefaultServerAggregator
+
+    CRASH_ROUND, TOTAL_ROUNDS, N = 3, 6, 3
+    ckpt_dir = str(tmp_path / "rounds")
+
+    class _CrashingAggregator(FedMLAggregator):
+        crashed = False
+
+        def add_local_trained_result(self, index, model_params, sample_num):
+            if (not self.crashed
+                    and int(self.args.round_idx) == CRASH_ROUND
+                    and self.receive_count() >= 1):
+                _CrashingAggregator.crashed = True
+                raise RuntimeError("simulated server crash")
+            super().add_local_trained_result(index, model_params, sample_num)
+
+    def _build(args, aggregator_cls):
+        import jax
+
+        dataset = fedml_tpu.data.load(args)
+        bundle = fedml_tpu.model.create(args, dataset[-1])
+        impl = DefaultServerAggregator(bundle, args)
+        if impl.get_model_params() is None:
+            impl.set_model_params(bundle.init_variables(
+                jax.random.PRNGKey(0)))
+        agg = aggregator_cls(args, impl, dataset[3])
+        server = FedMLServerManager(args, agg, rank=0, client_num=N,
+                                    backend="INPROC")
+        clients = [init_client(args, dataset, bundle, rank,
+                               backend="INPROC")
+                   for rank in range(1, N + 1)]
+        return server, clients
+
+    common = dict(training_type="cross_silo", client_num_in_total=N,
+                  client_num_per_round=N, comm_round=TOTAL_ROUNDS,
+                  data_scale=0.3, frequency_of_the_test=1,
+                  checkpoint_dir=ckpt_dir)
+
+    # -- phase 1: crash mid-round CRASH_ROUND --------------------------------
+    args1 = fedml_tpu.init(args_factory(run_id="crash_p1", **common))
+    server1, clients1 = _build(args1, _CrashingAggregator)
+    for c in clients1:
+        threading.Thread(target=c.run, daemon=True).start()
+    with pytest.raises(RuntimeError, match="simulated server crash"):
+        server1.run()
+    # completed rounds 0..CRASH_ROUND-1, each evaluated once
+    assert len(server1.aggregator.metrics_history) == CRASH_ROUND
+    assert int(args1.round_idx) == CRASH_ROUND
+
+    # -- phase 2: restarted server resumes from the checkpoint ---------------
+    args2 = fedml_tpu.init(args_factory(run_id="crash_p2",
+                                        resume_from="latest", **common))
+    server2, clients2 = _build(args2, FedMLAggregator)
+    assert int(args2.round_idx) == CRASH_ROUND, "did not resume at round k"
+    # the result accepted before the crash was restored — only the missing
+    # clients get re-solicited
+    assert server2.aggregator.receive_count() == 1
+    threads2 = [threading.Thread(target=c.run, daemon=True)
+                for c in clients2]
+    for t in threads2:
+        t.start()
+    server2.run()
+    for t in threads2:
+        t.join(timeout=30)
+    assert int(args2.round_idx) == TOTAL_ROUNDS
+    # rounds CRASH_ROUND..TOTAL_ROUNDS-1 ran here — completed rounds were
+    # NOT re-aggregated
+    assert len(server2.aggregator.metrics_history) == \
+        TOTAL_ROUNDS - CRASH_ROUND
+    assert np.isfinite(server2.aggregator.metrics_history[-1]["test_loss"])
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_heartbeat_detector_drops_dead_client_immediately(args_factory):
+    """A client that dies mid-run stops heartbeating; the failure detector
+    declares it dead after miss_threshold intervals and the round
+    completes with the survivors — WITHOUT waiting out the (long) round
+    timer."""
+    import fedml_tpu
+    from fedml_tpu.cross_silo.runner import init_client, init_server
+
+    LONG_TIMEOUT = 60.0
+    args = fedml_tpu.init(args_factory(
+        training_type="cross_silo", client_num_in_total=3,
+        client_num_per_round=3, comm_round=3, data_scale=0.3,
+        learning_rate=0.1, frequency_of_the_test=1, run_id="hb_drop",
+        heartbeat_interval_s=0.15, heartbeat_miss_threshold=3,
+        round_timeout_s=LONG_TIMEOUT, min_clients_per_round=2))
+    dataset = fedml_tpu.data.load(args)
+    bundle = fedml_tpu.model.create(args, dataset[-1])
+    server = init_server(args, dataset, bundle, backend="INPROC")
+    clients = [init_client(args, dataset, bundle, rank, backend="INPROC")
+               for rank in range(1, 4)]
+
+    # rank 3 "dies" when it receives the round-1 sync: its handler raises,
+    # the comm base tears the node down, heartbeats stop
+    doomed = clients[2]
+    real_train = doomed.trainer_dist_adapter.train
+
+    def _dying_train(round_idx):
+        if int(round_idx) >= 1:
+            raise RuntimeError("client 3 crashed")
+        return real_train(round_idx)
+
+    doomed.trainer_dist_adapter.train = _dying_train
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    start = time.monotonic()
+    for t in threads:
+        t.start()
+    server.run()
+    elapsed = time.monotonic() - start
+
+    assert int(args.round_idx) == 3
+    assert len(server.aggregator.metrics_history) == 3
+    # the dead client was dropped by the failure detector, not the timer
+    assert server.client_online_status[3] is False
+    assert elapsed < LONG_TIMEOUT / 2, (
+        f"run took {elapsed:.1f}s — the dead client was only dropped by "
+        "the round timer, not the heartbeat detector")

@@ -298,3 +298,60 @@ def test_chaos_transport_elastic_cross_silo_survives(args_factory):
     total_chaos = sum(c.stats["dropped"] + c.stats["duplicated"]
                       for c in chaos_instances)
     assert total_chaos > 0, "chaos never fired — test proves nothing"
+
+
+def test_grpc_stub_cached_and_channels_closed_on_stop(args_factory):
+    """send_message must reuse one cached stub per channel (not rebuild it
+    every send), and stop_receive_message must close every client channel
+    so the sockets are released."""
+    import grpc
+
+    from fedml_tpu.core.distributed.communication.grpc import GRPCCommManager
+
+    args = args_factory(grpc_base_port=18930)
+    m0 = GRPCCommManager(args=args, rank=0, size=2)
+    m1 = GRPCCommManager(args=args, rank=1, size=2)
+    c1 = _Collector()
+    m1.add_observer(c1)
+    t1 = threading.Thread(target=m1.handle_receive_message, daemon=True)
+    t1.start()
+
+    m0.send_message(Message("A", 0, 1))
+    stub_after_first = m0._stubs[1]
+    channel_after_first = m0._channels[1]
+    m0.send_message(Message("B", 0, 1))
+    assert m0._stubs[1] is stub_after_first, "stub rebuilt on second send"
+    assert m0._channels[1] is channel_after_first
+    deadline = time.time() + 10
+    while time.time() < deadline and len(c1.got) < 2:
+        time.sleep(0.05)
+    assert len(c1.got) == 2
+
+    m1.stop_receive_message()
+    m0.stop_receive_message()
+    assert m0._channels == {} and m0._stubs == {}, \
+        "client channels not released on stop"
+    # the closed channel object rejects further use
+    with pytest.raises(Exception):
+        channel_after_first.unary_unary("/x/y")(b"", timeout=1)
+    del grpc  # imported for documentation of the dependency
+
+
+def test_grpc_send_retries_transient_failures_with_backoff(args_factory):
+    """A send to an unreachable peer is retried grpc_send_retries times
+    with backoff before the RpcError surfaces (transient channel errors
+    must not instantly kill the handler thread that sends replies)."""
+    import grpc
+
+    from fedml_tpu.core.distributed.communication.grpc import GRPCCommManager
+
+    args = args_factory(grpc_base_port=18950, grpc_send_retries=2,
+                        grpc_retry_backoff_s=0.05, grpc_send_timeout_s=1.0)
+    m0 = GRPCCommManager(args=args, rank=0, size=2)
+    start = time.time()
+    with pytest.raises(grpc.RpcError):
+        m0.send_message(Message("DOOMED", 0, 1))   # nobody at rank 1's port
+    # 2 retries × ≥0.025s jittered backoff happened before surfacing
+    assert time.time() - start > 0.05
+    m0.stop_receive_message()
+    assert m0._channels == {} and m0._stubs == {}
