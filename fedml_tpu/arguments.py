@@ -87,6 +87,19 @@ _DEFAULTS: Dict[str, Any] = {
     "resume_from": None,             # "latest" or a round index
     "round_timeout_s": 0.0,          # elastic round timer (0 disables)
     "min_clients_per_round": 1,
+    # robustness: byzantine-robust data plane (docs/ROBUSTNESS.md
+    # "Data-plane robustness") — robust aggregation operator selector
+    # (trimmed_mean[:frac]|median|krum:f|multi_krum:f[:k]|
+    #  geo_median[:iters]|norm_clip:C), upload admission control, and
+    # straggler-tolerant round pacing
+    "robust_agg": None,
+    "admission_control": False,
+    "admission_norm_bound": 0.0,     # L2 screen on ||upload - global|| (0 off)
+    "admission_resolicit_max": 1,    # re-solicits per quarantined client/round
+    "over_provision": 0,             # solicit K+m clients, aggregate first K
+    "round_deadline_s": 0.0,         # hard round deadline (0 disables)
+    "round_deadline_grace_s": 2.0,   # extension while below the floor
+    "min_aggregation_clients": 1,    # deadline never closes a round below this
     # tracking_args
     "enable_tracking": True,
     "log_file_dir": None,

@@ -71,8 +71,27 @@ def diagnosis(config, checks) -> None:
 @click.option("--resume-from", default=None,
               help="resume the server from checkpoint state: 'latest' or "
                    "a round index (requires --checkpoint-dir)")
+@click.option("--robust-agg", default=None,
+              help="byzantine-robust aggregation operator: "
+                   "trimmed_mean[:frac]|median|krum:f|multi_krum:f[:k]|"
+                   "geo_median[:iters]|norm_clip:C")
+@click.option("--admission-control/--no-admission-control",
+              "admission_control", default=None,
+              help="validate every upload against the global tree "
+                   "(structure/shape/dtype, NaN/Inf, norm screen) and "
+                   "quarantine rejects")
+@click.option("--over-provision", default=None, type=int, metavar="M",
+              help="solicit K+M clients per round, aggregate with the "
+                   "first K arrivals (straggler tolerance)")
+@click.option("--round-deadline-s", default=None, type=float,
+              help="hard round deadline: aggregate with whoever reported "
+                   "when it fires, dropping stragglers (0 = off)")
+@click.option("--min-aggregation-clients", default=None, type=int,
+              help="the deadline never closes a round with fewer results "
+                   "than this floor (re-solicits + grace-extends instead)")
 def run(config: str, rank: int, role: str, reliable, heartbeat_interval_s,
-        checkpoint_dir, resume_from) -> None:
+        checkpoint_dir, resume_from, robust_agg, admission_control,
+        over_provision, round_deadline_s, min_aggregation_clients) -> None:
     """Run a training config (reference `fedml run` / launchers)."""
     import fedml_tpu
 
@@ -87,6 +106,22 @@ def run(config: str, rank: int, role: str, reliable, heartbeat_interval_s,
         overrides["checkpoint_dir"] = checkpoint_dir
     if resume_from is not None:
         overrides["resume_from"] = resume_from
+    if robust_agg is not None:
+        from ..ml.aggregator.robust import parse_robust_agg
+
+        try:  # fail at the CLI boundary, not mid-round
+            parse_robust_agg(robust_agg)
+        except ValueError as e:
+            raise click.BadParameter(str(e), param_hint="--robust-agg")
+        overrides["robust_agg"] = robust_agg
+    if admission_control is not None:
+        overrides["admission_control"] = admission_control
+    if over_provision is not None:
+        overrides["over_provision"] = over_provision
+    if round_deadline_s is not None:
+        overrides["round_deadline_s"] = round_deadline_s
+    if min_aggregation_clients is not None:
+        overrides["min_aggregation_clients"] = min_aggregation_clients
     args = fedml_tpu.init(fedml_tpu.Config.from_yaml(config, overrides))
     device = fedml_tpu.device.get_device(args)
     dataset = fedml_tpu.data.load(args)

@@ -83,7 +83,10 @@ class ServerAggregator(abc.ABC):
                 base_aggregation_func=FedMLAggOperator.agg,
                 extra_auxiliary_info=self.get_model_params(),
             )
-        return FedMLAggOperator.agg(self.args, raw_client_model_or_grad_list)
+        # center = the current global model: the clipping anchor for
+        # robust_agg=norm_clip (a no-op for every other operator)
+        return FedMLAggOperator.agg(self.args, raw_client_model_or_grad_list,
+                                    center=self.get_model_params())
 
     def on_after_aggregation(self, aggregated_model_or_grad: Any) -> Any:
         if FedMLFHE.is_encrypted(aggregated_model_or_grad):

@@ -1,4 +1,4 @@
-"""Fault-injection (chaos) transport wrapper.
+"""Fault-injection (chaos) plane: transport faults + client-behavior faults.
 
 NEW capability (SURVEY §5: the reference has "no systematic fault
 injection" — crash simulation only via attacks).  ChaosCommManager wraps
@@ -16,6 +16,12 @@ smoke runs:
     register_comm_backend("CHAOS_INPROC", lambda args, rank, size:
         ChaosCommManager(InProcCommManager(rank, size, args.run_id),
                          drop_p=0.1, seed=rank))
+
+``ChaosClientTrainer`` is the DATA-plane counterpart: it wraps any
+ClientTrainer and injects byzantine/straggler client behavior (slow
+training, NaN uploads, sign-flipped or scaled updates) — the adversary
+that proves robust aggregation, update admission control and
+deadline-paced rounds correct (tests/test_aggregation.py byzantine soak).
 """
 
 from __future__ import annotations
@@ -110,3 +116,82 @@ class ChaosCommManager(BaseCommunicationManager):
 
     def stop_receive_message(self) -> None:
         self.inner.stop_receive_message()
+
+
+# ---------------------------------------------------------------------------
+# client-behavior fault injection (the data-plane adversary)
+# ---------------------------------------------------------------------------
+class ChaosClientTrainer:
+    """Wraps any ClientTrainer with byzantine/straggler behavior.
+
+    Modes (``chaos_trainer(inner, "mode[:param]")`` parses the spec):
+
+    * ``slow[:delay_s]``    — straggler: sleep before training (default 1 s);
+    * ``nan``               — poison every uploaded leaf with NaN;
+    * ``sign_flip[:scale]`` — upload ``-scale·w`` (scale default 1.0), the
+      classic gradient-reversal byzantine client;
+    * ``scale[:factor]``    — upload ``factor·w`` (default 10.0), a
+      model-boosting/backdoor-amplification client.
+
+    Perturbations apply to ``get_model_params()`` AFTER training, so the
+    wrapped trainer's own learning dynamics stay untouched — exactly the
+    upload the server would receive from a compromised silo.  Everything
+    else delegates to the inner trainer (``__getattr__``), so the wrapper
+    drops into ``init_client(..., client_trainer=...)`` or any plane that
+    accepts a ClientTrainer.
+    """
+
+    def __init__(self, inner: Any, mode: str = "nan",
+                 param: float = None) -> None:
+        self.inner = inner
+        self.mode = str(mode)
+        defaults = {"slow": 1.0, "nan": 0.0, "sign_flip": 1.0,
+                    "scale": 10.0}
+        if self.mode not in defaults:
+            raise ValueError(
+                f"unknown chaos_trainer mode {mode!r}; expected one of "
+                f"{'|'.join(defaults)}")
+        self.param = float(defaults[self.mode] if param is None else param)
+        self.faults_injected = 0
+
+    def __getattr__(self, name: str) -> Any:
+        if name == "inner":  # pre-__init__ access (copy/pickle) must not recurse
+            raise AttributeError(name)
+        return getattr(self.inner, name)
+
+    def train(self, train_data, device=None, args=None):
+        if self.mode == "slow" and self.param > 0:
+            import time
+
+            logging.info("chaos_trainer: straggling %.2fs", self.param)
+            time.sleep(self.param)
+        return self.inner.train(train_data, device, args)
+
+    def get_model_params(self) -> Any:
+        params = self.inner.get_model_params()
+        if self.mode in ("slow",) or params is None:
+            return params
+        import jax
+        import jax.numpy as jnp
+
+        self.faults_injected += 1
+        if self.mode == "nan":
+            return jax.tree_util.tree_map(
+                lambda w: jnp.full_like(w, jnp.nan)
+                if jnp.issubdtype(jnp.asarray(w).dtype, jnp.floating)
+                else w, params)
+        factor = -self.param if self.mode == "sign_flip" else self.param
+        return jax.tree_util.tree_map(
+            lambda w: w * factor
+            if jnp.issubdtype(jnp.asarray(w).dtype, jnp.floating)
+            else w, params)
+
+
+def chaos_trainer(inner: Any, spec: str) -> ChaosClientTrainer:
+    """Spec-string factory: ``slow:2.5`` / ``nan`` / ``sign_flip`` /
+    ``scale:10`` → a wrapped trainer."""
+    parts = [p for p in str(spec).strip().split(":") if p != ""]
+    if not parts:
+        raise ValueError("empty chaos_trainer spec")
+    param = float(parts[1]) if len(parts) > 1 else None
+    return ChaosClientTrainer(inner, mode=parts[0].lower(), param=param)

@@ -20,7 +20,7 @@ from ..ml.trainer.default_trainer import DefaultServerAggregator
 from .client.fedml_client_master_manager import ClientMasterManager
 from .client.trainer_dist_adapter import TrainerDistAdapter
 from .server.fedml_aggregator import FedMLAggregator
-from .server.fedml_server_manager import FedMLServerManager
+from .server.fedml_server_manager import FedMLServerManager, fleet_size
 
 
 def init_server(args: Any, dataset: Tuple, bundle: Any,
@@ -34,7 +34,7 @@ def init_server(args: Any, dataset: Tuple, bundle: Any,
         aggregator_impl.set_model_params(bundle.init_variables(rng))
     test_global = dataset[3]
     agg = FedMLAggregator(args, aggregator_impl, test_global)
-    client_num = int(args.client_num_per_round)
+    client_num = fleet_size(args)
     opt = str(getattr(args, "federated_optimizer", "FedAvg"))
     if opt == FED_OPT_LIGHTSECAGG:
         from .lightsecagg.lsa_server_manager import LSAServerManager
@@ -52,7 +52,7 @@ def init_client(args: Any, dataset: Tuple, bundle: Any, rank: int,
                 client_trainer: Optional[Any] = None,
                 backend: str = "INPROC") -> ClientMasterManager:
     adapter = TrainerDistAdapter(args, bundle, dataset, client_trainer)
-    size = int(args.client_num_per_round) + 1
+    size = fleet_size(args) + 1
     opt = str(getattr(args, "federated_optimizer", "FedAvg"))
     if opt == FED_OPT_LIGHTSECAGG:
         from .lightsecagg.lsa_client_manager import LSAClientManager
@@ -91,7 +91,7 @@ class LocalFederationRunner:
         return self.client_trainer
 
     def train(self):
-        n = int(self.args.client_num_per_round)
+        n = fleet_size(self.args)
         server = init_server(self.args, self.dataset, self.bundle,
                              self.server_aggregator, backend="INPROC")
         clients: List[ClientMasterManager] = [

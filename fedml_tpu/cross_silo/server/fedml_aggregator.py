@@ -21,6 +21,11 @@ _dup_uploads_total = metrics.counter(
     "Uploads that arrived for a client index already counted this round "
     "(should stay 0 when the reliable plane dedups the transport)",
     labels=("run_id",))
+_quarantined_total = metrics.counter(
+    "fedml_quarantined_updates_total",
+    "Uploads rejected by admission control, by reason "
+    "(structure_mismatch / non_finite / norm_outlier)",
+    labels=("run_id", "reason"))
 
 
 class FedMLAggregator:
@@ -38,6 +43,19 @@ class FedMLAggregator:
         #: never hit this (re-solicitation targets only missing indices)
         self.duplicate_uploads = 0
         self._run_label = str(getattr(args, "run_id", "0"))
+        # update admission control (docs/ROBUSTNESS.md "Data-plane
+        # robustness"): validate every upload against the global tree
+        # before it can enter the received set
+        self.admission_control = bool(
+            getattr(args, "admission_control", False))
+        self.admission_norm_bound = float(
+            getattr(args, "admission_norm_bound", 0) or 0)
+        #: per-round quarantine ledger {client index: last rejection
+        #: reason}, cleared by aggregate() — introspection/ops surface
+        #: (re-solicitation itself is driven by the
+        #: add_local_trained_result return value)
+        self.quarantined_this_round: Dict[int, str] = {}
+        self.quarantined_total = 0
 
     def get_global_model_params(self):
         return self.aggregator.get_model_params()
@@ -46,13 +64,91 @@ class FedMLAggregator:
         self.aggregator.set_model_params(params)
 
     def add_local_trained_result(self, index: int, model_params,
-                                 sample_num) -> None:
+                                 sample_num):
+        """Admit one upload into the round's received set.
+
+        Returns ``None`` on acceptance, else the quarantine reason string
+        (the caller re-solicits the client like a missing upload).
+
+        Keep-first on duplicates: a second upload for an index already
+        counted this round increments the duplicate counters but can
+        NEVER overwrite the aggregated-in result — a late or forged
+        duplicate would otherwise replace the update the round already
+        committed to (and checkpointed).
+        """
         if index in self._received_this_round:
             self.duplicate_uploads += 1
             _dup_uploads_total.labels(run_id=self._run_label).inc()
+            return None
+        if self.admission_control:
+            reason = self._admit(model_params)
+            if reason is not None:
+                self.quarantined_this_round[index] = reason
+                self.quarantined_total += 1
+                _quarantined_total.labels(
+                    run_id=self._run_label, reason=reason).inc()
+                logging.warning(
+                    "server: QUARANTINED upload from client index %d "
+                    "(%s) — not counted, will be re-solicited",
+                    index, reason)
+                return reason
         self.model_dict[index] = model_params
         self.sample_num_dict[index] = float(sample_num)
         self._received_this_round.add(index)
+        return None
+
+    def _admit(self, model_params) -> Optional[str]:
+        """Validate an upload against the global tree: structure/shape/
+        dtype match, NaN/Inf scan, and (when ``admission_norm_bound`` > 0)
+        an update-norm outlier screen.  One fused device reduction, one
+        host sync per upload — this runs in the receive handler, not a
+        hot loop.  Returns the rejection reason or None."""
+        import jax
+        import jax.numpy as jnp
+
+        from ...core.fhe import FedMLFHE
+
+        global_tree = self.get_global_model_params()
+        if FedMLFHE.is_encrypted(model_params):
+            # content checks on ciphertext are meaningless by design
+            return None
+        if isinstance(model_params, tuple):
+            # pair payloads (params, variates) have no single global
+            # counterpart for the structure/norm checks, but the NaN/Inf
+            # scan applies to the whole tuple tree unchanged
+            global_tree = None
+        if global_tree is not None:
+            ref_leaves, ref_def = jax.tree_util.tree_flatten(global_tree)
+            try:
+                got_leaves, got_def = jax.tree_util.tree_flatten(
+                    model_params)
+            except Exception:  # noqa: BLE001 — unflattenable payload
+                return "structure_mismatch"
+            if (got_def != ref_def
+                    or any(jnp.shape(g) != jnp.shape(r)
+                           or jnp.asarray(g).dtype != jnp.asarray(r).dtype
+                           for g, r in zip(got_leaves, ref_leaves))):
+                return "structure_mismatch"
+        finite = jnp.array(True)
+        sq_delta = jnp.zeros((), jnp.float32)
+        ref = (jax.tree_util.tree_leaves(global_tree)
+               if global_tree is not None else None)
+        for i, leaf in enumerate(jax.tree_util.tree_leaves(model_params)):
+            x = jnp.asarray(leaf)
+            if not jnp.issubdtype(x.dtype, jnp.floating):
+                continue
+            xf = x.astype(jnp.float32)
+            finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(xf)))
+            if self.admission_norm_bound > 0 and ref is not None:
+                d = xf - jnp.asarray(ref[i]).astype(jnp.float32)
+                sq_delta = sq_delta + jnp.sum(d * d)
+        finite_host, sq_host = jax.device_get((finite, sq_delta))
+        if not bool(finite_host):
+            return "non_finite"
+        if (self.admission_norm_bound > 0
+                and float(sq_host) > self.admission_norm_bound ** 2):
+            return "norm_outlier"
+        return None
 
     def receive_count(self) -> int:
         return len(self._received_this_round)
@@ -94,6 +190,7 @@ class FedMLAggregator:
         Clears the received set for the next round."""
         idxs = sorted(self._received_this_round)
         self._received_this_round = set()
+        self.quarantined_this_round = {}
         raw = [(self.sample_num_dict[i], self.model_dict[i]) for i in idxs]
         # nests under the server manager's round span via use_ctx; the
         # legacy "server.agg" event pair rides along inside mlops.span
@@ -106,22 +203,37 @@ class FedMLAggregator:
         return agg
 
     # -- selection (reference :113-160) -------------------------------------
+    def _round_rng(self, round_idx: int, stream: int) -> np.random.Generator:
+        """Deterministic per-``(run_id, round_idx)`` RNG.  The reference
+        seeds the GLOBAL ``np.random`` state with the bare round index —
+        any concurrent numpy consumer (another run in-process, a data
+        loader) perturbs the stream, and a crash-resumed server could
+        re-solicit a DIFFERENT cohort than the one it checkpointed.  A
+        private Generator keyed on the run identity makes the cohort a
+        pure function of (run_id, random_seed, round_idx)."""
+        import zlib
+
+        seq = np.random.SeedSequence([
+            zlib.crc32(self._run_label.encode()),
+            int(getattr(self.args, "random_seed", 0) or 0),
+            int(round_idx), int(stream)])
+        return np.random.default_rng(seq)
+
     def client_sampling(self, round_idx: int, client_num_in_total: int,
                         client_num_per_round: int) -> List[int]:
-        if client_num_in_total == client_num_per_round:
+        if client_num_in_total <= client_num_per_round:
             return list(range(client_num_in_total))
-        np.random.seed(round_idx)
-        return [int(c) for c in np.random.choice(
-            range(client_num_in_total), client_num_per_round, replace=False)]
+        rng = self._round_rng(round_idx, stream=0)
+        return [int(c) for c in rng.choice(
+            client_num_in_total, client_num_per_round, replace=False)]
 
     def data_silo_selection(self, round_idx: int, data_silo_num_in_total: int,
                             client_num_in_total: int) -> List[int]:
         if data_silo_num_in_total == client_num_in_total:
             return list(range(data_silo_num_in_total))
-        np.random.seed(round_idx)
-        return [int(c) for c in np.random.choice(
-            range(data_silo_num_in_total), client_num_in_total,
-            replace=True)]
+        rng = self._round_rng(round_idx, stream=1)
+        return [int(c) for c in rng.choice(
+            data_silo_num_in_total, client_num_in_total, replace=True)]
 
     def test_on_server_for_all_clients(self, round_idx: int) -> Dict[str, Any]:
         with tracing.span("server.eval", round=round_idx):

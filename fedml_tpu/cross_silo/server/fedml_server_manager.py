@@ -43,6 +43,21 @@ _resumed_round = metrics.gauge(
     "fedml_resumed_from_round",
     "Round index this server restored from a crash-resume checkpoint "
     "(absent when the run started fresh)", labels=("run_id",))
+_stragglers_dropped = metrics.counter(
+    "fedml_round_stragglers_dropped_total",
+    "Clients dropped from a round by the deadline pacer (solicited but "
+    "unreported when the round deadline fired)", labels=("run_id",))
+
+
+def fleet_size(args: Any) -> int:
+    """Physical client ranks per round: K plus the straggler-tolerance
+    over-provision margin, capped by the population.  The SINGLE source of
+    truth shared by the runner (how many client processes to spawn) and
+    the server's cohort sampling — if these drifted apart the server would
+    solicit ranks with no running client behind them."""
+    return min(int(args.client_num_per_round)
+               + int(getattr(args, "over_provision", 0) or 0),
+               int(args.client_num_in_total))
 
 
 class FedMLServerManager(FedMLCommManager):
@@ -67,6 +82,34 @@ class FedMLServerManager(FedMLCommManager):
             getattr(args, "round_timeout_s", 0) or 0)
         self.min_clients = int(
             getattr(args, "min_clients_per_round", 1) or 1)
+        # straggler-tolerant pacing (docs/ROBUSTNESS.md "Data-plane
+        # robustness"): over-provision selects K+m clients while the
+        # aggregator's completion target stays K (the first K arrivals
+        # close the round); the round deadline aggregates whatever arrived
+        # once it fires (never below min_aggregation_clients, extending by
+        # grace periods below that), dropping unreported solicited ranks
+        # exactly like heartbeat-dead clients
+        self.over_provision = int(getattr(args, "over_provision", 0) or 0)
+        self.round_deadline_s = float(
+            getattr(args, "round_deadline_s", 0) or 0)
+        self.deadline_grace_s = float(
+            getattr(args, "round_deadline_grace_s", 2.0) or 2.0)
+        self.min_agg_clients = max(1, int(
+            getattr(args, "min_aggregation_clients", 1) or 1))
+        self._deadline_timer: Optional[threading.Timer] = None
+        #: ranks the deadline pacer dropped while they were (presumably)
+        #: still training: they already hold the next round's broadcast in
+        #: their queue, so their next heartbeat must NOT trigger a
+        #: late-join catch-up re-send (a duplicate full training pass);
+        #: an explicit ONLINE re-announce (a restarted client) still does
+        self._deadline_dropped: set = set()
+        #: ranks already re-solicited after a quarantined upload this
+        #: round — bounded so a persistently-byzantine client costs at
+        #: most ``admission_resolicit_max`` extra training passes per
+        #: round before the deadline pacer completes without it
+        self._quarantine_resolicits: Dict[int, int] = {}
+        self._resolicit_max = int(
+            getattr(args, "admission_resolicit_max", 1) or 0)
         self._round_lock = threading.RLock()
         self._round_timer: Optional[threading.Timer] = None
         self._init_timer: Optional[threading.Timer] = None
@@ -129,11 +172,18 @@ class FedMLServerManager(FedMLCommManager):
                 self._resume_training()
         super().run()
 
+    def _cohort_size(self) -> int:
+        """Clients to solicit per round — the aggregator's completion
+        target stays K, so the slowest m solicited clients never gate the
+        round."""
+        return fleet_size(self.args)
+
     def finish(self) -> None:
         self._hb_stop.set()
         with self._round_lock:
             self._finishing = True
-            for timer in (self._round_timer, self._init_timer):
+            for timer in (self._round_timer, self._init_timer,
+                          self._deadline_timer):
                 if timer is not None:
                     timer.cancel()
         super().finish()
@@ -191,12 +241,13 @@ class FedMLServerManager(FedMLCommManager):
         self.is_initialized = True
         self.client_id_list_in_this_round = self.aggregator.client_sampling(
             self.args.round_idx, int(self.args.client_num_in_total),
-            int(self.args.client_num_per_round))
+            self._cohort_size())
         self.data_silo_index_of_client = self.aggregator.data_silo_selection(
             self.args.round_idx, int(self.args.client_num_in_total),
             len(self.client_id_list_in_this_round))
         self._open_round_span()
         self._arm_round_timer()
+        self._arm_deadline_timer()
         if self.aggregator.check_whether_all_receive():
             # the crash hit AFTER the last upload was persisted but BEFORE
             # aggregation: no client is missing, so no upload will ever
@@ -319,6 +370,15 @@ class FedMLServerManager(FedMLCommManager):
                             "declared dead", sender)
         if not (announce or was_online is not True):
             return
+        if not announce and sender in self._deadline_dropped:
+            # dropped by the deadline pacer for SLOWNESS, not death: the
+            # client is alive and already holds the current broadcast in
+            # its queue — a catch-up re-send would cost it a duplicate
+            # training pass.  An explicit ONLINE announce (restarted
+            # process, empty queue) still takes the catch-up path below.
+            self._deadline_dropped.discard(sender)
+            return
+        self._deadline_dropped.discard(sender)
         if not self.is_initialized:
             if len(self.client_online_status) == self.client_num:
                 self._start_training()
@@ -380,13 +440,14 @@ class FedMLServerManager(FedMLCommManager):
     def send_init_msg(self) -> None:
         self.client_id_list_in_this_round = self.aggregator.client_sampling(
             self.args.round_idx, int(self.args.client_num_in_total),
-            int(self.args.client_num_per_round))
+            self._cohort_size())
         self.data_silo_index_of_client = self.aggregator.data_silo_selection(
             self.args.round_idx, int(self.args.client_num_in_total),
             len(self.client_id_list_in_this_round))
         self._open_round_span()
         self._broadcast_round()
         self._arm_round_timer()
+        self._arm_deadline_timer()
 
     def _broadcast_round(self, only_rank: Optional[int] = None) -> None:
         """Send the current round's model to every participating rank (or
@@ -449,6 +510,75 @@ class FedMLServerManager(FedMLCommManager):
                 len(self.client_id_list_in_this_round))
             self._complete_round()
 
+    def _quarantine_exhausted(self, rank: int) -> bool:
+        """True when this rank's uploads were quarantined this round AND
+        its re-solicit budget is spent — nothing further is expected from
+        it until the next round.  Caller holds ``_round_lock``."""
+        return ((rank - 1) in self.aggregator.quarantined_this_round
+                and self._quarantine_resolicits.get(rank, 0)
+                >= self._resolicit_max)
+
+    # -- deadline-paced rounds (straggler tolerance) -------------------------
+    def _arm_deadline_timer(self, delay_s: Optional[float] = None) -> None:
+        """Arm (or re-arm, for a grace extension) the round deadline.
+        Caller holds ``_round_lock``."""
+        if self.round_deadline_s <= 0:
+            return
+        if self._deadline_timer is not None:
+            self._deadline_timer.cancel()
+        self._deadline_timer = threading.Timer(
+            self.round_deadline_s if delay_s is None else delay_s,
+            self._on_round_deadline, args=(self.args.round_idx,))
+        self._deadline_timer.daemon = True
+        self._deadline_timer.start()
+
+    def _on_round_deadline(self, round_idx: int) -> None:
+        """Deadline fired: aggregate with whoever reported, dropping the
+        stragglers from the round exactly like heartbeat-dead clients (a
+        straggler that shows up later rejoins via the late-join catch-up
+        path).  Below ``min_aggregation_clients`` the round is NEVER
+        closed: re-solicit the missing ranks and extend by the grace
+        period until the floor is met."""
+        with self._round_lock:
+            if self.args.round_idx != round_idx or self._finishing:
+                return  # round already completed normally
+            got = self.aggregator.receive_count()
+            ranks = set(self._ranks_for(self.client_id_list_in_this_round))
+            # quarantined ranks DID report on time — their uploads were
+            # rejected by admission control and already counted in the
+            # quarantine metric; conflating them with stragglers would
+            # make a data-poisoning problem read as a pacing problem
+            quarantined = {r for r in ranks
+                           if (r - 1) in self.aggregator.quarantined_this_round}
+            missing = [r for r in ranks
+                       if not self.aggregator.has_received(r - 1)]
+            stragglers = [r for r in missing if r not in quarantined]
+            if got < self.min_agg_clients:
+                # re-solicit only ranks a retry could actually recover
+                # (a quarantine-exhausted client would just be rejected
+                # again) and extend by the grace period
+                resend = [r for r in missing
+                          if not self._quarantine_exhausted(r)]
+                logging.warning(
+                    "server: round %d deadline with %d results (< min "
+                    "aggregation floor %d) — re-soliciting %s, extending "
+                    "by %.1fs grace", round_idx, got, self.min_agg_clients,
+                    resend, self.deadline_grace_s)
+                for rank in resend:
+                    self._broadcast_round(only_rank=rank)
+                self._arm_deadline_timer(self.deadline_grace_s)
+                return
+            for rank in stragglers:
+                self.client_online_status[rank] = False
+                self._deadline_dropped.add(rank)
+                _stragglers_dropped.labels(run_id=self._run_label).inc()
+            logging.warning(
+                "server: round %d deadline — aggregating %d/%d results, "
+                "dropping stragglers %s (quarantined, not stragglers: %s)",
+                round_idx, got, len(ranks), stragglers,
+                sorted(quarantined))
+            self._complete_round()
+
     def _ranks_for(self, client_ids: List[int]) -> List[int]:
         """client slots → comm ranks 1..client_num (round-robin when
         client_num_per_round < physical clients is 1:1 in this build)."""
@@ -488,8 +618,30 @@ class FedMLServerManager(FedMLCommManager):
                 self._round_train_metrics[sender] = train_metrics
             self._last_seen[sender] = time.monotonic()
             self.client_online_status[sender] = True
-            self.aggregator.add_local_trained_result(
+            reason = self.aggregator.add_local_trained_result(
                 sender - 1, model_params, local_sample_number)
+            if reason is not None:
+                # quarantined: the upload never entered the received set —
+                # re-solicit the client like a missing upload (PR 4's
+                # re-solicitation path), bounded per round so a
+                # persistently-byzantine sender can't loop training
+                # forever; past the bound the deadline pacer completes the
+                # round without it
+                n_prev = self._quarantine_resolicits.get(sender, 0)
+                if n_prev < self._resolicit_max:
+                    self._quarantine_resolicits[sender] = n_prev + 1
+                    logging.warning(
+                        "server: re-soliciting client %d after "
+                        "quarantined upload (%s, attempt %d/%d)",
+                        sender, reason, n_prev + 1, self._resolicit_max)
+                    self._broadcast_round(only_rank=sender)
+                else:
+                    # budget exhausted: this rank is given up on for the
+                    # round — it no longer blocks early completion, so a
+                    # persistent byzantine client can't stall a run that
+                    # has no deadline/timeout pacer configured
+                    self._maybe_complete_early()
+                return
             self._persist_round_state()
             if self.aggregator.check_whether_all_receive():
                 self._complete_round()
@@ -499,15 +651,27 @@ class FedMLServerManager(FedMLCommManager):
     def _maybe_complete_early(self) -> None:
         """Elastic early completion: when every ONLINE participant has
         reported, don't idle out the full timeout waiting for ranks the
-        server already knows are absent (round timer OR heartbeat detector
-        supplies the liveness signal).  Caller holds ``_round_lock``."""
-        if self.round_timeout_s <= 0 and self._hb_interval <= 0:
+        server already knows are absent (round timer, heartbeat detector
+        OR deadline pacer supplies the liveness signal).  A
+        deadline-dropped straggler without heartbeats stays offline, so
+        later rounds close on the survivors' uploads instead of paying
+        the deadline again; a heartbeating straggler re-marks itself
+        online and rounds run at deadline pace — bounded, since it may
+        yet report in time.  Admission control is a fourth signal: a rank
+        quarantined past its re-solicit budget is given up on for the
+        round (its uploads will keep being rejected), so it must not hold
+        the round open.  Caller holds ``_round_lock``."""
+        if (self.round_timeout_s <= 0 and self._hb_interval <= 0
+                and self.round_deadline_s <= 0
+                and not self.aggregator.admission_control):
             return
         ranks = set(self._ranks_for(self.client_id_list_in_this_round))
-        online = {r for r in ranks if self.client_online_status.get(r)}
+        online = {r for r in ranks if self.client_online_status.get(r)
+                  and not self._quarantine_exhausted(r)}
         if (online
                 and all(self.aggregator.has_received(r - 1) for r in online)
-                and self.aggregator.receive_count() >= self.min_clients):
+                and self.aggregator.receive_count()
+                >= max(self.min_clients, self.min_agg_clients)):
             logging.info(
                 "server: round %d — all %d online participants reported; "
                 "completing without waiting for %d offline",
@@ -519,6 +683,8 @@ class FedMLServerManager(FedMLCommManager):
         Caller must hold ``_round_lock``."""
         if self._round_timer is not None:
             self._round_timer.cancel()
+        if self._deadline_timer is not None:
+            self._deadline_timer.cancel()
         mlops.event("server.wait", False, self.args.round_idx)
         n_reported = self.aggregator.receive_count()
         # aggregation + eval run UNDER the round span's context so the
@@ -560,13 +726,15 @@ class FedMLServerManager(FedMLCommManager):
             return
         # next round
         self._caught_up_this_round = set()
+        self._quarantine_resolicits = {}
         self.client_id_list_in_this_round = self.aggregator.client_sampling(
             self.args.round_idx, int(self.args.client_num_in_total),
-            int(self.args.client_num_per_round))
+            self._cohort_size())
         mlops.event("server.wait", True, self.args.round_idx)
         self._open_round_span()
         self._broadcast_round()
         self._arm_round_timer()
+        self._arm_deadline_timer()
 
     def send_finish_to_all(self) -> None:
         for rank in range(1, self.client_num + 1):

@@ -1,0 +1,18 @@
+from .agg_operator import (  # noqa: F401
+    FedMLAggOperator,
+    agg_psum,
+    agg_stacked,
+    uniform_average,
+    weighted_average,
+)
+from .robust import (  # noqa: F401
+    RobustAggSpec,
+    geo_median,
+    krum,
+    median,
+    norm_clip,
+    parse_robust_agg,
+    robust_agg_stacked,
+    stack_grad_list,
+    trimmed_mean,
+)

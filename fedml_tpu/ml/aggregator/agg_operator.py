@@ -61,13 +61,21 @@ def agg_stacked(stacked: Any, weights: jnp.ndarray) -> Any:
     ``weights``: [n_clients] nonnegative (need not be normalized — masked-out
     clients carry weight 0, which implements *selective* aggregation without
     dynamic shapes).
+
+    Accumulation runs in float32 regardless of the leaf dtype (a bf16 sum
+    over many clients loses low-order bits), and the reduced leaf is cast
+    BACK to its input dtype — a bf16 model tree comes back bf16, not
+    silently widened to f32.  Non-float leaves keep the f32 result (a
+    "weighted average" of integers is fractional by construction).
     """
-    norm = jnp.maximum(jnp.sum(weights), 1e-12)
-    w = weights / norm
+    w = weights.astype(jnp.float32)
+    w = w / jnp.maximum(jnp.sum(w), 1e-12)
 
     def _leaf(x: jnp.ndarray) -> jnp.ndarray:
         wshape = (x.shape[0],) + (1,) * (x.ndim - 1)
-        return jnp.sum(x * w.reshape(wshape), axis=0)
+        acc = jnp.sum(x.astype(jnp.float32) * w.reshape(wshape), axis=0)
+        return (acc.astype(x.dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else acc)
 
     return jax.tree_util.tree_map(_leaf, stacked)
 
@@ -84,30 +92,61 @@ def agg_psum(update: Any, weight: jnp.ndarray, axis_name: str) -> Any:
 
 
 class FedMLAggOperator:
-    """Dispatch on ``args.federated_optimizer`` (reference :10-30)."""
+    """Dispatch on ``args.federated_optimizer`` (reference :10-30), with a
+    byzantine-robust override: ``args.robust_agg`` replaces the weighted
+    average with a stacked robust operator (trimmed mean / median / Krum /
+    geometric median / norm clipping — `ml/aggregator/robust.py`) on every
+    plane that funnels through here (SP, cross-silo server)."""
 
     @staticmethod
-    def agg(args: Any, raw_grad_list: List[Tuple[float, Any]]) -> Any:
+    def _reduce(args: Any, grad_list: List[Tuple[float, Any]],
+                center: Any = None) -> Any:
+        """One weighted reduction — robust when ``args.robust_agg`` asks
+        for it, the plain sample-weighted average otherwise."""
+        from .robust import parse_robust_agg, robust_agg_stacked, stack_grad_list
+
+        spec = parse_robust_agg(getattr(args, "robust_agg", None))
+        if spec is None or not grad_list:
+            return weighted_average(grad_list)
+        # a single-result round still goes through the operator: every op
+        # degenerates to that client EXCEPT norm_clip, which must keep
+        # clipping exactly when a lone upload has maximal influence
+        stacked = stack_grad_list([g for _, g in grad_list])
+        weights = jnp.asarray([float(n) for n, _ in grad_list], jnp.float32)
+        return robust_agg_stacked(spec, stacked, weights, center=center)
+
+    @staticmethod
+    def agg(args: Any, raw_grad_list: List[Tuple[float, Any]],
+            center: Any = None) -> Any:
+        """``center`` is the current global model when the caller has one
+        (ServerAggregator passes it) — the clipping center for
+        ``robust_agg=norm_clip:C``; ignored by every other path."""
         opt = getattr(args, "federated_optimizer", "FedAvg")
         # pair-payload paths apply only when callers actually ship
         # (params, extra) tuples (reference passes state+variate pairs)
         is_pair = raw_grad_list and isinstance(raw_grad_list[0][1], tuple)
         if not is_pair and opt in (FED_OPT_SCAFFOLD, FED_OPT_MIME):
-            return weighted_average(raw_grad_list)
+            return FedMLAggOperator._reduce(args, raw_grad_list, center)
         if opt == FED_OPT_SCAFFOLD:
             # items are (n_k, (params, c_delta)); weights by n_k, c uniform
-            # over client_num_in_total (reference :100-118).
+            # over client_num_in_total (reference :100-118).  The robust
+            # operator applies to the PARAMS component only: control
+            # variates average uniformly by contract, and a byzantine
+            # variate's reach is bounded by 1/client_num_in_total.
             n_total = float(getattr(args, "client_num_in_total", len(raw_grad_list)))
-            params_avg = weighted_average(
-                [(n, pair[0]) for n, pair in raw_grad_list])
+            params_avg = FedMLAggOperator._reduce(
+                args, [(n, pair[0]) for n, pair in raw_grad_list], center)
             c_avg = uniform_average(
                 [pair[1] for _, pair in raw_grad_list], denom=n_total)
             return params_avg, c_avg
         if opt == FED_OPT_MIME:
-            # items are (n_k, (params, grads)): both sample-weighted (:120-134)
-            params_avg = weighted_average(
-                [(n, pair[0]) for n, pair in raw_grad_list])
-            grads_avg = weighted_average(
-                [(n, pair[1]) for n, pair in raw_grad_list])
+            # items are (n_k, (params, grads)): both sample-weighted
+            # (:120-134) — and both robustly reduced under robust_agg (a
+            # poisoned full-grad corrupts the server momentum just as
+            # surely as poisoned params corrupt the model)
+            params_avg = FedMLAggOperator._reduce(
+                args, [(n, pair[0]) for n, pair in raw_grad_list], center)
+            grads_avg = FedMLAggOperator._reduce(
+                args, [(n, pair[1]) for n, pair in raw_grad_list])
             return params_avg, grads_avg
-        return weighted_average(raw_grad_list)
+        return FedMLAggOperator._reduce(args, raw_grad_list, center)

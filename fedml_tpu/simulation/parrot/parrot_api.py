@@ -45,6 +45,7 @@ from ...constants import (
 )
 from ...core import mlops
 from ...ml.aggregator.agg_operator import agg_stacked
+from ...ml.aggregator.robust import parse_robust_agg, robust_agg_stacked
 from ...ml.engine.local_update import build_eval_step, build_local_update, make_batches
 from ...ml.engine.mesh import MeshManager, build_hybrid_mesh, build_mesh
 from ...ml.engine.optimizers import build_server_optimizer
@@ -333,12 +334,23 @@ class ParrotAPI:
     def _build_aggregate(self):
         """Shared post-vmap logic: weighted aggregation + per-algorithm
         server-state update, operating on stacked per-client outputs
-        (uniform round and bucketed round feed the same contract)."""
+        (uniform round and bucketed round feed the same contract).
+
+        ``robust_agg`` swaps the fused weighted mean for a stacked robust
+        operator (`ml/aggregator/robust.py`) INSIDE the same jit — the
+        per-client outputs already carry the leading client axis the
+        robust kernels consume, so byzantine-robust rounds cost one fused
+        sort/distance reduction, not a host round-trip."""
         algo = self.algo
+        robust_spec = parse_robust_agg(
+            getattr(self.args, "robust_agg", None))
 
         def aggregate(global_vars, server_state, client_ids, new_vars,
                       algo_out, metrics, weights):
-            agg_vars = agg_stacked(new_vars, weights)
+            agg_vars = (robust_agg_stacked(robust_spec, new_vars, weights,
+                                           center=global_vars)
+                        if robust_spec is not None
+                        else agg_stacked(new_vars, weights))
             new_state = dict(server_state)
 
             if algo == FED_OPT_FEDOPT:
@@ -383,7 +395,12 @@ class ParrotAPI:
                     global_vars["params"], d_avg))
             elif algo == FED_OPT_MIME:
                 beta = float(getattr(self.args, "server_momentum", 0.9) or 0.9)
-                g = agg_stacked(algo_out["full_grad"], weights)
+                # robust reduce the full grads too: poisoned grads corrupt
+                # the server momentum just as poisoned params corrupt w
+                g = (robust_agg_stacked(robust_spec,
+                                        algo_out["full_grad"], weights)
+                     if robust_spec is not None
+                     else agg_stacked(algo_out["full_grad"], weights))
                 new_state["momentum"] = jax.tree_util.tree_map(
                     lambda m, gg: beta * m + (1.0 - beta) * gg,
                     server_state["momentum"], g)
@@ -545,7 +562,7 @@ class ParrotAPI:
             "batch_size", "client_num_in_total", "client_num_per_round",
             "compute_dtype", "data_dtype", "hetero_buckets", "conv_impl",
             "server_lr", "server_momentum", "feddyn_alpha", "fedprox_mu",
-            "random_seed")]
+            "random_seed", "robust_agg")]
         h.update("|".join(cfg).encode())
         h.update(repr((self.x_all.shape, str(self.x_all.dtype),
                        self.y_all.shape, self.nb, self.bs,
@@ -559,7 +576,8 @@ class ParrotAPI:
                     "ml/engine/local_update.py",
                     "ml/engine/model_bundle.py",
                     "ml/engine/optimizers.py",
-                    "ml/aggregator/agg_operator.py"):
+                    "ml/aggregator/agg_operator.py",
+                    "ml/aggregator/robust.py"):
             try:
                 with open(os.path.join(pkg, rel), "rb") as f:
                     h.update(f.read())

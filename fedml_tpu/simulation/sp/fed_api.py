@@ -58,6 +58,15 @@ class FedSimAPI:
         self.trainer = client_trainer or DefaultClientTrainer(bundle, args)
         self.aggregator = server_aggregator or DefaultServerAggregator(
             bundle, args)
+        # robust aggregation rides FedMLAggOperator.agg unchanged (the
+        # aggregator funnels through it); parse the selector NOW so a
+        # typo'd --robust-agg fails at startup, not rounds in
+        from ...ml.aggregator.robust import parse_robust_agg
+
+        robust_spec = parse_robust_agg(getattr(args, "robust_agg", None))
+        if robust_spec is not None:
+            logging.info("sp: byzantine-robust aggregation enabled (%s)",
+                         robust_spec)
 
         rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0) or 0))
         self.global_vars = bundle.init_variables(

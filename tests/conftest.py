@@ -29,7 +29,8 @@ _SMOKE_FILES = {
     "test_lint.py", "test_lint_wholeprogram.py",
     # test_reliability.py runs in its own dedicated smoke.yml step (like
     # test_observability.py) — listing it here would run the chaos soak
-    # twice per CI job
+    # twice per CI job; test_aggregation.py likewise runs in the
+    # byzantine-soak step (its slow-marked soaks only run there)
 }
 
 

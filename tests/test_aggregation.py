@@ -1,0 +1,490 @@
+"""The data-plane defense stack: aggregation arithmetic (weighted /
+uniform / stacked), byzantine-robust operators, update admission control,
+straggler-tolerant round pacing — proven end-to-end by a seeded
+byzantine+straggler soak (slow tier; CI runs it in the dedicated
+byzantine-soak step)."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.ml.aggregator.agg_operator import (
+    FedMLAggOperator,
+    agg_stacked,
+    uniform_average,
+    weighted_average,
+)
+from fedml_tpu.ml.aggregator.robust import (
+    geo_median,
+    krum,
+    median,
+    norm_clip,
+    parse_robust_agg,
+    robust_agg_stacked,
+    stack_grad_list,
+    trimmed_mean,
+)
+
+
+def _tree(val, shape=(4, 3), dtype=jnp.float32):
+    return {"w": jnp.full(shape, val, dtype),
+            "b": jnp.full((2,), val, dtype)}
+
+
+def _honest_stack(n=5, base=1.0, jitter=0.05, seed=0, dtype=jnp.float32):
+    rng = np.random.RandomState(seed)
+    trees = [jax.tree_util.tree_map(
+        lambda x: x + jitter * jnp.asarray(
+            rng.randn(*np.shape(x)).astype(np.float32)),
+        _tree(base, dtype=dtype)) for _ in range(n)]
+    return trees
+
+
+# ------------------------------------------------------------- arithmetic
+def test_weighted_average_weights_by_sample_count():
+    out = weighted_average([(1.0, _tree(0.0)), (3.0, _tree(4.0))])
+    np.testing.assert_allclose(np.asarray(out["w"]), 3.0, atol=1e-6)
+    # nonpositive total falls back to uniform
+    out = weighted_average([(0.0, _tree(2.0)), (0.0, _tree(4.0))])
+    np.testing.assert_allclose(np.asarray(out["b"]), 3.0, atol=1e-6)
+
+
+def test_uniform_average_custom_denominator():
+    out = uniform_average([_tree(2.0), _tree(4.0)], denom=4.0)
+    np.testing.assert_allclose(np.asarray(out["w"]), 1.5, atol=1e-6)
+
+
+def test_agg_stacked_mask_selects_clients():
+    stacked = stack_grad_list([_tree(1.0), _tree(5.0), _tree(9.0)])
+    # masked-out middle client must not contribute
+    out = agg_stacked(stacked, jnp.asarray([1.0, 0.0, 3.0]))
+    np.testing.assert_allclose(np.asarray(out["w"]), 7.0, atol=1e-5)
+
+
+def test_agg_stacked_keeps_bf16_leaves_bf16():
+    """f32 accumulation, but the reduced leaf comes back in the INPUT
+    dtype — a bf16 model tree must not silently widen to f32."""
+    stacked = stack_grad_list(
+        [_tree(1.0, dtype=jnp.bfloat16), _tree(3.0, dtype=jnp.bfloat16)])
+    out = agg_stacked(stacked, jnp.asarray([1.0, 1.0]))
+    assert out["w"].dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out["w"], np.float32), 2.0, atol=1e-2)
+
+
+def test_agg_operator_scaffold_and_mime_pair_paths(args_factory):
+    pairs = [(2.0, (_tree(1.0), _tree(10.0))),
+             (2.0, (_tree(3.0), _tree(30.0)))]
+    args = args_factory(federated_optimizer="SCAFFOLD",
+                        client_num_in_total=4)
+    params_avg, c_avg = FedMLAggOperator.agg(args, pairs)
+    np.testing.assert_allclose(np.asarray(params_avg["w"]), 2.0, atol=1e-6)
+    # control variates average uniformly over client_num_in_total
+    np.testing.assert_allclose(np.asarray(c_avg["w"]), 10.0, atol=1e-6)
+    args = args_factory(federated_optimizer="Mime")
+    params_avg, grads_avg = FedMLAggOperator.agg(args, pairs)
+    np.testing.assert_allclose(np.asarray(grads_avg["w"]), 20.0, atol=1e-6)
+
+
+# ------------------------------------------------- robust operator suite
+def test_parse_robust_agg_specs():
+    assert parse_robust_agg(None) is None
+    assert parse_robust_agg("") is None
+    s = parse_robust_agg("trimmed_mean:0.2")
+    assert s.name == "trimmed_mean" and s.param == pytest.approx(0.2)
+    assert parse_robust_agg("median").name == "median"
+    assert parse_robust_agg("krum:1") == ("krum", 1.0, 1)
+    assert parse_robust_agg("multi_krum:1:3").k == 3
+    assert parse_robust_agg("geo_median:12").param == 12
+    assert parse_robust_agg("norm_clip:5").param == 5.0
+    for bad in ("bogus", "trimmed_mean:0.7", "krum", "norm_clip:-1",
+                "norm_clip", "multi_krum:x"):
+        with pytest.raises(ValueError):
+            parse_robust_agg(bad)
+
+
+def test_trimmed_mean_ignores_f_outliers():
+    trees = _honest_stack(5)
+    trees.append(_tree(1e6))          # one wild byzantine client
+    stacked = stack_grad_list(trees)
+    w = jnp.ones(6)
+    out = trimmed_mean(stacked, w, trim_frac=0.2)   # k = floor(.2*6) = 1
+    honest = np.mean([np.asarray(t["w"]) for t in trees[:5]])
+    assert abs(float(np.asarray(out["w"]).mean()) - honest) < 0.2
+    assert np.isfinite(np.asarray(out["w"])).all()
+
+
+def test_median_bounded_by_honest_range():
+    trees = _honest_stack(4)
+    trees += [_tree(-1e5), _tree(jnp.nan)]          # < half byzantine
+    stacked = stack_grad_list(trees)
+    out = median(stacked, jnp.ones(6))
+    vals = np.asarray(out["w"])
+    honest = np.stack([np.asarray(t["w"]) for t in trees[:4]])
+    assert np.isfinite(vals).all()
+    assert (vals >= honest.min(axis=0) - 1e-5).all()
+    assert (vals <= honest.max(axis=0) + 1e-5).all()
+
+
+def test_krum_picks_an_honest_client():
+    trees = _honest_stack(5)
+    trees.append(_tree(50.0))
+    stacked = stack_grad_list(trees)
+    out = krum(stacked, jnp.ones(6), f=1, k=1)
+    # the pick is exactly one of the honest updates, never the outlier
+    picked = np.asarray(out["w"])
+    honest = [np.asarray(t["w"]) for t in trees[:5]]
+    assert any(np.allclose(picked, h, atol=1e-5) for h in honest)
+
+
+def test_krum_degenerate_mask_falls_back_to_weighted_mean():
+    """With n_valid <= f+2 every Krum score is +inf and top_k's arbitrary
+    picks may all be masked — the fallback must return the valid clients'
+    weighted mean, never a silent zero model."""
+    trees = _honest_stack(4, jitter=0.0)
+    stacked = stack_grad_list(trees)
+    w = jnp.asarray([0.0, 0.0, 0.0, 2.0])   # lone survivor at index 3
+    out = krum(stacked, w, f=1, k=1)
+    np.testing.assert_allclose(np.asarray(out["w"]), 1.0, atol=1e-5)
+
+
+def test_multi_krum_averages_honest_selection():
+    trees = _honest_stack(5)
+    trees.append(_tree(50.0))
+    out = krum(stack_grad_list(trees), jnp.ones(6), f=1, k=3)
+    assert abs(float(np.asarray(out["w"]).mean()) - 1.0) < 0.2
+
+
+def test_geo_median_resists_outlier():
+    trees = _honest_stack(5)
+    trees.append(_tree(1e4))
+    out = geo_median(stack_grad_list(trees), jnp.ones(6), iters=32)
+    assert abs(float(np.asarray(out["w"]).mean()) - 1.0) < 0.2
+
+
+def test_norm_clip_bounds_outlier_influence():
+    trees = _honest_stack(5, jitter=0.0)
+    trees.append(_tree(1e6))
+    center = _tree(1.0)
+    out = norm_clip(stack_grad_list(trees), jnp.ones(6), 1.0, center=center)
+    # the outlier's delta is clipped to norm 1 → total shift ≤ 1/6
+    assert abs(float(np.asarray(out["w"]).mean()) - 1.0) < 0.2
+
+
+def test_robust_ops_respect_weight_mask():
+    """Weight-0 clients are excluded exactly — a masked byzantine client
+    must not shift any operator (the Parrot selective-aggregation
+    contract)."""
+    trees = _honest_stack(4, jitter=0.0)
+    trees.append(_tree(1e6))
+    stacked = stack_grad_list(trees)
+    w = jnp.asarray([1.0, 1.0, 1.0, 1.0, 0.0])
+    for spec in ("trimmed_mean:0.0", "median", "krum:0", "geo_median:8",
+                 "norm_clip:100"):
+        out = robust_agg_stacked(parse_robust_agg(spec), stacked, w,
+                                 center=_tree(1.0))
+        np.testing.assert_allclose(
+            np.asarray(out["w"]), 1.0, atol=1e-3, err_msg=spec)
+
+
+def test_robust_ops_jit_compatible_on_stacked_pytrees():
+    """Acceptance: every operator traces under jit on a stacked pytree
+    (leading client axis) with a TRACED weight mask — no per-leaf Python
+    loop over clients in the hot path, one compiled program per
+    participation pattern."""
+    trees = _honest_stack(6)
+    stacked = stack_grad_list(trees)
+    for spec_str in ("trimmed_mean:0.2", "median", "krum:1",
+                     "multi_krum:1:2", "geo_median:4", "norm_clip:2.0"):
+        spec = parse_robust_agg(spec_str)
+        fn = jax.jit(lambda s, w, sp=spec: robust_agg_stacked(sp, s, w))
+        out = fn(stacked, jnp.ones(6))
+        assert np.isfinite(np.asarray(out["w"])).all(), spec_str
+        # same compiled fn, different mask → still correct (shapes static)
+        out2 = fn(stacked, jnp.asarray([1., 1., 1., 0., 0., 0.]))
+        assert np.isfinite(np.asarray(out2["w"])).all(), spec_str
+
+
+def test_agg_operator_threads_robust_spec(args_factory):
+    """--robust-agg reroutes FedMLAggOperator.agg (the SP + cross-silo
+    funnel) through the stacked robust operator."""
+    grad_list = [(10.0, t) for t in _honest_stack(4)]
+    grad_list.append((10.0, _tree(1e6)))
+    args = args_factory(robust_agg="median")
+    out = FedMLAggOperator.agg(args, grad_list)
+    assert abs(float(np.asarray(out["w"]).mean()) - 1.0) < 0.2
+    # plain average for contrast is dragged away by the outlier
+    plain = FedMLAggOperator.agg(args_factory(), grad_list)
+    assert float(np.asarray(plain["w"]).mean()) > 1e4
+    # pair payloads: robust on the params component, uniform variates
+    pairs = [(1.0, (t, _tree(0.0))) for t in _honest_stack(4)]
+    pairs.append((1.0, (_tree(1e6), _tree(0.0))))
+    args = args_factory(robust_agg="median", federated_optimizer="SCAFFOLD",
+                        client_num_in_total=5)
+    params_avg, _ = FedMLAggOperator.agg(args, pairs)
+    assert abs(float(np.asarray(params_avg["w"]).mean()) - 1.0) < 0.2
+
+
+# --------------------------------------------------- admission control
+class _StubImpl:
+    """Minimal ServerAggregator stand-in: holds a params tree."""
+
+    def __init__(self, params):
+        self._p = params
+
+    def get_model_params(self):
+        return self._p
+
+    def set_model_params(self, p):
+        self._p = p
+
+
+def _aggregator(args_factory, **kw):
+    from fedml_tpu.cross_silo.server.fedml_aggregator import FedMLAggregator
+
+    args = args_factory(client_num_per_round=3, **kw)
+    return FedMLAggregator(args, _StubImpl(_tree(1.0)), test_global=None)
+
+
+def test_admission_quarantines_nan_structure_and_norm(args_factory):
+    agg = _aggregator(args_factory, admission_control=True,
+                      admission_norm_bound=10.0, run_id="adm")
+    assert agg.add_local_trained_result(0, _tree(1.1), 5) is None
+    assert agg.add_local_trained_result(1, _tree(jnp.nan), 5) == "non_finite"
+    assert agg.add_local_trained_result(
+        1, {"wrong": jnp.zeros(3)}, 5) == "structure_mismatch"
+    bad_shape = {"w": jnp.ones((2, 2)), "b": jnp.ones(2)}
+    assert agg.add_local_trained_result(
+        1, bad_shape, 5) == "structure_mismatch"
+    assert agg.add_local_trained_result(
+        1, _tree(1e6), 5) == "norm_outlier"
+    # the quarantined index never entered the received set...
+    assert agg.receive_count() == 1 and not agg.has_received(1)
+    assert agg.quarantined_total == 4
+    # ...and the per-round ledger holds the LAST rejection reason
+    assert agg.quarantined_this_round == {1: "norm_outlier"}
+    # ...and a clean retry is admitted
+    assert agg.add_local_trained_result(1, _tree(0.9), 5) is None
+    assert agg.has_received(1)
+    # pair payloads (params, variates): no structure/norm counterpart,
+    # but the NaN/Inf scan still applies to the whole tuple tree
+    assert agg.add_local_trained_result(
+        2, (_tree(jnp.nan), _tree(0.0)), 5) == "non_finite"
+    assert agg.add_local_trained_result(
+        2, (_tree(1.0), _tree(0.0)), 5) is None
+    from fedml_tpu.core.mlops import metrics
+    assert "fedml_quarantined_updates_total" in metrics.render_prometheus()
+
+
+def test_admission_off_accepts_everything(args_factory):
+    agg = _aggregator(args_factory)
+    assert agg.add_local_trained_result(0, _tree(jnp.nan), 5) is None
+    assert agg.has_received(0)
+
+
+def test_duplicate_upload_keeps_first_result(args_factory):
+    """Keep-first: a late/forged duplicate must never replace the
+    already-counted (and possibly checkpointed) update."""
+    agg = _aggregator(args_factory, run_id="dupfirst")
+    agg.add_local_trained_result(0, _tree(1.0), 5)
+    assert agg.add_local_trained_result(0, _tree(999.0), 7) is None
+    assert agg.duplicate_uploads == 1
+    np.testing.assert_allclose(np.asarray(agg.model_dict[0]["w"]), 1.0)
+    assert agg.sample_num_dict[0] == 5.0
+
+
+def test_client_sampling_deterministic_and_isolated(args_factory):
+    """Cohorts are a pure function of (run_id, random_seed, round_idx) —
+    a crash-resumed server re-derives the SAME cohort — and sampling no
+    longer touches the global np.random stream."""
+    a1 = _aggregator(args_factory, run_id="det", client_num_in_total=20)
+    a2 = _aggregator(args_factory, run_id="det", client_num_in_total=20)
+    for r in (0, 1, 7):
+        assert a1.client_sampling(r, 20, 5) == a2.client_sampling(r, 20, 5)
+        assert a1.data_silo_selection(r, 30, 5) == \
+            a2.data_silo_selection(r, 30, 5)
+    assert a1.client_sampling(0, 20, 5) != a1.client_sampling(1, 20, 5)
+    other = _aggregator(args_factory, run_id="other", client_num_in_total=20)
+    assert other.client_sampling(0, 20, 5) != a1.client_sampling(0, 20, 5)
+    # the global numpy stream is untouched
+    np.random.seed(1234)
+    expect = np.random.RandomState(1234).rand(3)
+    a1.client_sampling(3, 20, 5)
+    np.testing.assert_allclose(np.random.rand(3), expect)
+
+
+# ------------------------------------------------------- chaos trainer
+def test_chaos_trainer_modes():
+    from fedml_tpu.core.distributed.communication.chaos import chaos_trainer
+
+    class _T:
+        params = _tree(2.0)
+
+        def get_model_params(self):
+            return self.params
+
+        def train(self, data, device=None, args=None):
+            return {"train_loss": 1.0}
+
+    nan_t = chaos_trainer(_T(), "nan")
+    assert not np.isfinite(np.asarray(nan_t.get_model_params()["w"])).any()
+    flip = chaos_trainer(_T(), "sign_flip")
+    np.testing.assert_allclose(np.asarray(flip.get_model_params()["w"]), -2.0)
+    scale = chaos_trainer(_T(), "scale:10")
+    np.testing.assert_allclose(np.asarray(scale.get_model_params()["w"]), 20.0)
+    slow = chaos_trainer(_T(), "slow:0.05")
+    t0 = time.monotonic()
+    slow.train(None)
+    assert time.monotonic() - t0 >= 0.05
+    np.testing.assert_allclose(np.asarray(slow.get_model_params()["w"]), 2.0)
+    with pytest.raises(ValueError):
+        chaos_trainer(_T(), "explode")
+
+
+def test_parrot_robust_aggregation_inside_round_jit(args_factory):
+    """The Parrot vectorized plane swaps its fused weighted mean for the
+    robust operator INSIDE the round jit (and the fused scan path)."""
+    import fedml_tpu
+    from fedml_tpu.simulation.parrot.parrot_api import ParrotAPI
+
+    args = fedml_tpu.init(args_factory(
+        comm_round=2, robust_agg="median", run_id="parrot_rob"))
+    dataset = fedml_tpu.data.load(args)
+    bundle = fedml_tpu.model.create(args, dataset[-1])
+    api = ParrotAPI(args, None, dataset, bundle)
+    m = api.train()
+    assert np.isfinite(m["test_loss"])
+
+
+# ---------------------------------------------- end-to-end (slow tier)
+def _run_federation(args_factory, run_id, adversaries=None, n=5,
+                    comm_round=6, **kw):
+    """One INPROC cross-silo federation; ``adversaries`` maps rank →
+    chaos_trainer spec.  Returns (args, server, elapsed_s)."""
+    import fedml_tpu
+    from fedml_tpu.core.distributed.communication.chaos import chaos_trainer
+    from fedml_tpu.cross_silo.runner import fleet_size, init_client, init_server
+    from fedml_tpu.ml.trainer.default_trainer import DefaultClientTrainer
+
+    cfg = dict(training_type="cross_silo", client_num_in_total=n,
+               client_num_per_round=n, comm_round=comm_round, data_scale=0.2,
+               learning_rate=0.1, frequency_of_the_test=1, run_id=run_id)
+    cfg.update(kw)
+    args = fedml_tpu.init(args_factory(**cfg))
+    fleet = fleet_size(args)
+    dataset = fedml_tpu.data.load(args)
+    bundle = fedml_tpu.model.create(args, dataset[-1])
+    server = init_server(args, dataset, bundle, backend="INPROC")
+    clients = []
+    for rank in range(1, fleet + 1):
+        trainer = DefaultClientTrainer(bundle, args)
+        if adversaries and rank in adversaries:
+            trainer = chaos_trainer(trainer, adversaries[rank])
+        clients.append(init_client(args, dataset, bundle, rank, trainer,
+                                   backend="INPROC"))
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    server.run()
+    elapsed = time.monotonic() - t0
+    for t in threads:
+        t.join(timeout=15)
+    return args, server, elapsed
+
+
+def test_admission_without_pacer_completes_rounds(args_factory):
+    """Regression: with admission control on but NO deadline/timeout
+    pacer configured (the defaults), a persistently-byzantine client must
+    not hang the round — once its quarantine re-solicit budget is spent,
+    the round closes on the remaining participants."""
+    _, server, _ = _run_federation(
+        args_factory, "bz_nopacer", adversaries={3: "nan"}, n=3,
+        comm_round=2, admission_control=True)
+    assert len(server.aggregator.metrics_history) == 2
+    assert server.aggregator.quarantined_total >= 2
+    assert all(np.isfinite(m["test_loss"])
+               for m in server.aggregator.metrics_history)
+
+
+@pytest.mark.slow
+def test_byzantine_soak_robust_converges_where_fedavg_diverges(args_factory):
+    """Acceptance soak: 5 clients, 2 adversarial (sign-flip + NaN
+    injector), seeded.  Trimmed-mean and median runs (admission control +
+    deadline pacing on) reach a final loss within 10% of the clean-FedAvg
+    baseline; plain FedAvg under the same faults does not.  NaN uploads
+    land in fedml_quarantined_updates_total and NEVER in the global model
+    (finite every round)."""
+    from fedml_tpu.core.mlops import metrics
+    from fedml_tpu.core.security.utils import tree_to_vector
+
+    ADV = {4: "sign_flip", 5: "nan"}
+    _, s_clean, _ = _run_federation(args_factory, "bz_clean")
+    clean = s_clean.aggregator.metrics_history[-1]["test_loss"]
+    assert np.isfinite(clean)
+
+    _, s_bad, _ = _run_federation(args_factory, "bz_bad", adversaries=ADV)
+    bad = s_bad.aggregator.metrics_history[-1]["test_loss"]
+    # plain FedAvg is poisoned: NaN or far off the clean baseline
+    assert not (np.isfinite(bad) and bad <= 1.1 * clean), (bad, clean)
+
+    # floor 4 = every honest client + the sign-flipper: the NaN client is
+    # always quarantined (never counted), so the deadline closes every
+    # round with EXACTLY the same 4-member set on any machine speed —
+    # below 4 it grace-extends, making the soak timing-independent
+    robust_kw = dict(admission_control=True, round_deadline_s=2.0,
+                     round_deadline_grace_s=1.0, min_aggregation_clients=4)
+    for op, run_id in (("trimmed_mean:0.25", "bz_tm"), ("median", "bz_md")):
+        _, server, _ = _run_federation(
+            args_factory, run_id, adversaries=ADV, robust_agg=op,
+            **robust_kw)
+        hist = server.aggregator.metrics_history
+        assert len(hist) == 6, f"{op}: not every round completed"
+        # the NaN client never reached the global model: finite EVERY round
+        assert all(np.isfinite(m["test_loss"]) for m in hist), op
+        final_global = tree_to_vector(
+            server.aggregator.get_global_model_params())
+        assert np.isfinite(np.asarray(final_global)).all(), op
+        robust_loss = hist[-1]["test_loss"]
+        assert robust_loss <= 1.1 * clean, (op, robust_loss, clean)
+        # NaN uploads were quarantined, never counted as received (on a
+        # loaded machine a late NaN upload may be stale-dropped instead
+        # of quarantined for some rounds, so this is a floor, not 1/round)
+        assert server.aggregator.quarantined_total >= 2, op
+    assert "fedml_quarantined_updates_total" in metrics.render_prometheus()
+
+
+@pytest.mark.slow
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_deadline_paced_round_with_straggler(args_factory):
+    """Acceptance: over-provisioned selection (K+m) completes each round
+    with the first K results BEFORE the injected straggler finishes; the
+    deadline pacer (no over-provision) drops the straggler like a
+    heartbeat-dead client and the run still completes every round."""
+    DELAY = 4.0
+    # -- K of K+m: completion target stays K=3, fleet is 4 ----------------
+    args, server, elapsed = _run_federation(
+        args_factory, "straggle_op", adversaries={4: f"slow:{DELAY}"},
+        n=4, comm_round=2, client_num_per_round=3, over_provision=1)
+    assert int(args.round_idx) == 2
+    assert len(server.aggregator.metrics_history) == 2
+    # both rounds closed on the 3 fast arrivals, not the 4s straggler
+    assert elapsed < 2 * DELAY * 0.9, (
+        f"{elapsed:.1f}s — rounds waited for the straggler")
+
+    # -- deadline drop: 3 of 3 with one straggler, deadline < delay -------
+    args2, server2, elapsed2 = _run_federation(
+        args_factory, "straggle_dl", adversaries={3: f"slow:{DELAY}"},
+        n=3, comm_round=2, round_deadline_s=1.0,
+        round_deadline_grace_s=0.5, min_aggregation_clients=2)
+    assert int(args2.round_idx) == 2
+    assert len(server2.aggregator.metrics_history) == 2
+    assert elapsed2 < 2 * DELAY * 0.9
+    # the straggler was dropped from the round exactly like a
+    # heartbeat-dead client
+    assert server2.client_online_status[3] is False
