@@ -1,0 +1,340 @@
+#!/usr/bin/env python
+"""Transport benchmark matrix for the cross-silo plane.
+
+{inproc, grpc, mqtt} × {sync, async} × {none, quantize, sparsify} × WAN
+profile → round time, bytes-on-wire, accuracy-at-round — the measurement
+ROADMAP item 5 calls for (transport choice + payload size dominate WAN
+round time; until this file neither had ever been measured here).
+
+Codecs map to ``--wire-compression`` specs:
+
+* ``none``      — raw f32 pytrees both directions;
+* ``quantize``  — ``int8`` blocked delta quantization (+ int8 downlink).
+  NOTE: int8's reduction ceiling is 4.0x by construction (8 of 32 bits);
+  with scale/framing overhead it lands ≈3.9x;
+* ``sparsify``  — ``topk8:0.1`` (top-10% delta coords, int8-quantized,
+  error feedback) — the fused quantize+sparsify delta codec, ≥4x
+  end-to-end including the int8 downlink.
+
+The WAN-straggler soak (acceptance): 5 silos, one on ``wan-lossy`` at
+10x latency; async (buffer_k=3, flush 2 s) must sustain ≥3x the sync
+round-completion rate at equal final accuracy, and the sparsify codec
+must cut total bytes-on-wire ≥4x at equal accuracy — both checked by
+``--guard`` (exit 2 on regression; the CI async-soak step runs
+``--quick --guard``).
+
+Usage:
+    python benchmarks/bench_transports.py --quick --guard \
+        --out benchmarks/bench_transports_quick.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import fedml_tpu  # noqa: E402
+from fedml_tpu.arguments import Config
+from fedml_tpu.core.distributed.communication.chaos import (
+    ChaosProfile,
+    chaos_from_profile,
+)
+from fedml_tpu.core.distributed.fedml_comm_manager import (
+    register_comm_backend,
+)
+from fedml_tpu.core.mlops import metrics
+from fedml_tpu.cross_silo.runner import init_client, init_server
+
+CODECS = {"none": None, "quantize": "int8", "sparsify": "topk8:0.1"}
+
+#: an unimpaired counting profile — the chaos wrapper still accounts
+#: bytes, so every transport's payload traffic is measured the same way
+LAN = ChaosProfile("lan")
+
+PROFILES: Dict[str, Any] = {"lan": LAN, "wan-good": "wan-good",
+                            "wan-lossy": "wan-lossy"}
+
+_GRPC_PORT = [21000]  # unique port block per grpc cell
+
+
+def _base_args(run_id: str, **kw) -> Any:
+    # mnist-shaped synthetic data + lr → a 7.8k-param model (~31 KB/f32
+    # payload): big enough that codec framing is noise, small enough that
+    # every cell trains in seconds on CPU
+    base = dict(
+        training_type="cross_silo", dataset="mnist", model="lr",
+        client_num_in_total=3, client_num_per_round=3, comm_round=3,
+        epochs=1, batch_size=16, learning_rate=0.1, data_scale=0.1,
+        frequency_of_the_test=1, enable_tracking=False,
+        compute_dtype="float32", run_id=run_id)
+    base.update(kw)
+    return fedml_tpu.init(Config(**base))
+
+
+def _register_profile_backend(name: str, transport: str, profile: Any,
+                              straggler_rank: Optional[int] = None,
+                              straggler_scale: float = 1.0) -> None:
+    def factory(args, rank=0, size=0):
+        if transport == "inproc":
+            from fedml_tpu.core.distributed.communication.inprocess import (
+                InProcCommManager,
+            )
+
+            inner = InProcCommManager(rank, size, str(args.run_id))
+        elif transport == "grpc":
+            from fedml_tpu.core.distributed.communication.grpc import (
+                GRPCCommManager,
+            )
+
+            inner = GRPCCommManager(args=args, rank=rank, size=size)
+        elif transport == "mqtt":
+            from fedml_tpu.core.distributed.communication.mqtt_s3 import (
+                MqttS3CommManager,
+            )
+
+            inner = MqttS3CommManager(args=args, rank=rank, size=size)
+        else:
+            raise ValueError(transport)
+        prof = profile
+        scale = 1.0
+        if straggler_rank is not None and rank == straggler_rank:
+            prof, scale = "wan-lossy", straggler_scale
+        return chaos_from_profile(inner, prof, seed=1000 + rank,
+                                  latency_scale=scale)
+
+    register_comm_backend(name, factory)
+
+
+def _wire_bytes(run_id: str) -> Dict[str, float]:
+    m = metrics.REGISTRY.collect().get("fedml_wire_bytes_total")
+    out: Dict[str, float] = {"up": 0.0, "down": 0.0}
+    if m is None:
+        return out
+    for key, child in list(m._children.items()):
+        rid, direction, _codec = key
+        if rid == run_id and direction in out:
+            out[direction] += child.value
+    out["total"] = out["up"] + out["down"]
+    return out
+
+
+def _federate(args: Any, backend: str, n_clients: int,
+              join_timeout: float = 60.0) -> Dict[str, Any]:
+    dataset = fedml_tpu.data.load(args)
+    bundle = fedml_tpu.model.create(args, dataset[-1])
+    server = init_server(args, dataset, bundle, backend=backend)
+    clients = [init_client(args, dataset, bundle, rank, backend=backend)
+               for rank in range(1, n_clients + 1)]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    server.run()
+    wall = time.monotonic() - t0
+    for t in threads:
+        t.join(timeout=join_timeout)
+    hist = server.aggregator.metrics_history
+    return {"wall_s": round(wall, 3),
+            "final": hist[-1] if hist else {},
+            "acc_at_round": [
+                {"round": h.get("round"), "test_acc": h.get("test_acc")}
+                for h in hist]}
+
+
+def run_cell(transport: str, mode: str, codec: str, profile: str,
+             rounds: int, cell_timeout_s: float = 180.0) -> Dict[str, Any]:
+    run_id = f"bt_{transport}_{mode}_{codec}_{profile}"
+    backend = f"BENCH_{run_id}".upper()
+    _register_profile_backend(backend, transport, PROFILES[profile])
+    kw: Dict[str, Any] = {"comm_round": rounds}
+    if CODECS[codec]:
+        kw["wire_compression"] = CODECS[codec]
+    if mode == "async":
+        kw.update(async_agg=True, async_buffer_k=2)
+    if profile != "lan":
+        # lossy profiles DROP messages: without the reliability plane (and
+        # a round-timer backstop for what outlives its retransmit
+        # deadline) a sync cell would stall forever on one lost upload
+        kw.update(reliable=True, reliable_retx_initial_s=0.2,
+                  reliable_retx_max_s=1.0, round_timeout_s=15.0,
+                  min_clients_per_round=2)
+    if transport == "grpc":
+        _GRPC_PORT[0] += 20
+        kw["grpc_base_port"] = _GRPC_PORT[0]
+    if transport == "mqtt":
+        kw["mqtt_broker"] = "inproc"
+    args = _base_args(run_id, **kw)
+    cell = {"transport": transport, "mode": mode, "codec": codec,
+            "profile": profile, "rounds": rounds}
+    box: Dict[str, Any] = {}
+
+    def _worker():
+        try:
+            box["res"] = _federate(args, backend, n_clients=3)
+        except Exception as e:  # noqa: BLE001 — a transport missing from
+            # the environment (no grpc wheel, no broker) skips its cells,
+            # it does not kill the matrix
+            box["err"] = f"{type(e).__name__}: {e}"
+
+    t = threading.Thread(target=_worker, daemon=True)
+    t.start()
+    t.join(timeout=cell_timeout_s)
+    if t.is_alive():
+        cell["skipped"] = f"timeout after {cell_timeout_s:.0f}s"
+        return cell
+    if "err" in box:
+        cell["skipped"] = box["err"]
+        return cell
+    res = box["res"]
+    bytes_on_wire = _wire_bytes(run_id)
+    cell.update(
+        wall_s=res["wall_s"],
+        rounds_per_s=round(rounds / max(res["wall_s"], 1e-9), 3),
+        bytes_up=bytes_on_wire["up"], bytes_down=bytes_on_wire["down"],
+        bytes_total=bytes_on_wire["total"],
+        test_acc=res["final"].get("test_acc"),
+        test_loss=res["final"].get("test_loss"),
+        acc_at_round=res["acc_at_round"])
+    return cell
+
+
+def run_straggler_soak(rounds: int = 12) -> Dict[str, Any]:
+    """The acceptance soak: one wan-lossy silo at 10x latency among 5.
+    Sync pays the straggler every round (bounded by its round timer);
+    async force-starts on the fast four, flushes on the 3 fastest, and
+    folds the straggler's stale uploads with decayed weight.  Server-side
+    eval runs once at the end (it is identical work in both modes and
+    would otherwise mask the round-time contrast being measured)."""
+    n = 5
+    common = dict(client_num_in_total=n, client_num_per_round=n,
+                  comm_round=rounds, reliable=True,
+                  reliable_retx_initial_s=0.2, reliable_retx_max_s=1.0,
+                  frequency_of_the_test=rounds)
+    clean = _federate(_base_args("bt_soak_clean", **common), "INPROC", n)
+
+    _register_profile_backend("BT_SOAK_SYNC", "inproc", "wan-good",
+                              straggler_rank=n, straggler_scale=10.0)
+    sync = _federate(_base_args(
+        "bt_soak_sync", round_timeout_s=8.0, min_clients_per_round=3,
+        **common), "BT_SOAK_SYNC", n)
+
+    _register_profile_backend("BT_SOAK_ASYNC", "inproc", "wan-good",
+                              straggler_rank=n, straggler_scale=10.0)
+    asn = _federate(_base_args(
+        "bt_soak_async", async_agg=True, async_buffer_k=3, async_flush_s=2.0,
+        async_staleness="poly:0.5", wire_compression="int8",
+        round_timeout_s=1.0, min_clients_per_round=3,
+        **common), "BT_SOAK_ASYNC", n)
+
+    sync_rate = rounds / max(sync["wall_s"], 1e-9)
+    async_rate = rounds / max(asn["wall_s"], 1e-9)
+    return {
+        "silos": n, "rounds": rounds, "straggler": "wan-lossy @ 10x latency",
+        "clean_acc": clean["final"].get("test_acc"),
+        "sync": {"wall_s": sync["wall_s"],
+                 "rounds_per_s": round(sync_rate, 3),
+                 "test_acc": sync["final"].get("test_acc")},
+        "async": {"wall_s": asn["wall_s"],
+                  "rounds_per_s": round(async_rate, 3),
+                  "test_acc": asn["final"].get("test_acc"),
+                  "bytes_total": _wire_bytes("bt_soak_async")["total"]},
+        "sync_bytes_total": _wire_bytes("bt_soak_sync")["total"],
+        "round_rate_ratio": round(async_rate / max(sync_rate, 1e-9), 2),
+    }
+
+
+def check_guard(cells: List[Dict], soak: Dict) -> List[str]:
+    """Bytes-on-wire + straggler regression guard (CI async-soak step).
+    Returns a list of violations (empty = pass)."""
+    bad: List[str] = []
+    by_key = {(c["transport"], c["mode"], c["profile"], c["codec"]): c
+              for c in cells if "skipped" not in c}
+    for (tr, mode, prof, codec), c in by_key.items():
+        if codec != "sparsify":
+            continue
+        base = by_key.get((tr, mode, prof, "none"))
+        if base is None or not base.get("bytes_total"):
+            continue
+        ratio = base["bytes_total"] / max(c["bytes_total"], 1e-9)
+        if ratio < 4.0:
+            bad.append(f"{tr}/{mode}/{prof}: sparsify bytes reduction "
+                       f"{ratio:.2f}x < 4x")
+        if (base.get("test_acc") is not None
+                and c.get("test_acc") is not None
+                and abs(base["test_acc"] - c["test_acc"]) > 0.15):
+            bad.append(f"{tr}/{mode}/{prof}: sparsify accuracy "
+                       f"{c['test_acc']:.3f} vs {base['test_acc']:.3f} "
+                       f"(> 0.15 apart)")
+    if soak:
+        if soak["round_rate_ratio"] < 3.0:
+            bad.append(f"soak: async/sync round-completion ratio "
+                       f"{soak['round_rate_ratio']}x < 3x")
+        ca, aa = soak.get("clean_acc"), soak["async"].get("test_acc")
+        if ca is not None and aa is not None and abs(ca - aa) > 0.15:
+            bad.append(f"soak: async acc {aa:.3f} vs clean {ca:.3f}")
+    return bad
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--quick", action="store_true",
+                   help="inproc only, lan profile, + the straggler soak")
+    p.add_argument("--guard", action="store_true",
+                   help="exit 2 when the bytes/straggler guard fails")
+    p.add_argument("--rounds", type=int, default=3)
+    p.add_argument("--no-soak", action="store_true")
+    p.add_argument("--out", default=None, help="write JSON here")
+    a = p.parse_args(argv)
+
+    transports = ["inproc"] if a.quick else ["inproc", "grpc", "mqtt"]
+    profiles = ["lan"] if a.quick else ["lan", "wan-good", "wan-lossy"]
+    cells: List[Dict] = []
+    for transport in transports:
+        for profile in profiles:
+            if transport != "inproc" and profile != "lan":
+                # WAN emulation wraps the transport identically — the
+                # non-lan rows only vary payload timing, measured once on
+                # the in-process transport to keep the matrix affordable
+                continue
+            for mode in ("sync", "async"):
+                for codec in ("none", "quantize", "sparsify"):
+                    print(f"[bench_transports] {transport}/{mode}/{codec}"
+                          f"/{profile} ...", flush=True)
+                    cells.append(run_cell(transport, mode, codec, profile,
+                                          a.rounds))
+
+    soak = {} if a.no_soak else run_straggler_soak()
+    violations = check_guard(cells, soak)
+    report = {
+        "bench": "transports",
+        "quick": bool(a.quick),
+        "matrix": {"transports": transports, "profiles": profiles,
+                   "modes": ["sync", "async"],
+                   "codecs": {k: v or "raw" for k, v in CODECS.items()}},
+        "cells": cells,
+        "straggler_soak": soak,
+        "guard_violations": violations,
+    }
+    out = json.dumps(report, indent=2, default=float)
+    if a.out:
+        with open(a.out, "w") as f:
+            f.write(out + "\n")
+        print(f"[bench_transports] wrote {a.out}")
+    else:
+        print(out)
+    if violations:
+        print("[bench_transports] GUARD FAILED:", *violations, sep="\n  ")
+        return 2 if a.guard else 0
+    print("[bench_transports] guard clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

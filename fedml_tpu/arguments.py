@@ -100,6 +100,22 @@ _DEFAULTS: Dict[str, Any] = {
     "round_deadline_s": 0.0,         # hard round deadline (0 disables)
     "round_deadline_grace_s": 2.0,   # extension while below the floor
     "min_aggregation_clients": 1,    # deadline never closes a round below this
+    # robustness: buffered-async rounds + wire compression
+    # (docs/ROBUSTNESS.md "Asynchronous rounds").  async_agg folds
+    # admitted uploads into a buffer as they arrive (staleness-weighted,
+    # FedBuff-style) instead of waiting out a K-upload barrier; the
+    # buffer flushes every async_buffer_k updates (0 → K) or
+    # async_flush_s seconds (0 → count-trigger only); comm_round then
+    # counts FLUSHES.  wire_compression negotiates a per-link update
+    # codec: none|bf16|int8|topk[:ratio]|topk8[:ratio] (delta encoding +
+    # client-side error feedback always included).
+    "async_agg": False,
+    "async_buffer_k": 0,             # flush after this many folded updates
+    "async_flush_s": 0.0,            # flush a non-empty buffer this often
+    "async_staleness": "poly:0.5",   # constant|poly[:a]|exp[:a]|hinge[:c[:a]]
+    "async_staleness_cutoff": 10,    # versions; older uploads expire
+    "async_server_lr": 1.0,          # global ← global + lr·(agg − global)
+    "wire_compression": None,        # per-link update codec (see above)
     # tracking_args
     "enable_tracking": True,
     "log_file_dir": None,

@@ -89,9 +89,36 @@ def diagnosis(config, checks) -> None:
 @click.option("--min-aggregation-clients", default=None, type=int,
               help="the deadline never closes a round with fewer results "
                    "than this floor (re-solicits + grace-extends instead)")
+@click.option("--async-agg/--no-async-agg", "async_agg", default=None,
+              help="buffered-async rounds (FedBuff-style): fold admitted "
+                   "uploads as they arrive with staleness weighting "
+                   "instead of waiting out the K-upload barrier; "
+                   "comm_round counts buffer flushes")
+@click.option("--async-buffer-k", default=None, type=int, metavar="K",
+              help="flush the async buffer after K folded updates "
+                   "(0 = client_num_per_round)")
+@click.option("--async-flush-s", default=None, type=float,
+              help="flush a non-empty async buffer after this many "
+                   "seconds (0 = count trigger only)")
+@click.option("--async-staleness", default=None,
+              help="staleness decay for async folding: "
+                   "constant|poly[:a]|exp[:a]|hinge[:c[:a]] "
+                   "(weight = n_samples · f(version − client_round))")
+@click.option("--async-staleness-cutoff", default=None, type=int,
+              help="uploads staler than this many versions are counted "
+                   "expired_stale and dropped (ACKed, never quarantined)")
+@click.option("--async-server-lr", default=None, type=float,
+              help="async flush mixing rate: "
+                   "global ← global + lr·(aggregate − global)")
+@click.option("--wire-compression", default=None,
+              help="per-link update codec, negotiated via capability "
+                   "flags: none|bf16|int8|topk[:ratio]|topk8[:ratio] "
+                   "(delta encoding + error feedback included)")
 def run(config: str, rank: int, role: str, reliable, heartbeat_interval_s,
         checkpoint_dir, resume_from, robust_agg, admission_control,
-        over_provision, round_deadline_s, min_aggregation_clients) -> None:
+        over_provision, round_deadline_s, min_aggregation_clients,
+        async_agg, async_buffer_k, async_flush_s, async_staleness,
+        async_staleness_cutoff, async_server_lr, wire_compression) -> None:
     """Run a training config (reference `fedml run` / launchers)."""
     import fedml_tpu
 
@@ -122,6 +149,39 @@ def run(config: str, rank: int, role: str, reliable, heartbeat_interval_s,
         overrides["round_deadline_s"] = round_deadline_s
     if min_aggregation_clients is not None:
         overrides["min_aggregation_clients"] = min_aggregation_clients
+    if async_agg is not None:
+        overrides["async_agg"] = async_agg
+    if async_buffer_k is not None:
+        if async_buffer_k < 0:
+            raise click.BadParameter("must be >= 0 (0 = cohort size)",
+                                     param_hint="--async-buffer-k")
+        overrides["async_buffer_k"] = async_buffer_k
+    if async_flush_s is not None:
+        if async_flush_s < 0:
+            raise click.BadParameter("must be >= 0 (0 = count trigger only)",
+                                     param_hint="--async-flush-s")
+        overrides["async_flush_s"] = async_flush_s
+    if async_staleness is not None:
+        from ..ml.aggregator.staleness import parse_staleness
+
+        try:  # fail at the CLI boundary, not on the first stale upload
+            parse_staleness(async_staleness)
+        except ValueError as e:
+            raise click.BadParameter(str(e), param_hint="--async-staleness")
+        overrides["async_staleness"] = async_staleness
+    if async_staleness_cutoff is not None:
+        overrides["async_staleness_cutoff"] = async_staleness_cutoff
+    if async_server_lr is not None:
+        overrides["async_server_lr"] = async_server_lr
+    if wire_compression is not None:
+        from ..utils.compression import parse_wire_compression
+
+        try:
+            parse_wire_compression(wire_compression)
+        except ValueError as e:
+            raise click.BadParameter(str(e),
+                                     param_hint="--wire-compression")
+        overrides["wire_compression"] = wire_compression
     args = fedml_tpu.init(fedml_tpu.Config.from_yaml(config, overrides))
     device = fedml_tpu.device.get_device(args)
     dataset = fedml_tpu.data.load(args)

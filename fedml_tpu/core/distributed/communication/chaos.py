@@ -4,18 +4,34 @@ NEW capability (SURVEY §5: the reference has "no systematic fault
 injection" — crash simulation only via attacks).  ChaosCommManager wraps
 any BaseCommunicationManager and injects, deterministically from a seed:
 
-* message DROPS (probability ``drop_p``),
+* message DROPS (probability ``drop_p``), plus BURST drops (``burst_p``
+  opens a window that swallows the next ``burst_len`` messages — the
+  correlated-loss pattern of a WAN route flap, which independent
+  per-message drops never produce),
 * DUPLICATES (``dup_p`` — the same message delivered twice),
 * DELAYS (``delay_p`` with uniform [0, max_delay_s] on a side thread, so
-  reordering happens naturally).
+  reordering happens naturally),
+* WAN LINK EMULATION: fixed one-way ``base_latency_s`` + uniform
+  ``jitter_s`` on every message, and bandwidth shaping —
+  ``bandwidth_mbps`` > 0 queues each message behind the link's
+  serialization time (payload bytes ÷ rate, FIFO per link, so a bulk
+  model broadcast delays the control message behind it exactly like a
+  real bottleneck link).
 
-Use it in tests to prove protocol robustness (elastic rounds, liveness,
-SecAgg dropout recovery) and register it as a custom backend for chaos
-smoke runs:
+Shaped-bandwidth wait and injected latency are accounted SEPARATELY
+(``stats["bw_wait_s"]`` vs ``stats["latency_s"]``, and the
+``fedml_chaos_*`` metrics) so benchmark numbers can attribute WAN delay
+to payload size vs propagation — conflating them would make compression
+look like a latency fix.
 
-    register_comm_backend("CHAOS_INPROC", lambda args, rank, size:
-        ChaosCommManager(InProcCommManager(rank, size, args.run_id),
-                         drop_p=0.1, seed=rank))
+Named WAN presets (``CHAOS_PROFILES`` / ``chaos_from_profile``):
+``wan-good`` (clean inter-region link), ``wan-lossy`` (congested transit:
+loss, jitter, bursts, 50 Mbps), ``cellular`` (high-RTT 10 Mbps with burst
+fades).  Use them in tests and the transport benchmark matrix:
+
+    register_comm_backend("WAN_INPROC", lambda args, rank=0, size=0:
+        chaos_from_profile(InProcCommManager(rank, size, str(args.run_id)),
+                           "wan-lossy", seed=rank))
 
 ``ChaosClientTrainer`` is the DATA-plane counterpart: it wraps any
 ClientTrainer and injects byzantine/straggler client behavior (slow
@@ -28,13 +44,89 @@ from __future__ import annotations
 
 import logging
 import threading
+from dataclasses import dataclass, replace
 from typing import Any, List
 
 import numpy as np
 
+from ...mlops import metrics
 from .base_com_manager import BaseCommunicationManager
 from .message import Message
 from .observer import Observer
+
+_chaos_dropped = metrics.counter(
+    "fedml_chaos_dropped_total",
+    "Messages dropped by the chaos plane, by kind (random | burst)",
+    labels=("profile", "kind"))
+_chaos_bytes = metrics.counter(
+    "fedml_chaos_bytes_total",
+    "Payload bytes that entered the (possibly shaped) chaos link",
+    labels=("profile",))
+_chaos_bw_wait = metrics.counter(
+    "fedml_chaos_bw_wait_seconds_total",
+    "Cumulative shaped-bandwidth serialization wait (payload bytes / link "
+    "rate) — delay attributable to PAYLOAD SIZE",
+    labels=("profile",))
+_chaos_latency = metrics.counter(
+    "fedml_chaos_injected_latency_seconds_total",
+    "Cumulative injected propagation latency + jitter — delay attributable "
+    "to the LINK, independent of payload size",
+    labels=("profile",))
+
+
+@dataclass(frozen=True)
+class ChaosProfile:
+    """A named WAN link shape.  ``latency``/``jitter`` are one-way."""
+
+    name: str
+    drop_p: float = 0.0
+    dup_p: float = 0.0
+    base_latency_s: float = 0.0
+    jitter_s: float = 0.0
+    bandwidth_mbps: float = 0.0     # 0 = unshaped
+    burst_p: float = 0.0            # P(a send opens a drop burst)
+    burst_len: int = 0              # messages swallowed per burst
+
+
+#: the WAN catalog (numbers follow the cross-silo communication-backend
+#: measurement setups: inter-region ~40 ms RTT clean links, congested
+#: transit with correlated loss, and high-RTT low-rate cellular)
+CHAOS_PROFILES = {
+    "wan-good": ChaosProfile(
+        "wan-good", drop_p=0.001, base_latency_s=0.02, jitter_s=0.005,
+        bandwidth_mbps=200.0),
+    "wan-lossy": ChaosProfile(
+        "wan-lossy", drop_p=0.03, dup_p=0.01, base_latency_s=0.08,
+        jitter_s=0.04, bandwidth_mbps=50.0, burst_p=0.01, burst_len=4),
+    "cellular": ChaosProfile(
+        "cellular", drop_p=0.02, dup_p=0.005, base_latency_s=0.12,
+        jitter_s=0.08, bandwidth_mbps=10.0, burst_p=0.03, burst_len=6),
+}
+
+
+def chaos_from_profile(inner: BaseCommunicationManager, profile: Any,
+                       seed: int = 0, latency_scale: float = 1.0,
+                       bandwidth_scale: float = 1.0,
+                       protect_types: Any = ()) -> "ChaosCommManager":
+    """Build a ChaosCommManager from a named preset (or a ChaosProfile).
+
+    ``latency_scale``/``bandwidth_scale`` derive degraded variants without
+    new presets — e.g. the async soak's straggler silo runs ``wan-lossy``
+    at ``latency_scale=10``."""
+    prof = (profile if isinstance(profile, ChaosProfile)
+            else CHAOS_PROFILES[str(profile)])
+    if latency_scale != 1.0 or bandwidth_scale != 1.0:
+        prof = replace(
+            prof,
+            base_latency_s=prof.base_latency_s * latency_scale,
+            jitter_s=prof.jitter_s * latency_scale,
+            bandwidth_mbps=prof.bandwidth_mbps * bandwidth_scale)
+    return ChaosCommManager(
+        inner, drop_p=prof.drop_p, dup_p=prof.dup_p, seed=seed,
+        base_latency_s=prof.base_latency_s, jitter_s=prof.jitter_s,
+        bandwidth_mbps=prof.bandwidth_mbps, burst_p=prof.burst_p,
+        burst_len=prof.burst_len, profile_name=prof.name,
+        protect_types=protect_types)
 
 
 class ChaosCommManager(BaseCommunicationManager):
@@ -42,17 +134,33 @@ class ChaosCommManager(BaseCommunicationManager):
                  drop_p: float = 0.0, dup_p: float = 0.0,
                  delay_p: float = 0.0, max_delay_s: float = 0.2,
                  seed: int = 0,
-                 protect_types: Any = ()) -> None:
+                 protect_types: Any = (),
+                 base_latency_s: float = 0.0, jitter_s: float = 0.0,
+                 bandwidth_mbps: float = 0.0, burst_p: float = 0.0,
+                 burst_len: int = 0,
+                 profile_name: str = "custom") -> None:
         self.inner = inner
         self.drop_p = float(drop_p)
         self.dup_p = float(dup_p)
         self.delay_p = float(delay_p)
         self.max_delay_s = float(max_delay_s)
+        self.base_latency_s = float(base_latency_s)
+        self.jitter_s = float(jitter_s)
+        self.bandwidth_mbps = float(bandwidth_mbps)
+        self.burst_p = float(burst_p)
+        self.burst_len = int(burst_len)
+        self.profile_name = str(profile_name)
         self.rng = np.random.RandomState(seed)
         # message types exempt from chaos (e.g. FINISH, so runs terminate)
         self.protect_types = {str(t) for t in protect_types}
-        self.stats = {"sent": 0, "dropped": 0, "duplicated": 0, "delayed": 0}
+        self.stats = {"sent": 0, "dropped": 0, "duplicated": 0, "delayed": 0,
+                      "burst_dropped": 0, "bytes_sent": 0,
+                      "bw_wait_s": 0.0, "latency_s": 0.0}
         self._rng_lock = threading.Lock()
+        #: messages still to swallow in the current drop burst
+        self._burst_left = 0
+        #: monotonic time the shaped link becomes free (FIFO serialization)
+        self._link_free_at = 0.0
 
     # -- chaos on the SEND side ---------------------------------------------
     def send_message(self, msg: Message) -> None:
@@ -75,21 +183,68 @@ class ChaosCommManager(BaseCommunicationManager):
             # reordering, not a deterministic immediate echo
             self._chaos_send(msg)
 
+    def _payload_nbytes(self, msg: Message) -> int:
+        from ....utils.serialization import estimate_nbytes
+
+        return estimate_nbytes(msg.msg_params)
+
     def _chaos_send(self, msg: Message) -> None:
-        """One delivery attempt through the drop → delay pipeline."""
+        """One delivery attempt through the burst → drop → shape → delay
+        pipeline."""
+        import time
+
+        nbytes = self._payload_nbytes(msg)
         with self._rng_lock:
-            dropped = self.rng.rand() < self.drop_p
+            self.stats["bytes_sent"] += nbytes
+            # correlated (burst) loss first: an open burst swallows the
+            # message regardless of the independent drop roll
+            if self._burst_left > 0:
+                self._burst_left -= 1
+                self.stats["burst_dropped"] += 1
+                self.stats["dropped"] += 1
+                burst_drop = True
+            else:
+                burst_drop = False
+                if self.burst_p > 0 and self.rng.rand() < self.burst_p:
+                    self._burst_left = self.burst_len
+            dropped = burst_drop or self.rng.rand() < self.drop_p
             delayed = (not dropped) and self.rng.rand() < self.delay_p
             delay_s = self.rng.rand() * self.max_delay_s
-            if dropped:
+            latency_s = 0.0
+            bw_wait_s = 0.0
+            if not dropped:
+                if self.base_latency_s > 0 or self.jitter_s > 0:
+                    latency_s = (self.base_latency_s
+                                 + self.rng.rand() * self.jitter_s)
+                if self.bandwidth_mbps > 0:
+                    # FIFO link shaping: this message serializes AFTER
+                    # whatever is already queued on the link
+                    ser_s = nbytes * 8.0 / (self.bandwidth_mbps * 1e6)
+                    now = time.monotonic()
+                    start = max(now, self._link_free_at)
+                    self._link_free_at = start + ser_s
+                    bw_wait_s = self._link_free_at - now
+                self.stats["latency_s"] += latency_s
+                self.stats["bw_wait_s"] += bw_wait_s
+            if dropped and not burst_drop:
                 self.stats["dropped"] += 1
             elif delayed:
                 self.stats["delayed"] += 1
+        _chaos_bytes.labels(profile=self.profile_name).inc(nbytes)
         if dropped:
-            logging.debug("chaos: DROP %s", msg.get_type())
+            _chaos_dropped.labels(
+                profile=self.profile_name,
+                kind="burst" if burst_drop else "random").inc()
+            logging.debug("chaos: DROP %s%s", msg.get_type(),
+                          " (burst)" if burst_drop else "")
             return
-        if delayed:
-            t = threading.Timer(delay_s, self._timer_send, args=(msg,))
+        if latency_s > 0:
+            _chaos_latency.labels(profile=self.profile_name).inc(latency_s)
+        if bw_wait_s > 0:
+            _chaos_bw_wait.labels(profile=self.profile_name).inc(bw_wait_s)
+        total_delay = latency_s + bw_wait_s + (delay_s if delayed else 0.0)
+        if total_delay > 0:
+            t = threading.Timer(total_delay, self._timer_send, args=(msg,))
             t.daemon = True
             t.start()
         else:

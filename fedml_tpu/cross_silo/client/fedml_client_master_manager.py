@@ -17,6 +17,7 @@ from ...core.mlops import tracing
 from ...core.distributed.communication.message import Message
 from ...core.distributed.communication.reliable import ARG_VOLATILE
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
+from ...utils.compression import WIRE_BYTES as _wire_bytes
 from ..message_define import MyMessage
 from .trainer_dist_adapter import TrainerDistAdapter
 
@@ -29,6 +30,12 @@ class ClientMasterManager(FedMLCommManager):
         self.trainer_dist_adapter = trainer_dist_adapter
         self.num_rounds = int(args.comm_round)
         self._compressor = None  # built lazily when enable_compression
+        #: negotiated uplink wire codec: assigned by the server per link
+        #: on the round broadcast (None until then / for legacy servers);
+        #: one instance per assignment so the error-feedback residual
+        #: persists across rounds
+        self._wire_codec = None
+        self._wire_codec_spec: str = ""
         self.round_idx = 0
         self._hb_stop = threading.Event()
 
@@ -80,14 +87,39 @@ class ClientMasterManager(FedMLCommManager):
     # -- protocol ------------------------------------------------------------
     def send_client_status(self, receiver_id: int,
                            status: str = MyMessage.CLIENT_STATUS_ONLINE) -> None:
+        from ...utils.compression import WIRE_CAPS
+
         msg = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS,
                       self.get_sender_id(), receiver_id)
         msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_STATUS, status)
         msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_OS, "python")
+        # capability advertisement: the server only assigns a wire codec
+        # this build can actually decode/encode
+        msg.add_params(MyMessage.MSG_ARG_KEY_WIRE_CAPS, list(WIRE_CAPS))
         self.send_message(msg)
 
-    def handle_message_init(self, msg: Message) -> None:
+    def _unpack_broadcast(self, msg: Message) -> Any:
+        """Model payload → tree, honoring the server's codec assignment.
+        The DECODED tree doubles as the delta reference for compressed
+        uploads — identical bits to the server's copy by construction."""
         global_model = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        if msg.get(MyMessage.MSG_ARG_KEY_MODEL_ENCODED):
+            from ...utils.compression import WireCodec
+
+            global_model = WireCodec.decode_model(global_model)
+        codec_spec = msg.get(MyMessage.MSG_ARG_KEY_WIRE_CODEC)
+        if codec_spec and str(codec_spec) != self._wire_codec_spec:
+            from ...utils.compression import WireCodec
+
+            self._wire_codec = WireCodec(str(codec_spec))
+            self._wire_codec_spec = str(codec_spec)
+        elif not codec_spec:
+            self._wire_codec = None
+            self._wire_codec_spec = ""
+        return global_model
+
+    def handle_message_init(self, msg: Message) -> None:
+        global_model = self._unpack_broadcast(msg)
         client_index = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX)
         self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND, 0))
         mlops.log_training_status("RUNNING")
@@ -96,7 +128,7 @@ class ClientMasterManager(FedMLCommManager):
             tracing.extract(msg.get(MyMessage.MSG_ARG_KEY_TRACE_CTX)))
 
     def handle_message_receive_model_from_server(self, msg: Message) -> None:
-        global_model = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        global_model = self._unpack_broadcast(msg)
         client_index = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX)
         self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND,
                                      self.round_idx + 1))
@@ -131,7 +163,19 @@ class ClientMasterManager(FedMLCommManager):
             # relay hop) can stitch receive-side spans without local state
             msg.add_params(MyMessage.MSG_ARG_KEY_TRACE_CTX,
                            tracing.inject(trace_ctx))
-        if getattr(self.args, "enable_compression", False):
+        if self._wire_codec is not None:
+            # negotiated wire codec: ship delta(weights, received global)
+            # through quantize/sparsify with client-side error feedback;
+            # the server reconstructs against its identical reference
+            from ...utils.serialization import estimate_nbytes
+
+            payload = self._wire_codec.encode_delta(weights, global_model)
+            msg.add_params(MyMessage.MSG_ARG_KEY_WIRE_UPDATE, payload)
+            _wire_bytes.labels(
+                run_id=str(getattr(self.args, "run_id", "0")),
+                direction="up", codec=self._wire_codec.spec.kind).inc(
+                estimate_nbytes(payload))
+        elif getattr(self.args, "enable_compression", False):
             # sparse delta upload (reference utils/compression.py TopK/EF):
             # only top-k(|Δ|) entries travel; the server reconstructs
             # weights = global + Δ against its own copy of the global model
@@ -155,7 +199,12 @@ class ClientMasterManager(FedMLCommManager):
             payload, _ = self._compressor.compress(delta)
             msg.add_params(MyMessage.MSG_ARG_KEY_COMPRESSED_UPDATE, payload)
         else:
+            from ...utils.serialization import estimate_nbytes
+
             msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, weights)
+            _wire_bytes.labels(
+                run_id=str(getattr(self.args, "run_id", "0")),
+                direction="up", codec="raw").inc(estimate_nbytes(weights))
         msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, n_samples)
         msg.add_params(MyMessage.MSG_ARG_KEY_TRAIN_METRICS,
                        getattr(self.trainer_dist_adapter.trainer,

@@ -36,6 +36,18 @@ class MyMessage:
     MSG_ARG_KEY_CLIENT_OS = "client_os"
     MSG_ARG_KEY_TRAIN_METRICS = "train_metrics"
     MSG_ARG_KEY_COMPRESSED_UPDATE = "compressed_update"
+    # wire-compression negotiation (docs/ROBUSTNESS.md "Asynchronous
+    # rounds"): clients advertise codec capability tokens on their status
+    # message; the server assigns a codec per link on every round
+    # broadcast (only when the link's caps cover it — a legacy client
+    # simply keeps exchanging raw pytrees).  Compressed uploads travel as
+    # a self-describing delta payload; compressed broadcasts replace the
+    # model tree with per-leaf quantized marker dicts and set the
+    # MODEL_ENCODED flag
+    MSG_ARG_KEY_WIRE_CAPS = "wire_caps"
+    MSG_ARG_KEY_WIRE_CODEC = "wire_codec"
+    MSG_ARG_KEY_WIRE_UPDATE = "wire_update"
+    MSG_ARG_KEY_MODEL_ENCODED = "model_wq"
     # distributed-tracing context ({trace_id, span_id}, `mlops.tracing`):
     # injected by the server into every round broadcast and echoed back on
     # uploads, so one round's spans across server/clients/aggregator stitch

@@ -36,6 +36,14 @@ def init_server(args: Any, dataset: Tuple, bundle: Any,
     agg = FedMLAggregator(args, aggregator_impl, test_global)
     client_num = fleet_size(args)
     opt = str(getattr(args, "federated_optimizer", "FedAvg"))
+    async_agg = bool(getattr(args, "async_agg", False))
+    if opt in (FED_OPT_LIGHTSECAGG, FED_OPT_SECAGG) and async_agg:
+        # secure aggregation's masking/reconstruction stages are sync
+        # barriers by construction — folding updates one at a time would
+        # sum partial mask sets (garbage after unmasking)
+        raise ValueError(
+            f"async_agg is incompatible with federated_optimizer={opt}: "
+            "secure aggregation requires synchronous rounds")
     if opt == FED_OPT_LIGHTSECAGG:
         from .lightsecagg.lsa_server_manager import LSAServerManager
         return LSAServerManager(args, agg, rank=0, client_num=client_num,
@@ -44,6 +52,11 @@ def init_server(args: Any, dataset: Tuple, bundle: Any,
         from .secagg.sa_server_manager import SAServerManager
         return SAServerManager(args, agg, rank=0, client_num=client_num,
                                backend=backend)
+    if async_agg:
+        from .server.async_server_manager import AsyncFedMLServerManager
+        return AsyncFedMLServerManager(args, agg, rank=0,
+                                       client_num=client_num,
+                                       backend=backend)
     return FedMLServerManager(args, agg, rank=0, client_num=client_num,
                               backend=backend)
 

@@ -1,0 +1,501 @@
+"""Buffered-async cross-silo server (FedBuff-style, docs/ROBUSTNESS.md
+"Asynchronous rounds").
+
+The sync server is a barrier: round T closes only when K uploads for
+round T arrive, so one slow WAN link gates every silo.  This manager
+removes the barrier:
+
+* every ADMITTED upload is folded into a buffer as it arrives, weighted
+  by ``n_samples · f(T - t)`` where ``t`` is the server version the
+  client trained against and ``f`` the staleness decay
+  (``ml/aggregator/staleness.py``);
+* the buffer FLUSHES into the global model every ``async_buffer_k``
+  updates or ``async_flush_s`` seconds (whichever first), advancing the
+  server version — a flush is this mode's "round";
+* a client is re-dispatched the current global the moment its upload is
+  handled, so silos train continuously; a client already at the frontier
+  parks until the next flush (guaranteeing at most ONE upload per client
+  per version, which is what makes the ``(sender, client_round)`` dedup
+  key sound).
+
+Composition with the robustness stack is strict and order-matters:
+
+1. **dedup** (keep-first on ``(sender, client_round)``) — transport-level
+   duplicates never fold twice;
+2. **staleness cutoff** — an update older than ``async_staleness_cutoff``
+   versions (e.g. a retransmit that survived past the reliable plane's
+   deadline) is counted ``expired_stale``, ACKed (the reliable wrapper
+   ACKed on delivery, below this layer) and DROPPED — it is *lateness*,
+   not hostility, so it must NOT be quarantined, and it can never re-open
+   a flushed buffer;
+3. **admission control** — the same quarantine screen as the sync path,
+   BEFORE the buffer: poison is rejected outright, never merely
+   down-weighted;
+4. **robust aggregation** — the flush funnels through
+   ``FedMLAggregator.aggregate_buffer`` → the ServerAggregator hooks →
+   ``FedMLAggOperator`` with ``--robust-agg``, so whatever slipped past
+   admission still meets the robust operator with its staleness-decayed
+   weight.
+
+The sync pacers (``round_timeout_s`` / ``round_deadline_s`` /
+over-provision) are barrier machinery and are inert here — the flush
+trigger pair is the async pacer.  The heartbeat failure detector and
+late-join catch-up still apply unchanged.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+from ...core import mlops
+from ...core.mlops import metrics, tracing
+from ...core.distributed.communication.message import Message
+from ...ml.aggregator.staleness import parse_staleness, staleness_weight
+from ..message_define import MyMessage
+from .fedml_aggregator import FedMLAggregator
+from .fedml_server_manager import FedMLServerManager
+
+_async_updates = metrics.counter(
+    "fedml_async_updates_total",
+    "Uploads handled by the buffered-async server, by outcome (folded | "
+    "expired_stale | duplicate | quarantined)",
+    labels=("run_id", "outcome"))
+_async_flushes = metrics.counter(
+    "fedml_async_flushes_total",
+    "Buffer flushes (async round completions), by trigger (count | timer "
+    "| drain)", labels=("run_id", "trigger"))
+_async_buffer = metrics.gauge(
+    "fedml_async_buffer_size", "Updates currently buffered, not yet flushed",
+    labels=("run_id",))
+_async_staleness_hist = metrics.histogram(
+    "fedml_async_update_staleness",
+    "Staleness (server version - client round) of folded updates",
+    labels=("run_id",),
+    buckets=(0.0, 1.0, 2.0, 3.0, 5.0, 8.0, 13.0, 21.0))
+
+#: bound on the (sender, client_round) keep-first window
+_DEDUP_WINDOW = 4096
+
+#: sentinel: a compressed upload whose trained-against delta reference is
+#: no longer held (e.g. the version predates a crash-resume) — the update
+#: cannot be reconstructed and is dropped as expired_stale
+_MISSING_REF = object()
+
+
+class AsyncFedMLServerManager(FedMLServerManager):
+    def __init__(self, args: Any, aggregator: FedMLAggregator, comm=None,
+                 rank: int = 0, client_num: int = 0,
+                 backend: str = "INPROC") -> None:
+        super().__init__(args, aggregator, comm, rank, client_num, backend)
+        k_default = max(1, int(args.client_num_per_round))
+        buffer_k = int(getattr(args, "async_buffer_k", 0) or 0)
+        flush_s = float(getattr(args, "async_flush_s", 0) or 0)
+        if buffer_k < 0 or flush_s < 0:
+            # fail at startup like a malformed staleness/codec spec —
+            # a negative k is truthy and would silently degenerate to
+            # flush-on-every-upload
+            raise ValueError(
+                f"async_buffer_k ({buffer_k}) and async_flush_s "
+                f"({flush_s}) must be >= 0 (0 = use the default trigger)")
+        self.buffer_k = buffer_k or k_default
+        self.flush_s = flush_s
+        self.staleness_cutoff = int(
+            getattr(args, "async_staleness_cutoff", 10) or 10)
+        self.server_lr = float(getattr(args, "async_server_lr", 1.0) or 1.0)
+        # parse at construction so a typo'd spec fails at startup
+        self._staleness_spec = parse_staleness(
+            getattr(args, "async_staleness", None))
+        #: (weight, model, sender, client_round) awaiting the next flush
+        self._buffer: List[Tuple[float, Any, int, int]] = []
+        #: keep-first dedup over (sender, client_round) — NOT (sender,
+        #: round-index-of-the-received-set) like the sync path: a client
+        #: legitimately uploads once per version it trained, and only a
+        #: transport duplicate repeats a (sender, version) pair
+        self._seen_uploads: "OrderedDict" = OrderedDict()
+        #: ranks parked at the frontier (uploaded for the current version;
+        #: released by the next flush)
+        self._waiting: set = set()
+        #: rank → last server version dispatched to it
+        self._dispatched_version: Dict[int, int] = {}
+        #: version → delta reference (the decoded broadcast) for decoding
+        #: compressed uploads trained against an OLDER version; bounded by
+        #: the staleness cutoff — anything older is expired_stale anyway
+        self._version_refs: "OrderedDict" = OrderedDict()
+        self._last_flush = time.monotonic()
+        self._flush_stop = threading.Event()
+
+    # -- sync-barrier machinery, inert in async mode -------------------------
+    def _arm_round_timer(self) -> None:   # the flush pair is the pacer
+        return
+
+    def _arm_deadline_timer(self, delay_s: Optional[float] = None) -> None:
+        return
+
+    def _maybe_complete_early(self) -> None:
+        # no early round-close in async (there is no barrier to close),
+        # but a heartbeat-dead declaration shrinks the online set — the
+        # drain trigger must re-fire or survivors parked at the frontier
+        # stay gated on a dead silo's never-coming upload forever
+        with self._round_lock:
+            if self.is_initialized and not self._finishing:
+                self._maybe_flush_drained()
+
+    # -- lifecycle -----------------------------------------------------------
+    def run(self) -> None:
+        if self.flush_s > 0:
+            t = threading.Thread(target=self._flush_loop, daemon=True,
+                                 name="async-flush-timer")
+            t.start()
+        super().run()
+
+    def finish(self) -> None:
+        self._flush_stop.set()
+        super().finish()
+
+    def _resume_training(self) -> None:
+        """Crash-resume, async flavor: restore version + global and
+        re-dispatch the frontier to everyone as they re-announce.  The
+        in-flight buffer is NOT checkpointed (its updates re-arrive from
+        re-dispatched clients within a staleness window) — only flushed
+        state survives, which is exactly the effectively-once guarantee
+        the sync path gives per round."""
+        if self.args.round_idx >= self.round_num:
+            logging.warning(
+                "async server: checkpoint says the run already completed "
+                "(version %d/%d) — broadcasting FINISH and exiting",
+                self.args.round_idx, self.round_num)
+            self.send_finish_to_all()
+            mlops.log_aggregation_status("FINISHED")
+            self.finish()
+            return
+        mlops.log_aggregation_status("RUNNING")
+        self._run_span = tracing.start_span(
+            "fed_run", run_id=self._run_label, rounds=self.round_num,
+            resumed_at=int(self.args.round_idx))
+        self.is_initialized = True
+        self.client_id_list_in_this_round = self.aggregator.client_sampling(
+            self.args.round_idx, int(self.args.client_num_in_total),
+            self._cohort_size())
+        self.data_silo_index_of_client = self.aggregator.data_silo_selection(
+            self.args.round_idx, int(self.args.client_num_in_total),
+            len(self.client_id_list_in_this_round))
+        self._open_round_span()
+        self._broadcast_round()
+
+    # -- dispatch bookkeeping ------------------------------------------------
+    def _note_round_ref(self, ref: Any, raw: Optional[Any] = None) -> None:
+        """Version the delta references: a compressed upload trained
+        against version t decodes against ref[t], not the frontier.  Both
+        flavors are kept — a CODEC link's delta is against the DECODED
+        broadcast, a legacy TopK link's against the RAW global it was
+        sent (reconstructing the latter against the dequantized ref would
+        bake the whole-model quantization error into every upload)."""
+        super()._note_round_ref(ref, raw)
+        version = int(self.args.round_idx)
+        self._version_refs[version] = (ref, ref if raw is None else raw)
+        while len(self._version_refs) > self.staleness_cutoff + 2:
+            self._version_refs.popitem(last=False)
+
+    def _ref_for(self, client_round: int, raw: bool = False) -> Any:
+        """Delta reference the client trained against, or ``None`` when
+        that version's reference is gone (e.g. crash-resume only restores
+        the frontier) — reconstructing against any OTHER version's
+        reference would silently corrupt the update by the inter-version
+        model delta, and the corruption passes admission (finite, right
+        shapes), so the caller must drop the upload instead."""
+        pair = self._version_refs.get(int(client_round))
+        if pair is not None:
+            return pair[1] if raw else pair[0]
+        return None
+
+    def _broadcast_round(self, only_rank=None) -> None:
+        super()._broadcast_round(only_rank)
+        version = int(self.args.round_idx)
+        ranks = (set(self._ranks_for(self.client_id_list_in_this_round))
+                 if only_rank is None
+                 else {only_rank} if isinstance(only_rank, int)
+                 else set(only_rank))
+        for rank in ranks:
+            self._dispatched_version[rank] = version
+            self._waiting.discard(rank)
+
+    def _redispatch(self, rank: int) -> None:
+        """Hand ``rank`` its next unit of work: the current global if it
+        hasn't trained this version yet, else park it until the next
+        flush.  Caller holds ``_round_lock``."""
+        if self._finishing:
+            return
+        version = int(self.args.round_idx)
+        if self._dispatched_version.get(rank, -1) >= version:
+            self._waiting.add(rank)
+            self._maybe_flush_drained()
+            return
+        self._broadcast_round(only_rank=rank)
+
+    def _maybe_flush_drained(self) -> None:
+        """Every online participant is parked at the frontier → nothing
+        more can arrive, so waiting for the count/timer trigger would
+        idle the fleet (or deadlock it when ``async_buffer_k`` exceeds
+        the cohort and no timer is armed).  Caller holds
+        ``_round_lock``."""
+        ranks = set(self._ranks_for(self.client_id_list_in_this_round))
+        active = [r for r in ranks
+                  if self.client_online_status.get(r)
+                  and r not in self._waiting]
+        if active:
+            return
+        if self._buffer:
+            self._flush("drain")
+            return
+        # Empty buffer with every online silo parked.  A rank parked by a
+        # transport duplicate while still training its outstanding
+        # dispatch will unpark things when that upload lands; a rank
+        # whose quarantine re-solicit budget is spent never will.  When
+        # NO parked rank owes an upload, no admissible update can ever
+        # arrive and no flush will ever release the fleet — abort
+        # cleanly instead of hanging forever.
+        online = [r for r in ranks if self.client_online_status.get(r)]
+        if not online:
+            return      # everyone offline: the failure detector's rejoin
+            # path (late-join catch-up) is the wake-up mechanism
+        for r in online:
+            if (self._quarantine_resolicits.get(r, 0) < self._resolicit_max
+                    and (r, self._dispatched_version.get(r, -1))
+                    not in self._seen_uploads):
+                return  # r still owes its dispatched upload
+        logging.error(
+            "async server: every online silo is parked with an EMPTY "
+            "buffer and no upload outstanding (quarantine re-solicit "
+            "budgets spent at version %d) — the run cannot make progress, "
+            "aborting", int(self.args.round_idx))
+        self.send_finish_to_all()
+        mlops.log_aggregation_status("FAILED")
+        if self._run_span is not None:
+            self._run_span.end()
+            self._run_span = None
+        self.finish()
+
+    # -- the async upload path -----------------------------------------------
+    def handle_message_receive_model_from_client(self, msg: Message) -> None:
+        sender = msg.get_sender_id()
+        with self._round_lock:
+            if self._finishing:
+                return
+            version = int(self.args.round_idx)
+            client_round = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND, version))
+            self._last_seen[sender] = time.monotonic()
+            self.client_online_status[sender] = True
+            key = (sender, client_round)
+            if key in self._seen_uploads:
+                # keep-first: a transport duplicate (or forged replay) of
+                # an already-folded (sender, version) pair never folds
+                # twice.  Re-dispatch is idempotent (an already-current
+                # rank just parks), and it un-sticks a restarted client
+                # whose pre-restart upload was the one that counted.
+                _async_updates.labels(run_id=self._run_label,
+                                      outcome="duplicate").inc()
+                logging.debug("async server: duplicate upload %s", key)
+                self._redispatch(sender)
+                return
+            staleness = version - client_round
+            if staleness > self.staleness_cutoff:
+                # past the staleness cutoff (e.g. a retransmit that beat
+                # the reliable plane's deadline into a much later
+                # version): expired, NOT adversarial.  The reliable
+                # wrapper already ACKed on delivery; marking the key seen
+                # makes the drop idempotent; the flushed buffers it
+                # missed stay closed.  The silo is alive and its work is
+                # worthless — hand it the frontier immediately.
+                self._seen_uploads[key] = True
+                self._trim_dedup()
+                _async_updates.labels(run_id=self._run_label,
+                                      outcome="expired_stale").inc()
+                logging.warning(
+                    "async server: EXPIRED upload from %d (trained v%d, "
+                    "now v%d > cutoff %d) — dropped, re-dispatching",
+                    sender, client_round, version, self.staleness_cutoff)
+                self._redispatch(sender)
+                return
+            model_params = self._decode_upload(msg, client_round)
+            if model_params is _MISSING_REF:
+                # a delta we can no longer reconstruct (its version's
+                # reference predates a crash-resume): same treatment as
+                # past-cutoff lateness — drop, never quarantine, and hand
+                # the silo the frontier so its next delta is decodable
+                self._seen_uploads[key] = True
+                self._trim_dedup()
+                _async_updates.labels(run_id=self._run_label,
+                                      outcome="expired_stale").inc()
+                logging.warning(
+                    "async server: upload from %d is a delta against "
+                    "version %d whose reference is no longer held "
+                    "(crash-resume?) — dropped as expired_stale, "
+                    "re-dispatching", sender, client_round)
+                self._redispatch(sender)
+                return
+            train_metrics = msg.get(MyMessage.MSG_ARG_KEY_TRAIN_METRICS)
+            if isinstance(train_metrics, dict) and train_metrics:
+                self._round_train_metrics[sender] = train_metrics
+            reason = self.aggregator.admission_check(model_params)
+            if reason is not None:
+                # quarantined ≠ stale: poison is rejected outright.  The
+                # key is NOT marked seen — a re-trained (honest) retry for
+                # this version must get screened, not dedup-dropped.
+                _async_updates.labels(run_id=self._run_label,
+                                      outcome="quarantined").inc()
+                self.aggregator.quarantined_this_round[sender - 1] = reason
+                n_prev = self._quarantine_resolicits.get(sender, 0)
+                if n_prev < self._resolicit_max:
+                    self._quarantine_resolicits[sender] = n_prev + 1
+                    logging.warning(
+                        "async server: QUARANTINED upload from %d (%s) — "
+                        "re-soliciting (attempt %d/%d)", sender, reason,
+                        n_prev + 1, self._resolicit_max)
+                    self._dispatched_version.pop(sender, None)
+                    self._redispatch(sender)
+                else:
+                    # budget spent: parked without work until next flush
+                    self._waiting.add(sender)
+                    self._maybe_flush_drained()
+                return
+            n_samples = float(msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 1.0))
+            weight = n_samples * staleness_weight(self._staleness_spec,
+                                                  staleness)
+            self._seen_uploads[key] = True
+            self._trim_dedup()
+            self._buffer.append((weight, model_params, sender, client_round))
+            _async_updates.labels(run_id=self._run_label,
+                                  outcome="folded").inc()
+            _async_staleness_hist.labels(run_id=self._run_label).observe(
+                float(staleness))
+            _async_buffer.labels(run_id=self._run_label).set(
+                len(self._buffer))
+            if len(self._buffer) >= self.buffer_k:
+                self._flush("count")
+            # after a count-flush the version advanced, so this hands the
+            # triggering sender the NEW global; otherwise it parks or gets
+            # the current one
+            self._redispatch(sender)
+
+    def _decode_upload(self, msg: Message, client_round: int) -> Any:
+        """Raw | negotiated wire codec | legacy TopK payload → model tree,
+        or ``_MISSING_REF`` when the upload is a delta whose
+        trained-against reference is no longer held (treated as
+        expired_stale by the caller).  Caller holds ``_round_lock``."""
+        model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        if model_params is not None:
+            return model_params
+        wire_update = msg.get(MyMessage.MSG_ARG_KEY_WIRE_UPDATE)
+        if wire_update is not None:
+            from ...utils.compression import decode_delta
+
+            ref = self._ref_for(client_round)
+            if ref is None:
+                return _MISSING_REF
+            return decode_delta(wire_update, ref)
+        compressed = msg.get(MyMessage.MSG_ARG_KEY_COMPRESSED_UPDATE)
+        if compressed is not None:
+            import jax
+
+            from ...utils.compression import TopKCompressor, tree_spec
+
+            # a legacy TopK link received the RAW global (it never
+            # negotiated the wire codec), so its delta reconstructs
+            # against the raw reference, not the decoded broadcast
+            ref = self._ref_for(client_round, raw=True)
+            if ref is None:
+                return _MISSING_REF
+            delta = TopKCompressor().decompress(compressed, tree_spec(ref))
+            return jax.tree_util.tree_map(lambda g, d: g + d, ref, delta)
+        return None
+
+    def _trim_dedup(self) -> None:
+        while len(self._seen_uploads) > _DEDUP_WINDOW:
+            self._seen_uploads.popitem(last=False)
+
+    # -- flushing ------------------------------------------------------------
+    def _flush_loop(self) -> None:
+        """Timer trigger: flush a non-empty buffer every ``flush_s``.  An
+        empty check restarts the window — the timer measures time the
+        OLDEST buffered update has waited, not absolute cadence."""
+        while not self._flush_stop.wait(
+                max(0.01, self._last_flush + self.flush_s
+                    - time.monotonic())):
+            with self._round_lock:
+                if self._finishing:
+                    return
+                if (self._buffer and time.monotonic() - self._last_flush
+                        >= self.flush_s * 0.999):
+                    self._flush("timer")
+                elif not self._buffer:
+                    self._last_flush = time.monotonic()
+
+    def _flush(self, trigger: str) -> None:
+        """Fold the buffer into the global model and advance the version.
+        Caller holds ``_round_lock``."""
+        if not self._buffer:
+            return
+        version = int(self.args.round_idx)
+        entries = [(w, m) for (w, m, _, _) in self._buffer]
+        staleness = [version - t for (_, _, _, t) in self._buffer]
+        n_folded = len(entries)
+        self._buffer = []
+        self._last_flush = time.monotonic()
+        with tracing.use_ctx(
+                self._round_span.ctx if self._round_span else None):
+            self.aggregator.aggregate_buffer(entries,
+                                             server_lr=self.server_lr)
+            freq = int(getattr(self.args, "frequency_of_the_test", 1) or 1)
+            if (version % freq == 0 or version == self.round_num - 1):
+                self.aggregator.test_on_server_for_all_clients(version)
+        _async_flushes.labels(run_id=self._run_label, trigger=trigger).inc()
+        _async_buffer.labels(run_id=self._run_label).set(0)
+        logging.info(
+            "async server: flush v%d→v%d (%s): folded %d updates, "
+            "staleness %s", version, version + 1, trigger, n_folded,
+            staleness)
+        self._finish_round_span(n_folded)
+        self.args.round_idx = version + 1
+        self._persist_round_state()
+        if self.args.round_idx >= self.round_num:
+            self.send_finish_to_all()
+            mlops.log_aggregation_status("FINISHED")
+            if self._run_span is not None:
+                self._run_span.end()
+                self._run_span = None
+            self.finish()
+            return
+        self._caught_up_this_round = set()
+        self._quarantine_resolicits = {}
+        self._open_round_span()
+        # release the parked frontier in ONE broadcast — per-rank calls
+        # would re-encode the full model once per parked silo
+        if self._waiting:
+            self._broadcast_round(only_rank=set(self._waiting))
+
+    def _finish_round_span(self, n_folded: int) -> None:
+        from .fedml_server_manager import (
+            _clients_reported,
+            _round_seconds,
+            _rounds_total,
+        )
+
+        _clients_reported.labels(run_id=self._run_label).set(n_folded)
+        _rounds_total.labels(run_id=self._run_label).inc()
+        losses = [m.get("train_loss")
+                  for m in self._round_train_metrics.values()
+                  if isinstance(m.get("train_loss"), (int, float))]
+        self._round_train_metrics = {}
+        if self._round_span is not None:
+            if losses:
+                self._round_span.set_attr(
+                    "mean_client_train_loss", sum(losses) / len(losses))
+            self._round_span.set_attr("clients_reported", n_folded)
+            self._round_span.set_attr("async", True)
+            _round_seconds.labels(run_id=self._run_label).observe(
+                self._round_span.end())
+            self._round_span = None

@@ -150,6 +150,53 @@ class FedMLAggregator:
             return "norm_outlier"
         return None
 
+    def admission_check(self, model_params) -> Optional[str]:
+        """Admission screen WITHOUT the round-set bookkeeping — the
+        buffered-async path screens uploads before folding them into the
+        buffer (its dedup/staleness accounting lives in the async server
+        manager, not the per-round received set).  Returns the quarantine
+        reason or None; counts the quarantine metric like the sync path."""
+        if not self.admission_control:
+            return None
+        reason = self._admit(model_params)
+        if reason is not None:
+            self.quarantined_total += 1
+            _quarantined_total.labels(
+                run_id=self._run_label, reason=reason).inc()
+        return reason
+
+    def aggregate_buffer(self, entries: List[Tuple[float, Any]],
+                         server_lr: float = 1.0) -> Any:
+        """Fold one buffered-async batch of ``(weight, model)`` pairs into
+        the global model.  Runs the SAME funnel as the sync path
+        (on_before → robust-agg/defense aggregate → on_after), so a
+        byzantine update that slipped past admission still meets the
+        robust operator, then mixes the result into the global:
+        ``global ← global + server_lr · (agg − global)`` (``server_lr`` =
+        1.0 replaces it outright, the sync-equivalent).  Unlike
+        ``aggregate`` there is no received-set to clear — the async
+        manager owns buffer/dedup state."""
+        import jax
+
+        global_model = self.get_global_model_params()
+        with tracing.span("server.aggregate_async", n_updates=len(entries)):
+            with mlops.span("server.agg"):
+                raw = self.aggregator.on_before_aggregation(list(entries))
+                agg = self.aggregator.aggregate(raw)
+                agg = self.aggregator.on_after_aggregation(agg)
+        if server_lr != 1.0:
+            import jax.numpy as jnp
+
+            def _mix(g, a):
+                ga, aa = jnp.asarray(g), jnp.asarray(a)
+                if not jnp.issubdtype(ga.dtype, jnp.floating):
+                    return aa
+                return ga + server_lr * (aa.astype(ga.dtype) - ga)
+
+            agg = jax.tree_util.tree_map(_mix, global_model, agg)
+        self.aggregator.set_model_params(agg)
+        return agg
+
     def receive_count(self) -> int:
         return len(self._received_this_round)
 

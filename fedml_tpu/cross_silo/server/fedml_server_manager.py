@@ -18,6 +18,7 @@ from ...core import mlops
 from ...core.mlops import metrics, tracing
 from ...core.distributed.communication.message import Message
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
+from ...utils.compression import WIRE_BYTES as _wire_bytes
 from ..message_define import MyMessage
 from .fedml_aggregator import FedMLAggregator
 
@@ -110,6 +111,23 @@ class FedMLServerManager(FedMLCommManager):
         self._quarantine_resolicits: Dict[int, int] = {}
         self._resolicit_max = int(
             getattr(args, "admission_resolicit_max", 1) or 0)
+        # wire compression (docs/ROBUSTNESS.md "Asynchronous rounds"):
+        # negotiated per link — a client advertises capability tokens on
+        # its status message; broadcasts to capable links carry the
+        # quantized model + the uplink codec assignment, everyone else
+        # keeps exchanging raw pytrees.  ``_round_ref`` is the DECODED
+        # broadcast (identical on both ends by construction), the exact
+        # reference compressed uplink deltas are reconstructed against.
+        from ...utils.compression import parse_wire_compression
+
+        self._wire_spec = parse_wire_compression(
+            getattr(args, "wire_compression", None))
+        self._peer_caps: Dict[int, tuple] = {}
+        self._round_ref: Optional[Any] = None
+        #: (round_idx, enc_payload, decoded) — the global only changes
+        #: when round_idx advances, so re-solicits/catch-ups/async
+        #: re-dispatches within a round reuse one full-model encode
+        self._enc_cache: Optional[tuple] = None
         self._round_lock = threading.RLock()
         self._round_timer: Optional[threading.Timer] = None
         self._init_timer: Optional[threading.Timer] = None
@@ -335,7 +353,10 @@ class FedMLServerManager(FedMLCommManager):
         sender = msg.get_sender_id()
         status = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_STATUS)
         client_os = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_OS, "unknown")
+        caps = msg.get(MyMessage.MSG_ARG_KEY_WIRE_CAPS)
         with self._round_lock:
+            if caps:
+                self._peer_caps[sender] = tuple(str(c) for c in caps)
             if status == MyMessage.CLIENT_STATUS_ONLINE:
                 self._mark_alive(sender, announce=True)
             n_online = sum(self.client_online_status.values())
@@ -449,20 +470,77 @@ class FedMLServerManager(FedMLCommManager):
         self._arm_round_timer()
         self._arm_deadline_timer()
 
-    def _broadcast_round(self, only_rank: Optional[int] = None) -> None:
+    def _link_codec(self, rank: int) -> bool:
+        """True when this link negotiated the configured wire codec (the
+        peer's advertised capability tokens cover it)."""
+        if self._wire_spec is None:
+            return False
+        from ...utils.compression import required_caps
+
+        caps = set(self._peer_caps.get(rank, ()))
+        need = set(required_caps(self._wire_spec))
+        # the downlink leg quantizes the model (int8, or bf16 for a bf16
+        # spec) — the peer must be able to decode it
+        need.add("bf16" if self._wire_spec.kind == "bf16" else "int8")
+        return need.issubset(caps)
+
+    def _note_round_ref(self, ref: Any, raw: Optional[Any] = None) -> None:
+        """Record the round's shared delta reference (hook point — the
+        async manager versions these).  ``ref`` is what a CODEC link
+        computes deltas against (the decoded broadcast); ``raw`` is the
+        unencoded global a legacy/raw link received (defaults to ref)."""
+        self._round_ref = ref
+
+    def _broadcast_round(self, only_rank=None) -> None:
         """Send the current round's model to every participating rank (or
-        just ``only_rank`` for re-solicitation/late-join catch-up) — one
-        message per slot a rank serves.  Caller holds ``_round_lock``."""
+        just ``only_rank`` — an int, or a collection of ranks — for
+        re-solicitation/late-join catch-up/async flush release) — one
+        message per slot a rank serves.  Caller holds ``_round_lock``.
+
+        With wire compression negotiated, capable links receive the
+        quantized model plus their uplink codec assignment; the DECODED
+        broadcast becomes the round's delta reference on both ends."""
+        from ...utils.serialization import estimate_nbytes
+
+        only = (None if only_rank is None
+                else {only_rank} if isinstance(only_rank, int)
+                else set(only_rank))
         mtype = (MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT
                  if self.args.round_idx else
                  MyMessage.MSG_TYPE_S2C_INIT_CONFIG)
         global_model = self.aggregator.get_global_model_params()
+        enc_payload = None
+        if self._wire_spec is not None:
+            from ...utils.compression import WireCodec
+
+            version = int(self.args.round_idx)
+            if self._enc_cache is not None and self._enc_cache[0] == version:
+                _, enc_payload, decoded = self._enc_cache
+            else:
+                enc_payload = WireCodec.encode_model(
+                    global_model,
+                    "bf16" if self._wire_spec.kind == "bf16" else "int8")
+                decoded = WireCodec.decode_model(enc_payload)
+                self._enc_cache = (version, enc_payload, decoded)
+            self._note_round_ref(decoded, raw=global_model)
+        else:
+            self._note_round_ref(global_model)
         for i, rank in enumerate(
                 self._ranks_for(self.client_id_list_in_this_round)):
-            if only_rank is not None and rank != only_rank:
+            if only is not None and rank not in only:
                 continue
+            use_codec = enc_payload is not None and self._link_codec(rank)
             msg = Message(mtype, self.get_sender_id(), rank)
-            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_model)
+            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                           enc_payload if use_codec else global_model)
+            if use_codec:
+                msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_ENCODED, True)
+                msg.add_params(MyMessage.MSG_ARG_KEY_WIRE_CODEC,
+                               str(getattr(self.args, "wire_compression")))
+            _wire_bytes.labels(
+                run_id=self._run_label, direction="down",
+                codec=(self._wire_spec.kind if use_codec else "raw")).inc(
+                estimate_nbytes(enc_payload if use_codec else global_model))
             msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
                            self.client_id_list_in_this_round[i])
             msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.args.round_idx)
@@ -601,6 +679,15 @@ class FedMLServerManager(FedMLCommManager):
                 return
             model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
             compressed = msg.get(MyMessage.MSG_ARG_KEY_COMPRESSED_UPDATE)
+            wire_update = msg.get(MyMessage.MSG_ARG_KEY_WIRE_UPDATE)
+            if model_params is None and wire_update is not None:
+                # negotiated codec: weights = round reference + decoded
+                # delta, reconstructed inside the jitted decode path
+                from ...utils.compression import decode_delta
+
+                ref = (self._round_ref if self._round_ref is not None
+                       else self.aggregator.get_global_model_params())
+                model_params = decode_delta(wire_update, ref)
             if model_params is None and compressed is not None:
                 # sparse delta: rebuild weights = global + Δ using OUR copy
                 # of the global model for the tree structure

@@ -1,0 +1,95 @@
+"""Staleness-weight functions for buffered-async aggregation.
+
+In the buffered-async mode (docs/ROBUSTNESS.md "Asynchronous rounds") an
+update trained against server version ``t`` may be folded into the buffer
+at version ``T`` ≥ t.  Its aggregation weight is ``n_samples · f(T - t)``
+where ``f`` is one of the decay functions below — staleness DOWN-WEIGHTS
+an honest-but-late update, it never quarantines it (that is admission
+control's job, and conflating the two would let an adversary disguise
+poison as lateness or make a slow silo read as hostile).
+
+Catalog (``--async-staleness`` spec strings):
+
+* ``constant``      — f(s) = 1: pure FedBuff buffering, no decay.
+* ``poly[:a]``      — f(s) = (1+s)^-a (default a = 0.5, the FedBuff
+  paper's choice); heavy-tailed, a very stale update still contributes.
+* ``exp[:a]``       — f(s) = e^{-a·s} (default a = 0.5); aggressive,
+  effectively mutes updates older than a few versions.
+* ``hinge[:c[:a]]`` — f(s) = 1 for s ≤ c, else (1 + a·(s-c))^-1
+  (defaults c = 3, a = 1.0): free grace window, polynomial decay past it.
+
+All functions map s=0 → 1.0 (a fresh update keeps its full sample
+weight) and are monotone non-increasing.  Weights are computed on the
+host at admission time (one float per upload) — they parameterize the
+robust-agg reduction, they do not run inside it.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, NamedTuple, Optional
+
+
+class StalenessSpec(NamedTuple):
+    """Parsed ``--async-staleness`` selector."""
+
+    name: str
+    a: float = 0.5
+    cutoff: float = 3.0   # hinge grace window
+
+
+_FUNCTIONS = ("constant", "poly", "exp", "hinge")
+
+
+def parse_staleness(spec: Any) -> StalenessSpec:
+    """``None``/empty → the default ``poly:0.5``; else validate + parse.
+
+    Raises ``ValueError`` on an unknown function or malformed parameter so
+    a typo'd flag fails at startup, not on the first stale upload.
+    """
+    if spec is None or spec is False or str(spec).strip() == "":
+        return StalenessSpec("poly", 0.5)
+    parts = [p for p in str(spec).strip().split(":") if p != ""]
+    name = parts[0].lower()
+    if name not in _FUNCTIONS:
+        raise ValueError(
+            f"unknown async_staleness function {name!r}; expected one of "
+            f"{'|'.join(_FUNCTIONS)}")
+    try:
+        if name == "constant":
+            return StalenessSpec(name, 0.0)
+        if name == "hinge":
+            cutoff = float(parts[1]) if len(parts) > 1 else 3.0
+            a = float(parts[2]) if len(parts) > 2 else 1.0
+            if cutoff < 0 or a <= 0:
+                raise ValueError("hinge needs cutoff >= 0 and a > 0")
+            return StalenessSpec(name, a, cutoff)
+        a = float(parts[1]) if len(parts) > 1 else 0.5
+        if a <= 0:
+            raise ValueError(f"{name} decay rate must be > 0")
+        return StalenessSpec(name, a)
+    except ValueError as e:
+        raise ValueError(
+            f"malformed async_staleness spec {spec!r}: {e}") from e
+
+
+def staleness_weight(spec: StalenessSpec, staleness: float) -> float:
+    """f(s) for one update; ``staleness`` = server_version - client_round
+    (clamped at 0 — an update can never be fresher than the frontier)."""
+    s = max(0.0, float(staleness))
+    if spec.name == "constant":
+        return 1.0
+    if spec.name == "poly":
+        return (1.0 + s) ** (-spec.a)
+    if spec.name == "exp":
+        return math.exp(-spec.a * s)
+    # hinge
+    if s <= spec.cutoff:
+        return 1.0
+    return 1.0 / (1.0 + spec.a * (s - spec.cutoff))
+
+
+def staleness_fn(spec: Any) -> Callable[[float], float]:
+    """Parse once, close over the spec: ``fn(staleness) -> weight``."""
+    parsed = parse_staleness(spec)
+    return lambda s: staleness_weight(parsed, s)

@@ -7,14 +7,42 @@ plus flatten/unflatten helpers (`utils/model_utils.py`).
 TPU-first: selection is ``jax.lax.top_k`` on the flattened update (one fused
 op), residuals are a pytree carried between rounds; compress returns
 (values, indices) pairs suitable for the wire.
+
+``WireCodec`` (docs/ROBUSTNESS.md "Asynchronous rounds") is the cross-silo
+wire-compression layer built on the fused kernels in
+``ops/wire_compression.py``: per-update DELTA encoding against the last
+global the client received (the server keeps the identical reference per
+version, so reconstruction is exact up to codec error), int8/bf16
+quantization and/or top-k sparsification of the delta, error-feedback
+residual kept client-side, and a self-describing full-model downlink
+encoding (per-leaf blocked int8) that survives every transport's
+serializer.  Decode paths are jitted — on the server the decompression
+folds into the aggregation program instead of running as eager host ops.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Tuple
+from functools import partial
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from ..core.mlops import metrics
+from ..ops.wire_compression import (
+    dequantize_int8_blocked,
+    quantize_int8_blocked,
+    scatter_flat,
+    topk_select,
+)
+
+#: shared by the client/server comm managers — one definition so the
+#: label set and help text cannot drift between the two ends of the wire
+WIRE_BYTES = metrics.counter(
+    "fedml_wire_bytes_total",
+    "Model payload bytes placed on the wire, by direction (up = client "
+    "uploads, down = server broadcasts) and codec (raw when uncompressed)",
+    labels=("run_id", "direction", "codec"))
 
 
 def tree_spec(tree: Any) -> Any:
@@ -80,3 +108,222 @@ class EFTopKCompressor(TopKCompressor):
         sent = jnp.zeros_like(flat).at[idx].set(values)
         self.residual = flat - sent
         return {"values": values, "indices": idx, "size": len(flat)}, spec
+
+
+# ---------------------------------------------------------------------------
+# wire codec: delta + quantize/sparsify, negotiated per cross-silo link
+# ---------------------------------------------------------------------------
+
+class WireSpec(NamedTuple):
+    """Parsed ``--wire-compression`` selector (static per link)."""
+
+    kind: str          # bf16 | int8 | topk | topk8
+    ratio: float = 0.01
+
+
+_WIRE_KINDS = ("bf16", "int8", "topk", "topk8")
+
+#: capability tokens a client advertises in its status message; the server
+#: only assigns a codec whose tokens the link's peer supports
+WIRE_CAPS = ("delta", "bf16", "int8", "topk")
+
+#: reserved marker key for per-leaf quantized downlink payloads
+_WQ_KEY = "__wq__"
+
+
+def parse_wire_compression(spec: Any) -> Optional[WireSpec]:
+    """``None``/empty → None; else validate + parse.  Raises ``ValueError``
+    on an unknown codec or malformed ratio so a typo'd flag fails at
+    startup, not on the first upload."""
+    if spec is None or spec is False or str(spec).strip() == "":
+        return None
+    parts = [p for p in str(spec).strip().split(":") if p != ""]
+    kind = parts[0].lower()
+    if kind == "none":
+        return None
+    if kind not in _WIRE_KINDS:
+        raise ValueError(
+            f"unknown wire_compression codec {kind!r}; expected one of "
+            f"none|{'|'.join(_WIRE_KINDS)}")
+    ratio = 0.01
+    if len(parts) > 1:
+        if kind in ("bf16", "int8"):
+            raise ValueError(
+                f"wire_compression {kind} takes no parameter")
+        try:
+            ratio = float(parts[1])
+        except ValueError as e:
+            raise ValueError(
+                f"malformed wire_compression ratio {parts[1]!r}") from e
+        if not 0.0 < ratio <= 1.0:
+            raise ValueError("wire_compression top-k ratio must be in (0, 1]")
+    return WireSpec(kind, ratio)
+
+
+def required_caps(spec: WireSpec) -> Tuple[str, ...]:
+    """Capability tokens a peer must advertise for this codec to apply."""
+    caps = ["delta"]
+    if spec.kind == "bf16":
+        caps.append("bf16")
+    if spec.kind in ("int8", "topk8"):
+        caps.append("int8")
+    if spec.kind in ("topk", "topk8"):
+        caps.append("topk")
+    return tuple(caps)
+
+
+# decode paths are jitted with static sizes: the scatter/dequant/add chain
+# compiles once per (codec, model size) and the server's buffer fold calls
+# it as one fused program — "decompress inside the agg jit"
+@partial(jax.jit, static_argnames=("size",))
+def _decode_topk_flat(values, idx, size):
+    return scatter_flat(values, idx, size)
+
+
+@partial(jax.jit, static_argnames=("size",))
+def _decode_int8_flat(q, scales, size):
+    return dequantize_int8_blocked(q, scales, size)
+
+
+@partial(jax.jit, static_argnames=("size",))
+def _decode_topk8_flat(q, scales, idx, size):
+    k = q.shape[0]
+    return scatter_flat(dequantize_int8_blocked(q, scales, k), idx, size)
+
+
+@jax.jit
+def _add_flat(ref_flat, delta_flat):
+    return ref_flat + delta_flat
+
+
+class WireCodec:
+    """Per-link update codec: DELTA against a shared reference + one of
+    bf16 cast / blocked-int8 quantize / top-k sparsify / top-k+int8,
+    with an error-feedback residual on the encode side.
+
+    One instance per link per direction: the encoder's residual
+    accumulates everything the codec dropped, so the information is sent
+    eventually rather than lost (EF-SGD / DoubleSqueeze idiom).
+    """
+
+    def __init__(self, spec: Any) -> None:
+        parsed = spec if isinstance(spec, WireSpec) else (
+            parse_wire_compression(spec))
+        if parsed is None:
+            raise ValueError("WireCodec needs a non-empty codec spec")
+        self.spec = parsed
+        self._residual: Optional[jnp.ndarray] = None
+
+    # -- uplink: delta encoding ---------------------------------------------
+    def encode_delta(self, update: Any, ref: Any) -> Dict[str, Any]:
+        """update tree + shared reference tree → wire payload dict (arrays
+        + scalars only — serializable by every transport)."""
+        flat_u, _ = _flatten(update)
+        flat_r, _ = _flatten(ref)
+        delta = flat_u - flat_r
+        if self._residual is not None and self._residual.shape == delta.shape:
+            delta = delta + self._residual
+        payload = self._encode_flat(delta)
+        decoded = decode_delta_flat(payload)
+        self._residual = delta - decoded
+        return payload
+
+    def _encode_flat(self, delta: jnp.ndarray) -> Dict[str, Any]:
+        kind = self.spec.kind
+        d = int(delta.shape[0])
+        if kind == "bf16":
+            return {"codec": "bf16", "flat": delta.astype(jnp.bfloat16),
+                    "size": d}
+        if kind == "int8":
+            q, s = quantize_int8_blocked(delta)
+            return {"codec": "int8", "q": q, "scales": s, "size": d}
+        k = max(1, int(d * self.spec.ratio))
+        values, idx = topk_select(delta, k)
+        if kind == "topk":
+            return {"codec": "topk", "values": values, "idx": idx, "size": d}
+        q, s = quantize_int8_blocked(values)
+        return {"codec": "topk8", "values_q": q, "scales": s, "idx": idx,
+                "size": d}
+
+    # -- downlink: self-describing full-model encoding -----------------------
+    @staticmethod
+    def encode_model(tree: Any, kind: str = "int8") -> Any:
+        """Full-model broadcast payload: every float leaf is replaced by a
+        marker dict holding its blocked-int8 (or bf16) form plus enough
+        metadata to invert it WITHOUT a reference tree — the client may
+        not have one yet (INIT).  Container structure is preserved, so
+        any transport serializer that carries the original tree carries
+        this one."""
+        if kind not in ("int8", "bf16"):
+            kind = "int8"   # topk on a full model is meaningless
+
+        def _leaf(x: Any) -> Any:
+            arr = jnp.asarray(x)
+            if not jnp.issubdtype(arr.dtype, jnp.floating):
+                return x
+            if kind == "bf16":
+                return {_WQ_KEY: "bf16", "flat": arr.astype(jnp.bfloat16),
+                        "dtype": str(arr.dtype)}
+            flat = arr.reshape(-1).astype(jnp.float32)
+            q, s = quantize_int8_blocked(flat)
+            return {_WQ_KEY: "int8", "q": q, "scales": s,
+                    "shape": list(arr.shape), "dtype": str(arr.dtype)}
+
+        return jax.tree_util.tree_map(_leaf, tree)
+
+    @staticmethod
+    def is_encoded_model(tree: Any) -> bool:
+        for leaf in jax.tree_util.tree_leaves(
+                tree, is_leaf=lambda x: isinstance(x, dict)
+                and _WQ_KEY in x):
+            if isinstance(leaf, dict) and _WQ_KEY in leaf:
+                return True
+        return False
+
+    @staticmethod
+    def decode_model(tree: Any) -> Any:
+        """Invert ``encode_model``.  Deterministic: every decoder of the
+        same payload reconstructs bit-identical values, which is what
+        makes the decoded broadcast usable as the shared delta
+        reference."""
+
+        def _is_marker(x: Any) -> bool:
+            return isinstance(x, dict) and _WQ_KEY in x
+
+        def _leaf(x: Any) -> Any:
+            if not _is_marker(x):
+                return x
+            if x[_WQ_KEY] == "bf16":
+                return jnp.asarray(x["flat"]).astype(x["dtype"])
+            flat = _decode_int8_flat(jnp.asarray(x["q"]),
+                                     jnp.asarray(x["scales"]),
+                                     int(jnp.size(jnp.asarray(x["q"]))))
+            return flat.reshape(x["shape"]).astype(x["dtype"])
+
+        return jax.tree_util.tree_map(_leaf, tree, is_leaf=_is_marker)
+
+
+def decode_delta_flat(payload: Dict[str, Any]) -> jnp.ndarray:
+    """Wire payload → flat f32 delta (jitted per codec/size)."""
+    codec = str(payload["codec"])
+    size = int(payload["size"])
+    if codec == "bf16":
+        return jnp.asarray(payload["flat"]).astype(jnp.float32)
+    if codec == "int8":
+        return _decode_int8_flat(jnp.asarray(payload["q"]),
+                                 jnp.asarray(payload["scales"]), size)
+    if codec == "topk":
+        return _decode_topk_flat(jnp.asarray(payload["values"]),
+                                 jnp.asarray(payload["idx"]), size)
+    if codec == "topk8":
+        return _decode_topk8_flat(jnp.asarray(payload["values_q"]),
+                                  jnp.asarray(payload["scales"]),
+                                  jnp.asarray(payload["idx"]), size)
+    raise ValueError(f"unknown wire payload codec {codec!r}")
+
+
+def decode_delta(payload: Dict[str, Any], ref: Any) -> Any:
+    """payload + shared reference tree → reconstructed update tree
+    (ref + delta, cast back to the reference leaf dtypes)."""
+    flat_r, spec = _flatten(ref)
+    return _unflatten(_add_flat(flat_r, decode_delta_flat(payload)), spec)

@@ -162,3 +162,32 @@ def message_to_wire(msg_params: Dict[str, Any]) -> bytes:
 
 def message_from_wire(data: bytes) -> Dict[str, Any]:
     return loads_pytree(data)
+
+
+def estimate_nbytes(obj: Any) -> int:
+    """Wire-size estimate of a message payload WITHOUT serializing it:
+    array leaves count their raw buffer bytes, scalars/strings their
+    natural width, containers a small framing constant.  Used by the
+    chaos plane's bandwidth shaping and the bytes-on-wire accounting —
+    both need a per-message cost, neither can afford a second
+    ``dumps_pytree`` pass per send."""
+    if obj is None or isinstance(obj, bool):
+        return 1
+    if isinstance(obj, (int, float)):
+        return 8
+    if isinstance(obj, str):
+        return len(obj)
+    if isinstance(obj, bytes):
+        return len(obj)
+    if isinstance(obj, dict):
+        return 16 + sum(estimate_nbytes(k) + estimate_nbytes(v)
+                        for k, v in obj.items())
+    if isinstance(obj, (list, tuple)):
+        return 16 + sum(estimate_nbytes(x) for x in obj)
+    nbytes = getattr(obj, "nbytes", None)
+    if nbytes is not None:
+        return int(nbytes)
+    try:
+        return int(np.asarray(obj).nbytes)
+    except Exception:  # noqa: BLE001 — opaque object: flat guess, never raise
+        return 64

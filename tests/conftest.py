@@ -30,7 +30,8 @@ _SMOKE_FILES = {
     # test_reliability.py runs in its own dedicated smoke.yml step (like
     # test_observability.py) — listing it here would run the chaos soak
     # twice per CI job; test_aggregation.py likewise runs in the
-    # byzantine-soak step (its slow-marked soaks only run there)
+    # byzantine-soak step (its slow-marked soaks only run there), and
+    # test_async_agg.py in the async-soak step (wan-lossy straggler soak)
 }
 
 

@@ -1,0 +1,549 @@
+"""Buffered-async aggregation plane: staleness weighting, buffer flush
+triggers, wire-compression round-trips, the reliable×async interaction
+(expired_stale), (sender, client_round) dedup, and the WAN-straggler
+chaos soak (slow tier)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.runner import FedMLRunner
+
+
+def _run(args):
+    args = fedml_tpu.init(args)
+    device = fedml_tpu.device.get_device(args)
+    dataset = fedml_tpu.data.load(args)
+    bundle = fedml_tpu.model.create(args, dataset[-1])
+    return FedMLRunner(args, device, dataset, bundle).run()
+
+
+# -- staleness-weight catalog -------------------------------------------------
+
+def test_staleness_catalog():
+    from fedml_tpu.ml.aggregator.staleness import (
+        parse_staleness,
+        staleness_fn,
+        staleness_weight,
+    )
+
+    # every function maps s=0 → 1 and is monotone non-increasing
+    for spec in ("constant", "poly", "poly:1.0", "exp:0.5", "hinge:3:1.0"):
+        parsed = parse_staleness(spec)
+        assert staleness_weight(parsed, 0) == pytest.approx(1.0)
+        ws = [staleness_weight(parsed, s) for s in range(8)]
+        assert all(a >= b for a, b in zip(ws, ws[1:])), (spec, ws)
+
+    # exact values
+    assert staleness_weight(parse_staleness("poly:0.5"), 3) == \
+        pytest.approx(0.5)          # (1+3)^-0.5
+    assert staleness_weight(parse_staleness("exp:1.0"), 1) == \
+        pytest.approx(np.exp(-1.0))
+    hinge = parse_staleness("hinge:3:1.0")
+    assert staleness_weight(hinge, 3) == pytest.approx(1.0)  # grace window
+    assert staleness_weight(hinge, 5) == pytest.approx(1.0 / 3.0)
+    assert staleness_weight(parse_staleness("constant"), 100) == 1.0
+    # default is the FedBuff poly:0.5
+    assert parse_staleness(None).name == "poly"
+    # negatives clamp (an update can't be fresher than the frontier)
+    assert staleness_fn("poly:0.5")(-2) == pytest.approx(1.0)
+
+    for bad in ("frobnicate", "poly:-1", "exp:0", "hinge:-1"):
+        with pytest.raises(ValueError):
+            parse_staleness(bad)
+
+
+# -- wire codec ---------------------------------------------------------------
+
+def test_parse_wire_compression():
+    from fedml_tpu.utils.compression import (
+        parse_wire_compression,
+        required_caps,
+    )
+
+    assert parse_wire_compression(None) is None
+    assert parse_wire_compression("none") is None
+    assert parse_wire_compression("int8").kind == "int8"
+    spec = parse_wire_compression("topk8:0.05")
+    assert spec.kind == "topk8" and spec.ratio == pytest.approx(0.05)
+    assert set(required_caps(spec)) == {"delta", "int8", "topk"}
+    assert set(required_caps(parse_wire_compression("bf16"))) == \
+        {"delta", "bf16"}
+    for bad in ("zstd", "topk:0", "topk:2", "int8:0.5", "topk:x"):
+        with pytest.raises(ValueError):
+            parse_wire_compression(bad)
+
+
+def _toy_trees():
+    import jax.numpy as jnp
+
+    ref = {"a": jnp.arange(700, dtype=jnp.float32).reshape(7, 100) / 9.0,
+           "b": {"w": jnp.linspace(-1, 1, 300).astype(jnp.float32)}}
+    upd = {"a": ref["a"] * 1.01 + 0.05,
+           "b": {"w": ref["b"]["w"] * 0.9 - 0.02}}
+    return ref, upd
+
+
+@pytest.mark.parametrize("spec", ["bf16", "int8", "topk:0.2", "topk8:0.2"])
+def test_wire_codec_delta_roundtrip(spec):
+    import jax
+
+    from fedml_tpu.utils.compression import WireCodec, decode_delta
+
+    ref, upd = _toy_trees()
+    codec = WireCodec(spec)
+    payload = codec.encode_delta(upd, ref)
+    back = decode_delta(payload, ref)
+    # dtype and structure preserved
+    assert (jax.tree_util.tree_structure(back)
+            == jax.tree_util.tree_structure(upd))
+    # quantization error is bounded by a scale quantum; top-k drops
+    # coordinates (recovered by error feedback below)
+    err = max(float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+              for a, b in zip(jax.tree_util.tree_leaves(back),
+                              jax.tree_util.tree_leaves(upd)))
+    assert err < (2.0 if spec.startswith("topk") else 0.05)
+
+
+def test_wire_codec_error_feedback_recovers_dropped_mass():
+    """What top-k drops one round, the EF residual re-sends later: the
+    cumulative decoded delta converges to the true cumulative delta."""
+    import jax
+
+    from fedml_tpu.utils.compression import (
+        WireCodec,
+        _flatten,
+        decode_delta_flat,
+    )
+
+    ref, upd = _toy_trees()
+    true_delta = np.asarray(_flatten(upd)[0] - _flatten(ref)[0])
+    codec = WireCodec("topk8:0.1")
+    sent = np.zeros_like(true_delta)
+    rels = {}
+    for i in range(1, 31):
+        payload = codec.encode_delta(upd, ref)
+        sent = sent + np.asarray(decode_delta_flat(payload))
+        if i in (5, 30):
+            rels[i] = (np.linalg.norm(sent - i * true_delta)
+                       / np.linalg.norm(i * true_delta))
+    # the residual is BOUNDED, so the relative shortfall of the
+    # cumulative sent mass decays ~1/n — without EF it would be the
+    # constant fraction top-k drops every round
+    no_ef = WireCodec("topk8:0.1")
+    no_ef._residual = None
+    one_shot = np.asarray(decode_delta_flat(no_ef._encode_flat(
+        _flatten(upd)[0] - _flatten(ref)[0])))
+    rel_no_ef = (np.linalg.norm(one_shot - true_delta)
+                 / np.linalg.norm(true_delta))
+    assert rels[30] < rels[5] * 0.4, rels       # decays with rounds
+    assert rels[30] < rel_no_ef * 0.5, (rels, rel_no_ef)  # beats no-EF
+
+
+def test_wire_codec_decode_runs_inside_jit():
+    """The decompress path must be jit-traceable so the server can fold
+    it into the aggregation program (and the pallas kernel's interpret
+    mode must agree with the jnp fallback)."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.ops.wire_compression import (
+        dequantize_int8_blocked,
+        quantize_int8_blocked,
+        scatter_flat,
+    )
+
+    flat = jnp.linspace(-3, 3, 2000).astype(jnp.float32)
+    q, s = quantize_int8_blocked(flat)
+    qi, si = quantize_int8_blocked(flat, interpret=True)  # pallas path
+    np.testing.assert_array_equal(np.asarray(q), np.asarray(qi))
+    np.testing.assert_allclose(np.asarray(s), np.asarray(si), rtol=1e-6)
+
+    deq = jax.jit(lambda a, b: dequantize_int8_blocked(a, b, 2000))(q, s)
+    np.testing.assert_allclose(np.asarray(deq), np.asarray(flat),
+                               atol=float(np.max(np.asarray(s))) + 1e-6)
+    sc = jax.jit(lambda v, i: scatter_flat(v, i, 10))(
+        jnp.ones(3), jnp.array([1, 5, 7]))
+    np.testing.assert_array_equal(
+        np.asarray(sc), np.array([0, 1, 0, 0, 0, 1, 0, 1, 0, 0], np.float32))
+
+
+def test_encoded_model_broadcast_roundtrip_is_shared_reference():
+    """decode(encode_model(g)) is deterministic — both ends of the link
+    derive bit-identical delta references from the same payload."""
+    import jax
+
+    from fedml_tpu.utils.compression import WireCodec
+
+    ref, _ = _toy_trees()
+    enc = WireCodec.encode_model(ref, "int8")
+    assert WireCodec.is_encoded_model(enc)
+    a = WireCodec.decode_model(enc)
+    b = WireCodec.decode_model(enc)
+    for la, lb in zip(jax.tree_util.tree_leaves(a),
+                      jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    assert not WireCodec.is_encoded_model(a)
+
+
+# -- async manager unit tier (stub aggregator, no training) -------------------
+
+class _StubServerAggregator:
+    """Minimal FedMLAggregator stand-in recording buffer folds."""
+
+    admission_control = False
+    metrics_history: list
+
+    def __init__(self, reject_reason=None):
+        import jax.numpy as jnp
+
+        self.global_params = {"w": jnp.zeros(8, jnp.float32)}
+        self.folds = []
+        self.metrics_history = []
+        self.quarantined_this_round = {}
+        self._reject = reject_reason
+
+    def get_global_model_params(self):
+        return self.global_params
+
+    def set_global_model_params(self, p):
+        self.global_params = p
+
+    def admission_check(self, params):
+        return self._reject
+
+    def aggregate_buffer(self, entries, server_lr=1.0):
+        self.folds.append(list(entries))
+        return self.global_params
+
+    def test_on_server_for_all_clients(self, round_idx):
+        self.metrics_history.append({"round": round_idx})
+        return {"round": round_idx}
+
+    def client_sampling(self, r, total, k):
+        return list(range(k))
+
+    def data_silo_selection(self, r, total, k):
+        return list(range(k))
+
+
+def _mk_async_server(args_factory, run_id, n_clients=3, **kw):
+    from fedml_tpu.cross_silo.server.async_server_manager import (
+        AsyncFedMLServerManager,
+    )
+
+    args = args_factory(training_type="cross_silo",
+                        client_num_in_total=n_clients,
+                        client_num_per_round=n_clients, run_id=run_id, **kw)
+    agg = _StubServerAggregator()
+    if kw.get("admission_control"):
+        agg.admission_control = True
+    mgr = AsyncFedMLServerManager(args, agg, rank=0, client_num=n_clients,
+                                  backend="INPROC")
+    mgr.is_initialized = True
+    mgr.client_id_list_in_this_round = list(range(n_clients))
+    for rank in range(1, n_clients + 1):
+        mgr.client_online_status[rank] = True
+        mgr._dispatched_version[rank] = 0
+    return mgr, agg
+
+
+def _upload(mgr, sender, client_round, n_samples=10.0, params=None):
+    import jax.numpy as jnp
+
+    from fedml_tpu.core.distributed.communication.message import Message
+    from fedml_tpu.cross_silo.message_define import MyMessage
+
+    msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, sender, 0)
+    msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, client_round)
+    msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, n_samples)
+    msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                   params if params is not None
+                   else {"w": jnp.ones(8, jnp.float32) * sender})
+    mgr.handle_message_receive_model_from_client(msg)
+
+
+def test_async_count_flush_applies_staleness_weights(args_factory):
+    mgr, agg = _mk_async_server(args_factory, "as_unit1",
+                                async_agg=True, async_buffer_k=2,
+                                async_staleness="poly:0.5", comm_round=50)
+    mgr.args.round_idx = 4  # pretend 4 flushes happened
+    _upload(mgr, 1, client_round=4, n_samples=10)   # fresh: weight 10
+    assert len(mgr._buffer) == 1 and not agg.folds
+    _upload(mgr, 2, client_round=1, n_samples=10)   # staleness 3: 10·(4)^-½=5
+    # count trigger at k=2 → one flush, buffer drained, version advanced
+    assert len(agg.folds) == 1 and not mgr._buffer
+    assert int(mgr.args.round_idx) == 5
+    weights = [w for w, _ in agg.folds[0]]
+    assert weights[0] == pytest.approx(10.0)
+    assert weights[1] == pytest.approx(10.0 / np.sqrt(4.0))
+
+
+def test_async_expired_stale_is_dropped_not_quarantined(args_factory):
+    """Satellite: a retransmitted update arriving past its staleness
+    cutoff is counted expired_stale and dropped — never quarantined, and
+    it cannot re-open a flushed buffer (the fold list stays empty)."""
+    from fedml_tpu.core.mlops import metrics
+
+    mgr, agg = _mk_async_server(args_factory, "as_unit2",
+                                async_agg=True, async_buffer_k=4,
+                                async_staleness_cutoff=3, comm_round=50,
+                                admission_control=True)
+    agg.admission_control = True
+    mgr.args.round_idx = 10
+    _upload(mgr, 1, client_round=2)   # staleness 8 > cutoff 3
+    assert not mgr._buffer and not agg.folds
+    assert mgr.aggregator.quarantined_this_round == {}
+    m = metrics.REGISTRY.collect()["fedml_async_updates_total"]
+    assert m.labels(run_id="as_unit2", outcome="expired_stale").value == 1
+    # the duplicate retransmit of the SAME expired upload is dedup-suppressed
+    _upload(mgr, 1, client_round=2)
+    assert m.labels(run_id="as_unit2", outcome="expired_stale").value == 1
+    assert m.labels(run_id="as_unit2", outcome="duplicate").value == 1
+    assert not mgr._buffer and not agg.folds
+
+
+def test_async_dedup_key_is_sender_and_client_round(args_factory):
+    """Satellite: keep-first dedup on (sender, client_round) — the same
+    client uploading in two DIFFERENT rounds is legitimate, the same
+    (sender, round) pair twice is a transport duplicate."""
+    from fedml_tpu.core.mlops import metrics
+
+    mgr, agg = _mk_async_server(args_factory, "as_unit3",
+                                async_agg=True, async_buffer_k=10,
+                                comm_round=50)
+    mgr.args.round_idx = 2
+    _upload(mgr, 1, client_round=1)
+    _upload(mgr, 1, client_round=1)   # transport duplicate → suppressed
+    _upload(mgr, 1, client_round=2)   # different round → legitimate
+    assert len(mgr._buffer) == 2
+    m = metrics.REGISTRY.collect()["fedml_async_updates_total"]
+    assert m.labels(run_id="as_unit3", outcome="duplicate").value == 1
+    assert m.labels(run_id="as_unit3", outcome="folded").value == 2
+
+
+def test_async_quarantine_before_buffer(args_factory):
+    """Admission control screens async uploads BEFORE the buffer: poison
+    is rejected outright, not staleness-down-weighted."""
+    mgr, agg = _mk_async_server(args_factory, "as_unit4",
+                                async_agg=True, async_buffer_k=4,
+                                comm_round=50, admission_control=True)
+    agg.admission_control = True
+    agg._reject = "non_finite"
+    _upload(mgr, 1, client_round=0)
+    assert not mgr._buffer
+    assert mgr.aggregator.quarantined_this_round.get(0) == "non_finite"
+    # a corrected retry for the SAME round is re-screened, not dedup-dropped
+    agg._reject = None
+    _upload(mgr, 1, client_round=0)
+    assert len(mgr._buffer) == 1
+
+
+def test_async_timer_flush(args_factory):
+    mgr, agg = _mk_async_server(args_factory, "as_unit5",
+                                async_agg=True, async_buffer_k=99,
+                                async_flush_s=0.2, comm_round=50)
+    t = threading.Thread(target=mgr._flush_loop, daemon=True)
+    t.start()
+    _upload(mgr, 1, client_round=0)
+    deadline = time.time() + 5
+    while time.time() < deadline and not agg.folds:
+        time.sleep(0.02)
+    mgr._flush_stop.set()
+    assert agg.folds, "timer never flushed the buffer"
+    assert int(mgr.args.round_idx) == 1
+
+
+def test_async_drain_flush_when_everyone_parked(args_factory):
+    """All online participants at the frontier → flush immediately
+    instead of idling (or deadlocking when buffer_k > cohort)."""
+    mgr, agg = _mk_async_server(args_factory, "as_unit6", n_clients=2,
+                                async_agg=True, async_buffer_k=99,
+                                comm_round=50)
+    _upload(mgr, 1, client_round=0)
+    assert not agg.folds          # rank 2 still active
+    _upload(mgr, 2, client_round=0)
+    assert len(agg.folds) == 1    # both parked → drain flush
+    assert int(mgr.args.round_idx) == 1
+
+
+def test_async_dead_silo_triggers_drain_flush(args_factory):
+    """A heartbeat-dead declaration shrinks the online set — the drain
+    trigger must re-fire so survivors parked at the frontier are not
+    gated forever on the dead silo's never-coming upload."""
+    mgr, agg = _mk_async_server(args_factory, "as_unit8", n_clients=3,
+                                async_agg=True, async_buffer_k=3,
+                                comm_round=50)
+    _upload(mgr, 1, client_round=0)
+    _upload(mgr, 2, client_round=0)
+    assert not agg.folds              # rank 3 still online and active
+    with mgr._round_lock:
+        mgr.client_online_status[3] = False   # hb monitor declares dead
+        mgr._maybe_complete_early()
+    assert len(agg.folds) == 1        # drain flushed without rank 3
+    assert int(mgr.args.round_idx) == 1
+
+
+def test_async_missing_delta_ref_is_expired_not_corrupted(args_factory):
+    """A compressed upload whose trained-against reference is no longer
+    held (version predates a crash-resume) cannot be reconstructed —
+    it must be dropped as expired_stale, never decoded against a
+    different version's reference (silent corruption) and never
+    quarantined."""
+    from fedml_tpu.core.distributed.communication.message import Message
+    from fedml_tpu.core.mlops import metrics
+    from fedml_tpu.cross_silo.message_define import MyMessage
+
+    mgr, agg = _mk_async_server(args_factory, "as_unit9",
+                                async_agg=True, async_buffer_k=4,
+                                comm_round=50)
+    mgr.args.round_idx = 3            # resumed: no refs for versions < 3
+    msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, 1, 0)
+    msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, 2)
+    msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 10.0)
+    msg.add_params(MyMessage.MSG_ARG_KEY_WIRE_UPDATE, {"pre_crash": True})
+    mgr.handle_message_receive_model_from_client(msg)
+    assert not mgr._buffer and not agg.folds
+    assert mgr.aggregator.quarantined_this_round == {}
+    m = metrics.REGISTRY.collect()["fedml_async_updates_total"]
+    assert m.labels(run_id="as_unit9", outcome="expired_stale").value == 1
+
+
+def test_async_quarantine_exhaustion_aborts_instead_of_hanging(args_factory):
+    """When every online silo is parked with an EMPTY buffer and the
+    quarantine re-solicit budgets are spent, no admissible upload can
+    ever arrive and no flush will release the fleet — the server must
+    abort the run cleanly, not hang forever."""
+    mgr, agg = _mk_async_server(args_factory, "as_unit7", n_clients=1,
+                                async_agg=True, async_buffer_k=99,
+                                comm_round=50, admission_control=True,
+                                admission_resolicit_max=1)
+    agg.admission_control = True
+    agg._reject = "non_finite"
+    _upload(mgr, 1, client_round=0)       # quarantined → re-solicited
+    assert not mgr._finishing
+    _upload(mgr, 1, client_round=0)       # budget spent → parked
+    assert mgr._finishing, (
+        "server parked its only silo with an empty buffer and kept "
+        "waiting for a flush that can never come")
+    assert not agg.folds and mgr.aggregator.quarantined_this_round
+
+
+# -- integration: full protocol over INPROC -----------------------------------
+
+def test_async_full_protocol_converges(args_factory):
+    m = _run(args_factory(training_type="cross_silo", backend="INPROC",
+                          role="simulated", client_num_in_total=3,
+                          client_num_per_round=3, comm_round=4,
+                          data_scale=0.3, learning_rate=0.1,
+                          run_id="as_e2e", async_agg=True,
+                          async_buffer_k=2))
+    assert np.isfinite(m["test_loss"])
+    assert m["test_acc"] > 0.2
+
+
+def test_async_with_wire_compression_matches_sync(args_factory):
+    """int8 delta compression under async folding: equal-accuracy check
+    against the plain sync run (quantize+delta+EF loses ~nothing on this
+    workload), plus the ≥4x uplink byte reduction."""
+    from fedml_tpu.core.mlops import metrics
+
+    common = dict(training_type="cross_silo", backend="INPROC",
+                  role="simulated", client_num_in_total=3,
+                  client_num_per_round=3, comm_round=3, data_scale=0.3,
+                  learning_rate=0.1)
+    sync = _run(args_factory(run_id="as_wc_sync", **common))
+    comp = _run(args_factory(run_id="as_wc_async", async_agg=True,
+                             async_buffer_k=3, wire_compression="int8",
+                             **common))
+    assert np.isfinite(comp["test_loss"])
+    assert abs(sync["test_acc"] - comp["test_acc"]) < 0.15
+    wb = metrics.REGISTRY.collect()["fedml_wire_bytes_total"]
+    raw_up = wb.labels(run_id="as_wc_sync", direction="up",
+                       codec="raw").value
+    int8_up = wb.labels(run_id="as_wc_async", direction="up",
+                        codec="int8").value
+    assert int8_up > 0 and raw_up > 0
+    # int8 payload ≈ ¼ of f32 (+ scales); both runs ship 9 uploads
+    assert raw_up / int8_up > 3.0, (raw_up, int8_up)
+
+
+# -- chaos soak: WAN straggler (slow tier, runs in CI async-soak step) --------
+
+def _register_wan_backend(name, straggler_rank, latency_scale):
+    from fedml_tpu.core.distributed.communication.chaos import (
+        chaos_from_profile,
+    )
+    from fedml_tpu.core.distributed.communication.inprocess import (
+        InProcCommManager,
+    )
+    from fedml_tpu.core.distributed.fedml_comm_manager import (
+        register_comm_backend,
+    )
+
+    def factory(args, rank=0, size=0):
+        inner = InProcCommManager(rank, size, str(args.run_id))
+        scale = latency_scale if rank == straggler_rank else 1.0
+        return chaos_from_profile(
+            inner, "wan-lossy" if rank == straggler_rank else "wan-good",
+            seed=100 + rank, latency_scale=scale)
+
+    register_comm_backend(name, factory)
+
+
+@pytest.mark.slow
+def test_async_wan_straggler_soak(args_factory):
+    """5 silos, one on wan-lossy at 10x latency: async round progress
+    must not be gated by the straggler (wall-clock beats sync under the
+    SAME chaos), and the final model must match sync FedAvg within
+    tolerance."""
+    import threading as _t
+
+    from fedml_tpu.cross_silo.runner import init_client, init_server
+
+    def federate(run_id, backend, **kw):
+        args = fedml_tpu.init(args_factory(
+            training_type="cross_silo", client_num_in_total=5,
+            client_num_per_round=5, comm_round=4, data_scale=0.3,
+            learning_rate=0.1, run_id=run_id, reliable=True,
+            reliable_retx_initial_s=0.2, reliable_retx_max_s=1.0,
+            frequency_of_the_test=1, **kw))
+        dataset = fedml_tpu.data.load(args)
+        bundle = fedml_tpu.model.create(args, dataset[-1])
+        server = init_server(args, dataset, bundle, backend=backend)
+        clients = [init_client(args, dataset, bundle, rank, backend=backend)
+                   for rank in range(1, 6)]
+        threads = [_t.Thread(target=c.run, daemon=True) for c in clients]
+        t0 = time.monotonic()
+        for th in threads:
+            th.start()
+        server.run()
+        wall = time.monotonic() - t0
+        for th in threads:
+            th.join(timeout=30)
+        return server.aggregator.metrics_history[-1], wall
+
+    _register_wan_backend("WAN_SOAK_SYNC", straggler_rank=5,
+                          latency_scale=10.0)
+    _register_wan_backend("WAN_SOAK_ASYNC", straggler_rank=5,
+                          latency_scale=10.0)
+    # clean sync baseline for the accuracy bar (no chaos, plain INPROC)
+    clean, _ = federate("soak_clean", "INPROC")
+    sync_m, sync_wall = federate("soak_sync", "WAN_SOAK_SYNC",
+                                 round_timeout_s=8.0,
+                                 min_clients_per_round=3)
+    async_m, async_wall = federate(
+        "soak_async", "WAN_SOAK_ASYNC", async_agg=True, async_buffer_k=3,
+        async_flush_s=2.0, async_staleness="poly:0.5",
+        wire_compression="int8")
+    assert np.isfinite(async_m["test_loss"])
+    # round progress is not gated by the slowest link: the async run's
+    # rounds complete faster than the sync run's under identical chaos
+    assert async_wall < sync_wall, (async_wall, sync_wall)
+    # equal final accuracy within tolerance (both vs the clean baseline)
+    assert abs(async_m["test_acc"] - clean["test_acc"]) < 0.15, \
+        (async_m["test_acc"], clean["test_acc"])
+    assert np.isfinite(sync_m["test_loss"])

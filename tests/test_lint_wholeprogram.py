@@ -495,6 +495,107 @@ def test_fully_keyword_bound_registration_counts(tmp_path):
     assert _lint(tmp_path, ["PROTO002", "FLOW001", "RES001"]) == []
 
 
+ASYNC_DEFINE = """\
+    class MyMessage:
+        MSG_TYPE_C2S_HELLO = "C2S_HELLO"
+        MSG_TYPE_S2C_INIT = "S2C_INIT"
+        MSG_TYPE_S2C_SYNC = "S2C_SYNC"
+        MSG_TYPE_C2S_UPLOAD = "C2S_UPLOAD"
+        MSG_TYPE_S2C_FINISH = "S2C_FINISH"
+"""
+
+ASYNC_SERVER = """\
+    from .base import BaseCommManager, Message
+    from .message_define import MyMessage
+
+    class AsyncServerManager(BaseCommManager):
+        def __init__(self):
+            super().__init__()
+            self.buffer = []
+            self.version = 0
+
+        def register_message_receive_handlers(self):
+            self.register_message_receive_handler(
+                MyMessage.MSG_TYPE_C2S_HELLO, self.handle_hello)
+            self.register_message_receive_handler(
+                MyMessage.MSG_TYPE_C2S_UPLOAD, self.handle_upload)
+
+        def run(self):
+            self.register_message_receive_handlers()
+
+        def handle_hello(self, msg):
+            self.send_message(Message(MyMessage.MSG_TYPE_S2C_INIT, 0, 1))
+
+        def handle_upload(self, msg):
+            self.buffer.append(msg)
+            if len(self.buffer) >= 2:
+                self._flush()
+            else:
+                self.send_message(Message(MyMessage.MSG_TYPE_S2C_SYNC, 0, 1))
+
+        def _flush(self):
+            self.buffer = []
+            self.version += 1
+            self.send_message(Message(MyMessage.MSG_TYPE_S2C_FINISH, 0, 1))
+            self.finish()
+"""
+
+ASYNC_CLIENT = """\
+    from .base import BaseCommManager, Message
+    from .message_define import MyMessage
+
+    class AsyncClientManager(BaseCommManager):
+        def register_message_receive_handlers(self):
+            self.register_message_receive_handler(
+                MyMessage.MSG_TYPE_S2C_INIT, self.handle_dispatch)
+            self.register_message_receive_handler(
+                MyMessage.MSG_TYPE_S2C_SYNC, self.handle_dispatch)
+            self.register_message_receive_handler(
+                MyMessage.MSG_TYPE_S2C_FINISH, self.handle_finish)
+
+        def run(self):
+            self.register_message_receive_handlers()
+            self.send_message(Message(MyMessage.MSG_TYPE_C2S_HELLO, 1, 0))
+
+        def handle_dispatch(self, msg):
+            self.send_message(Message(MyMessage.MSG_TYPE_C2S_UPLOAD, 1, 0))
+
+        def handle_finish(self, msg):
+            self.finish()
+"""
+
+
+def test_flow001_buffered_async_rounds_reach_finish(tmp_path):
+    # the buffered-async message shape: the server answers each upload
+    # with the next dispatch (no per-round barrier) and only the flush
+    # path emits FINISH — the liveness FSM must see FINISH as reachable
+    # through the fold → flush chain, not flag the buffered loop as a
+    # stall
+    _write_protocol(tmp_path, base=BASE_GUARDED, server=ASYNC_SERVER,
+                    client=ASYNC_CLIENT, define=ASYNC_DEFINE)
+    assert _lint(tmp_path, ["PROTO002", "FLOW001", "RES001"]) == []
+
+
+def test_flow001_flags_async_flush_that_never_finishes(tmp_path):
+    # regression guard for the FSM: a buffered server that re-dispatches
+    # forever and never reaches its flush (the only FINISH emitter) is a
+    # liveness bug, buffered or not — the flush method EXISTS, so this is
+    # FLOW001's unreachable-send verdict, not PROTO002's orphan verdict
+    server = ASYNC_SERVER.replace(
+        "            if len(self.buffer) >= 2:\n"
+        "                self._flush()\n"
+        "            else:\n"
+        "                self.send_message("
+        "Message(MyMessage.MSG_TYPE_S2C_SYNC, 0, 1))",
+        "            self.send_message("
+        "Message(MyMessage.MSG_TYPE_S2C_SYNC, 0, 1))")
+    _write_protocol(tmp_path, base=BASE_GUARDED, server=server,
+                    client=ASYNC_CLIENT, define=ASYNC_DEFINE)
+    found = _lint(tmp_path, ["FLOW001"])
+    msgs = " | ".join(f.message for f in found)
+    assert "rounds can never finish" in msgs
+
+
 def test_flow001_noqa(tmp_path):
     _write_protocol(tmp_path, server=STALLED_SERVER,
                     client=STALLED_CLIENT.replace(
