@@ -1,0 +1,68 @@
+"""Regenerate benchmarks/northstar_client_sizes.json — the per-client
+sample histogram of the north-star bench partition, consumed by the
+PERF003 padding-waste lint (fedml_tpu/analysis/perf) so `fedml lint
+--perf` can audit the size-bucket policy without touching the dataset.
+
+Deterministic: the histogram depends only on the committed synthetic-CIFAR
+generator (gen_northstar_cifar.py, DATA_VERSION) and the seeded
+Dirichlet(0.5) partition, so re-running after a data-version bump is the
+only time this file changes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+sys.path.insert(0, ROOT)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+NPZ = os.path.join(ROOT, ".data_cache", "northstar", "cifar10.npz")
+OUT = os.path.join(HERE, "northstar_client_sizes.json")
+
+
+def main() -> None:
+    import numpy as np
+
+    if not os.path.exists(NPZ):
+        subprocess.run([sys.executable,
+                        os.path.join(HERE, "gen_northstar_cifar.py")],
+                       check=True)
+    with np.load(NPZ) as z:
+        y = z["y_train"]
+        meta = str(z["meta"][0])
+    from fedml_tpu.data.partition import partition
+
+    m = partition(y if y.ndim == 1 else y[:, 0], 100, "hetero", 0.5, 0)
+    sizes = [int(len(m[c])) for c in range(100)]
+    payload = {
+        "description": "Per-client sample counts of the north-star bench "
+                       "partition (benchmarks/gen_northstar_cifar.py npz, "
+                       "Dirichlet(0.5), 100 clients, seed 0) — consumed "
+                       "by the PERF003 padding-waste lint and regenerable "
+                       "with benchmarks/gen_northstar_client_sizes.py",
+        "dataset": "cifar10_northstar",
+        "data_version": meta,
+        "partition_method": "hetero",
+        "partition_alpha": 0.5,
+        "random_seed": 0,
+        "client_num_in_total": 100,
+        "client_num_per_round": 10,
+        "batch_size": 32,
+        "hetero_buckets": 10,
+        # the bench's bucket-cap policy of record (bench.py
+        # hetero_bucket_cap) — PERF003 audits bucket_plan under exactly
+        # this policy, so a bench-side change must be mirrored here
+        "hetero_bucket_cap": 0.8,
+        "sizes": sizes,
+    }
+    with open(OUT, "w") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    print(json.dumps({"out": OUT, "n": sum(sizes)}))
+
+
+if __name__ == "__main__":
+    main()

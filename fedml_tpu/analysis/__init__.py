@@ -11,6 +11,11 @@ Rule families (docs/STATIC_ANALYSIS.md has the full catalog):
   protocol liveness over the send/handle FSM, SHARD001 PartitionSpec/mesh
   contracts, RES001 thread + receive-loop lifecycle; ``--graph dot|json``
   exports the send/handle graph
+* perf tier (``--perf``, ``analysis.perf``): traces REGISTERED jit
+  entrypoints (ShapeDtypeStruct specs, no data) and lints their IR —
+  PERF001 donation audit, PERF002 bf16→f32 widening, PERF003
+  padding-waste in the size-bucket policy, PERF004 layout-changing
+  transposes in scan bodies, PERF005 host callbacks inside jit
 
 Entry points: ``run_lint`` (library), ``run_cli`` (the `fedml lint`
 command body; exit codes 0 = clean, 1 = new findings, 2 = internal error).
@@ -49,6 +54,8 @@ def run_cli(root: Optional[str] = None,
             update_baseline: bool = False,
             rule_ids: Optional[Sequence[str]] = None,
             whole_program: bool = False,
+            perf: bool = False,
+            perf_registry=None,
             graph: Optional[str] = None,
             echo=print) -> int:
     """Body of ``fedml lint``; returns the process exit code."""
@@ -87,14 +94,16 @@ def run_cli(root: Optional[str] = None,
                  "--rules — the baseline must come from a full scan")
             return EXIT_INTERNAL_ERROR
         if update_baseline:
-            # the baseline file is SHARED by the per-file and whole-program
-            # CI gates; rewriting it from a per-file-only scan would drop
-            # every baselined cross-file entry, so always take the fullest
-            # scan when rewriting
+            # the baseline file is SHARED by the per-file, whole-program
+            # and perf CI gates; rewriting it from a partial scan would
+            # drop every baselined entry of the skipped tiers, so always
+            # take the fullest scan when rewriting
             whole_program = True
+            perf = True
         root_p = Path(root) if root else default_root()
         result = run_lint(root_p, paths=paths or None, rule_ids=rule_ids,
-                          whole_program=whole_program)
+                          whole_program=whole_program, perf=perf,
+                          perf_registry=perf_registry)
         baseline_p = (Path(baseline) if baseline
                       else root_p / DEFAULT_BASELINE_NAME)
         if update_baseline:

@@ -131,7 +131,10 @@ def _apply_noqa(findings: List[Finding],
 def run_lint(root: Optional[Path] = None,
              paths: Optional[Sequence[str]] = None,
              rule_ids: Optional[Sequence[str]] = None,
-             whole_program: bool = False) -> LintResult:
+             whole_program: bool = False,
+             perf: bool = False,
+             perf_registry=None) -> LintResult:
+    from .perf.rules import perf_rule_ids
     from .rules import make_program_rules, make_rules
 
     t0 = time.monotonic()
@@ -140,14 +143,20 @@ def run_lint(root: Optional[Path] = None,
     all_rules = make_rules()
     all_prog_rules = make_program_rules()
     prog_ids = {r.id.upper() for r in all_prog_rules}
+    # PERF000 is the pass's own trace-failure finding, suppressible and
+    # baselineable like any rule id
+    perf_ids = {r.upper() for r in perf_rule_ids()} | {"PERF000"}
     if wanted is not None:
-        known = {r.id.upper() for r in all_rules} | prog_ids
+        known = {r.id.upper() for r in all_rules} | prog_ids | perf_ids
         unknown = sorted(wanted - known)
         if unknown:
             raise ValueError(f"unknown rule id(s) {unknown}; "
                              f"known: {sorted(known)}")
-        # asking for a whole-program rule by id implies the full pass
+        # asking for a whole-program/perf rule by id implies that pass;
+        # conversely --perf with a rule filter that selects NO perf rule
+        # would trace every entrypoint for nothing — skip the pass
         whole_program = whole_program or bool(wanted & prog_ids)
+        perf = bool(wanted & perf_ids)
     rules = [r for r in all_rules
              if wanted is None or r.id.upper() in wanted]
     prog_rules = ([r for r in all_prog_rules
@@ -225,6 +234,17 @@ def run_lint(root: Optional[Path] = None,
                     prog_findings = [f for f in prog_findings
                                      if f.path in subset]
                 _emit_project(prog_findings)
+    if perf:
+        from .perf import run_perf_pass
+
+        perf_findings, perf_notes = run_perf_pass(
+            root, registry=perf_registry, rule_ids=rule_ids)
+        if paths:
+            subset_paths = {c.path for c in contexts}
+            perf_findings = [f for f in perf_findings
+                             if f.path in subset_paths]
+        _emit_project(perf_findings)
+        notes.extend(perf_notes)
     findings.sort(key=Finding.sort_key)
     return LintResult(findings, n_files, suppressed,
                       time.monotonic() - t0, notes)

@@ -1,0 +1,112 @@
+"""Perf-lint tier: trace registered jit entrypoints and lint their IR.
+
+Third analysis tier next to the AST plane (``analysis/rules``) and the
+whole-program plane (``analysis/wholeprogram``): ``fedml lint --perf``
+resolves every ``register_jit_entrypoint`` entry (ShapeDtypeStruct specs,
+no real data), traces it with ``jax.make_jaxpr``-equivalent staging, and
+runs the PERF rule family over the jaxpr / lowered StableHLO / optional
+compile stats.  Findings share the noqa fingerprints, the
+``.fedml-lint-baseline.json`` ratchet, the text/JSON output and the exit
+codes of the other tiers.
+
+jax imports stay inside the pass — ``fedml lint`` without ``--perf``
+never pays them.  When the pass runs in a process that has not picked a
+JAX platform yet, it pins ``JAX_PLATFORMS=cpu`` first: lint tracing is
+abstract and must never grab an accelerator.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from pathlib import Path
+from typing import List, Optional, Sequence, Tuple
+
+from ..findings import SEV_ERROR, Finding
+from .registry import (
+    EntrypointRegistry,
+    EntrypointSpec,
+    default_registry,
+    load_default_entrypoints,
+    register_jit_entrypoint,
+)
+from .rules import make_perf_rules, perf_rule_ids
+
+__all__ = [
+    "EntrypointRegistry", "EntrypointSpec", "register_jit_entrypoint",
+    "default_registry", "load_default_entrypoints", "run_perf_pass",
+    "make_perf_rules", "perf_rule_ids",
+]
+
+
+def _pin_cpu_platform() -> None:
+    """Abstract tracing must not initialize an accelerator backend (or
+    hang probing for one).  Respect an explicit JAX_PLATFORMS; otherwise
+    pin cpu — importing ``fedml_tpu`` already imports jax, so the check
+    is whether a BACKEND is initialized yet (lazy), not the module."""
+    if os.environ.get("JAX_PLATFORMS"):
+        return
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if "jax" in sys.modules:
+        try:
+            from jax._src import xla_bridge
+
+            if not xla_bridge.backends_are_initialized():
+                sys.modules["jax"].config.update("jax_platforms", "cpu")
+        except Exception:       # backend already live: use it as-is
+            pass
+
+
+def run_perf_pass(root: Path,
+                  registry: Optional[EntrypointRegistry] = None,
+                  rule_ids: Optional[Sequence[str]] = None
+                  ) -> Tuple[List[Finding], List[str]]:
+    """Trace every registered entrypoint and run the requested PERF rules.
+
+    Returns (findings, notes).  A factory/trace failure becomes a
+    PERF000 *error* finding (a broken registration must fail the gate,
+    not silently shrink coverage) plus a surfaced note.
+    """
+    _pin_cpu_platform()
+    from .tracing import TracedEntrypoint
+
+    reg = registry if registry is not None else load_default_entrypoints()
+    wanted = ({r.strip().upper() for r in rule_ids} if rule_ids else None)
+    rules = [r for r in make_perf_rules()
+             if wanted is None or r.id.upper() in wanted]
+    findings: List[Finding] = []
+    notes: List[str] = []
+    if not reg.entries():
+        notes.append("perf pass: no registered jit entrypoints")
+        return findings, notes
+    for spec in reg.entries():
+        path = _rel_or_default(spec, root)
+        try:
+            traced = TracedEntrypoint(spec, root)
+        except Exception as exc:  # noqa: BLE001 — converted to a finding
+            msg = f"{exc.__class__.__name__}: {str(exc).splitlines()[0][:160]}" \
+                if str(exc) else exc.__class__.__name__
+            findings.append(Finding(
+                "PERF000", SEV_ERROR, path,
+                int(spec.meta.get("src_line", 1) or 1), 0,
+                f"entrypoint '{spec.name}' failed to build/trace — {msg}"))
+            notes.append(f"perf pass: entrypoint '{spec.name}' failed to "
+                         f"trace ({msg})")
+            continue
+        spec.path = path  # rules anchor whole-entry findings here
+        for rule in rules:
+            findings.extend(rule.check_entrypoint(traced))
+    return findings, notes
+
+
+def _rel_or_default(spec: EntrypointSpec, root: Path) -> str:
+    """Relativize the registration site to the lint root so noqa comments
+    next to ``register_jit_entrypoint`` calls apply."""
+    src = spec.path or spec.meta.get("src_file")
+    if not src:
+        return "fedml_tpu/analysis/perf/entrypoints.py"
+    try:
+        return Path(src).resolve().relative_to(
+            Path(root).resolve()).as_posix()
+    except Exception:
+        return str(src)

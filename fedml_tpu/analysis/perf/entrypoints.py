@@ -1,0 +1,305 @@
+"""Registered jit entrypoints — the repo's hot programs, as the perf-lint
+tier traces them.
+
+Every factory builds a SMALL synthetic instance of the real program (tiny
+model, synthetic partition, scan chunk 4) purely to obtain the jitted
+callable + abstract arg specs; structure — donation layout, dtype chains,
+scan bodies, callback reachability — is identical to the production
+config, only the shapes shrink, so the IR facts the rules check transfer.
+Everything runs on CPU under ``JAX_PLATFORMS=cpu`` in well under the
+60-second smoke budget.
+
+Widen allowlists record the DELIBERATE mixed-precision policy:
+
+* ``fedml_tpu/models/`` — model forwards upcast around normalization
+  (flax BN/GN computes statistics in f32 by design) and emit f32 logits
+  for the loss; both are the bf16 training recipe, not accidents.
+* the aggregation kernels (``agg_operator.py`` / ``robust.py``) are
+  sanctioned globally by the rule itself — f32 accumulation over the
+  client axis is the documented contract.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+
+from .registry import register_jit_entrypoint
+
+#: repo root (…/fedml_tpu/analysis/perf/entrypoints.py → three up)
+_ROOT = Path(__file__).resolve().parents[3]
+
+
+# ---------------------------------------------------------------------------
+# Parrot — the north-star simulation hot path
+# ---------------------------------------------------------------------------
+_MINI_PARROT = None
+
+
+def _mini_parrot_api():
+    """A structurally-faithful miniature of the bench ParrotAPI: bf16
+    compute, size-bucketed with the bench's rotating-window cap (so the
+    capped gather path is in the trace), synthetic data.  Memoized — the
+    three parrot entries share one build per process (the fused entry's
+    FUSED_CHUNK_ROUNDS override doesn't affect the other two, whose jits
+    were built in __init__)."""
+    global _MINI_PARROT
+    if _MINI_PARROT is not None:
+        return _MINI_PARROT
+    import fedml_tpu
+    from fedml_tpu.runner import FedMLRunner
+
+    args = fedml_tpu.init(fedml_tpu.Config(
+        dataset="synthetic", model="lr", backend="parrot",
+        client_num_in_total=8, client_num_per_round=4, comm_round=2,
+        epochs=1, batch_size=8, learning_rate=0.1, data_scale=0.3,
+        partition_alpha=0.3, frequency_of_the_test=1,
+        enable_tracking=False, compute_dtype="bfloat16",
+        hetero_buckets=2, hetero_bucket_cap=0.8, parrot_aot_cache=False))
+    device = fedml_tpu.device.get_device(args)
+    dataset = fedml_tpu.data.load(args)
+    bundle = fedml_tpu.model.create(args, dataset[-1])
+    _MINI_PARROT = FedMLRunner(args, device, dataset, bundle).runner
+    return _MINI_PARROT
+
+
+def _sds(tree):
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
+
+
+def _parrot_fused_scan():
+    import jax
+    import jax.numpy as jnp
+
+    api = _mini_parrot_api()
+    api.FUSED_CHUNK_ROUNDS = 4      # scan length is structural, not ruleful
+    fn = api._build_multi_round_step()
+    args = (_sds(api.device_data), _sds(api.global_vars),
+            _sds(api.server_state),
+            jax.ShapeDtypeStruct((2,), jnp.uint32),
+            jax.ShapeDtypeStruct((), jnp.int32))
+    return fn, args
+
+
+def _parrot_bucketed_round():
+    import jax
+    import jax.numpy as jnp
+
+    api = _mini_parrot_api()
+    args = (_sds(api.device_data), _sds(api.global_vars),
+            _sds(api.server_state), jax.ShapeDtypeStruct((2,), jnp.uint32))
+    return api.bucketed_round_step, args
+
+
+def _parrot_eval_step():
+    api = _mini_parrot_api()
+    batches = api._make_test_batches()
+    return api.eval_step, (_sds(api.global_vars), _sds(batches))
+
+
+def _northstar_bucket_stats():
+    """PERF003 input: the committed north-star client-size histogram run
+    through the live ``bucket_plan`` policy — the audit sees exactly the
+    padding the bench config pays."""
+    p = _ROOT / "benchmarks" / "northstar_client_sizes.json"
+    if not p.is_file():
+        return None
+    d = json.loads(p.read_text(encoding="utf-8"))
+    from ...simulation.parrot.parrot_api import bucket_plan
+
+    plan = bucket_plan(np.asarray(d["sizes"]),
+                       int(d["client_num_per_round"]),
+                       int(d["batch_size"]),
+                       int(d["hetero_buckets"]),
+                       float(d.get("hetero_bucket_cap", 0.0)))
+    return {"buckets": [{"padded": b["padded"], "real": b["real"]}
+                        for b in plan]}
+
+
+register_jit_entrypoint(
+    "parrot/fused_round_scan", _parrot_fused_scan,
+    donate_argnums=(1, 2),
+    meta={"widen_allow": ("fedml_tpu/models/",),
+          "bucket_stats_fn": _northstar_bucket_stats})
+
+register_jit_entrypoint(
+    "parrot/bucketed_round_step", _parrot_bucketed_round,
+    donate_argnums=(1, 2),
+    meta={"widen_allow": ("fedml_tpu/models/",)})
+
+register_jit_entrypoint(
+    # eval reuses global_vars/test batches every call — donating would be
+    # a bug; donate_argnums=() records the audit decision
+    "parrot/eval_step", _parrot_eval_step,
+    donate_argnums=(),
+    meta={"widen_allow": ("fedml_tpu/models/",)})
+
+
+# ---------------------------------------------------------------------------
+# Robust aggregation operators (shared by SP / cross-silo / Parrot)
+# ---------------------------------------------------------------------------
+def _stacked_tree(n=8, dtype="bfloat16"):
+    import jax
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(dtype)
+    return {
+        "conv": jax.ShapeDtypeStruct((n, 3, 3, 16, 32), dt),
+        "dense": {"kernel": jax.ShapeDtypeStruct((n, 256, 64), dt),
+                  "bias": jax.ShapeDtypeStruct((n, 64), dt)},
+    }
+
+
+def _robust_agg():
+    import jax
+    import jax.numpy as jnp
+
+    from ...ml.aggregator.robust import parse_robust_agg, robust_agg_stacked
+
+    spec = parse_robust_agg("trimmed_mean:0.2")
+
+    def agg(stacked, weights):
+        return robust_agg_stacked(spec, stacked, weights)
+
+    return jax.jit(agg), (
+        _stacked_tree(), jax.ShapeDtypeStruct((8,), jnp.float32))
+
+
+def _agg_stacked():
+    import jax
+    import jax.numpy as jnp
+
+    from ...ml.aggregator.agg_operator import agg_stacked
+
+    return jax.jit(agg_stacked), (
+        _stacked_tree(), jax.ShapeDtypeStruct((8,), jnp.float32))
+
+
+register_jit_entrypoint("agg/robust_trimmed_mean", _robust_agg)
+register_jit_entrypoint("agg/stacked_weighted_mean", _agg_stacked)
+
+
+# ---------------------------------------------------------------------------
+# Wire compression (cross-silo upload/broadcast codecs)
+# ---------------------------------------------------------------------------
+_WIRE_D = 1 << 18      # flat update length the codec entries trace at
+
+
+def _ref_tree():
+    """bf16 model-shaped reference the decode folds into (sums to _WIRE_D
+    elements so the flat delta matches)."""
+    import jax
+    import jax.numpy as jnp
+
+    return {"w": jax.ShapeDtypeStruct((512, 448), jnp.bfloat16),
+            "b": jax.ShapeDtypeStruct((32768,), jnp.bfloat16)}
+
+
+def _wire_quantize():
+    import jax
+    import jax.numpy as jnp
+
+    from ...ops.wire_compression import quantize_int8_blocked
+
+    return (jax.jit(lambda flat: quantize_int8_blocked(flat)),
+            (jax.ShapeDtypeStruct((_WIRE_D,), jnp.float32),))
+
+
+def _wire_decode_int8_delta():
+    import jax
+    import jax.numpy as jnp
+
+    from ...ops.wire_compression import BLOCK
+    from ...utils.compression import decode_delta
+
+    n_scales = -(-_WIRE_D // BLOCK)
+
+    def decode(ref, q, scales):
+        return decode_delta(
+            {"codec": "int8", "q": q, "scales": scales, "size": _WIRE_D},
+            ref)
+
+    return jax.jit(decode), (
+        _ref_tree(),
+        jax.ShapeDtypeStruct((_WIRE_D,), jnp.int8),
+        jax.ShapeDtypeStruct((n_scales,), jnp.float32))
+
+
+def _wire_decode_topk8_delta():
+    import jax
+    import jax.numpy as jnp
+
+    from ...ops.wire_compression import BLOCK
+    from ...utils.compression import decode_delta
+
+    k = _WIRE_D // 10
+    n_scales = -(-k // BLOCK)
+
+    def decode(ref, q, scales, idx):
+        return decode_delta(
+            {"codec": "topk8", "values_q": q, "scales": scales,
+             "idx": idx, "size": _WIRE_D}, ref)
+
+    return jax.jit(decode), (
+        _ref_tree(),
+        jax.ShapeDtypeStruct((k,), jnp.int8),
+        jax.ShapeDtypeStruct((n_scales,), jnp.float32),
+        jax.ShapeDtypeStruct((k,), jnp.int32))
+
+
+register_jit_entrypoint("wire/quantize_int8", _wire_quantize)
+# the decode output shape-matches the reference tree, but the reference
+# is the SHARED per-version broadcast every upload of that version
+# reconstructs against — donating it would corrupt the next decode, so
+# donate_argnums=() records the audited decision.  widen_allow: the
+# per-leaf f32 add in _add_delta_tree is REQUIRED for bit-exact
+# reconstruction (the EF residual and per-version reference contract
+# model an exact apply); the fixed waste was the whole-model flat f32
+# materialization, which is gone — the per-leaf chain fuses.
+_WIRE_WIDEN_OK = ("fedml_tpu/utils/compression.py",)
+register_jit_entrypoint("wire/decode_int8_delta", _wire_decode_int8_delta,
+                        donate_argnums=(),
+                        meta={"widen_allow": _WIRE_WIDEN_OK})
+register_jit_entrypoint("wire/decode_topk8_delta",
+                        _wire_decode_topk8_delta, donate_argnums=(),
+                        meta={"widen_allow": _WIRE_WIDEN_OK})
+
+
+# ---------------------------------------------------------------------------
+# LLM SFT train step (functional LoRA epoch scan)
+# ---------------------------------------------------------------------------
+def _llm_train_epoch():
+    import jax
+    import jax.numpy as jnp
+
+    import fedml_tpu
+    from ...train.llm.trainer import LLMTrainConfig, LLMTrainer
+
+    args = fedml_tpu.Config(model="transformer", dataset="shakespeare",
+                            compute_dtype="float32")
+    bundle = fedml_tpu.model.create(args, 90)
+    cfg = LLMTrainConfig(seq_len=16, batch_size=2, lora_rank=2)
+    trainer = LLMTrainer(bundle, cfg)
+    trainable = trainer._trainables()
+    opt_state = trainer.tx.init(trainable)
+    base_params = trainer.variables["params"]
+    model_state = {k: v for k, v in trainer.variables.items()
+                   if k != "params"}
+    batches = {
+        "x": jax.ShapeDtypeStruct((2, 2, 16), jnp.int32),
+        "y": jax.ShapeDtypeStruct((2, 2, 16), jnp.int32),
+        "mask": jax.ShapeDtypeStruct((2, 2, 16), jnp.float32),
+    }
+    return trainer._train_epoch, (
+        _sds(trainable), _sds(opt_state), _sds(base_params),
+        _sds(model_state), batches,
+        jax.ShapeDtypeStruct((2,), jnp.uint32))
+
+
+register_jit_entrypoint("llm/train_epoch", _llm_train_epoch,
+                        donate_argnums=(0, 1))

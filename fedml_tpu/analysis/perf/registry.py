@@ -1,0 +1,147 @@
+"""Jit-entrypoint registry for the perf-lint tier.
+
+The AST plane (rules/) and whole-program plane (wholeprogram/) read source
+text; the performance bugs that cap MFU — dropped buffer donation, silent
+bf16→f32 widening, padding waste, layout-changing copies — are only
+visible in the traced jaxpr and compiled HLO.  This registry is the bridge:
+hot jitted programs register a *factory* (so nothing heavy happens at
+import time) plus abstract argument specs (``jax.ShapeDtypeStruct`` trees
+— tracing needs shapes and dtypes, never real data), and ``fedml lint
+--perf`` traces each entry and lints its IR.
+
+Registration is declarative and lazy:
+
+    from fedml_tpu.analysis.perf import register_jit_entrypoint
+
+    register_jit_entrypoint(
+        "parrot/bucketed_round_step",
+        fn_factory=_build_mini_parrot_round,   # () -> (jitted_fn, args)
+        abstract_args=None,                    # or a tuple of SDS trees
+        donate_argnums=(1, 2),
+        meta={"widen_allow": ("fedml_tpu/models/",)},
+    )
+
+``fn_factory`` returns either the jitted callable (when ``abstract_args``
+is given) or a ``(fn, args)`` pair (when the specs depend on the built
+object, e.g. a model's parameter tree).  Factories run on CPU under
+``JAX_PLATFORMS=cpu`` in CI — they must stay small and synthetic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+#: marker severity tags an entry can carry; "cold" marks entrypoints that
+#: are NOT on the training hot path (their findings default to baseline
+#: candidates rather than must-fix)
+TAG_HOT = "hot"
+TAG_COLD = "cold"
+
+
+@dataclasses.dataclass
+class EntrypointSpec:
+    """One registered jit program (lazy — nothing traced until the pass)."""
+
+    name: str
+    fn_factory: Callable[[], Any]
+    abstract_args: Optional[Any] = None
+    #: argnums the jit DECLARES donated (audited by PERF001); None when
+    #: the entrypoint donates nothing on purpose
+    donate_argnums: Optional[Tuple[int, ...]] = None
+    #: repo-relative posix path the findings anchor to when an eqn has no
+    #: usable source frame (e.g. the registering module)
+    path: str = ""
+    #: free-form rule knobs: widen_allow (PERF002 path prefixes),
+    #: bucket_stats / bucket_stats_fn (PERF003), min_elems overrides …
+    meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    tag: str = TAG_HOT
+
+    def build(self) -> Tuple[Any, Tuple[Any, ...]]:
+        """Resolve the factory → (jitted_fn, abstract_args tuple)."""
+        out = self.fn_factory()
+        if isinstance(out, tuple) and len(out) == 2 and callable(out[0]):
+            fn, args = out
+        else:
+            fn, args = out, self.abstract_args
+        if args is None:
+            raise ValueError(
+                f"entrypoint {self.name!r}: no abstract args — pass "
+                f"abstract_args at registration or return (fn, args) "
+                f"from the factory")
+        if not isinstance(args, tuple):
+            args = (args,)
+        return fn, args
+
+
+class EntrypointRegistry:
+    """Ordered name → EntrypointSpec map.  A second registration of the
+    same name replaces the first (latest wins) so tests and notebooks can
+    re-register without duplicate findings."""
+
+    def __init__(self) -> None:
+        self._entries: Dict[str, EntrypointSpec] = {}
+
+    def register(self, spec: EntrypointSpec) -> EntrypointSpec:
+        self._entries[spec.name] = spec
+        return spec
+
+    def entries(self) -> List[EntrypointSpec]:
+        return list(self._entries.values())
+
+    def names(self) -> List[str]:
+        return list(self._entries.keys())
+
+    def get(self, name: str) -> Optional[EntrypointSpec]:
+        return self._entries.get(name)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+#: process-wide default registry — ``entrypoints.py`` populates it with the
+#: repo's real hot programs; tests build their own private registries
+_DEFAULT = EntrypointRegistry()
+
+
+def default_registry() -> EntrypointRegistry:
+    return _DEFAULT
+
+
+def register_jit_entrypoint(
+        name: str,
+        fn_factory: Callable[[], Any],
+        abstract_args: Optional[Any] = None,
+        *,
+        donate_argnums: Optional[Sequence[int]] = None,
+        path: str = "",
+        meta: Optional[Dict[str, Any]] = None,
+        tag: str = TAG_HOT,
+        registry: Optional[EntrypointRegistry] = None) -> EntrypointSpec:
+    """Register a jitted program for the perf-lint pass (see module doc)."""
+    meta = dict(meta or {})
+    if "src_file" not in meta:
+        # anchor whole-entry findings at the registration call site so a
+        # `# fedml: noqa[PERF00x]` comment next to it applies
+        import inspect
+
+        frame = inspect.currentframe()
+        caller = frame.f_back if frame is not None else None
+        if caller is not None:
+            meta["src_file"] = caller.f_code.co_filename
+            meta["src_line"] = caller.f_lineno
+    spec = EntrypointSpec(
+        name=name, fn_factory=fn_factory, abstract_args=abstract_args,
+        donate_argnums=(tuple(donate_argnums)
+                        if donate_argnums is not None else None),
+        path=path, meta=meta, tag=tag)
+    return (registry if registry is not None else _DEFAULT).register(spec)
+
+
+def load_default_entrypoints() -> EntrypointRegistry:
+    """Import the repo's registrations (idempotent) and return the default
+    registry.  Kept separate from module import so ``fedml lint`` without
+    ``--perf`` never pays the jax import."""
+    from . import entrypoints  # noqa: F401 — importing registers
+
+    return _DEFAULT

@@ -61,7 +61,12 @@ def make_program_rules() -> List[ProgramRule]:
 
 
 def rule_catalog() -> List[dict]:
+    from ..perf.rules import make_perf_rules
+
     return ([{"id": r.id, "severity": r.severity, "title": r.title,
               "whole_program": False} for r in make_rules()]
             + [{"id": r.id, "severity": r.severity, "title": r.title,
-                "whole_program": True} for r in make_program_rules()])
+                "whole_program": True} for r in make_program_rules()]
+            + [{"id": r.id, "severity": r.severity, "title": r.title,
+                "whole_program": False, "perf": True}
+               for r in make_perf_rules()])

@@ -369,6 +369,11 @@ def job_logs(run_id: str, tail: int) -> None:
               help="also run the cross-file pass (PROTO002 orphan wire "
                    "traffic, FLOW001 protocol liveness, SHARD001 spec/mesh "
                    "contracts, RES001 resource lifecycle)")
+@click.option("--perf", "perf", is_flag=True,
+              help="also trace the registered jit entrypoints and lint "
+                   "their IR (PERF001 donation audit, PERF002 dtype "
+                   "widening, PERF003 padding waste, PERF004 scan-body "
+                   "transposes, PERF005 host callbacks)")
 @click.option("--graph", default=None,
               type=click.Choice(["dot", "json"]),
               help="emit the send/handle graph instead of linting")
@@ -376,7 +381,8 @@ def job_logs(run_id: str, tail: int) -> None:
               help="checkout root (default: the directory containing the "
                    "fedml_tpu package)")
 def lint(fmt: str, baseline: str, update_baseline: bool, paths,
-         rules: str, whole_program: bool, graph: str, root: str) -> None:
+         rules: str, whole_program: bool, perf: bool, graph: str,
+         root: str) -> None:
     """JAX-aware static analysis with a CI ratchet (docs/STATIC_ANALYSIS.md).
 
     Exit codes: 0 clean, 1 new (unbaselined) findings, 2 internal error."""
@@ -387,7 +393,7 @@ def lint(fmt: str, baseline: str, update_baseline: bool, paths,
     raise SystemExit(run_cli(
         root=root, paths=list(paths) or None, fmt=fmt, baseline=baseline,
         update_baseline=update_baseline, rule_ids=rule_ids,
-        whole_program=whole_program, graph=graph,
+        whole_program=whole_program, perf=perf, graph=graph,
         echo=click.echo))
 
 

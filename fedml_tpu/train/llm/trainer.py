@@ -129,7 +129,15 @@ class LLMTrainer:
         elif config.strategy != "none":
             raise ValueError(f"unknown llm strategy {config.strategy!r}; "
                              f"known: none, dp, fsdp")
-        self._train_epoch = jax.jit(self._build_epoch_fn())
+        # donate trainable+opt_state: train() rebinds both every epoch and
+        # writes the final value back, so the epoch scan updates in place
+        # instead of holding two copies of the trainable+optimizer state
+        # at peak (PERF001).  Non-LoRA mode passes base_params as the SAME
+        # buffers as `trainable` — donating there would overwrite a
+        # still-read input, so it keeps the copy.
+        self._train_epoch = jax.jit(
+            self._build_epoch_fn(),
+            donate_argnums=(0, 1) if config.use_lora else ())
 
     def _trainables(self):
         return self.lora if self.cfg.use_lora else self.variables["params"]
@@ -209,6 +217,12 @@ class LLMTrainer:
                 trainable, opt_state, loss = self._train_epoch(
                     trainable, opt_state, base_params, model_state, batches,
                     sub)
+            if cfg.use_lora:
+                # the donated call above deleted the buffers self.lora
+                # still points at — rebind EVERY epoch so an abnormal
+                # exit (checkpoint failure, KeyboardInterrupt) never
+                # leaves the trainer holding dead arrays
+                self.lora = trainable
             # one deliberate sync per EPOCH (not per step): the scalar gates
             # logging/checkpointing, and the scan above has already retired
             loss_host = float(loss)  # fedml: noqa[JAX003] — epoch boundary

@@ -192,8 +192,32 @@ def _decode_topk8_flat(q, scales, idx, size):
 
 
 @jax.jit
-def _add_flat(ref_flat, delta_flat):
-    return ref_flat + delta_flat
+def _add_delta_tree(ref: Any, delta_flat: jnp.ndarray) -> Any:
+    """ref tree + flat f32 delta → reconstructed tree, PER LEAF as
+    ``(leaf.astype(f32) + delta_slice).astype(leaf.dtype)``.
+
+    The add MUST run in f32: the delta is an exact f32 difference of the
+    client's values, so f32-add-then-cast reproduces the client's update
+    BIT-EXACTLY (the error-feedback residual and the async per-version
+    reference contract both model an exact server-side apply); narrowing
+    the delta before the add would round twice and drift.  What the old
+    path wasted — and this one doesn't — is the WHOLE-MODEL flat f32
+    materialization: ``_flatten(ref)`` concatenated every leaf into one
+    full-model f32 buffer and the add produced another, where the
+    per-leaf convert→add→convert chain fuses in XLA without either
+    (the per-leaf f32 compute is allowlisted at the wire entrypoints'
+    PERF002 registration — exactness requires it)."""
+    treedef, shapes, dtypes = tree_spec(ref)
+    # reuse _unflatten's offset walk but keep the delta f32 — casting a
+    # slice to the leaf dtype before the add would round/truncate it
+    delta = _unflatten(delta_flat,
+                       (treedef, shapes, [jnp.float32] * len(shapes)))
+
+    def _leaf(r: jnp.ndarray, d: jnp.ndarray) -> jnp.ndarray:
+        dt = jnp.result_type(r)
+        return (r.astype(jnp.float32) + d).astype(dt)
+
+    return jax.tree_util.tree_map(_leaf, ref, delta)
 
 
 class WireCodec:
@@ -324,6 +348,6 @@ def decode_delta_flat(payload: Dict[str, Any]) -> jnp.ndarray:
 
 def decode_delta(payload: Dict[str, Any], ref: Any) -> Any:
     """payload + shared reference tree → reconstructed update tree
-    (ref + delta, cast back to the reference leaf dtypes)."""
-    flat_r, spec = _flatten(ref)
-    return _unflatten(_add_flat(flat_r, decode_delta_flat(payload)), spec)
+    (ref + delta in each leaf's own dtype — one fused jit per tree
+    structure, no whole-model f32 widening of the reference)."""
+    return _add_delta_tree(ref, decode_delta_flat(payload))

@@ -108,6 +108,31 @@ def test_wire_codec_delta_roundtrip(spec):
     assert err < (2.0 if spec.startswith("topk") else 0.05)
 
 
+def test_decode_delta_bf16_reconstruction_is_bit_exact():
+    """The per-leaf decode must keep the f32-add-then-cast contract: with
+    a lossless payload (topk k=all), a bf16 update reconstructs
+    BIT-EXACTLY against a bf16 reference — the EF residual and the async
+    per-version reference both model an exact server-side apply, so a
+    double-rounded add would drift every round."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.utils.compression import WireCodec, decode_delta
+
+    rng = np.random.RandomState(3)
+    ref = {"w": jnp.asarray(rng.randn(64, 64), jnp.bfloat16),
+           "b": jnp.asarray(rng.randn(4096), jnp.bfloat16)}
+    upd = {"w": (ref["w"].astype(jnp.float32) * 1.01 + 0.03).astype(
+        jnp.bfloat16), "b": (ref["b"].astype(jnp.float32) - 0.5).astype(
+        jnp.bfloat16)}
+    payload = WireCodec("topk:1.0").encode_delta(upd, ref)
+    back = decode_delta(payload, ref)
+    for a, b in zip(jax.tree_util.tree_leaves(back),
+                    jax.tree_util.tree_leaves(upd)):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_wire_codec_error_feedback_recovers_dropped_mass():
     """What top-k drops one round, the EF residual re-sends later: the
     cumulative decoded delta converges to the true cumulative delta."""

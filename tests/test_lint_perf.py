@@ -1,0 +1,570 @@
+"""Perf-lint tier: entrypoint registry, PERF001-PERF005 IR rules, noqa,
+fingerprint stability, baseline ratchet, and the repo-clean smoke over the
+real registered entrypoints (CPU, <60s)."""
+
+from __future__ import annotations
+
+import importlib.util
+import itertools
+import json
+import textwrap
+import time
+
+import pytest
+
+from fedml_tpu.analysis import run_cli, run_lint
+from fedml_tpu.analysis.baseline import write_baseline
+from fedml_tpu.analysis.engine import default_root
+from fedml_tpu.analysis.findings import fingerprints
+from fedml_tpu.analysis.perf import EntrypointRegistry
+
+_seq = itertools.count()
+
+
+def _write(tmp_path, relpath: str, source: str):
+    f = tmp_path / relpath
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(source))
+    return f
+
+
+def _load(tmp_path, relpath: str = "fedml_tpu/hot.py"):
+    """Import a fixture module from the tmp lint root so jaxpr source
+    frames (and noqa lookups) resolve inside that root."""
+    name = f"_perf_fixture_{next(_seq)}"
+    spec = importlib.util.spec_from_file_location(name,
+                                                  tmp_path / relpath)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _lint(tmp_path, reg, rules=None):
+    return run_lint(root=tmp_path, rule_ids=rules, perf=True,
+                    perf_registry=reg).findings
+
+
+def _ids(findings):
+    return [f.rule_id for f in findings]
+
+
+#: fixture-module prelude: a private registry the test pulls out as REG
+_PRELUDE = """\
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.analysis.perf import (
+        EntrypointRegistry,
+        register_jit_entrypoint,
+    )
+
+    REG = EntrypointRegistry()
+"""
+
+
+# -- PERF001: donation audit --------------------------------------------------
+
+def test_perf001_fires_on_dropped_donation(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py", _PRELUDE + """\
+
+    def _factory():
+        def step(state):
+            return state.astype(jnp.bfloat16)   # dtype change drops it
+        return (jax.jit(step, donate_argnums=(0,)),
+                (jax.ShapeDtypeStruct((128, 128), jnp.float32),))
+
+    register_jit_entrypoint("fx/step", _factory, donate_argnums=(0,),
+                            registry=REG)
+    """)
+    found = _lint(tmp_path, _load(tmp_path).REG)
+    assert _ids(found) == ["PERF001"]
+    assert "donation is silently dropped" in found[0].message
+    assert found[0].path == "fedml_tpu/hot.py"
+
+
+def test_perf001_silent_when_donation_aliases(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py", _PRELUDE + """\
+
+    def _factory():
+        def step(state):
+            return state * 2.0                  # same shape/dtype: aliases
+        return (jax.jit(step, donate_argnums=(0,)),
+                (jax.ShapeDtypeStruct((128, 128), jnp.float32),))
+
+    register_jit_entrypoint("fx/step", _factory, donate_argnums=(0,),
+                            registry=REG)
+    """)
+    assert _lint(tmp_path, _load(tmp_path).REG) == []
+
+
+def test_perf001_fires_on_missing_donation(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py", _PRELUDE + """\
+
+    def _factory():
+        def step(params, batch):
+            return params + jnp.sum(batch), jnp.sum(batch)
+        return (jax.jit(step),
+                (jax.ShapeDtypeStruct((256, 256), jnp.float32),
+                 jax.ShapeDtypeStruct((8, 8), jnp.float32)))
+
+    register_jit_entrypoint("fx/step", _factory, registry=REG)
+    """)
+    found = _lint(tmp_path, _load(tmp_path).REG)
+    assert _ids(found) == ["PERF001"]
+    assert "declares no donate_argnums" in found[0].message
+
+
+def test_perf001_optout_with_empty_donate_argnums(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py", _PRELUDE + """\
+
+    def _factory():
+        def step(params, batch):
+            return params + jnp.sum(batch), jnp.sum(batch)
+        return (jax.jit(step),
+                (jax.ShapeDtypeStruct((256, 256), jnp.float32),
+                 jax.ShapeDtypeStruct((8, 8), jnp.float32)))
+
+    # inputs are reused by the caller — audited, donation declined
+    register_jit_entrypoint("fx/step", _factory, donate_argnums=(),
+                            registry=REG)
+    """)
+    assert _lint(tmp_path, _load(tmp_path).REG) == []
+
+
+def test_perf001_ignores_unused_eliminated_args(tmp_path):
+    # an arg the program never reads is ELIMINATED from the lowered
+    # module; donating it frees the buffer — that is not a dropped
+    # donation (regression: positional alias mapping must survive
+    # eliminated args sitting between kept ones)
+    _write(tmp_path, "fedml_tpu/hot.py", _PRELUDE + """\
+
+    def _factory():
+        def step(unused, state):
+            return state * 2.0
+        return (jax.jit(step, donate_argnums=(0, 1)),
+                (jax.ShapeDtypeStruct((64, 64), jnp.float32),
+                 jax.ShapeDtypeStruct((128, 128), jnp.float32)))
+
+    register_jit_entrypoint("fx/step", _factory, donate_argnums=(0, 1),
+                            registry=REG)
+    """)
+    assert _lint(tmp_path, _load(tmp_path).REG) == []
+
+
+def test_perf001_dropped_donation_shadowed_by_eliminated_twin(tmp_path):
+    # an UNUSED (eliminated) arg with the same shape/dtype as a later
+    # donated-but-dropped arg must not shadow the real finding: the
+    # dropped set comes from jax's lower-time warning, which fires
+    # exactly for mismatches and never for eliminated args
+    _write(tmp_path, "fedml_tpu/hot.py", _PRELUDE + """\
+
+    def _factory():
+        def step(unused, state):
+            return state.astype(jnp.bfloat16)
+        return (jax.jit(step, donate_argnums=(1,)),
+                (jax.ShapeDtypeStruct((128, 128), jnp.float32),
+                 jax.ShapeDtypeStruct((128, 128), jnp.float32)))
+
+    register_jit_entrypoint("fx/step", _factory, donate_argnums=(1,),
+                            registry=REG)
+    """)
+    found = _lint(tmp_path, _load(tmp_path).REG)
+    assert _ids(found) == ["PERF001"]
+    assert "float32[128,128]" in found[0].message
+
+
+def test_perf001_lost_donation_guard_silent_on_eliminated_type_twin(tmp_path):
+    # a donated-but-UNUSED arg sharing a tensor type with a kept arg
+    # makes the leaf alignment ambiguous; the lost-donation guard must
+    # stay silent (the eliminated donation just freed a buffer)
+    _write(tmp_path, "fedml_tpu/hot.py", _PRELUDE + """\
+
+    def _factory():
+        def step(unused, state):
+            return state * 2.0
+        return (jax.jit(step, donate_argnums=(0,)),
+                (jax.ShapeDtypeStruct((128, 128), jnp.float32),
+                 jax.ShapeDtypeStruct((128, 128), jnp.float32)))
+
+    register_jit_entrypoint("fx/step", _factory, donate_argnums=(0,),
+                            registry=REG)
+    """)
+    assert _lint(tmp_path, _load(tmp_path).REG) == []
+
+
+def test_perf001_fires_when_jit_lost_its_donation(tmp_path):
+    # the registration declares donate_argnums but the factory's jit has
+    # none: no warning fires (nothing was declared to jax) and nothing
+    # aliases — the audit must not pass vacuously
+    _write(tmp_path, "fedml_tpu/hot.py", _PRELUDE + """\
+
+    def _factory():
+        def step(state):
+            return state * 2.0
+        return (jax.jit(step),      # <- donation forgotten here
+                (jax.ShapeDtypeStruct((128, 128), jnp.float32),))
+
+    register_jit_entrypoint("fx/step", _factory, donate_argnums=(0,),
+                            registry=REG)
+    """)
+    found = _lint(tmp_path, _load(tmp_path).REG)
+    assert _ids(found) == ["PERF001"]
+    assert "lost its" in found[0].message
+
+
+# -- PERF002: dtype widening --------------------------------------------------
+
+_WIDEN = """\
+
+    def _factory():
+        def step(x):
+            y = x.astype(jnp.float32) * 2.0     {noqa}
+            return jnp.sum(y)
+        return (jax.jit(step),
+                (jax.ShapeDtypeStruct((64, 128), jnp.bfloat16),))
+
+    register_jit_entrypoint("fx/widen", _factory, registry=REG{extra})
+"""
+
+
+def _widen_module(noqa: str = "", extra: str = "") -> str:
+    return _PRELUDE + _WIDEN.format(noqa=noqa, extra=extra)
+
+
+def test_perf002_fires_on_bf16_widening(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py", _widen_module())
+    found = _lint(tmp_path, _load(tmp_path).REG)
+    assert _ids(found) == ["PERF002"]
+    assert "widens to float32" in found[0].message
+    # the finding lands on the widening SOURCE LINE, so noqa works there
+    assert found[0].path == "fedml_tpu/hot.py"
+    assert "astype" in (tmp_path / "fedml_tpu/hot.py").read_text() \
+        .splitlines()[found[0].line - 1]
+
+
+def test_perf002_silent_when_chain_stays_bf16(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py", _PRELUDE + """\
+
+    def _factory():
+        def step(x):
+            # NB: jnp.sum would widen (f32 accumulator) — and PERF002
+            # would be right to say so
+            return x * jnp.bfloat16(2.0)
+        return (jax.jit(step),
+                (jax.ShapeDtypeStruct((64, 128), jnp.bfloat16),))
+
+    register_jit_entrypoint("fx/widen", _factory, registry=REG)
+    """)
+    assert _lint(tmp_path, _load(tmp_path).REG) == []
+
+
+def test_perf002_widen_allow_sanctions_path(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py", _widen_module(
+        extra=",\n        meta={'widen_allow': ('fedml_tpu/hot.py',)}"))
+    assert _lint(tmp_path, _load(tmp_path).REG) == []
+
+
+def test_perf002_noqa_suppresses_on_source_line(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py",
+           _widen_module(noqa="# fedml: noqa[PERF002] — f32 on purpose"))
+    res = run_lint(root=tmp_path, perf=True,
+                   perf_registry=_load(tmp_path).REG)
+    assert res.findings == [] and res.suppressed == 1
+
+
+def test_perf002_small_tensors_ignored(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py", _PRELUDE + """\
+
+    def _factory():
+        def step(x):
+            return jnp.sum(x.astype(jnp.float32))
+        return (jax.jit(step),
+                (jax.ShapeDtypeStruct((4, 4), jnp.bfloat16),))
+
+    register_jit_entrypoint("fx/widen", _factory, registry=REG)
+    """)
+    assert _lint(tmp_path, _load(tmp_path).REG) == []
+
+
+# -- PERF003: padding waste ---------------------------------------------------
+
+def _bucket_reg(stats):
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.analysis.perf import register_jit_entrypoint
+
+    reg = EntrypointRegistry()
+    register_jit_entrypoint(
+        "fx/buckets",
+        lambda: (jax.jit(lambda x: x + 1),
+                 (jax.ShapeDtypeStruct((8,), jnp.float32),)),
+        path="fedml_tpu/hot.py",
+        meta={"bucket_stats": stats}, registry=reg)
+    return reg
+
+
+def test_perf003_fires_on_wasteful_bucket(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py", "x = 1\n")
+    reg = _bucket_reg({"buckets": [{"padded": 1088, "real": 790.0},
+                                   {"padded": 512, "real": 500.0},
+                                   {"padded": 512, "real": 500.0},
+                                   {"padded": 512, "real": 500.0},
+                                   {"padded": 512, "real": 500.0}]})
+    found = _lint(tmp_path, reg, rules=["PERF003"])
+    assert _ids(found) == ["PERF003"]
+    assert "bucket 0" in found[0].message
+
+
+def test_perf003_fires_on_round_level_waste(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py", "x = 1\n")
+    # every bucket just under the per-bucket bar, total over the round bar
+    reg = _bucket_reg({"buckets": [{"padded": 620, "real": 500.0}
+                                   for _ in range(4)]})
+    found = _lint(tmp_path, reg, rules=["PERF003"])
+    assert _ids(found) == ["PERF003"]
+    assert "round-level" in found[0].message
+
+
+def test_perf003_silent_on_tight_policy(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py", "x = 1\n")
+    reg = _bucket_reg({"buckets": [{"padded": 512, "real": 500.0},
+                                   {"padded": 416, "real": 410.0}]})
+    assert _lint(tmp_path, reg, rules=["PERF003"]) == []
+
+
+def test_perf003_northstar_policy_of_record_is_tight():
+    """The committed histogram + the live bucket_plan under the bench's
+    cap must stay under the waste thresholds (the satellite fix), and the
+    padded total must hold the <= 4250 acceptance line."""
+    import numpy as np
+
+    from fedml_tpu.simulation.parrot.parrot_api import bucket_plan
+
+    d = json.loads((default_root() / "benchmarks" /
+                    "northstar_client_sizes.json").read_text())
+    plan = bucket_plan(np.asarray(d["sizes"]), d["client_num_per_round"],
+                       d["batch_size"], d["hetero_buckets"],
+                       d["hetero_bucket_cap"])
+    padded = sum(b["padded"] for b in plan)
+    real = sum(b["real"] for b in plan)
+    assert padded <= 4250, padded
+    assert padded / real - 1.0 <= 0.08, (padded, real)
+    # and the UNCAPPED policy is what PERF003 exists to catch
+    plan0 = bucket_plan(np.asarray(d["sizes"]), d["client_num_per_round"],
+                        d["batch_size"], d["hetero_buckets"], 0.0)
+    padded0 = sum(b["padded"] for b in plan0)
+    assert padded0 >= 5600, padded0
+    assert any(b["padded"] / b["real"] - 1.0 > 0.25 for b in plan0)
+
+
+# -- PERF004: layout-changing transpose in scan bodies ------------------------
+
+def test_perf004_fires_on_explicit_transpose_in_scan(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py", _PRELUDE + """\
+
+    def _factory():
+        def body(c, x):
+            z = jnp.transpose(x, (1, 0))
+            return c + jnp.sum(z), z
+        def step(xs):
+            return jax.lax.scan(body, jnp.float32(0), xs)
+        return (jax.jit(step),
+                (jax.ShapeDtypeStruct((4, 128, 64), jnp.float32),))
+
+    register_jit_entrypoint("fx/scan", _factory, registry=REG)
+    """)
+    found = _lint(tmp_path, _load(tmp_path).REG)
+    assert _ids(found) == ["PERF004"]
+    assert "inside a scan body" in found[0].message
+
+
+def test_perf004_silent_when_hoisted_out_of_scan(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py", _PRELUDE + """\
+
+    def _factory():
+        def body(c, z):
+            return c + jnp.sum(z), z
+        def step(xs):
+            zs = jnp.transpose(xs, (0, 2, 1))   # once, outside the loop
+            return jax.lax.scan(body, jnp.float32(0), zs)
+        return (jax.jit(step),
+                (jax.ShapeDtypeStruct((4, 128, 64), jnp.float32),))
+
+    register_jit_entrypoint("fx/scan", _factory, registry=REG)
+    """)
+    assert _lint(tmp_path, _load(tmp_path).REG) == []
+
+
+def test_perf004_autodiff_transposes_filtered(tmp_path):
+    # grad-of-matmul inserts transposes attributed to the forward line;
+    # the source-text check keeps them out
+    _write(tmp_path, "fedml_tpu/hot.py", _PRELUDE + """\
+
+    def _factory():
+        def loss(w, x):
+            return jnp.sum(jnp.tanh(x @ w))
+        def body(w, x):
+            return w - 0.1 * jax.grad(loss)(w, x), jnp.float32(0)
+        def step(w, xs):
+            return jax.lax.scan(body, w, xs)
+        return (jax.jit(step),
+                (jax.ShapeDtypeStruct((128, 128), jnp.float32),
+                 jax.ShapeDtypeStruct((4, 64, 128), jnp.float32)))
+
+    register_jit_entrypoint("fx/scan", _factory, registry=REG)
+    """)
+    assert _lint(tmp_path, _load(tmp_path).REG, rules=["PERF004"]) == []
+
+
+# -- PERF005: host callbacks --------------------------------------------------
+
+def test_perf005_fires_on_debug_print_in_jit(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py", _PRELUDE + """\
+
+    def _factory():
+        def body(c, x):
+            jax.debug.print("c={c}", c=c)
+            return c + jnp.sum(x), c
+        def step(xs):
+            return jax.lax.scan(body, jnp.float32(0), xs)
+        return (jax.jit(step),
+                (jax.ShapeDtypeStruct((4, 8, 8), jnp.float32),))
+
+    register_jit_entrypoint("fx/cb", _factory, registry=REG)
+    """)
+    found = _lint(tmp_path, _load(tmp_path).REG)
+    assert _ids(found) == ["PERF005"]
+    assert found[0].severity == "error"
+    assert "scan body" in found[0].message
+
+
+def test_perf005_silent_without_callbacks(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py", _PRELUDE + """\
+
+    def _factory():
+        def step(xs):
+            return jnp.sum(xs)
+        return (jax.jit(step),
+                (jax.ShapeDtypeStruct((4, 8, 8), jnp.float32),))
+
+    register_jit_entrypoint("fx/cb", _factory, registry=REG)
+    """)
+    assert _lint(tmp_path, _load(tmp_path).REG) == []
+
+
+# -- PERF000: broken registrations fail loudly --------------------------------
+
+def test_perf000_trace_failure_is_an_error_finding(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py", _PRELUDE + """\
+
+    def _factory():
+        raise RuntimeError("model import exploded")
+
+    register_jit_entrypoint("fx/broken", _factory, registry=REG)
+    """)
+    res = run_lint(root=tmp_path, perf=True,
+                   perf_registry=_load(tmp_path).REG)
+    assert _ids(res.findings) == ["PERF000"]
+    assert res.findings[0].severity == "error"
+    assert "model import exploded" in res.findings[0].message
+    assert any("failed to trace" in n for n in res.notes)
+
+
+# -- engine integration -------------------------------------------------------
+
+def test_perf_rules_imply_perf_pass(tmp_path):
+    """--rules PERF00x auto-enables the perf pass (like whole-program),
+    and the per-file tiers do NOT run a second time."""
+    _write(tmp_path, "fedml_tpu/hot.py", _widen_module())
+    mod = _load(tmp_path)
+    res = run_lint(root=tmp_path, rule_ids=["PERF002"],
+                   perf_registry=mod.REG)      # no perf=True
+    assert _ids(res.findings) == ["PERF002"]
+    # a JAX001-triggering file proves AST rules were filtered out
+    _write(tmp_path, "fedml_tpu/loopy.py", """\
+        import jax
+
+        def train(fn, xs):
+            for x in xs:
+                jax.jit(fn)(x)
+    """)
+    res = run_lint(root=tmp_path, rule_ids=["PERF002"],
+                   perf_registry=mod.REG)
+    assert _ids(res.findings) == ["PERF002"]
+
+
+def test_unknown_perf_rule_rejected(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py", "x = 1\n")
+    with pytest.raises(ValueError, match="unknown rule id"):
+        run_lint(root=tmp_path, rule_ids=["PERF999"])
+
+
+# -- fingerprints + baseline ratchet ------------------------------------------
+
+def test_perf_fingerprints_stable_under_unrelated_churn(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py", _widen_module())
+    f1 = _lint(tmp_path, _load(tmp_path).REG)
+    fp1 = [fp for _, fp in fingerprints(f1)]
+    # unrelated edits above the finding move its line; fingerprint holds
+    _write(tmp_path, "fedml_tpu/hot.py",
+           "    # a new header comment\n    X_UNRELATED = 42\n"
+           + _widen_module())
+    f2 = _lint(tmp_path, _load(tmp_path).REG)
+    fp2 = [fp for _, fp in fingerprints(f2)]
+    assert fp1 == fp2
+    assert f1[0].line != f2[0].line
+
+
+def test_perf_baseline_ratchet_roundtrip(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py", _widen_module())
+    mod = _load(tmp_path)
+    findings = _lint(tmp_path, mod.REG)
+    assert findings
+    baseline = tmp_path / ".fedml-lint-baseline.json"
+    write_baseline(baseline, findings)
+    # baselined → clean exit
+    assert run_cli(root=str(tmp_path), perf=True, perf_registry=mod.REG,
+                   baseline=str(baseline), echo=lambda *a, **k: None) == 0
+    # a NEW finding (second widening entrypoint) → exit 1
+    _write(tmp_path, "fedml_tpu/hot2.py", _widen_module().replace(
+        "fx/widen", "fx/widen2"))
+    mod2 = _load(tmp_path, "fedml_tpu/hot2.py")
+    reg = EntrypointRegistry()
+    for e in mod.REG.entries() + mod2.REG.entries():
+        reg.register(e)
+    assert run_cli(root=str(tmp_path), perf=True, perf_registry=reg,
+                   baseline=str(baseline), echo=lambda *a, **k: None) == 1
+
+
+# -- repo-clean smoke over the real registry ----------------------------------
+
+def test_repo_perf_lint_clean_and_fast():
+    """The real registered entrypoints (parrot round + fused scan, robust
+    agg, wire codecs, LLM train step) trace on CPU inside the smoke
+    budget and raise no new findings over the committed baseline."""
+    t0 = time.monotonic()
+    root = default_root()
+    res = run_lint(root=root, rule_ids=[
+        "PERF000", "PERF001", "PERF002", "PERF003", "PERF004", "PERF005"])
+    took = time.monotonic() - t0
+    from fedml_tpu.analysis.baseline import (
+        DEFAULT_BASELINE_NAME,
+        load_baseline,
+        partition,
+    )
+
+    baseline_p = root / DEFAULT_BASELINE_NAME
+    known = load_baseline(baseline_p) if baseline_p.is_file() else {}
+    new, _old = partition(res.findings, known)
+    assert new == [], [f.render() for f, _ in new]
+    assert not res.notes, res.notes
+    assert took < 60.0, f"perf pass took {took:.1f}s (budget 60s)"
+    # the registry actually covered the hot programs
+    from fedml_tpu.analysis.perf import load_default_entrypoints
+
+    names = set(load_default_entrypoints().names())
+    for expected in ("parrot/fused_round_scan", "parrot/bucketed_round_step",
+                     "agg/robust_trimmed_mean", "wire/decode_int8_delta",
+                     "llm/train_epoch"):
+        assert expected in names, sorted(names)
