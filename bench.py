@@ -82,8 +82,13 @@ def main() -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
 
     import fedml_tpu
+    from fedml_tpu.core.mlops import flight_recorder
     from fedml_tpu.runner import FedMLRunner
 
+    # fresh flight-log dir per invocation so `fedml perf diff` can compare
+    # bench runs without records bleeding across appends
+    flight_dir = os.path.join(HERE, ".bench_flight",
+                              time.strftime("%Y%m%d-%H%M%S"))
     args = fedml_tpu.init(fedml_tpu.Config(
         dataset="cifar10",
         data_cache_dir=NPZ_DIR,          # 50k-sample shared npz
@@ -99,6 +104,8 @@ def main() -> None:
         learning_rate=0.05,
         frequency_of_the_test=1000,      # eval handled manually below
         enable_tracking=False,
+        flight_recorder=True,            # phase attribution + measured MFU
+        log_file_dir=flight_dir,
         compute_dtype="bfloat16",
         hetero_buckets=10,               # 1 client per stratum: minimal
                                          # padding AND no grouped-conv
@@ -157,20 +164,27 @@ def main() -> None:
     samples_per_sec = samples / dt
     rounds_done += n_meas
 
-    # ---- executed-FLOPs MFU (analytic) -----------------------------------
-    # XLA cost_analysis is unreliable through the remote-TPU plugin (it
-    # reported ~16x low on this config) and lowering a second executable
-    # just to read it costs a full compile, so count analytically:
-    # ResNet-56 on 32x32 CIFAR = 126.5 MMACs/sample forward (well-known
-    # figure; 2 FLOPs/MAC), x3 for fwd+bwd, times the PADDED samples each
-    # round actually executes (Σ_buckets k_b·nb_b·bs, or k·nb·bs uniform).
+    # ---- measured MFU (XLA cost analysis x flight-recorder device time) --
+    # The compiled chunk's executed FLOPs come from XLA's own
+    # cost_analysis, captured by flight_recorder.note_program when
+    # _ensure_multi_round_step compiled (or cache-loaded) the fused scan;
+    # device seconds come from the recorder's block_until_ready-synced
+    # device_compute phase.  The hand-derived ResNet-56 figure stays as a
+    # CROSS-CHECK: the remote-TPU plugin once reported cost_analysis ~16x
+    # low, and a silent factor like that must fail the bench, not ship in
+    # a headline MFU.  Analytic: ResNet-56 on 32x32 CIFAR = 126.5
+    # MMACs/sample forward (well-known figure; 2 FLOPs/MAC), x3 for
+    # fwd+bwd, times the PADDED samples each round actually executes
+    # (Σ_buckets k_b·nb_b·bs, or k·nb·bs uniform).
     RESNET56_FWD_FLOPS = 2 * 126.5e6
     TRAIN_MULT = 3.0
     if api.buckets is not None:
         padded_per_round = sum(b["k"] * b["nb"] for b in api.buckets) * api.bs
     else:
         padded_per_round = api.k * api.nb * api.bs
-    flops_per_round = padded_per_round * RESNET56_FWD_FLOPS * TRAIN_MULT
+    flops_analytic = padded_per_round * RESNET56_FWD_FLOPS * TRAIN_MULT
+    chunk_flops = (api.program_costs or {}).get("flops")
+    flops_cost = chunk_flops / chunk if chunk_flops else None
     from fedml_tpu.constants import (
         TPU_PEAK_BF16_DEFAULT,
         TPU_PEAK_BF16_FLOPS,
@@ -178,7 +192,36 @@ def main() -> None:
 
     kind = jax.devices()[0].device_kind
     peak = TPU_PEAK_BF16_FLOPS.get(kind, TPU_PEAK_BF16_DEFAULT)
-    mfu = flops_per_round * rounds_per_sec / peak
+
+    # measured device seconds per round over the perf window's fused
+    # chunks (warmup + measured window are all kind="parrot_fused")
+    fl = flight_recorder.summarize(
+        flight_recorder.load_flight_log(flight_dir))
+    pf = fl["kinds"].get("parrot_fused", {})
+    dev_s = pf.get("phases_s", {}).get("device_compute", 0.0)
+    dev_s_per_round = dev_s / max(1, pf.get("rounds", 0))
+
+    flops_per_round = flops_cost if flops_cost else flops_analytic
+    flops_source = ("xla_cost_analysis(compiled fused chunk)/chunk_rounds"
+                    if flops_cost else
+                    "analytic 2*126.5e6 FLOPs/sample x3 (cost_analysis "
+                    "unavailable on this backend)")
+    if dev_s_per_round > 0:
+        mfu = flops_per_round / dev_s_per_round / peak
+        mfu_source = (f"{flops_source} / flight-recorder device_compute "
+                      "seconds / chip peak")
+    else:
+        mfu = flops_per_round * rounds_per_sec / peak
+        mfu_source = f"{flops_source} x rounds_per_sec / chip peak (wall)"
+    mfu_guard_msg = None
+    if flops_cost:
+        ratio = flops_cost / flops_analytic
+        if not (0.5 <= ratio <= 2.0):
+            mfu_guard_msg = (
+                f"MFU FLOPS GUARD FAILED: cost_analysis/analytic ratio "
+                f"{ratio:.3f} outside [0.5, 2] — XLA's reported FLOPs and "
+                f"the hand-derived ResNet-56 figure disagree >2x; one of "
+                f"them is wrong (remote-TPU plugin has reported ~16x low)")
 
     # ---- train to the accuracy target (wall-clock-to-accuracy) ------------
     test_batches = api._make_test_batches()
@@ -230,8 +273,23 @@ def main() -> None:
                                  else round(wall_to_target, 2)),
     }
     result["est_mfu"] = round(mfu, 4)
+    result["mfu_source"] = mfu_source
     result["flops_per_round"] = round(flops_per_round, 1)
+    result["flops_per_round_analytic"] = round(flops_analytic, 1)
+    if flops_cost:
+        result["flops_cost_vs_analytic_ratio"] = round(
+            flops_cost / flops_analytic, 3)
     result["padded_samples_per_round"] = int(padded_per_round)
+    # measured round-phase decomposition from the flight recorder (whole
+    # run so far: compile + warmup + perf window), plus log provenance so
+    # `fedml perf report/diff` can re-render it
+    fl_final = flight_recorder.summarize(
+        flight_recorder.load_flight_log(flight_dir))
+    result["round_phase_seconds"] = fl_final["phases_s"]
+    result["flight_coverage"] = fl_final["coverage"]
+    result["flight_overhead_frac"] = fl_final["overhead_frac"]
+    result["flight_log"] = os.path.relpath(
+        os.path.join(flight_dir, "flight.jsonl"), HERE)
     # per-bucket padded-vs-real so the padding-waste trend stays visible
     # round over round (same accounting as the PERF003 perf-lint rule)
     waste = api.bucket_waste_stats() if hasattr(api, "bucket_waste_stats") \
@@ -316,6 +374,9 @@ def main() -> None:
     if acc < TARGET_TEST_ACC:
         print(f"ACCURACY GUARD FAILED: {acc:.4f} < {TARGET_TEST_ACC}",
               file=sys.stderr)
+        sys.exit(1)
+    if mfu_guard_msg is not None:
+        print(mfu_guard_msg, file=sys.stderr)
         sys.exit(1)
 
 

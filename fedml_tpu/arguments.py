@@ -120,6 +120,11 @@ _DEFAULTS: Dict[str, Any] = {
     "enable_tracking": True,
     "log_file_dir": None,
     "enable_wandb": False,
+    # performance flight recorder (docs/OBSERVABILITY.md): opt-in
+    # round-phase attribution + measured MFU; env toggle
+    # FEDML_TPU_FLIGHT_RECORDER=1 overrides
+    "flight_recorder": False,
+    "flight_max_records": 0,         # 0 → module default (4096)
     # precision / engine
     "dtype": "float32",
     "compute_dtype": "bfloat16",

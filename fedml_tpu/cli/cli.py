@@ -451,6 +451,65 @@ def trace_list(log_dir: str) -> None:
 
 
 @cli.group()
+def perf() -> None:
+    """Performance flight-recorder utilities over a run's flight.jsonl
+    (docs/OBSERVABILITY.md "Performance flight recorder")."""
+
+
+@perf.command("report")
+@click.argument("path", type=click.Path(exists=True))
+@click.option("--json", "as_json", is_flag=True,
+              help="emit the summarize() dict instead of the table")
+def perf_report(path: str, as_json: bool) -> None:
+    """Phase-breakdown report of a flight log (file or run log dir):
+    per-phase seconds/share, coverage, recorder overhead, top sinks and
+    per-program FLOPs / MFU / HBM."""
+    from ..core.mlops import flight_recorder
+
+    records = flight_recorder.load_flight_log(path)
+    if not records:
+        raise click.ClickException(f"no flight records under {path}")
+    if as_json:
+        click.echo(json.dumps(flight_recorder.summarize(records)))
+    else:
+        click.echo(flight_recorder.report(records))
+
+
+@perf.command("diff")
+@click.argument("path_a", type=click.Path(exists=True))
+@click.argument("path_b", type=click.Path(exists=True))
+@click.option("--label-a", default="A", help="row label for PATH_A")
+@click.option("--label-b", default="B", help="row label for PATH_B")
+def perf_diff(path_a: str, path_b: str, label_a: str, label_b: str) -> None:
+    """Per-phase per-round delta between two flight logs (e.g. two bench
+    runs) — the regression-hunting view."""
+    from ..core.mlops import flight_recorder
+
+    a = flight_recorder.load_flight_log(path_a)
+    b = flight_recorder.load_flight_log(path_b)
+    if not a or not b:
+        raise click.ClickException("one of the flight logs is empty")
+    click.echo(flight_recorder.diff(a, b, label_a=label_a, label_b=label_b))
+
+
+@perf.command("programs")
+@click.option("--entry", "entries", multiple=True,
+              help="restrict to these registered entrypoints (repeatable)")
+@click.option("--root", default=None, type=click.Path(exists=True),
+              help="checkout root (default: the installed package's parent)")
+def perf_programs(entries, root: str) -> None:
+    """Analytic FLOPs + HBM for every registered perf-lint entrypoint
+    (PR-7 registry), from XLA cost/memory analysis.  Compiles each entry
+    abstractly — seconds per program, not a hot path."""
+    from ..core.mlops import flight_recorder
+
+    costs = flight_recorder.entrypoint_costs(
+        names=list(entries) or None, root=root)
+    for name, info in sorted(costs.items()):
+        click.echo(json.dumps(dict(info, program=name)))
+
+
+@cli.group()
 def cluster() -> None:
     """Named reusable edge groups (reference `fedml cluster`)."""
 

@@ -39,6 +39,9 @@ def init(args: Any) -> None:
         _state["enabled"] = bool(getattr(args, "enable_tracking", True))
         _state["log_dir"] = log_dir
         _state["run_id"] = str(getattr(args, "run_id", "0"))
+    # the flight recorder is opt-in and independent of enable_tracking —
+    # bench runs record phases with the JSONL event pipeline off
+    flight_recorder.configure(args, log_dir=log_dir)
     if getattr(args, "enable_wandb", False):
         _try_add_wandb(args)
 
@@ -56,6 +59,7 @@ def reset() -> None:
         _state["files"] = {}
         _state["sinks"] = []
         _state["enabled"] = False
+    flight_recorder.reset()
 
 
 def shutdown() -> None:
@@ -195,6 +199,7 @@ def _try_add_wandb(args: Any) -> None:
 
 # observability plane submodules (imported last — tracing/metrics call back
 # into this module's _emit at runtime): `mlops.tracing.span(...)`,
-# `mlops.metrics.counter(...)`
+# `mlops.metrics.counter(...)`, `mlops.flight_recorder.record_round(...)`
+from . import flight_recorder  # noqa: E402,F401
 from . import metrics  # noqa: E402,F401
 from . import tracing  # noqa: E402,F401

@@ -88,6 +88,9 @@ def system_snapshot() -> Dict[str, Any]:
                 if "bytes_limit" in ms:
                     info["hbm_limit_gb"] = round(
                         ms["bytes_limit"] / 2 ** 30, 3)
+                if "peak_bytes_in_use" in ms:
+                    info["hbm_peak_gb"] = round(
+                        ms["peak_bytes_in_use"] / 2 ** 30, 3)
             except Exception:
                 pass
             devs.append(info)

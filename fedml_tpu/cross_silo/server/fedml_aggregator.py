@@ -13,7 +13,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ...core import mlops
-from ...core.mlops import metrics, tracing
+from ...core.mlops import flight_recorder, metrics, tracing
 from ...core.alg_frame.context import Context
 
 _dup_uploads_total = metrics.counter(
@@ -180,7 +180,9 @@ class FedMLAggregator:
 
         global_model = self.get_global_model_params()
         with tracing.span("server.aggregate_async", n_updates=len(entries)):
-            with mlops.span("server.agg"):
+            with mlops.span("server.agg"), \
+                    flight_recorder.phase("device_compute",
+                                          program="server/aggregate"):
                 raw = self.aggregator.on_before_aggregation(list(entries))
                 agg = self.aggregator.aggregate(raw)
                 agg = self.aggregator.on_after_aggregation(agg)
@@ -242,7 +244,9 @@ class FedMLAggregator:
         # nests under the server manager's round span via use_ctx; the
         # legacy "server.agg" event pair rides along inside mlops.span
         with tracing.span("server.aggregate", n_clients=len(idxs)):
-            with mlops.span("server.agg"):
+            with mlops.span("server.agg"), \
+                    flight_recorder.phase("device_compute",
+                                          program="server/aggregate"):
                 raw = self.aggregator.on_before_aggregation(raw)
                 agg = self.aggregator.aggregate(raw)
                 agg = self.aggregator.on_after_aggregation(agg)

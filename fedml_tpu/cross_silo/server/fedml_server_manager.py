@@ -15,7 +15,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ...core import mlops
-from ...core.mlops import metrics, tracing
+from ...core.mlops import flight_recorder, metrics, tracing
 from ...core.distributed.communication.message import Message
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
 from ...utils.compression import WIRE_BYTES as _wire_bytes
@@ -525,29 +525,34 @@ class FedMLServerManager(FedMLCommManager):
             self._note_round_ref(decoded, raw=global_model)
         else:
             self._note_round_ref(global_model)
-        for i, rank in enumerate(
-                self._ranks_for(self.client_id_list_in_this_round)):
-            if only is not None and rank not in only:
-                continue
-            use_codec = enc_payload is not None and self._link_codec(rank)
-            msg = Message(mtype, self.get_sender_id(), rank)
-            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
-                           enc_payload if use_codec else global_model)
-            if use_codec:
-                msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_ENCODED, True)
-                msg.add_params(MyMessage.MSG_ARG_KEY_WIRE_CODEC,
-                               str(getattr(self.args, "wire_compression")))
-            _wire_bytes.labels(
-                run_id=self._run_label, direction="down",
-                codec=(self._wire_spec.kind if use_codec else "raw")).inc(
-                estimate_nbytes(enc_payload if use_codec else global_model))
-            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
-                           self.client_id_list_in_this_round[i])
-            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.args.round_idx)
-            if self._round_span is not None:
-                msg.add_params(MyMessage.MSG_ARG_KEY_TRACE_CTX,
-                               tracing.inject(self._round_span.ctx))
-            self.send_message(msg)
+        with flight_recorder.phase("comm", program="server/broadcast"):
+            for i, rank in enumerate(
+                    self._ranks_for(self.client_id_list_in_this_round)):
+                if only is not None and rank not in only:
+                    continue
+                use_codec = enc_payload is not None and self._link_codec(rank)
+                msg = Message(mtype, self.get_sender_id(), rank)
+                msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                               enc_payload if use_codec else global_model)
+                if use_codec:
+                    msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_ENCODED, True)
+                    msg.add_params(MyMessage.MSG_ARG_KEY_WIRE_CODEC,
+                                   str(getattr(self.args, "wire_compression")))
+                nbytes = estimate_nbytes(
+                    enc_payload if use_codec else global_model)
+                _wire_bytes.labels(
+                    run_id=self._run_label, direction="down",
+                    codec=(self._wire_spec.kind if use_codec
+                           else "raw")).inc(nbytes)
+                flight_recorder.note_transfer("comm", nbytes)
+                msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
+                               self.client_id_list_in_this_round[i])
+                msg.add_params(MyMessage.MSG_ARG_KEY_ROUND,
+                               self.args.round_idx)
+                if self._round_span is not None:
+                    msg.add_params(MyMessage.MSG_ARG_KEY_TRACE_CTX,
+                                   tracing.inject(self._round_span.ctx))
+                self.send_message(msg)
 
     # -- elastic round timeout ----------------------------------------------
     def _arm_round_timer(self) -> None:

@@ -18,7 +18,7 @@ import numpy as np
 from ...core.alg_frame.client_trainer import ClientTrainer
 from ...core.alg_frame.server_aggregator import ServerAggregator
 from ...core.fhe import FedMLFHE
-from ...core.mlops import metrics, tracing
+from ...core.mlops import flight_recorder, metrics, tracing
 from ..engine.local_update import build_eval_step, build_local_update, make_batches
 from ..engine.model_bundle import ModelBundle
 
@@ -101,29 +101,40 @@ class DefaultClientTrainer(ClientTrainer):
 
     def train(self, train_data, device=None, args=None) -> Dict[str, Any]:
         args = args or self.args
-        nb = self.num_batches or max(
-            1, -(-len(train_data[1]) // self.batch_size))
-        batches = batches_for(train_data, self.batch_size, nb,
-                              self.bundle.input_dtype)
-        rng = jax.random.fold_in(
-            jax.random.PRNGKey(self.rng_seed), self.id)
-        with tracing.span("trainer.local_update", client_id=self.id,
-                          num_batches=nb) as sp, \
-                _local_update_seconds.labels(
-                    model=self._model_label).time(), \
-                _maybe_jax_profile(args, self._profile_state):
-            new_vars, algo_out, step_metrics = self.local_update(
-                self.params, batches, rng, self.algo_state or None)
-            # block so the span/histogram measure the real device work,
-            # not the async dispatch
-            new_vars = jax.block_until_ready(new_vars)
-            # ONE device→host transfer for every scalar; float() per metric
-            # here was a separate blocking sync per value (JAX003)
-            host_metrics = jax.device_get(step_metrics)
-            self.last_metrics = {
-                k: float(v)  # fedml: noqa[JAX003] — host numpy after get
-                for k, v in host_metrics.items()}
-            sp.set_attr("loss", self.last_metrics.get("train_loss"))
+        # flight record spans the whole local update so host-side batch
+        # prep lands in the host_gap residual, device work in
+        # device_compute, and the scalar fetch in d2h
+        with flight_recorder.record_round(
+                "sp_local_update", rounds=1,
+                program="trainer/local_update") as fr:
+            nb = self.num_batches or max(
+                1, -(-len(train_data[1]) // self.batch_size))
+            batches = batches_for(train_data, self.batch_size, nb,
+                                  self.bundle.input_dtype)
+            rng = jax.random.fold_in(
+                jax.random.PRNGKey(self.rng_seed), self.id)
+            with tracing.span("trainer.local_update", client_id=self.id,
+                              num_batches=nb) as sp, \
+                    _local_update_seconds.labels(
+                        model=self._model_label).time(), \
+                    _maybe_jax_profile(args, self._profile_state):
+                with fr.phase("device_compute"):
+                    new_vars, algo_out, step_metrics = self.local_update(
+                        self.params, batches, rng, self.algo_state or None)
+                    # block so the span/histogram measure the real device
+                    # work, not the async dispatch
+                    new_vars = jax.block_until_ready(new_vars)
+                with fr.phase("d2h"):
+                    # ONE device→host transfer for every scalar; float()
+                    # per metric here was a separate blocking sync per
+                    # value (JAX003)
+                    host_metrics = jax.device_get(step_metrics)
+                flight_recorder.note_transfer(
+                    "d2h", flight_recorder.tree_nbytes(host_metrics))
+                self.last_metrics = {
+                    k: float(v)  # fedml: noqa[JAX003] — host numpy after get
+                    for k, v in host_metrics.items()}
+                sp.set_attr("loss", self.last_metrics.get("train_loss"))
         _local_updates_total.labels(model=self._model_label).inc()
         if "train_loss" in self.last_metrics:
             _train_loss_last.labels(model=self._model_label).set(

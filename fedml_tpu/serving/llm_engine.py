@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..core.mlops import flight_recorder
 from ..core.mlops import metrics as _metrics
 
 _ttft_seconds = _metrics.histogram(
@@ -263,10 +264,16 @@ class BatchedLLMEngine:
                     tail = req.ids[-self.window:]
                     x[slot, :len(tail)] = tail  # left-aligned window
                     pos[slot] = len(tail)
+            t_step = time.monotonic()
             with self._metrics.step.time():
                 logits = np.asarray(self._step(self.variables,
                                                jnp.asarray(x),
                                                jnp.asarray(pos)))
+            # histogram-only attribution: per-token flight-log writes
+            # would BE the overhead the recorder exists to catch
+            flight_recorder.observe_phase(
+                "device_compute", time.monotonic() - t_step,
+                program="serving/decode_step")
             for slot, req in enumerate(self._active):
                 if req is None:
                     continue
@@ -624,10 +631,14 @@ class KVCacheLLMEngine:
                     if self._pos[slot] < len(req.ids) else 0
             if self.active_count == 0:
                 continue
+            t_step = time.monotonic()
             with self._metrics.step.time():
                 self._cache, logits = self.lm.decode(
                     self._cache, jnp.asarray(tokens), jnp.asarray(self._pos))
                 logits = np.asarray(logits)
+            flight_recorder.observe_phase(
+                "device_compute", time.monotonic() - t_step,
+                program="serving/decode_step")
             for slot, req in enumerate(self._active):
                 if req is None:
                     continue
@@ -723,7 +734,10 @@ class KVCacheLLMEngine:
             jnp.asarray(top_k), jnp.asarray(top_p), sub, k,
             exact_filters=exact)
         emitted = np.asarray(emitted)
-        self._metrics.step.observe(time.monotonic() - t_dispatch)
+        dt_dispatch = time.monotonic() - t_dispatch
+        self._metrics.step.observe(dt_dispatch)
+        flight_recorder.observe_phase(
+            "device_compute", dt_dispatch, program="serving/decode_step")
         for slot, req in enumerate(self._active):
             if req is None:
                 continue

@@ -44,6 +44,7 @@ from ...constants import (
     FED_OPT_SCAFFOLD,
 )
 from ...core import mlops
+from ...core.mlops import flight_recorder
 from ...ml.aggregator.agg_operator import agg_stacked
 from ...ml.aggregator.robust import parse_robust_agg, robust_agg_stacked
 from ...ml.engine.local_update import build_eval_step, build_local_update, make_batches
@@ -224,7 +225,19 @@ class ParrotAPI:
         #: (tests/test_aot_cache.py) and bench.py's warm/cold marker
         self.aot_cache_hit = False
         self._fused_is_plain_jit = False
+        #: XLA cost/memory analysis of the fused program, captured by the
+        #: flight recorder at AOT time (None until built, or when the
+        #: backend reports nothing) — bench.py's measured-MFU source
+        self.program_costs: Optional[Dict[str, Any]] = None
         self.metrics_history: List[Dict[str, Any]] = []
+        if flight_recorder.enabled():
+            # the uploads above are async; force + time them so the h2d
+            # bucket carries the real dataset-transfer cost, and count
+            # the resident bytes at the boundary
+            with flight_recorder.phase("h2d", program="parrot/device_data"):
+                jax.block_until_ready(self.device_data)
+            flight_recorder.note_transfer(
+                "h2d", flight_recorder.tree_nbytes(self.device_data))
 
     def _build_buckets(self) -> None:
         """Split clients into size strata (equal client counts, stratum
@@ -738,7 +751,24 @@ class ParrotAPI:
         return os.path.join(base, f"parrot_mrs_{h.hexdigest()[:24]}.jaxexp")
 
     def _ensure_multi_round_step(self) -> None:
-        """Build (or load) the fused program.  With a cache dir
+        """Build (or load) the fused program, attributing the wall time
+        to the flight recorder's ``compile`` bucket and capturing the
+        program's XLA cost/memory analysis (``self.program_costs``) for
+        measured MFU."""
+        if self.multi_round_step is not None:
+            return
+        with flight_recorder.phase("compile",
+                                   program="parrot/fused_round_scan"):
+            self._build_or_load_multi_round_step()
+        if self.program_costs is None:
+            # works for a freshly-compiled AND a cache-loaded executable;
+            # stays None on the plain-jit fallback (nothing compiled yet)
+            self.program_costs = flight_recorder.note_program(
+                "parrot/fused_round_scan", self.multi_round_step,
+                chunk_rounds=self.FUSED_CHUNK_ROUNDS)
+
+    def _build_or_load_multi_round_step(self) -> None:
+        """With a cache dir
         configured, the COMPILED EXECUTABLE round-trips through
         `jax.experimental.serialize_executable`: a warm process skips the
         ~40 s retrace, ~5-20 s lowering AND the XLA compile entirely
@@ -865,54 +895,73 @@ class ParrotAPI:
             # the scan always runs the full chunk; n_active masks the tail
             # (idle rounds pass the carry through), so one compiled
             # program serves every round count
-            try:
-                self.global_vars, self.server_state, rms = \
-                    self.multi_round_step(
-                        self.device_data, self.global_vars,
-                        self.server_state, sub,
-                        jnp.asarray(step, jnp.int32))
-            except Exception as e:
-                # an AOT/deserialized executable can still reject its args
-                # at bind time (input layout/sharding mismatch vs what jit
-                # would have inferred); bind-time failures leave the donated
-                # buffers intact, so fall back to the plain jit fn once.
-                # An EXECUTION-time failure has already consumed the donated
-                # state — detect that (deleted leaves) and re-raise the
-                # root cause instead of crashing later on dead arrays.
-                if self._fused_is_plain_jit:
-                    raise
+            with flight_recorder.record_round(
+                    "parrot_fused", rounds=step,
+                    program="parrot/fused_round_scan") as fr:
+                with fr.phase("device_compute"):
+                    try:
+                        self.global_vars, self.server_state, rms = \
+                            self.multi_round_step(
+                                self.device_data, self.global_vars,
+                                self.server_state, sub,
+                                jnp.asarray(step, jnp.int32))
+                    except Exception as e:
+                        # an AOT/deserialized executable can still reject its
+                        # args at bind time (input layout/sharding mismatch vs
+                        # what jit would have inferred); bind-time failures
+                        # leave the donated buffers intact, so fall back to
+                        # the plain jit fn once.  An EXECUTION-time failure
+                        # has already consumed the donated state — detect that
+                        # (deleted leaves) and re-raise the root cause instead
+                        # of crashing later on dead arrays.
+                        if self._fused_is_plain_jit:
+                            raise
 
-                def _live(tree):
-                    return all(
-                        not (hasattr(leaf, "is_deleted")
-                             and leaf.is_deleted())
-                        for leaf in jax.tree_util.tree_leaves(tree))
+                        def _live(tree):
+                            return all(
+                                not (hasattr(leaf, "is_deleted")
+                                     and leaf.is_deleted())
+                                for leaf in jax.tree_util.tree_leaves(tree))
 
-                if not (_live(self.global_vars)
-                        and _live(self.server_state)):
-                    raise
-                logging.warning("parrot: compiled fused step rejected its "
-                                "args (%s); falling back to plain jit", e)
-                if self.aot_cache_hit:
-                    # the artifact produced a bind-incompatible executable;
-                    # drop it so later processes recompile+rewrite instead
-                    # of paying load→bind-fail→retrace forever
-                    import os
+                        if not (_live(self.global_vars)
+                                and _live(self.server_state)):
+                            raise
+                        logging.warning(
+                            "parrot: compiled fused step rejected its "
+                            "args (%s); falling back to plain jit", e)
+                        if self.aot_cache_hit:
+                            # the artifact produced a bind-incompatible
+                            # executable; drop it so later processes
+                            # recompile+rewrite instead of paying
+                            # load→bind-fail→retrace forever
+                            import os
 
-                    stale = self._aot_cache_path()
-                    if stale:
-                        try:
-                            os.remove(stale)
-                        except OSError:
-                            pass
-                self.multi_round_step = self._build_multi_round_step()
-                self._fused_is_plain_jit = True
-                self.aot_cache_hit = False
-                self.global_vars, self.server_state, rms = \
-                    self.multi_round_step(
-                        self.device_data, self.global_vars,
-                        self.server_state, sub,
-                        jnp.asarray(step, jnp.int32))
+                            stale = self._aot_cache_path()
+                            if stale:
+                                try:
+                                    os.remove(stale)
+                                except OSError:
+                                    pass
+                        self.multi_round_step = self._build_multi_round_step()
+                        self._fused_is_plain_jit = True
+                        self.aot_cache_hit = False
+                        self.global_vars, self.server_state, rms = \
+                            self.multi_round_step(
+                                self.device_data, self.global_vars,
+                                self.server_state, sub,
+                                jnp.asarray(step, jnp.int32))
+                    if flight_recorder.enabled():
+                        # device-completion sync point: without it the
+                        # phase measures dispatch, not execution
+                        rms = jax.block_until_ready(rms)
+                flops = (self.program_costs or {}).get("flops")
+                dev_s = fr.phase_seconds("device_compute")
+                if flops and dev_s > 0:
+                    # idle masked tail rounds are ~free — charge only the
+                    # active fraction of the chunk's analytic FLOPs
+                    fr.note(mfu=flight_recorder.measured_mfu(
+                        "parrot/fused_round_scan",
+                        flops * (step / chunk), dev_s))
             if step < chunk:
                 rms = jax.tree_util.tree_map(lambda a: a[:step], rms)
             out.append(rms)
@@ -963,19 +1012,32 @@ class ParrotAPI:
             for round_idx in range(start_round, comm_rounds):
                 t0 = time.time()
                 rng, sub = jax.random.split(rng)
-                if self.n_buckets > 1:
-                    # stratified on-device sampling (documented deviation
-                    # from the reference's host np.random.seed(round) draws)
-                    (self.global_vars, self.server_state,
-                     rm) = self.bucketed_round_step(
-                        self.device_data, self.global_vars,
-                        self.server_state, sub)
-                else:
-                    client_ids = jnp.asarray(
-                        self._client_sampling(round_idx))
-                    self.global_vars, self.server_state, rm = self.round_step(
-                        self.device_data, self.global_vars,
-                        self.server_state, client_ids, sub)
+                with flight_recorder.record_round(
+                        "parrot_round", rounds=1,
+                        program="parrot/round_step") as fr:
+                    if self.n_buckets > 1:
+                        # stratified on-device sampling (documented
+                        # deviation from the reference's host
+                        # np.random.seed(round) draws)
+                        with fr.phase("device_compute"):
+                            (self.global_vars, self.server_state,
+                             rm) = self.bucketed_round_step(
+                                self.device_data, self.global_vars,
+                                self.server_state, sub)
+                            if flight_recorder.enabled():
+                                rm = jax.block_until_ready(rm)
+                    else:
+                        # host-side sampling stays outside the device
+                        # phase — it lands in the host_gap residual
+                        client_ids = jnp.asarray(
+                            self._client_sampling(round_idx))
+                        with fr.phase("device_compute"):
+                            (self.global_vars, self.server_state,
+                             rm) = self.round_step(
+                                self.device_data, self.global_vars,
+                                self.server_state, client_ids, sub)
+                            if flight_recorder.enabled():
+                                rm = jax.block_until_ready(rm)
                 freq = int(getattr(self.args, "frequency_of_the_test", 5) or 5)
                 if round_idx % freq == 0 or round_idx == comm_rounds - 1:
                     out = self.eval_step(self.global_vars, test_batches)

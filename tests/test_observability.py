@@ -395,3 +395,116 @@ def test_trainer_jax_profile_capture(tmp_path):
     with _maybe_jax_profile(_Args(), state):
         pass
     assert state["captured"] == 1
+
+
+# -- metrics plane under concurrency (PR 9 satellite) ------------------------
+
+def test_histogram_concurrent_observe_consistency():
+    """A scrape racing multi-threaded observe() must stay internally
+    consistent: bucket counts cumulative and monotone, and the implicit
+    +Inf bucket exactly equal to the snapshot's count."""
+    r = metrics_mod.MetricsRegistry()
+    h = r.histogram("race_seconds", "x", buckets=(0.1, 1.0, 10.0))
+    stop = threading.Event()
+    errors = []
+
+    def writer(seed):
+        vals = (0.05, 0.5, 5.0, 50.0)
+        i = seed
+        while not stop.is_set():
+            h.observe(vals[i % 4])
+            i += 1
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                pairs, _s, count = h.labels().snapshot()
+                cums = [c for _b, c in pairs]
+                assert cums == sorted(cums), f"non-monotone: {cums}"
+                assert pairs[-1][0] == float("inf")
+                assert pairs[-1][1] == count, \
+                    f"+Inf {pairs[-1][1]} != count {count}"
+                # exposition renders from one locked snapshot too
+                text = r.render_prometheus()
+                m = re.search(
+                    r'race_seconds_bucket\{le="\+Inf"\} (\d+)', text)
+                c = re.search(r"race_seconds_count (\d+)", text)
+                assert m and c and m.group(1) == c.group(1)
+            except AssertionError as e:  # noqa: PERF203
+                errors.append(e)
+                return
+    threads = [threading.Thread(target=writer, args=(k,)) for k in range(4)]
+    threads += [threading.Thread(target=scraper) for _ in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errors, errors[0]
+    # quiescent cross-check: totals add up after the race
+    pairs, _s, count = h.labels().snapshot()
+    assert pairs[-1][1] == count > 0
+
+
+def test_counter_concurrent_increments_exact():
+    r = metrics_mod.MetricsRegistry()
+    c = r.counter("c_race_total", "x", labels=("w",))
+    N, T = 2000, 8
+
+    def worker(k):
+        child = c.labels(w=str(k % 2))
+        for _ in range(N):
+            child.inc()
+    threads = [threading.Thread(target=worker, args=(k,)) for k in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    total = sum(c.labels(w=str(i)).value for i in (0, 1))
+    assert total == N * T
+
+
+def test_registry_reset_mid_scrape_safe():
+    """reset() racing scrapes and writers must never raise or wedge —
+    cached handles keep working, fresh get-or-create re-registers."""
+    r = metrics_mod.MetricsRegistry()
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        while not stop.is_set():
+            try:
+                r.counter("reset_race_total").inc()
+                r.histogram("reset_race_seconds",
+                            buckets=(1.0,)).observe(0.5)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                text = r.render_prometheus()
+                assert text == "" or text.endswith("\n")
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    def resetter():
+        while not stop.is_set():
+            r.reset()
+            time.sleep(0.005)
+    threads = ([threading.Thread(target=writer) for _ in range(3)]
+               + [threading.Thread(target=scraper) for _ in range(2)]
+               + [threading.Thread(target=resetter)])
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errors, errors[0]
+    # the registry still works after the churn
+    r.counter("reset_race_total").inc()
+    assert "reset_race_total" in r.render_prometheus()
