@@ -16,6 +16,12 @@ Rule families (docs/STATIC_ANALYSIS.md has the full catalog):
   PERF001 donation audit, PERF002 bf16→f32 widening, PERF003
   padding-waste in the size-bucket policy, PERF004 layout-changing
   transposes in scan bodies, PERF005 host callbacks inside jit
+* mesh tier (``--mesh``, ``analysis.mesh``): lowers registered
+  entrypoints SPMD-partitioned per declared mesh variant (forced
+  8-device CPU host platform) and lints the compiled HLO — SHARD002
+  boundary resharding, SHARD003 idle-axis replication, SHARD004
+  collective budget ratchet, SHARD005 cross-host loop all-gathers,
+  SHARD006 donation lost to sharding mismatch
 
 Entry points: ``run_lint`` (library), ``run_cli`` (the `fedml lint`
 command body; exit codes 0 = clean, 1 = new findings, 2 = internal error).
@@ -55,6 +61,7 @@ def run_cli(root: Optional[str] = None,
             rule_ids: Optional[Sequence[str]] = None,
             whole_program: bool = False,
             perf: bool = False,
+            mesh: bool = False,
             perf_registry=None,
             graph: Optional[str] = None,
             echo=print) -> int:
@@ -94,16 +101,17 @@ def run_cli(root: Optional[str] = None,
                  "--rules — the baseline must come from a full scan")
             return EXIT_INTERNAL_ERROR
         if update_baseline:
-            # the baseline file is SHARED by the per-file, whole-program
-            # and perf CI gates; rewriting it from a partial scan would
-            # drop every baselined entry of the skipped tiers, so always
-            # take the fullest scan when rewriting
+            # the baseline file is SHARED by the per-file, whole-program,
+            # perf and mesh CI gates; rewriting it from a partial scan
+            # would drop every baselined entry of the skipped tiers, so
+            # always take the fullest scan when rewriting
             whole_program = True
             perf = True
+            mesh = True
         root_p = Path(root) if root else default_root()
         result = run_lint(root_p, paths=paths or None, rule_ids=rule_ids,
                           whole_program=whole_program, perf=perf,
-                          perf_registry=perf_registry)
+                          mesh=mesh, perf_registry=perf_registry)
         baseline_p = (Path(baseline) if baseline
                       else root_p / DEFAULT_BASELINE_NAME)
         if update_baseline:

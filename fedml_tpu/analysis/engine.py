@@ -133,7 +133,9 @@ def run_lint(root: Optional[Path] = None,
              rule_ids: Optional[Sequence[str]] = None,
              whole_program: bool = False,
              perf: bool = False,
+             mesh: bool = False,
              perf_registry=None) -> LintResult:
+    from .mesh.rules import mesh_rule_ids
     from .perf.rules import perf_rule_ids
     from .rules import make_program_rules, make_rules
 
@@ -143,20 +145,25 @@ def run_lint(root: Optional[Path] = None,
     all_rules = make_rules()
     all_prog_rules = make_program_rules()
     prog_ids = {r.id.upper() for r in all_prog_rules}
-    # PERF000 is the pass's own trace-failure finding, suppressible and
-    # baselineable like any rule id
+    # PERF000/SHARD000 are the passes' own build-failure findings,
+    # suppressible and baselineable like any rule id
     perf_ids = {r.upper() for r in perf_rule_ids()} | {"PERF000"}
+    mesh_ids = {r.upper() for r in mesh_rule_ids()} | {"SHARD000"}
     if wanted is not None:
-        known = {r.id.upper() for r in all_rules} | prog_ids | perf_ids
+        known = ({r.id.upper() for r in all_rules} | prog_ids | perf_ids
+                 | mesh_ids)
         unknown = sorted(wanted - known)
         if unknown:
             raise ValueError(f"unknown rule id(s) {unknown}; "
                              f"known: {sorted(known)}")
-        # asking for a whole-program/perf rule by id implies that pass;
-        # conversely --perf with a rule filter that selects NO perf rule
-        # would trace every entrypoint for nothing — skip the pass
+        # asking for a whole-program/perf/mesh rule by id implies that
+        # pass; conversely --perf/--mesh with a rule filter that selects
+        # NO rule of that tier would trace every entrypoint for nothing
+        # — skip the pass.  (SHARD001 is a whole-program rule; only
+        # SHARD000/SHARD002-006 enable the mesh pass.)
         whole_program = whole_program or bool(wanted & prog_ids)
         perf = bool(wanted & perf_ids)
+        mesh = bool(wanted & mesh_ids)
     rules = [r for r in all_rules
              if wanted is None or r.id.upper() in wanted]
     prog_rules = ([r for r in all_prog_rules
@@ -234,17 +241,38 @@ def run_lint(root: Optional[Path] = None,
                     prog_findings = [f for f in prog_findings
                                      if f.path in subset]
                 _emit_project(prog_findings)
+    build_cache = None
+    if perf or mesh:
+        # one shared factory-build cache: a run mixing the perf and mesh
+        # tiers (e.g. --rules PERF001,SHARD004) builds each registered
+        # entrypoint once instead of once per tier
+        from .perf import EntrypointBuildCache
+
+        build_cache = EntrypointBuildCache()
     if perf:
         from .perf import run_perf_pass
 
         perf_findings, perf_notes = run_perf_pass(
-            root, registry=perf_registry, rule_ids=rule_ids)
+            root, registry=perf_registry, rule_ids=rule_ids,
+            cache=build_cache)
         if paths:
             subset_paths = {c.path for c in contexts}
             perf_findings = [f for f in perf_findings
                              if f.path in subset_paths]
         _emit_project(perf_findings)
         notes.extend(perf_notes)
+    if mesh:
+        from .mesh import run_mesh_pass
+
+        mesh_findings, mesh_notes = run_mesh_pass(
+            root, registry=perf_registry, rule_ids=rule_ids,
+            cache=build_cache)
+        if paths:
+            subset_paths = {c.path for c in contexts}
+            mesh_findings = [f for f in mesh_findings
+                             if f.path in subset_paths]
+        _emit_project(mesh_findings)
+        notes.extend(mesh_notes)
     findings.sort(key=Finding.sort_key)
     return LintResult(findings, n_files, suppressed,
                       time.monotonic() - t0, notes)

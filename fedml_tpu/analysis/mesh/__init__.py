@@ -1,0 +1,138 @@
+"""Mesh-lint tier: SPMD collective-flow analysis over mesh-lowered
+entrypoints.
+
+Fourth analysis tier next to the AST plane, the whole-program plane and
+the single-device perf tier: ``fedml lint --mesh`` resolves every
+``register_jit_entrypoint`` entry that declares mesh variants
+(``MeshVariant``: mesh shape + axis names + in/out shardings), lowers it
+SPMD-partitioned on CPU under a forced 8-device host platform, and runs
+the SHARD002-SHARD006 rules over the compiled (partitioned) HLO — the
+only artifact that carries the collectives XLA's partitioner inserted.
+Findings share the noqa fingerprints, the ``.fedml-lint-baseline.json``
+ratchet, the text/JSON output and the exit codes of the other tiers.
+
+jax imports stay inside the pass; when no backend is initialized yet the
+pass pins ``JAX_PLATFORMS=cpu`` and forces the 8-device host platform so
+``fedml lint --mesh`` works from a bare shell.  When a backend is
+already live with fewer devices than a variant's mesh needs, that
+variant becomes a SHARD000 error (coverage must not silently shrink).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..findings import SEV_ERROR, Finding
+from .rules import make_mesh_rules, mesh_rule_ids
+from .variants import INHERIT, OK_IN, OK_OUT, MeshVariant
+
+__all__ = [
+    "MeshVariant", "INHERIT", "OK_IN", "OK_OUT", "run_mesh_pass",
+    "make_mesh_rules", "mesh_rule_ids", "collective_report",
+]
+
+#: the forced host-platform device count every mesh variant lowers under
+FORCED_DEVICE_COUNT = 8
+
+_FORCE_FLAG = "--xla_force_host_platform_device_count"
+
+
+def _pin_mesh_cpu_platform(n_devices: int = FORCED_DEVICE_COUNT) -> None:
+    """Like the perf tier's CPU pin, plus the forced host device count —
+    both only help when no backend is initialized yet (XLA reads
+    XLA_FLAGS at backend init)."""
+    backend_live = False
+    if "jax" in sys.modules:
+        try:
+            from jax._src import xla_bridge
+
+            backend_live = xla_bridge.backends_are_initialized()
+        except Exception:
+            backend_live = True
+    if backend_live:
+        return
+    if not os.environ.get("JAX_PLATFORMS"):
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        if "jax" in sys.modules:
+            try:
+                sys.modules["jax"].config.update("jax_platforms", "cpu")
+            except Exception:
+                pass
+    flags = os.environ.get("XLA_FLAGS", "")
+    if _FORCE_FLAG not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} {_FORCE_FLAG}={n_devices}").strip()
+
+
+def run_mesh_pass(root: Path,
+                  registry=None,
+                  rule_ids: Optional[Sequence[str]] = None,
+                  cache=None) -> Tuple[List[Finding], List[str]]:
+    """Lower every registered mesh variant SPMD-partitioned and run the
+    requested SHARD rules.  Returns (findings, notes).  A build/lower/
+    compile failure becomes a SHARD000 *error* finding — a broken mesh
+    registration must fail the gate, not silently shrink coverage."""
+    _pin_mesh_cpu_platform()
+    from ..perf import _rel_or_default
+    from ..perf.registry import EntrypointBuildCache, load_default_entrypoints
+    from .lowering import MeshLoweredEntrypoint
+
+    reg = registry if registry is not None else load_default_entrypoints()
+    wanted = ({r.strip().upper() for r in rule_ids} if rule_ids else None)
+    rules = [r for r in make_mesh_rules()
+             if wanted is None or r.id.upper() in wanted]
+    if cache is None:
+        cache = EntrypointBuildCache()
+    findings: List[Finding] = []
+    notes: List[str] = []
+    n_variants = 0
+    for spec in reg.entries():
+        variants = spec.mesh_variants or ()
+        if not variants:
+            continue
+        path = _rel_or_default(spec, root)
+        spec.path = path
+        for variant in variants:
+            n_variants += 1
+            try:
+                lowered = MeshLoweredEntrypoint(spec, variant, root,
+                                                cache=cache)
+            except Exception as exc:  # noqa: BLE001 — becomes a finding
+                msg = (f"{exc.__class__.__name__}: "
+                       f"{str(exc).splitlines()[0][:160]}"
+                       if str(exc) else exc.__class__.__name__)
+                findings.append(Finding(
+                    "SHARD000", SEV_ERROR, path,
+                    int(spec.meta.get("src_line", 1) or 1), 0,
+                    f"mesh variant '{variant.budget_key(spec.name)}' "
+                    f"failed to lower/compile — {msg}"))
+                notes.append(f"mesh pass: variant "
+                             f"'{variant.budget_key(spec.name)}' failed "
+                             f"({msg})")
+                continue
+            for rule in rules:
+                findings.extend(rule.check_lowered(lowered))
+    if n_variants == 0:
+        notes.append("mesh pass: no registered mesh variants")
+    return findings, notes
+
+
+def collective_report(root, registry=None,
+                      names: Optional[Sequence[str]] = None
+                      ) -> Dict[str, Dict[str, Any]]:
+    """Per-entrypoint collective count/bytes per mesh variant —
+    ``{entry: {variant: collective_stats}}`` — for the ``fedml perf
+    programs`` collectives columns.  Same compile, same parser, same
+    totals as the SHARD004 budget ratchet."""
+    from .budgets import collect_registry_stats
+
+    stats = collect_registry_stats(root, registry=registry,
+                                   names=set(names) if names else None)
+    out: Dict[str, Dict[str, Any]] = {}
+    for key, s in stats.items():
+        entry, _, variant = key.rpartition("@")
+        out.setdefault(entry, {})[variant] = s
+    return out

@@ -1,0 +1,91 @@
+"""Per-entrypoint collective budgets (SHARD004's committed ratchet).
+
+``benchmarks/collective_budgets.json`` commits, per
+``<entrypoint>@<variant>``, the count and byte volume of the budgeted
+collective ops (``utils.hlo_costs.BUDGET_OPS``) in the CPU-partitioned
+module.  The mesh pass compares what it just compiled against the file:
+over budget → finding; missing entry → finding telling the author to
+commit one.  Regenerate after a DELIBERATE change with::
+
+    python -m fedml_tpu.analysis.mesh.budgets
+
+which rewrites the file from the live registry (the diff is the review
+artifact — a collective-structure change can never land silently).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+BUDGET_FILE = "benchmarks/collective_budgets.json"
+
+_DOC = ("per-entrypoint collective budget over the CPU-partitioned "
+        "module (8 forced host devices): count + byte volume of "
+        "all-reduce/all-gather/reduce-scatter/all-to-all per "
+        "<entrypoint>@<mesh variant>.  SHARD004 ratchets against this "
+        "file; regenerate deliberately with "
+        "`python -m fedml_tpu.analysis.mesh.budgets`.")
+
+
+def budget_path(root) -> Path:
+    return Path(root) / BUDGET_FILE
+
+
+def load_budgets(root) -> Optional[Dict[str, Any]]:
+    """The committed budget entries, or None when the file is missing."""
+    p = budget_path(root)
+    if not p.is_file():
+        return None
+    data = json.loads(p.read_text(encoding="utf-8"))
+    return data.get("entries", {})
+
+
+def write_budgets(root, stats_by_key: Dict[str, Dict[str, Any]]) -> Path:
+    p = budget_path(root)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    payload = {"_doc": _DOC,
+               "entries": {k: stats_by_key[k]
+                           for k in sorted(stats_by_key)}}
+    p.write_text(json.dumps(payload, indent=2, sort_keys=False) + "\n",
+                 encoding="utf-8")
+    return p
+
+
+def collect_registry_stats(root, registry=None,
+                           names=None) -> Dict[str, Dict[str, Any]]:
+    """Compile every registered mesh variant and return
+    ``{budget_key: collective_stats}`` — the generator behind both the
+    committed budget file and the ``fedml perf programs`` collectives
+    columns."""
+    from ..perf.registry import EntrypointBuildCache, load_default_entrypoints
+    from . import _pin_mesh_cpu_platform
+    from .lowering import MeshLoweredEntrypoint
+
+    _pin_mesh_cpu_platform(8)
+    reg = registry if registry is not None else load_default_entrypoints()
+    cache = EntrypointBuildCache()
+    out: Dict[str, Dict[str, Any]] = {}
+    for spec in reg.entries():
+        if names is not None and spec.name not in names:
+            continue
+        for variant in spec.mesh_variants or ():
+            lowered = MeshLoweredEntrypoint(spec, variant, Path(root),
+                                            cache=cache)
+            out[variant.budget_key(spec.name)] = lowered.collective_stats()
+    return out
+
+
+def main() -> int:
+    from ..engine import default_root
+
+    root = default_root()
+    stats = collect_registry_stats(root)
+    p = write_budgets(root, stats)
+    print(f"wrote {p} ({len(stats)} budget entries)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

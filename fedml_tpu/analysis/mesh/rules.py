@@ -1,0 +1,317 @@
+"""SHARD002-SHARD006 — collective-flow lints over mesh-lowered
+entrypoints (docs/STATIC_ANALYSIS.md "Mesh tier" has the catalog).
+
+Each rule reads a ``MeshLoweredEntrypoint`` (partitioned HLO + resolved
+arg/out shardings + lower-time warnings) and yields findings whose
+messages are LINE-FREE and shape-keyed, like the PERF family, so the
+shared fingerprint/baseline machinery stays stable under source churn.
+Findings anchor at the registration call site — a
+``# fedml: noqa[SHARD00x]`` next to ``register_jit_entrypoint``
+suppresses, and the declared-design escape hatches (``replicate_ok`` /
+``reshard_ok`` on the variant, with a ``note``) are the preferred,
+reviewable alternative.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Tuple
+
+from ..findings import SEV_ERROR, SEV_WARNING, Finding
+from .lowering import MeshLoweredEntrypoint
+from .variants import OK_IN, OK_OUT
+
+_MESH_REGISTRY: List[type] = []
+
+
+class MeshRule:
+    """Base: one rule instance sees every (entrypoint, variant) once."""
+
+    id: str = ""
+    severity: str = SEV_WARNING
+    title: str = ""
+
+    def check_lowered(self, lowered: MeshLoweredEntrypoint
+                      ) -> Iterable[Finding]:
+        return ()
+
+
+def register_mesh(cls):
+    _MESH_REGISTRY.append(cls)
+    return cls
+
+
+def make_mesh_rules() -> List[MeshRule]:
+    return [cls() for cls in _MESH_REGISTRY]
+
+
+def mesh_rule_ids() -> List[str]:
+    return [cls.id for cls in _MESH_REGISTRY]
+
+
+def _site(lowered: MeshLoweredEntrypoint) -> Tuple[str, int]:
+    spec = lowered.spec
+    return (spec.path or "fedml_tpu/analysis/perf/entrypoints.py",
+            int(spec.meta.get("src_line", 1) or 1))
+
+
+def _key(lowered: MeshLoweredEntrypoint) -> str:
+    return lowered.variant.budget_key(lowered.spec.name)
+
+
+def _fmt_bytes(n: int) -> str:
+    if n >= 1 << 20:
+        return f"{n / (1 << 20):.1f}MiB"
+    if n >= 1 << 10:
+        return f"{n / (1 << 10):.1f}KiB"
+    return f"{n}B"
+
+
+def _param_argnums(lowered: MeshLoweredEntrypoint,
+                   param_indices) -> set:
+    """Map partitioned-HLO parameter indices back to top-level argnums
+    via flattened-leaf offsets.  When XLA eliminated unused args the
+    counts disagree — return every argnum (conservative: declared
+    ``reshard_ok`` argnums still exempt, attribution text degrades)."""
+    leaves = lowered.arg_leaves
+    entry = lowered.module.computations.get(lowered.module.entry, {})
+    n_params = sum(1 for i in entry.values() if i.op == "parameter")
+    if n_params != len(leaves):
+        return {leaf.argnum for leaf in leaves}
+    return {leaves[i].argnum for i in param_indices
+            if 0 <= i < len(leaves)}
+
+
+@register_mesh
+class BoundaryReshardRule(MeshRule):
+    """SHARD002 — a collective rooted at a program input (or producing
+    the ROOT value) through pass-through ops only: the partitioner is
+    resharding a boundary value, so the declared in/out sharding
+    disagrees with what the program actually consumes/produces.  Either
+    fix the declared spec (the caller pays this collective EVERY call)
+    or declare the reshard deliberate via ``reshard_ok`` + note."""
+
+    id = "SHARD002"
+    severity = SEV_WARNING
+    title = "boundary resharding not implied by declared shardings"
+
+    #: only data-MOVEMENT collectives are reshards: an all-reduce or
+    #: reduce-scatter at the boundary is the program's own reduction
+    #: (in-sharded → out-replicated implies combining, e.g. the weighted
+    #: mean over a sharded client axis), never a layout fixup
+    RESHARD_OPS = frozenset({"all-gather", "all-to-all",
+                             "collective-permute", "collective-broadcast"})
+
+    def check_lowered(self, lowered):
+        v = lowered.variant
+        path, line = _site(lowered)
+        ok_argnums = {a for a in v.reshard_ok if isinstance(a, int)}
+        ok_in = OK_IN in v.reshard_ok
+        ok_out = OK_OUT in v.reshard_ok
+        for c in lowered.collectives():
+            if c.op not in self.RESHARD_OPS:
+                continue
+            if c.roots_param and not ok_in:
+                argnums = _param_argnums(lowered, c.param_indices)
+                if argnums and argnums <= ok_argnums:
+                    continue
+                yield Finding(
+                    self.id, self.severity, path, line, 0,
+                    f"[{_key(lowered)}] {c.op} ({_fmt_bytes(c.nbytes)}) "
+                    f"reshards program input arg"
+                    f"{sorted(argnums) if argnums else '?'} right at the "
+                    f"boundary — the declared in_sharding disagrees with "
+                    f"what the program consumes; fix the spec or declare "
+                    f"reshard_ok with a note")
+            elif c.feeds_root and not c.roots_param and not ok_out:
+                yield Finding(
+                    self.id, self.severity, path, line, 0,
+                    f"[{_key(lowered)}] {c.op} ({_fmt_bytes(c.nbytes)}) "
+                    f"produces the program output — the declared "
+                    f"out_sharding forces a reshard of the computed "
+                    f"value; fix the out spec or declare reshard_ok "
+                    f"with a note")
+
+
+@register_mesh
+class IdleAxisReplicationRule(MeshRule):
+    """SHARD003 — a large input held fully replicated while a mesh axis
+    that could divide it sits idle: every device stores the whole array
+    (client-axis state, eval batches...).  Shard it, or declare the
+    replication deliberate via ``replicate_ok`` + note."""
+
+    id = "SHARD003"
+    severity = SEV_WARNING
+    title = "large array replicated while a dividing mesh axis is idle"
+
+    def check_lowered(self, lowered):
+        v = lowered.variant
+        path, line = _site(lowered)
+        axes = {a: int(s) for a, s in v.mesh_axes.items() if int(s) > 1}
+        if not axes:
+            return
+        for leaf in lowered.arg_leaves:
+            if leaf.argnum in v.replicate_ok:
+                continue
+            if leaf.nbytes < v.min_bytes:
+                continue
+            if not getattr(leaf.sharding, "is_fully_replicated", False):
+                continue
+            dividing = sorted(
+                a for a, s in axes.items()
+                if any(d >= s and d % s == 0 for d in leaf.shape))
+            if not dividing:
+                continue
+            where = f"arg{leaf.argnum}" + (f":{leaf.path}" if leaf.path
+                                           else "")
+            yield Finding(
+                self.id, self.severity, path, line, 0,
+                f"[{_key(lowered)}] {where} "
+                f"{leaf.dtype}[{','.join(map(str, leaf.shape))}] "
+                f"({_fmt_bytes(leaf.nbytes)}) is fully replicated while "
+                f"mesh axis {'/'.join(dividing)} could divide it — every "
+                f"device stores the whole array; shard it or declare "
+                f"replicate_ok with a note")
+
+
+@register_mesh
+class CollectiveBudgetRule(MeshRule):
+    """SHARD004 — the compiled module's collective count/bytes versus
+    the committed ``benchmarks/collective_budgets.json``.  Over budget
+    or missing entry → finding; regenerate deliberately with
+    ``python -m fedml_tpu.analysis.mesh.budgets`` (the diff is the
+    review artifact)."""
+
+    id = "SHARD004"
+    severity = SEV_WARNING
+    title = "per-entrypoint collective budget ratchet"
+
+    def check_lowered(self, lowered):
+        from .budgets import BUDGET_FILE, load_budgets
+
+        path, line = _site(lowered)
+        key = _key(lowered)
+        actual = lowered.collective_stats()
+        entries = load_budgets(lowered.root)
+        budget = (entries or {}).get(key)
+        if budget is None:
+            yield Finding(
+                self.id, self.severity, path, line, 0,
+                f"[{key}] no committed collective budget (actual: "
+                f"{actual['total_ops']} ops, "
+                f"{_fmt_bytes(actual['total_bytes'])}) — run "
+                f"`python -m fedml_tpu.analysis.mesh.budgets` and commit "
+                f"{BUDGET_FILE}")
+            return
+        over_ops = actual["total_ops"] > int(budget.get("total_ops", 0))
+        over_bytes = (actual["total_bytes"]
+                      > int(budget.get("total_bytes", 0)))
+        if over_ops or over_bytes:
+            yield Finding(
+                self.id, self.severity, path, line, 0,
+                f"[{key}] collectives exceed the committed budget: "
+                f"{actual['total_ops']} ops / "
+                f"{_fmt_bytes(actual['total_bytes'])} vs budgeted "
+                f"{budget.get('total_ops', 0)} ops / "
+                f"{_fmt_bytes(int(budget.get('total_bytes', 0)))} — fix "
+                f"the sharding regression or regenerate {BUDGET_FILE} "
+                f"deliberately")
+
+
+@register_mesh
+class CrossHostLoopGatherRule(MeshRule):
+    """SHARD005 — replica groups classified cross-host vs intra-host
+    under the variant's ``devices_per_host`` model; a LARGE cross-host
+    all-gather inside a round loop (while-body computation) is an error:
+    it moves the gathered payload over DCN every iteration, the exact
+    traffic the sharded design exists to avoid."""
+
+    id = "SHARD005"
+    severity = SEV_ERROR
+    title = "large cross-host all-gather inside a round loop"
+
+    def check_lowered(self, lowered):
+        v = lowered.variant
+        path, line = _site(lowered)
+        for c in lowered.collectives():
+            if c.op != "all-gather" or not c.in_loop:
+                continue
+            if c.nbytes < v.min_bytes:
+                continue
+            hosts = c.hosts_spanned(v.devices_per_host)
+            if hosts <= 1:
+                continue
+            yield Finding(
+                self.id, self.severity, path, line, 0,
+                f"[{_key(lowered)}] cross-host all-gather "
+                f"({_fmt_bytes(c.nbytes)}, {hosts} hosts of "
+                f"{v.devices_per_host} devices, group size "
+                f"{c.group_size}) inside the round loop — gathered "
+                f"state crosses DCN every iteration; keep it sharded "
+                f"or move the gather out of the loop")
+
+
+@register_mesh
+class DonationShardingMismatchRule(MeshRule):
+    """SHARD006 — a donated input whose output sharding differs: the
+    mesh lowering drops the alias (XLA cannot alias buffers with
+    different per-device shapes), forcing exactly the copy donation was
+    meant to avoid.  The single-device perf trace (PERF001) cannot see
+    this — the drop only exists under SPMD lowering."""
+
+    id = "SHARD006"
+    severity = SEV_WARNING
+    title = "donation lost to sharding mismatch"
+
+    def check_lowered(self, lowered):
+        dropped = lowered.dropped_donations()
+        if not dropped:
+            return
+        path, line = _site(lowered)
+        out_sh = lowered.out_shardings
+        for leaf in lowered.arg_leaves:
+            if not leaf.donated:
+                continue
+            try:
+                same = leaf.sharding.is_equivalent_to(
+                    out_sh, len(leaf.shape))
+            except Exception:
+                same = leaf.sharding == out_sh
+            if same:
+                # dropped for a non-sharding reason (dtype/shape) —
+                # PERF001 owns that on the single-device trace
+                continue
+            shard_shape = tuple(leaf.sharding.shard_shape(leaf.shape))
+            sdtype = _short_dtype(leaf.dtype)
+            if not any(_matches(d, sdtype, shard_shape) for d in dropped):
+                continue
+            where = f"arg{leaf.argnum}" + (f":{leaf.path}" if leaf.path
+                                           else "")
+            yield Finding(
+                self.id, self.severity, path, line, 0,
+                f"[{_key(lowered)}] donated {where} "
+                f"{leaf.dtype}[{','.join(map(str, leaf.shape))}] lost "
+                f"its donation under SPMD lowering — in-sharding "
+                f"{_spec_str(leaf.sharding)} vs out-sharding "
+                f"{_spec_str(out_sh)} have different per-device "
+                f"layouts, so XLA keeps the copy; align the declared "
+                f"shardings (or stop donating)")
+
+
+_SHORT_DTYPES = {"float32": "float32", "bfloat16": "bfloat16",
+                 "float16": "float16"}
+
+
+def _short_dtype(dtype: str) -> str:
+    return _SHORT_DTYPES.get(dtype, dtype)
+
+
+def _matches(dropped_repr: str, dtype: str,
+             shard_shape: Tuple[int, ...]) -> bool:
+    """The warning carries per-DEVICE avals, e.g. ``float32[8,16]``."""
+    want = f"{dtype}[{','.join(map(str, shard_shape))}]"
+    return want in dropped_repr.replace(" ", "")
+
+
+def _spec_str(sharding) -> str:
+    spec = getattr(sharding, "spec", None)
+    return str(spec) if spec is not None else str(sharding)

@@ -1,0 +1,93 @@
+"""Mesh-variant declarations for registered jit entrypoints.
+
+A :class:`MeshVariant` tells the mesh-lint tier how to lower one
+registered hot program SPMD-partitioned: which named mesh to build over
+the (CPU-forced) device grid, how every positional argument is sharded
+going in, what the outputs promise coming out, and which deviations are
+*declared design* rather than findings.  Declarations are plain data —
+no jax at import time — and resolve to real ``NamedSharding``s only when
+the pass runs (``lowering.MeshLoweredEntrypoint``).
+
+Per-argument ``in_specs`` entry forms (one entry per positional arg):
+
+* ``None`` — fully replicated (``P()``) on every leaf
+* a tuple of axis names / ``None`` — ``P(*entry)`` on every leaf
+  (homogeneous args: arrays or stacks whose leading dims agree)
+* a strategy string (``"dp" | "fsdp" | "tp" | "tp_fsdp"``) — resolved
+  through ``parallel.sharding.make_param_shardings`` (parameter trees)
+* a callable ``(mesh, arg_sds_tree) -> sharding pytree`` — full control
+
+``out_specs`` takes ``None`` (replicated), a spec tuple, or a callable
+``(mesh) -> out_shardings`` handed to ``jax.jit`` verbatim.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+#: sentinel: the variant donates whatever the EntrypointSpec declares
+INHERIT = "inherit"
+
+#: ``reshard_ok`` marker exempting every input-rooted boundary collective
+OK_IN = "in"
+#: ``reshard_ok`` marker exempting every ROOT-feeding boundary collective
+OK_OUT = "out"
+
+
+@dataclasses.dataclass
+class MeshVariant:
+    """One SPMD lowering of an entrypoint (see module doc for the spec
+    entry forms).  ``name`` scopes the budget key ``<entry>@<name>`` in
+    ``benchmarks/collective_budgets.json``."""
+
+    name: str
+    #: axis name → size, in mesh order (e.g. {"clients": 8}); the pass
+    #: builds the mesh over the first prod(sizes) CPU devices
+    mesh_axes: Dict[str, int]
+    in_specs: Optional[Tuple[Any, ...]] = None
+    out_specs: Any = None
+    #: argnums the mesh lowering donates; INHERIT → the spec's set
+    donate_argnums: Any = INHERIT
+    #: host-span model for SHARD005: device i lives on host i // this
+    #: (8 forced CPU devices with 4/host models a 2-host DCN slice)
+    devices_per_host: int = 4
+    #: argnums whose FULL replication is the declared design (SHARD003
+    #: exemption) — pair with ``note`` saying why
+    replicate_ok: Tuple[int, ...] = ()
+    #: boundary-resharding exemptions (SHARD002): argnum ints, OK_IN,
+    #: or OK_OUT — again a declared-design contract, not a suppression
+    reshard_ok: Tuple[Any, ...] = ()
+    #: "large array" floor (bytes) for SHARD003/SHARD005 — the mini
+    #: registry programs are tiny, so variants tune this to their scale
+    min_bytes: int = 1 << 16
+    #: justification recorded next to replicate_ok / reshard_ok
+    note: str = ""
+    #: optional build override: () -> (fn, args) — used when the mesh
+    #: lowering needs a DIFFERENT program instance than the single-device
+    #: perf trace (e.g. Parrot's mesh backend bakes sharding constraints
+    #: into the jit at construction time)
+    fn_factory: Optional[Callable[[], Any]] = None
+
+    def __post_init__(self) -> None:
+        if not self.mesh_axes:
+            raise ValueError(f"mesh variant {self.name!r}: empty mesh_axes")
+        for ax, size in self.mesh_axes.items():
+            if int(size) < 1:
+                raise ValueError(
+                    f"mesh variant {self.name!r}: axis {ax!r} size {size} "
+                    f"must be a positive int (no -1 here — the lint mesh "
+                    f"is explicit so budgets stay comparable)")
+
+    @property
+    def n_devices(self) -> int:
+        n = 1
+        for size in self.mesh_axes.values():
+            n *= int(size)
+        return n
+
+    def budget_key(self, entry_name: str) -> str:
+        return f"{entry_name}@{self.name}"
+
+    def host_of(self, device_id: int) -> int:
+        return int(device_id) // max(int(self.devices_per_host), 1)

@@ -24,6 +24,7 @@ from typing import List, Optional, Sequence, Tuple
 
 from ..findings import SEV_ERROR, Finding
 from .registry import (
+    EntrypointBuildCache,
     EntrypointRegistry,
     EntrypointSpec,
     default_registry,
@@ -33,9 +34,10 @@ from .registry import (
 from .rules import make_perf_rules, perf_rule_ids
 
 __all__ = [
-    "EntrypointRegistry", "EntrypointSpec", "register_jit_entrypoint",
-    "default_registry", "load_default_entrypoints", "run_perf_pass",
-    "make_perf_rules", "perf_rule_ids",
+    "EntrypointRegistry", "EntrypointSpec", "EntrypointBuildCache",
+    "register_jit_entrypoint", "default_registry",
+    "load_default_entrypoints", "run_perf_pass", "make_perf_rules",
+    "perf_rule_ids",
 ]
 
 
@@ -59,8 +61,8 @@ def _pin_cpu_platform() -> None:
 
 def run_perf_pass(root: Path,
                   registry: Optional[EntrypointRegistry] = None,
-                  rule_ids: Optional[Sequence[str]] = None
-                  ) -> Tuple[List[Finding], List[str]]:
+                  rule_ids: Optional[Sequence[str]] = None,
+                  cache=None) -> Tuple[List[Finding], List[str]]:
     """Trace every registered entrypoint and run the requested PERF rules.
 
     Returns (findings, notes).  A factory/trace failure becomes a
@@ -82,7 +84,8 @@ def run_perf_pass(root: Path,
     for spec in reg.entries():
         path = _rel_or_default(spec, root)
         try:
-            traced = TracedEntrypoint(spec, root)
+            prebuilt = cache.build(spec) if cache is not None else None
+            traced = TracedEntrypoint(spec, root, prebuilt=prebuilt)
         except Exception as exc:  # noqa: BLE001 — converted to a finding
             msg = f"{exc.__class__.__name__}: {str(exc).splitlines()[0][:160]}" \
                 if str(exc) else exc.__class__.__name__

@@ -26,6 +26,7 @@ from pathlib import Path
 
 import numpy as np
 
+from ..mesh.variants import OK_OUT, MeshVariant
 from .registry import register_jit_entrypoint
 
 #: repo root (…/fedml_tpu/analysis/perf/entrypoints.py → three up)
@@ -102,6 +103,93 @@ def _parrot_eval_step():
     return api.eval_step, (_sds(api.global_vars), _sds(batches))
 
 
+_MINI_PARROT_MESH = {}
+
+
+def _mini_parrot_api_mesh(clients_axis):
+    """Mesh-backend twin of ``_mini_parrot_api`` (same mini config, same
+    buckets) built over ``{"clients": clients_axis}``.  The mesh API bakes
+    its ``with_sharding_constraint`` layout into the jit at construction —
+    the mesh tier must lower a mesh-built instance, not reshard the
+    single-device one.  ``clients_axis=2`` divides the per-bucket cohort
+    (client-axis grid); ``clients_axis=8`` exceeds it, so the constraint
+    falls through to the intra-batch axis (batch-axis grid) — the two
+    variants cover both placements of ``_grid_sharding``."""
+    if clients_axis in _MINI_PARROT_MESH:
+        return _MINI_PARROT_MESH[clients_axis]
+    import fedml_tpu
+    from fedml_tpu.runner import FedMLRunner
+
+    args = fedml_tpu.init(fedml_tpu.Config(
+        dataset="synthetic", model="lr", backend="mesh",
+        mesh_shape={"clients": clients_axis},
+        client_num_in_total=8, client_num_per_round=4, comm_round=2,
+        epochs=1, batch_size=8, learning_rate=0.1, data_scale=0.3,
+        partition_alpha=0.3, frequency_of_the_test=1,
+        enable_tracking=False, compute_dtype="bfloat16",
+        hetero_buckets=2, hetero_bucket_cap=0.8, parrot_aot_cache=False))
+    device = fedml_tpu.device.get_device(args)
+    dataset = fedml_tpu.data.load(args)
+    bundle = fedml_tpu.model.create(args, dataset[-1])
+    _MINI_PARROT_MESH[clients_axis] = FedMLRunner(
+        args, device, dataset, bundle).runner
+    return _MINI_PARROT_MESH[clients_axis]
+
+
+def _parrot_bucketed_mesh(clients_axis):
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        api = _mini_parrot_api_mesh(clients_axis)
+        args = (_sds(api.device_data), _sds(api.global_vars),
+                _sds(api.server_state),
+                jax.ShapeDtypeStruct((2,), jnp.uint32))
+        return api.bucketed_round_step, args
+
+    return build
+
+
+def _parrot_fused_mesh(clients_axis):
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        api = _mini_parrot_api_mesh(clients_axis)
+        api.FUSED_CHUNK_ROUNDS = 4
+        fn = api._build_multi_round_step()
+        args = (_sds(api.device_data), _sds(api.global_vars),
+                _sds(api.server_state),
+                jax.ShapeDtypeStruct((2,), jnp.uint32),
+                jax.ShapeDtypeStruct((), jnp.int32))
+        return fn, args
+
+    return build
+
+
+#: SHARD003 contract for every parrot mesh variant: the dataset/index
+#: grid (argnum 0) rides replicated BY DESIGN — per-round gather indices
+#: address arbitrary clients from every device, so sharding the data
+#: arrays would trade one resident copy for a per-round resharding
+#: collective.  global_vars/server_state are the replicated global model
+#: by definition (and are tiny in the mini).
+_PARROT_MESH_NOTE = ("data grid replicated by design: per-round gathers "
+                     "address arbitrary clients from every device")
+
+
+def _parrot_mesh_variants(fn_factory_for):
+    return (
+        MeshVariant(
+            "client_axis", {"clients": 2},
+            fn_factory=fn_factory_for(2),
+            replicate_ok=(0,), note=_PARROT_MESH_NOTE),
+        MeshVariant(
+            "batch_axis", {"clients": 8},
+            fn_factory=fn_factory_for(8),
+            replicate_ok=(0,), note=_PARROT_MESH_NOTE),
+    )
+
+
 def _northstar_bucket_stats():
     """PERF003 input: the committed north-star client-size histogram run
     through the live ``bucket_plan`` policy — the audit sees exactly the
@@ -125,12 +213,14 @@ register_jit_entrypoint(
     "parrot/fused_round_scan", _parrot_fused_scan,
     donate_argnums=(1, 2),
     meta={"widen_allow": ("fedml_tpu/models/",),
-          "bucket_stats_fn": _northstar_bucket_stats})
+          "bucket_stats_fn": _northstar_bucket_stats},
+    mesh_variants=_parrot_mesh_variants(_parrot_fused_mesh))
 
 register_jit_entrypoint(
     "parrot/bucketed_round_step", _parrot_bucketed_round,
     donate_argnums=(1, 2),
-    meta={"widen_allow": ("fedml_tpu/models/",)})
+    meta={"widen_allow": ("fedml_tpu/models/",)},
+    mesh_variants=_parrot_mesh_variants(_parrot_bucketed_mesh))
 
 register_jit_entrypoint(
     # eval reuses global_vars/test batches every call — donating would be
@@ -180,8 +270,58 @@ def _agg_stacked():
         _stacked_tree(), jax.ShapeDtypeStruct((8,), jnp.float32))
 
 
-register_jit_entrypoint("agg/robust_trimmed_mean", _robust_agg)
-register_jit_entrypoint("agg/stacked_weighted_mean", _agg_stacked)
+def _agg_mesh_variant():
+    """Stacked updates shard over the client axis; the reduced global
+    comes back replicated — exactly the cross-silo server's layout when
+    the stacked buffer lives sharded across a pod slice."""
+    return MeshVariant(
+        "clients8", {"clients": 8},
+        in_specs=(("clients",), ("clients",)),
+        min_bytes=1 << 12)
+
+
+register_jit_entrypoint("agg/robust_trimmed_mean", _robust_agg,
+                        mesh_variants=(_agg_mesh_variant(),))
+register_jit_entrypoint("agg/stacked_weighted_mean", _agg_stacked,
+                        mesh_variants=(_agg_mesh_variant(),))
+
+
+# ---------------------------------------------------------------------------
+# Buffered-async fold (PR-6 aggregate_buffer device hot path)
+# ---------------------------------------------------------------------------
+def _async_fold_buffer():
+    """The buffered-async server's device-side fold: staleness-decayed
+    weights reduce the stacked update buffer and the result mixes into
+    the (donated) global at ``server_lr`` — ``agg_operator.fold_buffer``,
+    the jittable core of ``FedMLAggregator.aggregate_buffer``."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...ml.aggregator.agg_operator import fold_buffer
+
+    stacked = _stacked_tree()
+    global_tree = jax.tree_util.tree_map(
+        lambda s: jax.ShapeDtypeStruct(s.shape[1:], s.dtype), stacked)
+    return jax.jit(fold_buffer, donate_argnums=(0,)), (
+        global_tree, stacked, jax.ShapeDtypeStruct((8,), jnp.float32),
+        jax.ShapeDtypeStruct((), jnp.float32))
+
+
+register_jit_entrypoint(
+    # the global tree (argnum 0) is donated: aggregate_buffer writes the
+    # mixed result straight back as the next global, so the fold updates
+    # in place instead of holding old+new globals at peak
+    "async/aggregate_buffer", _async_fold_buffer,
+    donate_argnums=(0,),
+    mesh_variants=(MeshVariant(
+        "clients8", {"clients": 8},
+        # buffer shards over clients; global/weights/lr replicated (the
+        # global must be resident everywhere to mix and to donate into)
+        in_specs=(None, ("clients",), ("clients",), None),
+        replicate_ok=(0,),
+        note=("the global tree mixes and re-broadcasts every fold — it "
+              "is replicated state by definition"),
+        min_bytes=1 << 12),))
 
 
 # ---------------------------------------------------------------------------
@@ -262,9 +402,20 @@ register_jit_entrypoint("wire/quantize_int8", _wire_quantize)
 # model an exact apply); the fixed waste was the whole-model flat f32
 # materialization, which is gone — the per-leaf chain fuses.
 _WIRE_WIDEN_OK = ("fedml_tpu/utils/compression.py",)
-register_jit_entrypoint("wire/decode_int8_delta", _wire_decode_int8_delta,
-                        donate_argnums=(),
-                        meta={"widen_allow": _WIRE_WIDEN_OK})
+register_jit_entrypoint(
+    "wire/decode_int8_delta", _wire_decode_int8_delta,
+    donate_argnums=(),
+    meta={"widen_allow": _WIRE_WIDEN_OK},
+    # the mesh variant PINS the codec at zero collectives: decode is
+    # replicated host-adjacent work (the reference tree is the shared
+    # per-version broadcast, the payload is one silo's upload) — if a
+    # sharding change ever makes the partitioner insert a collective
+    # here, the SHARD004 budget of 0 catches it
+    mesh_variants=(MeshVariant(
+        "replicated8", {"data": 8},
+        replicate_ok=(0, 1, 2),
+        note=("codec runs replicated: reference tree is the shared "
+              "per-version broadcast, payload is one silo's upload")),))
 register_jit_entrypoint("wire/decode_topk8_delta",
                         _wire_decode_topk8_delta, donate_argnums=(),
                         meta={"widen_allow": _WIRE_WIDEN_OK})
@@ -301,5 +452,83 @@ def _llm_train_epoch():
         jax.ShapeDtypeStruct((2,), jnp.uint32))
 
 
-register_jit_entrypoint("llm/train_epoch", _llm_train_epoch,
-                        donate_argnums=(0, 1))
+_LLM_MESH = None
+
+
+def _llm_train_epoch_mesh():
+    """Mesh twin of ``_llm_train_epoch`` at the production layout
+    (trainer.train: batches ``P(None, "data")``, base params per
+    strategy, LoRA/opt replicated).  Batch dim 8 so the ``data`` axis
+    divides it on both the fsdp and tp_fsdp grids."""
+    global _LLM_MESH
+    if _LLM_MESH is not None:
+        return _LLM_MESH
+    import jax
+    import jax.numpy as jnp
+
+    import fedml_tpu
+    from ...train.llm.trainer import LLMTrainConfig, LLMTrainer
+
+    args = fedml_tpu.Config(model="transformer", dataset="shakespeare",
+                            compute_dtype="float32")
+    bundle = fedml_tpu.model.create(args, 90)
+    # strategy="fsdp" so the built epoch carries the pin-frozen-base
+    # constraint (trainer._build_epoch_fn) exactly as production does on
+    # a sharded mesh; the tp_fsdp variant lowers the SAME program under
+    # its finer grid (the trainer itself only models dp/fsdp)
+    cfg = LLMTrainConfig(seq_len=16, batch_size=8, lora_rank=2,
+                         strategy="fsdp", data_parallel=8)
+    trainer = LLMTrainer(bundle, cfg)
+    trainable = trainer._trainables()
+    opt_state = trainer.tx.init(trainable)
+    base_params = trainer.variables["params"]
+    model_state = {k: v for k, v in trainer.variables.items()
+                   if k != "params"}
+    batches = {
+        "x": jax.ShapeDtypeStruct((2, 8, 16), jnp.int32),
+        "y": jax.ShapeDtypeStruct((2, 8, 16), jnp.int32),
+        "mask": jax.ShapeDtypeStruct((2, 8, 16), jnp.float32),
+    }
+    _LLM_MESH = (trainer._train_epoch, (
+        _sds(trainable), _sds(opt_state), _sds(base_params),
+        _sds(model_state), batches,
+        jax.ShapeDtypeStruct((2,), jnp.uint32)))
+    return _LLM_MESH
+
+
+#: per-arg layout of the llm epoch under SPMD — mirrors trainer.train():
+#: (trainable, opt_state, base_params, model_state, batches, rng);
+#: LoRA/opt replicated (small by construction), base params per strategy,
+#: batch dim over `data`
+_LLM_IN_SPECS = lambda strategy: (  # noqa: E731 — spec table, not logic
+    None, None, strategy, None, (None, "data"), None)
+
+register_jit_entrypoint(
+    "llm/train_epoch", _llm_train_epoch,
+    donate_argnums=(0, 1),
+    mesh_variants=(
+        MeshVariant(
+            "fsdp", {"data": 8},
+            fn_factory=_llm_train_epoch_mesh,
+            in_specs=_LLM_IN_SPECS("fsdp"),
+            replicate_ok=(0, 1),
+            # argnum 2: the frozen base gathers ONCE at epoch entry (the
+            # pin-frozen-base constraint) and stays fsdp-sharded at rest;
+            # OK_OUT: the updated adapters/opt state gather back to the
+            # replicated contract once per epoch, outside the step loop
+            reshard_ok=(2, OK_OUT),
+            note=("LoRA adapters + optimizer state replicate (small by "
+                  "construction); frozen base gathers once per epoch at "
+                  "entry, epoch-final output gathers are per-epoch not "
+                  "per-step")),
+        MeshVariant(
+            "tp_fsdp", {"data": 4, "model": 2},
+            fn_factory=_llm_train_epoch_mesh,
+            in_specs=_LLM_IN_SPECS("tp_fsdp"),
+            replicate_ok=(0, 1),
+            reshard_ok=(2, OK_OUT),
+            note=("LoRA adapters + optimizer state replicate (small by "
+                  "construction); frozen base gathers once per epoch at "
+                  "entry, epoch-final output gathers are per-epoch not "
+                  "per-step")),
+    ))

@@ -56,6 +56,9 @@ class EntrypointSpec:
     #: bucket_stats / bucket_stats_fn (PERF003), min_elems overrides …
     meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
     tag: str = TAG_HOT
+    #: mesh-tier coverage: ``analysis.mesh.MeshVariant`` declarations
+    #: (typed loosely — the registry must import without the mesh tier)
+    mesh_variants: Tuple[Any, ...] = ()
 
     def build(self) -> Tuple[Any, Tuple[Any, ...]]:
         """Resolve the factory → (jitted_fn, abstract_args tuple)."""
@@ -99,6 +102,22 @@ class EntrypointRegistry:
         return len(self._entries)
 
 
+class EntrypointBuildCache:
+    """Memoizes ``spec.build()`` per spec name so ONE ``run_lint`` call
+    that runs both the perf and mesh tiers (``--rules`` mixing PERF and
+    SHARD ids, or ``--update-baseline``) builds each entrypoint's
+    factory once — the build (e.g. the mini-Parrot API) is the expensive
+    half; each tier still lowers its own way."""
+
+    def __init__(self) -> None:
+        self._built: Dict[str, Tuple[Any, Tuple[Any, ...]]] = {}
+
+    def build(self, spec: "EntrypointSpec") -> Tuple[Any, Tuple[Any, ...]]:
+        if spec.name not in self._built:
+            self._built[spec.name] = spec.build()
+        return self._built[spec.name]
+
+
 #: process-wide default registry — ``entrypoints.py`` populates it with the
 #: repo's real hot programs; tests build their own private registries
 _DEFAULT = EntrypointRegistry()
@@ -117,8 +136,13 @@ def register_jit_entrypoint(
         path: str = "",
         meta: Optional[Dict[str, Any]] = None,
         tag: str = TAG_HOT,
+        mesh_variants: Optional[Sequence[Any]] = None,
         registry: Optional[EntrypointRegistry] = None) -> EntrypointSpec:
-    """Register a jitted program for the perf-lint pass (see module doc)."""
+    """Register a jitted program for the perf-lint pass (see module doc).
+
+    ``mesh_variants`` (``analysis.mesh.MeshVariant`` instances) opt the
+    entry into the mesh tier: ``fedml lint --mesh`` lowers it SPMD-
+    partitioned per variant and runs the SHARD002-006 rules."""
     meta = dict(meta or {})
     if "src_file" not in meta:
         # anchor whole-entry findings at the registration call site so a
@@ -134,7 +158,8 @@ def register_jit_entrypoint(
         name=name, fn_factory=fn_factory, abstract_args=abstract_args,
         donate_argnums=(tuple(donate_argnums)
                         if donate_argnums is not None else None),
-        path=path, meta=meta, tag=tag)
+        path=path, meta=meta, tag=tag,
+        mesh_variants=tuple(mesh_variants or ()))
     return (registry if registry is not None else _DEFAULT).register(spec)
 
 

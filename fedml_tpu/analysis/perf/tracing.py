@@ -63,12 +63,16 @@ class EqnSite:
 class TracedEntrypoint:
     """Trace + lower one EntrypointSpec and expose its IR views."""
 
-    def __init__(self, spec: EntrypointSpec, root) -> None:
+    def __init__(self, spec: EntrypointSpec, root,
+                 prebuilt=None) -> None:
         import jax
 
         self.spec = spec
         self.root = root
-        fn, args = spec.build()
+        # ``prebuilt`` is an (fn, args) pair from EntrypointBuildCache —
+        # one run_lint mixing the perf and mesh tiers builds each
+        # factory once and hands the result to both
+        fn, args = prebuilt if prebuilt is not None else spec.build()
         if not hasattr(fn, "trace"):
             fn = jax.jit(fn)
         self._fn = fn

@@ -374,6 +374,14 @@ def job_logs(run_id: str, tail: int) -> None:
                    "their IR (PERF001 donation audit, PERF002 dtype "
                    "widening, PERF003 padding waste, PERF004 scan-body "
                    "transposes, PERF005 host callbacks)")
+@click.option("--mesh", "mesh", is_flag=True,
+              help="also lower registered entrypoints SPMD-partitioned "
+                   "per declared mesh variant and lint the compiled HLO "
+                   "(SHARD002 boundary resharding, SHARD003 idle-axis "
+                   "replication, SHARD004 collective budgets, SHARD005 "
+                   "cross-host loop gathers, SHARD006 donation lost to "
+                   "sharding); auto-on when a SHARD00[2-6] rule id is "
+                   "requested")
 @click.option("--graph", default=None,
               type=click.Choice(["dot", "json"]),
               help="emit the send/handle graph instead of linting")
@@ -381,8 +389,8 @@ def job_logs(run_id: str, tail: int) -> None:
               help="checkout root (default: the directory containing the "
                    "fedml_tpu package)")
 def lint(fmt: str, baseline: str, update_baseline: bool, paths,
-         rules: str, whole_program: bool, perf: bool, graph: str,
-         root: str) -> None:
+         rules: str, whole_program: bool, perf: bool, mesh: bool,
+         graph: str, root: str) -> None:
     """JAX-aware static analysis with a CI ratchet (docs/STATIC_ANALYSIS.md).
 
     Exit codes: 0 clean, 1 new (unbaselined) findings, 2 internal error."""
@@ -393,7 +401,7 @@ def lint(fmt: str, baseline: str, update_baseline: bool, paths,
     raise SystemExit(run_cli(
         root=root, paths=list(paths) or None, fmt=fmt, baseline=baseline,
         update_baseline=update_baseline, rule_ids=rule_ids,
-        whole_program=whole_program, perf=perf, graph=graph,
+        whole_program=whole_program, perf=perf, mesh=mesh, graph=graph,
         echo=click.echo))
 
 
@@ -497,16 +505,49 @@ def perf_diff(path_a: str, path_b: str, label_a: str, label_b: str) -> None:
               help="restrict to these registered entrypoints (repeatable)")
 @click.option("--root", default=None, type=click.Path(exists=True),
               help="checkout root (default: the installed package's parent)")
-def perf_programs(entries, root: str) -> None:
+@click.option("--json", "as_json", is_flag=True,
+              help="emit one JSON object keyed by program instead of "
+                   "one line per program")
+@click.option("--collectives/--no-collectives", "with_collectives",
+              default=True,
+              help="include per-mesh-variant collective count/bytes "
+                   "columns from the mesh-lint tier (compiles each "
+                   "variant SPMD-partitioned on the forced 8-device "
+                   "CPU platform — same parser, same totals as the "
+                   "SHARD004 budget ratchet)")
+def perf_programs(entries, root: str, as_json: bool,
+                  with_collectives: bool) -> None:
     """Analytic FLOPs + HBM for every registered perf-lint entrypoint
-    (PR-7 registry), from XLA cost/memory analysis.  Compiles each entry
+    (PR-7 registry), from XLA cost/memory analysis, plus per-mesh-variant
+    collective count/bytes from the mesh tier.  Compiles each entry
     abstractly — seconds per program, not a hot path."""
     from ..core.mlops import flight_recorder
 
+    if with_collectives:
+        # pin before entrypoint_costs initializes the backend: the mesh
+        # variants need the forced 8-device host platform, and XLA only
+        # reads XLA_FLAGS at backend init
+        from ..analysis.mesh import _pin_mesh_cpu_platform
+
+        _pin_mesh_cpu_platform()
     costs = flight_recorder.entrypoint_costs(
         names=list(entries) or None, root=root)
-    for name, info in sorted(costs.items()):
-        click.echo(json.dumps(dict(info, program=name)))
+    if with_collectives:
+        from ..analysis.engine import default_root
+        from ..analysis.mesh import collective_report
+
+        report = collective_report(root or default_root(),
+                                   names=list(entries) or None)
+        for name, info in costs.items():
+            if name in report:
+                info["collectives"] = report[name]
+    if as_json:
+        click.echo(json.dumps(
+            {name: info for name, info in sorted(costs.items())},
+            indent=2))
+    else:
+        for name, info in sorted(costs.items()):
+            click.echo(json.dumps(dict(info, program=name)))
 
 
 @cli.group()

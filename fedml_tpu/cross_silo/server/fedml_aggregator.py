@@ -176,8 +176,6 @@ class FedMLAggregator:
         1.0 replaces it outright, the sync-equivalent).  Unlike
         ``aggregate`` there is no received-set to clear — the async
         manager owns buffer/dedup state."""
-        import jax
-
         global_model = self.get_global_model_params()
         with tracing.span("server.aggregate_async", n_updates=len(entries)):
             with mlops.span("server.agg"), \
@@ -187,15 +185,12 @@ class FedMLAggregator:
                 agg = self.aggregator.aggregate(raw)
                 agg = self.aggregator.on_after_aggregation(agg)
         if server_lr != 1.0:
-            import jax.numpy as jnp
+            # shared with the jittable async/aggregate_buffer registry
+            # entry (agg_operator.fold_buffer) so the perf/mesh lint
+            # tiers trace the SAME mixing arithmetic the server runs
+            from ...ml.aggregator.agg_operator import mix_global
 
-            def _mix(g, a):
-                ga, aa = jnp.asarray(g), jnp.asarray(a)
-                if not jnp.issubdtype(ga.dtype, jnp.floating):
-                    return aa
-                return ga + server_lr * (aa.astype(ga.dtype) - ga)
-
-            agg = jax.tree_util.tree_map(_mix, global_model, agg)
+            agg = mix_global(global_model, agg, server_lr)
         self.aggregator.set_model_params(agg)
         return agg
 

@@ -80,6 +80,40 @@ def agg_stacked(stacked: Any, weights: jnp.ndarray) -> Any:
     return jax.tree_util.tree_map(_leaf, stacked)
 
 
+def mix_global(global_tree: Any, agg_tree: Any, server_lr: Any) -> Any:
+    """Server-rate mixing ``global ← global + server_lr · (agg − global)``
+    in the global leaf's dtype (``server_lr`` = 1.0 replaces outright, the
+    sync-equivalent).  Non-float leaves take the aggregate as-is — a
+    fractional mix of step counters is meaningless.  Jittable (traced by
+    the ``async/aggregate_buffer`` registry entry) and host-callable (the
+    buffered-async server mixes with it after the robust funnel)."""
+
+    def _mix(g, a):
+        ga, aa = jnp.asarray(g), jnp.asarray(a)
+        if not jnp.issubdtype(ga.dtype, jnp.floating):
+            return aa
+        # mix in f32, come back in the global's dtype: an f32 server_lr
+        # would otherwise PROMOTE a bf16 mix to f32 — silently widening
+        # the global and (under jit) dropping the donated-global alias
+        gf = ga.astype(jnp.float32)
+        mixed = gf + jnp.asarray(server_lr, jnp.float32) * (
+            aa.astype(jnp.float32) - gf)
+        return mixed.astype(ga.dtype)
+
+    return jax.tree_util.tree_map(_mix, global_tree, agg_tree)
+
+
+def fold_buffer(global_tree: Any, stacked: Any, weights: jnp.ndarray,
+                server_lr: Any = 1.0) -> Any:
+    """Buffered-async fold core (PR-6 ``aggregate_buffer``), jittable:
+    staleness-decayed ``weights`` ([n_buffer], computed host-side by
+    ``staleness_fn`` × sample counts) weight one fused reduction over the
+    stacked update buffer, and the result mixes into the global at
+    ``server_lr``.  The device-side hot path of the async server — the
+    ``async/aggregate_buffer`` registry entry traces exactly this."""
+    return mix_global(global_tree, agg_stacked(stacked, weights), server_lr)
+
+
 def agg_psum(update: Any, weight: jnp.ndarray, axis_name: str) -> Any:
     """Weighted mean across a mesh axis — the NCCL-allreduce equivalent
     (reference `simulation/nccl/.../LocalAggregator.py:69-80`) as an XLA

@@ -27,7 +27,7 @@ import numpy as np
 import optax
 
 from ...ml.engine.model_bundle import ModelBundle, masked_loss
-from .lora import apply_lora, count_trainable, init_lora
+from .lora import _path_str, apply_lora, count_trainable, init_lora
 
 
 @dataclasses.dataclass
@@ -146,6 +146,7 @@ class LLMTrainer:
         bundle, cfg = self.bundle, self.cfg
         use_lora = cfg.use_lora
         tx = self.tx
+        mesh = self.mesh
 
         def loss_fn(trainable, base_params, model_state, batch, rng):
             params = (apply_lora(base_params, trainable, cfg.lora_alpha)
@@ -158,6 +159,30 @@ class LLMTrainer:
         def epoch(trainable, opt_state, base_params, model_state, batches,
                   rng):
             nb = batches["x"].shape[0]
+            if use_lora and mesh is not None:
+                # base params are FROZEN across the epoch scan, but the
+                # per-step LoRA merge (base + B@A) is not loop-invariant,
+                # so the SPMD partitioner re-gathers every fsdp-sharded
+                # LoRA-TARGET kernel INSIDE each step (a cross-host
+                # all-gather per target per iteration — SHARD005).  Pin
+                # exactly those leaves replicated before the loop: each
+                # gathers once per epoch at entry and the step body runs
+                # collective-free on them.  Non-target leaves keep their
+                # fsdp sharding (their hoisted gathers are already
+                # loop-invariant), and base stays sharded at rest between
+                # epochs (train() re-device_puts per strategy).
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                repl = NamedSharding(mesh, P())
+                targets = set(trainable)
+
+                def _pin(path, leaf):
+                    if _path_str(path) in targets:
+                        return jax.lax.with_sharding_constraint(leaf, repl)
+                    return leaf
+
+                base_params = jax.tree_util.tree_map_with_path(
+                    _pin, base_params)
 
             def step(carry, i):
                 trainable, opt_state, rng = carry

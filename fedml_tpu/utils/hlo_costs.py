@@ -108,6 +108,28 @@ def summarize_compiled(compiled: Any) -> Dict[str, Any]:
     return summarize(compiled.as_text())
 
 
+#: the four ops the per-entrypoint collective budget covers
+#: (``benchmarks/collective_budgets.json``, SHARD004); collective-permute
+#: is excluded — it is point-to-point and the budget models fan-in traffic
+BUDGET_OPS = ("all-reduce", "all-gather", "reduce-scatter", "all-to-all")
+
+
+def collective_totals(hlo_text: str,
+                      ops: Any = BUDGET_OPS) -> Dict[str, Any]:
+    """Count + byte totals restricted to ``ops`` — the ONE number the
+    mesh-lint budget ratchet, ``fedml perf programs`` and the bench JSONs
+    share, so provenance and lint can never disagree."""
+    s = summarize(hlo_text)
+    per_op = {op: {"count": s["counts"].get(op, 0),
+                   "bytes": s["bytes"].get(op, 0)}
+              for op in ops if s["counts"].get(op)}
+    return {
+        "total_ops": sum(v["count"] for v in per_op.values()),
+        "total_bytes": sum(v["bytes"] for v in per_op.values()),
+        "per_op": per_op,
+    }
+
+
 # ---- bandwidth model (ASSUMPTIONS, single source of truth) ---------------
 #: v5e ICI: 2D torus, ~45 GB/s one-way per link per direction (public
 #: "How to Scale Your Model" figure); ring-allreduce effective bandwidth

@@ -27,6 +27,7 @@ _SMOKE_FILES = {
     "test_transports.py", "test_security.py", "test_mpc.py",
     "test_fhe.py", "test_aux_subsystems.py", "test_multiprocess.py",
     "test_lint.py", "test_lint_wholeprogram.py", "test_lint_perf.py",
+    "test_lint_mesh.py",
     # test_reliability.py runs in its own dedicated smoke.yml step (like
     # test_observability.py) — listing it here would run the chaos soak
     # twice per CI job; test_aggregation.py likewise runs in the

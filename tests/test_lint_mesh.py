@@ -1,0 +1,413 @@
+"""Mesh-lint tier: SHARD002-SHARD006 collective-flow rules over
+mesh-lowered fixture programs (forced 8-device CPU mesh — conftest pins
+it), replica-group host-span units, the SHARD004 budget-ratchet
+roundtrip, the shared perf+mesh build cache, and the repo-clean smoke
+over the real registered mesh variants (<60s)."""
+
+from __future__ import annotations
+
+import importlib.util
+import itertools
+import textwrap
+import time
+
+from fedml_tpu.analysis import run_lint
+from fedml_tpu.analysis.engine import default_root
+from fedml_tpu.analysis.mesh.budgets import (
+    collect_registry_stats,
+    load_budgets,
+    write_budgets,
+)
+from fedml_tpu.analysis.mesh.lowering import (
+    CollectiveInstr,
+    expand_replica_groups,
+)
+
+_seq = itertools.count()
+
+
+def _write(tmp_path, relpath: str, source: str):
+    f = tmp_path / relpath
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(source))
+    return f
+
+
+def _load(tmp_path, relpath: str = "fedml_tpu/hot.py"):
+    name = f"_mesh_fixture_{next(_seq)}"
+    spec = importlib.util.spec_from_file_location(name,
+                                                  tmp_path / relpath)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _lint(tmp_path, reg, rules):
+    """SHARD rule ids auto-enable the mesh pass (no mesh=True here —
+    that IS the engine integration under test)."""
+    return run_lint(root=tmp_path, rule_ids=rules, perf_registry=reg)
+
+
+def _ids(findings):
+    return [f.rule_id for f in findings]
+
+
+#: fixture prelude: a private registry the test pulls out as REG.  Bare
+#: PartitionSpec constraints resolve against the lowering's mesh context.
+_PRELUDE = """\
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from fedml_tpu.analysis.mesh import OK_IN, OK_OUT, MeshVariant
+    from fedml_tpu.analysis.perf import (
+        EntrypointRegistry,
+        register_jit_entrypoint,
+    )
+
+    REG = EntrypointRegistry()
+"""
+
+
+# -- SHARD002: boundary resharding --------------------------------------------
+
+_RESHARD = """\
+
+    def _factory():
+        def step(x):
+            return x * 2.0
+        return (jax.jit(step),
+                (jax.ShapeDtypeStruct((64, 64), jnp.float32),))
+
+    register_jit_entrypoint({noqa}
+        "fx/reshard", _factory, donate_argnums=(),
+        mesh_variants=(MeshVariant("m", {{"d": 8}}, in_specs=(("d",),),
+                                   min_bytes=1024{vkw}),),
+        registry=REG)
+"""
+
+
+def _reshard_module(noqa: str = "", vkw: str = "") -> str:
+    return _PRELUDE + _RESHARD.format(noqa=noqa, vkw=vkw)
+
+
+def test_shard002_fires_on_boundary_reshard(tmp_path):
+    # sharded in, replicated out (the default): the partitioner must
+    # all-gather the computed value right at the boundary
+    _write(tmp_path, "fedml_tpu/hot.py", _reshard_module())
+    res = _lint(tmp_path, _load(tmp_path).REG, ["SHARD002"])
+    assert _ids(res.findings) == ["SHARD002"]
+    assert "all-gather" in res.findings[0].message
+    assert "produces the program output" in res.findings[0].message
+    assert res.findings[0].path == "fedml_tpu/hot.py"
+
+
+def test_shard002_silent_when_specs_match(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py",
+           _reshard_module(vkw=', out_specs=("d",)'))
+    res = _lint(tmp_path, _load(tmp_path).REG, ["SHARD002"])
+    assert res.findings == []
+
+
+def test_shard002_reshard_ok_declares_design(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py", _reshard_module(
+        vkw=", reshard_ok=(OK_OUT,), note='replicated result by design'"))
+    res = _lint(tmp_path, _load(tmp_path).REG, ["SHARD002"])
+    assert res.findings == []
+
+
+def test_shard002_noqa_suppresses_at_registration(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py", _reshard_module(
+        noqa="  # fedml: noqa[SHARD002] — boundary gather accepted"))
+    res = _lint(tmp_path, _load(tmp_path).REG, ["SHARD002"])
+    assert res.findings == [] and res.suppressed == 1
+
+
+# -- SHARD003: idle-axis replication ------------------------------------------
+
+_REPL = """\
+
+    def _factory():
+        def step(x):
+            return jnp.sum(x)
+        return (jax.jit(step),
+                (jax.ShapeDtypeStruct((64, 64), jnp.float32),))
+
+    register_jit_entrypoint({noqa}
+        "fx/repl", _factory, donate_argnums=(),
+        mesh_variants=(MeshVariant("m", {{"d": 8}}{vkw}),),
+        registry=REG)
+"""
+
+
+def _repl_module(noqa: str = "", vkw: str = ", min_bytes=1024") -> str:
+    return _PRELUDE + _REPL.format(noqa=noqa, vkw=vkw)
+
+
+def test_shard003_fires_on_idle_axis_replication(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py", _repl_module())
+    res = _lint(tmp_path, _load(tmp_path).REG, ["SHARD003"])
+    assert _ids(res.findings) == ["SHARD003"]
+    assert "fully replicated" in res.findings[0].message
+    assert "mesh axis d" in res.findings[0].message
+
+
+def test_shard003_silent_when_sharded(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py", _repl_module(
+        vkw=', in_specs=(("d",),), min_bytes=1024'))
+    res = _lint(tmp_path, _load(tmp_path).REG, ["SHARD003"])
+    assert res.findings == []
+
+
+def test_shard003_replicate_ok_declares_design(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py", _repl_module(
+        vkw=", min_bytes=1024, replicate_ok=(0,),"
+            " note='broadcast operand by design'"))
+    res = _lint(tmp_path, _load(tmp_path).REG, ["SHARD003"])
+    assert res.findings == []
+
+
+def test_shard003_small_arrays_ignored(tmp_path):
+    # 16KiB sits under the default 64KiB bar
+    _write(tmp_path, "fedml_tpu/hot.py", _repl_module(vkw=""))
+    res = _lint(tmp_path, _load(tmp_path).REG, ["SHARD003"])
+    assert res.findings == []
+
+
+def test_shard003_noqa_suppresses_at_registration(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py", _repl_module(
+        noqa="  # fedml: noqa[SHARD003] — replicated on purpose"))
+    res = _lint(tmp_path, _load(tmp_path).REG, ["SHARD003"])
+    assert res.findings == [] and res.suppressed == 1
+
+
+# -- SHARD005: cross-host all-gather in a round loop --------------------------
+
+_LOOP = """\
+
+    def _factory():
+        def body(c, _):
+            {body}
+            return nxt, None
+        def step(c):
+            out, _ = jax.lax.scan(body, c, None, length=4)
+            return out
+        return (jax.jit(step),
+                (jax.ShapeDtypeStruct((64, 64), jnp.float32),))
+
+    register_jit_entrypoint({noqa}
+        "fx/loop", _factory, donate_argnums=(),
+        mesh_variants=(MeshVariant("m", {{"d": 8}}, in_specs=(("d",),),
+                                   out_specs=("d",), min_bytes=1024),),
+        registry=REG)
+"""
+
+#: the carry mutates every step, so the gather can NOT hoist out
+_GATHERING_BODY = """\
+full = jax.lax.with_sharding_constraint(c, P())
+            nxt = jax.lax.with_sharding_constraint(full * 1.01, P("d"))"""
+
+_SHARDED_BODY = "nxt = c * 1.01"
+
+
+def test_shard005_fires_on_cross_host_loop_gather(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py",
+           _PRELUDE + _LOOP.format(body=_GATHERING_BODY, noqa=""))
+    res = _lint(tmp_path, _load(tmp_path).REG, ["SHARD005"])
+    assert _ids(res.findings) == ["SHARD005"]
+    assert res.findings[0].severity == "error"
+    assert "cross-host all-gather" in res.findings[0].message
+    assert "2 hosts" in res.findings[0].message
+    assert "inside the round loop" in res.findings[0].message
+
+
+def test_shard005_silent_when_loop_stays_sharded(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py",
+           _PRELUDE + _LOOP.format(body=_SHARDED_BODY, noqa=""))
+    res = _lint(tmp_path, _load(tmp_path).REG, ["SHARD005"])
+    assert res.findings == []
+
+
+def test_shard005_noqa_suppresses_at_registration(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py",
+           _PRELUDE + _LOOP.format(
+               body=_GATHERING_BODY,
+               noqa="  # fedml: noqa[SHARD005] — tiny demo loop"))
+    res = _lint(tmp_path, _load(tmp_path).REG, ["SHARD005"])
+    assert res.findings == [] and res.suppressed == 1
+
+
+# -- SHARD006: donation lost to sharding mismatch -----------------------------
+
+_DONATE = """\
+
+    def _factory():
+        def step(x):
+            return x + 1.0
+        return (jax.jit(step, donate_argnums=(0,)),
+                (jax.ShapeDtypeStruct((64, 64), jnp.float32),))
+
+    register_jit_entrypoint({noqa}
+        "fx/donate", _factory, donate_argnums=(0,),
+        mesh_variants=(MeshVariant("m", {{"d": 8}}, in_specs=(("d",),),
+                                   {outkw}min_bytes=1024),),
+        registry=REG)
+"""
+
+
+def test_shard006_fires_on_donation_lost_to_sharding(tmp_path):
+    # in-sharded, out-replicated: different per-device layouts, XLA
+    # cannot alias, the donation silently buys nothing
+    _write(tmp_path, "fedml_tpu/hot.py",
+           _PRELUDE + _DONATE.format(noqa="", outkw=""))
+    res = _lint(tmp_path, _load(tmp_path).REG, ["SHARD006"])
+    assert _ids(res.findings) == ["SHARD006"]
+    assert "lost its donation" in res.findings[0].message
+
+
+def test_shard006_silent_when_out_sharding_matches(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py",
+           _PRELUDE + _DONATE.format(noqa="", outkw='out_specs=("d",), '))
+    res = _lint(tmp_path, _load(tmp_path).REG, ["SHARD006"])
+    assert res.findings == []
+
+
+def test_shard006_noqa_suppresses_at_registration(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py",
+           _PRELUDE + _DONATE.format(
+               noqa="  # fedml: noqa[SHARD006] — copy accepted", outkw=""))
+    res = _lint(tmp_path, _load(tmp_path).REG, ["SHARD006"])
+    assert res.findings == [] and res.suppressed == 1
+
+
+# -- SHARD004: budget ratchet roundtrip ---------------------------------------
+
+def test_shard004_budget_ratchet_roundtrip(tmp_path):
+    _write(tmp_path, "fedml_tpu/hot.py", _reshard_module())
+    reg = _load(tmp_path).REG
+    # no committed file → missing-entry finding pointing at the generator
+    res = _lint(tmp_path, reg, ["SHARD004"])
+    assert _ids(res.findings) == ["SHARD004"]
+    assert "no committed collective budget" in res.findings[0].message
+    assert "fedml_tpu.analysis.mesh.budgets" in res.findings[0].message
+    # generate-and-commit (what `python -m ...mesh.budgets` does) → clean
+    stats = collect_registry_stats(tmp_path, registry=reg)
+    assert set(stats) == {"fx/reshard@m"}
+    assert stats["fx/reshard@m"]["total_ops"] >= 1
+    write_budgets(tmp_path, stats)
+    assert load_budgets(tmp_path) == stats
+    res = _lint(tmp_path, reg, ["SHARD004"])
+    assert res.findings == []
+    # a ratchet below the compiled reality → over-budget finding
+    tight = {k: dict(v, total_ops=0) for k, v in stats.items()}
+    write_budgets(tmp_path, tight)
+    res = _lint(tmp_path, reg, ["SHARD004"])
+    assert _ids(res.findings) == ["SHARD004"]
+    assert "exceed the committed budget" in res.findings[0].message
+
+
+# -- replica-group expansion + host-span classification -----------------------
+
+def test_expand_replica_groups_explicit():
+    line = "all-gather(...), replica_groups={{0,1},{2,3}}, dims={0}"
+    assert expand_replica_groups(line) == [[0, 1], [2, 3]]
+
+
+def test_expand_replica_groups_iota():
+    line = "all-reduce(...), replica_groups=[2,4]<=[8]"
+    assert expand_replica_groups(line) == [[0, 1, 2, 3], [4, 5, 6, 7]]
+
+
+def test_expand_replica_groups_iota_transposed():
+    # arange(8).reshape(2,4).T.reshape(4,2): pairs spanning both halves
+    line = "all-gather(...), replica_groups=[4,2]<=[2,4]T(1,0)"
+    assert expand_replica_groups(line) == [[0, 4], [1, 5], [2, 6], [3, 7]]
+
+
+def _coll(groups):
+    return CollectiveInstr(op="all-gather", nbytes=0, groups=groups,
+                           computation="c", in_loop=False, name="ag")
+
+
+def test_hosts_spanned_classification():
+    # 4 devices per modeled host: {0..3} host 0, {4..7} host 1
+    assert _coll([[0, 1, 2, 3]]).hosts_spanned(4) == 1
+    assert _coll([[4, 5, 6, 7]]).hosts_spanned(4) == 1
+    assert _coll([[0, 4]]).hosts_spanned(4) == 2
+    assert _coll([[0, 1], [2, 7]]).hosts_spanned(4) == 2
+    # the whole 8-device mesh on one 8-device host stays intra-host
+    assert _coll([[0, 1, 2, 3, 4, 5, 6, 7]]).hosts_spanned(8) == 1
+    assert _coll([[0, 1, 2, 3, 4, 5, 6, 7]]).hosts_spanned(4) == 2
+
+
+# -- engine integration: shared perf+mesh build cache -------------------------
+
+def test_mixed_perf_and_mesh_rules_build_once(tmp_path):
+    """A run mixing PERF and SHARD rule ids builds each registered
+    factory ONCE (the shared EntrypointBuildCache), not once per tier."""
+    _write(tmp_path, "fedml_tpu/hot.py", _PRELUDE + """\
+
+    CALLS = []
+
+    def _factory():
+        CALLS.append(1)
+        def step(x):
+            return x.astype(jnp.bfloat16)       # dtype change: PERF001
+        return (jax.jit(step, donate_argnums=(0,)),
+                (jax.ShapeDtypeStruct((128, 128), jnp.float32),))
+
+    register_jit_entrypoint(
+        "fx/shared", _factory, donate_argnums=(0,),
+        mesh_variants=(MeshVariant("m", {"d": 8}, in_specs=(("d",),),
+                                   min_bytes=1024),),
+        registry=REG)
+    """)
+    mod = _load(tmp_path)
+    res = run_lint(root=tmp_path, rule_ids=["PERF001", "SHARD003"],
+                   perf_registry=mod.REG)
+    assert "PERF001" in _ids(res.findings)
+    assert len(mod.CALLS) == 1, mod.CALLS
+
+
+# -- repo-clean smoke over the real registry ----------------------------------
+
+def test_repo_mesh_lint_clean_and_fast():
+    """Every registered mesh variant (parrot client/batch axes, llm
+    fsdp/tp_fsdp, robust agg, async fold, wire decode) lowers
+    SPMD-partitioned on the forced 8-device CPU mesh inside the smoke
+    budget, and the SHARD rules raise no new findings over the committed
+    baseline + budgets."""
+    t0 = time.monotonic()
+    root = default_root()
+    res = run_lint(root=root, rule_ids=[
+        "SHARD002", "SHARD003", "SHARD004", "SHARD005", "SHARD006"])
+    took = time.monotonic() - t0
+    from fedml_tpu.analysis.baseline import (
+        DEFAULT_BASELINE_NAME,
+        load_baseline,
+        partition,
+    )
+
+    baseline_p = root / DEFAULT_BASELINE_NAME
+    known = load_baseline(baseline_p) if baseline_p.is_file() else {}
+    new, _old = partition(res.findings, known)
+    assert new == [], [f.render() for f, _ in new]
+    assert not res.notes, res.notes
+    assert took < 60.0, f"mesh pass took {took:.1f}s (budget 60s)"
+    # the registry actually covers the programs the tier exists for
+    from fedml_tpu.analysis.perf import load_default_entrypoints
+
+    variants = {
+        f"{spec.name}@{v.name}"
+        for spec in load_default_entrypoints().entries()
+        for v in (spec.mesh_variants or ())
+    }
+    for expected in ("parrot/fused_round_scan@client_axis",
+                     "parrot/fused_round_scan@batch_axis",
+                     "parrot/bucketed_round_step@client_axis",
+                     "parrot/bucketed_round_step@batch_axis",
+                     "llm/train_epoch@fsdp", "llm/train_epoch@tp_fsdp",
+                     "agg/robust_trimmed_mean@clients8",
+                     "async/aggregate_buffer@clients8",
+                     "wire/decode_int8_delta@replicated8"):
+        assert expected in variants, sorted(variants)
