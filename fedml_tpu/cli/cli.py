@@ -191,6 +191,13 @@ def run(config: str, rank: int, role: str, reliable, heartbeat_interval_s,
     metrics = FedMLRunner(args, device, dataset, bundle).run()
     click.echo(json.dumps({k: v for k, v in (metrics or {}).items()
                            if isinstance(v, (int, float, str))}))
+    if getattr(args, "preempted_at_round", None) is not None:
+        # drained at a round boundary for the pod scheduler: report
+        # EX_TEMPFAIL so the queue requeues this job with resume instead
+        # of marking it finished/failed
+        from ..scheduler.pod import PREEMPTED_EXIT_CODE
+
+        sys.exit(PREEMPTED_EXIT_CODE)
 
 
 def _launch_and_echo(job_yaml: str, job_type: str) -> None:
@@ -350,6 +357,164 @@ def job_logs(run_id: str, tail: int) -> None:
     from .. import api
 
     click.echo(api.run_logs(run_id, tail), nl=False)
+
+
+def _job_brief(row: dict) -> dict:
+    """The list/status projection of a queue row (drop bulky fields)."""
+    return {k: row[k] for k in
+            ("job_id", "name", "tenant", "kind", "priority", "n_slots",
+             "state", "resume", "preempt_count", "run_id", "returncode",
+             "submitted_ts", "dispatched_ts", "finished_ts", "log_dir")}
+
+
+@cli.group()
+def jobs() -> None:
+    """Multi-tenant pod job queue: gang scheduling with round-boundary
+    preemption (docs/SCHEDULER.md)."""
+
+
+@jobs.command("submit")
+@click.argument("job_yaml", type=click.Path(exists=True))
+@click.option("--pod-dir", default=None,
+              help="pod state dir (default: $FEDML_TPU_POD_DIR or "
+                   "~/.fedml_tpu/pod)")
+def jobs_submit(job_yaml: str, pod_dir: str) -> None:
+    """Queue a job.yaml for the pod scheduler (returns immediately; the
+    `fedml jobs pod` daemon dispatches when the gang fits)."""
+    from ..scheduler.pod import JobQueue, JobSpec
+
+    try:
+        spec = JobSpec.from_yaml(job_yaml)
+    except ValueError as exc:
+        raise click.ClickException(str(exc))
+    queue = JobQueue(pod_dir)
+    try:
+        queue.submit(spec)
+        click.echo(json.dumps({"job_id": spec.job_id, "name": spec.name,
+                               "tenant": spec.tenant, "kind": spec.kind,
+                               "slots": spec.n_slots, "state": "QUEUED"}))
+    finally:
+        queue.close()
+
+
+@jobs.command("list")
+@click.option("--pod-dir", default=None)
+@click.option("--state", default=None,
+              help="filter: QUEUED|RUNNING|PREEMPTING|FINISHED|FAILED|"
+                   "CANCELLED")
+@click.option("--tenant", default=None)
+@click.option("--limit", default=50)
+def jobs_list(pod_dir: str, state: str, tenant: str, limit: int) -> None:
+    from ..scheduler.pod import JobQueue
+
+    queue = JobQueue(pod_dir)
+    try:
+        for row in queue.list_jobs(state=state, tenant=tenant,
+                                   limit=limit):
+            click.echo(json.dumps(_job_brief(row)))
+    finally:
+        queue.close()
+
+
+@jobs.command("status")
+@click.argument("job_id")
+@click.option("--pod-dir", default=None)
+def jobs_status(job_id: str, pod_dir: str) -> None:
+    from ..scheduler.pod import JobQueue
+
+    queue = JobQueue(pod_dir)
+    try:
+        row = queue.get(job_id)
+    finally:
+        queue.close()
+    if row is None:
+        raise click.ClickException(f"no such job: {job_id}")
+    click.echo(json.dumps(row))
+
+
+@jobs.command("preempt")
+@click.argument("job_id")
+@click.option("--pod-dir", default=None)
+def jobs_preempt(job_id: str, pod_dir: str) -> None:
+    """Drain a RUNNING job at its next round boundary; it requeues with
+    ``--resume-from latest`` and loses no completed rounds."""
+    from ..scheduler.pod import JobQueue
+
+    queue = JobQueue(pod_dir)
+    try:
+        ok = queue.request_preempt(job_id)
+    finally:
+        queue.close()
+    click.echo(json.dumps({"job_id": job_id, "preempt_requested": ok}))
+    if not ok:
+        raise SystemExit(1)
+
+
+@jobs.command("cancel")
+@click.argument("job_id")
+@click.option("--pod-dir", default=None)
+def jobs_cancel(job_id: str, pod_dir: str) -> None:
+    from ..scheduler.pod import JobQueue
+
+    queue = JobQueue(pod_dir)
+    try:
+        ok = queue.request_cancel(job_id)
+    finally:
+        queue.close()
+    click.echo(json.dumps({"job_id": job_id, "cancel_requested": ok}))
+    if not ok:
+        raise SystemExit(1)
+
+
+@jobs.command("pod")
+@click.option("--pod-dir", default=None)
+@click.option("--slots", default=None, type=int,
+              help="register this many device slots (default: one per "
+                   "local jax device)")
+@click.option("--tick-s", default=0.5, type=float)
+@click.option("--drain-grace-s", default=60.0, type=float,
+              help="seconds a PREEMPTING job may keep running before a "
+                   "hard kill (still requeued with resume)")
+@click.option("--tenant-weight", "tenant_weights", multiple=True,
+              metavar="TENANT=W",
+              help="fair-share weight override (repeatable)")
+@click.option("--once", is_flag=True,
+              help="run a single scheduling pass and exit (cron mode)")
+def jobs_pod(pod_dir: str, slots: int, tick_s: float,
+             drain_grace_s: float, tenant_weights, once: bool) -> None:
+    """Run the pod scheduler daemon: gang dispatch over the shared
+    resource db with weighted fair-share, priority eviction and
+    round-boundary preemption."""
+    from ..scheduler.pod import (JobQueue, PodScheduler,
+                                 ServingReplicaScaler)
+    from ..scheduler.resource_db import ComputeResourceDB
+
+    weights = {}
+    for item in tenant_weights:
+        tenant, _, w = item.partition("=")
+        if not tenant or not w:
+            raise click.BadParameter("expected TENANT=WEIGHT",
+                                     param_hint="--tenant-weight")
+        weights[tenant] = float(w)
+    queue = JobQueue(pod_dir)
+    resources = ComputeResourceDB(queue.root, total_slots=slots)
+    resources.reclaim_stale()  # free slots orphaned by a dead daemon
+    sched = PodScheduler(queue, resources, tenant_weights=weights or None,
+                         tick_s=tick_s, drain_grace_s=drain_grace_s,
+                         serving_scaler=ServingReplicaScaler(queue))
+    if once:
+        click.echo(json.dumps(sched.step()))
+        return
+    click.echo(json.dumps({"pod_dir": queue.root,
+                           "slots": resources.report()["total"]}))
+    sched.start()
+    import time
+
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        sched.stop()
 
 
 @cli.command()

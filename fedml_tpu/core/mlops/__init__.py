@@ -31,9 +31,14 @@ _state: Dict[str, Any] = {
 
 def init(args: Any) -> None:
     reset()  # back-to-back runs must not inherit open files or sinks
-    log_dir = getattr(args, "log_file_dir", None) or os.path.join(
-        os.path.expanduser("~"), ".fedml_tpu", "logs",
-        str(getattr(args, "run_id", "0")))
+    # FEDML_TPU_LOG_DIR is the pod scheduler's per-job isolation contract:
+    # each dispatch gets its own directory so two tenants' events/metrics/
+    # traces/flight logs never interleave.  Explicit config still wins.
+    log_dir = (getattr(args, "log_file_dir", None)
+               or os.environ.get("FEDML_TPU_LOG_DIR")
+               or os.path.join(
+                   os.path.expanduser("~"), ".fedml_tpu", "logs",
+                   str(getattr(args, "run_id", "0"))))
     os.makedirs(log_dir, exist_ok=True)
     with _lock:
         _state["enabled"] = bool(getattr(args, "enable_tracking", True))
@@ -179,6 +184,36 @@ def span(name: str, value: Any = None) -> _Span:
 def log_dir() -> Optional[str]:
     """The active run's log directory (None before the first init)."""
     return _state["log_dir"]
+
+
+class _JobScope:
+    """Context manager behind `job_scope` (kept a class so tests can
+    introspect the synthesized args)."""
+
+    def __init__(self, log_dir: str, run_id: Any,
+                 enable_tracking: bool) -> None:
+        from types import SimpleNamespace
+
+        self.args = SimpleNamespace(
+            log_file_dir=log_dir, run_id=str(run_id),
+            enable_tracking=enable_tracking)
+
+    def __enter__(self) -> "_JobScope":
+        init(self.args)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        shutdown()
+        return False
+
+
+def job_scope(log_dir: str, run_id: Any = "0",
+              enable_tracking: bool = True) -> _JobScope:
+    """Scope the mlops lifecycle to one pod job: `init` against a
+    job-private ``log_dir`` on entry, full `shutdown` on exit — so
+    in-process job runners get the same isolation a subprocess gets from
+    ``FEDML_TPU_LOG_DIR``, and nothing leaks into the next job."""
+    return _JobScope(log_dir, run_id, enable_tracking)
 
 
 def _try_add_wandb(args: Any) -> None:

@@ -163,6 +163,12 @@ class _Metric:
                 child = self._children[key] = _CHILD_TYPES[self.kind](self)
         return child
 
+    def children(self) -> Dict[Tuple[str, ...], _Child]:
+        """Snapshot of label-key → child, for programmatic consumers
+        (e.g. the pod serving scaler reading decode histograms)."""
+        with self._lock:
+            return dict(self._children)
+
     def _default_child(self) -> Any:
         """The no-labels child, for unlabelled metrics' direct methods."""
         if self.label_names:

@@ -8,6 +8,8 @@ client_index), collects C2S models, aggregates, advances rounds, sends FINISH.
 from __future__ import annotations
 
 import logging
+import os
+import signal
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -48,6 +50,10 @@ _stragglers_dropped = metrics.counter(
     "fedml_round_stragglers_dropped_total",
     "Clients dropped from a round by the deadline pacer (solicited but "
     "unreported when the round deadline fired)", labels=("run_id",))
+_preempted_round = metrics.gauge(
+    "fedml_preempted_at_round",
+    "Round index at which this server drained for a pod preemption "
+    "(absent when the run was never preempted)", labels=("run_id",))
 
 
 def fleet_size(args: Any) -> int:
@@ -182,6 +188,21 @@ class FedMLServerManager(FedMLCommManager):
             resume = getattr(args, "resume_from", None)
             if resume is not None and resume is not False and resume != "":
                 self._try_resume(resume)
+        # round-boundary preemption (pod scheduler contract): a drain
+        # file (FEDML_TPU_DRAIN_FILE) or SIGUSR1 asks this server to stop
+        # at the NEXT round boundary — the boundary checkpoint is already
+        # persisted by then, so the requeued job resumes with zero lost
+        # rounds and zero duplicate-counted uploads.  The launcher turns
+        # ``args.preempted_at_round`` into exit code 75 (EX_TEMPFAIL).
+        self._drain_file = (os.environ.get("FEDML_TPU_DRAIN_FILE")
+                            or getattr(args, "drain_file", None))
+        self._drain_event = threading.Event()
+        self.args.preempted_at_round = None
+        try:
+            signal.signal(signal.SIGUSR1,
+                          lambda *_: self._drain_event.set())
+        except ValueError:
+            pass  # not the main thread (in-process jobs poll the file)
 
     def run(self) -> None:
         self._start_hb_monitor()
@@ -770,6 +791,16 @@ class FedMLServerManager(FedMLCommManager):
                 self.args.round_idx, len(online), len(ranks - online))
             self._complete_round()
 
+    def _drain_requested(self) -> bool:
+        """True once a pod drain signal (file or SIGUSR1) has been seen —
+        latches, so a racing file removal cannot un-drain mid-boundary."""
+        if self._drain_event.is_set():
+            return True
+        if self._drain_file and os.path.exists(self._drain_file):
+            self._drain_event.set()
+            return True
+        return False
+
     def _complete_round(self) -> None:
         """Aggregate (possibly a partial set), test, advance or finish.
         Caller must hold ``_round_lock``."""
@@ -812,6 +843,28 @@ class FedMLServerManager(FedMLCommManager):
             self.send_finish_to_all()
             mlops.log_aggregation_status("FINISHED")
             if self._run_span is not None:
+                self._run_span.end()
+                self._run_span = None
+            self.finish()
+            return
+        if self._drain_requested():
+            # preempted at this boundary: the round_idx checkpoint is
+            # queued on the writer and finish() drains it before exit, so
+            # the requeued dispatch resumes exactly here — no lost round,
+            # and the aggregator's received set is empty (no upload can
+            # be double-counted).  Clients get FINISH so the process tree
+            # winds down cleanly; resume re-launches the full cohort.
+            logging.info("################ DRAIN at round boundary %d — "
+                         "preempting (checkpoint saved)",
+                         self.args.round_idx)
+            self.args.preempted_at_round = int(self.args.round_idx)
+            _preempted_round.labels(run_id=self._run_label).set(
+                int(self.args.round_idx))
+            self.send_finish_to_all()
+            mlops.log_aggregation_status("PREEMPTED")
+            if self._run_span is not None:
+                self._run_span.set_attr(
+                    "preempted_at_round", int(self.args.round_idx))
                 self._run_span.end()
                 self._run_span = None
             self.finish()

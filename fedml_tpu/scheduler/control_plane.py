@@ -26,6 +26,15 @@ Endpoints (JSON in/out):
   GET  /api/v1/runs/<id>
   GET  /api/v1/runs/<id>/wait?timeout=<s>
   POST /api/v1/runs/<id>/stop
+
+Pod job-queue tier (present when constructed with ``pod_queue=``; a
+pod-only plane may pass ``master=None``):
+  GET  /api/v1/pod/stats
+  GET  /api/v1/pod/jobs?state=&tenant=&limit=
+  GET  /api/v1/pod/jobs/<id>
+  POST /api/v1/pod/jobs       {job_name, kind, tenant, slots, command, ...}
+  POST /api/v1/pod/jobs/<id>/preempt
+  POST /api/v1/pod/jobs/<id>/cancel
 """
 
 from __future__ import annotations
@@ -43,12 +52,21 @@ from ..utils.http_json import DeepBacklogHTTPServer, BadRequest, JsonHandler
 from .agents import MasterAgent
 
 _RUN_PATH = re.compile(r"^/api/v1/runs/([0-9a-f]+)(/(wait|stop))?$")
+_POD_JOB_PATH = re.compile(
+    r"^/api/v1/pod/jobs/([0-9a-f]+)(/(preempt|cancel))?$")
 
 
 class ControlPlaneServer:
-    def __init__(self, master: MasterAgent, host: str = "127.0.0.1",
-                 port: int = 0, api_key: Optional[str] = None) -> None:
+    def __init__(self, master: Optional[MasterAgent],
+                 host: str = "127.0.0.1", port: int = 0,
+                 api_key: Optional[str] = None,
+                 pod_queue: Optional[Any] = None) -> None:
+        """``master`` drives the fleet/runs endpoints; ``pod_queue`` (a
+        `pod.JobQueue`) enables the /api/v1/pod tier.  Either may be None
+        — a pod-only control plane passes ``master=None``; the missing
+        tier answers 503."""
         self.master = master
+        self.pod_queue = pod_queue
         self.api_key = api_key or None
         plane = self
 
@@ -78,6 +96,11 @@ class ControlPlaneServer:
                     return None
                 if not self._authed():
                     return self._reply(401, {"error": "bad api key"})
+                path = self.path.split("?")[0]
+                if path.startswith("/api/v1/pod"):
+                    return self._pod_get(path)
+                if plane.master is None:
+                    return self._reply(503, {"error": "no master agent"})
                 if self.path == "/api/v1/fleet":
                     return self._reply(200, {"edges": plane.master.fleet()})
                 m = _RUN_PATH.match(self.path.split("?")[0])
@@ -99,6 +122,53 @@ class ControlPlaneServer:
                         return self._reply(404, {"error": "unknown run"})
                 return self._reply(404, {"error": "not found"})
 
+            def _pod_get(self, path: str):
+                if plane.pod_queue is None:
+                    return self._reply(503, {"error": "no pod queue"})
+                if path == "/api/v1/pod/stats":
+                    return self._reply(200, plane.pod_queue.stats())
+                if path == "/api/v1/pod/jobs":
+                    from urllib.parse import parse_qs, urlparse
+
+                    q = parse_qs(urlparse(self.path).query)
+                    rows = plane.pod_queue.list_jobs(
+                        state=(q.get("state") or [None])[0],
+                        tenant=(q.get("tenant") or [None])[0],
+                        limit=int((q.get("limit") or ["200"])[0]))
+                    return self._reply(200, {"jobs": rows})
+                m = _POD_JOB_PATH.match(path)
+                if m and not m.group(3):
+                    row = plane.pod_queue.get(m.group(1))
+                    if row is None:
+                        return self._reply(404, {"error": "unknown job"})
+                    return self._reply(200, row)
+                return self._reply(404, {"error": "not found"})
+
+            def _pod_post(self, body):
+                if plane.pod_queue is None:
+                    return self._reply(503, {"error": "no pod queue"})
+                if self.path == "/api/v1/pod/jobs":
+                    from .pod import JobSpec
+
+                    try:
+                        spec = JobSpec.from_dict(body)
+                    except (ValueError, TypeError) as e:
+                        return self._reply(400, {"error": str(e)})
+                    plane.pod_queue.submit(spec)
+                    return self._reply(200, {"job_id": spec.job_id})
+                m = _POD_JOB_PATH.match(self.path)
+                if m and m.group(3) == "preempt":
+                    ok = plane.pod_queue.request_preempt(m.group(1))
+                    return self._reply(200 if ok else 409,
+                                       {"job_id": m.group(1),
+                                        "preempt_requested": ok})
+                if m and m.group(3) == "cancel":
+                    ok = plane.pod_queue.request_cancel(m.group(1))
+                    return self._reply(200 if ok else 409,
+                                       {"job_id": m.group(1),
+                                        "cancel_requested": ok})
+                return self._reply(404, {"error": "not found"})
+
             def do_POST(self) -> None:  # noqa: N802
                 if not self._authed():
                     return self._reply(401, {"error": "bad api key"})
@@ -106,6 +176,10 @@ class ControlPlaneServer:
                     body = self.json_body()
                 except BadRequest:
                     return self._reply(400, {"error": "bad json"})
+                if self.path.startswith("/api/v1/pod"):
+                    return self._pod_post(body)
+                if plane.master is None:
+                    return self._reply(503, {"error": "no master agent"})
                 if self.path == "/api/v1/match":
                     try:
                         edges = plane.master.match_edges(
@@ -236,6 +310,32 @@ class ControlPlaneClient:
     def stop_run(self, run_id: str) -> None:
         self._call("POST", f"/api/v1/runs/{run_id}/stop", {})
 
+    # -- pod job queue -------------------------------------------------------
+    def pod_submit(self, spec: Dict[str, Any]) -> str:
+        """Submit a pod job from its YAML-shaped dict; returns job_id."""
+        return self._call("POST", "/api/v1/pod/jobs", spec)["job_id"]
+
+    def pod_jobs(self, state: Optional[str] = None,
+                 tenant: Optional[str] = None) -> List[Dict[str, Any]]:
+        qs = "&".join(f"{k}={v}" for k, v in
+                      (("state", state), ("tenant", tenant)) if v)
+        return self._call("GET", "/api/v1/pod/jobs"
+                          + (f"?{qs}" if qs else ""))["jobs"]
+
+    def pod_job(self, job_id: str) -> Dict[str, Any]:
+        return self._call("GET", f"/api/v1/pod/jobs/{job_id}")
+
+    def pod_preempt(self, job_id: str) -> bool:
+        return self._call("POST", f"/api/v1/pod/jobs/{job_id}/preempt",
+                          {})["preempt_requested"]
+
+    def pod_cancel(self, job_id: str) -> bool:
+        return self._call("POST", f"/api/v1/pod/jobs/{job_id}/cancel",
+                          {})["cancel_requested"]
+
+    def pod_stats(self) -> Dict[str, int]:
+        return self._call("GET", "/api/v1/pod/stats")
+
 
 def main() -> None:
     import argparse
@@ -248,10 +348,19 @@ def main() -> None:
     p.add_argument("--channel", default="agents")
     p.add_argument("--store-dir", default=None)
     p.add_argument("--api-key", default=os.environ.get("FEDML_API_KEY"))
+    p.add_argument("--pod-dir", default=None,
+                   help="also expose the pod job queue at /api/v1/pod "
+                        "(the `fedml jobs pod` daemon's state dir)")
     cli = p.parse_args()
     master = MasterAgent(channel=cli.channel, store_dir=cli.store_dir)
+    pod_queue = None
+    if cli.pod_dir is not None:
+        from .pod import JobQueue
+
+        pod_queue = JobQueue(cli.pod_dir)
     srv = ControlPlaneServer(master, cli.host, cli.port,
-                             api_key=cli.api_key).start()
+                             api_key=cli.api_key,
+                             pod_queue=pod_queue).start()
     print(json.dumps({"control_plane": srv.url}), flush=True)
     try:
         while True:

@@ -1,0 +1,42 @@
+"""Multi-tenant pod scheduler — many federated jobs, one TPU pod.
+
+Capability parity+: the reference FedML's largest plane is its MLOps
+scheduler (~25.4k LoC of launch/run/deploy runners); this package is the
+TPU-era equivalent scoped to ONE device pool — a control plane that
+gang-schedules mesh slices from a shared `ComputeResourceDB` to mixed
+workloads (Parrot sims, cross-silo rounds, serving replicas):
+
+* `JobSpec` / `JobQueue` — YAML job submissions in a shared sqlite queue
+  (`fedml jobs submit|list|status|preempt|cancel`);
+* `GangAllocator` — dispatch only when the FULL gang fits, weighted
+  fair-share across tenants plus priority eviction of preemptible jobs;
+* `PodScheduler` — the dispatch loop: round-boundary preemption (drain
+  signal → the server force-saves its `RoundCheckpointer` state at the
+  next boundary → exits `PREEMPTED_EXIT_CODE` → requeued with
+  `--resume-from latest`), per-tenant AOT-cache sharing
+  (`FEDML_TPU_AOT_CACHE_DIR`), per-job mlops isolation
+  (`FEDML_TPU_LOG_DIR`), and the queue metrics plane;
+* `ServingReplicaScaler` — serving-replica jobs scale their slot demand
+  from the PR-9 decode histograms via `scheduler.autoscaler`.
+
+See docs/SCHEDULER.md for the job YAML schema and lifecycle.
+"""
+
+from .jobspec import (  # noqa: F401
+    JOB_KINDS,
+    KIND_CROSS_SILO,
+    KIND_PARROT,
+    KIND_SERVING,
+    PREEMPTED_EXIT_CODE,
+    JobSpec,
+    JobState,
+)
+from .queue import JobQueue, pod_root  # noqa: F401
+from .allocator import GangAllocator, PlacementPlan  # noqa: F401
+from .runners import (  # noqa: F401
+    CallableJobRunner,
+    JobContext,
+    SubprocessJobRunner,
+)
+from .scheduler import PodScheduler  # noqa: F401
+from .serving_scaler import ServingReplicaScaler  # noqa: F401

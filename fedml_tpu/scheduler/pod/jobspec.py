@@ -1,0 +1,124 @@
+"""Job specifications for the pod scheduler.
+
+A job is ONE gang-scheduled unit of work: it runs only when its full slot
+demand (a mesh slice of the pod) can be allocated at once.  The YAML
+schema extends the launcher's job.yaml contract (`local_launcher.JobConfig`)
+with the multi-tenant fields:
+
+```yaml
+job_name: team-a-sim          # display name
+tenant: team-a                # fair-share accounting bucket
+kind: parrot                  # parrot | cross_silo | serving
+priority: 10                  # higher evicts lower (preemptible) jobs
+slots: 4                      # gang size — device slots held while running
+command: fedml run --cf fedml_config.yaml {resume}
+workdir: .                    # resolved relative to the YAML file
+preemptible: true             # may be drained for higher-priority work
+fedml_env:                    # extra environment for the dispatch
+  FEDML_TPU_FLIGHT_RECORDER: "1"
+```
+
+`{resume}` in the command expands to ``--resume-from latest`` when the job
+is re-dispatched after a round-boundary preemption, and to the empty
+string on the first dispatch — the job script stays a single line either
+way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import uuid
+from typing import Any, Dict, Optional
+
+#: exit code a dispatched job uses to report "preempted at a round
+#: boundary, checkpoint saved — requeue me with --resume-from latest".
+#: BSD's EX_TEMPFAIL: a transient condition, retry later.
+PREEMPTED_EXIT_CODE = 75
+
+KIND_PARROT = "parrot"
+KIND_CROSS_SILO = "cross_silo"
+KIND_SERVING = "serving"
+JOB_KINDS = (KIND_PARROT, KIND_CROSS_SILO, KIND_SERVING)
+
+
+class JobState:
+    """Lifecycle: QUEUED → RUNNING → {FINISHED, FAILED} | PREEMPTING →
+    (exit) → QUEUED again with ``resume=1`` (or PREEMPTED when the job is
+    not requeued, e.g. cancelled mid-drain).  CANCELLED is terminal from
+    any non-terminal state."""
+
+    QUEUED = "QUEUED"
+    RUNNING = "RUNNING"
+    PREEMPTING = "PREEMPTING"
+    PREEMPTED = "PREEMPTED"
+    FINISHED = "FINISHED"
+    FAILED = "FAILED"
+    CANCELLED = "CANCELLED"
+
+    ACTIVE = (QUEUED, RUNNING, PREEMPTING)
+    TERMINAL = (PREEMPTED, FINISHED, FAILED, CANCELLED)
+
+
+@dataclasses.dataclass
+class JobSpec:
+    name: str
+    kind: str = KIND_CROSS_SILO
+    tenant: str = "default"
+    priority: int = 0
+    n_slots: int = 1
+    command: str = ""
+    workdir: str = "."
+    env: Dict[str, str] = dataclasses.field(default_factory=dict)
+    preemptible: bool = True
+    job_id: str = dataclasses.field(
+        default_factory=lambda: uuid.uuid4().hex[:12])
+
+    def validate(self) -> "JobSpec":
+        if self.kind not in JOB_KINDS:
+            raise ValueError(
+                f"job kind {self.kind!r} not in {JOB_KINDS}")
+        if int(self.n_slots) < 1:
+            raise ValueError(f"slots must be >= 1, got {self.n_slots}")
+        if not self.name:
+            raise ValueError("job_name is required")
+        return self
+
+    @classmethod
+    def from_dict(cls, raw: Dict[str, Any],
+                  base_dir: Optional[str] = None) -> "JobSpec":
+        workdir = str(raw.get("workdir", ".") or ".")
+        if base_dir is not None:
+            workdir = os.path.normpath(os.path.join(base_dir, workdir))
+        slots = raw.get("slots", raw.get("n_slots"))
+        return cls(
+            name=str(raw.get("job_name", "")
+                     or f"job_{uuid.uuid4().hex[:8]}"),
+            kind=str(raw.get("kind", KIND_CROSS_SILO)),
+            tenant=str(raw.get("tenant", "default") or "default"),
+            priority=int(raw.get("priority", 0) or 0),
+            n_slots=int(1 if slots is None else slots),
+            command=str(raw.get("command", raw.get("job", "")) or ""),
+            workdir=workdir,
+            env={k: str(v) for k, v in
+                 dict(raw.get("fedml_env", raw.get("env", {})) or {}
+                      ).items()},
+            preemptible=bool(raw.get("preemptible", True)),
+        ).validate()
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "JobSpec":
+        import yaml
+
+        with open(path) as f:
+            raw = yaml.safe_load(f) or {}
+        return cls.from_dict(raw,
+                             base_dir=os.path.dirname(os.path.abspath(path)))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def render_command(self, resume: bool) -> str:
+        """Expand the ``{resume}`` placeholder for this dispatch."""
+        return self.command.replace(
+            "{resume}", "--resume-from latest" if resume else "").strip()

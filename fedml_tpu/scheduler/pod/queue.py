@@ -1,0 +1,223 @@
+"""Shared sqlite job queue — the pod scheduler's source of truth.
+
+The CLI (`fedml jobs submit|preempt|cancel`) and the scheduler daemon are
+separate PROCESSES sharing this database: submissions and control
+requests are plain row writes, the daemon polls and owns every state
+transition.  Single-statement updates ride sqlite's atomicity; the
+multi-row transitions (requeue-after-preemption) run under BEGIN
+IMMEDIATE, same discipline as `ComputeResourceDB.allocate`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .jobspec import JobSpec, JobState
+
+_COLUMNS = (
+    "job_id", "name", "tenant", "kind", "priority", "n_slots", "command",
+    "workdir", "env", "preemptible", "state", "resume",
+    "preempt_requested", "cancel_requested", "preempt_count",
+    "submitted_ts", "dispatched_ts", "finished_ts", "run_id",
+    "returncode", "log_dir", "slots")
+
+
+def pod_root(root: Optional[str] = None) -> str:
+    """The pod control plane's state directory (queue db, per-job logs,
+    drain files, the shared AOT cache).  ``FEDML_TPU_POD_DIR`` overrides
+    for tests and multi-pod hosts."""
+    root = (root or os.environ.get("FEDML_TPU_POD_DIR")
+            or os.path.join(os.path.expanduser("~"), ".fedml_tpu", "pod"))
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+class JobQueue:
+    def __init__(self, root: Optional[str] = None) -> None:
+        self.root = pod_root(root)
+        self.path = os.path.join(self.root, "queue.db")
+        # isolation_level=None → autocommit + manual BEGIN IMMEDIATE for
+        # the transitions that must be atomic across processes
+        self._conn = sqlite3.connect(self.path, check_same_thread=False,
+                                     isolation_level=None, timeout=10.0)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS jobs ("
+                "job_id TEXT PRIMARY KEY, name TEXT, tenant TEXT, "
+                "kind TEXT, priority INTEGER, n_slots INTEGER, "
+                "command TEXT, workdir TEXT, env TEXT, "
+                "preemptible INTEGER, state TEXT, resume INTEGER, "
+                "preempt_requested INTEGER, cancel_requested INTEGER, "
+                "preempt_count INTEGER, submitted_ts REAL, "
+                "dispatched_ts REAL, finished_ts REAL, run_id TEXT, "
+                "returncode INTEGER, log_dir TEXT, slots TEXT)")
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+    # -- intake ---------------------------------------------------------------
+    def submit(self, spec: JobSpec) -> str:
+        spec.validate()
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO jobs VALUES "
+                "(?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                (spec.job_id, spec.name, spec.tenant, spec.kind,
+                 int(spec.priority), int(spec.n_slots), spec.command,
+                 spec.workdir, json.dumps(spec.env),
+                 int(spec.preemptible), JobState.QUEUED, 0, 0, 0, 0,
+                 time.time(), None, None, None, None, None, None))
+        return spec.job_id
+
+    # -- reads ----------------------------------------------------------------
+    @staticmethod
+    def _row_to_dict(row) -> Dict[str, Any]:
+        d = dict(zip(_COLUMNS, row))
+        d["env"] = json.loads(d["env"] or "{}")
+        d["slots"] = json.loads(d["slots"] or "[]")
+        for key in ("preemptible", "resume", "preempt_requested",
+                    "cancel_requested"):
+            d[key] = bool(d[key])
+        return d
+
+    def get(self, job_id: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            row = self._conn.execute(
+                f"SELECT {','.join(_COLUMNS)} FROM jobs WHERE job_id=?",
+                (job_id,)).fetchone()
+        return None if row is None else self._row_to_dict(row)
+
+    def list_jobs(self, state: Optional[str] = None,
+                  tenant: Optional[str] = None,
+                  limit: int = 200) -> List[Dict[str, Any]]:
+        q = f"SELECT {','.join(_COLUMNS)} FROM jobs"
+        cond, params = [], []
+        if state:
+            cond.append("state=?")
+            params.append(state)
+        if tenant:
+            cond.append("tenant=?")
+            params.append(tenant)
+        if cond:
+            q += " WHERE " + " AND ".join(cond)
+        q += " ORDER BY submitted_ts LIMIT ?"
+        params.append(int(limit))
+        with self._lock:
+            rows = self._conn.execute(q, params).fetchall()
+        return [self._row_to_dict(r) for r in rows]
+
+    def queued(self) -> List[Dict[str, Any]]:
+        return self.list_jobs(state=JobState.QUEUED)
+
+    def active(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            rows = self._conn.execute(
+                f"SELECT {','.join(_COLUMNS)} FROM jobs WHERE state IN "
+                "(?,?) ORDER BY dispatched_ts",
+                (JobState.RUNNING, JobState.PREEMPTING)).fetchall()
+        return [self._row_to_dict(r) for r in rows]
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT state, COUNT(*) FROM jobs GROUP BY state"
+            ).fetchall()
+        return {state: int(n) for state, n in rows}
+
+    # -- control requests (CLI/API side) --------------------------------------
+    def request_preempt(self, job_id: str) -> bool:
+        """Ask the scheduler to drain a RUNNING job at its next round
+        boundary.  Returns False when the job isn't running."""
+        with self._lock:
+            cur = self._conn.execute(
+                "UPDATE jobs SET preempt_requested=1 "
+                "WHERE job_id=? AND state=?", (job_id, JobState.RUNNING))
+        return cur.rowcount > 0
+
+    def request_cancel(self, job_id: str) -> bool:
+        """Cancel: QUEUED jobs die immediately; RUNNING/PREEMPTING jobs
+        get the flag and the scheduler stops them on its next pass."""
+        with self._lock:
+            try:
+                self._conn.execute("BEGIN IMMEDIATE")
+                cur = self._conn.execute(
+                    "UPDATE jobs SET state=?, finished_ts=? "
+                    "WHERE job_id=? AND state=?",
+                    (JobState.CANCELLED, time.time(), job_id,
+                     JobState.QUEUED))
+                if cur.rowcount == 0:
+                    cur = self._conn.execute(
+                        "UPDATE jobs SET cancel_requested=1 "
+                        "WHERE job_id=? AND state IN (?,?)",
+                        (job_id, JobState.RUNNING, JobState.PREEMPTING))
+                self._conn.execute("COMMIT")
+            except sqlite3.OperationalError:
+                try:
+                    self._conn.execute("ROLLBACK")
+                except sqlite3.OperationalError:
+                    pass
+                return False
+        return cur.rowcount > 0
+
+    def update_slots(self, job_id: str, n_slots: int) -> bool:
+        """Resize a QUEUED job's gang demand (the serving scaler's knob —
+        a RUNNING job must be preempted first; its requeued row can then
+        be resized before re-dispatch)."""
+        with self._lock:
+            cur = self._conn.execute(
+                "UPDATE jobs SET n_slots=? WHERE job_id=? AND state=?",
+                (max(1, int(n_slots)), job_id, JobState.QUEUED))
+        return cur.rowcount > 0
+
+    # -- scheduler-owned transitions ------------------------------------------
+    def mark_dispatched(self, job_id: str, run_id: str, slots: List[int],
+                        log_dir: str) -> None:
+        with self._lock:
+            self._conn.execute(
+                "UPDATE jobs SET state=?, run_id=?, slots=?, log_dir=?, "
+                "dispatched_ts=?, preempt_requested=0 WHERE job_id=?",
+                (JobState.RUNNING, run_id, json.dumps(list(slots)),
+                 log_dir, time.time(), job_id))
+
+    def mark_preempting(self, job_id: str) -> None:
+        with self._lock:
+            self._conn.execute(
+                "UPDATE jobs SET state=?, preempt_requested=0 "
+                "WHERE job_id=? AND state=?",
+                (JobState.PREEMPTING, job_id, JobState.RUNNING))
+
+    def mark_finished(self, job_id: str, state: str,
+                      returncode: Optional[int]) -> None:
+        with self._lock:
+            self._conn.execute(
+                "UPDATE jobs SET state=?, returncode=?, finished_ts=?, "
+                "run_id=NULL WHERE job_id=?",
+                (state, returncode, time.time(), job_id))
+
+    def requeue_preempted(self, job_id: str,
+                          returncode: Optional[int]) -> None:
+        """Preempted job back to the queue: ``resume=1`` so the next
+        dispatch expands ``{resume}`` to ``--resume-from latest``."""
+        with self._lock:
+            try:
+                self._conn.execute("BEGIN IMMEDIATE")
+                self._conn.execute(
+                    "UPDATE jobs SET state=?, resume=1, "
+                    "preempt_count=preempt_count+1, returncode=?, "
+                    "run_id=NULL, slots=NULL, preempt_requested=0 "
+                    "WHERE job_id=?",
+                    (JobState.QUEUED, returncode, job_id))
+                self._conn.execute("COMMIT")
+            except sqlite3.OperationalError:
+                try:
+                    self._conn.execute("ROLLBACK")
+                except sqlite3.OperationalError:
+                    pass

@@ -1,0 +1,180 @@
+"""Job runners: how a dispatched JobSpec actually executes.
+
+Two interchangeable backends behind one handle contract
+(``poll() -> Optional[int]``, ``drain()``, ``kill()``):
+
+* ``SubprocessJobRunner`` — production: the job command runs in its own
+  process group with live log capture, registered in the launcher's runs
+  db (so ``fedml job list|logs`` see pod jobs too).  The dispatch
+  environment carries the pod contract:
+
+  - ``FEDML_TPU_DRAIN_FILE`` — the drain signal; the cross-silo server
+    polls it and exits ``PREEMPTED_EXIT_CODE`` at the next round boundary
+    with its checkpoint saved (SIGUSR1 is sent too, same meaning);
+  - ``FEDML_TPU_LOG_DIR`` — job-scoped mlops log dir (per-job isolation
+    of metrics/traces/flight logs);
+  - ``FEDML_TPU_AOT_CACHE_DIR`` — the pod-shared parrot AOT executable
+    cache (per-tenant compile sharing keyed by executable digests).
+
+* ``CallableJobRunner`` — in-process: the workload is a Python callable
+  receiving a ``JobContext``; used by the mixed-workload soak (8
+  concurrent jax workloads in one process beat 8 subprocess imports) and
+  available for embedding the scheduler in a notebook/driver process.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import subprocess
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from .jobspec import PREEMPTED_EXIT_CODE
+
+
+class JobContext:
+    """What a dispatched workload sees: identity, its mesh slice, the
+    pod-contract environment, and the drain channel."""
+
+    def __init__(self, job_id: str, run_id: str, slots: List[int],
+                 env: Dict[str, str], resume: bool,
+                 drain_path: str, log_dir: str) -> None:
+        self.job_id = job_id
+        self.run_id = run_id
+        self.slots = list(slots)
+        self.env = dict(env)
+        self.resume = resume
+        self.drain_path = drain_path
+        self.log_dir = log_dir
+
+    def drain_requested(self) -> bool:
+        return os.path.exists(self.drain_path)
+
+
+def signal_drain(drain_path: str) -> None:
+    """Raise the drain flag: create the drain file (the polled channel —
+    works for subprocess AND in-process workloads)."""
+    os.makedirs(os.path.dirname(drain_path), exist_ok=True)
+    with open(drain_path, "w") as f:
+        f.write("drain\n")
+
+
+class SubprocessJobHandle:
+    def __init__(self, proc: subprocess.Popen, ctx: JobContext,
+                 log_file) -> None:
+        self.proc = proc
+        self.ctx = ctx
+        self._log_file = log_file
+
+    def poll(self) -> Optional[int]:
+        rc = self.proc.poll()
+        if rc is not None and self._log_file is not None:
+            try:
+                self._log_file.close()
+            except OSError:
+                pass
+            self._log_file = None
+        return rc
+
+    def drain(self) -> None:
+        signal_drain(self.ctx.drain_path)
+        try:  # belt and braces: the server also listens for SIGUSR1
+            self.proc.send_signal(signal.SIGUSR1)
+        except (ProcessLookupError, OSError):
+            pass
+
+    def kill(self) -> None:
+        try:
+            os.killpg(os.getpgid(self.proc.pid), signal.SIGTERM)
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+
+
+class SubprocessJobRunner:
+    def start(self, job: Dict[str, Any], ctx: JobContext,
+              command: str) -> SubprocessJobHandle:
+        from ..local_launcher import register_run
+
+        env = dict(os.environ)
+        env.update(ctx.env)
+        os.makedirs(ctx.log_dir, exist_ok=True)
+        log_path = os.path.join(ctx.log_dir, "job.log")
+        log_file = open(log_path, "w")
+        proc = subprocess.Popen(
+            ["bash", "-c", command], cwd=job.get("workdir") or ".",
+            env=env, stdout=log_file, stderr=subprocess.STDOUT,
+            start_new_session=True)  # own pgid → kill() can killpg
+        try:
+            register_run(ctx.run_id, job.get("name", ""), log_path,
+                         pid=proc.pid)
+        except Exception:  # noqa: BLE001 — runs-db visibility is
+            # best-effort; the queue row is the source of truth
+            logging.exception("pod: runs-db registration failed for %s",
+                              ctx.run_id)
+        return SubprocessJobHandle(proc, ctx, log_file)
+
+
+class CallableJobHandle:
+    def __init__(self, fn: Callable[[JobContext], Any],
+                 ctx: JobContext) -> None:
+        self.ctx = ctx
+        self._fn = fn
+        self._returncode: Optional[int] = None
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"pod-job-{ctx.job_id[:8]}")
+        self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            rc = self._fn(self.ctx)
+            rc = 0 if rc is None else int(rc)
+        except Exception:  # noqa: BLE001 — a crashed workload is FAILED,
+            # never a scheduler crash
+            logging.exception("pod: in-process job %s crashed",
+                              self.ctx.job_id)
+            rc = 1
+        self._returncode = rc
+
+    def poll(self) -> Optional[int]:
+        if self._thread.is_alive():
+            return None
+        self._thread.join(timeout=0)
+        return self._returncode
+
+    def drain(self) -> None:
+        signal_drain(self.ctx.drain_path)
+
+    def kill(self) -> None:
+        # cooperative only: raise the drain flag and let the workload
+        # observe it — there is no safe way to kill a Python thread
+        signal_drain(self.ctx.drain_path)
+
+
+class CallableJobRunner:
+    """In-process runner: maps job name → workload callable.  A workload
+    returns its exit code (``PREEMPTED_EXIT_CODE`` after a drain-file
+    round-boundary exit) or raises to report failure."""
+
+    def __init__(self, workloads: Dict[str, Callable[[JobContext], Any]]
+                 ) -> None:
+        self.workloads = dict(workloads)
+
+    def start(self, job: Dict[str, Any], ctx: JobContext,
+              command: str) -> CallableJobHandle:
+        fn = self.workloads.get(job["name"]) or self.workloads.get(
+            job["kind"])
+        if fn is None:
+            raise KeyError(
+                f"no workload registered for job {job['name']!r} "
+                f"(kind {job['kind']!r})")
+        return CallableJobHandle(fn, ctx)
+
+
+__all__ = [
+    "JobContext", "SubprocessJobRunner", "SubprocessJobHandle",
+    "CallableJobRunner", "CallableJobHandle", "signal_drain",
+    "PREEMPTED_EXIT_CODE",
+]

@@ -1,0 +1,312 @@
+"""PodScheduler — the dispatch loop over queue + allocator + resources.
+
+One pass (`step()`) reaps exits, applies cancel/preempt requests, drives
+drains to their grace deadline, asks the `GangAllocator` for a placement
+plan, and dispatches.  `start()` runs the same pass on a background
+thread (the `fedml jobs pod` daemon); tests call `step()` synchronously.
+
+Preemption lifecycle (the "nearly free" path the PR-4 checkpoints buy):
+
+    RUNNING ──drain()──► PREEMPTING ──exit 75──► QUEUED (resume=1)
+       │                     │                      │
+       │                     └─grace exceeded──► kill() → same requeue
+       └─exit 0 during drain──► FINISHED (it just finished first)
+
+The drained server force-saves its `RoundCheckpointer` state at the next
+round boundary before exiting, so the requeued dispatch's
+``--resume-from latest`` loses zero rounds and re-counts zero uploads.
+
+Queue metrics exported from here: ``fedml_job_queue_wait_seconds``,
+``fedml_pod_slot_utilization``, ``fedml_jobs_preempted_total`` plus depth
+/running/eviction series.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ...core.mlops import metrics
+from ..resource_db import ComputeResourceDB
+from .allocator import GangAllocator
+from .jobspec import PREEMPTED_EXIT_CODE, JobState
+from .queue import JobQueue
+from .runners import JobContext, SubprocessJobRunner
+
+_queue_wait = metrics.histogram(
+    "fedml_job_queue_wait_seconds",
+    "Time a job spent QUEUED before its gang was dispatched",
+    labels=("tenant",),
+    buckets=(0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 1800.0, 7200.0))
+_slot_util = metrics.gauge(
+    "fedml_pod_slot_utilization",
+    "Fraction of pod device slots currently allocated to jobs")
+_preempted_total = metrics.counter(
+    "fedml_jobs_preempted_total",
+    "Jobs preempted at a round boundary and requeued with resume",
+    labels=("tenant",))
+_evictions_total = metrics.counter(
+    "fedml_pod_evictions_total",
+    "Preemptions initiated by the allocator for higher-priority jobs",
+    labels=("tenant",))
+_queue_depth = metrics.gauge(
+    "fedml_pod_queue_depth", "Jobs waiting in the QUEUED state")
+_jobs_running = metrics.gauge(
+    "fedml_pod_jobs_running", "Jobs currently dispatched on the pod")
+
+
+class PodScheduler:
+    def __init__(self, queue: JobQueue, resources: ComputeResourceDB,
+                 runner: Optional[Any] = None,
+                 tenant_weights: Optional[Dict[str, float]] = None,
+                 tick_s: float = 0.5, drain_grace_s: float = 60.0,
+                 serving_scaler: Optional[Any] = None) -> None:
+        self.queue = queue
+        self.resources = resources
+        self.runner = runner or SubprocessJobRunner()
+        self.allocator = GangAllocator(tenant_weights)
+        self.tick_s = float(tick_s)
+        self.drain_grace_s = float(drain_grace_s)
+        self.serving_scaler = serving_scaler
+        self.aot_cache_dir = os.path.join(queue.root, "aot_cache")
+        self._lock = threading.Lock()
+        self._handles: Dict[str, Any] = {}
+        self._reservations: Dict[str, int] = {}
+        self._drain_started: Dict[str, float] = {}
+        self._busy_slot_seconds = 0.0
+        self._t0: Optional[float] = None
+        self._last_tick: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> "PodScheduler":
+        # fresh event per start: a rebind, not a cross-thread mutation —
+        # stop() always signals the event the live loop is waiting on
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="pod-scheduler")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.tick_s):
+            try:
+                self.step()
+            except Exception:  # noqa: BLE001 — one bad pass must not
+                # kill the daemon; the queue state is re-read every tick
+                logging.exception("pod: scheduler pass failed")
+
+    # -- accounting -----------------------------------------------------------
+    def aggregate_utilization(self) -> float:
+        """Busy slot-seconds / (total slots × elapsed) since the first
+        step — the soak's headline number."""
+        total = int(self.resources.report()["total"]) or 1
+        with self._lock:
+            if self._t0 is None or self._last_tick is None:
+                return 0.0
+            elapsed = self._last_tick - self._t0
+            busy = self._busy_slot_seconds
+        return busy / (total * elapsed) if elapsed > 0 else 0.0
+
+    def _integrate_busy(self, now: float, in_use: int) -> None:
+        with self._lock:
+            if self._t0 is None:
+                self._t0 = now
+            elif self._last_tick is not None:
+                self._busy_slot_seconds += in_use * (now - self._last_tick)
+            self._last_tick = now
+
+    # -- one scheduling pass --------------------------------------------------
+    def step(self, now: Optional[float] = None) -> Dict[str, Any]:
+        now = time.monotonic() if now is None else now
+        report = self.resources.report()
+        self._integrate_busy(now, int(report["in_use"]))
+        summary: Dict[str, Any] = {"reaped": [], "dispatched": [],
+                                   "draining": [], "evicted": []}
+        self._reap(summary)
+        self._apply_control_requests(now, summary)
+        self._enforce_drain_grace(now)
+        self._place(now, summary)
+        if self.serving_scaler is not None:
+            try:
+                self.serving_scaler.tick()
+            except Exception:  # noqa: BLE001 — scaling is advisory
+                logging.exception("pod: serving scaler tick failed")
+        report = self.resources.report()
+        total = int(report["total"]) or 1
+        _slot_util.set(int(report["in_use"]) / total)
+        _queue_depth.set(len(self.queue.queued()))
+        with self._lock:
+            _jobs_running.set(len(self._handles))
+        summary["free_slots"] = int(report["free"])
+        return summary
+
+    def _reap(self, summary: Dict[str, Any]) -> None:
+        with self._lock:
+            handles = dict(self._handles)
+        for job_id, handle in handles.items():
+            rc = handle.poll()
+            if rc is None:
+                continue
+            self.resources.release(handle.ctx.run_id)
+            job = self.queue.get(job_id)
+            tenant = job["tenant"] if job else "default"
+            draining = bool(job and job["state"] == JobState.PREEMPTING)
+            if job is None:
+                pass
+            elif job["cancel_requested"]:
+                self.queue.mark_finished(job_id, JobState.CANCELLED, rc)
+            elif rc == PREEMPTED_EXIT_CODE or (draining and rc != 0):
+                # a drained job that died non-zero (grace kill, SIGTERM)
+                # still resumes from its last boundary checkpoint — the
+                # checkpoint is written on every accepted upload, so even
+                # a hard kill loses no completed round
+                self.queue.requeue_preempted(job_id, rc)
+                _preempted_total.labels(tenant=tenant).inc()
+            elif rc == 0:
+                self.queue.mark_finished(job_id, JobState.FINISHED, 0)
+            else:
+                self.queue.mark_finished(job_id, JobState.FAILED, rc)
+            with self._lock:
+                self._handles.pop(job_id, None)
+                self._drain_started.pop(job_id, None)
+            try:
+                os.remove(handle.ctx.drain_path)
+            except OSError:
+                pass
+            summary["reaped"].append((job_id, rc))
+
+    def _apply_control_requests(self, now: float,
+                                summary: Dict[str, Any]) -> None:
+        for job in self.queue.active():
+            job_id = job["job_id"]
+            with self._lock:
+                handle = self._handles.get(job_id)
+            if handle is None:
+                continue
+            if job["cancel_requested"]:
+                handle.kill()
+            elif (job["state"] == JobState.RUNNING
+                  and job["preempt_requested"]):
+                self._drain(job, handle, now, summary)
+
+    def _drain(self, job: Dict[str, Any], handle: Any, now: float,
+               summary: Dict[str, Any]) -> None:
+        handle.drain()
+        self.queue.mark_preempting(job["job_id"])
+        with self._lock:
+            self._drain_started.setdefault(job["job_id"], now)
+        summary["draining"].append(job["job_id"])
+
+    def _enforce_drain_grace(self, now: float) -> None:
+        with self._lock:
+            drains = dict(self._drain_started)
+        for job_id, t0 in drains.items():
+            if now - t0 <= self.drain_grace_s:
+                continue
+            with self._lock:
+                handle = self._handles.get(job_id)
+            if handle is not None:
+                logging.warning(
+                    "pod: job %s exceeded drain grace (%.0fs) — killing",
+                    job_id, self.drain_grace_s)
+                handle.kill()
+
+    def _place(self, now: float, summary: Dict[str, Any]) -> None:
+        queued = self.queue.queued()
+        running = self.queue.active()
+        queued_ids = {j["job_id"] for j in queued}
+        with self._lock:
+            # reservations for jobs that left the queue (dispatched,
+            # cancelled) are dead — drop them before planning
+            stale = [jid for jid in self._reservations
+                     if jid not in queued_ids]
+            for jid in stale:
+                self._reservations.pop(jid, None)
+            reserved = dict(self._reservations)
+        free = len(self.resources.available_slots())
+        plan = self.allocator.plan(queued, running, free, reserved)
+        for victim in plan.evict:
+            with self._lock:
+                handle = self._handles.get(victim["job_id"])
+            if handle is not None:
+                self._drain(victim, handle, now, summary)
+                _evictions_total.labels(tenant=victim["tenant"]).inc()
+                summary["evicted"].append(victim["job_id"])
+        with self._lock:
+            self._reservations.update(plan.reserve)
+        for job in plan.dispatch:
+            if self._dispatch(job):
+                summary["dispatched"].append(job["job_id"])
+
+    def _dispatch(self, job: Dict[str, Any]) -> bool:
+        run_id = uuid.uuid4().hex[:12]
+        slots = self.resources.allocate(run_id, int(job["n_slots"]))
+        if not slots:
+            return False  # lost a race against another dispatcher
+        job_id = job["job_id"]
+        drain_path = os.path.join(self.queue.root, "drain",
+                                  f"{run_id}.drain")
+        log_dir = os.path.join(self.queue.root, "logs", job_id, run_id)
+        env = {
+            "FEDML_TPU_DRAIN_FILE": drain_path,
+            "FEDML_TPU_LOG_DIR": log_dir,
+            "FEDML_TPU_AOT_CACHE_DIR": self.aot_cache_dir,
+            "FEDML_CURRENT_RUN_ID": run_id,
+            "FEDML_TPU_JOB_ID": job_id,
+            "FEDML_TPU_JOB_TENANT": str(job["tenant"]),
+            "FEDML_TPU_SLOTS": ",".join(str(s) for s in slots),
+        }
+        env.update(job["env"])
+        ctx = JobContext(job_id, run_id, slots, env,
+                         resume=bool(job["resume"]),
+                         drain_path=drain_path, log_dir=log_dir)
+        command = str(job["command"]).replace(
+            "{resume}",
+            "--resume-from latest" if job["resume"] else "").strip()
+        try:
+            handle = self.runner.start(job, ctx, command)
+        except Exception:  # noqa: BLE001 — a bad job spec must not take
+            # the scheduler down with it
+            logging.exception("pod: dispatch of %s failed", job_id)
+            self.resources.release(run_id)
+            self.queue.mark_finished(job_id, JobState.FAILED, None)
+            return False
+        pid = getattr(getattr(handle, "proc", None), "pid", None)
+        self.resources.set_pid(run_id, pid if pid else os.getpid())
+        self.queue.mark_dispatched(job_id, run_id, slots, log_dir)
+        wait_s = max(0.0, time.time() - float(job["submitted_ts"] or 0.0))
+        _queue_wait.labels(tenant=str(job["tenant"])).observe(wait_s)
+        with self._lock:
+            self._handles[job_id] = handle
+            self._reservations.pop(job_id, None)
+        logging.info("pod: dispatched %s (%s/%s, %d slots, run %s%s)",
+                     job["name"], job["tenant"], job["kind"], len(slots),
+                     run_id, ", resume" if job["resume"] else "")
+        return True
+
+    # -- conveniences ---------------------------------------------------------
+    def run_until_idle(self, timeout_s: float = 300.0,
+                       poll_s: float = 0.05) -> bool:
+        """Synchronously step until the queue drains (no QUEUED and no
+        active jobs).  Returns False on timeout.  Test/driver helper —
+        the daemon uses `start()` instead."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            self.step()
+            stats = self.queue.stats()
+            if not any(stats.get(s, 0) for s in JobState.ACTIVE):
+                return True
+            time.sleep(poll_s)
+        return False

@@ -1,0 +1,122 @@
+"""Serving-job replica scaler: decode telemetry → gang-size demand.
+
+Bridges two existing planes: the serving engine's decode histogram
+(``fedml_llm_decode_step_seconds``, exported per model since the serving
+PR) and the `ReplicaAutoscaler` policy (scale up fast on latency/qps
+breach, shrink slowly with cooldown).  Each pod *serving* job gets its
+own autoscaler; the decision lands on the job queue:
+
+* job still QUEUED → ``update_slots`` resizes the gang before dispatch;
+* job RUNNING with the wrong slot count → ``request_preempt`` so the
+  scheduler drains it at a safe boundary and the requeued row is resized
+  before its next dispatch.
+
+No threads of its own — `PodScheduler.step()` ticks it, so all metric
+reads and queue writes happen on the scheduler's pass.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+from ...core.mlops import metrics
+from ..autoscaler import AutoscalePolicy, ReplicaAutoscaler
+from .jobspec import KIND_SERVING, JobState
+from .queue import JobQueue
+
+DECODE_METRIC = "fedml_llm_decode_step_seconds"
+
+
+class ServingReplicaScaler:
+    def __init__(self, queue: JobQueue,
+                 policy: Optional[AutoscalePolicy] = None,
+                 registry: Optional[metrics.MetricsRegistry] = None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.queue = queue
+        self.policy = policy or AutoscalePolicy()
+        self.registry = registry
+        self.clock = clock
+        self._scalers: Dict[str, ReplicaAutoscaler] = {}
+        self._pending_resize: Dict[str, int] = {}
+        self._last_sum = 0.0
+        self._last_count = 0
+        self._last_t: Optional[float] = None
+
+    def _decode_window(self) -> Optional[Dict[str, float]]:
+        """Aggregate qps / mean step latency from the decode histogram
+        delta since the previous tick (all label children summed — the
+        pod scales on total serving pressure)."""
+        registry = self.registry or metrics.REGISTRY
+        metric = registry.collect().get(DECODE_METRIC)
+        now = self.clock()
+        if metric is None:
+            self._last_t = now
+            return None
+        total_sum, total_count = 0.0, 0
+        for child in metric.children().values():
+            _, h_sum, h_count = child.snapshot()
+            total_sum += h_sum
+            total_count += h_count
+        if self._last_t is None:
+            # first tick: establish the baseline, no window yet
+            self._last_sum, self._last_count = total_sum, total_count
+            self._last_t = now
+            return None
+        dt = max(now - self._last_t, 1e-9)
+        d_count = max(total_count - self._last_count, 0)
+        d_sum = max(total_sum - self._last_sum, 0.0)
+        self._last_sum, self._last_count = total_sum, total_count
+        self._last_t = now
+        return {
+            "qps": d_count / dt,
+            "latency_s": (d_sum / d_count) if d_count else 0.0,
+        }
+
+    def _scaler_for(self, job_id: str) -> ReplicaAutoscaler:
+        scaler = self._scalers.get(job_id)
+        if scaler is None:
+            scaler = self._scalers[job_id] = ReplicaAutoscaler(
+                policy=self.policy, clock=self.clock)
+        return scaler
+
+    def tick(self) -> Dict[str, int]:
+        """One scaling pass; returns job_id → desired slots (for tests
+        and the daemon's status line)."""
+        window = self._decode_window()
+        decisions: Dict[str, int] = {}
+        serving = [j for j in self.queue.list_jobs()
+                   if j["kind"] == KIND_SERVING
+                   and j["state"] in JobState.ACTIVE]
+        live_ids = {j["job_id"] for j in serving}
+        for stale in [jid for jid in self._scalers
+                      if jid not in live_ids]:
+            self._scalers.pop(stale, None)
+            self._pending_resize.pop(stale, None)
+        # land resizes pledged while the job was still draining
+        for job in serving:
+            want = self._pending_resize.get(job["job_id"])
+            if want is not None and job["state"] == JobState.QUEUED:
+                if self.queue.update_slots(job["job_id"], want):
+                    self._pending_resize.pop(job["job_id"], None)
+        if window is None:
+            return decisions
+        for job in serving:
+            scaler = self._scaler_for(job["job_id"])
+            scaler.replicas = max(int(job["n_slots"]),
+                                  self.policy.min_replicas)
+            want = scaler.observe(window["qps"], window["latency_s"])
+            decisions[job["job_id"]] = want
+            if want == int(job["n_slots"]):
+                continue
+            if job["state"] == JobState.QUEUED:
+                self.queue.update_slots(job["job_id"], want)
+            elif job["state"] == JobState.RUNNING:
+                # resize via the safe path: drain at a boundary, then
+                # apply the new gang size to the requeued row above
+                self.queue.request_preempt(job["job_id"])
+                self._pending_resize[job["job_id"]] = want
+        return decisions
+
+
+__all__ = ["ServingReplicaScaler", "DECODE_METRIC"]

@@ -39,7 +39,12 @@ class ComputeResourceDB:
             self.conn.execute(
                 "CREATE TABLE IF NOT EXISTS devices ("
                 "slot INTEGER PRIMARY KEY, kind TEXT, hbm_gb REAL, "
-                "run_id TEXT, allocated_ts REAL)")
+                "run_id TEXT, allocated_ts REAL, pid INTEGER)")
+            cols = [r[1] for r in self.conn.execute(
+                "PRAGMA table_info(devices)").fetchall()]
+            if "pid" not in cols:  # pre-pod dbs lack the owner pid
+                self.conn.execute(
+                    "ALTER TABLE devices ADD COLUMN pid INTEGER")
         if total_slots is not None:
             self.register_devices(total_slots)
         elif not self.list_devices():
@@ -63,24 +68,25 @@ class ComputeResourceDB:
         with _LOCK, self.conn:
             for i, (k, h) in enumerate(zip(kinds, hbm)):
                 self.conn.execute(
-                    "INSERT OR IGNORE INTO devices VALUES (?,?,?,NULL,NULL)",
-                    (i, k, h))
+                    "INSERT OR IGNORE INTO devices VALUES "
+                    "(?,?,?,NULL,NULL,NULL)", (i, k, h))
 
     def register_devices(self, n: int, kind: str = "slot",
                          hbm_gb: float = 0.0) -> None:
         with _LOCK, self.conn:
             for i in range(n):
                 self.conn.execute(
-                    "INSERT OR IGNORE INTO devices VALUES (?,?,?,NULL,NULL)",
-                    (i, kind, hbm_gb))
+                    "INSERT OR IGNORE INTO devices VALUES "
+                    "(?,?,?,NULL,NULL,NULL)", (i, kind, hbm_gb))
 
     def list_devices(self) -> List[Dict[str, Any]]:
         with _LOCK:
             rows = self.conn.execute(
-                "SELECT slot, kind, hbm_gb, run_id, allocated_ts "
+                "SELECT slot, kind, hbm_gb, run_id, allocated_ts, pid "
                 "FROM devices ORDER BY slot").fetchall()
         return [{"slot": r[0], "kind": r[1], "hbm_gb": r[2],
-                 "run_id": r[3], "allocated_ts": r[4]} for r in rows]
+                 "run_id": r[3], "allocated_ts": r[4], "pid": r[5]}
+                for r in rows]
 
     def available_slots(self) -> List[int]:
         with _LOCK:
@@ -89,10 +95,13 @@ class ComputeResourceDB:
                 "ORDER BY slot").fetchall()
         return [r[0] for r in rows]
 
-    def allocate(self, run_id: str, n_slots: int = 1) -> List[int]:
+    def allocate(self, run_id: str, n_slots: int = 1,
+                 pid: Optional[int] = None) -> List[int]:
         """Atomically claim ``n_slots`` free slots for ``run_id`` —
         cross-process safe (BEGIN IMMEDIATE write lock + run_id IS NULL
-        guard).  Returns [] (allocating nothing) if not enough are free."""
+        guard).  Returns [] (allocating nothing) if not enough are free.
+        ``pid`` records the owning process so a crashed owner's slots can
+        be reclaimed without waiting out the age cutoff."""
         with _LOCK:
             try:
                 self.conn.execute("BEGIN IMMEDIATE")
@@ -107,9 +116,9 @@ class ComputeResourceDB:
                 claimed = 0
                 for s in slots:
                     cur = self.conn.execute(
-                        "UPDATE devices SET run_id=?, allocated_ts=? "
-                        "WHERE slot=? AND run_id IS NULL",
-                        (str(run_id), now, s))
+                        "UPDATE devices SET run_id=?, allocated_ts=?, "
+                        "pid=? WHERE slot=? AND run_id IS NULL",
+                        (str(run_id), now, pid, s))
                     claimed += cur.rowcount
                 if claimed < n_slots:
                     self.conn.execute("ROLLBACK")
@@ -123,22 +132,59 @@ class ComputeResourceDB:
                 return []
         return slots
 
+    def set_pid(self, run_id: str, pid: Optional[int]) -> int:
+        """Record (or update) the owner pid after the job process exists
+        — allocation happens before the spawn, so the dispatcher calls
+        this once it knows the child's pid."""
+        with _LOCK, self.conn:
+            cur = self.conn.execute(
+                "UPDATE devices SET pid=? WHERE run_id=?",
+                (pid, str(run_id)))
+        return cur.rowcount
+
     def release(self, run_id: str) -> int:
         with _LOCK, self.conn:
             cur = self.conn.execute(
-                "UPDATE devices SET run_id=NULL, allocated_ts=NULL "
-                "WHERE run_id=?", (str(run_id),))
+                "UPDATE devices SET run_id=NULL, allocated_ts=NULL, "
+                "pid=NULL WHERE run_id=?", (str(run_id),))
         return cur.rowcount
 
+    @staticmethod
+    def _pid_alive(pid: Optional[int]) -> bool:
+        if not pid:
+            return True  # unknown owner: only the age cutoff applies
+        try:
+            os.kill(int(pid), 0)
+        except ProcessLookupError:
+            return False
+        except (PermissionError, OSError, ValueError):
+            return True  # exists but not ours (or bogus value): keep it
+        return True
+
     def reclaim_stale(self, max_age_s: float = 24 * 3600.0) -> int:
-        """Free slots whose allocation outlived ``max_age_s`` (crash
-        recovery; reference job_monitor cleanup)."""
+        """Free slots whose allocation outlived ``max_age_s`` OR whose
+        recorded owner pid is dead (crash recovery; reference job_monitor
+        cleanup — a killed run must not pin its slice for a day)."""
         cutoff = time.time() - max_age_s
+        with _LOCK:
+            rows = self.conn.execute(
+                "SELECT DISTINCT run_id, pid FROM devices "
+                "WHERE run_id IS NOT NULL").fetchall()
+        dead = [run_id for run_id, pid in rows
+                if not self._pid_alive(pid)]
+        freed = 0
         with _LOCK, self.conn:
             cur = self.conn.execute(
-                "UPDATE devices SET run_id=NULL, allocated_ts=NULL "
-                "WHERE run_id IS NOT NULL AND allocated_ts < ?", (cutoff,))
-        return cur.rowcount
+                "UPDATE devices SET run_id=NULL, allocated_ts=NULL, "
+                "pid=NULL WHERE run_id IS NOT NULL AND allocated_ts < ?",
+                (cutoff,))
+            freed += cur.rowcount
+            for run_id in dead:
+                cur = self.conn.execute(
+                    "UPDATE devices SET run_id=NULL, allocated_ts=NULL, "
+                    "pid=NULL WHERE run_id=?", (run_id,))
+                freed += cur.rowcount
+        return freed
 
     def close(self) -> None:
         with _LOCK:

@@ -676,12 +676,18 @@ class ParrotAPI:
         build the trace — so a stale artifact can never be replayed."""
         if not bool(getattr(self.args, "parrot_aot_cache", True)):
             return None
+        import hashlib
+        import os
+
+        # FEDML_TPU_AOT_CACHE_DIR is the pod scheduler's compile-sharing
+        # contract: every job dispatched on the pod points here, so one
+        # tenant's parrot compile is a digest-keyed cache hit for the
+        # next job with the same executable shape.  Explicit config wins.
         base = (getattr(self.args, "aot_cache_dir", None)
+                or os.environ.get("FEDML_TPU_AOT_CACHE_DIR")
                 or jax.config.jax_compilation_cache_dir)
         if not base:
             return None
-        import hashlib
-        import os
 
         h = hashlib.sha256()
         h.update(jax.__version__.encode())

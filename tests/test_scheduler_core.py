@@ -1,0 +1,160 @@
+"""Scheduler substrate under the pod: the local launcher's runs db and
+the ComputeResourceDB the gang allocator spends (race-safe allocate/
+release, dead-owner reclamation, legacy-schema migration)."""
+
+import os
+import signal
+import sqlite3
+import subprocess
+import threading
+import time
+
+import pytest
+
+from fedml_tpu.scheduler import local_launcher
+from fedml_tpu.scheduler.resource_db import ComputeResourceDB
+
+
+@pytest.fixture
+def home(tmp_path, monkeypatch):
+    """The launcher's runs db lives under ~/.fedml_tpu — isolate it."""
+    monkeypatch.setenv("HOME", str(tmp_path))
+    return tmp_path
+
+
+# ------------------------------------------------------- local launcher
+def test_runs_db_register_update_list_roundtrip(home):
+    local_launcher.register_run("run_a", "job-a", "/tmp/a.log", pid=1234)
+    run = local_launcher.get_run("run_a")
+    assert run["status"] == "RUNNING" and run["pid"] == 1234
+    assert run["job_name"] == "job-a" and run["finished"] is None
+
+    local_launcher.update_run_status("run_a", "FINISHED", returncode=0)
+    run = local_launcher.get_run("run_a")
+    assert run["status"] == "FINISHED" and run["returncode"] == 0
+    assert run["finished"] is not None
+
+    local_launcher.register_run("run_b", "job-b", "/tmp/b.log")
+    runs = local_launcher.list_runs()
+    assert [r["run_id"] for r in runs[:2]] == ["run_b", "run_a"]
+    assert local_launcher.get_run("nope") is None
+
+
+def test_stop_run_kills_live_process_group(home):
+    proc = subprocess.Popen(["sleep", "30"], start_new_session=True)
+    try:
+        local_launcher.register_run("run_s", "sleeper", "/tmp/s.log",
+                                    pid=proc.pid)
+        assert local_launcher.stop_run("run_s")
+        assert proc.wait(timeout=10) == -signal.SIGTERM
+        run = local_launcher.get_run("run_s")
+        assert run["status"] == "KILLED" and run["returncode"] == -15
+        # not RUNNING any more → refuses instead of re-signalling the pid
+        assert not local_launcher.stop_run("run_s")
+        assert not local_launcher.stop_run("missing")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def test_launch_job_local_roundtrip(home, tmp_path):
+    job = tmp_path / "job.yaml"
+    job.write_text("workspace: .\njob_name: hello\n"
+                   "job: echo launched-ok\n")
+    res = local_launcher.launch_job_local(str(job))
+    assert res.returncode == 0
+    assert "launched-ok" in open(res.log_path).read()
+    assert local_launcher.get_run(res.run_id)["status"] == "FINISHED"
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("workspace: .\njob_name: broken\njob: exit 3\n")
+    res2 = local_launcher.launch_job_local(str(bad))
+    assert res2.returncode == 3
+    assert local_launcher.get_run(res2.run_id)["status"] == "FAILED"
+
+
+# ------------------------------------------------------- resource db
+def test_allocate_release_symmetry(tmp_path):
+    db = ComputeResourceDB(str(tmp_path), total_slots=4)
+    slots = db.allocate("r1", 3)
+    assert slots == [0, 1, 2]
+    assert db.report() == dict(db.report(), total=4, free=1, in_use=3)
+    # gang does not fit → nothing is claimed (no partial allocation)
+    assert db.allocate("r2", 2) == []
+    assert db.report()["free"] == 1
+    assert db.release("r1") == 3
+    assert db.available_slots() == [0, 1, 2, 3]
+    assert db.release("r1") == 0   # idempotent
+    db.close()
+
+
+def test_allocate_is_race_safe_across_threads(tmp_path):
+    db = ComputeResourceDB(str(tmp_path), total_slots=4)
+    results = {}
+    start = threading.Barrier(8)
+
+    def worker(i):
+        start.wait()
+        results[i] = db.allocate(f"r{i}", 1, pid=os.getpid())
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    won = [s for s in results.values() if s]
+    assert len(won) == 4 and len([s for s in results.values() if not s]) == 4
+    claimed = [s for slots in won for s in slots]
+    assert sorted(claimed) == [0, 1, 2, 3]  # no slot double-assigned
+    db.close()
+
+
+def test_reclaim_frees_dead_pid_but_keeps_live_owner(tmp_path):
+    db = ComputeResourceDB(str(tmp_path), total_slots=4)
+    proc = subprocess.Popen(["sleep", "30"], start_new_session=True)
+    try:
+        assert db.allocate("alive", 2, pid=proc.pid)
+        dead = subprocess.Popen(["true"])
+        assert db.allocate("dead", 2) and db.set_pid("dead", dead.pid) == 2
+        dead.wait()               # reap — a zombie still answers kill(pid, 0)
+        assert ComputeResourceDB._pid_alive(dead.pid) is False
+        assert db.reclaim_stale() == 2
+        report = db.report()
+        assert report["free"] == 2 and report["in_use"] == 2
+        assert {d["run_id"] for d in report["devices"]
+                if d["run_id"]} == {"alive"}
+        # owner dies → its slots come back too
+        proc.terminate()
+        proc.wait(timeout=10)
+        assert db.reclaim_stale() == 2
+        assert db.report()["free"] == 4
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    db.close()
+
+
+def test_reclaim_age_cutoff_applies_without_pid(tmp_path):
+    db = ComputeResourceDB(str(tmp_path), total_slots=2)
+    assert db.allocate("old", 2)          # no pid → only the age cutoff
+    assert db.reclaim_stale(max_age_s=3600) == 0
+    db.conn.execute("UPDATE devices SET allocated_ts = allocated_ts - 7200")
+    assert db.reclaim_stale(max_age_s=3600) == 2
+    assert db.report()["free"] == 2
+    db.close()
+
+
+def test_legacy_schema_gains_pid_column(tmp_path):
+    legacy = sqlite3.connect(os.path.join(str(tmp_path), "resources.db"))
+    legacy.execute(
+        "CREATE TABLE devices (slot INTEGER PRIMARY KEY, kind TEXT, "
+        "hbm_gb REAL, run_id TEXT, allocated_ts REAL)")
+    legacy.execute("INSERT INTO devices VALUES (0,'slot',0.0,NULL,NULL)")
+    legacy.commit()
+    legacy.close()
+    db = ComputeResourceDB(str(tmp_path))
+    assert [d["pid"] for d in db.list_devices()] == [None]
+    assert db.allocate("r", 1, pid=os.getpid()) == [0]
+    assert db.list_devices()[0]["pid"] == os.getpid()
+    db.close()
