@@ -1,12 +1,21 @@
-"""Regenerate benchmarks/northstar_client_sizes.json — the per-client
+"""Regenerate the committed client-size histograms under benchmarks/.
+
+Default mode: benchmarks/northstar_client_sizes.json — the per-client
 sample histogram of the north-star bench partition, consumed by the
 PERF003 padding-waste lint (fedml_tpu/analysis/perf) so `fedml lint
 --perf` can audit the size-bucket policy without touching the dataset.
 
-Deterministic: the histogram depends only on the committed synthetic-CIFAR
-generator (gen_northstar_cifar.py, DATA_VERSION) and the seeded
-Dirichlet(0.5) partition, so re-running after a data-version bump is the
-only time this file changes.
+``--hyperscale [N]`` mode: benchmarks/hyperscale_client_sizes.json — a
+heavy-tailed (bounded-Pareto, Zipf-ish) population of N clients
+(default 100k) for the hyper-scale streaming bench and its PERF003
+audit.  The bucket-cap policy of record is asserted to hold ≥99% slot
+utilization on the scaled histogram before the file is written.
+
+Deterministic: the default histogram depends only on the committed
+synthetic-CIFAR generator (gen_northstar_cifar.py, DATA_VERSION) and the
+seeded Dirichlet(0.5) partition; the hyperscale histogram only on the
+counter-based `zipf_sizes` generator — re-running after a data-version
+or generator change is the only time these files change.
 """
 
 import json
@@ -21,6 +30,19 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 NPZ = os.path.join(ROOT, ".data_cache", "northstar", "cifar10.npz")
 OUT = os.path.join(HERE, "northstar_client_sizes.json")
+OUT_HYPER = os.path.join(HERE, "hyperscale_client_sizes.json")
+
+# the hyperscale bench's policy of record (bench.py --hyperscale and the
+# streaming entrypoint's PERF003 audit read exactly these knobs)
+HYPER_POLICY = {
+    "client_num_per_round": 1024,
+    "batch_size": 32,
+    "hetero_buckets": 32,
+    "hetero_bucket_cap": 0.6,
+    "zipf_exponent": 1.2,
+    "min_size": 64,
+    "max_size": 4096,
+}
 
 
 def main() -> None:
@@ -64,5 +86,50 @@ def main() -> None:
     print(json.dumps({"out": OUT, "n": sum(sizes)}))
 
 
+def main_hyperscale(n_clients: int) -> None:
+    import numpy as np
+
+    from fedml_tpu.data.population import zipf_sizes
+    from fedml_tpu.simulation.parrot.parrot_api import bucket_plan
+
+    p = HYPER_POLICY
+    sizes = zipf_sizes(n_clients, seed=0, exponent=p["zipf_exponent"],
+                       min_size=p["min_size"], max_size=p["max_size"])
+    plan = bucket_plan(np.asarray(sizes), p["client_num_per_round"],
+                       p["batch_size"], p["hetero_buckets"],
+                       p["hetero_bucket_cap"])
+    padded = sum(b["padded"] for b in plan)
+    real = sum(b["real"] for b in plan)
+    util = real / padded
+    assert util >= 0.99, (
+        f"bucket-cap policy holds only {util:.4f} slot utilization on the "
+        f"scaled histogram (need >=0.99) — retune HYPER_POLICY")
+    payload = {
+        "description": "Heavy-tailed (bounded-Pareto) per-client sample "
+                       "counts for the hyper-scale streaming bench "
+                       "(bench.py --hyperscale) and its PERF003 padding "
+                       "audit — regenerable with "
+                       "gen_northstar_client_sizes.py --hyperscale",
+        "generator": "fedml_tpu.data.population.zipf_sizes",
+        "random_seed": 0,
+        "client_num_in_total": n_clients,
+        **p,
+        "slot_utilization": round(util, 4),
+        "sizes": [int(s) for s in sizes],
+    }
+    with open(OUT_HYPER, "w") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    print(json.dumps({"out": OUT_HYPER, "n_clients": n_clients,
+                      "total_samples": int(sizes.sum()),
+                      "slot_utilization": round(util, 4)}))
+
+
 if __name__ == "__main__":
-    main()
+    if "--hyperscale" in sys.argv:
+        i = sys.argv.index("--hyperscale")
+        n = (int(sys.argv[i + 1]) if len(sys.argv) > i + 1
+             and sys.argv[i + 1].isdigit() else 100_000)
+        main_hyperscale(n)
+    else:
+        main()

@@ -125,6 +125,14 @@ _DEFAULTS: Dict[str, Any] = {
     # FEDML_TPU_FLIGHT_RECORDER=1 overrides
     "flight_recorder": False,
     "flight_max_records": 0,         # 0 → module default (4096)
+    # hyper-scale simulation (backend="hyperscale", docs/HYPERSCALE.md):
+    # double-buffered host→device cohort streaming over a virtual
+    # 10⁵–10⁶-client population
+    "stream_prefetch": 2,            # >=2 double-buffers; 1 = sequential
+    "cohort_sampling": None,         # reference | hierarchical (auto)
+    "availability_trace": None,      # None | "diurnal:<duty>:<period>"
+    "population_sizes_path": None,   # JSON {"sizes": [...]} per-client sizes
+    "population_virtual_threshold": 2048,  # N above this → virtual population
     # precision / engine
     "dtype": "float32",
     "compute_dtype": "bfloat16",

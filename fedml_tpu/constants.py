@@ -25,10 +25,14 @@ TRAINING_PLATFORM_SERVING = "fedml_serving"
 SIMULATION_BACKEND_SP = "sp"
 SIMULATION_BACKEND_PARROT = "parrot"
 SIMULATION_BACKEND_MESH = "mesh"
+# hyperscale — streamed cohorts over a virtual 10⁵–10⁶-client population
+# (double-buffered host→device staging, sharded per-client state)
+SIMULATION_BACKEND_HYPERSCALE = "hyperscale"
 SIMULATION_BACKENDS = (
     SIMULATION_BACKEND_SP,
     SIMULATION_BACKEND_PARROT,
     SIMULATION_BACKEND_MESH,
+    SIMULATION_BACKEND_HYPERSCALE,
 )
 
 # Cross-silo / distributed transports (reference: fedml_comm_manager.py:131-209)

@@ -1,0 +1,182 @@
+"""Virtual client populations for hyper-scale simulation.
+
+The standard loader (`data_loader.load`) materializes one ``(x, y)``
+tuple per client — fine at 10²–10³ clients, fatal at 10⁵–10⁶: a million
+dict entries plus a ``[N, cap]`` row-index matrix is gigabytes of host
+memory before a single round runs.  A :class:`ClientPopulation` instead
+keeps ONE base array pair and derives each client's row indices
+**lazily** from a counter-based RNG (Philox keyed by a sha256 digest of
+``(seed, cid)``), so the only O(N) state is the ``sizes`` vector
+(~4 MB at 10⁶ clients int32).  Determinism is positional, not
+sequential: client 734_211's rows are the same whether it is the first
+client ever solicited or the millionth, which is what makes
+crash-resume and distributed cohort assembly reproducible.
+
+Mirrors FedJAX's ``ClientDataset``-over-shared-arrays idiom (arxiv
+2108.02117) without materializing the per-client views.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "ClientPopulation",
+    "philox_generator",
+    "zipf_sizes",
+    "load_population",
+]
+
+
+def philox_generator(*parts: Any) -> np.random.Generator:
+    """Counter-based generator keyed by a sha256 digest of ``parts``.
+
+    sha256 (not python ``hash()``, which is salted per-process) so the
+    stream for a given ``(run_id, seed, round)`` or ``(seed, cid)`` is
+    identical across processes, hosts and restarts."""
+    digest = hashlib.sha256(
+        ":".join(str(p) for p in parts).encode()).digest()
+    key = int.from_bytes(digest[:16], "little")
+    return np.random.Generator(np.random.Philox(key=key))
+
+
+def zipf_sizes(n_clients: int, seed: int = 0, exponent: float = 1.2,
+               min_size: int = 8, max_size: int = 4096) -> np.ndarray:
+    """Heavy-tailed per-client dataset sizes (Zipf-ish, bounded).
+
+    Real federated populations are dominated by small clients with a
+    long tail of heavy ones (LEAF, Parrot §4); a bounded power law over
+    ranks reproduces that histogram deterministically."""
+    if n_clients <= 0:
+        return np.zeros(0, np.int64)
+    g = philox_generator("zipf_sizes", seed, n_clients, exponent)
+    # bounded Pareto: size = min·(1-u)^(-1/α) clipped at max — the bulk
+    # sits near min with a polynomial tail (top 1% of clients hold ~16%
+    # of all samples at α=1.2)
+    u = g.random(n_clients)
+    sizes = min_size * (1.0 - u) ** (-1.0 / float(exponent))
+    return np.clip(np.round(sizes), min_size, max_size).astype(np.int64)
+
+
+class ClientPopulation:
+    """A (possibly virtual) population of simulated clients over one
+    shared base array pair.
+
+    Two construction modes:
+
+    - :meth:`from_dataset` wraps the standard loader's output — every
+      client's rows come from ``args.client_row_map``, so trajectories
+      are bit-identical to the device-resident ParrotAPI path.  Used for
+      parity configs and any population that fits the loader.
+    - :meth:`virtual` scales to 10⁵–10⁶ clients: client ``cid`` draws
+      ``sizes[cid]`` rows from the base arrays via a Philox stream keyed
+      on ``(seed, cid)`` — computed on demand, never stored.
+    """
+
+    def __init__(self, x: np.ndarray, y: np.ndarray, sizes: np.ndarray,
+                 rows_fn: Callable[[int], np.ndarray],
+                 test: Tuple[np.ndarray, np.ndarray],
+                 class_num: int, virtual: bool, seed: int = 0):
+        self.x = x
+        self.y = y
+        self.sizes = np.asarray(sizes, np.int64)
+        self.n_clients = int(len(self.sizes))
+        self._rows_fn = rows_fn
+        self.test = test
+        self.class_num = int(class_num)
+        self.virtual = bool(virtual)
+        self.seed = int(seed)
+
+    def rows(self, cid: int) -> np.ndarray:
+        """Row indices into ``self.x``/``self.y`` for one client."""
+        return self._rows_fn(int(cid))
+
+    @property
+    def total_samples(self) -> int:
+        return int(self.sizes.sum())
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_dataset(cls, args: Any, dataset: Tuple) -> "ClientPopulation":
+        """Parity mode: identical client→row mapping to the standard
+        loader (requires ``args.client_row_map``, set by ``data.load``)."""
+        (_tn, _te, train_global, test_global, local_num,
+         _trl, _tel, class_num) = dataset
+        row_map: Dict[int, np.ndarray] = getattr(args, "client_row_map")
+        n = int(getattr(args, "client_num_in_total", len(row_map)))
+        sizes = np.asarray([len(row_map[c]) for c in range(n)], np.int64)
+        x, y = train_global
+        return cls(np.asarray(x), np.asarray(y), sizes,
+                   lambda cid: np.asarray(row_map[cid], np.int64),
+                   (np.asarray(test_global[0]), np.asarray(test_global[1])),
+                   class_num, virtual=False,
+                   seed=int(getattr(args, "random_seed", 0) or 0))
+
+    @classmethod
+    def virtual(cls, x: np.ndarray, y: np.ndarray, sizes: np.ndarray,
+                test: Tuple[np.ndarray, np.ndarray], class_num: int,
+                seed: int = 0) -> "ClientPopulation":
+        """Lazy population: rows for client ``cid`` are a deterministic
+        function of ``(seed, cid)`` — nothing per-client is stored."""
+        x = np.asarray(x)
+        y = np.asarray(y)
+        n_rows = int(len(y))
+        sizes = np.asarray(sizes, np.int64)
+
+        def rows_fn(cid: int) -> np.ndarray:
+            g = philox_generator("client_rows", seed, cid)
+            return g.integers(0, n_rows, size=int(sizes[cid]),
+                              dtype=np.int64)
+
+        return cls(x, y, sizes, rows_fn, test, class_num,
+                   virtual=True, seed=seed)
+
+
+def load_population(args: Any,
+                    dataset: Optional[Tuple] = None) -> ClientPopulation:
+    """Population for the hyper-scale backend.
+
+    ``population_sizes_path`` or ``client_num_in_total`` above the
+    loader-materialization threshold selects a virtual population over
+    the base arrays of the (small) source dataset; otherwise the
+    standard loader's partition is wrapped 1:1 for parity."""
+    import json
+    from . import data_loader
+
+    n = int(getattr(args, "client_num_in_total", 10))
+    sizes_path = getattr(args, "population_sizes_path", None)
+    threshold = int(getattr(args, "population_virtual_threshold", 2048))
+
+    if sizes_path:
+        with open(sizes_path) as f:
+            payload = json.load(f)
+        sizes = np.asarray(payload["sizes"] if isinstance(payload, dict)
+                           else payload, np.int64)
+        n = len(sizes)
+    elif n > threshold:
+        sizes = zipf_sizes(n, seed=int(getattr(args, "random_seed", 0) or 0))
+    else:
+        sizes = None
+
+    if sizes is None:
+        ds = dataset if dataset is not None else data_loader.load(args)
+        return ClientPopulation.from_dataset(args, ds)
+
+    # virtual path: load base arrays once at a small materialized client
+    # count (the partition is discarded — only the global arrays matter)
+    if dataset is None:
+        saved = getattr(args, "client_num_in_total", None)
+        try:
+            args.client_num_in_total = min(int(saved or 10), 64)
+            dataset = data_loader.load(args)
+        finally:
+            args.client_num_in_total = saved
+    (_tn, _te, train_global, test_global, _ln, _trl, _tel,
+     class_num) = dataset
+    return ClientPopulation.virtual(
+        np.asarray(train_global[0]), np.asarray(train_global[1]),
+        sizes, (np.asarray(test_global[0]), np.asarray(test_global[1])),
+        class_num, seed=int(getattr(args, "random_seed", 0) or 0))

@@ -13,6 +13,7 @@ from __future__ import annotations
 from typing import Any, Optional, Tuple
 
 from .constants import (
+    SIMULATION_BACKEND_HYPERSCALE,
     SIMULATION_BACKEND_MESH,
     SIMULATION_BACKEND_PARROT,
     SIMULATION_BACKEND_SP,
@@ -83,6 +84,14 @@ class FedMLRunner:
             if backend == SIMULATION_BACKEND_MESH:
                 from .simulation.parrot.parrot_api import ParrotAPI
                 return ParrotAPI(args, device, dataset, model, use_mesh=True)
+            if backend == SIMULATION_BACKEND_HYPERSCALE:
+                # streamed cohorts over a (possibly virtual) population;
+                # meshes automatically when >1 device is visible
+                from .simulation.parrot.hyperscale import StreamingParrotAPI
+                import jax as _jax
+                return StreamingParrotAPI(
+                    args, device, dataset, model,
+                    use_mesh=len(_jax.devices()) > 1)
             raise ValueError(f"unknown simulation backend {backend!r}")
         if ttype == TRAINING_PLATFORM_CROSS_SILO:
             try:

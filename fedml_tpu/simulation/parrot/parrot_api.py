@@ -107,6 +107,176 @@ def bucket_plan(sizes: np.ndarray, k: int, bs: int, n_buckets: int,
     return plan
 
 
+# ---------------------------------------------------------------------------
+# Shared round-engine pieces.  ParrotAPI (device-resident dataset) and the
+# hyper-scale streaming path (simulation/parrot/hyperscale.py — host-assembled
+# cohorts, population too large for HBM) run the SAME per-cohort arithmetic:
+# vmapped local updates over a stacked client axis, per-algorithm server-state
+# handling, fused weighted aggregation.  These module-level functions are that
+# shared contract; the two APIs differ only in how the batch grids reach the
+# device.
+# ---------------------------------------------------------------------------
+
+def per_client_algo_state(algo: str, server_state: Dict[str, Any],
+                          client_ids) -> Dict[str, Any]:
+    """Per-cohort gather of the per-client algorithm state (SCAFFOLD
+    variates, FedDyn lambdas) from the stacked ``[N, ...]`` server tables.
+    Runs inside the round jit — when the tables are laid out sharded along
+    the client axis, XLA lowers this to the cross-device cohort gather."""
+    if algo == FED_OPT_SCAFFOLD:
+        return {
+            "c_global": server_state["c_global"],
+            "c_local": jax.tree_util.tree_map(
+                lambda t: t[client_ids], server_state["c_locals"]),
+        }
+    if algo == FED_OPT_FEDDYN:
+        return {"feddyn_lambda": jax.tree_util.tree_map(
+            lambda t: t[client_ids], server_state["lambdas"])}
+    if algo == FED_OPT_MIME:
+        return {"server_momentum": server_state["momentum"]}
+    return {}
+
+
+def algo_in_axes(algo: str):
+    """vmap in_axes for the algo_state argument of ``local_update``."""
+    return {
+        FED_OPT_SCAFFOLD: {"c_global": None, "c_local": 0},
+        FED_OPT_FEDDYN: {"feddyn_lambda": 0},
+        FED_OPT_MIME: {"server_momentum": None},
+    }.get(algo)
+
+
+def grid_sharding(mesh, k_b: int, bs: int) -> Optional[NamedSharding]:
+    """How a ``[K, nb, bs, ...]`` batch grid shards over the mesh.
+
+    Prefer the client axis (pure client parallelism, aggregation lowers
+    to one all-reduce over the mesh).  When a cohort/bucket quota K is
+    smaller than the mesh, shard the INTRA-BATCH axis instead: each
+    client's SGD step becomes data-parallel over devices and XLA inserts
+    the gradient all-reduce.  Falls back to replicated (None) when
+    neither axis divides the mesh.  Balanced layouts first (exact
+    divisibility on either axis), then UNEVEN sharding (GSPMD pads the
+    ragged shard) — never silently replicate while an axis is at least
+    mesh-sized."""
+    if mesh is None:
+        return None
+    names = tuple(mesh.axis_names)
+    msize = int(np.prod([mesh.shape[n] for n in names]))
+    if msize <= 1:
+        return None
+    if k_b % msize == 0:
+        return NamedSharding(mesh, P(names))
+    if bs % msize == 0:
+        return NamedSharding(mesh, P(None, None, names))
+    if k_b >= msize:
+        return NamedSharding(mesh, P(names))
+    if bs >= msize:
+        return NamedSharding(mesh, P(None, None, names))
+    logging.warning(
+        "parrot mesh: clients-per-step %d and batch_size %d are both "
+        "smaller than the %d-device mesh — running replicated", k_b,
+        bs, msize)
+    return None
+
+
+def stacked_client_sharding(mesh) -> Optional[NamedSharding]:
+    """Leading-axis sharding for ``[N, ...]`` per-client state tables:
+    the client axis spreads over EVERY mesh axis so state capacity scales
+    with chips instead of replicating N copies of the table."""
+    if mesh is None:
+        return None
+    names = tuple(mesh.axis_names)
+    if int(np.prod([mesh.shape[n] for n in names])) <= 1:
+        return None
+    return NamedSharding(mesh, P(names))
+
+
+def build_aggregate(args: Any, algo: str, n_total: int,
+                    server_tx: Any = None):
+    """Shared post-vmap logic: weighted aggregation + per-algorithm
+    server-state update, operating on stacked per-client outputs (the
+    uniform round, the bucketed round and the hyper-scale streaming round
+    all feed the same contract).
+
+    ``robust_agg`` swaps the fused weighted mean for a stacked robust
+    operator (`ml/aggregator/robust.py`) INSIDE the same jit — the
+    per-client outputs already carry the leading client axis the robust
+    kernels consume, so byzantine-robust rounds cost one fused
+    sort/distance reduction, not a host round-trip."""
+    robust_spec = parse_robust_agg(getattr(args, "robust_agg", None))
+
+    def aggregate(global_vars, server_state, client_ids, new_vars,
+                  algo_out, metrics, weights):
+        agg_vars = (robust_agg_stacked(robust_spec, new_vars, weights,
+                                       center=global_vars)
+                    if robust_spec is not None
+                    else agg_stacked(new_vars, weights))
+        new_state = dict(server_state)
+
+        if algo == FED_OPT_FEDOPT:
+            pseudo = jax.tree_util.tree_map(
+                lambda g, a: g - a, global_vars["params"],
+                agg_vars["params"])
+            updates, opt_state = server_tx.update(
+                pseudo, server_state["opt_state"], global_vars["params"])
+            params = optax.apply_updates(global_vars["params"], updates)
+            agg_vars = dict(agg_vars, params=params)
+            new_state["opt_state"] = opt_state
+        elif algo == FED_OPT_SCAFFOLD:
+            new_state["c_locals"] = jax.tree_util.tree_map(
+                lambda all_c, new_c: all_c.at[client_ids].set(new_c),
+                server_state["c_locals"], algo_out["c_local"])
+            delta = jax.tree_util.tree_map(
+                lambda d: jnp.sum(d, axis=0) / float(n_total),
+                algo_out["c_delta"])
+            new_state["c_global"] = jax.tree_util.tree_map(
+                lambda c, d: c + d, server_state["c_global"], delta)
+        elif algo == FED_OPT_FEDDYN:
+            alpha = float(getattr(args, "feddyn_alpha", 0.01) or 0.01)
+            new_state["lambdas"] = jax.tree_util.tree_map(
+                lambda all_l, new_l: all_l.at[client_ids].set(new_l),
+                server_state["lambdas"], algo_out["feddyn_lambda"])
+            m_frac = client_ids.shape[0] / float(n_total)
+            new_state["h"] = jax.tree_util.tree_map(
+                lambda h, avg, g: h - alpha * m_frac * (avg - g),
+                server_state["h"], agg_vars["params"],
+                global_vars["params"])
+            agg_vars = dict(agg_vars, params=jax.tree_util.tree_map(
+                lambda p, h: p - h / alpha, agg_vars["params"],
+                new_state["h"]))
+        elif algo == FED_OPT_FEDNOVA:
+            w = weights / jnp.maximum(jnp.sum(weights), 1e-12)
+            tau_eff = jnp.sum(w * algo_out["tau"])
+            lr = float(getattr(args, "learning_rate", 0.03))
+            d_avg = jax.tree_util.tree_map(
+                lambda d: jnp.tensordot(w, d, axes=1), algo_out["nova_d"])
+            agg_vars = dict(agg_vars, params=jax.tree_util.tree_map(
+                lambda p, d: p - tau_eff * lr * d,
+                global_vars["params"], d_avg))
+        elif algo == FED_OPT_MIME:
+            beta = float(getattr(args, "server_momentum", 0.9) or 0.9)
+            # robust reduce the full grads too: poisoned grads corrupt
+            # the server momentum just as poisoned params corrupt w
+            g = (robust_agg_stacked(robust_spec,
+                                    algo_out["full_grad"], weights)
+                 if robust_spec is not None
+                 else agg_stacked(algo_out["full_grad"], weights))
+            new_state["momentum"] = jax.tree_util.tree_map(
+                lambda m, gg: beta * m + (1.0 - beta) * gg,
+                server_state["momentum"], g)
+
+        round_metrics = {
+            "train_loss": jnp.sum(metrics["train_loss"] * weights)
+            / jnp.maximum(jnp.sum(weights), 1e-12),
+            "train_acc": jnp.sum(metrics["train_acc"] * weights)
+            / jnp.maximum(jnp.sum(weights), 1e-12),
+            "samples": jnp.sum(weights),
+        }
+        return agg_vars, new_state, round_metrics
+
+    return aggregate
+
+
 def _zeros_like(t):
     return jax.tree_util.tree_map(jnp.zeros_like, t)
 
@@ -369,39 +539,7 @@ class ParrotAPI:
 
     # ------------------------------------------------------------------
     def _grid_sharding(self, k_b: int) -> Optional[NamedSharding]:
-        """How a [K, nb, bs, ...] batch grid shards over the mesh.
-
-        Prefer the client axis (pure client parallelism, aggregation
-        lowers to one all-reduce over the mesh — the NCCL-allreduce role,
-        `simulation/nccl/.../LocalAggregator.py:69-80`).  When a bucket's
-        quota K is smaller than the mesh (stratified buckets run k/B
-        clients each), shard the INTRA-BATCH axis instead: each client's
-        SGD step becomes data-parallel over devices and XLA inserts the
-        gradient all-reduce.  Falls back to replicated (None) when
-        neither axis divides the mesh."""
-        mesh = self.mesh
-        if mesh is None:
-            return None
-        names = tuple(mesh.axis_names)
-        msize = int(np.prod([mesh.shape[n] for n in names]))
-        if msize <= 1:
-            return None
-        # balanced layouts first (exact divisibility on either axis), then
-        # UNEVEN sharding (GSPMD pads the ragged shard) — never silently
-        # replicate while an axis is at least mesh-sized
-        if k_b % msize == 0:
-            return NamedSharding(mesh, P(names))
-        if self.bs % msize == 0:
-            return NamedSharding(mesh, P(None, None, names))
-        if k_b >= msize:
-            return NamedSharding(mesh, P(names))
-        if self.bs >= msize:
-            return NamedSharding(mesh, P(None, None, names))
-        logging.warning(
-            "parrot mesh: clients-per-step %d and batch_size %d are both "
-            "smaller than the %d-device mesh — running replicated", k_b,
-            self.bs, msize)
-        return None
+        return grid_sharding(self.mesh, k_b, self.bs)
 
     def _build_round_step(self):
         # the client axis shards over EVERY mesh axis (clients is parrot's
@@ -433,111 +571,14 @@ class ParrotAPI:
         return round_step
 
     def _per_client_algo_state(self, server_state, client_ids):
-        algo = self.algo
-        if algo == FED_OPT_SCAFFOLD:
-            return {
-                "c_global": server_state["c_global"],
-                "c_local": jax.tree_util.tree_map(
-                    lambda t: t[client_ids], server_state["c_locals"]),
-            }
-        if algo == FED_OPT_FEDDYN:
-            return {"feddyn_lambda": jax.tree_util.tree_map(
-                lambda t: t[client_ids], server_state["lambdas"])}
-        if algo == FED_OPT_MIME:
-            return {"server_momentum": server_state["momentum"]}
-        return {}
+        return per_client_algo_state(self.algo, server_state, client_ids)
 
     def _in_axes_algo(self):
-        return {
-            FED_OPT_SCAFFOLD: {"c_global": None, "c_local": 0},
-            FED_OPT_FEDDYN: {"feddyn_lambda": 0},
-            FED_OPT_MIME: {"server_momentum": None},
-        }.get(self.algo)
+        return algo_in_axes(self.algo)
 
     def _build_aggregate(self):
-        """Shared post-vmap logic: weighted aggregation + per-algorithm
-        server-state update, operating on stacked per-client outputs
-        (uniform round and bucketed round feed the same contract).
-
-        ``robust_agg`` swaps the fused weighted mean for a stacked robust
-        operator (`ml/aggregator/robust.py`) INSIDE the same jit — the
-        per-client outputs already carry the leading client axis the
-        robust kernels consume, so byzantine-robust rounds cost one fused
-        sort/distance reduction, not a host round-trip."""
-        algo = self.algo
-        robust_spec = parse_robust_agg(
-            getattr(self.args, "robust_agg", None))
-
-        def aggregate(global_vars, server_state, client_ids, new_vars,
-                      algo_out, metrics, weights):
-            agg_vars = (robust_agg_stacked(robust_spec, new_vars, weights,
-                                           center=global_vars)
-                        if robust_spec is not None
-                        else agg_stacked(new_vars, weights))
-            new_state = dict(server_state)
-
-            if algo == FED_OPT_FEDOPT:
-                pseudo = jax.tree_util.tree_map(
-                    lambda g, a: g - a, global_vars["params"],
-                    agg_vars["params"])
-                updates, opt_state = self.server_tx.update(
-                    pseudo, server_state["opt_state"], global_vars["params"])
-                params = optax.apply_updates(global_vars["params"], updates)
-                agg_vars = dict(agg_vars, params=params)
-                new_state["opt_state"] = opt_state
-            elif algo == FED_OPT_SCAFFOLD:
-                new_state["c_locals"] = jax.tree_util.tree_map(
-                    lambda all_c, new_c: all_c.at[client_ids].set(new_c),
-                    server_state["c_locals"], algo_out["c_local"])
-                delta = jax.tree_util.tree_map(
-                    lambda d: jnp.sum(d, axis=0) / float(self.n_total),
-                    algo_out["c_delta"])
-                new_state["c_global"] = jax.tree_util.tree_map(
-                    lambda c, d: c + d, server_state["c_global"], delta)
-            elif algo == FED_OPT_FEDDYN:
-                alpha = float(getattr(self.args, "feddyn_alpha", 0.01) or 0.01)
-                new_state["lambdas"] = jax.tree_util.tree_map(
-                    lambda all_l, new_l: all_l.at[client_ids].set(new_l),
-                    server_state["lambdas"], algo_out["feddyn_lambda"])
-                m_frac = client_ids.shape[0] / float(self.n_total)
-                new_state["h"] = jax.tree_util.tree_map(
-                    lambda h, avg, g: h - alpha * m_frac * (avg - g),
-                    server_state["h"], agg_vars["params"],
-                    global_vars["params"])
-                agg_vars = dict(agg_vars, params=jax.tree_util.tree_map(
-                    lambda p, h: p - h / alpha, agg_vars["params"],
-                    new_state["h"]))
-            elif algo == FED_OPT_FEDNOVA:
-                w = weights / jnp.maximum(jnp.sum(weights), 1e-12)
-                tau_eff = jnp.sum(w * algo_out["tau"])
-                lr = float(getattr(self.args, "learning_rate", 0.03))
-                d_avg = jax.tree_util.tree_map(
-                    lambda d: jnp.tensordot(w, d, axes=1), algo_out["nova_d"])
-                agg_vars = dict(agg_vars, params=jax.tree_util.tree_map(
-                    lambda p, d: p - tau_eff * lr * d,
-                    global_vars["params"], d_avg))
-            elif algo == FED_OPT_MIME:
-                beta = float(getattr(self.args, "server_momentum", 0.9) or 0.9)
-                # robust reduce the full grads too: poisoned grads corrupt
-                # the server momentum just as poisoned params corrupt w
-                g = (robust_agg_stacked(robust_spec,
-                                        algo_out["full_grad"], weights)
-                     if robust_spec is not None
-                     else agg_stacked(algo_out["full_grad"], weights))
-                new_state["momentum"] = jax.tree_util.tree_map(
-                    lambda m, gg: beta * m + (1.0 - beta) * gg,
-                    server_state["momentum"], g)
-
-            round_metrics = {
-                "train_loss": jnp.sum(metrics["train_loss"] * weights)
-                / jnp.maximum(jnp.sum(weights), 1e-12),
-                "train_acc": jnp.sum(metrics["train_acc"] * weights)
-                / jnp.maximum(jnp.sum(weights), 1e-12),
-                "samples": jnp.sum(weights),
-            }
-            return agg_vars, new_state, round_metrics
-
-        return aggregate
+        return build_aggregate(self.args, self.algo, self.n_total,
+                               server_tx=getattr(self, "server_tx", None))
 
     def _build_bucketed_round_step(self):
         """One round over size strata: each bucket vmaps its own quota of
