@@ -38,6 +38,17 @@ Usage: python benchmarks/llm_bench.py [--quick] [--bs N] [--remat]
   --quick  skip the batch-size sweeps (used from bench.py: train bs 4
            only, decode batches 8/128 only; results go to
            llm_bench_results_quick.json)
+
+FEDERATED MODE (--federated, CPU-feasible — this is what CI runs):
+  measures the fed-LLM plane (docs/FED_LLM.md) instead of the raw TPU
+  step: an INPROC 2-silo LoRA federation on shakespeare/transformer —
+  per-silo SFT tokens/s, uplink/downlink bytes-on-wire per round, the
+  adapter-vs-full-model bytes reduction, and the quality-vs-central
+  curve (same model trained centrally on the union stream with an equal
+  round budget).  Results go to llm_bench_federated[_quick].json;
+  --guard enforces benchmarks/llm_bench_federated_floor.json (exit 1
+  when the bytes reduction falls below 0.8x floor or the 20x hard
+  minimum).
 """
 
 import json
@@ -52,6 +63,8 @@ sys.path.insert(0, REPO)
 
 QUICK = "--quick" in sys.argv
 REMAT = "--remat" in sys.argv
+FEDERATED = "--federated" in sys.argv
+GUARD = "--guard" in sys.argv
 _bs = [a for i, a in enumerate(sys.argv) if sys.argv[i - 1] == "--bs"]
 FORCE_BS = int(_bs[0]) if _bs else 0
 
@@ -310,6 +323,143 @@ def bench_serving(peak: float, rtt: float):
                 decode[best_bs]["tokens_per_sec"]}
 
 
+FED_RESULTS_PATH = os.path.join(
+    HERE, "llm_bench_federated_quick.json" if QUICK
+    else "llm_bench_federated.json")
+FED_FLOOR_PATH = os.path.join(HERE, "llm_bench_federated_floor.json")
+
+#: ISSUE acceptance: adapter uploads must beat full-model transfer by at
+#: least this factor, regardless of what the committed floor says
+FED_MIN_REDUCTION = 20.0
+
+
+def main_federated() -> None:
+    import fedml_tpu
+    from fedml_tpu.ml.engine.local_update import build_eval_step
+    from fedml_tpu.ml.trainer.default_trainer import batches_for
+    from fedml_tpu.runner import FedMLRunner
+    from fedml_tpu.train.fed_llm.trainer import (
+        FED_LLM_TOKENS,
+        FED_LLM_TRAIN_SECONDS,
+    )
+    from fedml_tpu.train.llm.lora import apply_lora
+    from fedml_tpu.utils.compression import WIRE_BYTES
+    from fedml_tpu.utils.serialization import estimate_nbytes
+
+    run_id = "llm-bench-fed"
+    n_silos, rounds = 2, (3 if QUICK else 5)
+    lora_rank, seq_len, bs = 4, 32, 4
+    args = fedml_tpu.init(fedml_tpu.Config(
+        dataset="shakespeare", model="transformer",
+        training_type="cross_silo", backend="INPROC", role="simulated",
+        client_num_in_total=n_silos, client_num_per_round=n_silos,
+        comm_round=rounds, epochs=1, batch_size=bs, learning_rate=3e-3,
+        data_scale=0.5 if QUICK else 1.0, frequency_of_the_test=1,
+        random_seed=0, run_id=run_id, enable_tracking=False,
+        compute_dtype="float32", fed_llm=True, lora_rank=lora_rank,
+        fed_llm_seq_len=seq_len))
+    device = fedml_tpu.device.get_device(args)
+    dataset = fedml_tpu.data.load(args)
+    bundle = fedml_tpu.model.create(args, dataset[-1])
+
+    t0 = time.time()
+    metrics = FedMLRunner(args, device, dataset, bundle).run()
+    fed_wall = time.time() - t0
+    fed_hist = metrics["server_loss_history"]
+
+    # -- bytes on the wire (measured at the transport, not estimated) ----
+    codecs = ("raw", "bf16", "int8", "topk", "topk8")
+    up = sum(WIRE_BYTES.labels(run_id=run_id, direction="up",
+                               codec=c).value for c in codecs)
+    down = sum(WIRE_BYTES.labels(run_id=run_id, direction="down",
+                                 codec=c).value for c in codecs)
+    full_model = estimate_nbytes(
+        bundle.init_variables(jax.random.PRNGKey(0)))
+    n_uploads = n_silos * rounds
+    reduction = full_model / (up / n_uploads)
+
+    per_silo = {}
+    for silo in range(n_silos):
+        tok = FED_LLM_TOKENS.labels(run_id=run_id, silo=str(silo)).value
+        sec = FED_LLM_TRAIN_SECONDS.labels(run_id=run_id,
+                                           silo=str(silo)).value
+        per_silo[str(silo)] = {
+            "train_tokens": tok,
+            # counter includes the round-1 compile; steady-state rate is
+            # higher (the per-round logs show it)
+            "tokens_per_sec": round(tok / max(sec, 1e-9), 0),
+        }
+
+    # -- quality vs central: same model + token budget, no federation ----
+    from fedml_tpu.train.fed_llm.config import llm_config_from_args
+    from fedml_tpu.train.llm.trainer import LLMTrainer
+
+    import numpy as _np
+
+    union = _np.concatenate(
+        [_np.asarray(dataset[5][c][0]).reshape(-1)
+         for c in range(n_silos)]).astype(_np.int64)
+    central = LLMTrainer(bundle, llm_config_from_args(args),
+                         rng=jax.random.PRNGKey(0))
+    eval_step = jax.jit(build_eval_step(bundle))
+    test_global = dataset[3]
+    nb = max(1, -(-len(test_global[1]) // bs))
+    batches = jax.device_get(  # host-side once; reused every eval
+        batches_for(test_global, bs, nb, bundle.input_dtype))
+    central_hist = []
+    for _ in range(rounds):
+        central.train(union)  # fresh opt state per call == per-round SGD
+        merged = apply_lora(central.variables["params"], central.lora,
+                            central.cfg.lora_alpha)
+        out = jax.device_get(eval_step(
+            dict(central.variables, params=merged), batches))
+        central_hist.append(float(out["loss_sum"]) / max(
+            float(out["n"]), 1.0))
+
+    out = {
+        "mode": "federated", "quick": QUICK,
+        "model": "tiny-transformer d128 L2 (shakespeare char-LM)",
+        "silos": n_silos, "rounds": rounds, "lora_rank": lora_rank,
+        "seq_len": seq_len, "batch_size": bs,
+        "full_model_bytes": full_model,
+        "uplink_bytes_total": up,
+        "uplink_bytes_per_round": round(up / rounds, 0),
+        "downlink_bytes_per_round": round(down / rounds, 0),
+        "mean_upload_bytes": round(up / n_uploads, 0),
+        "uplink_bytes_reduction": round(reduction, 1),
+        "per_silo": per_silo,
+        "federated_loss_history": [round(x, 4) for x in fed_hist],
+        "central_loss_history": [round(x, 4) for x in central_hist],
+        "quality_gap_final": round(fed_hist[-1] - central_hist[-1], 4),
+        "federated_wall_s": round(fed_wall, 1),
+    }
+    with open(FED_RESULTS_PATH, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({
+        "fed_llm_uplink_reduction": out["uplink_bytes_reduction"],
+        "fed_llm_final_loss": out["federated_loss_history"][-1],
+        "fed_llm_quality_gap": out["quality_gap_final"],
+        "fed_llm_tokens_per_sec_per_silo":
+            [v["tokens_per_sec"] for v in per_silo.values()],
+        "detail": FED_RESULTS_PATH,
+    }))
+
+    if GUARD:
+        bad = {}
+        if reduction < FED_MIN_REDUCTION:
+            bad["uplink_bytes_reduction(min)"] = (round(reduction, 1),
+                                                  FED_MIN_REDUCTION)
+        if os.path.exists(FED_FLOOR_PATH):
+            with open(FED_FLOOR_PATH) as f:
+                floor = json.load(f)
+            k = "uplink_bytes_reduction"
+            if k in floor and reduction < 0.8 * floor[k]:
+                bad[k] = (round(reduction, 1), floor[k])
+        if bad:
+            print(f"FED LLM GUARD FAILED: {bad}", file=sys.stderr)
+            sys.exit(1)
+
+
 def main() -> None:
     kind = jax.devices()[0].device_kind
     peak = TPU_PEAK_BF16_FLOPS.get(kind, TPU_PEAK_BF16_DEFAULT)
@@ -349,4 +499,4 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    main_federated() if FEDERATED else main()

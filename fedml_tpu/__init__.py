@@ -134,6 +134,12 @@ def init(args: Optional[Config] = None, argv: Optional[list] = None,
     FedMLDefender.get_instance().init(args)
     FedMLDifferentialPrivacy.get_instance().init(args)
     FedMLFHE.get_instance().init(args)
+    if bool(getattr(args, "fed_llm", False)):
+        # fail on a typo'd fed-LLM flag HERE, not mid-federation (the
+        # parse_wire_compression startup idiom)
+        from .train.fed_llm import validate_fed_llm_args
+
+        validate_fed_llm_args(args)
     return args
 
 

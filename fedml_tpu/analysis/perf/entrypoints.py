@@ -634,3 +634,69 @@ register_jit_entrypoint(
                   "entry, epoch-final output gathers are per-epoch not "
                   "per-step")),
     ))
+
+
+# ---------------------------------------------------------------------------
+# Fed-LLM server round boundary (delta fold + LoRA merge)
+# ---------------------------------------------------------------------------
+def _fed_llm_delta_round():
+    import jax
+    import jax.numpy as jnp
+
+    import fedml_tpu
+    from ...train.fed_llm.delta_round import (
+        make_delta_round,
+        zeros_like_adapters,
+    )
+    from ...train.llm.lora import init_lora
+
+    args = fedml_tpu.Config(model="transformer", dataset="shakespeare",
+                            compute_dtype="float32")
+    bundle = fedml_tpu.model.create(args, 90)
+    variables = bundle.init_variables(jax.random.PRNGKey(0), batch_size=2)
+    base = variables["params"]
+    # only shapes/dtypes feed the trace; init_lora's deterministic
+    # PRNGKey(0) fallback is exactly the standalone use it documents
+    adapters = init_lora(base, rank=2)
+    fn = make_delta_round(16.0)
+    return fn, (_sds(adapters), _sds(base),
+                _sds(zeros_like_adapters(adapters)),
+                jax.ShapeDtypeStruct((), jnp.float32))
+
+
+#: per-arg layout under SPMD — (adapters, base_params, agg_delta,
+#: server_lr); adapters/delta replicated (tiny by construction — the
+#: whole point of the plane), base per strategy
+_FED_LLM_IN_SPECS = lambda strategy: (  # noqa: E731 — spec table, not logic
+    None, strategy, None, None)
+
+# donate (2,): the aggregated delta is freshly produced each round,
+# shape-matches the new adapters and is never read again — XLA aliases
+# its buffers.  Argnum 0 (the global adapters) is NOT donated: the
+# buffered-async server re-reads the pre-fold global for mix_global after
+# aggregate() returns; argnum 1 (base) is frozen shared state.
+register_jit_entrypoint(
+    "fed_llm/delta_round", _fed_llm_delta_round,
+    donate_argnums=(2,),
+    mesh_variants=(
+        MeshVariant(
+            "fsdp", {"data": 8},
+            in_specs=_FED_LLM_IN_SPECS("fsdp"),
+            replicate_ok=(0, 2),
+            reshard_ok=(1, OK_OUT),
+            note=("adapter tree + delta replicate (tiny by construction "
+                  "— they are the wire format); the fsdp-sharded base "
+                  "gathers once per ROUND for the serve/eval merge, and "
+                  "the merged output resharding is likewise per-round, "
+                  "amortized over every local step the silos run")),
+        MeshVariant(
+            "tp_fsdp", {"data": 4, "model": 2},
+            in_specs=_FED_LLM_IN_SPECS("tp_fsdp"),
+            replicate_ok=(0, 2),
+            reshard_ok=(1, OK_OUT),
+            note=("adapter tree + delta replicate (tiny by construction "
+                  "— they are the wire format); the fsdp-sharded base "
+                  "gathers once per ROUND for the serve/eval merge, and "
+                  "the merged output resharding is likewise per-round, "
+                  "amortized over every local step the silos run")),
+    ))

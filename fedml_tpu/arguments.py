@@ -116,6 +116,16 @@ _DEFAULTS: Dict[str, Any] = {
     "async_staleness_cutoff": 10,    # versions; older uploads expire
     "async_server_lr": 1.0,          # global ← global + lr·(agg − global)
     "wire_compression": None,        # per-link update codec (see above)
+    # fed-LLM plane (docs/FED_LLM.md) — cross-silo LoRA SFT where ONLY
+    # adapter deltas cross the wire; fed_llm swaps the default trainer/
+    # aggregator pair for train/fed_llm's at both seams
+    "fed_llm": False,
+    "lora_rank": 8,                  # adapter rank r per targeted kernel
+    "lora_alpha": 16.0,              # merge scale = alpha / rank
+    "lora_targets": None,            # comma-sep regexes (None → defaults)
+    "fed_llm_seq_len": 32,           # packed next-token sequence length
+    "fed_llm_strategy": "none",      # silo-local sharding: none|dp|fsdp
+    "fed_llm_serve_eval": False,     # round-boundary llm_engine probe
     # tracking_args
     "enable_tracking": True,
     "log_file_dir": None,

@@ -114,11 +114,23 @@ def diagnosis(config, checks) -> None:
               help="per-link update codec, negotiated via capability "
                    "flags: none|bf16|int8|topk[:ratio]|topk8[:ratio] "
                    "(delta encoding + error feedback included)")
+@click.option("--fed-llm/--no-fed-llm", "fed_llm", default=None,
+              help="federated LoRA SFT plane: silos run the train/llm "
+                   "functional-LoRA epoch and only adapter deltas cross "
+                   "the wire (docs/FED_LLM.md)")
+@click.option("--lora-rank", default=None, type=int, metavar="R",
+              help="adapter rank per targeted kernel (>= 1)")
+@click.option("--lora-alpha", default=None, type=float,
+              help="LoRA merge scale numerator (> 0; scale = alpha/rank)")
+@click.option("--lora-targets", default=None, metavar="RE[,RE...]",
+              help="comma-separated regexes selecting which 2D kernels "
+                   "get adapters (default: MLP + attention projections)")
 def run(config: str, rank: int, role: str, reliable, heartbeat_interval_s,
         checkpoint_dir, resume_from, robust_agg, admission_control,
         over_provision, round_deadline_s, min_aggregation_clients,
         async_agg, async_buffer_k, async_flush_s, async_staleness,
-        async_staleness_cutoff, async_server_lr, wire_compression) -> None:
+        async_staleness_cutoff, async_server_lr, wire_compression,
+        fed_llm, lora_rank, lora_alpha, lora_targets) -> None:
     """Run a training config (reference `fedml run` / launchers)."""
     import fedml_tpu
 
@@ -182,6 +194,26 @@ def run(config: str, rank: int, role: str, reliable, heartbeat_interval_s,
             raise click.BadParameter(str(e),
                                      param_hint="--wire-compression")
         overrides["wire_compression"] = wire_compression
+    if fed_llm is not None:
+        overrides["fed_llm"] = fed_llm
+    if lora_rank is not None:
+        if lora_rank < 1:
+            raise click.BadParameter("must be >= 1",
+                                     param_hint="--lora-rank")
+        overrides["lora_rank"] = lora_rank
+    if lora_alpha is not None:
+        if not lora_alpha > 0:
+            raise click.BadParameter("must be > 0",
+                                     param_hint="--lora-alpha")
+        overrides["lora_alpha"] = lora_alpha
+    if lora_targets is not None:
+        from ..train.fed_llm import parse_lora_targets
+
+        try:  # fail at the CLI boundary, not on the first init_lora walk
+            parse_lora_targets(lora_targets)
+        except ValueError as e:
+            raise click.BadParameter(str(e), param_hint="--lora-targets")
+        overrides["lora_targets"] = lora_targets
     args = fedml_tpu.init(fedml_tpu.Config.from_yaml(config, overrides))
     device = fedml_tpu.device.get_device(args)
     dataset = fedml_tpu.data.load(args)

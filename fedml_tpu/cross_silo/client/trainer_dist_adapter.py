@@ -26,6 +26,11 @@ class TrainerDistAdapter:
         (self.train_num, self.test_num, self.train_global, self.test_global,
          self.local_num_dict, self.train_data_local_dict,
          self.test_data_local_dict, self.class_num) = dataset
+        if client_trainer is None and bool(getattr(args, "fed_llm", False)):
+            # fed-LLM plane: local SFT through the functional-LoRA epoch;
+            # the exchanged params are the adapter tree
+            from ...train.fed_llm import FedLLMTrainer
+            client_trainer = FedLLMTrainer(bundle, args)
         self.trainer = client_trainer or DefaultClientTrainer(bundle, args)
         bs = int(getattr(args, "batch_size", 32))
         max_n = max(self.local_num_dict.values()) if self.local_num_dict else bs

@@ -28,6 +28,12 @@ def init_server(args: Any, dataset: Tuple, bundle: Any,
                 backend: str = "INPROC") -> FedMLServerManager:
     import jax
 
+    if server_aggregator is None and bool(getattr(args, "fed_llm", False)):
+        # fed-LLM plane: the global model IS the LoRA adapter tree.  The
+        # aggregator pre-sets adapter-shaped params, so the None-param
+        # full-model auto-init below never fires for it.
+        from ..train.fed_llm import FedLLMAggregator
+        server_aggregator = FedLLMAggregator(bundle, args)
     aggregator_impl = server_aggregator or DefaultServerAggregator(bundle, args)
     if aggregator_impl.get_model_params() is None:
         rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0) or 0))

@@ -1,0 +1,38 @@
+"""Federated LLM LoRA SFT plane (docs/FED_LLM.md).
+
+Each silo is a `train/llm` functional-LoRA trainer (packing, donated opt
+state, optional fsdp mesh slice); over the wire, ONLY the (A, B) adapter
+tree crosses — the cross-silo plane is pytree-generic, so the PR-6 wire
+codecs, admission screening, staleness decay, robust aggregation and
+SecAgg masking all apply unchanged in the tiny adapter space.
+
+Pieces:
+
+* ``FedLLMTrainer`` — `ClientTrainer` plugging into `TrainerDistAdapter`;
+  the exchanged "model params" ARE the LoRA adapter tree.
+* ``FedLLMAggregator`` — `ServerAggregator` that aggregates in DELTA
+  space through ``FedMLAggOperator.agg`` and folds+merges through the
+  registered ``fed_llm/delta_round`` jit.
+* ``delta_round`` — the server's round-boundary device program
+  (fold adapters + server_lr·Δ, merge into base for serving/eval).
+* ``config`` — flag parsing/validation mirroring the
+  ``parse_wire_compression`` ValueError-at-startup idiom.
+"""
+
+from .aggregator import FedLLMAggregator
+from .config import (
+    llm_config_from_args,
+    parse_lora_targets,
+    validate_fed_llm_args,
+)
+from .delta_round import make_delta_round
+from .trainer import FedLLMTrainer
+
+__all__ = [
+    "FedLLMAggregator",
+    "FedLLMTrainer",
+    "llm_config_from_args",
+    "make_delta_round",
+    "parse_lora_targets",
+    "validate_fed_llm_args",
+]

@@ -1,0 +1,156 @@
+"""FedLLMAggregator — delta-space server aggregation for the fed-LLM plane.
+
+The "global model" the cross-silo server holds, broadcasts, admits
+uploads against and checkpoints is the LoRA ADAPTER tree — never the base
+weights.  Per round:
+
+1. per-silo uploads (adapter trees) → deltas vs the current global
+   adapters;
+2. one reduction through ``FedMLAggOperator.agg`` in delta space —
+   ``--robust-agg`` (trimmed-mean/Krum/… on the stacked adapter trees),
+   staleness weights and the defense hooks apply unchanged, with the
+   ZERO tree as the norm_clip center (clip ``‖Δ‖``, not ``‖params‖``);
+3. the jitted ``fed_llm/delta_round`` program folds the aggregate into
+   the global adapters and merges them into the frozen base — the merged
+   params feed round-boundary eval and (``--fed-llm-serve-eval``) a
+   ``serving/llm_engine`` generation probe.
+
+The buffered-async server needs NO override: ``aggregate_buffer`` funnels
+through this same ``aggregate``, then mixes old/new ADAPTER trees with
+``mix_global`` (linear in adapter space) — the post-mix global no longer
+matches the cached merge, so ``test()`` lazily re-merges via the same
+compiled program at ``server_lr=0``.
+
+Base-weight consistency: the server builds its reference ``LLMTrainer``
+from the SAME ``PRNGKey(args.random_seed)`` every silo uses, so base
+params are bit-identical fleet-wide and the initial global adapters
+(b = 0 → effective model == base) are exactly what each silo initialized.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...core.alg_frame.server_aggregator import ServerAggregator
+from ...ml.aggregator.agg_operator import FedMLAggOperator
+from ...ml.engine.local_update import build_eval_step
+from ...ml.trainer.default_trainer import batches_for
+from ..llm.lora import count_trainable
+from ..llm.trainer import LLMTrainer
+from .config import llm_config_from_args
+from .delta_round import make_delta_round, zeros_like_adapters
+
+
+def _tree_sub(tree: Any, ref: Any) -> Any:
+    """upload − global, per leaf in f32 (the delta space's working dtype —
+    exact for f32/bf16 adapter leaves)."""
+    return jax.tree_util.tree_map(
+        lambda a, b: (jnp.asarray(a).astype(jnp.float32)
+                      - jnp.asarray(b).astype(jnp.float32)), tree, ref)
+
+
+class FedLLMAggregator(ServerAggregator):
+    """Server aggregator whose ``params`` is the global adapter tree."""
+
+    def __init__(self, bundle: Any, args: Any) -> None:
+        # validates every --fed-llm companion flag at construction — the
+        # parse_wire_compression startup idiom
+        cfg = llm_config_from_args(args)
+        super().__init__(bundle, args)
+        self.bundle = bundle
+        self.cfg = cfg
+        seed = int(getattr(args, "random_seed", 0) or 0)
+        # identical construction to every silo's trainer: same key split →
+        # bit-identical base params + initial adapters fleet-wide
+        self._ref = LLMTrainer(bundle, cfg, rng=jax.random.PRNGKey(seed))
+        # pre-set BEFORE init_server's None-param check: the default
+        # full-model auto-init must never replace the adapter-shaped
+        # global (admission validates uploads against this tree)
+        self.params = self._ref.lora
+        if not self.params:
+            raise ValueError(
+                "fed_llm: no LoRA targets matched any 2D kernel of model "
+                f"{getattr(args, 'model', None)!r} — check --lora-targets")
+        self._delta_round = make_delta_round(cfg.lora_alpha)
+        self._eval = jax.jit(build_eval_step(bundle))
+        self.batch_size = int(getattr(args, "batch_size", 32))
+        self._serve_eval = bool(getattr(args, "fed_llm_serve_eval", False))
+        #: merged-params cache, valid only while the global IS the tree
+        #: the last delta_round produced (async mixing invalidates it)
+        self._merged: Any = None
+        self._merged_for: Any = None
+        self._loss_history: List[float] = []
+        logging.info("fed_llm server: %d adapter params over %d targets "
+                     "(rank %d)", count_trainable(self.params),
+                     len(self.params), cfg.lora_rank)
+
+    # -- aggregation ---------------------------------------------------------
+    def aggregate(self, raw_client_model_or_grad_list: List[Tuple[float, Any]]
+                  ) -> Any:
+        gl = self.get_model_params()
+        deltas = [(n, _tree_sub(tree, gl))
+                  for n, tree in raw_client_model_or_grad_list]
+        agg_delta = FedMLAggOperator.agg(self.args, deltas,
+                                         center=zeros_like_adapters(gl))
+        new_adapters, merged = self._delta_round(
+            gl, self._ref.variables["params"], agg_delta,
+            jnp.float32(1.0))
+        self._merged, self._merged_for = merged, new_adapters
+        return new_adapters
+
+    def _merged_params(self) -> Any:
+        """Base + current global adapters, through the SAME compiled
+        delta_round (zero delta, server_lr = 0 → fold is the identity).
+        Hits the cache when the global is still the tree the last
+        ``aggregate`` produced; recomputes after an async mix,
+        ``test_with_params`` swap or checkpoint restore."""
+        gl = self.get_model_params()
+        if self._merged is not None and self._merged_for is gl:
+            return self._merged
+        new_adapters, merged = self._delta_round(
+            gl, self._ref.variables["params"], zeros_like_adapters(gl),
+            jnp.float32(0.0))
+        self.set_model_params(new_adapters)
+        self._merged, self._merged_for = merged, new_adapters
+        return merged
+
+    # -- round-boundary eval -------------------------------------------------
+    def test(self, test_data, device=None, args=None) -> Dict[str, Any]:
+        merged = self._merged_params()
+        variables = dict(self._ref.variables, params=merged)
+        nb = max(1, -(-len(test_data[1]) // self.batch_size))
+        batches = batches_for(test_data, self.batch_size, nb,
+                              self.bundle.input_dtype)
+        out = jax.device_get(self._eval(variables, batches))
+        n = max(float(out["n"]), 1.0)
+        m: Dict[str, Any] = {
+            "test_loss": float(out["loss_sum"]) / n,
+            "test_acc": float(out["correct"]) / n,
+            "test_total": n,
+            "adapter_params": count_trainable(self.get_model_params()),
+        }
+        self._loss_history.append(m["test_loss"])
+        # full per-eval trajectory rides on every metrics dict so INPROC
+        # runs (which only return the LAST entry) can assert convergence
+        m["server_loss_history"] = list(self._loss_history)
+        if self._serve_eval:
+            m.update(self._serve_sample(variables))
+        return m
+
+    def _serve_sample(self, variables: Dict[str, Any]) -> Dict[str, Any]:
+        """Round-boundary serving probe: spin the batched engine on the
+        merged weights, generate one continuation, tear down."""
+        from ...serving.llm_engine import BatchedLLMEngine
+
+        engine = BatchedLLMEngine(self.bundle, variables, max_batch=2,
+                                  window=self.cfg.seq_len)
+        try:
+            prompt = list(range(1, 9))
+            out = engine.generate(prompt, max_new=8, timeout=120.0)
+            return {"served_tokens": int(len(out) - len(prompt))}
+        finally:
+            engine.stop()

@@ -1,0 +1,57 @@
+"""``fed_llm/delta_round`` — the server's round-boundary device program.
+
+One jit per aggregator: fold the aggregated adapter DELTA into the global
+adapter tree (f32 accumulate, cast back — the ``agg_stacked`` contract)
+and merge the result into the frozen base weights for serving/eval.  The
+registered entrypoint (analysis/perf/entrypoints.py) traces exactly this
+program, so all four lint tiers — donation audit, widen chains, SHARD004
+collective budgets on the fsdp variants — cover the plane's hot path.
+
+Donation: ``agg_delta`` (argnum 2) is donated — it is freshly produced
+every round, shape/dtype-matches the adapter output, and is never read
+again, so XLA aliases its buffers for the new adapters.  The adapter tree
+itself (argnum 0) is NOT donated: the buffered-async server re-reads the
+pre-fold global for ``mix_global`` after ``aggregate()`` returns, and the
+base (argnum 1) is frozen shared state by definition.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..llm.lora import apply_lora
+
+
+def zeros_like_adapters(adapters: Dict[str, Any]) -> Dict[str, Any]:
+    """An all-zero delta tree (f32 — the delta space's working dtype)."""
+    return jax.tree_util.tree_map(
+        lambda a: jnp.zeros(jnp.shape(a), jnp.float32), adapters)
+
+
+def make_delta_round(alpha: float) -> Callable:
+    """→ jitted ``(adapters, base_params, agg_delta, server_lr) →
+    (new_adapters, merged_params)`` with the LoRA scale ``alpha`` closed
+    over (static — it changes the traced arithmetic).
+
+    ``server_lr`` is a traced scalar so sync (1.0), async post-mix
+    re-merge (0.0) and damped folds share ONE compiled program.
+    """
+
+    def delta_round(adapters: Any, base_params: Any, agg_delta: Any,
+                    server_lr: jnp.ndarray):
+        lr = jnp.asarray(server_lr, jnp.float32)
+
+        def _fold(a: jnp.ndarray, d: jnp.ndarray) -> jnp.ndarray:
+            # f32 add then cast back (agg_stacked/_add_delta_tree
+            # contract): a bf16 adapter tree folds without double rounding
+            return (a.astype(jnp.float32)
+                    + lr * d.astype(jnp.float32)).astype(a.dtype)
+
+        new_adapters = jax.tree_util.tree_map(_fold, adapters, agg_delta)
+        merged = apply_lora(base_params, new_adapters, alpha)
+        return new_adapters, merged
+
+    return jax.jit(delta_round, donate_argnums=(2,))

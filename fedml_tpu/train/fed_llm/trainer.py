@@ -1,0 +1,117 @@
+"""FedLLMTrainer — one silo's local SFT engine for the fed-LLM plane.
+
+Wraps the existing ``train/llm`` functional-LoRA trainer behind the
+``ClientTrainer`` seam: the exchanged "model params" ARE the LoRA adapter
+tree, so everything upstream (codec delta encoding, admission, robust
+agg, SecAgg) operates on the tiny adapter pytree unchanged.
+
+Base-weight consistency: every silo AND the server build the base params
+from the SAME ``PRNGKey(args.random_seed)`` split (``LLMTrainer.__init__``
+splits it identically), so a server-side merge of aggregated adapters is
+exactly what each silo would compute locally — no base weights ever cross
+the wire.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.alg_frame.client_trainer import ClientTrainer
+from ...core.mlops import metrics
+from ..llm.trainer import LLMTrainer
+from .config import llm_config_from_args
+
+#: per-silo training throughput, readable by ``llm_bench --federated``
+#: without plumbing metrics through the aggregation protocol
+FED_LLM_TOKENS = metrics.counter(
+    "fedml_fed_llm_train_tokens_total",
+    "Tokens consumed by fed-LLM local SFT epochs, per silo",
+    labels=("run_id", "silo"))
+FED_LLM_TRAIN_SECONDS = metrics.counter(
+    "fedml_fed_llm_train_seconds_total",
+    "Wall seconds spent in fed-LLM local SFT (includes first-round "
+    "compile), per silo",
+    labels=("run_id", "silo"))
+
+
+class FedLLMTrainer(ClientTrainer):
+    """Silo-local LoRA SFT; ``params`` is the adapter tree."""
+
+    def __init__(self, bundle: Any, args: Any) -> None:
+        # validates every --fed-llm companion flag at construction — the
+        # parse_wire_compression startup idiom
+        cfg = llm_config_from_args(args)
+        super().__init__(bundle, args)
+        self.cfg = cfg
+        seed = int(getattr(args, "random_seed", 0) or 0)
+        self.llm = LLMTrainer(bundle, cfg, rng=jax.random.PRNGKey(seed))
+        self.params = self.llm.lora
+        self.num_batches: Optional[int] = None
+        self.last_metrics: Dict[str, Any] = {}
+        self._run_label = str(getattr(args, "run_id", "0"))
+
+    # -- plane plumbing ------------------------------------------------------
+    def set_num_batches(self, nb: Optional[int]) -> None:
+        """Adapter contract hook; the LLM epoch derives its own batch grid
+        from the packed stream, so this is bookkeeping only."""
+        self.num_batches = None if nb is None else int(nb)
+
+    def set_model_params(self, model_parameters: Any) -> None:
+        # copy, don't alias: the epoch jit DONATES the adapter buffers,
+        # and an INPROC broadcast may hand us the server's own arrays
+        # (jnp.array copies; asarray would alias and let the donation
+        # delete the global tree)
+        adapters = jax.tree_util.tree_map(
+            lambda a: jnp.array(a), model_parameters)
+        self.params = adapters
+        self.llm.lora = adapters
+
+    def get_model_params(self) -> Any:
+        return self.params
+
+    # -- local SFT -----------------------------------------------------------
+    def _token_stream(self, train_data: Any) -> np.ndarray:
+        """(x, y) sequence partition → one flat token stream for the
+        packer.  Rows are independent corpus windows, so cross-row
+        next-token pairs are noise at row boundaries — the same packing
+        tradeoff the reference dataset_utils makes."""
+        x = np.asarray(train_data[0])
+        stream = x.reshape(-1).astype(np.int64)
+        need = self.cfg.seq_len * self.cfg.batch_size + 1
+        if len(stream) < need:
+            raise ValueError(
+                f"silo partition too small for fed_llm packing: "
+                f"{len(stream)} tokens < seq_len*batch_size+1 = {need}; "
+                f"lower --fed-llm-seq-len/--batch-size or raise "
+                f"--data-scale")
+        return stream
+
+    def train(self, train_data, device=None, args=None) -> Any:
+        stream = self._token_stream(train_data)
+        t0 = time.time()
+        out = self.llm.train(stream)
+        dt = max(time.time() - t0, 1e-9)
+        self.params = self.llm.lora
+        n_seq = (len(stream) - 1) // self.cfg.seq_len
+        n_seq = n_seq // self.cfg.batch_size * self.cfg.batch_size
+        n_tokens = n_seq * self.cfg.seq_len * max(1, self.cfg.epochs)
+        silo = str(self.id)
+        FED_LLM_TOKENS.labels(run_id=self._run_label, silo=silo).inc(
+            n_tokens)
+        FED_LLM_TRAIN_SECONDS.labels(run_id=self._run_label,
+                                     silo=silo).inc(dt)
+        self.last_metrics = {
+            "train_loss": float(out["train_loss"]),
+            "n_tokens": float(n_tokens),
+            "tokens_per_sec": float(n_tokens / dt),
+        }
+        logging.info("fed_llm silo %s: loss %.4f, %.0f tok/s",
+                     silo, self.last_metrics["train_loss"],
+                     self.last_metrics["tokens_per_sec"])
+        return out

@@ -41,6 +41,10 @@ class LLMTrainConfig:
     use_lora: bool = True
     lora_rank: int = 8
     lora_alpha: float = 16.0
+    #: regex list selecting the 2D kernels that get (A, B) factors;
+    #: None → lora.DEFAULT_TARGETS (fed_llm passes a validated
+    #: ``--lora-targets`` spec through here)
+    lora_targets: Optional[Tuple[str, ...]] = None
     grad_clip: float = 1.0
     checkpoint_dir: Optional[str] = None
     #: "none" | "dp" | "fsdp" — ZeRO-equivalent sharding of the BASE params
@@ -111,7 +115,9 @@ class LLMTrainer:
         self.lora: Dict[str, Any] = {}
         if config.use_lora:
             self.lora = init_lora(self.variables["params"],
-                                  rank=config.lora_rank, rng=lora_rng)
+                                  rank=config.lora_rank,
+                                  targets=config.lora_targets,
+                                  rng=lora_rng)
             logging.info("LoRA: %d trainable params",
                          count_trainable(self.lora))
         from ...ml.engine.optimizers import make_lr

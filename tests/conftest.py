@@ -31,8 +31,10 @@ _SMOKE_FILES = {
     # test_reliability.py runs in its own dedicated smoke.yml step (like
     # test_observability.py) — listing it here would run the chaos soak
     # twice per CI job; test_aggregation.py likewise runs in the
-    # byzantine-soak step (its slow-marked soaks only run there), and
-    # test_async_agg.py in the async-soak step (wan-lossy straggler soak)
+    # byzantine-soak step (its slow-marked soaks only run there),
+    # test_async_agg.py in the async-soak step (wan-lossy straggler
+    # soak), and test_fed_llm.py in the fed-llm step (e2e federations +
+    # the federated bench guard)
 }
 
 
