@@ -89,7 +89,7 @@ def main() -> None:
 def main_hyperscale(n_clients: int) -> None:
     import numpy as np
 
-    from fedml_tpu.data.population import zipf_sizes
+    from fedml_tpu.data.population import size_hist, zipf_sizes
     from fedml_tpu.simulation.parrot.parrot_api import bucket_plan
 
     p = HYPER_POLICY
@@ -108,14 +108,18 @@ def main_hyperscale(n_clients: int) -> None:
         "description": "Heavy-tailed (bounded-Pareto) per-client sample "
                        "counts for the hyper-scale streaming bench "
                        "(bench.py --hyperscale) and its PERF003 padding "
-                       "audit — regenerable with "
-                       "gen_northstar_client_sizes.py --hyperscale",
+                       "audit, histogram-encoded as ascending "
+                       "[size, count] pairs (decode with "
+                       "fedml_tpu.data.population.expand_size_hist; "
+                       "bucket stats are a function of the multiset, so "
+                       "they match the dense form exactly) — regenerable "
+                       "with gen_northstar_client_sizes.py --hyperscale",
         "generator": "fedml_tpu.data.population.zipf_sizes",
         "random_seed": 0,
         "client_num_in_total": n_clients,
         **p,
         "slot_utilization": round(util, 4),
-        "sizes": [int(s) for s in sizes],
+        "size_hist": size_hist(sizes),
     }
     with open(OUT_HYPER, "w") as f:
         json.dump(payload, f, indent=1)

@@ -296,9 +296,12 @@ def _hyperscale_bucket_stats():
     if not p.is_file():
         return None
     d = json.loads(p.read_text(encoding="utf-8"))
+    from ...data.population import decode_sizes
     from ...simulation.parrot.parrot_api import bucket_plan
 
-    plan = bucket_plan(np.asarray(d["sizes"]),
+    # committed file is histogram-encoded ([size, count] pairs); stats
+    # are multiset functions so the decode is exact
+    plan = bucket_plan(decode_sizes(d),
                        int(d["client_num_per_round"]),
                        int(d["batch_size"]),
                        int(d["hetero_buckets"]),
@@ -382,10 +385,108 @@ def _agg_mesh_variant():
         min_bytes=1 << 12)
 
 
+# widen_allow for the epilogue kernels: the fused-epilogue contract
+# REQUIRES f32 accumulation on bf16 leaves (agg_stacked's numerics of
+# record — weights normalize and reduce in f32, cast back once at the
+# end), and on TPU the widen lives in-register inside one pallas pass,
+# not in HBM; the jnp fallback keeps the same math for bitwise parity
+_EPILOGUE_WIDEN_OK = ("fedml_tpu/ops/epilogue.py",)
+
 register_jit_entrypoint("agg/robust_trimmed_mean", _robust_agg,
                         mesh_variants=(_agg_mesh_variant(),))
 register_jit_entrypoint("agg/stacked_weighted_mean", _agg_stacked,
+                        meta={"widen_allow": _EPILOGUE_WIDEN_OK},
                         mesh_variants=(_agg_mesh_variant(),))
+
+
+# ---------------------------------------------------------------------------
+# Fused round epilogue (ops/epilogue.py — reduce + mix + server-opt +
+# cast-back in one pass per leaf)
+# ---------------------------------------------------------------------------
+def _epilogue_opt_state(global_tree, with_t=True):
+    import jax
+    import jax.numpy as jnp
+
+    f32 = jax.tree_util.tree_map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, jnp.float32), global_tree)
+    state = {"m": f32,
+             "v": jax.tree_util.tree_map(lambda s: s, f32)}
+    if with_t:
+        state["t"] = jax.ShapeDtypeStruct((), jnp.int32)
+    return state
+
+
+def _fused_epilogue():
+    """The host-funnel fold: stacked client updates + weights reduce,
+    mix at ``server_lr`` and step the server optimizer (adam — the
+    FedOpt default) into the DONATED global, opt state threaded through
+    donated too — ``FedMLAggregator.aggregate_buffer``'s device program
+    when the fused channel is on."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...ops.epilogue import EpilogueSpec, fused_epilogue
+
+    spec = EpilogueSpec(opt="adam", lr=1e-3)
+    stacked = _stacked_tree()
+    global_tree = jax.tree_util.tree_map(
+        lambda s: jax.ShapeDtypeStruct(s.shape[1:], s.dtype), stacked)
+
+    def step(g, stacked_updates, weights, opt_state):
+        return fused_epilogue(g, stacked_updates, weights, 1.0, spec,
+                              opt_state)
+
+    return jax.jit(step, donate_argnums=(0, 3)), (
+        global_tree, stacked, jax.ShapeDtypeStruct((8,), jnp.float32),
+        _epilogue_opt_state(global_tree))
+
+
+def _parrot_fused_epilogue():
+    """The in-jit form: Parrot's ``build_aggregate`` FEDOPT channel —
+    f32 params (the round-step carry), per-cohort weights, NOTHING
+    donated (the enclosing round jit owns the carry's aliasing)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...ops.epilogue import EpilogueSpec, fused_epilogue
+
+    spec = EpilogueSpec(opt="adam", lr=1e-3)
+    stacked = _stacked_tree(dtype="float32")
+    global_tree = jax.tree_util.tree_map(
+        lambda s: jax.ShapeDtypeStruct(s.shape[1:], s.dtype), stacked)
+
+    def step(g, stacked_updates, weights, opt_state):
+        return fused_epilogue(g, stacked_updates, weights, 1.0, spec,
+                              opt_state)
+
+    return jax.jit(step), (
+        global_tree, stacked, jax.ShapeDtypeStruct((8,), jnp.float32),
+        _epilogue_opt_state(global_tree))
+
+
+_EPILOGUE_MESH_NOTE = ("the global + server-opt state mix and "
+                       "re-broadcast every round — replicated state by "
+                       "definition; only the stacked client axis shards")
+
+register_jit_entrypoint(
+    "agg/fused_epilogue", _fused_epilogue,
+    donate_argnums=(0, 3),
+    meta={"widen_allow": _EPILOGUE_WIDEN_OK},
+    mesh_variants=(MeshVariant(
+        "clients8", {"clients": 8},
+        in_specs=(None, ("clients",), ("clients",), None),
+        replicate_ok=(0, 3), note=_EPILOGUE_MESH_NOTE,
+        min_bytes=1 << 12),))
+
+register_jit_entrypoint(
+    "parrot/fused_epilogue", _parrot_fused_epilogue,
+    donate_argnums=(),
+    meta={"widen_allow": _EPILOGUE_WIDEN_OK},
+    mesh_variants=(MeshVariant(
+        "clients8", {"clients": 8},
+        in_specs=(None, ("clients",), ("clients",), None),
+        replicate_ok=(0, 3), note=_EPILOGUE_MESH_NOTE,
+        min_bytes=1 << 12),))
 
 
 # ---------------------------------------------------------------------------
@@ -415,6 +516,7 @@ register_jit_entrypoint(
     # in place instead of holding old+new globals at peak
     "async/aggregate_buffer", _async_fold_buffer,
     donate_argnums=(0,),
+    meta={"widen_allow": _EPILOGUE_WIDEN_OK},
     mesh_variants=(MeshVariant(
         "clients8", {"clients": 8},
         # buffer shards over clients; global/weights/lr replicated (the

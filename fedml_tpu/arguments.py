@@ -57,6 +57,15 @@ _DEFAULTS: Dict[str, Any] = {
     "server_optimizer": "adam",      # FedOpt
     "server_lr": 1e-3,
     "server_momentum": 0.9,
+    # fused round epilogue (ops/epilogue.py): reduce + mix + server-opt
+    # + cast-back as one pass per leaf on every aggregation funnel; off
+    # → the legacy separately-materialized chain (A/B via bench.py
+    # --epilogue)
+    "fused_epilogue": True,
+    # parrot warm pool: background-precompile the round/bucketed/fused
+    # step executables into the shared AOT cache at startup (also env
+    # FEDML_TPU_COMPILE_AHEAD=1)
+    "parrot_compile_ahead": False,
     "fedprox_mu": 0.1,
     "feddyn_alpha": 0.01,
     # validation_args

@@ -27,6 +27,9 @@ __all__ = [
     "ClientPopulation",
     "philox_generator",
     "zipf_sizes",
+    "size_hist",
+    "expand_size_hist",
+    "decode_sizes",
     "load_population",
 ]
 
@@ -59,6 +62,41 @@ def zipf_sizes(n_clients: int, seed: int = 0, exponent: float = 1.2,
     u = g.random(n_clients)
     sizes = min_size * (1.0 - u) ** (-1.0 / float(exponent))
     return np.clip(np.round(sizes), min_size, max_size).astype(np.int64)
+
+
+def size_hist(sizes: np.ndarray) -> list:
+    """Compact histogram encoding of a per-client size vector:
+    ascending ``[[size, count], ...]`` pairs.
+
+    The multiset of sizes is preserved exactly — everything downstream
+    of the committed artifacts (``bucket_plan`` strata, PERF003 padding
+    stats, slot-utilization acceptance) is a function of the multiset,
+    so a 100k-line ``"sizes"`` array compresses to a few thousand pairs
+    with identical results.  Per-client ORDER is not preserved; the
+    decoded vector is sorted ascending."""
+    vals, counts = np.unique(np.asarray(sizes, np.int64),
+                             return_counts=True)
+    return [[int(v), int(c)] for v, c in zip(vals, counts)]
+
+
+def expand_size_hist(hist: Any) -> np.ndarray:
+    """Inverse of `size_hist`: ``[[size, count], ...]`` → sorted int64
+    per-client size vector."""
+    if not hist:
+        return np.zeros(0, np.int64)
+    arr = np.asarray(hist, np.int64).reshape(-1, 2)
+    return np.repeat(arr[:, 0], arr[:, 1])
+
+
+def decode_sizes(payload: Any) -> np.ndarray:
+    """Loader shim for committed size files: accepts the legacy dense
+    form (``{"sizes": [...]}`` or a bare list) and the compact histogram
+    form (``{"size_hist": [[size, count], ...]}``)."""
+    if isinstance(payload, dict):
+        if "size_hist" in payload:
+            return expand_size_hist(payload["size_hist"])
+        return np.asarray(payload["sizes"], np.int64)
+    return np.asarray(payload, np.int64)
 
 
 class ClientPopulation:
@@ -153,8 +191,7 @@ def load_population(args: Any,
     if sizes_path:
         with open(sizes_path) as f:
             payload = json.load(f)
-        sizes = np.asarray(payload["sizes"] if isinstance(payload, dict)
-                           else payload, np.int64)
+        sizes = decode_sizes(payload)
         n = len(sizes)
     elif n > threshold:
         sizes = zipf_sizes(n, seed=int(getattr(args, "random_seed", 0) or 0))

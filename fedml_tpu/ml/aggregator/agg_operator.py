@@ -32,6 +32,7 @@ from ...constants import (
     FED_OPT_MIME,
     FED_OPT_SCAFFOLD,
 )
+from ...ops import epilogue as _epilogue
 
 
 def weighted_average(grad_list: Sequence[Tuple[float, Any]]) -> Any:
@@ -67,17 +68,12 @@ def agg_stacked(stacked: Any, weights: jnp.ndarray) -> Any:
     BACK to its input dtype — a bf16 model tree comes back bf16, not
     silently widened to f32.  Non-float leaves keep the f32 result (a
     "weighted average" of integers is fractional by construction).
+
+    Routed through the fused round-epilogue kernel family
+    (``ops/epilogue.py``): on TPU each leaf is one pallas HBM pass; off
+    TPU the jnp fallback is this contract's original math, bit for bit.
     """
-    w = weights.astype(jnp.float32)
-    w = w / jnp.maximum(jnp.sum(w), 1e-12)
-
-    def _leaf(x: jnp.ndarray) -> jnp.ndarray:
-        wshape = (x.shape[0],) + (1,) * (x.ndim - 1)
-        acc = jnp.sum(x.astype(jnp.float32) * w.reshape(wshape), axis=0)
-        return (acc.astype(x.dtype)
-                if jnp.issubdtype(x.dtype, jnp.floating) else acc)
-
-    return jax.tree_util.tree_map(_leaf, stacked)
+    return _epilogue.weighted_reduce(stacked, weights)
 
 
 def mix_global(global_tree: Any, agg_tree: Any, server_lr: Any) -> Any:
@@ -110,8 +106,42 @@ def fold_buffer(global_tree: Any, stacked: Any, weights: jnp.ndarray,
     ``staleness_fn`` × sample counts) weight one fused reduction over the
     stacked update buffer, and the result mixes into the global at
     ``server_lr``.  The device-side hot path of the async server — the
-    ``async/aggregate_buffer`` registry entry traces exactly this."""
-    return mix_global(global_tree, agg_stacked(stacked, weights), server_lr)
+    ``async/aggregate_buffer`` registry entry traces exactly this.
+
+    Reduce + mix run as ONE fused-epilogue pass per leaf (on TPU, one
+    pallas program; the jnp fallback composes ``mix_global`` over
+    ``agg_stacked`` exactly, so off-TPU folds are unchanged)."""
+    return _epilogue.fused_epilogue(global_tree, stacked, weights,
+                                    server_lr)[0]
+
+
+def _stackable_payload(grad_list: Sequence[Tuple[float, Any]]) -> bool:
+    """True when every client payload is the same pytree of numeric
+    arrays with matching shapes/dtypes — the precondition for routing
+    the host-driven funnel through the stacked fused reduction.  FHE
+    ciphertexts, ragged trees and scalar payloads fall back to
+    ``weighted_average``."""
+    try:
+        trees = [g for _, g in grad_list]
+        defs = [jax.tree_util.tree_structure(t) for t in trees]
+        if any(d != defs[0] for d in defs[1:]):
+            return False
+        rows = [jax.tree_util.tree_leaves(t) for t in trees]
+        first = rows[0]
+        if not first:
+            return False
+        for leaves in rows:
+            for a, b in zip(first, leaves):
+                if not (hasattr(b, "shape") and hasattr(b, "dtype")):
+                    return False
+                if tuple(a.shape) != tuple(b.shape) or a.dtype != b.dtype:
+                    return False
+                if not (jnp.issubdtype(b.dtype, jnp.floating)
+                        or jnp.issubdtype(b.dtype, jnp.integer)):
+                    return False
+        return True
+    except Exception:
+        return False
 
 
 def agg_psum(update: Any, weight: jnp.ndarray, axis_name: str) -> Any:
@@ -141,6 +171,20 @@ class FedMLAggOperator:
 
         spec = parse_robust_agg(getattr(args, "robust_agg", None))
         if spec is None or not grad_list:
+            if (grad_list
+                    and bool(getattr(args, "fused_epilogue", True))
+                    and _stackable_payload(grad_list)):
+                # fused funnel: stack once, reduce every leaf in a single
+                # f32-accumulating epilogue pass (the agg_stacked
+                # contract; on TPU a pallas kernel).  Zero-total rounds
+                # keep weighted_average's uniform-fallback semantics.
+                stacked = stack_grad_list([g for _, g in grad_list])
+                total = float(sum(n for n, _ in grad_list))
+                weights = (jnp.ones((len(grad_list),), jnp.float32)
+                           if total <= 0 else
+                           jnp.asarray([float(n) for n, _ in grad_list],
+                                       jnp.float32))
+                return agg_stacked(stacked, weights)
             return weighted_average(grad_list)
         # a single-result round still goes through the operator: every op
         # degenerates to that client EXCEPT norm_clip, which must keep

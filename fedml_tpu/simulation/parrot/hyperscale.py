@@ -56,7 +56,9 @@ from ...data.population import ClientPopulation, load_population, \
 from ...ml.engine.local_update import build_eval_step, build_local_update, \
     make_batches
 from ...ml.engine.mesh import build_hybrid_mesh, build_mesh
+from ...ml.aggregator.robust import parse_robust_agg
 from ...ml.engine.optimizers import build_server_optimizer
+from ...ops import epilogue as _epilogue
 from .parrot_api import _stack_zeros_like, _zeros_like, algo_in_axes, \
     bucket_plan, build_aggregate, grid_sharding, per_client_algo_state, \
     stacked_client_sharding
@@ -261,9 +263,20 @@ class StreamingParrotAPI:
         self.server_state: Dict[str, Any] = {}
         state_shard = stacked_client_sharding(self.mesh)
         if self.algo == FED_OPT_FEDOPT:
-            self.server_tx = build_server_optimizer(args)
-            self.server_state["opt_state"] = self.server_tx.init(
-                self.global_vars["params"])
+            # same channel choice as build_aggregate: fused-epilogue
+            # optimizer state when the server optimizer maps onto the
+            # kernel family, optax state otherwise
+            fused_opt = (_epilogue.spec_from_args(args)
+                         if parse_robust_agg(
+                             getattr(args, "robust_agg", None)) is None
+                         else None)
+            if fused_opt is not None:
+                self.server_state["opt_state"] = _epilogue.init_opt_state(
+                    self.global_vars["params"], fused_opt)
+            else:
+                self.server_tx = build_server_optimizer(args)
+                self.server_state["opt_state"] = self.server_tx.init(
+                    self.global_vars["params"])
         if self.algo == FED_OPT_SCAFFOLD:
             self.server_state["c_global"] = _zeros_like(
                 self.global_vars["params"])

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import threading
 import time
 from functools import partial
 from typing import Any, Dict, List, Optional, Tuple
@@ -47,6 +48,7 @@ from ...core import mlops
 from ...core.mlops import flight_recorder
 from ...ml.aggregator.agg_operator import agg_stacked
 from ...ml.aggregator.robust import parse_robust_agg, robust_agg_stacked
+from ...ops import epilogue as _epilogue
 from ...ml.engine.local_update import build_eval_step, build_local_update, make_batches
 from ...ml.engine.mesh import MeshManager, build_hybrid_mesh, build_mesh
 from ...ml.engine.optimizers import build_server_optimizer
@@ -204,6 +206,14 @@ def build_aggregate(args: Any, algo: str, n_total: int,
     kernels consume, so byzantine-robust rounds cost one fused
     sort/distance reduction, not a host round-trip."""
     robust_spec = parse_robust_agg(getattr(args, "robust_agg", None))
+    # FedOpt's server step fuses into the epilogue kernel when the
+    # optimizer maps onto a fused channel (sgd/momentum/adam): the params
+    # subtree runs reduce → pseudo-grad → optimizer → cast in ONE pass
+    # per leaf instead of reduce + optax update + apply.  Robust rounds
+    # keep the optax path (the sort/distance center can't fuse).
+    fused_opt = (_epilogue.spec_from_args(args)
+                 if algo == FED_OPT_FEDOPT and robust_spec is None
+                 else None)
 
     def aggregate(global_vars, server_state, client_ids, new_vars,
                   algo_out, metrics, weights):
@@ -213,7 +223,16 @@ def build_aggregate(args: Any, algo: str, n_total: int,
                     else agg_stacked(new_vars, weights))
         new_state = dict(server_state)
 
-        if algo == FED_OPT_FEDOPT:
+        if algo == FED_OPT_FEDOPT and fused_opt is not None:
+            # the plain params reduce above is dead code under the fused
+            # channel (XLA DCEs it): the epilogue re-reads the stacked
+            # params and emits the post-optimizer global directly
+            params, opt_state = _epilogue.fused_epilogue(
+                global_vars["params"], new_vars["params"], weights,
+                1.0, fused_opt, server_state["opt_state"])
+            agg_vars = dict(agg_vars, params=params)
+            new_state["opt_state"] = opt_state
+        elif algo == FED_OPT_FEDOPT:
             pseudo = jax.tree_util.tree_map(
                 lambda g, a: g - a, global_vars["params"],
                 agg_vars["params"])
@@ -342,9 +361,20 @@ class ParrotAPI:
         # ---- server state --------------------------------------------------
         self.server_state: Dict[str, Any] = {}
         if self.algo == FED_OPT_FEDOPT:
-            self.server_tx = build_server_optimizer(args)
-            self.server_state["opt_state"] = self.server_tx.init(
-                self.global_vars["params"])
+            # mirror build_aggregate's channel choice: fused epilogue
+            # state ({m, v, t} f32 trees) when the server optimizer maps,
+            # optax state otherwise (yogi/adagrad/robust rounds)
+            fused_opt = (_epilogue.spec_from_args(args)
+                         if parse_robust_agg(
+                             getattr(args, "robust_agg", None)) is None
+                         else None)
+            if fused_opt is not None:
+                self.server_state["opt_state"] = _epilogue.init_opt_state(
+                    self.global_vars["params"], fused_opt)
+            else:
+                self.server_tx = build_server_optimizer(args)
+                self.server_state["opt_state"] = self.server_tx.init(
+                    self.global_vars["params"])
         if self.algo == FED_OPT_SCAFFOLD:
             self.server_state["c_global"] = _zeros_like(
                 self.global_vars["params"])
@@ -400,6 +430,12 @@ class ParrotAPI:
         #: backend reports nothing) — bench.py's measured-MFU source
         self.program_costs: Optional[Dict[str, Any]] = None
         self.metrics_history: List[Dict[str, Any]] = []
+        #: warm pool (compile-ahead): {tag: {hit, seconds}} per executable
+        #: precompiled/cache-loaded in the background; empty until started
+        self._compile_ahead_thread: Optional[threading.Thread] = None
+        self.compile_ahead_report: Dict[str, Any] = {}
+        if self.compile_ahead_enabled():
+            self.start_compile_ahead()
         if flight_recorder.enabled():
             # the uploads above are async; force + time them so the h2d
             # bucket carries the real dataset-transfer cost, and count
@@ -709,10 +745,13 @@ class ParrotAPI:
         return jax.jit(multi, donate_argnums=(1, 2))
 
     # ------------------------------------------------------------------
-    def _aot_cache_path(self) -> Optional[str]:
-        """Disk path for the serialized multi-round program, or None when
-        AOT caching is off.  The key digests everything the traced
-        program depends on — config knobs, data/model shapes, bucket
+    def _aot_cache_path(self, tag: str = "mrs") -> Optional[str]:
+        """Disk path for a serialized parrot executable, or None when
+        AOT caching is off.  ``tag`` names the program — ``mrs`` (fused
+        multi-round scan), ``rs`` (uniform round step), ``brs`` (bucketed
+        round step; one program embedding every bucket signature from
+        ``bucket_plan()``) — and the key digests everything the traced
+        program depends on: config knobs, data/model shapes, bucket
         layout, device topology, jax version, AND the source files that
         build the trace — so a stale artifact can never be replayed."""
         if not bool(getattr(self.args, "parrot_aot_cache", True)):
@@ -744,7 +783,8 @@ class ParrotAPI:
             "batch_size", "client_num_in_total", "client_num_per_round",
             "compute_dtype", "data_dtype", "hetero_buckets", "conv_impl",
             "server_lr", "server_momentum", "feddyn_alpha", "fedprox_mu",
-            "random_seed", "robust_agg", "hetero_bucket_cap")]
+            "random_seed", "robust_agg", "hetero_bucket_cap",
+            "fused_epilogue", "server_optimizer")]
         h.update("|".join(cfg).encode())
         h.update(repr((self.x_all.shape, str(self.x_all.dtype),
                        self.y_all.shape, self.nb, self.bs,
@@ -759,7 +799,9 @@ class ParrotAPI:
                     "ml/engine/model_bundle.py",
                     "ml/engine/optimizers.py",
                     "ml/aggregator/agg_operator.py",
-                    "ml/aggregator/robust.py"):
+                    "ml/aggregator/robust.py",
+                    "ops/epilogue.py",
+                    "ops/pallas_ops.py"):
             try:
                 with open(os.path.join(pkg, rel), "rb") as f:
                     h.update(f.read())
@@ -795,13 +837,20 @@ class ParrotAPI:
             logging.warning("parrot: AOT cache dir unusable (%s); caching "
                             "off", e)
             return None
-        return os.path.join(base, f"parrot_mrs_{h.hexdigest()[:24]}.jaxexp")
+        return os.path.join(base,
+                            f"parrot_{tag}_{h.hexdigest()[:24]}.jaxexp")
 
     def _ensure_multi_round_step(self) -> None:
         """Build (or load) the fused program, attributing the wall time
         to the flight recorder's ``compile`` bucket and capturing the
         program's XLA cost/memory analysis (``self.program_costs``) for
         measured MFU."""
+        if self.multi_round_step is not None:
+            return
+        t = self._compile_ahead_thread
+        if t is not None and t.is_alive():
+            # warm pool is already building it — join instead of racing
+            t.join()
         if self.multi_round_step is not None:
             return
         with flight_recorder.phase("compile",
@@ -832,58 +881,21 @@ class ParrotAPI:
         jax's own persistent compilation cache."""
         if self.multi_round_step is not None:
             return
-        import os
-        import pickle
 
         fn = self._build_multi_round_step()
         path = self._aot_cache_path()
-        if path and os.path.exists(path):
-            try:
-                from jax.experimental import serialize_executable
-
-                with open(path, "rb") as f:
-                    # fstat the OPEN fd (not the path) so a symlink swap
-                    # between check and read can't redirect the unpickle
-                    if hasattr(os, "getuid"):
-                        import stat as _stat
-
-                        st = os.fstat(f.fileno())
-                        if (st.st_uid != os.getuid()
-                                or not _stat.S_ISREG(st.st_mode)):
-                            raise PermissionError(
-                                f"{path} not a regular file owned by us; "
-                                "refusing to unpickle")
-                    blob = pickle.load(f)
-                self.multi_round_step = \
-                    serialize_executable.deserialize_and_load(*blob)
-                self.aot_cache_hit = True
-                logging.info("parrot: fused executable loaded from "
-                             "AOT cache %s", path)
-                return
-            except Exception as e:  # stale/corrupt → rebuild
-                logging.warning("parrot: AOT cache load failed (%s); "
-                                "recompiling", e)
+        loaded = self._load_executable(path)
+        if loaded is not None:
+            self.multi_round_step = loaded
+            self.aot_cache_hit = True
+            logging.info("parrot: fused executable loaded from "
+                         "AOT cache %s", path)
+            return
         # compile EAGERLY even without a cache dir: readiness then always
         # includes the compile, so callers timing "program ready" vs
         # "first chunk" (bench.py) measure the same thing on every path
         try:
-            def _spec(a):
-                # carry the committed arrays' shardings into the traced
-                # specs so the compiled executable binds the same input
-                # layouts jit would infer — specs from shape/dtype alone
-                # can compile a program that reshards (or fails) at call
-                # time on a multi-chip mesh
-                sh = getattr(a, "sharding", None)
-                if sh is not None:
-                    try:
-                        return jax.ShapeDtypeStruct(a.shape, a.dtype,
-                                                    sharding=sh)
-                    except TypeError:
-                        pass
-                return jax.ShapeDtypeStruct(a.shape, a.dtype)
-
-            spec = jax.tree_util.tree_map(
-                _spec,
+            spec = self._aot_arg_spec(
                 (self.device_data, self.global_vars,
                  self.server_state, jax.random.PRNGKey(0),
                  jnp.zeros((), jnp.int32)))
@@ -895,20 +907,189 @@ class ParrotAPI:
             self._fused_is_plain_jit = True
             return
         self.multi_round_step = compiled
-        if path:
-            # persistence failures must not discard the live executable
-            try:
-                from jax.experimental import serialize_executable
+        self._save_executable(path, compiled)
 
-                blob = serialize_executable.serialize(compiled)
-                tmp = path + ".tmp"
-                with open(tmp, "wb") as f:
-                    pickle.dump(blob, f)
-                os.replace(tmp, path)
-                logging.info("parrot: fused executable cached to %s", path)
+    @staticmethod
+    def _aot_arg_spec(args_tree):
+        """ShapeDtypeStructs for ``trace()`` that carry the committed
+        arrays' shardings — specs from shape/dtype alone can compile a
+        program that reshards (or fails) at call time on a multi-chip
+        mesh."""
+
+        def _spec(a):
+            sh = getattr(a, "sharding", None)
+            if sh is not None:
+                try:
+                    return jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                                sharding=sh)
+                except TypeError:
+                    pass
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        return jax.tree_util.tree_map(_spec, args_tree)
+
+    def _load_executable(self, path: Optional[str]):
+        """Deserialize a cached executable, or None (missing/stale/
+        corrupt/foreign-owned — load failures degrade to a recompile,
+        never abort)."""
+        import os
+        import pickle
+
+        if not path or not os.path.exists(path):
+            return None
+        try:
+            from jax.experimental import serialize_executable
+
+            with open(path, "rb") as f:
+                # fstat the OPEN fd (not the path) so a symlink swap
+                # between check and read can't redirect the unpickle
+                if hasattr(os, "getuid"):
+                    import stat as _stat
+
+                    st = os.fstat(f.fileno())
+                    if (st.st_uid != os.getuid()
+                            or not _stat.S_ISREG(st.st_mode)):
+                        raise PermissionError(
+                            f"{path} not a regular file owned by us; "
+                            "refusing to unpickle")
+                blob = pickle.load(f)
+            return serialize_executable.deserialize_and_load(*blob)
+        except Exception as e:  # stale/corrupt → rebuild
+            logging.warning("parrot: AOT cache load failed (%s); "
+                            "recompiling", e)
+            return None
+
+    def _save_executable(self, path: Optional[str], compiled) -> None:
+        """Serialize ``compiled`` to the shared cache (atomic replace);
+        persistence failures must not discard the live executable."""
+        import os
+        import pickle
+
+        if not path:
+            return
+        try:
+            from jax.experimental import serialize_executable
+
+            blob = serialize_executable.serialize(compiled)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(blob, f)
+            os.replace(tmp, path)
+            logging.info("parrot: executable cached to %s", path)
+        except Exception as e:
+            logging.warning("parrot: AOT cache write failed (%s); "
+                            "executable kept in-memory only", e)
+
+    # ---- per-bucket AOT compile-ahead (warm pool) ---------------------
+
+    def compile_ahead_enabled(self) -> bool:
+        import os
+
+        return bool(getattr(self.args, "parrot_compile_ahead", False)
+                    or os.environ.get("FEDML_TPU_COMPILE_AHEAD"))
+
+    def start_compile_ahead(self, wait: bool = False) -> Dict[str, Any]:
+        """Background warm pool: precompile (or cache-load) every round
+        executable this config can dispatch — the per-round step (``rs``,
+        or ``brs``: ONE program embedding every bucket signature from
+        ``bucket_plan()``) and the fused multi-round scan (``mrs``) —
+        keyed by the ``_aot_cache_path`` digests and shared through
+        ``FEDML_TPU_AOT_CACHE_DIR``.  Round 1 then stops paying compile
+        in the flight log: the wall time lands in the standalone
+        ``compile_ahead`` phase (concurrent with host setup) instead of
+        the first round's ``compile`` bucket, and a second process with
+        the same digest loads the serialized executables outright.
+
+        Returns ``compile_ahead_report`` — ``{tag: {hit, seconds}}``,
+        fully populated once the worker finishes (``wait=True`` blocks)."""
+        t = self._compile_ahead_thread
+        if t is not None:
+            if wait:
+                t.join()
+            return self.compile_ahead_report
+        t = threading.Thread(target=self._compile_ahead_worker,
+                             name="parrot-compile-ahead", daemon=True)
+        self._compile_ahead_thread = t
+        t.start()
+        if wait:
+            t.join()
+        return self.compile_ahead_report
+
+    def _compile_ahead_worker(self) -> None:
+        rep = self.compile_ahead_report
+        try:
+            rep["brs" if self.n_buckets > 1 else "rs"] = \
+                self._warm_step("brs" if self.n_buckets > 1 else "rs")
+            t0 = time.perf_counter()
+            with flight_recorder.phase("compile_ahead",
+                                       program="parrot/fused_round_scan"):
+                self._build_or_load_multi_round_step()
+            rep["mrs"] = {"hit": bool(self.aot_cache_hit),
+                          "seconds": round(time.perf_counter() - t0, 3)}
+            if self.program_costs is None and not self._fused_is_plain_jit:
+                self.program_costs = flight_recorder.note_program(
+                    "parrot/fused_round_scan", self.multi_round_step,
+                    chunk_rounds=self.FUSED_CHUNK_ROUNDS)
+        except Exception as e:  # warm pool must never take the run down
+            rep["error"] = str(e)
+            logging.warning("parrot: compile-ahead worker failed (%s)", e)
+
+    def _warm_step(self, tag: str) -> Dict[str, Any]:
+        """Precompile (or cache-load) one per-round step executable and
+        install it in place of the plain jit, wrapped with a bind-failure
+        fallback."""
+        t0 = time.perf_counter()
+        if tag == "brs":
+            jit_fn = self.bucketed_round_step
+            spec = self._aot_arg_spec(
+                (self.device_data, self.global_vars, self.server_state,
+                 jax.random.PRNGKey(0)))
+        else:
+            jit_fn = self.round_step
+            spec = self._aot_arg_spec(
+                (self.device_data, self.global_vars, self.server_state,
+                 jnp.zeros((self.k,), jnp.int32), jax.random.PRNGKey(0)))
+        path = self._aot_cache_path(tag)
+        compiled = self._load_executable(path)
+        hit = compiled is not None
+        if compiled is None:
+            with flight_recorder.phase(
+                    "compile_ahead", program=f"parrot/round_step_{tag}"):
+                compiled = jit_fn.trace(*spec).lower().compile()
+            self._save_executable(path, compiled)
+        wrapped = self._wrap_step_with_fallback(compiled, jit_fn, tag)
+        if tag == "brs":
+            self.bucketed_round_step = wrapped
+        else:
+            self.round_step = wrapped
+        return {"hit": hit, "seconds": round(time.perf_counter() - t0, 3)}
+
+    def _wrap_step_with_fallback(self, compiled, jit_fn, tag: str):
+        """An AOT executable can reject its args at bind time (layout/
+        sharding drift vs what jit would infer); bind failures leave the
+        donated buffers intact, so fall back to the plain jit once.  An
+        execution failure has already consumed the donation — detect
+        (deleted leaves) and re-raise."""
+        state = {"fn": compiled, "fell_back": False}
+
+        def call(*call_args):
+            if state["fell_back"]:
+                return jit_fn(*call_args)
+            try:
+                return state["fn"](*call_args)
             except Exception as e:
-                logging.warning("parrot: AOT cache write failed (%s); "
-                                "executable kept in-memory only", e)
+                for tree in call_args:
+                    for leaf in jax.tree_util.tree_leaves(tree):
+                        if (hasattr(leaf, "is_deleted")
+                                and leaf.is_deleted()):
+                            raise
+                logging.warning(
+                    "parrot: warm %s executable rejected its args (%s); "
+                    "falling back to plain jit", tag, e)
+                state["fell_back"] = True
+                return jit_fn(*call_args)
+
+        return call
 
     #: rounds per fused call — the scan ALWAYS runs this many iterations
     #: and a traced ``n_active`` masks the tail, so exactly ONE compiled

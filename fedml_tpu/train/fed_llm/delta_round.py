@@ -22,6 +22,7 @@ from typing import Any, Callable, Dict
 import jax
 import jax.numpy as jnp
 
+from ...ops.epilogue import fold_delta
 from ..llm.lora import apply_lora
 
 
@@ -42,15 +43,12 @@ def make_delta_round(alpha: float) -> Callable:
 
     def delta_round(adapters: Any, base_params: Any, agg_delta: Any,
                     server_lr: jnp.ndarray):
-        lr = jnp.asarray(server_lr, jnp.float32)
-
-        def _fold(a: jnp.ndarray, d: jnp.ndarray) -> jnp.ndarray:
-            # f32 add then cast back (agg_stacked/_add_delta_tree
-            # contract): a bf16 adapter tree folds without double rounding
-            return (a.astype(jnp.float32)
-                    + lr * d.astype(jnp.float32)).astype(a.dtype)
-
-        new_adapters = jax.tree_util.tree_map(_fold, adapters, agg_delta)
+        # f32 add then cast back (agg_stacked/_add_delta_tree contract)
+        # through the fused-epilogue kernel family: on TPU each adapter
+        # leaf folds in one pallas HBM pass; the jnp fallback is the
+        # original math bit for bit
+        new_adapters = fold_delta(adapters, agg_delta,
+                                  jnp.asarray(server_lr, jnp.float32))
         merged = apply_lora(base_params, new_adapters, alpha)
         return new_adapters, merged
 

@@ -1,0 +1,498 @@
+"""Parity suite for the fused round epilogue (PR 14): every funnel the
+kernel family replaced must produce the same numbers it did before —
+fused == unfused within 1e-6 (bitwise where dtypes allow), against a
+float64 numpy reference, across weighted/masked/bf16 trees, every
+robust-agg operator, staleness-weighted async folds, and the
+momentum/adam server-optimizer channels round-tripped against optax.
+The pallas kernels run here in interpret mode (no TPU in CI); the jnp
+fallback is the bit-contract both paths are held to.
+
+Plus the cross-process compile-ahead proof: the warm pool's per-round
+executables must land in (and load from) the shared AOT cache so a
+second process skips trace+compile entirely.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import fedml_tpu
+from fedml_tpu.ml.aggregator.agg_operator import (
+    FedMLAggOperator,
+    agg_stacked,
+    fold_buffer,
+    mix_global,
+    weighted_average,
+)
+from fedml_tpu.ml.aggregator.robust import stack_grad_list
+from fedml_tpu.ops import epilogue as ep
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------- helpers
+
+def _stacked(c=5, dtype=jnp.float32, seed=0, with_int=False):
+    """Model-shaped stacked tree with a leading client axis: matrix +
+    bias + scalar-ish leaf, odd sizes to exercise lane padding."""
+    rng = np.random.default_rng(seed)
+
+    def mk(*shape):
+        return jnp.asarray(rng.normal(size=(c,) + shape), dtype)
+
+    tree = {"w": mk(7, 130), "b": mk(9), "s": mk()}
+    if with_int:
+        tree["steps"] = jnp.asarray(rng.integers(0, 50, size=(c,)),
+                                    jnp.int32)
+    return tree
+
+
+def _weights(c=5, seed=1):
+    return jnp.asarray(np.random.default_rng(seed).uniform(0.5, 3.0, c),
+                       jnp.float32)
+
+
+def _np_mean(stacked, weights):
+    """float64 reference weighted mean (normalized weights)."""
+    w = np.asarray(weights, np.float64)
+    w = w / w.sum()
+
+    def _leaf(x):
+        xf = np.asarray(x, np.float64)
+        return np.tensordot(w, xf, axes=(0, 0))
+
+    return jax.tree_util.tree_map(_leaf, stacked)
+
+
+def _assert_close(got, ref, atol=1e-6, rtol=1e-6):
+    for g, r in zip(jax.tree_util.tree_leaves(got),
+                    jax.tree_util.tree_leaves(ref)):
+        np.testing.assert_allclose(np.asarray(g, np.float64),
+                                   np.asarray(r, np.float64),
+                                   atol=atol, rtol=rtol)
+
+
+def _assert_bitwise(got, ref):
+    for g, r in zip(jax.tree_util.tree_leaves(got),
+                    jax.tree_util.tree_leaves(ref)):
+        assert g.dtype == r.dtype
+        assert np.array_equal(np.asarray(g), np.asarray(r)), (g, r)
+
+
+# ------------------------------------------------- weighted_reduce contract
+
+def test_weighted_reduce_matches_numpy_f32():
+    stacked, w = _stacked(), _weights()
+    out = ep.weighted_reduce(stacked, w, prefer_pallas=False)
+    _assert_close(out, _np_mean(stacked, w))
+
+
+def test_weighted_reduce_bf16_casts_back():
+    stacked, w = _stacked(dtype=jnp.bfloat16, seed=3), _weights()
+    out = ep.weighted_reduce(stacked, w, prefer_pallas=False)
+    for leaf in jax.tree_util.tree_leaves(out):
+        assert leaf.dtype == jnp.bfloat16
+    # accumulation happened in f32: only the final cast loses precision
+    _assert_close(out, _np_mean(stacked, w), atol=1e-2, rtol=1e-2)
+
+
+def test_weighted_reduce_int_leaf_keeps_f32():
+    stacked, w = _stacked(with_int=True), _weights()
+    out = ep.weighted_reduce(stacked, w, prefer_pallas=False)
+    assert out["steps"].dtype == jnp.float32
+    _assert_close(out["steps"], _np_mean(stacked, w)["steps"])
+
+
+def test_masked_weights_exclude_clients():
+    """Zero-weight clients must not influence the mean — the masked
+    cohort form every padded plane relies on."""
+    stacked, w = _stacked(c=6, seed=5), _weights(6)
+    mask = jnp.asarray([0.0, 1.0, 1.0, 0.0, 1.0, 0.0], jnp.float32)
+    masked = ep.weighted_reduce(stacked, w * mask, prefer_pallas=False)
+    keep = [1, 2, 4]
+    sub = jax.tree_util.tree_map(lambda x: x[jnp.asarray(keep)], stacked)
+    ref = ep.weighted_reduce(sub, w[jnp.asarray(keep)],
+                             prefer_pallas=False)
+    _assert_close(masked, ref)
+
+
+# ---------------------------------------- fused == unfused (compose parity)
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_fused_none_bitwise_equals_reduce_then_mix(dtype):
+    """spec=none is reduce + mix_global collapsed into one pass — the
+    composition must be BITWISE identical (same ops, same order)."""
+    stacked, w = _stacked(dtype=dtype, seed=7), _weights()
+    g = jax.tree_util.tree_map(lambda x: x[0], _stacked(dtype=dtype,
+                                                        seed=8))
+    lr = 0.5
+    fused, st = ep.fused_epilogue(g, stacked, w, lr, ep.NONE_SPEC,
+                                  prefer_pallas=False)
+    assert st is None
+    acc = ep.weighted_reduce(stacked, w, prefer_pallas=False)
+
+    def _mix(gl, al):
+        gf = gl.astype(jnp.float32)
+        af = al.astype(jnp.float32)
+        return (gf + jnp.float32(lr) * (af - gf)).astype(gl.dtype)
+
+    _assert_bitwise(fused, jax.tree_util.tree_map(_mix, g, acc))
+
+
+def test_fused_server_lr_one_replaces_global():
+    stacked, w = _stacked(seed=11), _weights()
+    g = jax.tree_util.tree_map(lambda x: x[0] * 0 + 99.0, stacked)
+    fused, _ = ep.fused_epilogue(g, stacked, w, 1.0, ep.NONE_SPEC,
+                                 prefer_pallas=False)
+    # f32 mix g + 1·(acc − g) cancels around the magnitude of g (99):
+    # replacement up to |g|·eps_f32, not bitwise
+    _assert_close(fused, _np_mean(stacked, w), atol=2e-5, rtol=1e-5)
+
+
+# ------------------------------------------------ pallas interpret parity
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_pallas_reduce_matches_jnp(dtype):
+    stacked, w = _stacked(dtype=dtype, seed=13), _weights()
+    pl = ep.weighted_reduce(stacked, w, prefer_pallas=True,
+                            interpret=True)
+    ref = ep.weighted_reduce(stacked, w, prefer_pallas=False)
+    _assert_close(pl, ref, atol=2e-6, rtol=2e-6)
+
+
+@pytest.mark.parametrize("opt", ["none", "sgd", "momentum", "adam"])
+def test_pallas_epilogue_matches_jnp(opt):
+    stacked, w = _stacked(seed=17), _weights()
+    g = jax.tree_util.tree_map(lambda x: x[0], _stacked(seed=18))
+    spec = ep.EpilogueSpec(opt=opt, lr=0.1)
+    st = ep.init_opt_state(g, spec)
+    pl, pl_st = ep.fused_epilogue(g, stacked, w, 0.7, spec, st,
+                                  prefer_pallas=True, interpret=True)
+    jn, jn_st = ep.fused_epilogue(g, stacked, w, 0.7, spec, st,
+                                  prefer_pallas=False)
+    _assert_close(pl, jn, atol=2e-6, rtol=2e-6)
+    if st is not None:
+        _assert_close(
+            [l for l in jax.tree_util.tree_leaves(pl_st)],
+            [l for l in jax.tree_util.tree_leaves(jn_st)],
+            atol=2e-6, rtol=2e-6)
+
+
+def test_pallas_fold_delta_matches_jnp():
+    g = jax.tree_util.tree_map(lambda x: x[0], _stacked(seed=19))
+    d = jax.tree_util.tree_map(lambda x: x[1], _stacked(seed=20))
+    pl = ep.fold_delta(g, d, 0.3, prefer_pallas=True, interpret=True)
+    jn = ep.fold_delta(g, d, 0.3, prefer_pallas=False)
+    _assert_close(pl, jn, atol=2e-6, rtol=2e-6)
+
+
+# ------------------------------------- FedMLAggOperator routing equivalence
+
+def _grad_list(c=5, dtype=jnp.float32, seed=0):
+    stacked = _stacked(c=c, dtype=dtype, seed=seed)
+    ns = np.random.default_rng(seed + 100).integers(10, 90, c)
+    return [(float(ns[i]),
+             jax.tree_util.tree_map(lambda x: x[i], stacked))
+            for i in range(c)]
+
+
+def _args(**kw):
+    base = dict(federated_optimizer="FedAvg", fused_epilogue=True,
+                client_num_in_total=5)
+    base.update(kw)
+    return fedml_tpu.Config(**base)
+
+
+def test_agg_fused_matches_legacy_weighted_average_f32():
+    gl = _grad_list()
+    fused = FedMLAggOperator.agg(_args(), gl)
+    legacy = FedMLAggOperator.agg(_args(fused_epilogue=False), gl)
+    _assert_close(fused, legacy)
+    # and the flag really flips the route: legacy == eager funnel exactly
+    _assert_bitwise(legacy, weighted_average(gl))
+
+
+def test_agg_fused_matches_legacy_weighted_average_bf16():
+    gl = _grad_list(dtype=jnp.bfloat16, seed=2)
+    fused = FedMLAggOperator.agg(_args(), gl)
+    legacy = FedMLAggOperator.agg(_args(fused_epilogue=False), gl)
+    # legacy accumulates eagerly in bf16; fused holds f32 until the final
+    # cast — fused is the MORE accurate one, so compare both to f64
+    ref = _np_mean([g for _, g in [(1, stack_grad_list(
+        [g for _, g in gl]))]][0], jnp.asarray([n for n, _ in gl]))
+    _assert_close(fused, ref, atol=3e-2, rtol=3e-2)
+    _assert_close(legacy, ref, atol=3e-2, rtol=3e-2)
+
+
+def test_agg_zero_total_uniform_fallback():
+    gl = [(0.0, g) for _, g in _grad_list(seed=4)]
+    fused = FedMLAggOperator.agg(_args(), gl)
+    legacy = FedMLAggOperator.agg(_args(fused_epilogue=False), gl)
+    _assert_close(fused, legacy)
+    uni = _np_mean(stack_grad_list([g for _, g in gl]),
+                   jnp.ones((len(gl),)))
+    _assert_close(fused, uni)
+
+
+@pytest.mark.parametrize("op", ["trimmed_mean:0.2", "median", "krum:1",
+                                "multi_krum:1:3", "geo_median",
+                                "norm_clip:1.0"])
+def test_agg_robust_ops_unaffected_by_fused_flag(op):
+    """Robust rounds bypass the fused channel entirely — both flag
+    states must take the identical stacked-operator path."""
+    gl = _grad_list(seed=6)
+    center = jax.tree_util.tree_map(lambda x: x[0],
+                                    _stacked(seed=9))
+    on = FedMLAggOperator.agg(_args(robust_agg=op), gl, center=center)
+    off = FedMLAggOperator.agg(_args(robust_agg=op, fused_epilogue=False),
+                               gl, center=center)
+    _assert_bitwise(on, off)
+
+
+@pytest.mark.parametrize("opt", ["SCAFFOLD", "Mime"])
+def test_agg_pair_payloads_fused_parity(opt):
+    """(params, extra) pair payloads: fused flag must only change the
+    reduction's accumulation path, never the pair plumbing."""
+    c = 4
+    ps = _stacked(c=c, seed=21)
+    ex = _stacked(c=c, seed=22)
+    ns = [17.0, 3.0, 40.0, 8.0]
+    gl = [(ns[i], (jax.tree_util.tree_map(lambda x: x[i], ps),
+                   jax.tree_util.tree_map(lambda x: x[i], ex)))
+          for i in range(c)]
+    a_on = _args(federated_optimizer=opt, client_num_in_total=c)
+    a_off = _args(federated_optimizer=opt, client_num_in_total=c,
+                  fused_epilogue=False)
+    on_p, on_e = FedMLAggOperator.agg(a_on, gl)
+    off_p, off_e = FedMLAggOperator.agg(a_off, gl)
+    _assert_close(on_p, off_p)
+    _assert_close(on_e, off_e)
+    _assert_close(on_p, _np_mean(ps, jnp.asarray(ns)))
+
+
+# ----------------------------------------------- async staleness-weighted
+
+def test_fold_buffer_matches_legacy_reduce_mix_chain():
+    """The buffered-async fold (one fused pass) against the pre-fusion
+    chain: staleness-decayed weighted mean, then mix_global."""
+    stacked, w = _stacked(c=6, seed=23), None
+    staleness = jnp.asarray([1.0, 0.5, 0.25, 1.0, 0.125, 0.5],
+                            jnp.float32)
+    counts = jnp.asarray([30, 12, 44, 8, 20, 16], jnp.float32)
+    w = staleness * counts
+    g = jax.tree_util.tree_map(lambda x: x[0], _stacked(seed=24))
+    for lr in (1.0, 0.5):
+        fused = fold_buffer(g, stacked, w, lr)
+        legacy = mix_global(
+            g,
+            jax.tree_util.tree_map(
+                lambda x: jnp.asarray(x, jnp.float32),
+                _np_mean(stacked, w)),
+            lr)
+        _assert_close(fused, legacy)
+
+
+def test_agg_stacked_is_weighted_reduce():
+    stacked, w = _stacked(seed=25), _weights()
+    _assert_bitwise(agg_stacked(stacked, w),
+                    ep.weighted_reduce(stacked, w))
+
+
+# --------------------------------------- server-optimizer state roundtrips
+
+def _optax_run(tx, g, grads_per_step):
+    params = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(x, jnp.float32), g)
+    state = tx.init(params)
+    for grad in grads_per_step:
+        upd, state = tx.update(grad, state, params)
+        params = optax.apply_updates(params, upd)
+    return params
+
+
+@pytest.mark.parametrize("opt,mk_tx", [
+    ("sgd", lambda lr: optax.sgd(lr)),
+    ("momentum", lambda lr: optax.sgd(lr, momentum=0.9)),
+    ("adam", lambda lr: optax.adam(lr)),
+])
+def test_optimizer_channel_roundtrips_against_optax(opt, mk_tx):
+    """Multi-step: the fused channel's threaded state must track optax
+    exactly — pseudo-grad server_lr·(global − agg) into the standard
+    update at spec.lr."""
+    steps, lr, server_lr = 4, 0.05, 0.8
+    g = jax.tree_util.tree_map(lambda x: x[0], _stacked(seed=30))
+    spec = ep.EpilogueSpec(opt=opt, lr=lr)
+    st = ep.init_opt_state(g, spec)
+    cur = g
+    grads = []
+    for k in range(steps):
+        stacked, w = _stacked(seed=40 + k), _weights(seed=50 + k)
+        acc = ep.weighted_reduce(stacked, w, prefer_pallas=False)
+        grads.append(jax.tree_util.tree_map(
+            lambda gl, al: jnp.float32(server_lr)
+            * (gl.astype(jnp.float32) - al.astype(jnp.float32)),
+            cur, acc))
+        cur, st = ep.fused_epilogue(cur, stacked, w, server_lr, spec, st,
+                                    prefer_pallas=False)
+    ref = _optax_run(mk_tx(lr), g, grads)
+    # NOTE: grads were built from the FUSED trajectory's params, so this
+    # only matches if every intermediate step matched too
+    _assert_close(cur, ref)
+    if opt == "adam":
+        assert int(st["t"]) == steps
+    if st is not None:
+        for leaf in jax.tree_util.tree_leaves(st):
+            assert np.isfinite(np.asarray(leaf, np.float64)).all()
+
+
+def test_adam_state_threads_bias_correction():
+    """First step from zero state: adam's bias-corrected update must be
+    lr-scaled sign(grad)-ish, not the uncorrected tiny step."""
+    g = {"w": jnp.ones((4, 4), jnp.float32)}
+    stacked = {"w": jnp.zeros((3, 4, 4), jnp.float32)}
+    spec = ep.EpilogueSpec(opt="adam", lr=0.1)
+    st = ep.init_opt_state(g, spec)
+    out, st2 = ep.fused_epilogue(g, stacked, jnp.ones((3,)), 1.0, spec,
+                                 st, prefer_pallas=False)
+    # grad = 1·(1 − 0) = 1 everywhere → first adam step ≈ −lr
+    np.testing.assert_allclose(np.asarray(out["w"]), 1.0 - 0.1,
+                               atol=1e-5)
+    assert int(st2["t"]) == 1
+
+
+# ----------------------------------------------------------- spec_from_args
+
+def test_spec_from_args_mapping():
+    mk = fedml_tpu.Config
+    assert ep.spec_from_args(mk(server_optimizer="adam",
+                                server_lr=0.01)).opt == "adam"
+    s = ep.spec_from_args(mk(server_optimizer="sgd", server_lr=0.5,
+                             server_momentum=0.9))
+    assert s.opt == "momentum" and s.momentum == 0.9 and s.lr == 0.5
+    assert ep.spec_from_args(mk(server_optimizer="sgd", server_lr=0.5,
+                                server_momentum=0.0)).opt == "sgd"
+    assert ep.spec_from_args(mk(server_optimizer="yogi")) is None
+    assert ep.spec_from_args(mk(server_optimizer="adam",
+                                fused_epilogue=False)) is None
+
+
+def test_unknown_epilogue_opt_raises():
+    g = {"w": jnp.ones((2,), jnp.float32)}
+    with pytest.raises(ValueError):
+        ep.fused_epilogue(g, {"w": jnp.ones((2, 2))}, jnp.ones((2,)),
+                          1.0, ep.EpilogueSpec(opt="rmsprop"))
+
+
+# --------------------------------------------------- compile-ahead warm pool
+
+def _make_api(args_factory, cache_dir, **kw):
+    from fedml_tpu.runner import FedMLRunner
+
+    args = fedml_tpu.init(args_factory(
+        backend="parrot", dataset="mnist", model="lr", data_scale=0.05,
+        client_num_in_total=4, client_num_per_round=4, comm_round=2,
+        aot_cache_dir=str(cache_dir), parrot_compile_ahead=True, **kw))
+    dataset = fedml_tpu.data.load(args)
+    bundle = fedml_tpu.model.create(args, dataset[-1])
+    return FedMLRunner(args, None, dataset, bundle).runner
+
+
+def test_compile_ahead_warms_step_and_scan(args_factory, tmp_path):
+    """The warm pool must precompile BOTH dispatchable programs (per-round
+    step + fused scan), write their artifacts, and a second API instance
+    must load every one of them (all hits)."""
+    api = _make_api(args_factory, tmp_path)
+    rep = api.start_compile_ahead(wait=True)
+    assert "error" not in rep, rep
+    assert set(rep) == {"rs", "mrs"} and not rep["rs"]["hit"]
+    arts = sorted(f for f in os.listdir(tmp_path) if f.endswith(".jaxexp"))
+    assert any(f.startswith("parrot_rs_") for f in arts), arts
+    assert any(f.startswith("parrot_mrs_") for f in arts), arts
+    # the warmed executables actually run and train
+    rms = api.run_rounds_fused(2)
+    assert np.isfinite(np.asarray(rms["train_loss"])).all()
+
+    warm = _make_api(args_factory, tmp_path)
+    rep2 = warm.start_compile_ahead(wait=True)
+    assert "error" not in rep2, rep2
+    assert rep2["rs"]["hit"] and rep2["mrs"]["hit"], rep2
+    rms2 = warm.run_rounds_fused(2)
+    np.testing.assert_allclose(np.asarray(rms2["train_loss"]),
+                               np.asarray(rms["train_loss"]), atol=1e-6)
+
+
+def test_compile_ahead_idempotent_and_joined_by_ensure(args_factory,
+                                                      tmp_path):
+    """start twice → one worker; _ensure_multi_round_step must JOIN the
+    in-flight warm thread instead of racing a second compile."""
+    api = _make_api(args_factory, tmp_path)
+    api.start_compile_ahead()
+    t = api._compile_ahead_thread
+    api.start_compile_ahead()
+    assert api._compile_ahead_thread is t
+    api._ensure_multi_round_step()          # joins, never double-builds
+    assert not t.is_alive()
+    assert api.multi_round_step is not None
+
+
+_CHILD = textwrap.dedent("""
+    import json, os, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["FEDML_TPU_AOT_CACHE_DIR"] = {cache!r}
+    sys.path.insert(0, {repo!r})
+    import fedml_tpu
+    from fedml_tpu.runner import FedMLRunner
+    import numpy as np
+    args = fedml_tpu.init(fedml_tpu.Config(
+        dataset="mnist", model="lr", backend="parrot", data_scale=0.05,
+        client_num_in_total=4, client_num_per_round=4, comm_round=2,
+        epochs=1, batch_size=16, learning_rate=0.1,
+        enable_tracking=False, compute_dtype="float32",
+        parrot_compile_ahead=True))
+    dataset = fedml_tpu.data.load(args)
+    bundle = fedml_tpu.model.create(args, dataset[-1])
+    api = FedMLRunner(args, None, dataset, bundle).runner
+    t0 = time.time()
+    rep = api.start_compile_ahead(wait=True)
+    ready_s = time.time() - t0
+    rms = api.run_rounds_fused(2)
+    print("WARMPROOF " + json.dumps({{
+        "report": rep, "ready_s": ready_s,
+        "loss0": float(np.asarray(rms["train_loss"])[0])}}))
+""")
+
+
+@pytest.mark.slow
+def test_compile_ahead_shared_cache_across_processes(tmp_path):
+    """The committed cross-process proof of compile-ahead: a SECOND
+    process pointed at the same FEDML_TPU_AOT_CACHE_DIR must load every
+    warm-pool executable (all hits), get ready several x faster, and
+    train to the same first-round loss."""
+    cache = str(tmp_path / "aot")
+    script = _CHILD.format(repo=REPO, cache=cache)
+
+    def run():
+        out = subprocess.run([sys.executable, "-c", script],
+                             capture_output=True, text=True, timeout=600,
+                             cwd=REPO)
+        for ln in out.stdout.splitlines():
+            if ln.startswith("WARMPROOF "):
+                return json.loads(ln[len("WARMPROOF "):])
+        raise AssertionError(out.stderr[-3000:])
+
+    cold = run()
+    warm = run()
+    assert "error" not in cold["report"], cold
+    assert "error" not in warm["report"], warm
+    assert not cold["report"]["rs"]["hit"]
+    assert warm["report"]["rs"]["hit"] and warm["report"]["mrs"]["hit"]
+    assert warm["ready_s"] < cold["ready_s"] * 0.6, (cold, warm)
+    assert warm["loss0"] == pytest.approx(cold["loss0"], abs=1e-6)
