@@ -61,6 +61,24 @@ def _npz_is_current() -> bool:
         return False
 
 
+def _record_perf_history(label: str, metrics: dict) -> None:
+    """Append this run's headline to benchmarks/perf_history.jsonl so
+    `fedml perf regress` can flag regressions and stale carried numbers;
+    bookkeeping must never fail the bench."""
+    try:
+        import jax
+
+        from fedml_tpu.core.mlops import perf_history
+
+        perf_history.append_entry(
+            os.path.join(HERE, *perf_history.DEFAULT_HISTORY.split(os.sep)),
+            platform=jax.default_backend(), source="bench.py",
+            label=label, measured=True,
+            metrics={k: v for k, v in metrics.items() if v is not None})
+    except Exception as e:  # noqa: BLE001
+        print(f"perf-history append failed: {e}", file=sys.stderr)
+
+
 def main() -> None:
     if not _npz_is_current():
         # regenerate on version drift too: a stale pre-hard cache would
@@ -370,6 +388,12 @@ def main() -> None:
     except Exception:  # noqa: BLE001 — optional artifact
         pass
 
+    _record_perf_history(
+        label=result["metric"],
+        metrics={"rounds_per_s": rounds_per_sec,
+                 "measured_mfu": mfu,
+                 "tokens_per_s": result.get("llm_sft_tokens_per_sec")})
+
     print(json.dumps(result))
     if acc < TARGET_TEST_ACC:
         print(f"ACCURACY GUARD FAILED: {acc:.4f} < {TARGET_TEST_ACC}",
@@ -539,6 +563,10 @@ def main_hyperscale(n_clients: int, rounds: int) -> None:
         "flight_log": os.path.relpath(
             os.path.join(flight_dir, "flight.jsonl"), HERE),
     }
+    _record_perf_history(
+        label=result["metric"],
+        metrics={"clients_per_s": float(st["clients_per_sec"])})
+
     print(json.dumps(result))
     if not st["h2d_share"] < st_seq["h2d_share"]:
         print(f"OVERLAP GUARD FAILED: streamed h2d share "

@@ -144,6 +144,16 @@ _DEFAULTS: Dict[str, Any] = {
     # FEDML_TPU_FLIGHT_RECORDER=1 overrides
     "flight_recorder": False,
     "flight_max_records": 0,         # 0 → module default (4096)
+    # run ledger (docs/OBSERVABILITY.md "Run ledger"): opt-in cross-plane
+    # per-round event log + anatomy correlator; env toggle
+    # FEDML_TPU_RUN_LEDGER=1 overrides
+    "run_ledger": False,
+    "ledger_max_records": 0,         # 0 → module default (16384)
+    "trace_max_spans": 0,            # spans.jsonl cap (0 → default 16384)
+    # declarative SLO engine: path to slo.yaml evaluated at round
+    # boundaries (env FEDML_TPU_SLO_RULES); breaches inc
+    # fedml_slo_breaches_total and ledger `breach` events
+    "slo_rules": None,
     # hyper-scale simulation (backend="hyperscale", docs/HYPERSCALE.md):
     # double-buffered host→device cohort streaming over a virtual
     # 10⁵–10⁶-client population

@@ -607,17 +607,25 @@ def lint(fmt: str, baseline: str, update_baseline: bool, paths,
               help="control-plane base URL to scrape "
                    "(e.g. http://127.0.0.1:8899); default: this process's "
                    "local registry")
-def metrics(url: str) -> None:
+@click.option("--json", "as_json", is_flag=True,
+              help="parse the exposition text and emit one JSON object "
+                   "keyed by metric (type, help, samples, histogram "
+                   "series) instead of raw text")
+def metrics(url: str, as_json: bool) -> None:
     """Dump Prometheus-format metrics — from a running control plane's
     GET /metrics when --url is given, else the local typed registry."""
+    from ..core.mlops import metrics as m
+
     if url:
         from ..scheduler.control_plane import ControlPlaneClient
 
-        click.echo(ControlPlaneClient(url).metrics_text(), nl=False)
-        return
-    from ..core.mlops import metrics as m
-
-    click.echo(m.render_prometheus(), nl=False)
+        text = ControlPlaneClient(url).metrics_text()
+    else:
+        text = m.render_prometheus()
+    if as_json:
+        click.echo(json.dumps(m.parse_prometheus(text), indent=2))
+    else:
+        click.echo(text, nl=False)
 
 
 @cli.group()
@@ -745,6 +753,172 @@ def perf_programs(entries, root: str, as_json: bool,
     else:
         for name, info in sorted(costs.items()):
             click.echo(json.dumps(dict(info, program=name)))
+
+
+@perf.command("history")
+@click.option("--history", "history_path", default=None,
+              type=click.Path(exists=True),
+              help="perf history file (default: "
+                   "benchmarks/perf_history.jsonl next to the checkout)")
+@click.option("--json", "as_json", is_flag=True,
+              help="emit the raw entries instead of the table")
+def perf_history_cmd(history_path: str, as_json: bool) -> None:
+    """Benchmark headline history with provenance: one row per recorded
+    run — platform, rev, measured vs carried, headline metrics."""
+    from ..core.mlops import perf_history
+
+    entries = perf_history.load_history(history_path)
+    if not entries:
+        raise click.ClickException("no perf history entries found")
+    if as_json:
+        for e in entries:
+            click.echo(json.dumps(e))
+    else:
+        click.echo(perf_history.render_history(entries))
+
+
+@perf.command("regress")
+@click.option("--history", "history_path", default=None,
+              type=click.Path(exists=True),
+              help="perf history file (default: "
+                   "benchmarks/perf_history.jsonl next to the checkout)")
+@click.option("--drop-threshold", default=0.10, type=float,
+              help="fractional drop between the two newest measured "
+                   "values of a headline metric that counts as a "
+                   "regression (default 0.10)")
+@click.option("--allow-stale", is_flag=True,
+              help="do not fail on carried (unmeasured) headline entries")
+@click.option("--json", "as_json", is_flag=True,
+              help="emit the findings dict instead of the rendered lines")
+def perf_regress(history_path: str, drop_threshold: float,
+                 allow_stale: bool, as_json: bool) -> None:
+    """Perf-regression sentinel over the recorded history.
+
+    Exit 1 when any headline metric regressed past --drop-threshold on
+    some platform, or (unless --allow-stale) when a platform's newest
+    headline is a carried number nobody has re-measured."""
+    from ..core.mlops import perf_history
+
+    entries = perf_history.load_history(history_path)
+    if not entries:
+        raise click.ClickException("no perf history entries found")
+    findings = perf_history.detect(entries, drop_threshold=drop_threshold)
+    if as_json:
+        click.echo(json.dumps(findings, indent=2))
+    else:
+        click.echo(perf_history.render_findings(findings))
+    failed = bool(findings["regressions"]) or (
+        not allow_stale and bool(findings["stale"]))
+    if failed:
+        raise SystemExit(1)
+
+
+@cli.group()
+def rounds() -> None:
+    """Round-anatomy views over a run's ledger.jsonl — the correlator
+    join of ledger events, flight log and tracing spans
+    (docs/OBSERVABILITY.md "Run ledger")."""
+
+
+def _load_anatomy_or_die(log_dir: str):
+    from ..core.mlops import ledger
+
+    anatomy = ledger.load_anatomy(log_dir)
+    if not anatomy["rounds"] and not anatomy["ledger_events"]:
+        raise click.ClickException(
+            f"no ledger.jsonl under {log_dir} — run with run_ledger: true "
+            "or FEDML_TPU_RUN_LEDGER=1")
+    return anatomy
+
+
+@rounds.command("report")
+@click.option("--log-dir", required=True, type=click.Path(exists=True),
+              help="run log directory containing ledger.jsonl")
+@click.option("--json", "as_json", is_flag=True,
+              help="emit the anatomy dict instead of the table")
+def rounds_report(log_dir: str, as_json: bool) -> None:
+    """One row per round: wall time, close reason, reported/expected,
+    quarantines, retransmits, deadline drops — plus the flight-recorder
+    footer when flight.jsonl is present."""
+    from ..core.mlops import ledger
+
+    anatomy = _load_anatomy_or_die(log_dir)
+    if as_json:
+        click.echo(json.dumps(anatomy, default=str))
+    else:
+        click.echo(ledger.render_report(anatomy))
+
+
+@rounds.command("timeline")
+@click.option("--log-dir", required=True, type=click.Path(exists=True))
+@click.option("--round", "round_idx", default=None, type=int,
+              help="render only this round (default: all)")
+def rounds_timeline(log_dir: str, round_idx) -> None:
+    """Per-round per-client anatomy: when each client was solicited, when
+    its upload arrived, retransmits/dups on its link, and its admission
+    verdict or straggler fate."""
+    from ..core.mlops import ledger
+
+    anatomy = _load_anatomy_or_die(log_dir)
+    click.echo(ledger.render_timeline(anatomy, round_idx=round_idx))
+
+
+@rounds.command("stragglers")
+@click.option("--log-dir", required=True, type=click.Path(exists=True))
+def rounds_stragglers(log_dir: str) -> None:
+    """Per-client aggregate across all rounds, worst-offenders first:
+    deadline drops, heartbeat deaths, retransmits, quarantines."""
+    from ..core.mlops import ledger
+
+    anatomy = _load_anatomy_or_die(log_dir)
+    click.echo(ledger.render_stragglers(anatomy))
+
+
+@cli.group()
+def slo() -> None:
+    """Declarative SLO rules over the metrics registry and run artifacts
+    (docs/OBSERVABILITY.md "SLO engine")."""
+
+
+@slo.command("check")
+@click.option("--rules", "rules_path", required=True,
+              type=click.Path(exists=True),
+              help="YAML rules file (top-level `slos:` list)")
+@click.option("--log-dir", default=None, type=click.Path(exists=True),
+              help="run log directory — enables ledger/flight artifact "
+                   "fallbacks for indicators with no live metrics")
+@click.option("--metrics", "metrics_file", default=None,
+              type=click.Path(exists=True),
+              help="Prometheus exposition text file to evaluate against "
+                   "(default: this process's local registry)")
+@click.option("--json", "as_json", is_flag=True,
+              help="emit the per-rule results instead of the lines")
+def slo_check(rules_path: str, log_dir: str, metrics_file: str,
+              as_json: bool) -> None:
+    """Evaluate every rule and exit 1 on any breach.
+
+    Rules whose indicator has no data are SKIPPED, not breached — a
+    clean tiny run passes a full rule file."""
+    from ..core.mlops import slo as slo_mod
+
+    try:
+        rules = slo_mod.load_rules(rules_path)
+    except ValueError as e:
+        raise click.ClickException(str(e))
+    if not rules:
+        raise click.ClickException(f"no rules in {rules_path}")
+    if log_dir or metrics_file:
+        ctx = slo_mod.SLOContext.from_artifacts(
+            log_dir=log_dir, metrics_file=metrics_file)
+    else:
+        ctx = slo_mod.SLOContext.live()
+    results = slo_mod.evaluate(rules, ctx)
+    if as_json:
+        click.echo(json.dumps(results, indent=2))
+    else:
+        click.echo(slo_mod.render_results(results))
+    if slo_mod.breaches(results):
+        raise SystemExit(1)
 
 
 @cli.group()

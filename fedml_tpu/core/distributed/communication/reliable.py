@@ -49,7 +49,7 @@ import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
-from ...mlops import metrics
+from ...mlops import ledger, metrics
 from .base_com_manager import BaseCommunicationManager
 from .message import Message
 from .observer import Observer
@@ -224,6 +224,9 @@ class ReliableCommManager(BaseCommunicationManager, Observer):
             _inflight_gauge.labels(rank=self._rank_label).set(n_inflight)
             for seq, msg in expired:
                 _expired_total.labels(rank=self._rank_label).inc()
+                ledger.event("reliable", "expired", rank=self.rank,
+                             peer=msg.get_receiver_id(), seq=int(seq),
+                             msg_type=str(msg.get_type()))
                 logging.warning(
                     "reliable[%d]: giving up on seq=%d (%s → %d) after %.1fs "
                     "without ACK — recovery is now the round timer / failure "
@@ -231,6 +234,9 @@ class ReliableCommManager(BaseCommunicationManager, Observer):
                     msg.get_receiver_id(), self.retx_deadline_s)
             for msg in resend:
                 _retransmits_total.labels(rank=self._rank_label).inc()
+                ledger.event("reliable", "retransmit", rank=self.rank,
+                             peer=msg.get_receiver_id(),
+                             msg_type=str(msg.get_type()))
                 try:
                     self.inner.send_message(msg)
                 except Exception:
@@ -278,6 +284,9 @@ class ReliableCommManager(BaseCommunicationManager, Observer):
                 self.stats["dup_suppressed"] += 1
         if duplicate:
             _dup_suppressed_total.labels(rank=self._rank_label).inc()
+            ledger.event("reliable", "dup", rank=self.rank, peer=sender,
+                         seq=key[1], epoch=key[0],
+                         msg_type=str(msg_type))
             logging.debug("reliable[%d]: suppressed duplicate %s from %d "
                           "(epoch=%d seq=%d)", self.rank, msg_type, sender,
                           key[0], key[1])

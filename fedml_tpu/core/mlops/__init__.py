@@ -44,9 +44,13 @@ def init(args: Any) -> None:
         _state["enabled"] = bool(getattr(args, "enable_tracking", True))
         _state["log_dir"] = log_dir
         _state["run_id"] = str(getattr(args, "run_id", "0"))
-    # the flight recorder is opt-in and independent of enable_tracking —
-    # bench runs record phases with the JSONL event pipeline off
+    # the flight recorder, run ledger and SLO engine are opt-in and
+    # independent of enable_tracking — bench runs record phases with the
+    # JSONL event pipeline off
     flight_recorder.configure(args, log_dir=log_dir)
+    ledger.configure(args, log_dir=log_dir)
+    slo.configure(args, log_dir=log_dir)
+    tracing.configure(args)
     if getattr(args, "enable_wandb", False):
         _try_add_wandb(args)
 
@@ -65,6 +69,9 @@ def reset() -> None:
         _state["sinks"] = []
         _state["enabled"] = False
     flight_recorder.reset()
+    ledger.reset()
+    slo.reset()
+    tracing.reset_sink()
 
 
 def shutdown() -> None:
@@ -236,5 +243,8 @@ def _try_add_wandb(args: Any) -> None:
 # into this module's _emit at runtime): `mlops.tracing.span(...)`,
 # `mlops.metrics.counter(...)`, `mlops.flight_recorder.record_round(...)`
 from . import flight_recorder  # noqa: E402,F401
+from . import ledger  # noqa: E402,F401
 from . import metrics  # noqa: E402,F401
+from . import perf_history  # noqa: E402,F401
+from . import slo  # noqa: E402,F401
 from . import tracing  # noqa: E402,F401

@@ -557,10 +557,32 @@ def programs() -> Dict[str, Dict[str, Any]]:
 
 # -- flight-log analysis (the `fedml perf report` / `diff` backend) ----------
 
+def locate_flight_log(path: str) -> Optional[str]:
+    """Resolve a flight-log path from a file OR a run/log directory.
+    A directory without a direct ``flight.jsonl`` is searched one and
+    two levels down (``.bench_flight/<ts>/flight.jsonl``,
+    ``logs/<job>/<run>/flight.jsonl``), newest mtime winning."""
+    if not os.path.isdir(path):
+        return path if os.path.exists(path) else None
+    direct = os.path.join(path, "flight.jsonl")
+    if os.path.exists(direct):
+        return direct
+    import glob
+
+    candidates = (glob.glob(os.path.join(path, "*", "flight.jsonl"))
+                  + glob.glob(os.path.join(path, "*", "*", "flight.jsonl")))
+    if not candidates:
+        return None
+    return max(candidates, key=os.path.getmtime)
+
+
 def load_flight_log(path: str) -> List[Dict[str, Any]]:
-    """Parse a flight log — accepts the jsonl file or a run log dir."""
-    if os.path.isdir(path):
-        path = os.path.join(path, "flight.jsonl")
+    """Parse a flight log — accepts the jsonl file or a run log dir
+    (auto-located via ``locate_flight_log``)."""
+    located = locate_flight_log(path)
+    if located is None:
+        return []
+    path = located
     if not os.path.exists(path):
         return []
     records = []

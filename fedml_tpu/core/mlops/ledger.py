@@ -1,0 +1,569 @@
+"""Run ledger — append-only cross-plane event log + per-round anatomy.
+
+The flight recorder (`flight_recorder.py`) answers *where a round's wall
+time goes*; the metrics plane answers *how much of everything happened*.
+Neither can answer the operator's first question when a run misbehaves:
+**"what happened to client 3 in round 7?"** — the evidence is scattered
+across the server manager's logs, the reliable wrapper's retransmit
+counters, the aggregator's quarantine dict and the async funnel's
+outcome metric, none of it joinable after the fact.
+
+This module makes that correlation a first-class artifact.  Every plane
+appends structured events to one per-run, bounded, append-only JSONL
+ledger (``<log_dir>/ledger.jsonl``)::
+
+    {ts_mono, ts, run_id, round_idx, actor, event, attrs}
+
+* ``actor`` is the emitting plane (``server`` / ``aggregator`` /
+  ``async`` / ``reliable`` / ``scheduler`` / ``hyperscale`` /
+  ``serving`` / ``slo``);
+* ``round_idx`` is present when the emitter knows it (server lifecycle,
+  admission verdicts, async folds); transport events carry ``None`` and
+  are attributed to a round by the correlator via their ``ts_mono``
+  falling inside a round's window;
+* per-client events carry ``client`` (comm rank) in ``attrs``.
+
+The event vocabulary (docs/OBSERVABILITY.md "Run ledger" has the full
+schema): server round lifecycle (``round_start`` / ``solicit`` /
+``receive`` / ``round_close`` / ``deadline_drop`` / ``heartbeat_dead``
+/ ``late_join`` / ``preempt`` / ``run_finish``), admission verdicts
+(``admitted`` / ``quarantined{reason}`` / ``duplicate``), async funnel
+outcomes (``fold`` / ``flush`` / ``park`` / ``expired``), reliable-layer
+transport outcomes (``retransmit`` / ``dup`` / ``expired``), wire bytes
+per link (on ``solicit`` / ``receive``), pod-scheduler job lifecycle
+(``dispatch`` / ``preempt`` / ``requeue`` / ``finish``), hyperscale
+cohort staging (``stage``) and sampled serving decode batches
+(``decode_batch``).
+
+``round_anatomy`` is the correlator: it joins ledger events with the
+flight log's phase records and the tracing plane's per-round spans into
+per-round, per-client anatomy — rendered by ``fedml rounds
+report|timeline|stragglers`` (e.g. "round 7: client 3 solicited t+0.01,
+upload arrived t+4.20 after 2 retransmits, quarantined non_finite;
+round closed on deadline with 4/5").
+
+The ledger copies the flight recorder's idiom exactly: opt-in
+(``run_ledger: true`` config key or ``FEDML_TPU_RUN_LEDGER=1``), bounded
+(``ledger_max_records``, dropped-past-cap counter), self-measuring
+(``fedml_ledger_overhead_seconds_total`` — the combined ledger+recorder
+CI budget is <2% of round wall), and always-cheap when off (one dict
+hit per ``event()`` call).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from . import metrics as _metrics
+
+#: ledger records kept per run before dropping (an event is ~150 bytes,
+#: so the default bounds the file near 2.5 MiB)
+DEFAULT_MAX_RECORDS = 16384
+
+_lock = threading.Lock()
+_state: Dict[str, Any] = {
+    "enabled": False,
+    "log_dir": None,
+    "run_id": "0",
+    "file": None,
+    "written": 0,
+    "dropped": 0,
+    "max_records": DEFAULT_MAX_RECORDS,
+    "overhead_s": 0.0,
+}
+
+
+# metric handles are get-or-create per call (one dict hit) so a test's
+# REGISTRY.reset() can't leave this module holding unexported handles
+def _events_total() -> Any:
+    return _metrics.counter(
+        "fedml_ledger_events_total",
+        "Ledger events appended, by emitting plane and event name "
+        "(the SLO engine's rate indicators read these)",
+        labels=("actor", "event"))
+
+
+def _dropped_total() -> Any:
+    return _metrics.counter(
+        "fedml_ledger_dropped_records_total",
+        "Ledger events dropped past the ledger_max_records cap")
+
+
+def _overhead_total() -> Any:
+    return _metrics.counter(
+        "fedml_ledger_overhead_seconds_total",
+        "Ledger bookkeeping time, self-measured (combined with the "
+        "flight recorder's, CI budget: <2% of round wall)")
+
+
+# -- lifecycle ---------------------------------------------------------------
+
+def configure(args: Any, log_dir: Optional[str] = None) -> None:
+    """Arm (or disarm) the ledger for a run — called by ``mlops.init``.
+    Opt-in via the ``run_ledger`` config key or the
+    ``FEDML_TPU_RUN_LEDGER`` env toggle."""
+    env = os.environ.get("FEDML_TPU_RUN_LEDGER", "")
+    on = bool(getattr(args, "run_ledger", False)) \
+        or env.lower() in ("1", "true", "yes", "on")
+    enable(on, log_dir=log_dir,
+           run_id=str(getattr(args, "run_id", "0")),
+           max_records=int(getattr(args, "ledger_max_records", 0)
+                           or DEFAULT_MAX_RECORDS))
+
+
+def enable(on: bool = True, log_dir: Optional[str] = None,
+           run_id: str = "0",
+           max_records: int = DEFAULT_MAX_RECORDS) -> None:
+    """Programmatic arm/disarm (tests, bench).  Re-enabling resets the
+    per-run counters but appends to an existing ledger file."""
+    reset()
+    with _lock:
+        _state["enabled"] = bool(on)
+        _state["log_dir"] = log_dir
+        _state["run_id"] = run_id
+        _state["max_records"] = int(max_records)
+
+
+def reset() -> None:
+    """Close the ledger and disarm — safe to call repeatedly."""
+    with _lock:
+        f = _state["file"]
+        if f is not None:
+            try:
+                f.flush()
+                f.close()
+            except Exception:  # noqa: BLE001 — a wedged fd can't block reset
+                pass
+        _state.update(enabled=False, file=None, written=0, dropped=0,
+                      overhead_s=0.0)
+
+
+def enabled() -> bool:
+    return _state["enabled"]
+
+
+def ledger_path() -> Optional[str]:
+    d = _state["log_dir"]
+    return os.path.join(d, "ledger.jsonl") if d else None
+
+
+def overhead_s() -> float:
+    """Cumulative self-measured bookkeeping seconds this run."""
+    return float(_state["overhead_s"])
+
+
+def dropped() -> int:
+    return int(_state["dropped"])
+
+
+def event(actor: str, name: str, round_idx: Optional[int] = None,
+          **attrs: Any) -> None:
+    """Append one ledger event.  No-op (one dict hit) when disarmed;
+    never raises — an unwritable log dir degrades, never aborts the
+    plane that tried to record."""
+    if not _state["enabled"]:
+        return
+    t0 = time.perf_counter()
+    record = {
+        "ts_mono": time.monotonic(),
+        "ts": time.time(),
+        "run_id": _state["run_id"],
+        "round_idx": None if round_idx is None else int(round_idx),
+        "actor": actor,
+        "event": name,
+        "attrs": attrs,
+    }
+    _events_total().labels(actor=actor, event=name).inc()
+    with _lock:
+        if not _state["enabled"]:
+            return
+        if _state["written"] >= _state["max_records"]:
+            _state["dropped"] += 1
+            _dropped_total().inc()
+            _state["overhead_s"] += time.perf_counter() - t0
+            return
+        path = ledger_path()
+        if path is None:
+            return
+        f = _state["file"]
+        if f is None or f.closed:
+            try:
+                os.makedirs(_state["log_dir"], exist_ok=True)
+                f = _state["file"] = open(path, "a")
+            except OSError:
+                return
+        try:
+            f.write(json.dumps(record, default=str) + "\n")
+            f.flush()
+            _state["written"] += 1
+        except OSError:
+            pass
+        dt = time.perf_counter() - t0
+        _state["overhead_s"] += dt
+    _overhead_total().inc(dt)
+
+
+# -- loading -----------------------------------------------------------------
+
+def load_ledger(path: str) -> List[Dict[str, Any]]:
+    """Parse a ledger — accepts the jsonl file or a run log dir."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "ledger.jsonl")
+    if not os.path.exists(path):
+        return []
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                continue
+    return records
+
+
+# -- the correlator ----------------------------------------------------------
+
+#: reliable-layer events have no round_idx; their ``client`` is whichever
+#: end of the link is not the server (rank 0)
+def _client_of(rec: Dict[str, Any]) -> Optional[int]:
+    attrs = rec.get("attrs") or {}
+    c = attrs.get("client")
+    if c is not None:
+        return int(c)
+    if rec.get("actor") == "reliable":
+        rank = attrs.get("rank")
+        peer = attrs.get("peer")
+        for cand in (rank, peer):
+            if cand is not None and int(cand) != 0:
+                return int(cand)
+    return None
+
+
+def round_anatomy(ledger_records: List[Dict[str, Any]],
+                  flight_records: Optional[List[Dict[str, Any]]] = None,
+                  span_records: Optional[List[Dict[str, Any]]] = None,
+                  ) -> Dict[str, Any]:
+    """Join ledger events (+ optional flight log and tracing spans) into
+    per-round, per-client anatomy.
+
+    Events carrying ``round_idx`` anchor the rounds; events without one
+    (the reliable layer's) are attributed to the round whose
+    ``[round_start, next round_start)`` window contains their
+    ``ts_mono``.  Returns::
+
+        {"run_id", "rounds": {idx: {"t0", "wall_s", "closed",
+                                    "reported", "expected",
+                                    "clients": {rank: {...}},
+                                    "events": [...], ...}},
+         "flight": summarize(flight) | None,
+         "ledger_events": N}
+    """
+    rounds: Dict[int, Dict[str, Any]] = {}
+    run_id = None
+    # run-level milestones carry a round_idx for context but must not
+    # conjure a phantom round (run_finish stamps comm_round, one past
+    # the last real round)
+    _RUN_LEVEL = ("run_finish",)
+    run_events = [r for r in ledger_records
+                  if r.get("event") in _RUN_LEVEL]
+    anchored = [r for r in ledger_records
+                if r.get("round_idx") is not None
+                and r.get("event") not in _RUN_LEVEL]
+    floating = [r for r in ledger_records
+                if r.get("round_idx") is None
+                and r.get("event") not in _RUN_LEVEL]
+    for rec in ledger_records:
+        if run_id is None and rec.get("run_id") is not None:
+            run_id = str(rec["run_id"])
+
+    def _round(idx: int) -> Dict[str, Any]:
+        return rounds.setdefault(int(idx), {
+            "t0": None, "t_close": None, "wall_s": None, "closed": None,
+            "reported": None, "expected": None,
+            "clients": {}, "events": [], "quarantined": 0,
+            "retransmits": 0, "deadline_dropped": 0})
+
+    for rec in anchored:
+        r = _round(rec["round_idx"])
+        r["events"].append(rec)
+        ts = float(rec.get("ts_mono", 0.0))
+        if rec.get("event") == "round_start":
+            r["t0"] = ts if r["t0"] is None else min(r["t0"], ts)
+        if r["t0"] is None or (rec.get("event") != "round_close"
+                               and ts < r["t0"]):
+            # rounds without an explicit start (e.g. a truncated ledger)
+            # anchor on their earliest event
+            r["t0"] = ts if r["t0"] is None else min(r["t0"], ts)
+        if rec.get("event") in ("round_close", "flush"):
+            r["t_close"] = ts
+            attrs = rec.get("attrs") or {}
+            r["closed"] = attrs.get("closed") or attrs.get("trigger")
+            if attrs.get("reported") is not None:
+                r["reported"] = int(attrs["reported"])
+            elif attrs.get("n_folded") is not None:
+                r["reported"] = int(attrs["n_folded"])
+            if attrs.get("expected") is not None:
+                r["expected"] = int(attrs["expected"])
+
+    # attribute floating (transport) events by time window
+    starts = sorted((r["t0"], idx) for idx, r in rounds.items()
+                    if r["t0"] is not None)
+    for rec in floating:
+        ts = float(rec.get("ts_mono", 0.0))
+        target = None
+        for t0, idx in starts:
+            if ts >= t0:
+                target = idx
+            else:
+                break
+        if target is not None:
+            rounds[target]["events"].append(rec)
+
+    for idx, r in rounds.items():
+        if r["t0"] is not None and r["t_close"] is not None:
+            r["wall_s"] = round(r["t_close"] - r["t0"], 6)
+        t0 = r["t0"] or 0.0
+        for rec in sorted(r["events"], key=lambda e: e.get("ts_mono", 0.0)):
+            client = _client_of(rec)
+            if client is None:
+                continue
+            c = r["clients"].setdefault(int(client), {
+                "timeline": [], "solicited_t": None, "upload_t": None,
+                "retransmits": 0, "dups": 0, "verdict": None,
+                "reason": None, "deadline_dropped": False,
+                "heartbeat_dead": False, "late_join": False,
+                "staleness": None, "outcome": None})
+            t = round(float(rec.get("ts_mono", t0)) - t0, 3)
+            ev = rec.get("event")
+            attrs = rec.get("attrs") or {}
+            c["timeline"].append({"t": t, "actor": rec.get("actor"),
+                                  "event": ev, "attrs": attrs})
+            if ev == "solicit" and c["solicited_t"] is None:
+                c["solicited_t"] = t
+            elif ev == "receive":
+                c["upload_t"] = t
+            elif ev == "retransmit":
+                c["retransmits"] += 1
+                r["retransmits"] += 1
+            elif ev == "dup":
+                c["dups"] += 1
+            elif ev == "admitted" or ev == "fold":
+                c["verdict"] = "admitted"
+                if attrs.get("staleness") is not None:
+                    c["staleness"] = attrs["staleness"]
+                if ev == "fold":
+                    c["upload_t"] = c["upload_t"] if c["upload_t"] \
+                        is not None else t
+            elif ev == "quarantined":
+                c["verdict"] = "quarantined"
+                c["reason"] = attrs.get("reason")
+            elif ev == "deadline_drop":
+                c["deadline_dropped"] = True
+                r["deadline_dropped"] += 1
+            elif ev == "heartbeat_dead":
+                c["heartbeat_dead"] = True
+            elif ev == "late_join":
+                c["late_join"] = True
+            elif ev in ("expired", "park", "duplicate"):
+                c["outcome"] = ev
+        r["quarantined"] = sum(1 for c in r["clients"].values()
+                               if c["verdict"] == "quarantined")
+        if r["reported"] is None:
+            r["reported"] = sum(1 for c in r["clients"].values()
+                                if c["verdict"] == "admitted")
+        if r["expected"] is None and r["clients"]:
+            r["expected"] = len(r["clients"])
+
+    # join per-round spans (train_round carries round= in attrs)
+    if span_records:
+        for rec in span_records:
+            if rec.get("name") != "train_round":
+                continue
+            try:
+                idx = int((rec.get("attrs") or {}).get("round"))
+            except (TypeError, ValueError):
+                continue
+            if idx in rounds:
+                rounds[idx]["span_dur_s"] = round(
+                    float(rec.get("dur_s", 0.0)), 6)
+
+    flight_summary = None
+    if flight_records:
+        from . import flight_recorder
+
+        flight_summary = flight_recorder.summarize(flight_records)
+
+    return {"run_id": run_id, "rounds": rounds,
+            "flight": flight_summary,
+            "run_events": [{"event": r.get("event"),
+                            "actor": r.get("actor"),
+                            "attrs": r.get("attrs") or {}}
+                           for r in run_events],
+            "ledger_events": len(ledger_records)}
+
+
+def load_anatomy(log_dir: str) -> Dict[str, Any]:
+    """Convenience: correlate everything a run log dir holds (ledger +
+    flight log + spans, each optional)."""
+    from . import flight_recorder, tracing
+
+    return round_anatomy(
+        load_ledger(log_dir),
+        flight_records=flight_recorder.load_flight_log(log_dir),
+        span_records=tracing.load_spans(log_dir))
+
+
+# -- renderers (the `fedml rounds …` backends) -------------------------------
+
+def _fmt_round_header(idx: int, r: Dict[str, Any]) -> str:
+    wall = f"wall {r['wall_s']:.3f}s" if r.get("wall_s") is not None \
+        else "wall ?"
+    closed = r.get("closed") or "?"
+    rep = r.get("reported")
+    exp = r.get("expected")
+    who = f"{rep}/{exp}" if rep is not None and exp is not None else "?"
+    extra = ""
+    if r.get("span_dur_s") is not None:
+        extra = f"  span {r['span_dur_s']:.3f}s"
+    return (f"round {idx}  {wall}  closed {closed}  "
+            f"{who} reported{extra}")
+
+
+def _fmt_client_line(rank: int, c: Dict[str, Any]) -> str:
+    bits = []
+    if c["solicited_t"] is not None:
+        bits.append(f"solicited t+{c['solicited_t']:.2f}")
+    if c["late_join"]:
+        bits.append("late-joined")
+    if c["upload_t"] is not None:
+        up = f"upload arrived t+{c['upload_t']:.2f}"
+        if c["retransmits"]:
+            up += f" after {c['retransmits']} retransmit" + \
+                ("s" if c["retransmits"] != 1 else "")
+        bits.append(up)
+    elif c["retransmits"]:
+        bits.append(f"{c['retransmits']} retransmits, no upload")
+    else:
+        bits.append("no upload")
+    if c["dups"]:
+        bits.append(f"{c['dups']} dups suppressed")
+    if c["verdict"] == "quarantined":
+        bits.append(f"quarantined {c['reason'] or '?'}")
+    elif c["verdict"] == "admitted":
+        st = c.get("staleness")
+        bits.append("admitted" + (f" (staleness {st})"
+                                  if st not in (None, 0) else ""))
+    if c["outcome"] == "expired":
+        bits.append("expired stale")
+    elif c["outcome"] == "park":
+        bits.append("parked at frontier")
+    if c["deadline_dropped"]:
+        bits.append("DROPPED at deadline")
+    if c["heartbeat_dead"]:
+        bits.append("declared dead (heartbeat)")
+    return f"  client {rank}: " + ", ".join(bits)
+
+
+def render_timeline(anatomy: Dict[str, Any],
+                    round_idx: Optional[int] = None) -> str:
+    """The per-round per-client anatomy view: one block per round, one
+    line per client, timestamps relative to the round's start."""
+    rounds = anatomy.get("rounds") or {}
+    if not rounds:
+        return "(no ledger rounds)"
+    idxs = [round_idx] if round_idx is not None else sorted(rounds)
+    out = [f"run {anatomy.get('run_id')}: {len(rounds)} rounds, "
+           f"{anatomy.get('ledger_events', 0)} ledger events"]
+    for idx in idxs:
+        r = rounds.get(idx)
+        if r is None:
+            out.append(f"round {idx}: (not in ledger)")
+            continue
+        out.append(_fmt_round_header(idx, r))
+        for rank in sorted(r["clients"]):
+            out.append(_fmt_client_line(rank, r["clients"][rank]))
+        other = [e for e in r["events"]
+                 if _client_of(e) is None and e.get("event")
+                 not in ("round_start", "round_close")]
+        for rec in sorted(other, key=lambda e: e.get("ts_mono", 0.0)):
+            t = float(rec.get("ts_mono", 0.0)) - (r["t0"] or 0.0)
+            attrs = rec.get("attrs") or {}
+            extra = "".join(f" {k}={v}" for k, v in sorted(attrs.items()))
+            out.append(f"  +{t:7.3f}s {rec.get('actor')}."
+                       f"{rec.get('event')}{extra}")
+    return "\n".join(out)
+
+
+def render_report(anatomy: Dict[str, Any]) -> str:
+    """One line per round: wall, close reason, cohort accounting, fault
+    counts — the at-a-glance run health view."""
+    rounds = anatomy.get("rounds") or {}
+    if not rounds:
+        return "(no ledger rounds)"
+    out = [f"run {anatomy.get('run_id')}: {len(rounds)} rounds"]
+    out.append(f"{'round':<7}{'wall_s':>9}{'closed':>10}{'reported':>10}"
+               f"{'quarantined':>13}{'retx':>6}{'dropped':>9}")
+    for idx in sorted(rounds):
+        r = rounds[idx]
+        wall = f"{r['wall_s']:.3f}" if r.get("wall_s") is not None else "?"
+        rep = (f"{r['reported']}/{r['expected']}"
+               if r.get("reported") is not None
+               and r.get("expected") is not None else "?")
+        out.append(f"{idx:<7}{wall:>9}{str(r.get('closed') or '?'):>10}"
+                   f"{rep:>10}{r['quarantined']:>13}{r['retransmits']:>6}"
+                   f"{r['deadline_dropped']:>9}")
+    fs = anatomy.get("flight")
+    if fs and fs.get("records"):
+        top = next(iter(fs["phases_s"].items()), ("-", 0.0))
+        out.append(f"flight: {fs['records']} records, coverage "
+                   f"{fs['coverage']:.1%}, dominant phase {top[0]} "
+                   f"{top[1]:.3f}s, recorder overhead "
+                   f"{fs['overhead_frac']:.2%}")
+    return "\n".join(out)
+
+
+def render_stragglers(anatomy: Dict[str, Any]) -> str:
+    """Per-client aggregate across all rounds, worst-first: upload
+    latency, deadline drops, heartbeat deaths, retransmits — who is
+    slowing the federation down and why."""
+    rounds = anatomy.get("rounds") or {}
+    per_client: Dict[int, Dict[str, Any]] = {}
+    for r in rounds.values():
+        for rank, c in r["clients"].items():
+            s = per_client.setdefault(rank, {
+                "rounds": 0, "uploads": 0, "upload_ts": [],
+                "retransmits": 0, "deadline_drops": 0, "hb_dead": 0,
+                "quarantined": 0})
+            s["rounds"] += 1
+            if c["upload_t"] is not None:
+                s["uploads"] += 1
+                s["upload_ts"].append(c["upload_t"])
+            s["retransmits"] += c["retransmits"]
+            s["deadline_drops"] += int(c["deadline_dropped"])
+            s["hb_dead"] += int(c["heartbeat_dead"])
+            s["quarantined"] += int(c["verdict"] == "quarantined")
+    if not per_client:
+        return "(no per-client ledger events)"
+
+    def _badness(item):
+        _, s = item
+        worst_t = max(s["upload_ts"]) if s["upload_ts"] else 0.0
+        return -(s["deadline_drops"] * 1e6 + s["hb_dead"] * 1e5
+                 + s["retransmits"] * 1e2 + worst_t)
+
+    out = [f"{'client':<8}{'rounds':>7}{'uploads':>8}{'p_max_t':>9}"
+           f"{'retx':>6}{'ddl_drop':>9}{'hb_dead':>8}{'quar':>6}"]
+    for rank, s in sorted(per_client.items(), key=_badness):
+        worst = f"{max(s['upload_ts']):.2f}" if s["upload_ts"] else "-"
+        out.append(f"{rank:<8}{s['rounds']:>7}{s['uploads']:>8}"
+                   f"{worst:>9}{s['retransmits']:>6}"
+                   f"{s['deadline_drops']:>9}{s['hb_dead']:>8}"
+                   f"{s['quarantined']:>6}")
+    return "\n".join(out)

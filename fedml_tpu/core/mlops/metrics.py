@@ -21,6 +21,7 @@ A process-wide default ``REGISTRY`` backs the module-level ``counter`` /
 
 from __future__ import annotations
 
+import re
 import threading
 import time
 from typing import Any, Dict, Iterable, Optional, Sequence, Tuple
@@ -296,3 +297,128 @@ def histogram(name: str, help: str = "", labels: Sequence[str] = (),
 
 def render_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
     return (registry or REGISTRY).render_prometheus()
+
+
+# -- exposition parsing (the inverse of render_prometheus) -------------------
+#
+# `fedml metrics --json` and the SLO engine consume scrapes as data, not
+# text; parsing our own v0.0.4 output (plus anything prometheus_client
+# renders) keeps CI assertions and rule evaluation regex-free.
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)(?:\s+\d+)?$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape_label(v: str) -> str:
+    return v.replace(r'\"', '"').replace(r"\n", "\n").replace("\\\\", "\\")
+
+
+def _parse_value(v: str) -> float:
+    if v == "+Inf":
+        return float("inf")
+    if v == "-Inf":
+        return float("-inf")
+    return float(v)
+
+
+def parse_prometheus(text: str) -> Dict[str, Dict[str, Any]]:
+    """Parse exposition-format text into::
+
+        {metric: {"type", "help", "samples": [{"labels", "value"}],
+                  "series": [...]}}  # histograms only
+
+    Histogram ``_bucket`` / ``_sum`` / ``_count`` samples are regrouped
+    under the base metric: each ``series`` entry is one labelset with
+    ``buckets`` ([upper_bound, cumulative_count] pairs, +Inf last),
+    ``sum`` and ``count`` — the shape ``histogram_quantile`` takes.
+    """
+    out: Dict[str, Dict[str, Any]] = {}
+
+    def _metric(name: str) -> Dict[str, Any]:
+        return out.setdefault(name, {"type": "untyped", "help": "",
+                                     "samples": []})
+
+    hist_names = set()
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_text = rest.partition(" ")
+            _metric(name)["help"] = help_text
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            _metric(name)["type"] = kind.strip()
+            if kind.strip() == "histogram":
+                hist_names.add(name)
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, label_str, value = m.group(1), m.group(2), m.group(3)
+        labels = {k: _unescape_label(v)
+                  for k, v in _LABEL_RE.findall(label_str or "")}
+        try:
+            val = _parse_value(value)
+        except ValueError:
+            continue
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[:-len(suffix)] in hist_names:
+                base = name[:-len(suffix)]
+                break
+        entry = _metric(base)
+        entry["samples"].append({"name": name, "labels": labels,
+                                 "value": val})
+
+    # regroup histogram samples into per-labelset series
+    for name, entry in out.items():
+        if entry["type"] != "histogram":
+            continue
+        series: Dict[Tuple[Tuple[str, str], ...], Dict[str, Any]] = {}
+        for s in entry["samples"]:
+            labels = dict(s["labels"])
+            le = labels.pop("le", None)
+            key = tuple(sorted(labels.items()))
+            ser = series.setdefault(key, {"labels": labels, "buckets": [],
+                                          "sum": 0.0, "count": 0})
+            if s["name"].endswith("_bucket") and le is not None:
+                ser["buckets"].append([_parse_value(le), s["value"]])
+            elif s["name"].endswith("_sum"):
+                ser["sum"] = s["value"]
+            elif s["name"].endswith("_count"):
+                ser["count"] = int(s["value"])
+        for ser in series.values():
+            ser["buckets"].sort(key=lambda b: b[0])
+        entry["series"] = list(series.values())
+    return out
+
+
+def histogram_quantile(q: float,
+                       buckets: Sequence[Sequence[float]]) -> Optional[float]:
+    """Prometheus-style quantile from cumulative ``[upper_bound, count]``
+    pairs (linear interpolation within the winning bucket; the +Inf
+    bucket resolves to the highest finite bound).  None when empty."""
+    if not buckets:
+        return None
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    rank = q * total
+    prev_bound, prev_cum = 0.0, 0.0
+    for bound, cum in buckets:
+        if cum >= rank:
+            if bound == float("inf"):
+                return prev_bound if prev_bound > 0 else None
+            if cum == prev_cum:
+                return bound
+            return prev_bound + (bound - prev_bound) * \
+                (rank - prev_cum) / (cum - prev_cum)
+        prev_bound, prev_cum = bound, cum
+    return prev_bound if prev_bound > 0 else None

@@ -1,0 +1,194 @@
+"""Perf-history sentinel — accumulate bench headlines, flag regressions
+and stale carried numbers.
+
+The ROADMAP carries a 3.3687 rounds/s TPU headline measured at BENCH_r05
+and nothing has re-measured it since — the exact failure mode this
+module turns into a red CI line.  Every bench / flight summary appends
+one provenance-stamped entry to ``benchmarks/perf_history.jsonl``::
+
+    {ts, git_rev, platform, source, measured, carried_from, label,
+     notes, metrics: {rounds_per_s, clients_per_s, tokens_per_s,
+                      measured_mfu, ...}}
+
+* ``platform`` — "tpu" / "cpu" / ... (comparisons never cross it);
+* ``measured`` — False marks a *carried* headline (copied forward from
+  an older measurement, ``carried_from`` names it);
+* ``metrics`` — higher-is-better headline numbers.
+
+``detect()`` finds two failure classes per platform:
+
+* **regression** — a headline metric's newest measurement dropped more
+  than ``drop_threshold`` (default 10%) vs the previous one;
+* **stale** — a platform's newest entry is carried, not measured: the
+  headline everyone quotes no longer has a measurement behind it.
+
+``fedml perf history`` renders the ledger; ``fedml perf regress`` exits
+1 on either failure class (CI gates on it — smoke.yml seeds a two-entry
+history with a synthetic 20% rounds/s drop and asserts the nonzero
+exit).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import time
+from typing import Any, Dict, List, Optional
+
+DEFAULT_HISTORY = os.path.join("benchmarks", "perf_history.jsonl")
+
+#: headline metrics the sentinel watches — all higher-is-better
+HEADLINE_METRICS = ("rounds_per_s", "clients_per_s", "tokens_per_s",
+                    "measured_mfu")
+
+
+def git_rev(cwd: Optional[str] = None) -> str:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=cwd, capture_output=True, text=True, timeout=5)
+        if out.returncode == 0:
+            return out.stdout.strip()
+    except (OSError, subprocess.SubprocessError):
+        pass
+    return "unknown"
+
+
+def append_entry(path: str, platform: str, source: str,
+                 metrics: Dict[str, float], measured: bool = True,
+                 carried_from: Optional[str] = None,
+                 label: Optional[str] = None,
+                 notes: Optional[str] = None,
+                 ts: Optional[float] = None,
+                 rev: Optional[str] = None) -> Dict[str, Any]:
+    """Append one provenance-stamped entry; returns it."""
+    entry = {
+        "ts": time.time() if ts is None else float(ts),
+        "git_rev": rev if rev is not None else git_rev(),
+        "platform": str(platform),
+        "source": str(source),
+        "measured": bool(measured),
+        "carried_from": carried_from,
+        "label": label,
+        "notes": notes,
+        "metrics": {k: float(v) for k, v in metrics.items()
+                    if v is not None},
+    }
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+    return entry
+
+
+def default_history_path() -> str:
+    """``benchmarks/perf_history.jsonl`` at the checkout root (the
+    fedml_tpu package's parent directory)."""
+    pkg = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(os.path.dirname(pkg), *DEFAULT_HISTORY.split(os.sep))
+
+
+def load_history(path: Optional[str] = None) -> List[Dict[str, Any]]:
+    path = path or default_history_path()
+    if not os.path.exists(path):
+        return []
+    entries = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                entries.append(json.loads(line))
+            except ValueError:
+                continue
+    entries.sort(key=lambda e: e.get("ts", 0.0))
+    return entries
+
+
+def detect(entries: List[Dict[str, Any]],
+           drop_threshold: float = 0.10) -> Dict[str, List[Dict[str, Any]]]:
+    """→ {"regressions": [...], "stale": [...]} per platform.
+
+    A regression compares the two newest *measured* values of one
+    headline metric on one platform; stale flags a platform whose
+    newest entry carries an old number instead of measuring a new one.
+    """
+    by_platform: Dict[str, List[Dict[str, Any]]] = {}
+    for e in entries:
+        by_platform.setdefault(str(e.get("platform", "?")), []).append(e)
+
+    regressions = []
+    stale = []
+    for platform, plat_entries in sorted(by_platform.items()):
+        newest = plat_entries[-1]
+        if not newest.get("measured", True):
+            stale.append({
+                "platform": platform,
+                "label": newest.get("label"),
+                "carried_from": newest.get("carried_from"),
+                "age_entries": sum(
+                    1 for e in plat_entries if not e.get("measured", True)),
+                "metrics": newest.get("metrics", {}),
+            })
+        for metric in HEADLINE_METRICS:
+            series = [e for e in plat_entries
+                      if e.get("measured", True)
+                      and metric in (e.get("metrics") or {})]
+            if len(series) < 2:
+                continue
+            prev, cur = series[-2], series[-1]
+            old = float(prev["metrics"][metric])
+            new = float(cur["metrics"][metric])
+            if old <= 0:
+                continue
+            drop = (old - new) / old
+            if drop > drop_threshold:
+                regressions.append({
+                    "platform": platform, "metric": metric,
+                    "old": old, "new": new, "drop_frac": round(drop, 4),
+                    "old_rev": prev.get("git_rev"),
+                    "new_rev": cur.get("git_rev"),
+                    "old_label": prev.get("label"),
+                    "new_label": cur.get("label"),
+                })
+    return {"regressions": regressions, "stale": stale}
+
+
+def render_history(entries: List[Dict[str, Any]]) -> str:
+    if not entries:
+        return "(empty perf history)"
+    out = [f"{'when':<18}{'platform':<9}{'rev':<12}{'prov':<10}"
+           f"{'label':<40} metrics"]
+    for e in entries:
+        when = time.strftime("%Y-%m-%d %H:%M",
+                             time.localtime(e.get("ts", 0.0)))
+        prov = "measured" if e.get("measured", True) else "carried"
+        ms = " ".join(f"{k}={v:.4g}"
+                      for k, v in sorted((e.get("metrics") or {}).items()))
+        out.append(f"{when:<18}{str(e.get('platform')):<9}"
+                   f"{str(e.get('git_rev')):<12}{prov:<10}"
+                   f"{str(e.get('label') or '-'):<40} {ms}")
+    return "\n".join(out)
+
+
+def render_findings(findings: Dict[str, List[Dict[str, Any]]]) -> str:
+    out = []
+    for r in findings["regressions"]:
+        out.append(
+            f"REGRESSION [{r['platform']}] {r['metric']}: "
+            f"{r['old']:.4g} ({r['old_rev']}) -> {r['new']:.4g} "
+            f"({r['new_rev']}), -{r['drop_frac']:.1%}")
+    for s in findings["stale"]:
+        ms = " ".join(f"{k}={v:.4g}"
+                      for k, v in sorted((s.get("metrics") or {}).items()))
+        out.append(
+            f"STALE [{s['platform']}] newest entry is carried from "
+            f"{s.get('carried_from') or '?'} "
+            f"({s['age_entries']} carried in a row) — re-measure: {ms}")
+    if not out:
+        return "perf history clean: no regressions, no stale headlines"
+    return "\n".join(out)

@@ -1,0 +1,400 @@
+"""Declarative SLO engine — YAML rules over the metrics plane + ledger.
+
+Observability without enforcement rots: the flight recorder measured the
+h2d-blocked share, the admission funnel counted quarantines, and nothing
+ever *failed* when either drifted.  This module closes that loop with a
+small declarative rule language::
+
+    # slo.yaml
+    slos:
+      - name: round_p95
+        indicator: round_time_p95
+        max: 30.0
+      - name: quarantine
+        indicator: quarantine_rate
+        max: 0.25
+      - name: mfu_floor
+        indicator: measured_mfu
+        min: 0.05
+
+Each rule binds one *indicator* from the catalog to a ``max`` (upper
+bound) or ``min`` (floor).  Indicators resolve metrics-first (a parsed
+Prometheus scrape — live registry or file) with artifact fallbacks
+(ledger anatomy, flight summary), and return ``None`` when their data
+plane never ran — a rule whose indicator is None is *skipped*, not
+breached, so one ``slo.yaml`` can gate heterogeneous runs.
+
+Indicator catalog (docs/OBSERVABILITY.md "SLO engine" has the table):
+
+* ``round_time_p95`` — p95 of ``fedml_round_seconds`` (fallback: ledger
+  round walls);
+* ``quarantine_rate`` — quarantined / (admitted + quarantined) from the
+  ledger event counters;
+* ``retransmit_rate`` — ``fedml_reliable_retransmits_total`` /
+  ``fedml_reliable_sent_total`` (fallback: ledger transport events);
+* ``h2d_blocked_share`` — h2d phase share of attributed round wall from
+  ``fedml_round_phase_seconds`` (fallback: flight summary);
+* ``measured_mfu`` — min over programs of ``fedml_measured_mfu``
+  (fallback: flight summary program MFUs);
+* ``decode_ttft_p99`` — p99 of ``fedml_llm_ttft_seconds``.
+
+Evaluation surfaces: ``check_round_boundary()`` (wired into the sync
+server's ``_complete_round`` and the async funnel's ``_flush``) inc's
+``fedml_slo_breaches_total{rule}`` and appends a ledger ``breach`` event
+per violated rule; ``fedml slo check`` evaluates offline artifacts and
+exits nonzero on any breach — the CI gate.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+from . import metrics as _metrics
+
+logger = logging.getLogger(__name__)
+
+#: rules armed for in-run boundary checks (configure() fills this)
+_state: Dict[str, Any] = {"rules": [], "enabled": False}
+_lock = threading.Lock()
+
+
+def _breaches_total() -> Any:
+    return _metrics.counter(
+        "fedml_slo_breaches_total",
+        "SLO rule violations observed at round boundaries",
+        labels=("rule",))
+
+
+class SLORule:
+    """One declarative bound on one indicator."""
+
+    def __init__(self, name: str, indicator: str,
+                 max: Optional[float] = None,          # noqa: A002
+                 min: Optional[float] = None,          # noqa: A002
+                 **params: Any) -> None:
+        if indicator not in INDICATORS:
+            raise ValueError(
+                f"SLO rule {name!r}: unknown indicator {indicator!r} "
+                f"(catalog: {sorted(INDICATORS)})")
+        if max is None and min is None:
+            raise ValueError(f"SLO rule {name!r} needs max: or min:")
+        self.name = name
+        self.indicator = indicator
+        self.max = None if max is None else float(max)
+        self.min = None if min is None else float(min)
+        self.params = params
+
+    def evaluate(self, ctx: "SLOContext") -> Dict[str, Any]:
+        """→ {"rule", "indicator", "value", "ok", "bound"}; ``ok`` is
+        None (skipped) when the indicator has no data."""
+        value = INDICATORS[self.indicator](ctx, self)
+        ok: Optional[bool] = None
+        bound = None
+        if value is not None:
+            ok = True
+            if self.max is not None and value > self.max:
+                ok, bound = False, ("max", self.max)
+            if self.min is not None and value < self.min:
+                ok, bound = False, ("min", self.min)
+        return {"rule": self.name, "indicator": self.indicator,
+                "value": value, "ok": ok, "bound": bound}
+
+    def __repr__(self) -> str:
+        b = f"max={self.max}" if self.max is not None else f"min={self.min}"
+        return f"SLORule({self.name}: {self.indicator} {b})"
+
+
+def load_rules(path: str) -> List[SLORule]:
+    """Parse ``slo.yaml`` — top-level ``slos:`` list (a bare list also
+    works) of {name, indicator, max|min, extra params}."""
+    import yaml
+
+    with open(path) as f:
+        raw = yaml.safe_load(f) or {}
+    entries = raw.get("slos", raw) if isinstance(raw, dict) else raw
+    if not isinstance(entries, list):
+        raise ValueError(f"{path}: expected a 'slos:' list")
+    rules = []
+    for i, entry in enumerate(entries):
+        if not isinstance(entry, dict):
+            raise ValueError(f"{path}: rule #{i} is not a mapping")
+        entry = dict(entry)
+        name = entry.pop("name", None) or f"rule_{i}"
+        indicator = entry.pop("indicator", None)
+        if indicator is None:
+            raise ValueError(f"{path}: rule {name!r} missing indicator:")
+        rules.append(SLORule(name, indicator, **entry))
+    return rules
+
+
+class SLOContext:
+    """Lazily-resolved data sources an indicator can read: a parsed
+    Prometheus scrape, ledger anatomy, a flight summary."""
+
+    def __init__(self, metrics_text: Optional[str] = None,
+                 ledger_records: Optional[List[Dict[str, Any]]] = None,
+                 flight_summary: Optional[Dict[str, Any]] = None) -> None:
+        self._metrics_text = metrics_text
+        self._parsed: Optional[Dict[str, Any]] = None
+        self.ledger_records = ledger_records
+        self.flight_summary = flight_summary
+        self._anatomy: Optional[Dict[str, Any]] = None
+
+    @classmethod
+    def live(cls) -> "SLOContext":
+        """In-process: scrape the process registry (round-boundary hook)."""
+        return cls(metrics_text=_metrics.render_prometheus())
+
+    @classmethod
+    def from_artifacts(cls, log_dir: Optional[str] = None,
+                       metrics_file: Optional[str] = None) -> "SLOContext":
+        """Offline (`fedml slo check`): run log dir + optional scrape dump."""
+        from . import flight_recorder, ledger
+
+        text = None
+        if metrics_file and os.path.exists(metrics_file):
+            with open(metrics_file) as f:
+                text = f.read()
+        led = flight = None
+        if log_dir:
+            led = ledger.load_ledger(log_dir) or None
+            recs = flight_recorder.load_flight_log(log_dir)
+            flight = flight_recorder.summarize(recs) if recs else None
+        return cls(metrics_text=text, ledger_records=led,
+                   flight_summary=flight)
+
+    @property
+    def scrape(self) -> Dict[str, Any]:
+        if self._parsed is None:
+            self._parsed = _metrics.parse_prometheus(
+                self._metrics_text or "")
+        return self._parsed
+
+    @property
+    def anatomy(self) -> Dict[str, Any]:
+        if self._anatomy is None:
+            from . import ledger
+
+            self._anatomy = ledger.round_anatomy(self.ledger_records or [])
+        return self._anatomy
+
+    # -- scrape helpers -------------------------------------------------------
+    def counter_sum(self, name: str, **match: str) -> Optional[float]:
+        entry = self.scrape.get(name)
+        if entry is None:
+            return None
+        total = 0.0
+        found = False
+        for s in entry["samples"]:
+            if s["name"] != name:
+                continue
+            if all(s["labels"].get(k) == v for k, v in match.items()):
+                total += s["value"]
+                found = True
+        return total if found else None
+
+    def gauge_values(self, name: str) -> List[float]:
+        entry = self.scrape.get(name)
+        if entry is None:
+            return []
+        return [s["value"] for s in entry["samples"] if s["name"] == name]
+
+    def quantile(self, name: str, q: float) -> Optional[float]:
+        """Quantile over the merged buckets of every labelset of one
+        histogram (per-run_id series fold into one distribution)."""
+        entry = self.scrape.get(name)
+        if not entry or entry.get("type") != "histogram":
+            return None
+        merged: Dict[float, float] = {}
+        for ser in entry.get("series", []):
+            for bound, cum in ser["buckets"]:
+                merged[bound] = merged.get(bound, 0.0) + cum
+        buckets = sorted(merged.items())
+        return _metrics.histogram_quantile(q, buckets)
+
+    def hist_sum(self, name: str, **match: str) -> Optional[float]:
+        entry = self.scrape.get(name)
+        if not entry or entry.get("type") != "histogram":
+            return None
+        total = 0.0
+        found = False
+        for ser in entry.get("series", []):
+            if all(ser["labels"].get(k) == v for k, v in match.items()):
+                total += ser["sum"]
+                found = True
+        return total if found else None
+
+    def ledger_event_count(self, actor: str, event: str) -> float:
+        # metrics-first (fedml_ledger_events_total), ledger-file fallback
+        v = self.counter_sum("fedml_ledger_events_total",
+                             actor=actor, event=event)
+        if v is not None:
+            return v
+        return float(sum(1 for r in (self.ledger_records or [])
+                         if r.get("actor") == actor
+                         and r.get("event") == event))
+
+
+# -- the indicator catalog ---------------------------------------------------
+
+def _ind_round_time_p95(ctx: SLOContext, rule: SLORule) -> Optional[float]:
+    q = float(rule.params.get("quantile", 0.95))
+    v = ctx.quantile("fedml_round_seconds", q)
+    if v is not None:
+        return v
+    walls = sorted(r["wall_s"] for r in ctx.anatomy["rounds"].values()
+                   if r.get("wall_s") is not None)
+    if not walls:
+        return None
+    return walls[min(len(walls) - 1, int(q * len(walls)))]
+
+
+def _ind_quarantine_rate(ctx: SLOContext, rule: SLORule) -> Optional[float]:
+    quar = adm = 0.0
+    for actor in ("aggregator", "async"):
+        quar += ctx.ledger_event_count(actor, "quarantined")
+        adm += ctx.ledger_event_count(actor, "admitted")
+        adm += ctx.ledger_event_count(actor, "fold")
+    if quar + adm == 0:
+        # last resort: admission metric alone (pre-ledger runs)
+        quar = ctx.counter_sum("fedml_quarantined_updates_total") or 0.0
+        if quar == 0:
+            return None
+        return 1.0
+    return quar / (quar + adm)
+
+
+def _ind_retransmit_rate(ctx: SLOContext, rule: SLORule) -> Optional[float]:
+    sent = ctx.counter_sum("fedml_reliable_sent_total")
+    retx = ctx.counter_sum("fedml_reliable_retransmits_total")
+    if sent:
+        return (retx or 0.0) / sent
+    retx = ctx.ledger_event_count("reliable", "retransmit")
+    delivered = (ctx.ledger_event_count("server", "solicit")
+                 + ctx.ledger_event_count("server", "receive"))
+    if retx + delivered == 0:
+        return None
+    return retx / max(1.0, retx + delivered)
+
+
+def _ind_h2d_blocked_share(ctx: SLOContext, rule: SLORule) -> Optional[float]:
+    h2d = ctx.hist_sum("fedml_round_phase_seconds", phase="h2d")
+    if h2d is not None:
+        total = ctx.hist_sum("fedml_round_phase_seconds") or 0.0
+        return h2d / total if total > 0 else None
+    fs = ctx.flight_summary
+    if fs and fs.get("phases_s"):
+        total = sum(fs["phases_s"].values())
+        return fs["phases_s"].get("h2d", 0.0) / total if total > 0 else None
+    return None
+
+
+def _ind_measured_mfu(ctx: SLOContext, rule: SLORule) -> Optional[float]:
+    vals = [v for v in ctx.gauge_values("fedml_measured_mfu") if v > 0]
+    if not vals:
+        fs = ctx.flight_summary or {}
+        vals = [p.get("last_mfu") for p in (fs.get("programs") or {}).values()
+                if p.get("last_mfu")]
+        vals = [v for v in vals if v and v > 0]
+    return min(vals) if vals else None
+
+
+def _ind_decode_ttft_p99(ctx: SLOContext, rule: SLORule) -> Optional[float]:
+    return ctx.quantile("fedml_llm_ttft_seconds",
+                        float(rule.params.get("quantile", 0.99)))
+
+
+INDICATORS = {
+    "round_time_p95": _ind_round_time_p95,
+    "quarantine_rate": _ind_quarantine_rate,
+    "retransmit_rate": _ind_retransmit_rate,
+    "h2d_blocked_share": _ind_h2d_blocked_share,
+    "measured_mfu": _ind_measured_mfu,
+    "decode_ttft_p99": _ind_decode_ttft_p99,
+}
+
+
+# -- evaluation --------------------------------------------------------------
+
+def evaluate(rules: List[SLORule],
+             ctx: Optional[SLOContext] = None) -> List[Dict[str, Any]]:
+    """Evaluate every rule against one context → result dicts (see
+    ``SLORule.evaluate``)."""
+    ctx = ctx or SLOContext.live()
+    return [rule.evaluate(ctx) for rule in rules]
+
+
+def breaches(results: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    return [r for r in results if r["ok"] is False]
+
+
+def render_results(results: List[Dict[str, Any]]) -> str:
+    out = []
+    for r in results:
+        if r["ok"] is None:
+            status, detail = "SKIP", "no data"
+        else:
+            status = "OK" if r["ok"] else "BREACH"
+            kind, bound = r["bound"] if r["bound"] else ("", "")
+            detail = f"value {r['value']:.6g}"
+            if not r["ok"]:
+                detail += f" violates {kind} {bound:.6g}"
+        out.append(f"{status:<7} {r['rule']:<24} "
+                   f"{r['indicator']:<20} {detail}")
+    return "\n".join(out)
+
+
+# -- in-run boundary hook ----------------------------------------------------
+
+def configure(args: Any, log_dir: Optional[str] = None) -> None:
+    """Arm round-boundary checks when the run names a rules file
+    (``slo_rules`` config key or ``FEDML_TPU_SLO_RULES`` env)."""
+    path = getattr(args, "slo_rules", None) \
+        or os.environ.get("FEDML_TPU_SLO_RULES") or None
+    with _lock:
+        _state["rules"] = []
+        _state["enabled"] = False
+    if not path:
+        return
+    try:
+        rules = load_rules(path)
+    except Exception as exc:  # noqa: BLE001 — bad rules must not kill a run
+        logger.warning("slo: failed to load rules from %s: %s", path, exc)
+        return
+    with _lock:
+        _state["rules"] = rules
+        _state["enabled"] = True
+
+
+def reset() -> None:
+    with _lock:
+        _state["rules"] = []
+        _state["enabled"] = False
+
+
+def check_round_boundary(round_idx: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Evaluate armed rules against the live registry; inc the breach
+    counter + ledger a ``breach`` event per violation.  Cheap no-op when
+    no rules are armed.  Never raises."""
+    if not _state["enabled"]:
+        return []
+    try:
+        results = evaluate(_state["rules"], SLOContext.live())
+    except Exception as exc:  # noqa: BLE001
+        logger.warning("slo: round-boundary evaluation failed: %s", exc)
+        return []
+    from . import ledger
+
+    bad = breaches(results)
+    for r in bad:
+        _breaches_total().labels(rule=r["rule"]).inc()
+        kind, bound = r["bound"]
+        ledger.event("slo", "breach", round_idx=round_idx,
+                     rule=r["rule"], indicator=r["indicator"],
+                     value=r["value"], bound=bound, kind=kind)
+        logger.warning("SLO BREACH %s: %s=%.6g violates %s %.6g",
+                       r["rule"], r["indicator"], r["value"], kind, bound)
+    return results

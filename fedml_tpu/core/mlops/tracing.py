@@ -39,6 +39,48 @@ _tls = threading.local()
 #: captured); "1"/"0" force on/off.  Toggled via enable_jax_annotations().
 _jax_annotations = os.environ.get("FEDML_TPU_JAX_TRACE_ANNOTATIONS", "auto")
 
+#: spans.jsonl sink cap, the flight log's `flight_max_records` idiom
+#: applied here: spans past the cap still observe the duration histogram
+#: (and nest/propagate normally) but stop being written to the file
+DEFAULT_MAX_SPANS = 16384
+
+_sink_lock = threading.Lock()
+_sink = {"written": 0, "dropped": 0, "max_spans": DEFAULT_MAX_SPANS}
+
+
+def configure(args: Any) -> None:
+    """Per-run sink bounds (``trace_max_spans`` config key) — called by
+    ``mlops.init``; 0/absent keeps the module default."""
+    reset_sink(max_spans=int(getattr(args, "trace_max_spans", 0)
+                             or DEFAULT_MAX_SPANS))
+
+
+def reset_sink(max_spans: int = DEFAULT_MAX_SPANS) -> None:
+    with _sink_lock:
+        _sink.update(written=0, dropped=0, max_spans=int(max_spans))
+
+
+def dropped_spans() -> int:
+    return int(_sink["dropped"])
+
+
+def _dropped_total() -> Any:
+    return _metrics.counter(
+        "fedml_trace_dropped_spans_total",
+        "Span records dropped past the trace_max_spans sink cap")
+
+
+def _sink_admit() -> bool:
+    """One span's write budget check — False past the cap."""
+    with _sink_lock:
+        if _sink["written"] >= _sink["max_spans"]:
+            _sink["dropped"] += 1
+            _dropped_total().inc()
+            return False
+        _sink["written"] += 1
+        return True
+
+
 def _span_seconds() -> Any:
     # get-or-create each time (one dict hit) so a test's REGISTRY.reset()
     # can't leave this module holding an unexported handle
@@ -183,6 +225,8 @@ class Span:
         if status:
             self.status = status
         _span_seconds().labels(name=self.name).observe(dur)
+        if not _sink_admit():
+            return dur
         from . import _emit
 
         _emit("spans", {

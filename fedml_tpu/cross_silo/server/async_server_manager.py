@@ -52,7 +52,7 @@ from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 from ...core import mlops
-from ...core.mlops import metrics, tracing
+from ...core.mlops import ledger, metrics, slo, tracing
 from ...core.distributed.communication.message import Message
 from ...ml.aggregator.staleness import parse_staleness, staleness_weight
 from ..message_define import MyMessage
@@ -232,6 +232,7 @@ class AsyncFedMLServerManager(FedMLServerManager):
         version = int(self.args.round_idx)
         if self._dispatched_version.get(rank, -1) >= version:
             self._waiting.add(rank)
+            ledger.event("async", "park", round_idx=version, client=rank)
             self._maybe_flush_drained()
             return
         self._broadcast_round(only_rank=rank)
@@ -298,6 +299,8 @@ class AsyncFedMLServerManager(FedMLServerManager):
                 # whose pre-restart upload was the one that counted.
                 _async_updates.labels(run_id=self._run_label,
                                       outcome="duplicate").inc()
+                ledger.event("async", "duplicate", round_idx=version,
+                             client=sender, trained_version=client_round)
                 logging.debug("async server: duplicate upload %s", key)
                 self._redispatch(sender)
                 return
@@ -314,6 +317,8 @@ class AsyncFedMLServerManager(FedMLServerManager):
                 self._trim_dedup()
                 _async_updates.labels(run_id=self._run_label,
                                       outcome="expired_stale").inc()
+                ledger.event("async", "expired", round_idx=version,
+                             client=sender, staleness=staleness)
                 logging.warning(
                     "async server: EXPIRED upload from %d (trained v%d, "
                     "now v%d > cutoff %d) — dropped, re-dispatching",
@@ -330,6 +335,9 @@ class AsyncFedMLServerManager(FedMLServerManager):
                 self._trim_dedup()
                 _async_updates.labels(run_id=self._run_label,
                                       outcome="expired_stale").inc()
+                ledger.event("async", "expired", round_idx=version,
+                             client=sender, staleness=staleness,
+                             reason="missing_ref")
                 logging.warning(
                     "async server: upload from %d is a delta against "
                     "version %d whose reference is no longer held "
@@ -347,6 +355,8 @@ class AsyncFedMLServerManager(FedMLServerManager):
                 # this version must get screened, not dedup-dropped.
                 _async_updates.labels(run_id=self._run_label,
                                       outcome="quarantined").inc()
+                ledger.event("async", "quarantined", round_idx=version,
+                             client=sender, reason=reason)
                 self.aggregator.quarantined_this_round[sender - 1] = reason
                 n_prev = self._quarantine_resolicits.get(sender, 0)
                 if n_prev < self._resolicit_max:
@@ -370,6 +380,8 @@ class AsyncFedMLServerManager(FedMLServerManager):
             self._buffer.append((weight, model_params, sender, client_round))
             _async_updates.labels(run_id=self._run_label,
                                   outcome="folded").inc()
+            ledger.event("async", "fold", round_idx=version, client=sender,
+                         staleness=staleness, weight=round(weight, 6))
             _async_staleness_hist.labels(run_id=self._run_label).observe(
                 float(staleness))
             _async_buffer.labels(run_id=self._run_label).set(
@@ -454,6 +466,10 @@ class AsyncFedMLServerManager(FedMLServerManager):
                 self.aggregator.test_on_server_for_all_clients(version)
         _async_flushes.labels(run_id=self._run_label, trigger=trigger).inc()
         _async_buffer.labels(run_id=self._run_label).set(0)
+        ledger.event("async", "flush", round_idx=version, trigger=trigger,
+                     n_folded=n_folded,
+                     max_staleness=max(staleness) if staleness else 0)
+        slo.check_round_boundary(version)
         logging.info(
             "async server: flush v%d→v%d (%s): folded %d updates, "
             "staleness %s", version, version + 1, trigger, n_folded,

@@ -13,7 +13,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ...core import mlops
-from ...core.mlops import flight_recorder, metrics, tracing
+from ...core.mlops import flight_recorder, ledger, metrics, tracing
 from ...core.alg_frame.context import Context
 
 _dup_uploads_total = metrics.counter(
@@ -76,9 +76,12 @@ class FedMLAggregator:
         duplicate would otherwise replace the update the round already
         committed to (and checkpointed).
         """
+        round_idx = int(getattr(self.args, "round_idx", 0) or 0)
         if index in self._received_this_round:
             self.duplicate_uploads += 1
             _dup_uploads_total.labels(run_id=self._run_label).inc()
+            ledger.event("aggregator", "duplicate", round_idx=round_idx,
+                         client=index + 1)
             return None
         if self.admission_control:
             reason = self._admit(model_params)
@@ -87,6 +90,9 @@ class FedMLAggregator:
                 self.quarantined_total += 1
                 _quarantined_total.labels(
                     run_id=self._run_label, reason=reason).inc()
+                ledger.event("aggregator", "quarantined",
+                             round_idx=round_idx, client=index + 1,
+                             reason=reason)
                 logging.warning(
                     "server: QUARANTINED upload from client index %d "
                     "(%s) — not counted, will be re-solicited",
@@ -95,6 +101,8 @@ class FedMLAggregator:
         self.model_dict[index] = model_params
         self.sample_num_dict[index] = float(sample_num)
         self._received_this_round.add(index)
+        ledger.event("aggregator", "admitted", round_idx=round_idx,
+                     client=index + 1)
         return None
 
     def _admit(self, model_params) -> Optional[str]:
@@ -246,6 +254,9 @@ class FedMLAggregator:
                 agg = self.aggregator.aggregate(raw)
                 agg = self.aggregator.on_after_aggregation(agg)
         self.aggregator.set_model_params(agg)
+        ledger.event("aggregator", "aggregate",
+                     round_idx=int(getattr(self.args, "round_idx", 0) or 0),
+                     n_clients=len(idxs))
         return agg
 
     # -- selection (reference :113-160) -------------------------------------

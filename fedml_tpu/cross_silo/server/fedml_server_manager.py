@@ -17,7 +17,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ...core import mlops
-from ...core.mlops import flight_recorder, metrics, tracing
+from ...core.mlops import flight_recorder, ledger, metrics, slo, tracing
 from ...core.distributed.communication.message import Message
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
 from ...utils.compression import WIRE_BYTES as _wire_bytes
@@ -339,6 +339,9 @@ class FedMLServerManager(FedMLCommManager):
                 for rank in dead:
                     self.client_online_status[rank] = False
                     _hb_misses.labels(run_id=self._run_label).inc()
+                    ledger.event("server", "heartbeat_dead",
+                                 round_idx=int(self.args.round_idx),
+                                 client=rank)
                 if dead:
                     logging.warning(
                         "server: clients %s silent for > %d heartbeat "
@@ -445,6 +448,9 @@ class FedMLServerManager(FedMLCommManager):
                 logging.info("server: late-joining client %d caught up "
                              "into round %d", sender, self.args.round_idx)
                 self._caught_up_this_round.add(sender)
+                ledger.event("server", "late_join",
+                             round_idx=int(self.args.round_idx),
+                             client=sender)
                 self._broadcast_round(only_rank=sender)
 
     def _maybe_force_init(self) -> None:
@@ -478,6 +484,9 @@ class FedMLServerManager(FedMLCommManager):
             "train_round", parent=parent, round=int(self.args.round_idx))
         _current_round.labels(run_id=self._run_label).set(
             int(self.args.round_idx))
+        ledger.event("server", "round_start",
+                     round_idx=int(self.args.round_idx),
+                     expected=len(self.client_id_list_in_this_round))
 
     def send_init_msg(self) -> None:
         self.client_id_list_in_this_round = self.aggregator.client_sampling(
@@ -566,6 +575,11 @@ class FedMLServerManager(FedMLCommManager):
                     codec=(self._wire_spec.kind if use_codec
                            else "raw")).inc(nbytes)
                 flight_recorder.note_transfer("comm", nbytes)
+                ledger.event("server", "solicit",
+                             round_idx=int(self.args.round_idx),
+                             client=rank, nbytes=int(nbytes),
+                             codec=(self._wire_spec.kind if use_codec
+                                    else "raw"))
                 msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
                                self.client_id_list_in_this_round[i])
                 msg.add_params(MyMessage.MSG_ARG_KEY_ROUND,
@@ -612,6 +626,7 @@ class FedMLServerManager(FedMLCommManager):
                 "server: round %d timeout — aggregating %d/%d results, "
                 "dropping stragglers", round_idx, got,
                 len(self.client_id_list_in_this_round))
+            self._round_close_reason = "timeout"
             self._complete_round()
 
     def _quarantine_exhausted(self, rank: int) -> bool:
@@ -676,11 +691,14 @@ class FedMLServerManager(FedMLCommManager):
                 self.client_online_status[rank] = False
                 self._deadline_dropped.add(rank)
                 _stragglers_dropped.labels(run_id=self._run_label).inc()
+                ledger.event("server", "deadline_drop",
+                             round_idx=int(round_idx), client=rank)
             logging.warning(
                 "server: round %d deadline — aggregating %d/%d results, "
                 "dropping stragglers %s (quarantined, not stragglers: %s)",
                 round_idx, got, len(ranks), stragglers,
                 sorted(quarantined))
+            self._round_close_reason = "deadline"
             self._complete_round()
 
     def _ranks_for(self, client_ids: List[int]) -> List[int]:
@@ -731,6 +749,9 @@ class FedMLServerManager(FedMLCommManager):
                 self._round_train_metrics[sender] = train_metrics
             self._last_seen[sender] = time.monotonic()
             self.client_online_status[sender] = True
+            ledger.event("server", "receive",
+                         round_idx=int(self.args.round_idx),
+                         client=sender, samples=local_sample_number)
             reason = self.aggregator.add_local_trained_result(
                 sender - 1, model_params, local_sample_number)
             if reason is not None:
@@ -747,6 +768,10 @@ class FedMLServerManager(FedMLCommManager):
                         "server: re-soliciting client %d after "
                         "quarantined upload (%s, attempt %d/%d)",
                         sender, reason, n_prev + 1, self._resolicit_max)
+                    ledger.event("server", "resolicit",
+                                 round_idx=int(self.args.round_idx),
+                                 client=sender, reason=reason,
+                                 attempt=n_prev + 1)
                     self._broadcast_round(only_rank=sender)
                 else:
                     # budget exhausted: this rank is given up on for the
@@ -789,6 +814,7 @@ class FedMLServerManager(FedMLCommManager):
                 "server: round %d — all %d online participants reported; "
                 "completing without waiting for %d offline",
                 self.args.round_idx, len(online), len(ranks - online))
+            self._round_close_reason = "early"
             self._complete_round()
 
     def _drain_requested(self) -> bool:
@@ -808,6 +834,8 @@ class FedMLServerManager(FedMLCommManager):
             self._round_timer.cancel()
         if self._deadline_timer is not None:
             self._deadline_timer.cancel()
+        closed = getattr(self, "_round_close_reason", None) or "full"
+        self._round_close_reason = None
         mlops.event("server.wait", False, self.args.round_idx)
         n_reported = self.aggregator.receive_count()
         # aggregation + eval run UNDER the round span's context so the
@@ -834,12 +862,20 @@ class FedMLServerManager(FedMLCommManager):
             _round_seconds.labels(run_id=self._run_label).observe(
                 self._round_span.end())
             self._round_span = None
+        ledger.event("server", "round_close",
+                     round_idx=int(self.args.round_idx), closed=closed,
+                     reported=int(n_reported),
+                     expected=len(self.client_id_list_in_this_round))
+        slo.check_round_boundary(int(self.args.round_idx))
 
         self.args.round_idx += 1
         # boundary checkpoint: next round index + freshly aggregated global
         # params, received set cleared by aggregate()
         self._persist_round_state()
         if self.args.round_idx >= self.round_num:
+            ledger.event("server", "run_finish",
+                         round_idx=int(self.args.round_idx),
+                         rounds=int(self.round_num))
             self.send_finish_to_all()
             mlops.log_aggregation_status("FINISHED")
             if self._run_span is not None:
@@ -860,6 +896,8 @@ class FedMLServerManager(FedMLCommManager):
             self.args.preempted_at_round = int(self.args.round_idx)
             _preempted_round.labels(run_id=self._run_label).set(
                 int(self.args.round_idx))
+            ledger.event("server", "preempt",
+                         round_idx=int(self.args.round_idx))
             self.send_finish_to_all()
             mlops.log_aggregation_status("PREEMPTED")
             if self._run_span is not None:

@@ -30,7 +30,7 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional
 
-from ...core.mlops import metrics
+from ...core.mlops import ledger, metrics
 from ..resource_db import ComputeResourceDB
 from .allocator import GangAllocator
 from .jobspec import PREEMPTED_EXIT_CODE, JobState
@@ -174,10 +174,16 @@ class PodScheduler:
                 # a hard kill loses no completed round
                 self.queue.requeue_preempted(job_id, rc)
                 _preempted_total.labels(tenant=tenant).inc()
+                ledger.event("scheduler", "requeue", job_id=job_id,
+                             tenant=tenant, rc=rc)
             elif rc == 0:
                 self.queue.mark_finished(job_id, JobState.FINISHED, 0)
+                ledger.event("scheduler", "finish", job_id=job_id,
+                             tenant=tenant, rc=0)
             else:
                 self.queue.mark_finished(job_id, JobState.FAILED, rc)
+                ledger.event("scheduler", "finish", job_id=job_id,
+                             tenant=tenant, rc=rc)
             with self._lock:
                 self._handles.pop(job_id, None)
                 self._drain_started.pop(job_id, None)
@@ -205,6 +211,8 @@ class PodScheduler:
                summary: Dict[str, Any]) -> None:
         handle.drain()
         self.queue.mark_preempting(job["job_id"])
+        ledger.event("scheduler", "preempt", job_id=job["job_id"],
+                     tenant=str(job["tenant"]))
         with self._lock:
             self._drain_started.setdefault(job["job_id"], now)
         summary["draining"].append(job["job_id"])
@@ -291,6 +299,9 @@ class PodScheduler:
         with self._lock:
             self._handles[job_id] = handle
             self._reservations.pop(job_id, None)
+        ledger.event("scheduler", "dispatch", job_id=job_id,
+                     tenant=str(job["tenant"]), run=run_id,
+                     slots=len(slots), resume=bool(job["resume"]))
         logging.info("pod: dispatched %s (%s/%s, %d slots, run %s%s)",
                      job["name"], job["tenant"], job["kind"], len(slots),
                      run_id, ", resume" if job["resume"] else "")

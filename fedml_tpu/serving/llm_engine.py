@@ -25,7 +25,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from ..core.mlops import flight_recorder
+from ..core.mlops import flight_recorder, ledger
 from ..core.mlops import metrics as _metrics
 
 _ttft_seconds = _metrics.histogram(
@@ -53,19 +53,44 @@ class _EngineMetrics:
     """Per-engine cached label children — one label lookup at construction
     instead of one per decode step."""
 
+    #: decode ledger sampling stride: per-step ledger writes on the token
+    #: hot loop would be the overhead the self-measurement exists to
+    #: catch, so decode_batch events aggregate this many steps
+    DECODE_LEDGER_EVERY = 64
+
     def __init__(self, engine_label: str) -> None:
+        self.label = engine_label
         self.ttft = _ttft_seconds.labels(engine=engine_label)
         self.step = _decode_step_seconds.labels(engine=engine_label)
         self.tokens = _tokens_total.labels(engine=engine_label)
         self.tps = _tokens_per_s.labels(engine=engine_label)
         self.queue = _queue_depth.labels(engine=engine_label)
         self.active = _active_requests.labels(engine=engine_label)
+        self._decode_lock = threading.Lock()
+        self._decode_steps = 0
+        self._decode_secs = 0.0
 
     def note_token(self, req: "_Request") -> None:
         if req.t_first_token is None:
             req.t_first_token = time.monotonic()
             self.ttft.observe(req.t_first_token - req.t_submit)
         self.tokens.inc()
+
+    def note_decode(self, dt: float, batch: int) -> None:
+        """Sampled run-ledger attribution for the decode loop: one
+        ``decode_batch`` event per DECODE_LEDGER_EVERY dispatches."""
+        if not ledger.enabled():
+            return
+        with self._decode_lock:
+            self._decode_steps += 1
+            self._decode_secs += dt
+            if self._decode_steps < self.DECODE_LEDGER_EVERY:
+                return
+            steps, secs = self._decode_steps, self._decode_secs
+            self._decode_steps = 0
+            self._decode_secs = 0.0
+        ledger.event("serving", "decode_batch", engine=self.label,
+                     steps=steps, secs=round(secs, 6), batch=batch)
 
 
 _scatter_cache_row_jit = None
@@ -271,9 +296,10 @@ class BatchedLLMEngine:
                                                jnp.asarray(pos)))
             # histogram-only attribution: per-token flight-log writes
             # would BE the overhead the recorder exists to catch
+            dt_step = time.monotonic() - t_step
             flight_recorder.observe_phase(
-                "device_compute", time.monotonic() - t_step,
-                program="serving/decode_step")
+                "device_compute", dt_step, program="serving/decode_step")
+            self._metrics.note_decode(dt_step, self.active_count)
             for slot, req in enumerate(self._active):
                 if req is None:
                     continue
@@ -636,9 +662,10 @@ class KVCacheLLMEngine:
                 self._cache, logits = self.lm.decode(
                     self._cache, jnp.asarray(tokens), jnp.asarray(self._pos))
                 logits = np.asarray(logits)
+            dt_step = time.monotonic() - t_step
             flight_recorder.observe_phase(
-                "device_compute", time.monotonic() - t_step,
-                program="serving/decode_step")
+                "device_compute", dt_step, program="serving/decode_step")
+            self._metrics.note_decode(dt_step, self.active_count)
             for slot, req in enumerate(self._active):
                 if req is None:
                     continue
@@ -738,6 +765,7 @@ class KVCacheLLMEngine:
         self._metrics.step.observe(dt_dispatch)
         flight_recorder.observe_phase(
             "device_compute", dt_dispatch, program="serving/decode_step")
+        self._metrics.note_decode(dt_dispatch, self.active_count)
         for slot, req in enumerate(self._active):
             if req is None:
                 continue

@@ -50,7 +50,7 @@ import numpy as np
 from ...constants import AXIS_CLIENTS, FED_OPT_FEDDYN, FED_OPT_FEDOPT, \
     FED_OPT_MIME, FED_OPT_SCAFFOLD
 from ...core import mlops
-from ...core.mlops import flight_recorder
+from ...core.mlops import flight_recorder, ledger
 from ...data.population import ClientPopulation, load_population, \
     philox_generator
 from ...ml.engine.local_update import build_eval_step, build_local_update, \
@@ -379,6 +379,8 @@ class StreamingParrotAPI:
             flight_recorder.note_transfer("h2d", nbytes)
         self._bytes_h2d += nbytes
         cohort_ids = np.concatenate([sl["ids"] for sl in cohort])
+        ledger.event("hyperscale", "stage", round_idx=int(round_idx),
+                     clients=int(cohort_ids.size), nbytes=int(nbytes))
         return _Staged(tuple(grids), tuple(weights), tuple(ids_dev),
                        cohort_ids, nbytes, time.perf_counter() - t0)
 

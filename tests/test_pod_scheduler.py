@@ -294,6 +294,36 @@ def test_mlops_job_scope_isolates_log_dirs(tmp_path):
     assert not mlops._state["enabled"] and not mlops._state["files"]
 
 
+def test_job_scope_isolates_run_ledgers(tmp_path, monkeypatch):
+    """Per-job ledger isolation: two pod jobs scoped with
+    `mlops.job_scope` (the in-process dispatch contract) write DISJOINT
+    ledger.jsonl files — every record carries its own job's run_id, and
+    a job's ledger never leaks events from the other tenant."""
+    from fedml_tpu.core.mlops import ledger
+
+    monkeypatch.setenv("FEDML_TPU_RUN_LEDGER", "1")
+    d1, d2 = str(tmp_path / "jobA"), str(tmp_path / "jobB")
+    with mlops.job_scope(d1, run_id="tenant-a"):
+        assert ledger.enabled()
+        ledger.event("server", "round_start", round_idx=0, expected=2)
+        ledger.event("aggregator", "admitted", round_idx=0, client=1)
+    with mlops.job_scope(d2, run_id="tenant-b"):
+        ledger.event("server", "round_start", round_idx=0, expected=5)
+        ledger.event("server", "deadline_drop", round_idx=0, client=4)
+    # scope exit disarmed the ledger; no stray file at either root
+    assert not ledger.enabled()
+    a = ledger.load_ledger(d1)
+    b = ledger.load_ledger(d2)
+    assert {r["run_id"] for r in a} == {"tenant-a"}
+    assert {r["run_id"] for r in b} == {"tenant-b"}
+    assert {r["event"] for r in a} == {"round_start", "admitted"}
+    assert {r["event"] for r in b} == {"round_start", "deadline_drop"}
+    # and the anatomies resolve independently
+    assert ledger.load_anatomy(d1)["run_id"] == "tenant-a"
+    assert ledger.load_anatomy(d2)["rounds"][0]["clients"][4][
+        "deadline_dropped"] is True
+
+
 def test_mlops_init_honors_pod_log_dir_env(tmp_path, monkeypatch):
     pod_dir = str(tmp_path / "podlogs")
     monkeypatch.setenv("FEDML_TPU_LOG_DIR", pod_dir)
