@@ -1133,6 +1133,308 @@ def model_deploy(name: str, host: str, port: int) -> None:
         ep.stop()
 
 
+@cli.group()
+def load() -> None:
+    """Serving observatory: open-loop load soaks against the LLM engines
+    with per-request lifecycle telemetry and degradation curves
+    (docs/OBSERVABILITY.md "Serving observatory")."""
+
+
+def _default_length_hist() -> str:
+    """``benchmarks/serving_length_hist.json`` at the checkout root."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(root, "benchmarks", "serving_length_hist.json")
+
+
+def _build_lengths(spec, seed: int):
+    """``fixed:PROMPT:OUTPUT`` or a histogram JSON path."""
+    from ..serving.loadgen import LengthSampler
+
+    if str(spec).startswith("fixed:"):
+        parts = str(spec).split(":")
+        if len(parts) != 3:
+            raise click.ClickException(
+                f"bad lengths spec {spec!r} (want 'fixed:PROMPT:OUTPUT')")
+        return LengthSampler.fixed(int(parts[1]), int(parts[2]), seed=seed)
+    if not os.path.exists(spec):
+        raise click.ClickException(
+            f"length histogram {spec} not found (pass --lengths PATH or "
+            f"'fixed:PROMPT:OUTPUT')")
+    return LengthSampler.from_file(spec, seed=seed)
+
+
+def _engine_opts(fn):
+    """Shared CPU-proxy engine geometry flags for `load run|curve`."""
+    opts = [
+        click.option("--engine", "engine_kind", default="kv",
+                     type=click.Choice(["kv", "batched"]),
+                     help="kv: per-row KV cache engine (default); "
+                          "batched: full-window re-forward engine"),
+        click.option("--vocab", default=90),
+        click.option("--dim", default=32),
+        click.option("--layers", default=2),
+        click.option("--heads", default=4),
+        click.option("--max-len", "max_len", default=96,
+                     help="KV cache rows (prompt + generation budget)"),
+        click.option("--max-batch", "max_batch", default=4,
+                     help="engine slots (batch occupancy ceiling)"),
+        click.option("--tokens-per-dispatch", default=4),
+        click.option("--lengths", "lengths_spec", default=None,
+                     help="length histogram JSON (default: committed "
+                          "benchmarks/serving_length_hist.json) or "
+                          "'fixed:PROMPT:OUTPUT'"),
+        click.option("--admission", "admission_spec", default="queue:32",
+                     help="shed policy: 'queue:N' | 'ttft:SECONDS' | "
+                          "both comma-joined | 'none'"),
+        click.option("--seed", default=0),
+        click.option("--no-warmup", is_flag=True,
+                     help="skip the pre-soak jit warm-up (first requests "
+                          "will then pay XLA compile inside the "
+                          "measured window)"),
+    ]
+    for opt in reversed(opts):
+        fn = opt(fn)
+    return fn
+
+
+def _warm(model, engine_kind: str, geometry, sampler) -> None:
+    """Throwaway engine over the same model: compiles every prefill
+    bucket + the decode dispatch outside the measured window."""
+    from ..serving.loadgen import build_engine, warm_engine
+
+    eng = build_engine(model, engine_kind, admission=None, **geometry)
+    try:
+        n = warm_engine(eng, max_prompt=int(sampler.describe()["prompt_max"]),
+                        tokens_per_dispatch=geometry["tokens_per_dispatch"])
+    finally:
+        eng.stop()
+    click.echo(f"warm-up: {n} requests (jit compile excluded from the "
+               f"measured window)", err=True)
+
+
+@load.command("run")
+@_engine_opts
+@click.option("--arrivals", default="poisson:8",
+              help="'poisson:QPS' | 'mmpp:CALM:BURST[:SWITCH_P]' | "
+                   "'trace:PATH[:SCALE]' (PATH: JSONL trace or a previous "
+                   "run's ledger)")
+@click.option("--duration-s", default=10.0, type=float)
+@click.option("--cancel-fraction", default=0.0, type=float,
+              help="fraction of requests that disconnect mid-decode "
+                   "(exercises the cancel lifecycle under load)")
+@click.option("--out", "out_dir", default=None,
+              help="artifact directory (default: .fedml_load/<pid>); "
+                   "ledger.jsonl and spans.jsonl land here too")
+@click.option("--history", "history_path", default=None,
+              help="perf history to append the measured serving row to "
+                   "(default: benchmarks/perf_history.jsonl; 'none' "
+                   "disables)")
+@click.option("--platform", default="cpu",
+              help="provenance platform tag for the history row")
+@click.option("--json", "as_json", is_flag=True)
+def load_run(engine_kind, vocab, dim, layers, heads, max_len, max_batch,
+             tokens_per_dispatch, lengths_spec, admission_spec, seed,
+             no_warmup, arrivals, duration_s, cancel_fraction, out_dir,
+             history_path, platform, as_json) -> None:
+    """One open-loop soak: drive the engine at the offered load, record
+    every request's lifecycle (ledger + spans + requests.jsonl), dump a
+    Prometheus scrape for offline `fedml slo check --metrics`, and
+    append the measured serving headline to the perf history."""
+    from types import SimpleNamespace
+
+    from ..core import mlops
+    from ..core.mlops import metrics as metrics_mod
+    from ..core.mlops import perf_history
+    from ..serving.admission import parse_admission
+    from ..serving.loadgen import (build_engine, build_model,
+                                   parse_arrivals, render_report,
+                                   run_soak, summarize, write_artifacts)
+
+    geometry = dict(vocab=vocab, dim=dim, layers=layers, heads=heads,
+                    max_len=max_len, max_batch=max_batch,
+                    tokens_per_dispatch=tokens_per_dispatch)
+    try:
+        process = parse_arrivals(arrivals, seed=seed)
+        controller = parse_admission(admission_spec)
+    except ValueError as e:
+        raise click.ClickException(str(e))
+    sampler = _build_lengths(lengths_spec or _default_length_hist(), seed)
+    out_dir = out_dir or os.path.join(".fedml_load", f"run-{os.getpid()}")
+
+    model = build_model(engine_kind, seed=seed, **geometry)
+    if not no_warmup:
+        _warm(model, engine_kind, geometry, sampler)
+    # fresh registry AFTER warm-up: the measured histograms must not
+    # carry the warm-up's compile-dominated observations
+    metrics_mod.REGISTRY.reset()
+    mlops.init(SimpleNamespace(
+        log_file_dir=out_dir, run_id=f"load-{seed}", enable_tracking=True,
+        run_ledger=True, ledger_max_records=65536))
+    engine = build_engine(model, engine_kind, admission=controller,
+                          **geometry)
+    try:
+        result = run_soak(engine, process, sampler, duration_s,
+                          vocab=vocab, cancel_fraction=cancel_fraction,
+                          seed=seed)
+    finally:
+        engine.stop()
+    summary = summarize(result)
+    write_artifacts(out_dir, result, summary)
+    mlops.shutdown()
+
+    if as_json:
+        click.echo(json.dumps(summary, indent=2, sort_keys=True))
+    else:
+        click.echo(render_report(summary))
+        click.echo(f"artifacts: {out_dir}")
+    if history_path is None or history_path.lower() != "none":
+        ttft_p99 = summary.get("ttft_p99")
+        entry = perf_history.append_entry(
+            history_path or perf_history.default_history_path(),
+            platform=platform, source="fedml load run",
+            metrics={"serving_sustained_qps": summary["goodput_qps"],
+                     "serving_tokens_per_s": summary["tokens_per_s"]},
+            measured=True, label=f"load:{arrivals}",
+            notes=(f"offered {summary['offered_qps']:.2f} qps, ttft_p99 "
+                   + ("--" if ttft_p99 is None else f"{ttft_p99:.3f}s")
+                   + f", shed {summary['shed_rate'] * 100:.1f}%, "
+                     f"engine {engine_kind}"))
+        click.echo(f"perf history += {entry['metrics']}", err=True)
+
+
+@load.command("report")
+@click.option("--out", "out_dir", required=True,
+              type=click.Path(exists=True),
+              help="artifact directory from a previous `fedml load run`")
+@click.option("--anatomy", "show_anatomy", is_flag=True,
+              help="render exemplar per-request timelines from the "
+                   "ledger (slowest completed, a cancel, a shed)")
+@click.option("--rid", default=None, type=int,
+              help="render one request's full lifecycle timeline")
+@click.option("--json", "as_json", is_flag=True)
+def load_report(out_dir, show_anatomy, rid, as_json) -> None:
+    """Re-render a recorded soak offline: headline summary from
+    summary.json (rebuilt from requests.jsonl when absent), plus the
+    per-request anatomy join of ledger events and spans."""
+    from ..core.mlops.ledger import load_ledger
+    from ..core.mlops.tracing import load_spans
+    from ..serving.loadgen import (render_exemplars, render_report,
+                                   render_request_timeline,
+                                   request_anatomy, summarize_requests)
+
+    summary_path = os.path.join(out_dir, "summary.json")
+    if os.path.exists(summary_path):
+        with open(summary_path) as f:
+            summary = json.load(f)
+    else:
+        rows_path = os.path.join(out_dir, "requests.jsonl")
+        if not os.path.exists(rows_path):
+            raise click.ClickException(
+                f"no summary.json or requests.jsonl under {out_dir}")
+        rows = []
+        with open(rows_path) as f:
+            for line in f:
+                if line.strip():
+                    rows.append(json.loads(line))
+        if not rows:
+            raise click.ClickException(f"requests.jsonl empty in {out_dir}")
+        span = max((r.get("t_submit") or 0.0) for r in rows)
+        summary = summarize_requests(rows, max(span, 1e-9))
+    if as_json:
+        click.echo(json.dumps(summary, indent=2, sort_keys=True))
+    else:
+        click.echo(render_report(summary))
+    if show_anatomy or rid is not None:
+        records = load_ledger(out_dir)
+        if not records:
+            raise click.ClickException(
+                f"no ledger.jsonl under {out_dir} — was the run armed?")
+        anatomy = request_anatomy(records, load_spans(out_dir))
+        click.echo("")
+        if rid is not None:
+            click.echo(render_request_timeline(anatomy, rid))
+        else:
+            click.echo(render_exemplars(anatomy))
+
+
+@load.command("curve")
+@_engine_opts
+@click.option("--qps", "qps_spec", default="2,4,8,16",
+              help="comma-separated offered-load sweep points")
+@click.option("--duration-s", default=6.0, type=float,
+              help="soak seconds per sweep point")
+@click.option("--slo-ttft-p99", "slo_ttft", default=1.0, type=float,
+              help="TTFT p99 SLO bound used for knee detection (s)")
+@click.option("--goodput-floor", default=0.9, type=float,
+              help="knee requires goodput >= floor * offered")
+@click.option("--cancel-fraction", default=0.0, type=float)
+@click.option("--out", "out_path", default=None,
+              help="write the sweep points + knee to this JSON file")
+@click.option("--json", "as_json", is_flag=True)
+def load_curve(engine_kind, vocab, dim, layers, heads, max_len, max_batch,
+               tokens_per_dispatch, lengths_spec, admission_spec, seed,
+               no_warmup, qps_spec, duration_s, slo_ttft, goodput_floor,
+               cancel_fraction, out_path, as_json) -> None:
+    """Sweep offered load ascending and report the degradation curve:
+    the saturation knee (highest offered QPS still inside the TTFT SLO
+    at goodput) and whether the engine degrades gracefully past it —
+    shed rate absorbing the excess while admitted p99 stays bounded."""
+    from ..serving.admission import parse_admission
+    from ..serving.loadgen import (PoissonProcess, build_engine,
+                                   build_model, degradation_curve,
+                                   find_knee, render_curve, run_soak,
+                                   summarize)
+
+    geometry = dict(vocab=vocab, dim=dim, layers=layers, heads=heads,
+                    max_len=max_len, max_batch=max_batch,
+                    tokens_per_dispatch=tokens_per_dispatch)
+    try:
+        qps_points = [float(q) for q in qps_spec.split(",") if q.strip()]
+    except ValueError:
+        raise click.ClickException(f"bad --qps {qps_spec!r}")
+    if not qps_points:
+        raise click.ClickException("empty --qps sweep")
+    try:
+        parse_admission(admission_spec)   # fail fast before the sweep
+    except ValueError as e:
+        raise click.ClickException(str(e))
+    sampler = _build_lengths(lengths_spec or _default_length_hist(), seed)
+    model = build_model(engine_kind, seed=seed, **geometry)
+    if not no_warmup:
+        _warm(model, engine_kind, geometry, sampler)
+
+    def run_at(q: float):
+        # fresh engine per point (empty queue, same compiled model)
+        engine = build_engine(model, engine_kind,
+                              admission=parse_admission(admission_spec),
+                              **geometry)
+        try:
+            result = run_soak(engine, PoissonProcess(q, seed=seed),
+                              sampler, duration_s, vocab=vocab,
+                              cancel_fraction=cancel_fraction, seed=seed)
+        finally:
+            engine.stop()
+        click.echo(f"  offered {q:g} qps done", err=True)
+        return summarize(result)
+
+    points = degradation_curve(run_at, qps_points)
+    knee = find_knee(points, slo_ttft, goodput_floor)
+    if as_json:
+        click.echo(json.dumps({"points": points, "knee": knee},
+                              indent=2, sort_keys=True))
+    else:
+        click.echo(render_curve(points, slo_ttft, goodput_floor))
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump({"points": points, "knee": knee,
+                       "slo_ttft_p99_s": slo_ttft,
+                       "goodput_floor": goodput_floor},
+                      f, indent=2, sort_keys=True)
+            f.write("\n")
+        click.echo(f"curve written to {out_path}", err=True)
+
+
 def main() -> None:
     cli()
 

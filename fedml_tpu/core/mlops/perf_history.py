@@ -40,7 +40,8 @@ DEFAULT_HISTORY = os.path.join("benchmarks", "perf_history.jsonl")
 
 #: headline metrics the sentinel watches — all higher-is-better
 HEADLINE_METRICS = ("rounds_per_s", "clients_per_s", "tokens_per_s",
-                    "measured_mfu")
+                    "measured_mfu", "serving_sustained_qps",
+                    "serving_tokens_per_s")
 
 
 def git_rev(cwd: Optional[str] = None) -> str:

@@ -36,7 +36,14 @@ Indicator catalog (docs/OBSERVABILITY.md "SLO engine" has the table):
   ``fedml_round_phase_seconds`` (fallback: flight summary);
 * ``measured_mfu`` — min over programs of ``fedml_measured_mfu``
   (fallback: flight summary program MFUs);
-* ``decode_ttft_p99`` — p99 of ``fedml_llm_ttft_seconds``.
+* ``decode_ttft_p99`` — p99 of ``fedml_llm_ttft_seconds``;
+* ``queue_wait_p99`` — p99 of ``fedml_llm_queue_wait_seconds`` (the
+  queue leg of TTFT: submit → admit);
+* ``decode_tbt_p99`` — p99 of ``fedml_llm_tbt_seconds`` (finished
+  requests only — cancels are excluded at observation time);
+* ``serving_shed_rate`` — ``fedml_llm_shed_total`` /
+  ``fedml_llm_requests_total`` (fallback: serving ledger shed/submit
+  event counts).
 
 Evaluation surfaces: ``check_round_boundary()`` (wired into the sync
 server's ``_complete_round`` and the async funnel's ``_flush``) inc's
@@ -307,6 +314,29 @@ def _ind_decode_ttft_p99(ctx: SLOContext, rule: SLORule) -> Optional[float]:
                         float(rule.params.get("quantile", 0.99)))
 
 
+def _ind_queue_wait_p99(ctx: SLOContext, rule: SLORule) -> Optional[float]:
+    return ctx.quantile("fedml_llm_queue_wait_seconds",
+                        float(rule.params.get("quantile", 0.99)))
+
+
+def _ind_decode_tbt_p99(ctx: SLOContext, rule: SLORule) -> Optional[float]:
+    return ctx.quantile("fedml_llm_tbt_seconds",
+                        float(rule.params.get("quantile", 0.99)))
+
+
+def _ind_serving_shed_rate(ctx: SLOContext,
+                           rule: SLORule) -> Optional[float]:
+    shed = ctx.counter_sum("fedml_llm_shed_total")
+    total = ctx.counter_sum("fedml_llm_requests_total")
+    if shed is not None and total:
+        return shed / total
+    # ledger fallback: shed / submit event counts from the serving actor
+    submits = ctx.ledger_event_count("serving", "submit")
+    if submits <= 0:
+        return None
+    return ctx.ledger_event_count("serving", "shed") / submits
+
+
 INDICATORS = {
     "round_time_p95": _ind_round_time_p95,
     "quarantine_rate": _ind_quarantine_rate,
@@ -314,6 +344,9 @@ INDICATORS = {
     "h2d_blocked_share": _ind_h2d_blocked_share,
     "measured_mfu": _ind_measured_mfu,
     "decode_ttft_p99": _ind_decode_ttft_p99,
+    "queue_wait_p99": _ind_queue_wait_p99,
+    "decode_tbt_p99": _ind_decode_tbt_p99,
+    "serving_shed_rate": _ind_serving_shed_rate,
 }
 
 

@@ -1,0 +1,148 @@
+"""SLO-aware serving admission — shed at submit time, never collapse.
+
+The training plane learned this in PR 5: screen updates BEFORE they
+enter the funnel (`FedMLAggregator.add_local_trained_result` validates,
+quarantines with a recorded reason, and re-solicits) instead of letting
+a poisoned update corrupt the round.  The serving plane has the same
+failure shape under overload: a closed admission policy ("accept
+everything") turns an offered-load spike into unbounded queue growth —
+every admitted request still completes, but TTFT grows without bound
+and the p99 the SLO engine watches collapses for *all* traffic.
+
+`ServingAdmissionController` is the serving-plane port of that idiom:
+every `submit()` is screened against (a) a hard queue-depth bound and
+(b) an estimated queue wait — pending depth over the measured completion
+rate — against a TTFT budget.  A request that fails the screen is SHED:
+its future resolves with `ShedError`, a `shed` lifecycle event lands in
+the run ledger with the reason, `fedml_llm_shed_total{engine,reason}`
+counts it, and the OpenAI surface maps it to HTTP 429 — so past
+saturation the engine keeps bounded p99 for admitted requests while the
+shed rate (not the latency) absorbs the excess.  Screening is O(1) per
+submit and allocation-free on the admit path.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Optional, Tuple
+
+
+class ShedError(RuntimeError):
+    """A request refused admission by the serving admission policy.
+
+    Carries ``reason`` ("queue_full" / "ttft_budget") so surfaces can
+    report *why* (the OpenAI API maps this to HTTP 429)."""
+
+    def __init__(self, reason: str, detail: str = "") -> None:
+        super().__init__(detail or reason)
+        self.reason = reason
+
+
+class ServingAdmissionController:
+    """Screen serving submits against queue depth and a TTFT budget.
+
+    * ``max_queue_depth`` — hard bound on requests waiting for a slot;
+    * ``ttft_budget_s`` — shed when the estimated queue wait (pending
+      depth / completion rate over ``window_s``) exceeds the budget.
+      Cold start (no completions observed yet) admits: the estimate
+      needs real signal before it is allowed to refuse traffic.
+
+    The engine calls ``note_finish()`` on every retirement (finish OR
+    cancel — both free a slot) to feed the completion-rate estimate.
+    """
+
+    def __init__(self, max_queue_depth: Optional[int] = None,
+                 ttft_budget_s: Optional[float] = None,
+                 window_s: float = 10.0) -> None:
+        if max_queue_depth is None and ttft_budget_s is None:
+            raise ValueError("admission controller needs max_queue_depth "
+                             "and/or ttft_budget_s")
+        self.max_queue_depth = None if max_queue_depth is None \
+            else int(max_queue_depth)
+        self.ttft_budget_s = None if ttft_budget_s is None \
+            else float(ttft_budget_s)
+        self.window_s = float(window_s)
+        self._lock = threading.Lock()
+        self._finish_ts: "collections.deque[float]" = collections.deque(
+            maxlen=1024)
+        self._shed = 0
+        self._admitted = 0
+
+    # -- signal --------------------------------------------------------------
+    def note_finish(self) -> None:
+        """One request retired (finished or cancelled) — a slot freed."""
+        with self._lock:
+            self._finish_ts.append(time.monotonic())
+
+    def completion_rate(self) -> float:
+        """Requests retired per second over the sliding window (0.0 until
+        the first retirement ages into the window)."""
+        now = time.monotonic()
+        with self._lock:
+            recent = [t for t in self._finish_ts
+                      if now - t <= self.window_s]
+            if len(recent) < 2:
+                return 0.0
+            span = max(now - recent[0], 1e-6)
+            return len(recent) / span
+
+    # -- the screen ----------------------------------------------------------
+    def admit(self, queue_depth: int) -> Tuple[bool, Optional[str]]:
+        """→ (admitted, shed_reason).  O(1), never raises."""
+        if self.max_queue_depth is not None \
+                and queue_depth >= self.max_queue_depth:
+            with self._lock:
+                self._shed += 1
+            return False, "queue_full"
+        if self.ttft_budget_s is not None:
+            rate = self.completion_rate()
+            if rate > 0.0 and queue_depth / rate > self.ttft_budget_s:
+                with self._lock:
+                    self._shed += 1
+                return False, "ttft_budget"
+        with self._lock:
+            self._admitted += 1
+        return True, None
+
+    def stats(self) -> dict:
+        with self._lock:
+            shed, admitted = self._shed, self._admitted
+        return {"shed": shed, "admitted": admitted,
+                "completion_rate": self.completion_rate()}
+
+
+def parse_admission(spec: Optional[str]
+                    ) -> Optional[ServingAdmissionController]:
+    """CLI-boundary parser (the `parse_wire_compression` idiom):
+    ``"queue:64"`` | ``"ttft:0.5"`` | ``"queue:64,ttft:0.5"`` | ``"none"``
+    → a controller (or None).  Raises ValueError on a malformed spec so
+    bad flags die at startup, not mid-soak."""
+    if spec is None or spec.strip().lower() in ("", "none", "off"):
+        return None
+    max_q: Optional[int] = None
+    budget: Optional[float] = None
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            kind, _, val = part.partition(":")
+            kind = kind.strip().lower()
+            if kind == "queue":
+                max_q = int(val)
+                if max_q <= 0:
+                    raise ValueError
+            elif kind == "ttft":
+                budget = float(val)
+                if budget <= 0:
+                    raise ValueError
+            else:
+                raise ValueError
+        except ValueError:
+            raise ValueError(
+                f"bad admission spec {part!r} (want 'queue:N' and/or "
+                f"'ttft:SECONDS', e.g. 'queue:64,ttft:0.5')") from None
+    return ServingAdmissionController(max_queue_depth=max_q,
+                                      ttft_budget_s=budget)

@@ -16,6 +16,7 @@ batching on top of one jit-compiled fixed-shape decode step —
 
 from __future__ import annotations
 
+import itertools
 import logging
 import queue
 import threading
@@ -25,33 +26,20 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from ..core.mlops import flight_recorder, ledger
+from ..core.mlops import flight_recorder, ledger, tracing
 from ..core.mlops import metrics as _metrics
+from .admission import ServingAdmissionController, ShedError
 
-_ttft_seconds = _metrics.histogram(
-    "fedml_llm_ttft_seconds", "Submit-to-first-token latency",
-    labels=("engine",),
-    buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0))
-_decode_step_seconds = _metrics.histogram(
-    "fedml_llm_decode_step_seconds", "Latency of one decode dispatch",
-    labels=("engine",),
-    buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 5.0))
-_tokens_total = _metrics.counter(
-    "fedml_llm_tokens_total", "Tokens generated", labels=("engine",))
-_tokens_per_s = _metrics.gauge(
-    "fedml_llm_tokens_per_s", "Decode throughput since engine start",
-    labels=("engine",))
-_queue_depth = _metrics.gauge(
-    "fedml_llm_queue_depth", "Requests waiting for a batch slot",
-    labels=("engine",))
-_active_requests = _metrics.gauge(
-    "fedml_llm_active_requests", "Requests occupying batch slots",
-    labels=("engine",))
+#: request-id stream (one per process): every request carries ``rid``
+#: through its lifecycle events so the anatomy correlator can join them
+_rid_counter = itertools.count(1)
 
 
 class _EngineMetrics:
     """Per-engine cached label children — one label lookup at construction
-    instead of one per decode step."""
+    instead of one per decode step.  Metric objects resolve get-or-create
+    at construction (the ledger idiom) so an engine built after a test's
+    ``REGISTRY.reset()`` still lands on the exposition surface."""
 
     #: decode ledger sampling stride: per-step ledger writes on the token
     #: hot loop would be the overhead the self-measurement exists to
@@ -60,21 +48,159 @@ class _EngineMetrics:
 
     def __init__(self, engine_label: str) -> None:
         self.label = engine_label
-        self.ttft = _ttft_seconds.labels(engine=engine_label)
-        self.step = _decode_step_seconds.labels(engine=engine_label)
-        self.tokens = _tokens_total.labels(engine=engine_label)
-        self.tps = _tokens_per_s.labels(engine=engine_label)
-        self.queue = _queue_depth.labels(engine=engine_label)
-        self.active = _active_requests.labels(engine=engine_label)
+        self.ttft = _metrics.histogram(
+            "fedml_llm_ttft_seconds", "Submit-to-first-token latency",
+            labels=("engine",),
+            buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                     15.0, 60.0)).labels(engine=engine_label)
+        self.step = _metrics.histogram(
+            "fedml_llm_decode_step_seconds",
+            "Latency of one decode dispatch", labels=("engine",),
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1.0, 5.0)).labels(engine=engine_label)
+        self.tokens = _metrics.counter(
+            "fedml_llm_tokens_total", "Tokens generated",
+            labels=("engine",)).labels(engine=engine_label)
+        self.tps = _metrics.gauge(
+            "fedml_llm_tokens_per_s",
+            "Decode throughput since engine start",
+            labels=("engine",)).labels(engine=engine_label)
+        self.queue = _metrics.gauge(
+            "fedml_llm_queue_depth", "Requests waiting for a batch slot",
+            labels=("engine",)).labels(engine=engine_label)
+        self.active = _metrics.gauge(
+            "fedml_llm_active_requests", "Requests occupying batch slots",
+            labels=("engine",)).labels(engine=engine_label)
+        # TTFT decomposition (queue + prefill + first-decode): each leg
+        # its own histogram so /metrics alone can check the identity
+        self.queue_wait = _metrics.histogram(
+            "fedml_llm_queue_wait_seconds",
+            "Submit-to-admit wait for a batch slot (the queue leg of "
+            "TTFT: ttft = queue_wait + prefill + first_decode)",
+            labels=("engine",),
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1.0, 2.5, 5.0, 15.0, 60.0)).labels(engine=engine_label)
+        self.prefill = _metrics.histogram(
+            "fedml_llm_prefill_seconds",
+            "Admission-prefill latency (the prefill leg of TTFT)",
+            labels=("engine",),
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1.0, 5.0)).labels(engine=engine_label)
+        self.tbt = _metrics.histogram(
+            "fedml_llm_tbt_seconds",
+            "Per-request mean time-between-tokens, observed at FINISH "
+            "only (cancelled requests never count)",
+            labels=("engine",),
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1.0, 5.0)).labels(engine=engine_label)
+        self._shed_total = _metrics.counter(
+            "fedml_llm_shed_total",
+            "Requests refused admission by the serving admission policy",
+            labels=("engine", "reason"))
+        self._requests_total = _metrics.counter(
+            "fedml_llm_requests_total",
+            "Requests by terminal lifecycle outcome",
+            labels=("engine", "outcome"))
+        self.occupancy = _metrics.gauge(
+            "fedml_llm_batch_occupancy",
+            "Active batch slots / max_batch, sampled on the engine loop",
+            labels=("engine",)).labels(engine=engine_label)
+        self.kv_tokens = _metrics.gauge(
+            "fedml_llm_kv_cache_tokens",
+            "KV-cache positions in use across active slots, sampled on "
+            "the engine loop", labels=("engine",)).labels(
+                engine=engine_label)
         self._decode_lock = threading.Lock()
         self._decode_steps = 0
         self._decode_secs = 0.0
 
+    # -- per-request lifecycle ----------------------------------------------
+    # events: submit → (queue) → admit|shed → prefill → first_token →
+    # (decode) → finish|cancel.  Every emission carries ``rid`` so
+    # `loadgen.anatomy.request_anatomy` can join a request's lifecycle
+    # back together; all ledger writes are one-dict-hit no-ops when the
+    # run ledger is disarmed.
+
+    def note_submit(self, req: "_Request") -> None:
+        if ledger.enabled():
+            ledger.event("serving", "submit", rid=req.rid,
+                         engine=self.label, prompt_tokens=len(req.ids),
+                         max_new=req.remaining)
+            req.span = tracing.start_span(
+                "serving.request", rid=req.rid, engine=self.label)
+
+    def note_shed(self, req: "_Request", reason: str,
+                  queue_depth: int) -> None:
+        req.outcome = "shed"
+        req.finish_reason = "shed"
+        self._shed_total.labels(engine=self.label, reason=reason).inc()
+        self._requests_total.labels(engine=self.label,
+                                    outcome="shed").inc()
+        if ledger.enabled():
+            ledger.event("serving", "shed", rid=req.rid,
+                         engine=self.label, reason=reason,
+                         queue_depth=int(queue_depth))
+        if req.span is not None:
+            req.span.set_attr("reason", reason)
+            req.span.end("shed")
+
+    def note_admit(self, req: "_Request", slot: int) -> None:
+        req.t_admit = time.monotonic()
+        wait = req.t_admit - req.t_submit
+        self.queue_wait.observe(wait)
+        if ledger.enabled():
+            ledger.event("serving", "admit", rid=req.rid,
+                         engine=self.label, slot=int(slot),
+                         queue_wait_s=round(wait, 6))
+
+    def note_prefill(self, req: "_Request", secs: float) -> None:
+        req.t_prefill_done = time.monotonic()
+        self.prefill.observe(secs)
+        if ledger.enabled():
+            ledger.event("serving", "prefill", rid=req.rid,
+                         engine=self.label, secs=round(secs, 6),
+                         tokens=len(req.ids))
+
     def note_token(self, req: "_Request") -> None:
+        now = time.monotonic()
         if req.t_first_token is None:
-            req.t_first_token = time.monotonic()
-            self.ttft.observe(req.t_first_token - req.t_submit)
+            req.t_first_token = now
+            self.ttft.observe(now - req.t_submit)
+            if ledger.enabled():
+                ledger.event("serving", "first_token", rid=req.rid,
+                             engine=self.label,
+                             ttft_s=round(now - req.t_submit, 6),
+                             queue_wait_s=round(req.queue_wait_s(), 6),
+                             prefill_s=round(req.prefill_s(), 6),
+                             first_decode_s=round(
+                                 req.first_decode_s(now), 6))
+        req.t_last_token = now
+        req.n_generated += 1
         self.tokens.inc()
+
+    def note_retire(self, req: "_Request", outcome: str) -> None:
+        """Terminal lifecycle transition: ``finish`` or ``cancel``.
+        Idempotent per request; TBT is observed on FINISH only so a
+        cancelled stream's tokens never skew the TBT percentiles."""
+        if req.outcome is not None:
+            return
+        req.outcome = outcome
+        req.t_finish = time.monotonic()
+        self._requests_total.labels(engine=self.label,
+                                    outcome=outcome).inc()
+        if outcome == "finish" and req.n_generated >= 2 \
+                and req.t_first_token is not None \
+                and req.t_last_token is not None:
+            self.tbt.observe((req.t_last_token - req.t_first_token)
+                             / (req.n_generated - 1))
+        if ledger.enabled():
+            ledger.event("serving", outcome, rid=req.rid,
+                         engine=self.label, tokens=req.n_generated,
+                         finish_reason=req.finish_reason,
+                         service_s=round(req.t_finish - req.t_submit, 6))
+        if req.span is not None:
+            req.span.set_attr("tokens", req.n_generated)
+            req.span.end(None if outcome == "finish" else outcome)
 
     def note_decode(self, dt: float, batch: int) -> None:
         """Sampled run-ledger attribution for the decode loop: one
@@ -125,14 +251,44 @@ class _Request:
         self.top_p = float(top_p if top_p is not None else 1.0)
         self.on_token = on_token        # per-token streaming callback
         self.future: "Future[np.ndarray]" = Future()
-        #: "stop" (ran to its token budget) or "length" (the engine had to
-        #: truncate: cache capacity < prompt+max_new) — OpenAI semantics,
-        #: surfaced to callers via future.request.finish_reason
+        #: "stop" (ran to its token budget), "length" (the engine had to
+        #: truncate: cache capacity < prompt+max_new), "cancelled", or
+        #: "shed" — OpenAI semantics, surfaced to callers via
+        #: future.request.finish_reason
         self.finish_reason = "stop"
         self.cancelled = threading.Event()
+        # -- lifecycle telemetry (submit → admit|shed → prefill →
+        #    first_token → finish|cancel); rid joins a request's ledger
+        #    events + span back together in `loadgen.anatomy`
+        self.rid = next(_rid_counter)
         self.t_submit = time.monotonic()
+        self.t_admit: Optional[float] = None
+        self.t_prefill_done: Optional[float] = None
         self.t_first_token: Optional[float] = None
+        self.t_last_token: Optional[float] = None
+        self.t_finish: Optional[float] = None
+        self.n_generated = 0
+        #: terminal lifecycle outcome ("finish" | "cancel" | "shed"),
+        #: set exactly once by _EngineMetrics.note_retire / note_shed
+        self.outcome: Optional[str] = None
+        self.span: Optional[tracing.Span] = None
         self.future.request = self  # type: ignore[attr-defined]
+
+    # -- TTFT decomposition legs (ttft = queue_wait + prefill +
+    #    first_decode by construction; un-measured legs report 0.0)
+    def queue_wait_s(self) -> float:
+        if self.t_admit is None:
+            return 0.0
+        return self.t_admit - self.t_submit
+
+    def prefill_s(self) -> float:
+        if self.t_prefill_done is None or self.t_admit is None:
+            return 0.0
+        return self.t_prefill_done - self.t_admit
+
+    def first_decode_s(self, t_first: float) -> float:
+        base = self.t_prefill_done or self.t_admit or self.t_submit
+        return t_first - base
 
     def cancel(self) -> None:
         """Ask the worker to retire this request at the next step (used by
@@ -174,7 +330,9 @@ def _sample_token(row: np.ndarray, req: "_Request", rng: np.random.Generator
 class BatchedLLMEngine:
     def __init__(self, bundle: Any, variables: Dict[str, Any],
                  max_batch: int = 8, window: Optional[int] = None,
-                 max_wait_s: float = 0.005) -> None:
+                 max_wait_s: float = 0.005,
+                 admission: Optional[ServingAdmissionController] = None
+                 ) -> None:
         import jax
         import jax.numpy as jnp
 
@@ -184,11 +342,15 @@ class BatchedLLMEngine:
         self.window = int(window or getattr(bundle, "input_shape",
                                             (64,))[0] or 64)
         self.max_wait_s = float(max_wait_s)
+        self.admission = admission
         self._pending: "queue.Queue[_Request]" = queue.Queue()
         self._active: List[Optional[_Request]] = [None] * self.max_batch
         self._stop = threading.Event()
         self._np_rng = np.random.default_rng(7)
         self._metrics = _EngineMetrics("batched")
+        #: guards loop-mutated counters that stats() snapshots from other
+        #: threads (the autoscaler + load report read while the loop writes)
+        self._state_lock = threading.Lock()
         self._tokens_done = 0
         self._t_start = time.monotonic()
 
@@ -218,9 +380,20 @@ class BatchedLLMEngine:
         if self._stop.is_set():
             req.future.set_exception(RuntimeError("engine stopped"))
             return req.future
+        self._metrics.note_submit(req)
         if req.remaining <= 0:  # zero-budget: resolve without a decode step
+            self._metrics.note_retire(req, "finish")
             req.future.set_result(np.asarray(req.ids))
             return req.future
+        if self.admission is not None:
+            depth = self._pending.qsize()
+            ok, reason = self.admission.admit(depth)
+            if not ok:
+                self._metrics.note_shed(req, reason, depth)
+                req.future.set_exception(
+                    ShedError(reason, f"request shed ({reason}); "
+                                      f"queue_depth={depth}"))
+                return req.future
         self._pending.put(req)
         return req.future
 
@@ -249,6 +422,7 @@ class BatchedLLMEngine:
             except queue.Empty:
                 break
             if not req.future.done():
+                self._metrics.note_retire(req, "cancel")
                 req.future.set_exception(RuntimeError("engine stopped"))
 
     @property
@@ -266,9 +440,16 @@ class BatchedLLMEngine:
         for slot in range(self.max_batch):
             if self._active[slot] is None:
                 try:
-                    self._active[slot] = self._pending.get_nowait()
+                    req = self._pending.get_nowait()
                 except queue.Empty:
                     return
+                self._active[slot] = req
+                self._metrics.note_admit(req, slot)
+
+    def _retire(self, req: "_Request", outcome: str) -> None:
+        self._metrics.note_retire(req, outcome)
+        if self.admission is not None:
+            self.admission.note_finish()
 
     def _loop(self) -> None:
         jnp = self._jnp
@@ -280,6 +461,7 @@ class BatchedLLMEngine:
                     # only bounds BATCHING latency, not idle polling)
                     req = self._pending.get(timeout=0.5)
                     self._active[0] = req
+                    self._metrics.note_admit(req, 0)
                 except queue.Empty:
                     continue
             x = np.zeros((self.max_batch, self.window), np.int32)
@@ -300,11 +482,13 @@ class BatchedLLMEngine:
             flight_recorder.observe_phase(
                 "device_compute", dt_step, program="serving/decode_step")
             self._metrics.note_decode(dt_step, self.active_count)
+            produced = 0
             for slot, req in enumerate(self._active):
                 if req is None:
                     continue
                 if req.cancelled.is_set():
                     req.finish_reason = "cancelled"
+                    self._retire(req, "cancel")
                     if not req.future.done():
                         req.future.set_result(np.asarray(req.ids))
                     self._active[slot] = None
@@ -312,19 +496,25 @@ class BatchedLLMEngine:
                 nxt = _sample_token(logits[slot], req, self._np_rng)
                 req.ids.append(nxt)
                 self._metrics.note_token(req)
-                self._tokens_done += 1
+                produced += 1
                 req.emit(nxt)
                 req.remaining -= 1
                 if req.remaining <= 0:
+                    self._retire(req, "finish")
                     req.future.set_result(np.asarray(req.ids))
                     self._active[slot] = None  # slot freed mid-flight
+            with self._state_lock:
+                self._tokens_done += produced
+                tokens_done = self._tokens_done
             self._metrics.queue.set(self._pending.qsize())
             self._metrics.active.set(self.active_count)
-            self._metrics.tps.set(self._tokens_done / max(
+            self._metrics.occupancy.set(self.active_count / self.max_batch)
+            self._metrics.tps.set(tokens_done / max(
                 time.monotonic() - self._t_start, 1e-9))
         # drain on shutdown: active AND still-pending requests must resolve
         for req in self._active:
             if req is not None and not req.future.done():
+                self._retire(req, "cancel")
                 req.future.set_result(np.asarray(req.ids))
         while True:
             try:
@@ -332,7 +522,26 @@ class BatchedLLMEngine:
             except queue.Empty:
                 break
             if not req.future.done():
+                self._metrics.note_retire(req, "cancel")
                 req.future.set_exception(RuntimeError("engine stopped"))
+
+    def stats(self) -> Dict[str, float]:
+        """Live metrics in the autoscaler's `observe` shape.  The counter
+        snapshot happens under ``_state_lock`` (the loop batches its
+        updates under the same lock) and the SAME values are pushed to the
+        Prometheus gauges, so the load report and /metrics can't disagree."""
+        with self._state_lock:
+            tokens_done = self._tokens_done
+        dt = max(time.monotonic() - self._t_start, 1e-9)
+        tps = tokens_done / dt
+        depth = self._pending.qsize()
+        active = self.active_count
+        self._metrics.tps.set(tps)
+        self._metrics.queue.set(depth)
+        self._metrics.active.set(active)
+        self._metrics.occupancy.set(active / self.max_batch)
+        return {"tokens_per_s": tps, "queue_depth": depth,
+                "active": active, "capacity": self.max_batch}
 
 
 class LLMEnginePredictor:
@@ -442,7 +651,9 @@ class KVCacheLLMEngine:
     `BatchedLLMEngine`."""
 
     def __init__(self, lm: Any, max_batch: int = 8,
-                 tokens_per_dispatch: int = 8) -> None:
+                 tokens_per_dispatch: int = 8,
+                 admission: Optional[ServingAdmissionController] = None
+                 ) -> None:
         import jax
         import jax.numpy as jnp
 
@@ -453,6 +664,7 @@ class KVCacheLLMEngine:
         #: temperature, top-k and nucleus filtering all run on-device)
         #: with NO host round trip in between — a ~k x dispatch-latency win
         self.tokens_per_dispatch = max(int(tokens_per_dispatch), 1)
+        self.admission = admission
         self._pending: "queue.Queue[_Request]" = queue.Queue()
         self._active: List[Optional[_Request]] = [None] * self.max_batch
         # per-slot decode state: position only (prefill progress is
@@ -462,6 +674,9 @@ class KVCacheLLMEngine:
         self._stop = threading.Event()
         self._np_rng = np.random.default_rng(11)
         self._rng_key = jax.random.PRNGKey(13)
+        #: guards loop-mutated counters that stats() snapshots from other
+        #: threads (the autoscaler + load report read while the loop writes)
+        self._state_lock = threading.Lock()
         self._tokens_done = 0
         self._t_start = time.monotonic()
         self._metrics = _EngineMetrics("kv")
@@ -479,6 +694,7 @@ class KVCacheLLMEngine:
         if self._stop.is_set():
             req.future.set_exception(RuntimeError("engine stopped"))
             return req.future
+        self._metrics.note_submit(req)
         cap = self.lm.max_len
         req.prefix = []
         if len(req.ids) + req.remaining > cap:
@@ -497,8 +713,18 @@ class KVCacheLLMEngine:
                 req.finish_reason = "length"
             req.remaining = gen
         if req.remaining <= 0 or len(req.ids) == 0:
+            self._metrics.note_retire(req, "finish")
             req.future.set_result(np.asarray(req.prefix + req.ids))
             return req.future
+        if self.admission is not None:
+            depth = self._pending.qsize()
+            ok, reason = self.admission.admit(depth)
+            if not ok:
+                self._metrics.note_shed(req, reason, depth)
+                req.future.set_exception(
+                    ShedError(reason, f"request shed ({reason}); "
+                                      f"queue_depth={depth}"))
+                return req.future
         self._pending.put(req)
         return req.future
 
@@ -549,8 +775,14 @@ class KVCacheLLMEngine:
                     break
                 self._active[slot] = req
                 self._pos[slot] = 0
+                self._metrics.note_admit(req, slot)
                 any_prefilled |= self._prefill_admit(slot, req)
         return any_prefilled
+
+    def _retire(self, req: "_Request", outcome: str) -> None:
+        self._metrics.note_retire(req, outcome)
+        if self.admission is not None:
+            self.admission.note_finish()
 
     #: admission prefill length buckets (prompt padded up to the next
     #: bucket): one compiled prefill variant per bucket actually seen,
@@ -578,6 +810,7 @@ class KVCacheLLMEngine:
         jnp = self._jnp
         toks = np.zeros((1, tp), np.int32)
         toks[0, :p] = req.ids
+        t_prefill = time.monotonic()
         try:
             row_cache, _ = self.lm.prefill(jnp.asarray(toks),
                                            jnp.asarray([p], np.int32))
@@ -604,6 +837,7 @@ class KVCacheLLMEngine:
                 self._pos[:] = 0
             return False
         self._pos[slot] = p - 1
+        self._metrics.note_prefill(req, time.monotonic() - t_prefill)
         return True
 
     #: admission-turbo dispatch length: the FIRST dispatch after an
@@ -629,10 +863,17 @@ class KVCacheLLMEngine:
                     continue
                 self._active[0] = req
                 self._pos[0] = 0
+                self._metrics.note_admit(req, 0)
                 turbo = self._prefill_admit(0, req)
             self._metrics.queue.set(self._pending.qsize())
             self._metrics.active.set(self.active_count)
-            self._metrics.tps.set(self._tokens_done / max(
+            self._metrics.occupancy.set(self.active_count / self.max_batch)
+            self._metrics.kv_tokens.set(int(sum(
+                int(self._pos[s]) for s, r in enumerate(self._active)
+                if r is not None)))
+            with self._state_lock:
+                tokens_done = self._tokens_done
+            self._metrics.tps.set(tokens_done / max(
                 time.monotonic() - self._t_start, 1e-9))
             k = self.tokens_per_dispatch
             if turbo and self.ADMIT_TURBO_K and self.ADMIT_TURBO_K < k:
@@ -648,6 +889,7 @@ class KVCacheLLMEngine:
                     continue
                 if req.cancelled.is_set():
                     req.finish_reason = "cancelled"
+                    self._retire(req, "cancel")
                     if not req.future.done():
                         req.future.set_result(
                             np.asarray(getattr(req, "prefix", []) + req.ids))
@@ -666,6 +908,7 @@ class KVCacheLLMEngine:
             flight_recorder.observe_phase(
                 "device_compute", dt_step, program="serving/decode_step")
             self._metrics.note_decode(dt_step, self.active_count)
+            produced = 0
             for slot, req in enumerate(self._active):
                 if req is None:
                     continue
@@ -677,16 +920,20 @@ class KVCacheLLMEngine:
                 self._metrics.note_token(req)
                 req.emit(nxt)
                 req.remaining -= 1
-                self._tokens_done += 1
+                produced += 1
                 if (req.remaining <= 0
                         or self._pos[slot] + 1 >= self.lm.max_len):
                     if req.remaining > 0:  # cache-capacity cut, not budget
                         req.finish_reason = "length"
+                    self._retire(req, "finish")
                     req.future.set_result(
                         np.asarray(getattr(req, "prefix", []) + req.ids))
                     self._active[slot] = None
+            with self._state_lock:
+                self._tokens_done += produced
         for req in self._active:
             if req is not None and not req.future.done():
+                self._retire(req, "cancel")
                 req.future.set_result(
                     np.asarray(getattr(req, "prefix", []) + req.ids))
         while True:
@@ -695,17 +942,28 @@ class KVCacheLLMEngine:
             except queue.Empty:
                 break
             if not req.future.done():
+                self._metrics.note_retire(req, "cancel")
                 req.future.set_exception(RuntimeError("engine stopped"))
 
     def stats(self) -> Dict[str, float]:
         """Live metrics in the shape `scheduler.autoscaler.ReplicaAutoscaler
         .observe` consumes: decode throughput since start, queue depth, and
-        active batch occupancy."""
+        active batch occupancy.  The counter snapshot happens under
+        ``_state_lock`` (the worker loop batches its updates under the
+        same lock) and the SAME values are pushed to the Prometheus
+        gauges, so the load report and /metrics can't disagree."""
+        with self._state_lock:
+            tokens_done = self._tokens_done
         dt = max(time.monotonic() - self._t_start, 1e-9)
-        return {"tokens_per_s": self._tokens_done / dt,
-                "queue_depth": self._pending.qsize(),
-                "active": self.active_count,
-                "capacity": self.max_batch}
+        tps = tokens_done / dt
+        depth = self._pending.qsize()
+        active = self.active_count
+        self._metrics.tps.set(tps)
+        self._metrics.queue.set(depth)
+        self._metrics.active.set(active)
+        self._metrics.occupancy.set(active / self.max_batch)
+        return {"tokens_per_s": tps, "queue_depth": depth,
+                "active": active, "capacity": self.max_batch}
 
     def _can_multi(self, k: int) -> bool:
         """Multi-token dispatch applies when every active row has k
@@ -766,6 +1024,7 @@ class KVCacheLLMEngine:
         flight_recorder.observe_phase(
             "device_compute", dt_dispatch, program="serving/decode_step")
         self._metrics.note_decode(dt_dispatch, self.active_count)
+        produced = 0
         for slot, req in enumerate(self._active):
             if req is None:
                 continue
@@ -776,21 +1035,28 @@ class KVCacheLLMEngine:
             r = len(req.ids) - int(self._pos[slot])
             self._pos[slot] += k
             start = r - 1 if r <= k else k
+            # one host conversion per slot — the loop below touches only
+            # Python ints, never the (already np.asarray'd) batch array
+            row = emitted[slot].tolist()
             for j in range(start, k):
                 if req.remaining <= 0:
                     break
-                req.ids.append(int(emitted[slot, j]))
+                req.ids.append(row[j])
                 self._metrics.note_token(req)
-                req.emit(int(emitted[slot, j]))
+                req.emit(row[j])
                 req.remaining -= 1
-                self._tokens_done += 1
+                produced += 1
             if req.cancelled.is_set():
                 req.finish_reason = "cancelled"
             if (req.remaining <= 0 or req.cancelled.is_set()
                     or self._pos[slot] + 1 >= self.lm.max_len):
                 if req.remaining > 0 and not req.cancelled.is_set():
                     req.finish_reason = "length"
+                self._retire(req, "cancel" if req.cancelled.is_set()
+                             else "finish")
                 if not req.future.done():
                     req.future.set_result(
                         np.asarray(getattr(req, "prefix", []) + req.ids))
                 self._active[slot] = None
+        with self._state_lock:
+            self._tokens_done += produced

@@ -1,0 +1,44 @@
+"""Serving observatory — open-loop load plane for the LLM engines.
+
+Closed-loop drivers (N workers, each waiting for its response before
+sending the next request) can never see queueing collapse: when the
+engine slows down the offered load slows down with it, so latency looks
+flat right up to the cliff.  This package drives the engines OPEN-loop —
+arrivals follow a process (Poisson / bursty Markov-modulated / trace
+replay) that does not care how the engine is doing — and makes every
+request observable end to end:
+
+* `arrivals` — arrival processes + prompt/output-length distributions
+  sampled from committed histograms (the `size_hist` wire encoding);
+* `driver` — `OpenLoopDriver`: submits the schedule into a live engine,
+  samples queue-depth/occupancy gauges, self-measures its own overhead;
+* `report` — per-request summaries (p50/p99 TTFT and TBT, tokens/s,
+  shed rate) and the offered-load sweep → degradation curve with
+  saturation-knee detection;
+* `anatomy` — the `round_anatomy()` idiom applied per request: joins
+  ledger lifecycle events + spans into a queue→prefill→decode timeline.
+
+CLI surface: ``fedml load run|report|curve`` (see `cli.cli`).
+"""
+
+from .arrivals import (LengthSampler, MarkovModulatedProcess,
+                       PoissonProcess, TraceProcess, parse_arrivals)
+from .driver import LoadResult, OpenLoopDriver
+from .report import (degradation_curve, find_knee, render_curve,
+                     render_report, summarize_requests)
+from .anatomy import (coverage, render_exemplars, render_request_timeline,
+                      request_anatomy)
+from .harness import (DEFAULT_GEOMETRY, build_engine, build_model,
+                      run_soak, summarize, warm_engine, write_artifacts)
+
+__all__ = [
+    "PoissonProcess", "MarkovModulatedProcess", "TraceProcess",
+    "parse_arrivals", "LengthSampler",
+    "OpenLoopDriver", "LoadResult",
+    "summarize_requests", "render_report", "degradation_curve",
+    "find_knee", "render_curve",
+    "request_anatomy", "render_request_timeline", "render_exemplars",
+    "coverage",
+    "DEFAULT_GEOMETRY", "build_model", "build_engine", "warm_engine",
+    "run_soak", "summarize", "write_artifacts",
+]

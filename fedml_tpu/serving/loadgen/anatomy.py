@@ -1,0 +1,166 @@
+"""Per-request anatomy — `round_anatomy()` applied to the serving plane.
+
+The training plane's correlator answers "what happened to client 3 in
+round 7?"; this one answers "what happened to request 1042?".  It joins
+the serving lifecycle events a soak leaves in the run ledger (``submit →
+admit|shed → prefill → first_token → finish|cancel``, all keyed by
+``rid``) with the tracing plane's ``serving.request`` spans into one
+timeline per request, rendered by ``fedml load report --anatomy``::
+
+    request 1042 (kv)  outcome=finish
+      +0.000s submit       prompt=32 max_new=24
+      +0.013s admit        slot=1  queue_wait 13.1 ms
+      +0.019s prefill      6.2 ms over 32 tokens
+      +0.031s first_token  ttft 31.2 ms = queue 13.1 + prefill 6.2
+                           + first_decode 11.9
+      +0.412s finish       24 tokens, service 412.0 ms
+
+`coverage` is the CI gate: the fraction of submitted requests whose
+lifecycle reached a terminal event — an instrumentation regression
+(a retire path that forgets its event) shows up as coverage < 1.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+#: terminal lifecycle events — every submit must reach exactly one
+TERMINAL_EVENTS = ("finish", "cancel", "shed")
+
+
+def request_anatomy(ledger_records: Sequence[Dict[str, Any]],
+                    span_records: Optional[Sequence[Dict[str, Any]]] = None
+                    ) -> Dict[str, Any]:
+    """Join serving ledger events (+ optional spans) per request.
+
+    Returns ``{"requests": {rid: {...}}, "submitted": N,
+    "terminal": N, "coverage": frac, "outcomes": {...}}``.
+    """
+    requests: Dict[int, Dict[str, Any]] = {}
+    for rec in ledger_records:
+        if rec.get("actor") != "serving":
+            continue
+        attrs = rec.get("attrs") or {}
+        rid = attrs.get("rid")
+        if rid is None:
+            continue        # aggregate events (decode_batch) have no rid
+        rid = int(rid)
+        r = requests.setdefault(rid, {
+            "rid": rid, "events": [], "engine": attrs.get("engine"),
+            "outcome": None, "span": None})
+        r["events"].append({
+            "event": rec.get("event"),
+            "ts_mono": float(rec.get("ts_mono", 0.0)),
+            "attrs": attrs,
+        })
+        if rec.get("event") in TERMINAL_EVENTS:
+            r["outcome"] = rec.get("event")
+    for r in requests.values():
+        r["events"].sort(key=lambda e: e["ts_mono"])
+    for span in span_records or []:
+        rid = (span.get("attrs") or {}).get("rid")
+        if rid is not None and int(rid) in requests:
+            requests[int(rid)]["span"] = {
+                "dur_s": span.get("dur_s"),
+                "status": span.get("status"),
+                "trace_id": span.get("trace_id"),
+            }
+    submitted = sum(
+        1 for r in requests.values()
+        if any(e["event"] == "submit" for e in r["events"]))
+    terminal = sum(1 for r in requests.values()
+                   if r["outcome"] is not None)
+    outcomes: Dict[str, int] = {}
+    for r in requests.values():
+        key = r["outcome"] or "open"
+        outcomes[key] = outcomes.get(key, 0) + 1
+    return {
+        "requests": requests,
+        "submitted": submitted,
+        "terminal": terminal,
+        "coverage": terminal / submitted if submitted else 0.0,
+        "outcomes": outcomes,
+    }
+
+
+def coverage(anatomy: Dict[str, Any]) -> float:
+    """Lifecycle coverage: submitted requests that reached a terminal
+    event (the smoke gate asserts >= 0.95)."""
+    return float(anatomy.get("coverage", 0.0))
+
+
+def _fmt_event(e: Dict[str, Any], t0: float) -> str:
+    a = e["attrs"]
+    name = e["event"]
+    detail = ""
+    if name == "submit":
+        detail = (f"prompt={a.get('prompt_tokens')} "
+                  f"max_new={a.get('max_new')}")
+    elif name == "admit":
+        detail = (f"slot={a.get('slot')}  queue_wait "
+                  f"{float(a.get('queue_wait_s', 0.0)) * 1e3:.1f} ms")
+    elif name == "shed":
+        detail = (f"reason={a.get('reason')}  "
+                  f"queue_depth={a.get('queue_depth')}")
+    elif name == "prefill":
+        detail = (f"{float(a.get('secs', 0.0)) * 1e3:.1f} ms over "
+                  f"{a.get('tokens')} tokens")
+    elif name == "first_token":
+        detail = (f"ttft {float(a.get('ttft_s', 0.0)) * 1e3:.1f} ms = "
+                  f"queue {float(a.get('queue_wait_s', 0.0)) * 1e3:.1f} "
+                  f"+ prefill {float(a.get('prefill_s', 0.0)) * 1e3:.1f} "
+                  f"+ first_decode "
+                  f"{float(a.get('first_decode_s', 0.0)) * 1e3:.1f}")
+    elif name in ("finish", "cancel"):
+        detail = (f"{a.get('tokens')} tokens, service "
+                  f"{float(a.get('service_s', 0.0)) * 1e3:.1f} ms "
+                  f"({a.get('finish_reason')})")
+    return f"  +{e['ts_mono'] - t0:7.3f}s {name:<12} {detail}".rstrip()
+
+
+def render_request_timeline(anatomy: Dict[str, Any], rid: int) -> str:
+    """One request's queue→prefill→decode timeline."""
+    r = (anatomy.get("requests") or {}).get(int(rid))
+    if r is None or not r["events"]:
+        return f"(no lifecycle events for request {rid})"
+    t0 = r["events"][0]["ts_mono"]
+    head = (f"request {r['rid']} ({r.get('engine')})  "
+            f"outcome={r['outcome'] or 'open'}")
+    if r.get("span") is not None and r["span"].get("dur_s") is not None:
+        head += f"  span {float(r['span']['dur_s']) * 1e3:.1f} ms"
+    return "\n".join([head] + [_fmt_event(e, t0) for e in r["events"]])
+
+
+def render_exemplars(anatomy: Dict[str, Any]) -> str:
+    """The acceptance rendering: one COMPLETED request (the slowest
+    TTFT, where the decomposition is most interesting) and one SHED
+    request, plus the outcome census and coverage line."""
+    reqs = list((anatomy.get("requests") or {}).values())
+
+    def _ttft(r: Dict[str, Any]) -> float:
+        for e in r["events"]:
+            if e["event"] == "first_token":
+                return float(e["attrs"].get("ttft_s", 0.0))
+        return -1.0
+
+    finished = [r for r in reqs if r["outcome"] == "finish"]
+    shed = [r for r in reqs if r["outcome"] == "shed"]
+    cancelled = [r for r in reqs if r["outcome"] == "cancel"]
+    parts: List[str] = [
+        f"lifecycle coverage {anatomy['coverage'] * 100:.1f}% "
+        f"({anatomy['terminal']}/{anatomy['submitted']} submitted "
+        f"reached a terminal event)",
+        "outcomes " + "  ".join(
+            f"{k}={v}" for k, v in sorted(anatomy["outcomes"].items())),
+    ]
+    if finished:
+        worst = max(finished, key=_ttft)
+        parts += ["", "slowest completed request:",
+                  render_request_timeline(anatomy, worst["rid"])]
+    if cancelled:
+        parts += ["", "a cancelled (client-disconnect) request:",
+                  render_request_timeline(anatomy, cancelled[0]["rid"])]
+    if shed:
+        parts += ["", "a shed request:",
+                  render_request_timeline(anatomy, shed[0]["rid"])]
+    return "\n".join(parts)

@@ -1,0 +1,222 @@
+"""Arrival processes and length distributions for the open-loop driver.
+
+Every process yields ABSOLUTE arrival offsets (seconds from soak start),
+precomputed before the soak begins so schedule generation never competes
+with submission for the driver thread.  Three processes cover the
+envelope the serving papers measure (the Gemma-on-TPU serving envelope,
+arxiv 2605.25645, sweeps exactly these):
+
+* `PoissonProcess`   — memoryless steady state at a target QPS;
+* `MarkovModulatedProcess` — bursty MMPP-2: a hidden 2-state chain
+  alternates a calm rate and a burst rate, exposing queue behaviour
+  that a time-averaged Poisson at the same mean QPS hides;
+* `TraceProcess`     — replay of recorded inter-arrivals from a JSONL
+  trace or a previous run's ledger (`submit` events), optionally
+  time-scaled, so production traffic shapes are reproducible offline.
+
+`LengthSampler` draws prompt/output lengths from committed histograms in
+the `size_hist` wire encoding (`data.population`), so benchmark length
+mixes are versioned artifacts, not hardcoded constants.
+
+`parse_arrivals` is the CLI-boundary parser (the `parse_wire_compression`
+idiom): ``poisson:8`` | ``mmpp:2:20:0.1`` | ``trace:path[:scale]``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class PoissonProcess:
+    """Memoryless arrivals at ``rate_qps``: exponential inter-arrivals."""
+
+    def __init__(self, rate_qps: float, seed: int = 0) -> None:
+        if rate_qps <= 0:
+            raise ValueError("rate_qps must be > 0")
+        self.rate_qps = float(rate_qps)
+        self.seed = int(seed)
+
+    def schedule(self, duration_s: float) -> np.ndarray:
+        """Arrival offsets in [0, duration_s), sorted ascending."""
+        rng = np.random.default_rng(self.seed)
+        # draw enough gaps to overshoot the horizon with margin
+        n = max(int(self.rate_qps * duration_s * 2) + 16, 16)
+        t = np.cumsum(rng.exponential(1.0 / self.rate_qps, size=n))
+        while t[-1] < duration_s:
+            t = np.concatenate(
+                [t, t[-1] + np.cumsum(
+                    rng.exponential(1.0 / self.rate_qps, size=n))])
+        return t[t < duration_s]
+
+    def describe(self) -> Dict[str, Any]:
+        return {"process": "poisson", "rate_qps": self.rate_qps}
+
+
+class MarkovModulatedProcess:
+    """MMPP-2 bursty arrivals: a hidden 2-state Markov chain switches
+    between ``calm_qps`` and ``burst_qps``; ``switch_p`` is the per-event
+    probability of flipping state.  Mean rate sits between the two, but
+    the burst state drives queue excursions a flat Poisson never shows.
+    """
+
+    def __init__(self, calm_qps: float, burst_qps: float,
+                 switch_p: float = 0.1, seed: int = 0) -> None:
+        if calm_qps <= 0 or burst_qps <= 0:
+            raise ValueError("rates must be > 0")
+        if not 0.0 < switch_p <= 1.0:
+            raise ValueError("switch_p must be in (0, 1]")
+        self.calm_qps = float(calm_qps)
+        self.burst_qps = float(burst_qps)
+        self.switch_p = float(switch_p)
+        self.seed = int(seed)
+
+    def schedule(self, duration_s: float) -> np.ndarray:
+        rng = np.random.default_rng(self.seed)
+        out: List[float] = []
+        t = 0.0
+        bursting = False
+        while t < duration_s:
+            rate = self.burst_qps if bursting else self.calm_qps
+            t += float(rng.exponential(1.0 / rate))
+            if t < duration_s:
+                out.append(t)
+            if rng.random() < self.switch_p:
+                bursting = not bursting
+        return np.asarray(out)
+
+    def describe(self) -> Dict[str, Any]:
+        return {"process": "mmpp", "calm_qps": self.calm_qps,
+                "burst_qps": self.burst_qps, "switch_p": self.switch_p}
+
+
+class TraceProcess:
+    """Replay recorded arrival offsets.  ``scale`` > 1 speeds the trace
+    up (offsets divided by scale → higher offered load), the standard
+    trace-acceleration knob."""
+
+    def __init__(self, offsets_s: Sequence[float],
+                 scale: float = 1.0) -> None:
+        if scale <= 0:
+            raise ValueError("scale must be > 0")
+        arr = np.sort(np.asarray(list(offsets_s), dtype=np.float64))
+        if arr.size == 0:
+            raise ValueError("trace has no arrivals")
+        self._offsets = (arr - arr[0]) / float(scale)
+        self.scale = float(scale)
+
+    @classmethod
+    def from_jsonl(cls, path: str, scale: float = 1.0,
+                   key: str = "ts") -> "TraceProcess":
+        """Trace file: one JSON object per line carrying an absolute or
+        relative timestamp under ``key`` (bare numbers also accepted)."""
+        offsets: List[float] = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, (int, float)):
+                    offsets.append(float(rec))
+                elif isinstance(rec, dict) and key in rec:
+                    offsets.append(float(rec[key]))
+        return cls(offsets, scale=scale)
+
+    @classmethod
+    def from_ledger(cls, path: str, scale: float = 1.0) -> "TraceProcess":
+        """Replay the ``submit`` events of a previous run's ledger — the
+        observatory can re-drive yesterday's traffic shape."""
+        from ...core.mlops.ledger import load_ledger
+
+        offsets = [float(r.get("ts_mono", 0.0)) for r in load_ledger(path)
+                   if r.get("actor") == "serving"
+                   and r.get("event") == "submit"]
+        return cls(offsets, scale=scale)
+
+    def schedule(self, duration_s: float) -> np.ndarray:
+        return self._offsets[self._offsets < duration_s]
+
+    def describe(self) -> Dict[str, Any]:
+        return {"process": "trace", "arrivals": int(self._offsets.size),
+                "scale": self.scale}
+
+
+def parse_arrivals(spec: str, seed: int = 0):
+    """CLI-boundary parser: ``poisson:QPS`` | ``mmpp:CALM:BURST[:P]`` |
+    ``trace:PATH[:SCALE]`` → a process.  Raises ValueError on a
+    malformed spec so bad flags die at startup, not mid-soak."""
+    parts = [p for p in str(spec).strip().split(":") if p != ""]
+    if not parts:
+        raise ValueError("empty arrivals spec")
+    kind = parts[0].lower()
+    try:
+        if kind == "poisson" and len(parts) == 2:
+            return PoissonProcess(float(parts[1]), seed=seed)
+        if kind == "mmpp" and len(parts) in (3, 4):
+            p = float(parts[3]) if len(parts) == 4 else 0.1
+            return MarkovModulatedProcess(float(parts[1]), float(parts[2]),
+                                          switch_p=p, seed=seed)
+        if kind == "trace" and len(parts) in (2, 3):
+            scale = float(parts[2]) if len(parts) == 3 else 1.0
+            path = parts[1]
+            if os.path.isdir(path) or path.endswith("ledger.jsonl"):
+                return TraceProcess.from_ledger(path, scale=scale)
+            return TraceProcess.from_jsonl(path, scale=scale)
+    except ValueError as e:
+        if "arrivals spec" in str(e):
+            raise
+        raise ValueError(f"bad arrivals spec {spec!r}: {e}") from None
+    raise ValueError(
+        f"bad arrivals spec {spec!r} (want 'poisson:QPS', "
+        f"'mmpp:CALM:BURST[:SWITCH_P]' or 'trace:PATH[:SCALE]')")
+
+
+class LengthSampler:
+    """Prompt/output lengths drawn from committed histograms.
+
+    The histogram file carries the `size_hist` wire encoding from
+    `data.population` (``[[value, count], ...]``) under ``prompt`` and
+    ``output`` keys — a versioned artifact, so a benchmark's length mix
+    is reviewable in the diff that changes it."""
+
+    def __init__(self, prompt_hist: Any, output_hist: Any,
+                 seed: int = 0) -> None:
+        from ...data.population import expand_size_hist
+
+        self._prompts = expand_size_hist(prompt_hist)
+        self._outputs = expand_size_hist(output_hist)
+        if self._prompts.size == 0 or self._outputs.size == 0:
+            raise ValueError("length histogram is empty")
+        self._rng = np.random.default_rng(seed)
+
+    @classmethod
+    def from_file(cls, path: str, seed: int = 0) -> "LengthSampler":
+        with open(path) as f:
+            payload = json.load(f)
+        return cls(payload["prompt"], payload["output"], seed=seed)
+
+    @classmethod
+    def fixed(cls, prompt: int, output: int,
+              seed: int = 0) -> "LengthSampler":
+        return cls([[int(prompt), 1]], [[int(output), 1]], seed=seed)
+
+    def sample(self) -> Dict[str, int]:
+        return {
+            "prompt_tokens": int(self._rng.choice(self._prompts)),
+            "output_tokens": int(self._rng.choice(self._outputs)),
+        }
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "prompt_mean": float(self._prompts.mean()),
+            "output_mean": float(self._outputs.mean()),
+            "prompt_max": int(self._prompts.max()),
+            "output_max": int(self._outputs.max()),
+        }

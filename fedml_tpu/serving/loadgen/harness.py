@@ -1,0 +1,152 @@
+"""Soak harness behind ``fedml load run|curve`` (and the loadgen tests).
+
+The CLI-facing glue: build a CPU-proxy engine from geometry flags, warm
+the jit caches OUTSIDE the measured window (the first prefill of each
+bucket and the first decode dispatch cost seconds of XLA compile — left
+inside the soak they would dominate every latency percentile), run one
+`OpenLoopDriver` soak, and write the artifact set that ``fedml load
+report`` and ``fedml slo check --metrics`` consume offline::
+
+    out/
+      requests.jsonl   per-request lifecycle rows
+      gauges.jsonl     queue-depth / occupancy / tok/s time series
+      summary.json     the headline summary + run metadata
+      metrics.prom     Prometheus scrape at soak end (offline SLO input)
+      ledger.jsonl     serving lifecycle events   (when mlops is armed)
+      spans.jsonl      serving.request spans      (when mlops is armed)
+
+Warm-up uses a THROWAWAY engine over the same model object, then the
+metrics registry is reset and the measured engine built fresh — the
+model-level jits (prefill buckets, decode dispatch) are module-scoped in
+`kv_cache_lm`, so the compile cache survives while the warm-up's
+multi-second TTFTs never reach the measured histograms.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from ...core.mlops import metrics as _metrics
+from .driver import LoadResult, OpenLoopDriver
+from .report import summarize_requests
+
+#: tiny CPU-proxy geometry — same scale the serving tier-1 tests use, so
+#: a quick soak compiles in seconds and queues under tens of offered QPS
+DEFAULT_GEOMETRY: Dict[str, int] = {
+    "vocab": 90, "dim": 32, "layers": 2, "heads": 4, "max_len": 96,
+    "max_batch": 4, "tokens_per_dispatch": 4, "window": 24,
+}
+
+
+def build_model(kind: str = "kv", seed: int = 0,
+                **geometry: int) -> Any:
+    """The (engine-independent) model object: a `KVCacheLM` for the kv
+    engine, a ``(bundle, variables)`` pair for the batched engine."""
+    g = dict(DEFAULT_GEOMETRY, **geometry)
+    import jax
+    if kind == "kv":
+        from ..kv_cache_lm import KVCacheLM
+
+        return KVCacheLM.create(
+            jax.random.PRNGKey(seed), vocab=g["vocab"], dim=g["dim"],
+            layers=g["layers"], heads=g["heads"], max_len=g["max_len"])
+    if kind == "batched":
+        # the stock tiny transformer bundle (geometry dims fixed by the
+        # model hub config; vocab still honoured)
+        import fedml_tpu
+
+        args = fedml_tpu.Config(model="transformer", dataset="shakespeare",
+                                compute_dtype="float32")
+        bundle = fedml_tpu.model.create(args, g["vocab"])
+        variables = bundle.init_variables(jax.random.PRNGKey(seed),
+                                          batch_size=2)
+        return (bundle, variables)
+    raise ValueError(f"unknown engine kind {kind!r} (want 'kv'|'batched')")
+
+
+def build_engine(model: Any, kind: str = "kv", admission: Any = None,
+                 **geometry: int) -> Any:
+    g = dict(DEFAULT_GEOMETRY, **geometry)
+    from ..llm_engine import BatchedLLMEngine, KVCacheLLMEngine
+
+    if kind == "kv":
+        return KVCacheLLMEngine(
+            model, max_batch=g["max_batch"],
+            tokens_per_dispatch=g["tokens_per_dispatch"],
+            admission=admission)
+    if kind == "batched":
+        bundle, variables = model
+        return BatchedLLMEngine(bundle, variables,
+                                max_batch=g["max_batch"],
+                                window=g["window"], admission=admission)
+    raise ValueError(f"unknown engine kind {kind!r} (want 'kv'|'batched')")
+
+
+def warm_engine(engine: Any, max_prompt: int,
+                tokens_per_dispatch: int = 4) -> int:
+    """Touch every jit the soak will hit: one prompt per prefill bucket
+    up to ``max_prompt`` plus a decode long enough to cover the
+    multi-token dispatch.  Returns the number of warm-up requests."""
+    buckets = getattr(type(engine), "_PREFILL_BUCKETS", None) or (max_prompt,)
+    lengths = [b for b in buckets if b <= max_prompt] or [buckets[0]]
+    if lengths[-1] < max_prompt:
+        lengths.append(lengths[-1])          # max_prompt rides that bucket
+    futs = [engine.submit(list(range(1, n + 1)),
+                          max_new=max(2 * tokens_per_dispatch, 4),
+                          temperature=0.0)
+            for n in lengths]
+    for fut in futs:
+        fut.result(300.0)
+    return len(futs)
+
+
+def run_soak(engine: Any, arrivals: Any, lengths: Any, duration_s: float,
+             vocab: int = 90, cancel_fraction: float = 0.0,
+             seed: int = 0, gauge_period_s: float = 0.25,
+             drain_timeout_s: float = 300.0) -> LoadResult:
+    """One measured soak (the engine should already be warm)."""
+    driver = OpenLoopDriver(
+        engine, arrivals, lengths, duration_s=duration_s, vocab=vocab,
+        cancel_fraction=cancel_fraction, gauge_period_s=gauge_period_s,
+        seed=seed)
+    return driver.run(drain_timeout_s=drain_timeout_s)
+
+
+def summarize(result: LoadResult) -> Dict[str, Any]:
+    s = summarize_requests(result.rows, result.duration_s,
+                           wall_s=result.wall_s,
+                           overhead_s=result.overhead_s)
+    s["meta"] = dict(result.meta)
+    return s
+
+
+def write_artifacts(out_dir: str, result: LoadResult,
+                    summary: Optional[Dict[str, Any]] = None) -> List[str]:
+    """requests.jsonl + gauges.jsonl + summary.json + metrics.prom; the
+    mlops-side ledger.jsonl/spans.jsonl land in the same dir when the
+    run was armed with ``log_file_dir=out_dir``."""
+    os.makedirs(out_dir, exist_ok=True)
+    written = []
+
+    def _jsonl(name: str, rows: List[Dict[str, Any]]) -> None:
+        path = os.path.join(out_dir, name)
+        with open(path, "w") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+        written.append(path)
+
+    _jsonl("requests.jsonl", result.rows)
+    _jsonl("gauges.jsonl", result.gauges)
+    path = os.path.join(out_dir, "summary.json")
+    with open(path, "w") as f:
+        json.dump(summary if summary is not None else summarize(result),
+                  f, indent=2, sort_keys=True)
+        f.write("\n")
+    written.append(path)
+    path = os.path.join(out_dir, "metrics.prom")
+    with open(path, "w") as f:
+        f.write(_metrics.render_prometheus())
+    written.append(path)
+    return written

@@ -1,0 +1,181 @@
+"""Load-soak summaries and the offered-load degradation curve.
+
+`summarize_requests` turns per-request rows (the driver's output, or a
+reloaded ``requests.jsonl``) into the serving headline numbers: p50/p99
+TTFT with its queue/prefill decomposition, p50/p99 TBT (finished
+requests only), goodput, tokens/s and shed rate.
+
+`degradation_curve` sweeps offered load and `find_knee` locates the
+saturation point: the highest offered QPS the engine still serves at
+goodput (≥ ``goodput_floor`` of offered) within the TTFT SLO.  Past the
+knee a healthy engine DEGRADES GRACEFULLY — shed rate rises while
+admitted-request p99 stays bounded; a collapsing one shows p99 growing
+without bound.  `render_curve` prints exactly that contrast.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def _pct(values: List[float], q: float) -> Optional[float]:
+    if not values:
+        return None
+    return float(np.percentile(np.asarray(values, dtype=np.float64), q))
+
+
+def summarize_requests(rows: Sequence[Dict[str, Any]],
+                       duration_s: float,
+                       wall_s: Optional[float] = None,
+                       overhead_s: Optional[float] = None
+                       ) -> Dict[str, Any]:
+    """Headline numbers for one soak at a fixed offered load."""
+    rows = list(rows)
+    finished = [r for r in rows if r.get("outcome") == "finish"]
+    cancelled = [r for r in rows if r.get("outcome") == "cancel"]
+    shed = [r for r in rows if r.get("outcome") == "shed"]
+    admitted = [r for r in rows if r.get("outcome") != "shed"]
+    ttfts = [r["ttft_s"] for r in admitted if r.get("ttft_s") is not None]
+    qwaits = [r["queue_wait_s"] for r in admitted
+              if r.get("queue_wait_s") is not None]
+    prefills = [r["prefill_s"] for r in admitted
+                if r.get("prefill_s") is not None]
+    # TBT comes only from FINISHED requests — a cancelled stream's gaps
+    # must not skew the percentiles (mirrors fedml_llm_tbt_seconds)
+    tbts = [r["tbt_s"] for r in finished if r.get("tbt_s") is not None]
+    tokens = int(sum(int(r.get("tokens") or 0) for r in rows))
+    dur = max(float(duration_s), 1e-9)
+    span = max(float(wall_s if wall_s is not None else duration_s), 1e-9)
+    out: Dict[str, Any] = {
+        "offered": len(rows),
+        "offered_qps": len(rows) / dur,
+        "finished": len(finished),
+        "cancelled": len(cancelled),
+        "shed": len(shed),
+        "shed_rate": len(shed) / max(len(rows), 1),
+        "goodput_qps": len(finished) / dur,
+        "tokens": tokens,
+        "tokens_per_s": tokens / span,
+        "ttft_p50": _pct(ttfts, 50), "ttft_p99": _pct(ttfts, 99),
+        "queue_wait_p50": _pct(qwaits, 50),
+        "queue_wait_p99": _pct(qwaits, 99),
+        "prefill_p50": _pct(prefills, 50),
+        "prefill_p99": _pct(prefills, 99),
+        "tbt_p50": _pct(tbts, 50), "tbt_p99": _pct(tbts, 99),
+        "duration_s": float(duration_s),
+        "wall_s": float(wall_s) if wall_s is not None else None,
+    }
+    if overhead_s is not None and wall_s is not None:
+        out["overhead_s"] = float(overhead_s)
+        out["overhead_frac"] = float(overhead_s) / span
+    return out
+
+
+def _fmt_ms(v: Optional[float]) -> str:
+    return "    --" if v is None else f"{v * 1e3:6.1f}"
+
+
+def render_report(summary: Dict[str, Any]) -> str:
+    """Human rendering of one soak summary (`fedml load report`)."""
+    s = summary
+    lines = [
+        f"offered  {s['offered']} requests @ {s['offered_qps']:.2f} qps "
+        f"over {s['duration_s']:.1f}s",
+        f"outcome  finished {s['finished']}  cancelled {s['cancelled']}  "
+        f"shed {s['shed']}  (shed rate {s['shed_rate'] * 100:.1f}%)",
+        f"goodput  {s['goodput_qps']:.2f} qps   "
+        f"tokens {s['tokens']} ({s['tokens_per_s']:.1f} tok/s)",
+        "latency (ms)        p50     p99",
+        f"  ttft           {_fmt_ms(s['ttft_p50'])}  "
+        f"{_fmt_ms(s['ttft_p99'])}",
+        f"    queue wait   {_fmt_ms(s['queue_wait_p50'])}  "
+        f"{_fmt_ms(s['queue_wait_p99'])}",
+        f"    prefill      {_fmt_ms(s['prefill_p50'])}  "
+        f"{_fmt_ms(s['prefill_p99'])}",
+        f"  tbt            {_fmt_ms(s['tbt_p50'])}  "
+        f"{_fmt_ms(s['tbt_p99'])}",
+    ]
+    if s.get("overhead_frac") is not None:
+        lines.append(
+            f"observability overhead {s['overhead_s']:.3f}s "
+            f"({s['overhead_frac'] * 100:.2f}% of wall)")
+    return "\n".join(lines)
+
+
+def degradation_curve(run_at: Callable[[float], Dict[str, Any]],
+                      qps_points: Sequence[float]) -> List[Dict[str, Any]]:
+    """Sweep offered load: ``run_at(qps)`` → one soak summary per point
+    (ascending offered QPS so warm-compile cost lands on the first)."""
+    return [dict(run_at(float(q)), sweep_qps=float(q))
+            for q in sorted(qps_points)]
+
+
+def find_knee(points: Sequence[Dict[str, Any]],
+              slo_ttft_p99_s: float,
+              goodput_floor: float = 0.9) -> Optional[Dict[str, Any]]:
+    """The saturation knee: the HIGHEST offered point still serving at
+    goodput ≥ floor×offered with admitted p99 TTFT inside the SLO.
+    None when even the lowest point breaches (engine undersized)."""
+    knee = None
+    for p in sorted(points, key=lambda p: p["offered_qps"]):
+        ttft = p.get("ttft_p99")
+        good = p["goodput_qps"] >= goodput_floor * p["offered_qps"]
+        in_slo = ttft is not None and ttft <= slo_ttft_p99_s
+        if good and in_slo:
+            knee = p
+    return knee
+
+
+def render_curve(points: Sequence[Dict[str, Any]],
+                 slo_ttft_p99_s: float,
+                 goodput_floor: float = 0.9) -> str:
+    """The degradation table (`fedml load curve`): one row per offered
+    point, the knee marked, and a verdict on post-knee behaviour —
+    graceful (bounded admitted p99, shed rate absorbing the excess) or
+    collapsing (p99 past SLO with nothing shed)."""
+    knee = find_knee(points, slo_ttft_p99_s, goodput_floor)
+    lines = [
+        "offered_qps  goodput_qps  ttft_p50(ms)  ttft_p99(ms)  "
+        "tbt_p99(ms)  shed%    tok/s",
+    ]
+    for p in sorted(points, key=lambda p: p["offered_qps"]):
+        mark = "  <- knee" if knee is not None and p is knee else ""
+        lines.append(
+            f"{p['offered_qps']:11.2f}  {p['goodput_qps']:11.2f}  "
+            f"{_fmt_ms(p['ttft_p50']):>12}  {_fmt_ms(p['ttft_p99']):>12}  "
+            f"{_fmt_ms(p['tbt_p99']):>11}  {p['shed_rate'] * 100:5.1f}  "
+            f"{p['tokens_per_s']:7.1f}{mark}")
+    if knee is None:
+        lines.append(
+            f"no knee: every point breaches the SLO "
+            f"(ttft p99 <= {slo_ttft_p99_s * 1e3:.0f} ms, "
+            f"goodput >= {goodput_floor * 100:.0f}% of offered)")
+        return "\n".join(lines)
+    lines.append(
+        f"saturation knee: {knee['offered_qps']:.2f} qps offered "
+        f"({knee['goodput_qps']:.2f} qps goodput, ttft p99 "
+        f"{knee['ttft_p99'] * 1e3:.1f} ms)")
+    past = [p for p in points
+            if p["offered_qps"] > knee["offered_qps"]]
+    if past:
+        bounded = [p for p in past
+                   if p.get("ttft_p99") is not None
+                   and p["ttft_p99"] <= slo_ttft_p99_s]
+        shedding = [p for p in past if p["shed_rate"] > 0.0]
+        if len(bounded) == len(past) and shedding:
+            lines.append(
+                "past the knee: GRACEFUL — admitted p99 stays inside "
+                "the SLO while shed rate absorbs the excess "
+                f"(max shed {max(p['shed_rate'] for p in past) * 100:.1f}%)")
+        elif shedding:
+            lines.append(
+                "past the knee: shedding engaged but admitted p99 "
+                "breaches the SLO — shed earlier (tighten the "
+                "admission queue/ttft budget)")
+        else:
+            lines.append(
+                "past the knee: COLLAPSING — no shedding, p99 unbounded "
+                "(run with --admission to bound it)")
+    return "\n".join(lines)

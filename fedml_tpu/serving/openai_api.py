@@ -18,6 +18,7 @@ import time
 import uuid
 from typing import Any, Dict, Iterable, List, Optional
 
+from .admission import ShedError
 from .fedml_predictor import FedMLPredictor
 
 
@@ -114,6 +115,13 @@ class OpenAIServer:
                     meta = full(req) if callable(full) else None
                     result = (meta.get("stream", meta.get("text"))
                               if meta is not None else predictor.predict(req))
+                except ShedError as e:
+                    # admission shed → 429 in the OpenAI error shape, so
+                    # SDK clients back off instead of retrying hot
+                    self._json(429, {"error": {
+                        "message": str(e), "type": "overloaded",
+                        "code": e.reason}})
+                    return
                 except Exception as e:  # noqa: BLE001
                     self._json(500, {"error": {"message": str(e)}})
                     return
@@ -125,6 +133,11 @@ class OpenAIServer:
                         if not isinstance(result, str):
                             # lazy generators raise here, not in predict()
                             result = "".join(str(c) for c in result)
+                    except ShedError as e:
+                        self._json(429, {"error": {
+                            "message": str(e), "type": "overloaded",
+                            "code": e.reason}})
+                        return
                     except Exception as e:  # noqa: BLE001
                         self._json(500, {"error": {"message": str(e)}})
                         return
@@ -147,6 +160,15 @@ class OpenAIServer:
                                                       cid))
                         self.wfile.write(f"data: {data}\n\n".encode())
                         self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    # client disconnected mid-decode: closing the token
+                    # generator cancels the engine request (slot frees,
+                    # lifecycle retires as `cancel`); nothing more can be
+                    # written to this socket
+                    close = getattr(chunks, "close", None)
+                    if callable(close):
+                        close()
+                    return
                 except Exception as e:  # noqa: BLE001
                     # headers are already out: surface the error as a final
                     # chunk so SDK clients still see a terminated stream
