@@ -22,6 +22,13 @@ Rule families (docs/STATIC_ANALYSIS.md has the full catalog):
   boundary resharding, SHARD003 idle-axis replication, SHARD004
   collective budget ratchet, SHARD005 cross-host loop all-gathers,
   SHARD006 donation lost to sharding mismatch
+* conc tier (``--conc``, ``analysis.conc``): whole-program concurrency
+  analysis of the threaded control plane — CONC002 guarded-field
+  lockset inference, CONC003 lock-order DAG ratchet
+  (``benchmarks/lock_order.json``), CONC004 blocking-call-under-lock,
+  CONC005 condition-variable misuse, CONC006 timeout-less shutdown
+  waits; the runtime counterpart (``core.mlops.lock_profiler``) checks
+  observed acquisition order against the same committed DAG
 
 Entry points: ``run_lint`` (library), ``run_cli`` (the `fedml lint`
 command body; exit codes 0 = clean, 1 = new findings, 2 = internal error).
@@ -46,11 +53,53 @@ from .findings import Finding, fingerprints
 from .rules import rule_catalog
 
 __all__ = ["run_lint", "run_cli", "Finding", "LintResult", "rule_catalog",
-           "DEFAULT_BASELINE_NAME"]
+           "render_rule_list", "DEFAULT_BASELINE_NAME"]
 
 EXIT_CLEAN = 0
 EXIT_NEW_FINDINGS = 1
 EXIT_INTERNAL_ERROR = 2
+
+#: tier → (human label, enabling flag, doc anchor) for --list-rules
+TIER_DOCS = {
+    "file": ("per-file AST", "(default)",
+             "docs/STATIC_ANALYSIS.md#rule-catalog"),
+    "program": ("whole-program", "--whole-program",
+                "docs/STATIC_ANALYSIS.md#whole-program-pass"),
+    "perf": ("perf-IR", "--perf",
+             "docs/STATIC_ANALYSIS.md#perf-tier"),
+    "mesh": ("mesh-HLO", "--mesh",
+             "docs/STATIC_ANALYSIS.md#mesh-tier"),
+    "conc": ("concurrency", "--conc",
+             "docs/STATIC_ANALYSIS.md#concurrency-tier"),
+}
+
+
+def render_rule_list(fmt: str = "text") -> str:
+    """The five-tier rule catalog behind ``fedml lint --list-rules``."""
+    cat = rule_catalog()
+    if fmt == "json":
+        by_tier: dict = {}
+        for entry in cat:
+            tier = entry.get("tier", "file")
+            label, flag, doc = TIER_DOCS[tier]
+            by_tier.setdefault(tier, {
+                "tier": tier, "label": label, "flag": flag, "doc": doc,
+                "rules": []})["rules"].append(
+                {k: v for k, v in entry.items() if k != "tier"})
+        return json.dumps(
+            {"version": 1, "tool": "fedml-lint",
+             "tiers": [by_tier[t] for t in TIER_DOCS if t in by_tier]},
+            indent=2)
+    lines = []
+    for tier, (label, flag, doc) in TIER_DOCS.items():
+        rules = [e for e in cat if e.get("tier", "file") == tier]
+        if not rules:
+            continue
+        lines.append(f"{label} tier  [{flag}]  — {doc}")
+        for e in rules:
+            lines.append(f"  {e['id']:<10}{e['severity']:<9}{e['title']}")
+        lines.append("")
+    return "\n".join(lines).rstrip()
 
 
 def run_cli(root: Optional[str] = None,
@@ -62,11 +111,16 @@ def run_cli(root: Optional[str] = None,
             whole_program: bool = False,
             perf: bool = False,
             mesh: bool = False,
+            conc: bool = False,
             perf_registry=None,
             graph: Optional[str] = None,
+            list_rules: bool = False,
             echo=print) -> int:
     """Body of ``fedml lint``; returns the process exit code."""
     try:
+        if list_rules:
+            echo(render_rule_list("json" if fmt == "json" else "text"))
+            return EXIT_CLEAN
         if graph:
             if graph not in ("dot", "json"):
                 echo(f"fedml lint: unknown --graph format {graph!r} "
@@ -102,20 +156,27 @@ def run_cli(root: Optional[str] = None,
             return EXIT_INTERNAL_ERROR
         if update_baseline:
             # the baseline file is SHARED by the per-file, whole-program,
-            # perf and mesh CI gates; rewriting it from a partial scan
-            # would drop every baselined entry of the skipped tiers, so
-            # always take the fullest scan when rewriting
+            # perf, mesh and conc CI gates; rewriting it from a partial
+            # scan would drop every baselined entry of the skipped tiers,
+            # so always take the fullest scan when rewriting
             whole_program = True
             perf = True
             mesh = True
+            conc = True
         root_p = Path(root) if root else default_root()
         result = run_lint(root_p, paths=paths or None, rule_ids=rule_ids,
                           whole_program=whole_program, perf=perf,
-                          mesh=mesh, perf_registry=perf_registry)
+                          mesh=mesh, conc=conc,
+                          perf_registry=perf_registry)
         baseline_p = (Path(baseline) if baseline
                       else root_p / DEFAULT_BASELINE_NAME)
         if update_baseline:
-            if result.notes:
+            # "hint:" notes are advisory (e.g. the conc tier's missing/
+            # stale lock-order DAG — its findings are still complete);
+            # every other note means a pass was skipped or truncated
+            blocking = [n for n in result.notes
+                        if not n.startswith("hint:")]
+            if blocking:
                 # a skipped cross-file pass would rewrite the SHARED
                 # baseline without its cross-file entries — refuse rather
                 # than silently weaken it
@@ -124,6 +185,8 @@ def run_cli(root: Optional[str] = None,
                 echo("fedml lint: refusing --update-baseline — the scan "
                      "was incomplete; fix the parse errors first")
                 return EXIT_INTERNAL_ERROR
+            for note in result.notes:
+                echo(f"fedml lint: note: {note}")
             n = write_baseline(baseline_p, result.findings)
             echo(f"fedml lint: baseline written to {baseline_p} "
                  f"({n} findings)")

@@ -1,0 +1,92 @@
+"""Concurrency lint tier (``fedml lint --conc``) — the fifth pass.
+
+Whole-program concurrency analysis over the PR-3 package index: thread-
+root discovery, per-class lockset inference (CONC002), lock-order graph
+extraction with a committed-DAG ratchet (CONC003 /
+``benchmarks/lock_order.json``), blocking-call-under-lock (CONC004),
+condition-variable misuse (CONC005) and timeout-less shutdown-path
+waits (CONC006).  CONC000 is the pass's own failure finding, so conc
+coverage can never shrink silently — the same contract as
+PERF000/SHARD000.
+
+The pass shares the per-file engine's noqa / fingerprint / baseline /
+exit-code machinery: ``run_conc_pass`` only produces findings; the
+engine suppresses, partitions and reports them like any other tier.
+The runtime counterpart — the opt-in lock profiler whose observed
+acquisition edges the chaos soak checks against the SAME committed DAG
+— lives in ``core/mlops/lock_profiler.py``.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import List, Optional, Sequence, Tuple
+
+from ..findings import SEV_ERROR, Finding
+
+#: rule ids this pass can emit (CONC000 is the failure channel)
+CONC_RULE_IDS = ("CONC002", "CONC003", "CONC004", "CONC005", "CONC006")
+
+
+def conc_rule_ids() -> List[str]:
+    return list(CONC_RULE_IDS)
+
+
+def conc_catalog() -> List[dict]:
+    from .rules import CATALOG
+
+    return [{"id": rid, "severity": sev, "title": title, "reads": reads}
+            for rid, sev, title, reads in CATALOG]
+
+
+def run_conc_pass(root, rule_ids: Optional[Sequence[str]] = None
+                  ) -> Tuple[List[Finding], List[str]]:
+    """Run the conc tier over the WHOLE package rooted at ``root``.
+    Returns (findings, notes); the engine handles noqa/subset/baseline.
+    Never raises — a pass-level failure becomes a CONC000 finding."""
+    notes: List[str] = []
+    try:
+        from ..engine import parse_contexts
+        from ..wholeprogram import build_index
+        from . import rules as _rules
+        from .lockorder import committed_pairs
+        from .threadmodel import build_model
+
+        contexts, parse_errors = parse_contexts(Path(root), None)
+        if parse_errors:
+            # shared-state verdicts over a partial index would be
+            # guesses — skip loudly, same policy as the whole-program
+            # tier (the full scan's LINT001 fails the run anyway)
+            notes.append(
+                f"conc pass skipped: {len(parse_errors)} file(s) cannot "
+                f"be parsed (see LINT001) — concurrency verdicts would "
+                f"be guesses")
+            return ([Finding(
+                "CONC000", SEV_ERROR, rel,
+                getattr(exc, "lineno", 1) or 1, 0,
+                "conc pass skipped: file cannot be parsed")
+                for rel, exc in parse_errors], notes)
+        wanted = ({r.strip().upper() for r in rule_ids}
+                  if rule_ids else None)
+        model = build_model(build_index(contexts), contexts)
+        findings: List[Finding] = []
+        if wanted is None or "CONC002" in wanted:
+            findings.extend(_rules.conc002(model))
+        if wanted is None or "CONC003" in wanted:
+            f3, n3 = _rules.conc003(model, committed_pairs(root))
+            findings.extend(f3)
+            notes.extend(n3)
+        if wanted is None or "CONC004" in wanted:
+            findings.extend(_rules.conc004(model))
+        if wanted is None or "CONC005" in wanted:
+            findings.extend(_rules.conc005(model))
+        if wanted is None or "CONC006" in wanted:
+            findings.extend(_rules.conc006(model))
+        return findings, notes
+    except Exception as exc:  # noqa: BLE001 — the pass must never take
+        # down the whole lint run; CONC000 carries the failure instead
+        notes.append(f"conc pass failed: {exc.__class__.__name__}: {exc}")
+        return ([Finding(
+            "CONC000", SEV_ERROR, "fedml_tpu", 1, 0,
+            f"conc pass failed: {exc.__class__.__name__} — concurrency "
+            f"coverage is OFF until this is fixed")], notes)

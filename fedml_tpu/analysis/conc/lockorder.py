@@ -1,0 +1,108 @@
+"""The committed lock-order DAG (CONC003's ratchet — the SHARD004 idiom).
+
+``benchmarks/lock_order.json`` commits every statically-extracted
+acquisition-order edge (lock B acquired while A is held).  The conc
+pass compares what it just extracted against the file: a NEW edge is a
+finding until a human reviews it for deadlock safety and commits it; a
+cycle is always an error regardless of the file.  Regenerate after a
+DELIBERATE locking change with::
+
+    python -m fedml_tpu.analysis.conc.lockorder
+
+which rewrites the file from the current source (the diff is the review
+artifact — a lock-nesting change can never land silently).  The SAME
+edge set is the runtime gate: the chaos soak asserts the edges the lock
+profiler OBSERVED (``fedml conc report --check-dag``) are a subset of
+this file, so a dynamic path that nests locks in an order the static
+pass never saw fails CI instead of deadlocking in production.
+
+Entries are keyed ``"A -> B"`` with a representative site (path only —
+line numbers would churn the ratchet on every unrelated edit).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+ORDER_FILE = "benchmarks/lock_order.json"
+
+_DOC = ("committed lock acquisition-order DAG: every 'held -> acquired' "
+        "edge the conc pass extracts from nested 'with <lock>:' blocks "
+        "(lexical + call-mediated).  CONC003 ratchets against this file "
+        "and flags cycles as potential deadlocks; the runtime lock "
+        "profiler's chaos soak asserts observed edges are a subset.  "
+        "Regenerate deliberately with "
+        "`python -m fedml_tpu.analysis.conc.lockorder`.")
+
+
+def order_path(root) -> Path:
+    return Path(root) / ORDER_FILE
+
+
+def load_order(root) -> Optional[Dict[str, Any]]:
+    """The committed entries, or None when the file is missing."""
+    p = order_path(root)
+    if not p.is_file():
+        return None
+    data = json.loads(p.read_text(encoding="utf-8"))
+    return data.get("edges", {})
+
+
+def committed_pairs(root) -> Optional[Set[Tuple[str, str]]]:
+    entries = load_order(root)
+    if entries is None:
+        return None
+    out: Set[Tuple[str, str]] = set()
+    for key in entries:
+        a, sep, b = key.partition(" -> ")
+        if sep:
+            out.add((a, b))
+    return out
+
+
+def write_order(root, edges: Dict[Tuple[str, str], List[Any]]) -> Path:
+    """``edges`` — the conc model's deduped edge map
+    ((src, dst) → [Edge, …])."""
+    p = order_path(root)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    entries = {
+        f"{src} -> {dst}": {"site": sorted({e.path for e in sites})[0],
+                            "via": sorted({e.via for e in sites})}
+        for (src, dst), sites in edges.items()}
+    payload = {"_doc": _DOC,
+               "edges": {k: entries[k] for k in sorted(entries)}}
+    p.write_text(json.dumps(payload, indent=2, sort_keys=False) + "\n",
+                 encoding="utf-8")
+    return p
+
+
+def collect_edges(root) -> Dict[Tuple[str, str], List[Any]]:
+    """Build the model over the whole package and return its deduped
+    edge map — the generator behind the committed file."""
+    from ..engine import parse_contexts
+    from ..wholeprogram import build_index
+    from .threadmodel import build_model, dedup_edges
+
+    contexts, errors = parse_contexts(Path(root), None)
+    if errors:
+        raise RuntimeError(
+            f"{len(errors)} file(s) cannot be parsed; fix them first "
+            f"(the committed order must come from a full scan)")
+    model = build_model(build_index(contexts), contexts)
+    return dedup_edges(model.edges)
+
+
+def main() -> int:
+    from ..engine import default_root
+
+    root = default_root()
+    edges = collect_edges(root)
+    p = write_order(root, edges)
+    print(f"wrote {p} ({len(edges)} lock-order edges)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

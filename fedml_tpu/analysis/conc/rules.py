@@ -1,0 +1,483 @@
+"""CONC002-CONC006 — whole-program concurrency rules over the thread/lock
+model (``threadmodel.build_model``).  Each rule is a pure function
+``(model, …) -> findings``; the pass driver in ``conc/__init__`` applies
+the rule-id filter and the engine applies noqa/baseline on top.
+
+Messages are line-free (the fingerprint contract: a finding must survive
+unrelated-line churn) and name the fix, not just the smell.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import astutil
+from ..findings import SEV_ERROR, SEV_WARNING, Finding
+from .threadmodel import ClassConc, ConcModel, dedup_edges
+
+#: id, severity, title, one-line "what it reads" for --list-rules
+CATALOG = [
+    ("CONC000", SEV_ERROR, "concurrency pass could not run",
+     "pass-level failure finding so conc coverage can never shrink "
+     "silently"),
+    ("CONC002", SEV_WARNING,
+     "field guarded by a lock is also accessed without it",
+     "per-class lockset inference over thread-reachable field accesses"),
+    ("CONC003", SEV_WARNING,
+     "lock-order edge is new or participates in a cycle",
+     "acquisition-order graph from nested 'with' blocks, ratcheted "
+     "against benchmarks/lock_order.json (cycles are errors)"),
+    ("CONC004", SEV_WARNING, "blocking call while holding a lock",
+     "file/sqlite/socket I/O, checkpoint saves, device syncs and "
+     "unbounded waits lexically inside 'with <lock>:'"),
+    ("CONC005", SEV_ERROR, "condition-variable misuse",
+     "cond.wait() outside a while-predicate loop; notify without "
+     "holding the condition"),
+    ("CONC006", SEV_WARNING, "timeout-less blocking wait on a shutdown "
+     "path",
+     "join()/get()/wait()/result() without a timeout reachable from "
+     "stop()/finish()/close()"),
+]
+
+
+# -- CONC002: lockset inference ----------------------------------------------
+
+def conc002(model: ConcModel) -> List[Finding]:
+    out: List[Finding] = []
+    for cls in model.classes:
+        lockish = {a for a in cls.sync
+                   if cls.sync[a] in ("lock", "condition")}
+        if not lockish or not cls.thread_roots:
+            continue
+        closures = cls.thread_closure()
+        union_thread: Set[str] = set()
+        for c in closures.values():
+            union_thread |= c
+        callers: Dict[str, Set[str]] = {}
+        for m, callees in cls.calls.items():
+            for c in callees:
+                callers.setdefault(c, set()).add(m)
+        exclusively_thread = {
+            m for m in union_thread
+            if callers.get(m, set()) <= union_thread}
+        init_only = cls.init_only_methods()
+
+        def _labels(method: str) -> Set[str]:
+            labels = {f"thread:{r}" for r, c in closures.items()
+                      if method in c}
+            if method not in exclusively_thread:
+                labels.add("main")
+            return labels
+
+        for field, accesses in sorted(cls.field_accesses.items()):
+            # a field never STORED outside construction cannot race —
+            # concurrent reads of init-time state are safe (self.rank,
+            # config knobs); only runtime writes make a lock meaningful
+            if not any(a.store and a.method not in init_only
+                       for a in accesses):
+                continue
+            guarded = [a for a in accesses if a.lock in lockish]
+            if len(guarded) < 2:
+                continue
+            by_lock: Dict[str, int] = {}
+            for a in guarded:
+                by_lock[a.lock] = by_lock.get(a.lock, 0) + 1
+            dom = max(sorted(by_lock), key=lambda k: by_lock[k])
+            if by_lock[dom] < 2:
+                continue
+            roots: Set[str] = set()
+            for a in accesses:
+                if a.method not in init_only:
+                    roots |= _labels(a.method)
+            if len(roots) < 2:
+                continue
+            unguarded = sorted(
+                (a for a in accesses
+                 if a.lock is None and a.method not in init_only),
+                key=lambda a: (a.lineno, a.col))
+            if not unguarded:
+                continue
+            first = unguarded[0]
+            where = sorted({a.method for a in unguarded})
+            out.append(Finding(
+                "CONC002", SEV_WARNING, cls.path, first.lineno,
+                first.col,
+                f"'self.{field}' of {cls.name} is guarded by "
+                f"'{cls.lock_id(dom)}' at {by_lock[dom]} site(s) but "
+                f"accessed without it in {', '.join(where)} — the field "
+                f"is reachable from {len(roots)} thread roots; take the "
+                f"lock at every access or confine the field to one "
+                f"thread"))
+    return out
+
+
+# -- CONC003: lock-order graph + ratchet -------------------------------------
+
+def _cycles(edge_pairs: Set[Tuple[str, str]]) -> List[List[str]]:
+    """Strongly-connected components with ≥2 nodes (or a self-loop) in
+    the acquisition-order digraph — each is a potential deadlock."""
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edge_pairs:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    counter = [0]
+    sccs: List[List[str]] = []
+
+    def strongconnect(v: str) -> None:
+        # iterative Tarjan (the graph is tiny, but recursion depth must
+        # not depend on lock-chain length)
+        work = [(v, iter(sorted(graph[v])))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph[w]))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1 or (node, node) in edge_pairs:
+                    sccs.append(sorted(comp))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+def conc003(model: ConcModel,
+            committed: Optional[Set[Tuple[str, str]]]
+            ) -> Tuple[List[Finding], List[str]]:
+    out: List[Finding] = []
+    notes: List[str] = []
+    edges = dedup_edges(model.edges)
+    pairs = set(edges)
+    cyclic_nodes: Set[str] = set()
+    for comp in _cycles(pairs):
+        cyclic_nodes |= set(comp)
+        for (src, dst), sites in sorted(edges.items()):
+            if src in comp and dst in comp:
+                s = sites[0]
+                out.append(Finding(
+                    "CONC003", SEV_ERROR, s.path, s.lineno, 0,
+                    f"lock-order cycle through "
+                    f"{{{', '.join(comp)}}}: '{src}' is held while "
+                    f"acquiring '{dst}' — two threads taking these "
+                    f"locks in opposite order deadlock; impose one "
+                    f"global order"))
+    for (src, dst), sites in sorted(edges.items()):
+        if src in cyclic_nodes and dst in cyclic_nodes:
+            continue
+        if committed is None or (src, dst) not in committed:
+            s = sites[0]
+            out.append(Finding(
+                "CONC003", SEV_WARNING, s.path, s.lineno, 0,
+                f"new lock-order edge '{src}' -> '{dst}' is not in the "
+                f"committed DAG — review the nesting for deadlock "
+                f"safety, then commit it with "
+                f"`python -m fedml_tpu.analysis.conc.lockorder`"))
+    if committed is None:
+        # "hint:" notes are advisory — every edge still reports as a
+        # finding, so the scan is complete and --update-baseline may
+        # proceed (unlike a skipped pass, which must refuse)
+        notes.append(
+            "hint: conc: no committed lock-order DAG (benchmarks/"
+            "lock_order.json) — every edge reports as new; generate it "
+            "with `python -m fedml_tpu.analysis.conc.lockorder`")
+    else:
+        stale = sorted(committed - pairs)
+        if stale:
+            notes.append(
+                f"hint: conc: {len(stale)} committed lock-order edge(s) no "
+                f"longer observed ({', '.join(f'{a} -> {b}' for a, b in stale[:4])}"
+                f"{', …' if len(stale) > 4 else ''}) — regenerate "
+                f"benchmarks/lock_order.json to tighten the ratchet")
+    return out, notes
+
+
+# -- CONC004: blocking call under a lock -------------------------------------
+
+#: attribute tails that block REGARDLESS of arguments
+_ALWAYS_BLOCKING_TAILS = {"block_until_ready", "sendall", "makefile",
+                         "wait_until_finished"}
+#: attribute tails that block when called with NO timeout bound
+_TIMEOUT_TAILS = {"join", "result", "get", "wait"}
+#: sqlite-ish bases (the attr/name the call hangs off)
+_DB_BASES = ("conn", "db", "cur", "cursor", "sql")
+#: checkpoint-ish bases for .save/.restore
+_CKPT_BASES = ("ckpt", "checkpoint", "mngr", "manager", "orbax", "saver")
+
+
+def _base_tail(expr: ast.AST) -> str:
+    """Last identifier of the expression a method call hangs off."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return ""
+
+
+def _blocking_desc(call: ast.Call, aliases: Dict[str, str],
+                   cls: Optional[ClassConc]) -> Optional[str]:
+    name = astutil.call_name(call, aliases)
+    if name == "open":
+        return "open() (file I/O)"
+    if name == "time.sleep":
+        return "time.sleep()"
+    if name in ("jax.block_until_ready", "jax.device_get"):
+        return f"{name}() (device sync)"
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    tail = call.func.attr
+    base = _base_tail(call.func.value).lower()
+    if tail in _ALWAYS_BLOCKING_TAILS:
+        return f".{tail}()"
+    if tail in ("execute", "executemany", "commit") \
+            and any(b in base for b in _DB_BASES):
+        return f".{tail}() (sqlite I/O)"
+    if tail in ("save", "restore") \
+            and any(b in base for b in _CKPT_BASES):
+        return f".{tail}() (checkpoint I/O)"
+    if tail in _TIMEOUT_TAILS and not call.args \
+            and not any(kw.arg == "timeout" for kw in call.keywords):
+        if tail == "get":
+            # dict.get collides — only a QUEUE-typed self attr counts
+            attr = None
+            if isinstance(call.func.value, ast.Attribute):
+                v = call.func.value
+                if isinstance(v.value, ast.Name) and v.value.id == "self":
+                    attr = v.attr
+            if cls is None or attr is None \
+                    or cls.sync.get(attr) != "queue":
+                return None
+            return ".get() without timeout (queue)"
+        if tail == "wait" and cls is not None:
+            attr = None
+            v = call.func.value
+            if isinstance(v, ast.Attribute) \
+                    and isinstance(v.value, ast.Name) \
+                    and v.value.id == "self":
+                attr = v.attr
+            if attr is not None and cls.sync.get(attr) == "condition":
+                return None        # CONC005's territory
+        return f".{tail}() without timeout"
+    return None
+
+
+def _io_kind(desc: str) -> Optional[str]:
+    if "(sqlite I/O)" in desc:
+        return "sqlite"
+    if "(file I/O)" in desc:
+        return "file"
+    return None
+
+
+def conc004(model: ConcModel) -> List[Finding]:
+    # Collect candidates first: (lock_id, section_key, desc, finding).
+    # A "section" is one critical region (one `with` / acquisition site);
+    # per-lock section stats drive the dedicated-serializer exemption
+    # below, and the seen-set collapses regions reached by both the
+    # class-acquisition walk and the module-level walk (a method using a
+    # MODULE lock is visible to both).
+    cands: List[tuple] = []
+    sections: Dict[str, set] = {}
+    io_sections: Dict[tuple, set] = {}
+    seen: set = set()
+
+    def _walk_region(lock_id: str, path: str, region: ast.AST,
+                     aliases, cls: Optional[ClassConc]) -> None:
+        skey = (path, region.lineno, region.col_offset)
+        sections.setdefault(lock_id, set()).add(skey)
+        for node in ast.walk(region):
+            if not isinstance(node, ast.Call):
+                continue
+            desc = _blocking_desc(node, aliases, cls)
+            if desc is None:
+                continue
+            kind = _io_kind(desc)
+            if kind:
+                io_sections.setdefault((lock_id, kind), set()).add(skey)
+            key = (lock_id, path, node.lineno, node.col_offset, desc)
+            if key in seen:
+                continue
+            seen.add(key)
+            cands.append((lock_id, kind, Finding(
+                "CONC004", SEV_WARNING, path, node.lineno,
+                node.col_offset,
+                f"blocking call {desc} while holding '{lock_id}' — "
+                f"every thread contending for the lock stalls behind "
+                f"it; move the call outside the critical section or "
+                f"bound it with a timeout")))
+
+    for cls in model.classes:
+        ctx = model.contexts_by_path[cls.path]
+        for acq in cls.acquisitions:
+            # a Condition used as a context manager is CONC005 territory
+            # (wait/notify UNDER it are the point); plain locks only
+            attr_kinds = {cls.sync.get(a) for a in cls.sync
+                          if cls.lock_id(a) == acq.lock_id}
+            if "condition" in attr_kinds:
+                continue
+            _walk_region(acq.lock_id, cls.path, acq.node, ctx.aliases, cls)
+    # module-level 'with <lock>:' blocks (the ledger/metrics idiom)
+    for path, mod in model.modules.items():
+        if not mod.locks:
+            continue
+        ctx = model.contexts_by_path[path]
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            lid = None
+            for item in node.items:
+                if isinstance(item.context_expr, ast.Name) \
+                        and item.context_expr.id in mod.locks \
+                        and mod.locks[item.context_expr.id] == "lock":
+                    lid = mod.lock_id(item.context_expr.id)
+            if lid is None:
+                continue
+            _walk_region(lid, path, node, ctx.aliases, None)
+    # Dedicated-serializer exemption: when ≥60% of a lock's critical
+    # sections (and at least 3 of them) perform the same kind of I/O,
+    # the lock IS that resource's serializer — a sqlite connection or
+    # append-only log isn't thread-safe, and the lock exists precisely
+    # to order those calls.  Flagging every execute() under a dedicated
+    # DB lock would just teach people to scatter noqa; the rule keeps
+    # firing for the accidental case (an occasional blocking call under
+    # a lock that mostly guards in-memory state).
+    exempt: set = set()
+    for (lock_id, kind), sect in io_sections.items():
+        total = len(sections.get(lock_id, ()))
+        if len(sect) >= 3 and total and len(sect) / total >= 0.6:
+            exempt.add((lock_id, kind))
+    return [f for lock_id, kind, f in cands
+            if not (kind and (lock_id, kind) in exempt)]
+
+
+# -- CONC005: condition-variable misuse --------------------------------------
+
+def conc005(model: ConcModel) -> List[Finding]:
+    out: List[Finding] = []
+    for cls in model.classes:
+        conds = cls.attrs_of("condition")
+        if not conds:
+            continue
+        ctx = model.contexts_by_path[cls.path]
+        for mi in cls.info.methods.values():
+            for node in ast.walk(mi.node):
+                if not isinstance(node, ast.Call) \
+                        or not isinstance(node.func, ast.Attribute):
+                    continue
+                v = node.func.value
+                attr = None
+                if isinstance(v, ast.Attribute) \
+                        and isinstance(v.value, ast.Name) \
+                        and v.value.id == "self":
+                    attr = v.attr
+                if attr not in conds:
+                    continue
+                cv = cls.lock_id(attr)
+                if node.func.attr == "wait":
+                    in_while = any(
+                        isinstance(a, ast.While) for a in
+                        _ancestors_in_func(node, ctx.parents))
+                    if not in_while:
+                        out.append(Finding(
+                            "CONC005", SEV_ERROR, cls.path, node.lineno,
+                            node.col_offset,
+                            f"'{cv}.wait()' outside a while-predicate "
+                            f"loop — spurious wakeups and missed "
+                            f"notifies return with the predicate still "
+                            f"false; use `while not pred: cv.wait()` or "
+                            f"cv.wait_for(pred)"))
+                elif node.func.attr in ("notify", "notify_all"):
+                    holding = any(
+                        isinstance(a, (ast.With, ast.AsyncWith))
+                        and any(_self_attr_name(i.context_expr) == attr
+                                for i in a.items)
+                        for a in _ancestors_in_func(node, ctx.parents))
+                    if not holding:
+                        out.append(Finding(
+                            "CONC005", SEV_ERROR, cls.path, node.lineno,
+                            node.col_offset,
+                            f"'{cv}.{node.func.attr}()' without holding "
+                            f"the condition — the waiter can miss the "
+                            f"wakeup; wrap in `with {cv.split('.')[-1]}:`"
+                            ))
+    return out
+
+
+def _ancestors_in_func(node: ast.AST, parents):
+    for a in astutil.ancestors(node, parents):
+        if isinstance(a, astutil.FUNC_NODES):
+            return
+        yield a
+
+
+def _self_attr_name(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Attribute) \
+            and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "self":
+        return expr.attr
+    return None
+
+
+# -- CONC006: timeout-less blocking wait on a shutdown path ------------------
+
+def conc006(model: ConcModel) -> List[Finding]:
+    out: List[Finding] = []
+    for cls in model.classes:
+        shutdown = cls.shutdown_closure()
+        if not shutdown:
+            continue
+        for mname, root in sorted(shutdown.items()):
+            mi = cls.info.methods[mname]
+            for node in ast.walk(mi.node):
+                if not isinstance(node, ast.Call) \
+                        or not isinstance(node.func, ast.Attribute):
+                    continue
+                tail = node.func.attr
+                if tail not in ("join", "get", "wait", "result"):
+                    continue
+                if node.args or any(kw.arg == "timeout"
+                                    for kw in node.keywords):
+                    continue
+                if tail == "get":
+                    attr = _self_attr_name(node.func.value)
+                    if attr is None or cls.sync.get(attr) != "queue":
+                        continue
+                out.append(Finding(
+                    "CONC006", SEV_WARNING, cls.path, node.lineno,
+                    node.col_offset,
+                    f"timeout-less '.{tail}()' in {cls.name}.{mname} on "
+                    f"the shutdown path (reached from {root}()) — a "
+                    f"wedged peer makes stop/finish hang forever; add a "
+                    f"timeout or wake the waiter with a sentinel"))
+    return out

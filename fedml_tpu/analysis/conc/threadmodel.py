@@ -1,0 +1,462 @@
+"""Thread/lock model for the concurrency tier.
+
+Everything the CONC002-CONC006 rules reason about is derived here, from
+the whole-program ``PackageIndex`` plus one extra AST walk per class:
+
+* which attributes are SYNCHRONIZATION objects — locks, conditions,
+  events, queues, threads — recognized by constructor
+  (``threading.Lock()``, ``queue.Queue()``, …) and by the runtime
+  profiler's factories (``named_lock("Cls._lock")``, whose string
+  literal then becomes the lock's canonical id in both planes);
+* which methods are THREAD ROOTS — handed to
+  ``threading.Thread(target=…)`` / ``threading.Timer`` /
+  ``executor.submit(…)``, or a comm receive loop
+  (``handle_receive_message`` runs on the transport's listener thread)
+  — so "shared" can mean *reachable from ≥2 roots*, not merely "the
+  module has a lock";
+* every ``self.<field>`` access with its lexical ``with <lock>:``
+  context, for lockset inference;
+* every lock ACQUISITION with its nesting, and the call-mediated
+  acquisitions one ``self.m()`` / typed-attribute hop away, for the
+  lock-order graph.
+
+Like the rest of the analysis plane this never imports the code under
+analysis — stdlib ``ast`` only.
+
+Known, deliberate approximations (documented in
+docs/STATIC_ANALYSIS.md): ``lock.acquire()/release()`` pairs outside a
+``with`` are not tracked; cross-class edges resolve only through
+attributes whose class is visible from a ``self.x = ClassName(…)``
+assignment; module-level lock-order edges come from lexical nesting
+only.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import astutil
+from ..wholeprogram.index import ClassInfo, MethodInfo, PackageIndex
+
+#: constructor dotted name → sync kind
+SYNC_CTORS: Dict[str, str] = {
+    "threading.Lock": "lock",
+    "threading.RLock": "lock",
+    "multiprocessing.Lock": "lock",
+    "threading.Condition": "condition",
+    "threading.Semaphore": "semaphore",
+    "threading.BoundedSemaphore": "semaphore",
+    "threading.Event": "event",
+    "multiprocessing.Event": "event",
+    "queue.Queue": "queue",
+    "queue.SimpleQueue": "queue",
+    "queue.LifoQueue": "queue",
+    "queue.PriorityQueue": "queue",
+    "threading.Thread": "thread",
+    "threading.Timer": "thread",
+}
+
+#: the runtime profiler's factories (tail-name match so both
+#: ``named_lock(…)`` and ``lock_profiler.named_lock(…)`` resolve)
+FACTORY_TAILS: Dict[str, str] = {
+    "named_lock": "lock",
+    "named_rlock": "lock",
+}
+
+#: method names that are thread entry points by convention: the comm
+#: managers' receive loop runs on the transport's listener thread
+CONVENTION_ROOTS = ("handle_receive_message",)
+
+#: methods whose closure forms the shutdown path (CONC006)
+SHUTDOWN_ROOTS = ("stop", "finish", "close", "shutdown", "terminate",
+                  "stop_receive_message", "__exit__", "__del__")
+
+
+def _ctor_kind(call: ast.Call, aliases: Dict[str, str]
+               ) -> Tuple[Optional[str], Optional[str]]:
+    """(sync kind, explicit name literal) of a constructor call."""
+    name = astutil.call_name(call, aliases)
+    kind = SYNC_CTORS.get(name)
+    if kind is None:
+        tail = name.rsplit(".", 1)[-1] if name else ""
+        kind = FACTORY_TAILS.get(tail)
+        if kind is None:
+            return None, None
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and isinstance(call.args[0].value, str):
+            return kind, call.args[0].value
+    return kind, None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _timeout_given(call: ast.Call) -> bool:
+    """Does a ``.join()/.get()/.wait()/.result()`` call bound itself?"""
+    if call.args:
+        return True
+    return any(kw.arg == "timeout" for kw in call.keywords)
+
+
+@dataclasses.dataclass
+class FieldAccess:
+    field: str
+    method: str
+    lineno: int
+    col: int
+    store: bool
+    lock: Optional[str]          # innermost held self-lock ATTR, or None
+
+
+@dataclasses.dataclass
+class Acquisition:
+    """One ``with self.<attr>:`` (or module-lock) site."""
+    lock_id: str
+    method: str
+    lineno: int
+    node: ast.With
+
+
+@dataclasses.dataclass
+class Edge:
+    src: str                     # lock id held
+    dst: str                     # lock id acquired while src held
+    path: str
+    lineno: int
+    via: str                     # "Cls.method" of the outer acquisition
+
+
+@dataclasses.dataclass
+class ClassConc:
+    name: str
+    path: str
+    info: ClassInfo
+    sync: Dict[str, str] = dataclasses.field(default_factory=dict)
+    lock_names: Dict[str, str] = dataclasses.field(default_factory=dict)
+    attr_types: Dict[str, str] = dataclasses.field(default_factory=dict)
+    thread_roots: Set[str] = dataclasses.field(default_factory=set)
+    field_accesses: Dict[str, List[FieldAccess]] = dataclasses.field(
+        default_factory=dict)
+    acquisitions: List[Acquisition] = dataclasses.field(
+        default_factory=list)
+    #: method → lock ids acquired anywhere in its body
+    method_locks: Dict[str, Set[str]] = dataclasses.field(
+        default_factory=dict)
+    calls: Dict[str, Set[str]] = dataclasses.field(default_factory=dict)
+
+    # -- sync-attr views -----------------------------------------------------
+    def attrs_of(self, kind: str) -> Set[str]:
+        return {a for a, k in self.sync.items() if k == kind}
+
+    @property
+    def lock_attrs(self) -> Set[str]:
+        return self.attrs_of("lock")
+
+    def lock_id(self, attr: str) -> str:
+        """Canonical id: the ``named_lock`` literal when one was given,
+        else ``ClassName.attr`` (the same string the factory convention
+        asks callers to pass, so the planes agree by construction)."""
+        return self.lock_names.get(attr) or f"{self.name}.{attr}"
+
+    # -- reachability --------------------------------------------------------
+    def closure(self, roots) -> Set[str]:
+        seen: Set[str] = set()
+        stack = [r for r in roots if r in self.info.methods]
+        while stack:
+            m = stack.pop()
+            if m in seen:
+                continue
+            seen.add(m)
+            stack.extend(c for c in self.calls.get(m, ())
+                         if c in self.info.methods and c not in seen)
+        return seen
+
+    def thread_closure(self) -> Dict[str, Set[str]]:
+        return {r: self.closure({r}) for r in sorted(self.thread_roots)}
+
+    def init_only_methods(self) -> Set[str]:
+        """Methods reachable ONLY from ``__init__`` — they run before
+        any thread this class spawns exists, so their unguarded accesses
+        are not races."""
+        init = self.closure({"__init__"})
+        others = self.closure(set(self.info.methods) - {"__init__"})
+        return (init - others) | {"__init__"}
+
+    def shutdown_closure(self) -> Dict[str, str]:
+        """method → the shutdown root it is reachable from."""
+        out: Dict[str, str] = {}
+        for root in self.info.methods:
+            if root not in SHUTDOWN_ROOTS \
+                    and not root.startswith(("stop_", "shutdown_")):
+                continue
+            for m in self.closure({root}):
+                out.setdefault(m, root)
+        return out
+
+
+@dataclasses.dataclass
+class ModuleConc:
+    path: str
+    basename: str
+    locks: Dict[str, str] = dataclasses.field(default_factory=dict)
+    lock_names: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def lock_id(self, name: str) -> str:
+        return self.lock_names.get(name) or f"{self.basename}.{name}"
+
+
+@dataclasses.dataclass
+class ConcModel:
+    classes: List[ClassConc] = dataclasses.field(default_factory=list)
+    modules: Dict[str, ModuleConc] = dataclasses.field(default_factory=dict)
+    contexts_by_path: Dict[str, object] = dataclasses.field(
+        default_factory=dict)
+    edges: List[Edge] = dataclasses.field(default_factory=list)
+    #: class name → ClassConc (for typed-attribute edge resolution)
+    by_name: Dict[str, ClassConc] = dataclasses.field(default_factory=dict)
+
+
+# -- builders -----------------------------------------------------------------
+
+def _scan_sync_attrs(cls: ClassConc, aliases: Dict[str, str],
+                     class_names: Set[str]) -> None:
+    for mi in cls.info.methods.values():
+        for node in ast.walk(mi.node):
+            if not isinstance(node, ast.Assign) \
+                    or not isinstance(node.value, ast.Call):
+                continue
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr is None:
+                    continue
+                kind, literal = _ctor_kind(node.value, aliases)
+                if kind is not None:
+                    cls.sync[attr] = kind
+                    if literal:
+                        cls.lock_names[attr] = literal
+                    continue
+                ctor = astutil.call_name(node.value, aliases)
+                tail = ctor.rsplit(".", 1)[-1] if ctor else ""
+                if tail in class_names:
+                    cls.attr_types[attr] = tail
+
+
+def _scan_thread_roots(cls: ClassConc, aliases: Dict[str, str]) -> None:
+    for mi in cls.info.methods.values():
+        for node in ast.walk(mi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            name = astutil.call_name(node, aliases)
+            target: Optional[ast.AST] = None
+            if name in ("threading.Thread", "threading.Timer"):
+                for kw in node.keywords:
+                    if kw.arg in ("target", "function"):
+                        target = kw.value
+                if target is None and name == "threading.Timer" \
+                        and len(node.args) >= 2:
+                    target = node.args[1]
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "submit" and node.args:
+                target = node.args[0]
+            attr = _self_attr(target) if target is not None else None
+            if attr is not None and attr in cls.info.methods:
+                cls.thread_roots.add(attr)
+    for conv in CONVENTION_ROOTS:
+        if conv in cls.info.methods:
+            cls.thread_roots.add(conv)
+
+
+def _held_self_lock(node: ast.AST, parents, cls: ClassConc
+                    ) -> Optional[str]:
+    """Innermost enclosing ``with self.<lock-ish>:`` attr (lock or
+    condition — holding a Condition means holding its lock)."""
+    for anc in astutil.ancestors(node, parents):
+        if isinstance(anc, astutil.FUNC_NODES):
+            return None
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                attr = _self_attr(item.context_expr)
+                if attr is not None \
+                        and cls.sync.get(attr) in ("lock", "condition",
+                                                   "semaphore"):
+                    return attr
+    return None
+
+
+def _scan_field_accesses(cls: ClassConc, parents) -> None:
+    for mname, mi in cls.info.methods.items():
+        for node in ast.walk(mi.node):
+            attr = _self_attr(node)
+            if attr is None or attr in cls.sync \
+                    or attr in cls.info.methods:
+                continue
+            store = isinstance(node.ctx, (ast.Store, ast.Del))
+            cls.field_accesses.setdefault(attr, []).append(FieldAccess(
+                attr, mname, node.lineno, node.col_offset, store,
+                _held_self_lock(node, parents, cls)))
+
+
+def _with_lock_ids(item_expr: ast.AST, cls: Optional[ClassConc],
+                   mod: ModuleConc) -> Optional[str]:
+    """Lock id acquired by one with-item, or None if not a known lock."""
+    if cls is not None:
+        attr = _self_attr(item_expr)
+        if attr is not None and cls.sync.get(attr) in ("lock",
+                                                       "condition"):
+            return cls.lock_id(attr)
+    if isinstance(item_expr, ast.Name) and item_expr.id in mod.locks:
+        return mod.lock_id(item_expr.id)
+    return None
+
+
+def _scan_acquisitions(cls: ClassConc, mod: ModuleConc) -> None:
+    for mname, mi in cls.info.methods.items():
+        locks: Set[str] = set()
+        for node in ast.walk(mi.node):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            for item in node.items:
+                lid = _with_lock_ids(item.context_expr, cls, mod)
+                if lid is not None:
+                    locks.add(lid)
+                    cls.acquisitions.append(
+                        Acquisition(lid, mname, node.lineno, node))
+        cls.method_locks[mname] = locks
+
+
+def _closure_locks(cls: ClassConc, method: str) -> Set[str]:
+    return {lid for m in cls.closure({method})
+            for lid in cls.method_locks.get(m, ())}
+
+
+def _extract_class_edges(cls: ClassConc, mod: ModuleConc,
+                         model: ConcModel) -> List[Edge]:
+    """Lock-order edges rooted at this class's acquisitions: lexical
+    nesting, same-class call-mediated acquisitions, and one typed-
+    attribute hop (``with self._lock: self.store.put(…)`` reaches the
+    locks of ``type(self.store)``'s ``put`` closure)."""
+    edges: List[Edge] = []
+    for acq in cls.acquisitions:
+        via = f"{cls.name}.{acq.method}"
+
+        def _emit(dst: str, lineno: int) -> None:
+            if dst != acq.lock_id:
+                edges.append(Edge(acq.lock_id, dst, cls.path, lineno, via))
+
+        for node in ast.walk(acq.node):
+            if node is acq.node:
+                continue
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    lid = _with_lock_ids(item.context_expr, cls, mod)
+                    if lid is not None:
+                        _emit(lid, node.lineno)
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Attribute):
+                continue
+            callee = node.func
+            attr = _self_attr(callee.value)
+            if attr is None:
+                # self.<attr>.<m>(): one hop through a typed attribute
+                inner = _self_attr(getattr(callee.value, "value", None)) \
+                    if isinstance(callee.value, ast.Attribute) else None
+                if inner is not None and inner in cls.attr_types:
+                    target = model.by_name.get(cls.attr_types[inner])
+                    if target is not None \
+                            and callee.attr in target.info.methods:
+                        for lid in _closure_locks(target, callee.attr):
+                            _emit(lid, node.lineno)
+                continue
+            # self.m(): same-class call-mediated acquisition
+            if callee.attr in cls.info.methods:
+                for lid in _closure_locks(cls, callee.attr):
+                    _emit(lid, node.lineno)
+    return edges
+
+
+def _extract_module_edges(mod: ModuleConc, ctx) -> List[Edge]:
+    """Module-level lock nesting (lexical only)."""
+    edges: List[Edge] = []
+    if not mod.locks:
+        return edges
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        outer = None
+        for item in node.items:
+            outer = _with_lock_ids(item.context_expr, None, mod) or outer
+        if outer is None:
+            continue
+        for sub in ast.walk(node):
+            if sub is node or not isinstance(sub, (ast.With,
+                                                   ast.AsyncWith)):
+                continue
+            for item in sub.items:
+                lid = _with_lock_ids(item.context_expr, None, mod)
+                if lid is not None and lid != outer:
+                    fn = astutil.enclosing_function(sub, ctx.parents)
+                    via = f"{mod.basename}.{fn.name}" if fn is not None \
+                        else mod.basename
+                    edges.append(Edge(outer, lid, mod.path, sub.lineno,
+                                      via))
+    return edges
+
+
+def build_model(index: PackageIndex, contexts) -> ConcModel:
+    model = ConcModel(contexts_by_path={c.path: c for c in contexts})
+    class_names = {c.name for m in index.modules.values()
+                   for c in m.classes}
+    for path, minfo in sorted(index.modules.items()):
+        ctx = model.contexts_by_path.get(path)
+        if ctx is None:
+            continue
+        parts = path.rsplit("/", 2)
+        basename = parts[-1].removesuffix(".py")
+        if basename == "__init__" and len(parts) > 1:
+            # "pkg/__init__.py" locks read as 'pkg.<name>', not
+            # '__init__.<name>' — every package would collide otherwise
+            basename = parts[-2]
+        mod = ModuleConc(path, basename)
+        for stmt in getattr(ctx.tree, "body", []):
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and isinstance(stmt.value, ast.Call):
+                kind, literal = _ctor_kind(stmt.value, ctx.aliases)
+                if kind in ("lock", "condition"):
+                    mod.locks[stmt.targets[0].id] = kind
+                    if literal:
+                        mod.lock_names[stmt.targets[0].id] = literal
+        model.modules[path] = mod
+        for cinfo in minfo.classes:
+            cls = ClassConc(cinfo.name, path, cinfo)
+            cls.calls = {m: {c.func.attr for c in mi.self_calls}
+                         for m, mi in cinfo.methods.items()}
+            _scan_sync_attrs(cls, ctx.aliases, class_names)
+            _scan_thread_roots(cls, ctx.aliases)
+            _scan_field_accesses(cls, ctx.parents)
+            _scan_acquisitions(cls, mod)
+            model.classes.append(cls)
+            # first definition wins — duplicate class names across
+            # modules are rare and only feed the typed-attr hop
+            model.by_name.setdefault(cls.name, cls)
+    for cls in model.classes:
+        model.edges.extend(
+            _extract_class_edges(cls, model.modules[cls.path], model))
+    for path, mod in model.modules.items():
+        model.edges.extend(
+            _extract_module_edges(mod, model.contexts_by_path[path]))
+    return model
+
+
+def dedup_edges(edges: List[Edge]) -> Dict[Tuple[str, str], List[Edge]]:
+    out: Dict[Tuple[str, str], List[Edge]] = {}
+    for e in edges:
+        out.setdefault((e.src, e.dst), []).append(e)
+    return out

@@ -134,7 +134,9 @@ def run_lint(root: Optional[Path] = None,
              whole_program: bool = False,
              perf: bool = False,
              mesh: bool = False,
+             conc: bool = False,
              perf_registry=None) -> LintResult:
+    from .conc import conc_rule_ids
     from .mesh.rules import mesh_rule_ids
     from .perf.rules import perf_rule_ids
     from .rules import make_program_rules, make_rules
@@ -149,9 +151,10 @@ def run_lint(root: Optional[Path] = None,
     # suppressible and baselineable like any rule id
     perf_ids = {r.upper() for r in perf_rule_ids()} | {"PERF000"}
     mesh_ids = {r.upper() for r in mesh_rule_ids()} | {"SHARD000"}
+    conc_ids = {r.upper() for r in conc_rule_ids()} | {"CONC000"}
     if wanted is not None:
         known = ({r.id.upper() for r in all_rules} | prog_ids | perf_ids
-                 | mesh_ids)
+                 | mesh_ids | conc_ids)
         unknown = sorted(wanted - known)
         if unknown:
             raise ValueError(f"unknown rule id(s) {unknown}; "
@@ -164,6 +167,7 @@ def run_lint(root: Optional[Path] = None,
         whole_program = whole_program or bool(wanted & prog_ids)
         perf = bool(wanted & perf_ids)
         mesh = bool(wanted & mesh_ids)
+        conc = bool(wanted & conc_ids)
     rules = [r for r in all_rules
              if wanted is None or r.id.upper() in wanted]
     prog_rules = ([r for r in all_prog_rules
@@ -273,6 +277,16 @@ def run_lint(root: Optional[Path] = None,
                              if f.path in subset_paths]
         _emit_project(mesh_findings)
         notes.extend(mesh_notes)
+    if conc:
+        from .conc import run_conc_pass
+
+        conc_findings, conc_notes = run_conc_pass(root, rule_ids=rule_ids)
+        if paths:
+            subset_paths = {c.path for c in contexts}
+            conc_findings = [f for f in conc_findings
+                             if f.path in subset_paths]
+        _emit_project(conc_findings)
+        notes.extend(conc_notes)
     findings.sort(key=Finding.sort_key)
     return LintResult(findings, n_files, suppressed,
                       time.monotonic() - t0, notes)

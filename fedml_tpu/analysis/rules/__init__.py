@@ -61,12 +61,33 @@ def make_program_rules() -> List[ProgramRule]:
 
 
 def rule_catalog() -> List[dict]:
+    """Every rule of every tier (``fedml lint --list-rules`` renders
+    this).  ``tier`` ∈ file|program|perf|mesh|conc; the pass-failure
+    channels (PERF000/SHARD000/CONC000) are listed with their tier."""
+    from ..conc import conc_catalog
+    from ..mesh.rules import make_mesh_rules
     from ..perf.rules import make_perf_rules
 
-    return ([{"id": r.id, "severity": r.severity, "title": r.title,
-              "whole_program": False} for r in make_rules()]
-            + [{"id": r.id, "severity": r.severity, "title": r.title,
-                "whole_program": True} for r in make_program_rules()]
-            + [{"id": r.id, "severity": r.severity, "title": r.title,
-                "whole_program": False, "perf": True}
-               for r in make_perf_rules()])
+    cat = ([{"id": r.id, "severity": r.severity, "title": r.title,
+             "whole_program": False, "tier": "file"}
+            for r in make_rules()]
+           + [{"id": r.id, "severity": r.severity, "title": r.title,
+               "whole_program": True, "tier": "program"}
+              for r in make_program_rules()]
+           + [{"id": r.id, "severity": r.severity, "title": r.title,
+               "whole_program": False, "perf": True, "tier": "perf"}
+              for r in make_perf_rules()]
+           + [{"id": "PERF000", "severity": "error",
+               "title": "perf pass could not trace an entrypoint",
+               "whole_program": False, "perf": True, "tier": "perf"}]
+           + [{"id": r.id, "severity": r.severity, "title": r.title,
+               "whole_program": False, "mesh": True, "tier": "mesh"}
+              for r in make_mesh_rules()]
+           + [{"id": "SHARD000", "severity": "error",
+               "title": "mesh pass could not lower an entrypoint",
+               "whole_program": False, "mesh": True, "tier": "mesh"}]
+           + [{"id": c["id"], "severity": c["severity"],
+               "title": c["title"], "whole_program": True,
+               "conc": True, "tier": "conc", "reads": c["reads"]}
+              for c in conc_catalog()])
+    return cat

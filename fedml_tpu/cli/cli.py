@@ -579,15 +579,26 @@ def jobs_pod(pod_dir: str, slots: int, tick_s: float,
                    "cross-host loop gathers, SHARD006 donation lost to "
                    "sharding); auto-on when a SHARD00[2-6] rule id is "
                    "requested")
+@click.option("--conc", "conc", is_flag=True,
+              help="also run the whole-program concurrency pass over the "
+                   "threaded control plane (CONC002 guarded-field "
+                   "locksets, CONC003 lock-order DAG ratchet, CONC004 "
+                   "blocking-call-under-lock, CONC005 condition-variable "
+                   "misuse, CONC006 timeout-less shutdown waits); auto-on "
+                   "when a CONC00[2-6] rule id is requested")
 @click.option("--graph", default=None,
               type=click.Choice(["dot", "json"]),
               help="emit the send/handle graph instead of linting")
+@click.option("--list-rules", "list_rules", is_flag=True,
+              help="print the full five-tier rule catalog (ids, "
+                   "severities, titles, doc anchors) and exit; "
+                   "--format json for machine-readable output")
 @click.option("--root", default=None, type=click.Path(exists=True),
               help="checkout root (default: the directory containing the "
                    "fedml_tpu package)")
 def lint(fmt: str, baseline: str, update_baseline: bool, paths,
          rules: str, whole_program: bool, perf: bool, mesh: bool,
-         graph: str, root: str) -> None:
+         conc: bool, graph: str, list_rules: bool, root: str) -> None:
     """JAX-aware static analysis with a CI ratchet (docs/STATIC_ANALYSIS.md).
 
     Exit codes: 0 clean, 1 new (unbaselined) findings, 2 internal error."""
@@ -598,8 +609,8 @@ def lint(fmt: str, baseline: str, update_baseline: bool, paths,
     raise SystemExit(run_cli(
         root=root, paths=list(paths) or None, fmt=fmt, baseline=baseline,
         update_baseline=update_baseline, rule_ids=rule_ids,
-        whole_program=whole_program, perf=perf, mesh=mesh, graph=graph,
-        echo=click.echo))
+        whole_program=whole_program, perf=perf, mesh=mesh, conc=conc,
+        graph=graph, list_rules=list_rules, echo=click.echo))
 
 
 @cli.command()
@@ -661,6 +672,61 @@ def trace_list(log_dir: str) -> None:
                      for r in tracing.load_spans(log_dir))
     for tid, n in counts.most_common():
         click.echo(json.dumps({"trace_id": tid, "spans": n}))
+
+
+@cli.group()
+def conc() -> None:
+    """Lock-profiler utilities over a snapshot produced by the opt-in
+    runtime recorder (FEDML_TPU_LOCK_PROFILE=1, docs/OBSERVABILITY.md
+    "Lock profiler")."""
+
+
+@conc.command("report")
+@click.option("--snapshot", "snapshot_path", required=True,
+              type=click.Path(exists=True),
+              help="lock-profiler snapshot JSON "
+                   "(lock_profiler.dump() output)")
+@click.option("--check-dag", is_flag=True,
+              help="fail (exit 1) when an observed acquisition-order "
+                   "edge is missing from the committed static DAG "
+                   "(benchmarks/lock_order.json)")
+@click.option("--max-overhead", default=None, type=float, metavar="FRAC",
+              help="fail (exit 1) when the recorder's self-measured "
+                   "overhead fraction exceeds FRAC (CI uses 0.02)")
+@click.option("--root", default=None, type=click.Path(exists=True),
+              help="checkout root holding benchmarks/lock_order.json "
+                   "(default: the directory containing the fedml_tpu "
+                   "package)")
+def conc_report(snapshot_path: str, check_dag: bool,
+                max_overhead: float, root: str) -> None:
+    """Hottest locks, contended acquisition-order edges and the observed
+    order graph, from a runtime lock-profiler snapshot; --check-dag
+    gates observed edges against the conc tier's committed DAG."""
+    from ..analysis.conc.lockorder import committed_pairs
+    from ..analysis.engine import default_root
+    from ..core.mlops import lock_profiler
+
+    with open(snapshot_path, "r", encoding="utf-8") as fh:
+        snap = json.load(fh)
+    failed = False
+    extras = []
+    if check_dag:
+        committed = committed_pairs(root or default_root())
+        if committed is None:
+            raise click.ClickException(
+                "no committed lock-order DAG — run "
+                "`python -m fedml_tpu.analysis.conc.lockorder` first")
+        extras = lock_profiler.check_observed_edges(
+            lock_profiler.observed_edges(snap), committed)
+        failed = failed or bool(extras)
+    click.echo(lock_profiler.render_report(snap, extra_edges=extras))
+    if max_overhead is not None:
+        frac = float(snap.get("overhead_frac") or 0.0)
+        if frac > max_overhead:
+            click.echo(f"fedml conc: recorder overhead {frac:.4f} exceeds "
+                       f"budget {max_overhead:.4f}")
+            failed = True
+    raise SystemExit(1 if failed else 0)
 
 
 @cli.group()

@@ -134,6 +134,10 @@ class _Session:
         self.subs: Dict[str, int] = {}      # topic → granted qos (0|1)
         self.will: Optional[Tuple[str, bytes]] = None
         self.lock = threading.Lock()
+        #: serializes sendall on the shared socket — a SEPARATE lock so a
+        #: slow subscriber (sendall can block up to SEND_TIMEOUT_S) never
+        #: stalls pid allocation / inflight bookkeeping under ``lock``
+        self.wlock = threading.Lock()
         self.graceful = False
         self.inflight_qos2: Dict[int, Tuple[str, bytes]] = {}
         #: broker→subscriber QoS1 in flight: pid → [frame_sans_dup,
@@ -165,9 +169,11 @@ class _Session:
             _remember_lru(self.acked_in, pid)
 
     def send(self, data: bytes) -> None:
-        with self.lock:
+        with self.wlock:
             try:
-                self.sock.sendall(data)
+                # wlock is the socket-write serializer: the sendall IS
+                # the resource it protects, so blocking under it is the point
+                self.sock.sendall(data)  # fedml: noqa[CONC004] — see above
             except OSError:
                 # a timed-out/failed sendall may have written a PARTIAL
                 # frame; the byte stream to this subscriber is now
@@ -492,8 +498,10 @@ class MiniMqttClient:
             self._reader_done.set()
 
     def _send(self, data: bytes) -> None:
+        # _lock is held for nothing but this write: it serializes frames
+        # from the heartbeat/run/reader threads onto one socket
         with self._lock:
-            self._sock.sendall(data)
+            self._sock.sendall(data)  # fedml: noqa[CONC004] — see above
 
     def _next_pid(self) -> int:
         # caller holds _inflight_lock (pid allocation and the in-flight
@@ -551,9 +559,11 @@ class MiniMqttClient:
             now = time.monotonic()
             deadline = deadline or now + 5.0
             if now >= deadline or self._reader_done.is_set():
+                with self._inflight_lock:
+                    n_unacked = len(self._inflight_pub)
                 logging.warning("mini-mqtt %s: disconnect with %d QoS1 "
                                 "publishes still un-PUBACKed",
-                                self.client_id, len(self._inflight_pub))
+                                self.client_id, n_unacked)
                 break
             try:
                 self._retransmit(now)

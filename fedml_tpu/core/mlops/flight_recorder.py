@@ -183,7 +183,8 @@ def _write(record: Dict[str, Any]) -> None:
         if f is None or f.closed:
             try:
                 os.makedirs(_state["log_dir"], exist_ok=True)
-                f = _state["file"] = open(path, "a")
+                # one-time lazy open; _lock IS the appender's serializer
+                f = _state["file"] = open(path, "a")  # fedml: noqa[CONC004]
             except OSError:
                 return            # unwritable log dir degrades, never aborts
         f.write(json.dumps(record, default=str) + "\n")

@@ -193,7 +193,8 @@ def event(actor: str, name: str, round_idx: Optional[int] = None,
         if f is None or f.closed:
             try:
                 os.makedirs(_state["log_dir"], exist_ok=True)
-                f = _state["file"] = open(path, "a")
+                # one-time lazy open; _lock IS the appender's serializer
+                f = _state["file"] = open(path, "a")  # fedml: noqa[CONC004]
             except OSError:
                 return
         try:

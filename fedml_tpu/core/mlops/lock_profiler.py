@@ -1,0 +1,321 @@
+"""Opt-in runtime lock profiler — the dynamic half of the conc lint tier.
+
+The static side (``analysis/conc``) proves properties about the lock
+graph it can SEE; this module records what the running control plane
+actually DOES: every acquisition-order edge (lock B acquired while A is
+held), plus per-lock hold / wait / contention accounting.  ``fedml conc
+report`` renders a snapshot and can gate observed edges against the
+committed static DAG (``benchmarks/lock_order.json``) — the CI chaos
+soak asserts observed ⊆ committed, so a runtime path that nests locks
+in an order the static pass never saw fails the build instead of
+deadlocking in production.
+
+The idiom is the flight recorder's, exactly:
+
+* **opt-in** — ``FEDML_TPU_LOCK_PROFILE=1`` (or ``arm()`` from tests);
+* **free when off** — ``named_lock()`` returns a PLAIN
+  ``threading.Lock`` when disarmed, so the hot paths carry zero wrapper
+  frames; arming is a CONSTRUCTION-time decision (locks built before
+  ``arm()`` stay plain);
+* **self-measuring** — bookkeeping time accumulates into
+  ``overhead_s`` (wait time excluded: blocking on a contended lock is
+  the program's time, not the profiler's); the CI budget is <2%;
+* **bounded** — per-lock/per-edge dicts only grow with distinct lock
+  NAMES, which are static string literals by convention.
+
+Naming convention: the name passed to ``named_lock`` is the lock's
+identity in BOTH planes — ``"ClassName.attr"`` (e.g.
+``"PodScheduler._lock"``), matching the ids the static pass derives, so
+``check_observed_edges`` can compare them directly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
+
+from . import ledger
+from . import metrics as _metrics
+
+#: armed override: None → follow the env toggle; True/False → forced
+#: (tests / the soak harness call ``arm()`` instead of mutating environ)
+_armed: Optional[bool] = None
+
+_state_lock = threading.Lock()
+_state: Dict[str, Any] = {
+    "t0": time.monotonic(),
+    "overhead_s": 0.0,
+    # name → {"acquisitions", "contended", "wait_s", "hold_s"}
+    "locks": {},
+    # (held, acquired) → count
+    "edges": {},
+}
+_tls = threading.local()
+
+
+def enabled() -> bool:
+    if _armed is not None:
+        return _armed
+    return os.environ.get("FEDML_TPU_LOCK_PROFILE", "").lower() in (
+        "1", "true", "yes", "on")
+
+
+def arm(on: bool = True) -> None:
+    """Programmatic arm/disarm (tests, the chaos soak).  Resets the
+    recording state; only locks CONSTRUCTED after arming are profiled."""
+    global _armed
+    _armed = bool(on)
+    reset()
+
+
+def reset() -> None:
+    with _state_lock:
+        _state["t0"] = time.monotonic()
+        _state["overhead_s"] = 0.0
+        _state["locks"] = {}
+        _state["edges"] = {}
+
+
+def _held_stack() -> List[str]:
+    stack = getattr(_tls, "held", None)
+    if stack is None:
+        stack = _tls.held = []
+    return stack
+
+
+class _ProfiledLock:
+    """Lock wrapper recording wait/hold/contention and order edges.
+
+    The inner primitive does the real synchronization; bookkeeping runs
+    OUTSIDE it (under the profiler's own ``_state_lock``), and the
+    bookkeeping time — never the wait time — lands in ``overhead_s``.
+    Reentrant wrappers (``named_rlock``) record the edge and hold span
+    for the OUTERMOST acquire only."""
+
+    __slots__ = ("_name", "_inner", "_reentrant", "_depth", "_t_acquired")
+
+    def __init__(self, name: str, reentrant: bool = False) -> None:
+        self._name = name
+        self._inner = threading.RLock() if reentrant else threading.Lock()
+        self._reentrant = reentrant
+        self._depth = 0          # owner-thread only (guarded by _inner)
+        self._t_acquired = 0.0
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        t0 = time.perf_counter()
+        got = self._inner.acquire(False)
+        contended = not got
+        if not got and blocking:
+            got = (self._inner.acquire(True, timeout) if timeout
+                   and timeout > 0 else self._inner.acquire())
+        t1 = time.perf_counter()
+        if not got:
+            return False
+        if self._reentrant and self._depth > 0:
+            self._depth += 1
+            return True
+        self._depth = 1
+        self._t_acquired = t1
+        stack = _held_stack()
+        holder = stack[-1] if stack else None
+        stack.append(self._name)
+        self._record_acquire(holder, contended, t1 - t0)
+        return True
+
+    def release(self) -> None:
+        if self._reentrant and self._depth > 1:
+            self._depth -= 1
+            self._inner.release()
+            return
+        self._depth = 0
+        held_for = time.perf_counter() - self._t_acquired
+        stack = _held_stack()
+        if stack and stack[-1] == self._name:
+            stack.pop()
+        elif self._name in stack:     # out-of-order release — still unwind
+            stack.remove(self._name)
+        self._inner.release()
+        t0 = time.perf_counter()
+        with _state_lock:
+            rec = _state["locks"].get(self._name)
+            if rec is not None:
+                rec["hold_s"] += held_for
+            _state["overhead_s"] += time.perf_counter() - t0
+
+    def locked(self) -> bool:
+        if self._reentrant:
+            return self._depth > 0
+        return self._inner.locked()
+
+    def __enter__(self) -> "_ProfiledLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    # -- bookkeeping ---------------------------------------------------------
+    def _record_acquire(self, holder: Optional[str], contended: bool,
+                        wait_s: float) -> None:
+        t0 = time.perf_counter()
+        new_edge = False
+        with _state_lock:
+            rec = _state["locks"].setdefault(
+                self._name, {"acquisitions": 0, "contended": 0,
+                             "wait_s": 0.0, "hold_s": 0.0})
+            rec["acquisitions"] += 1
+            if contended:
+                rec["contended"] += 1
+                rec["wait_s"] += wait_s
+            if holder is not None and holder != self._name:
+                edge = (holder, self._name)
+                new_edge = edge not in _state["edges"]
+                _state["edges"][edge] = _state["edges"].get(edge, 0) + 1
+            _state["overhead_s"] += time.perf_counter() - t0
+        # a NEW order edge is a rare, load-bearing event — ledger it;
+        # repeat traversals stay dict-increment cheap
+        if new_edge and ledger.enabled():
+            ledger.event("lockprof", "edge", held=holder,
+                         acquired=self._name)
+
+
+def named_lock(name: str) -> Any:
+    """Lock factory: a plain ``threading.Lock`` when the profiler is
+    disarmed (the common case — zero overhead), a profiled wrapper when
+    armed.  ``name`` must be the static lock id (``"ClassName.attr"``)."""
+    if not enabled():
+        return threading.Lock()
+    return _ProfiledLock(name)
+
+
+def named_rlock(name: str) -> Any:
+    if not enabled():
+        return threading.RLock()
+    return _ProfiledLock(name, reentrant=True)
+
+
+# -- snapshot / report --------------------------------------------------------
+
+def snapshot() -> Dict[str, Any]:
+    """Copy the recording state and push it onto the metrics registry
+    (counter/gauge updates happen HERE, not per-acquire, so the armed
+    hot path stays two dict hits)."""
+    with _state_lock:
+        elapsed = max(time.monotonic() - _state["t0"], 1e-9)
+        locks = {name: dict(rec) for name, rec in _state["locks"].items()}
+        edges = [[a, b, n] for (a, b), n in sorted(_state["edges"].items())]
+        overhead = _state["overhead_s"]
+    # pushed as gauges (point-in-time copies of cumulative values): the
+    # recording dicts stay the single source of truth and the armed hot
+    # path never touches the registry
+    acq = _metrics.gauge(
+        "fedml_lock_acquisitions",
+        "Profiled lock acquisitions (FEDML_TPU_LOCK_PROFILE=1)",
+        labels=("lock",))
+    cont = _metrics.gauge(
+        "fedml_lock_contended",
+        "Profiled acquisitions that had to wait", labels=("lock",))
+    hold = _metrics.gauge(
+        "fedml_lock_hold_seconds",
+        "Cumulative seconds each profiled lock was held",
+        labels=("lock",))
+    wait = _metrics.gauge(
+        "fedml_lock_wait_seconds",
+        "Cumulative seconds spent waiting on contended acquisitions",
+        labels=("lock",))
+    for name, rec in locks.items():
+        acq.labels(lock=name).set(rec["acquisitions"])
+        cont.labels(lock=name).set(rec["contended"])
+        hold.labels(lock=name).set(round(rec["hold_s"], 6))
+        wait.labels(lock=name).set(round(rec["wait_s"], 6))
+    _metrics.gauge(
+        "fedml_lock_profiler_overhead_frac",
+        "Self-measured profiler bookkeeping time / elapsed").set(
+        overhead / elapsed)
+    return {
+        "armed": enabled(),
+        "elapsed_s": round(elapsed, 6),
+        "overhead_s": round(overhead, 6),
+        "overhead_frac": overhead / elapsed,
+        "locks": {name: {"acquisitions": rec["acquisitions"],
+                         "contended": rec["contended"],
+                         "wait_s": round(rec["wait_s"], 6),
+                         "hold_s": round(rec["hold_s"], 6)}
+                  for name, rec in sorted(locks.items())},
+        "edges": edges,
+    }
+
+
+def dump(path: str) -> str:
+    """Write ``snapshot()`` as JSON — the artifact ``fedml conc report``
+    consumes offline (the soak's equivalent of ``metrics.prom``)."""
+    snap = snapshot()
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(snap, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def observed_edges(snap: Optional[Dict[str, Any]] = None
+                   ) -> Set[Tuple[str, str]]:
+    if snap is None:
+        snap = snapshot()
+    return {(a, b) for a, b, _n in snap.get("edges", [])}
+
+
+def check_observed_edges(observed: Iterable[Tuple[str, str]],
+                         committed: Iterable[Tuple[str, str]]
+                         ) -> List[Tuple[str, str]]:
+    """Edges the runtime traversed that the committed static DAG does
+    not contain — empty means observed ⊆ committed (the soak gate)."""
+    allowed = set(tuple(e) for e in committed)
+    return sorted(set(tuple(e) for e in observed) - allowed)
+
+
+def render_report(snap: Dict[str, Any],
+                  extra_edges: Optional[List[Tuple[str, str]]] = None
+                  ) -> str:
+    """The ``fedml conc report`` text view: hottest locks by hold time,
+    contended edges, the observed acquisition-order graph."""
+    out = [f"lock profiler: armed={snap.get('armed')}  "
+           f"elapsed {snap.get('elapsed_s', 0.0):.2f}s  "
+           f"overhead {snap.get('overhead_frac', 0.0):.3%}"]
+    locks = snap.get("locks") or {}
+    if not locks:
+        out.append("(no profiled acquisitions — arm with "
+                   "FEDML_TPU_LOCK_PROFILE=1 and use named_lock locks)")
+    else:
+        out.append(f"{'lock':<40}{'acq':>8}{'contended':>10}"
+                   f"{'wait_s':>9}{'hold_s':>9}")
+        ranked = sorted(locks.items(),
+                        key=lambda kv: -kv[1].get("hold_s", 0.0))
+        for name, rec in ranked:
+            out.append(f"{name:<40}{rec['acquisitions']:>8}"
+                       f"{rec['contended']:>10}{rec['wait_s']:>9.4f}"
+                       f"{rec['hold_s']:>9.4f}")
+    edges = snap.get("edges") or []
+    if edges:
+        out.append("observed acquisition order (held -> acquired, count):")
+        for a, b, n in edges:
+            out.append(f"  {a} -> {b}  x{n}")
+    else:
+        out.append("observed acquisition order: (no nested acquisitions)")
+    if extra_edges is not None:
+        if extra_edges:
+            out.append("EDGES OUTSIDE THE COMMITTED STATIC DAG "
+                       "(benchmarks/lock_order.json):")
+            for a, b in extra_edges:
+                out.append(f"  {a} -> {b}")
+        else:
+            out.append("observed edges ⊆ committed static DAG: OK")
+    return "\n".join(out)

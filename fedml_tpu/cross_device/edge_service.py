@@ -68,7 +68,9 @@ class EdgeService:
 
     def stop(self) -> None:
         self._stop.set()
-        for run_id in list(self._runs):
+        with self._lock:
+            run_ids = list(self._runs)
+        for run_id in run_ids:
             self._abort(run_id)
         self._send_active("OFFLINE")
         self.broker.unsubscribe(_topic_start(self.edge_id), self._on_start)

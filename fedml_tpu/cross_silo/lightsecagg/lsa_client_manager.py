@@ -43,7 +43,11 @@ class LSAClientManager(FedMLCommManager):
         # the next share-holder instead of deadlocking the cohort.  The
         # lock covers the timer thread racing the receive-loop thread.
         self._pending_agg_request = None
-        self._req_lock = threading.Lock()
+        # RLock: _clear_pending_request re-acquires under callers that
+        # already hold it.  Guards _pending_agg_request/_req_timer AND
+        # received_shares — the give-up Timer thread reads shares
+        # concurrently with the receive thread's writes.
+        self._req_lock = threading.RLock()
         self._req_timer: Optional[threading.Timer] = None
         self._share_wait_s = float(
             getattr(args, "lsa_share_wait_s", 30.0) or 30.0)
@@ -84,9 +88,10 @@ class LSAClientManager(FedMLCommManager):
         self.round_idx = int(msg.get(LSAMessage.ARG_ROUND, 0))
         # retire state from completed rounds (early-arrived shares for the
         # current/future rounds are kept)
-        self.received_shares = {r: v for r, v in self.received_shares.items()
-                                if r >= self.round_idx}
         with self._req_lock:
+            self.received_shares = {
+                r: v for r, v in self.received_shares.items()
+                if r >= self.round_idx}
             if (self._pending_agg_request is not None
                     and self._pending_agg_request[0] < self.round_idx):
                 self._clear_pending_request()
@@ -109,8 +114,9 @@ class LSAClientManager(FedMLCommManager):
         for j in range(n):
             peer_rank = j + 1
             if peer_rank == self.rank:
-                self.received_shares.setdefault(
-                    self.round_idx, {})[self.rank] = shares[j]
+                with self._req_lock:
+                    self.received_shares.setdefault(
+                        self.round_idx, {})[self.rank] = shares[j]
                 self._maybe_answer_agg_request()
                 continue
             share_msg = Message(LSAMessage.MSG_TYPE_C2C_ENCODED_MASK_SHARE,
@@ -128,8 +134,9 @@ class LSAClientManager(FedMLCommManager):
 
     def handle_share(self, msg: Message) -> None:
         rnd = int(msg.get(LSAMessage.ARG_ROUND, self.round_idx))
-        self.received_shares.setdefault(rnd, {})[msg.get_sender_id()] = \
-            np.asarray(msg.get(LSAMessage.ARG_SHARE), np.int64)
+        with self._req_lock:
+            self.received_shares.setdefault(rnd, {})[msg.get_sender_id()] = \
+                np.asarray(msg.get(LSAMessage.ARG_SHARE), np.int64)
         self._maybe_answer_agg_request()
 
     def handle_agg_request(self, msg: Message) -> None:
@@ -145,11 +152,12 @@ class LSAClientManager(FedMLCommManager):
         self._maybe_answer_agg_request()
 
     def _clear_pending_request(self) -> None:
-        """Caller holds ``_req_lock``."""
-        self._pending_agg_request = None
-        if self._req_timer is not None:
-            self._req_timer.cancel()
-            self._req_timer = None
+        # _req_lock is reentrant — callers hold it already
+        with self._req_lock:
+            self._pending_agg_request = None
+            if self._req_timer is not None:
+                self._req_timer.cancel()
+                self._req_timer = None
 
     def _maybe_answer_agg_request(self) -> None:
         """Answer the server's aggregate-mask request once every

@@ -264,37 +264,38 @@ class FedMLServerManager(FedMLCommManager):
         clients must not retrain, and the missing ones are re-solicited
         individually as they re-announce (status/heartbeat → late-join
         catch-up) or by the elastic round timer for silent survivors."""
-        if self.args.round_idx >= self.round_num:
-            logging.warning(
-                "server: checkpoint says the run already completed "
-                "(round %d/%d) — broadcasting FINISH and exiting",
-                self.args.round_idx, self.round_num)
-            self.send_finish_to_all()
-            mlops.log_aggregation_status("FINISHED")
-            self.finish()
-            return
-        mlops.log_aggregation_status("RUNNING")
-        self._run_span = tracing.start_span(
-            "fed_run", run_id=self._run_label, rounds=self.round_num,
-            resumed_at=int(self.args.round_idx))
-        self.is_initialized = True
-        self.client_id_list_in_this_round = self.aggregator.client_sampling(
-            self.args.round_idx, int(self.args.client_num_in_total),
-            self._cohort_size())
-        self.data_silo_index_of_client = self.aggregator.data_silo_selection(
-            self.args.round_idx, int(self.args.client_num_in_total),
-            len(self.client_id_list_in_this_round))
-        self._open_round_span()
-        self._arm_round_timer()
-        self._arm_deadline_timer()
-        if self.aggregator.check_whether_all_receive():
-            # the crash hit AFTER the last upload was persisted but BEFORE
-            # aggregation: no client is missing, so no upload will ever
-            # re-trigger completion — aggregate now
-            logging.warning("server: resumed round %d already has every "
-                            "result — aggregating immediately",
-                            self.args.round_idx)
-            self._complete_round()
+        with self._round_lock:
+            if self.args.round_idx >= self.round_num:
+                logging.warning(
+                    "server: checkpoint says the run already completed "
+                    "(round %d/%d) — broadcasting FINISH and exiting",
+                    self.args.round_idx, self.round_num)
+                self.send_finish_to_all()
+                mlops.log_aggregation_status("FINISHED")
+                self.finish()
+                return
+            mlops.log_aggregation_status("RUNNING")
+            self._run_span = tracing.start_span(
+                "fed_run", run_id=self._run_label, rounds=self.round_num,
+                resumed_at=int(self.args.round_idx))
+            self.is_initialized = True
+            self.client_id_list_in_this_round = self.aggregator.client_sampling(
+                self.args.round_idx, int(self.args.client_num_in_total),
+                self._cohort_size())
+            self.data_silo_index_of_client = self.aggregator.data_silo_selection(
+                self.args.round_idx, int(self.args.client_num_in_total),
+                len(self.client_id_list_in_this_round))
+            self._open_round_span()
+            self._arm_round_timer()
+            self._arm_deadline_timer()
+            if self.aggregator.check_whether_all_receive():
+                # the crash hit AFTER the last upload was persisted but BEFORE
+                # aggregation: no client is missing, so no upload will ever
+                # re-trigger completion — aggregate now
+                logging.warning("server: resumed round %d already has every "
+                                "result — aggregating immediately",
+                                self.args.round_idx)
+                self._complete_round()
 
     def _persist_round_state(self) -> None:
         """Checkpoint the in-flight round (called on every accepted upload
@@ -402,56 +403,57 @@ class FedMLServerManager(FedMLCommManager):
         heartbeat from a client already known online and merely still
         training must NOT re-send it the round model (that would cost a
         redundant full training pass per client per round)."""
-        if self._finishing:
-            # the run is over: a late (re)announce — e.g. after a resumed
-            # server found the checkpointed run already complete — must not
-            # restart training or solicit dead peers
-            return
-        self._last_seen[sender] = time.monotonic()
-        was_online = self.client_online_status.get(sender)
-        self.client_online_status[sender] = True
-        if was_online is False:
-            logging.warning("server: client %d rejoined after being "
-                            "declared dead", sender)
-        if not (announce or was_online is not True):
-            return
-        if not announce and sender in self._deadline_dropped:
-            # dropped by the deadline pacer for SLOWNESS, not death: the
-            # client is alive and already holds the current broadcast in
-            # its queue — a catch-up re-send would cost it a duplicate
-            # training pass.  An explicit ONLINE announce (restarted
-            # process, empty queue) still takes the catch-up path below.
+        with self._round_lock:
+            if self._finishing:
+                # the run is over: a late (re)announce — e.g. after a resumed
+                # server found the checkpointed run already complete — must not
+                # restart training or solicit dead peers
+                return
+            self._last_seen[sender] = time.monotonic()
+            was_online = self.client_online_status.get(sender)
+            self.client_online_status[sender] = True
+            if was_online is False:
+                logging.warning("server: client %d rejoined after being "
+                                "declared dead", sender)
+            if not (announce or was_online is not True):
+                return
+            if not announce and sender in self._deadline_dropped:
+                # dropped by the deadline pacer for SLOWNESS, not death: the
+                # client is alive and already holds the current broadcast in
+                # its queue — a catch-up re-send would cost it a duplicate
+                # training pass.  An explicit ONLINE announce (restarted
+                # process, empty queue) still takes the catch-up path below.
+                self._deadline_dropped.discard(sender)
+                return
             self._deadline_dropped.discard(sender)
-            return
-        self._deadline_dropped.discard(sender)
-        if not self.is_initialized:
-            if len(self.client_online_status) == self.client_num:
-                self._start_training()
-            elif self.round_timeout_s > 0 and self._init_timer is None:
-                # elastic init: don't block forever on a client that
-                # never comes online — force-start after the timeout
-                # once ≥ min clients are here
-                self._init_timer = threading.Timer(
-                    self.round_timeout_s, self._maybe_force_init)
-                self._init_timer.daemon = True
-                self._init_timer.start()
-        else:
-            # elastic late join / rejoin: a (re)connecting client that
-            # hasn't uploaded this round is re-admitted with the round's
-            # current global model — at most ONCE per round (a duplicated
-            # re-announce must not trigger a redundant full training pass;
-            # lost syncs are covered by the timeout's re-solicitation)
-            if (sender in self._ranks_for(
-                    self.client_id_list_in_this_round)
-                    and sender not in self._caught_up_this_round
-                    and not self.aggregator.has_received(sender - 1)):
-                logging.info("server: late-joining client %d caught up "
-                             "into round %d", sender, self.args.round_idx)
-                self._caught_up_this_round.add(sender)
-                ledger.event("server", "late_join",
-                             round_idx=int(self.args.round_idx),
-                             client=sender)
-                self._broadcast_round(only_rank=sender)
+            if not self.is_initialized:
+                if len(self.client_online_status) == self.client_num:
+                    self._start_training()
+                elif self.round_timeout_s > 0 and self._init_timer is None:
+                    # elastic init: don't block forever on a client that
+                    # never comes online — force-start after the timeout
+                    # once ≥ min clients are here
+                    self._init_timer = threading.Timer(
+                        self.round_timeout_s, self._maybe_force_init)
+                    self._init_timer.daemon = True
+                    self._init_timer.start()
+            else:
+                # elastic late join / rejoin: a (re)connecting client that
+                # hasn't uploaded this round is re-admitted with the round's
+                # current global model — at most ONCE per round (a duplicated
+                # re-announce must not trigger a redundant full training pass;
+                # lost syncs are covered by the timeout's re-solicitation)
+                if (sender in self._ranks_for(
+                        self.client_id_list_in_this_round)
+                        and sender not in self._caught_up_this_round
+                        and not self.aggregator.has_received(sender - 1)):
+                    logging.info("server: late-joining client %d caught up "
+                                 "into round %d", sender, self.args.round_idx)
+                    self._caught_up_this_round.add(sender)
+                    ledger.event("server", "late_join",
+                                 round_idx=int(self.args.round_idx),
+                                 client=sender)
+                    self._broadcast_round(only_rank=sender)
 
     def _maybe_force_init(self) -> None:
         with self._round_lock:
@@ -471,34 +473,37 @@ class FedMLServerManager(FedMLCommManager):
                 self._init_timer.start()
 
     def _start_training(self) -> None:
-        mlops.log_aggregation_status("RUNNING")
-        self._run_span = tracing.start_span(
-            "fed_run", run_id=self._run_label, rounds=self.round_num)
-        self.is_initialized = True
-        self._persist_round_state()   # round-0 anchor for crash-resume
-        self.send_init_msg()
+        with self._round_lock:
+            mlops.log_aggregation_status("RUNNING")
+            self._run_span = tracing.start_span(
+                "fed_run", run_id=self._run_label, rounds=self.round_num)
+            self.is_initialized = True
+            self._persist_round_state()   # round-0 anchor for crash-resume
+            self.send_init_msg()
 
     def _open_round_span(self) -> None:
-        parent = self._run_span.ctx if self._run_span else None
-        self._round_span = tracing.start_span(
-            "train_round", parent=parent, round=int(self.args.round_idx))
-        _current_round.labels(run_id=self._run_label).set(
-            int(self.args.round_idx))
-        ledger.event("server", "round_start",
-                     round_idx=int(self.args.round_idx),
-                     expected=len(self.client_id_list_in_this_round))
+        with self._round_lock:
+            parent = self._run_span.ctx if self._run_span else None
+            self._round_span = tracing.start_span(
+                "train_round", parent=parent, round=int(self.args.round_idx))
+            _current_round.labels(run_id=self._run_label).set(
+                int(self.args.round_idx))
+            ledger.event("server", "round_start",
+                         round_idx=int(self.args.round_idx),
+                         expected=len(self.client_id_list_in_this_round))
 
     def send_init_msg(self) -> None:
-        self.client_id_list_in_this_round = self.aggregator.client_sampling(
-            self.args.round_idx, int(self.args.client_num_in_total),
-            self._cohort_size())
-        self.data_silo_index_of_client = self.aggregator.data_silo_selection(
-            self.args.round_idx, int(self.args.client_num_in_total),
-            len(self.client_id_list_in_this_round))
-        self._open_round_span()
-        self._broadcast_round()
-        self._arm_round_timer()
-        self._arm_deadline_timer()
+        with self._round_lock:
+            self.client_id_list_in_this_round = self.aggregator.client_sampling(
+                self.args.round_idx, int(self.args.client_num_in_total),
+                self._cohort_size())
+            self.data_silo_index_of_client = self.aggregator.data_silo_selection(
+                self.args.round_idx, int(self.args.client_num_in_total),
+                len(self.client_id_list_in_this_round))
+            self._open_round_span()
+            self._broadcast_round()
+            self._arm_round_timer()
+            self._arm_deadline_timer()
 
     def _link_codec(self, rank: int) -> bool:
         """True when this link negotiated the configured wire codec (the
@@ -519,7 +524,8 @@ class FedMLServerManager(FedMLCommManager):
         async manager versions these).  ``ref`` is what a CODEC link
         computes deltas against (the decoded broadcast); ``raw`` is the
         unencoded global a legacy/raw link received (defaults to ref)."""
-        self._round_ref = ref
+        with self._round_lock:
+            self._round_ref = ref
 
     def _broadcast_round(self, only_rank=None) -> None:
         """Send the current round's model to every participating rank (or
@@ -530,64 +536,65 @@ class FedMLServerManager(FedMLCommManager):
         With wire compression negotiated, capable links receive the
         quantized model plus their uplink codec assignment; the DECODED
         broadcast becomes the round's delta reference on both ends."""
-        from ...utils.serialization import estimate_nbytes
+        with self._round_lock:
+            from ...utils.serialization import estimate_nbytes
 
-        only = (None if only_rank is None
-                else {only_rank} if isinstance(only_rank, int)
-                else set(only_rank))
-        mtype = (MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT
-                 if self.args.round_idx else
-                 MyMessage.MSG_TYPE_S2C_INIT_CONFIG)
-        global_model = self.aggregator.get_global_model_params()
-        enc_payload = None
-        if self._wire_spec is not None:
-            from ...utils.compression import WireCodec
+            only = (None if only_rank is None
+                    else {only_rank} if isinstance(only_rank, int)
+                    else set(only_rank))
+            mtype = (MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT
+                     if self.args.round_idx else
+                     MyMessage.MSG_TYPE_S2C_INIT_CONFIG)
+            global_model = self.aggregator.get_global_model_params()
+            enc_payload = None
+            if self._wire_spec is not None:
+                from ...utils.compression import WireCodec
 
-            version = int(self.args.round_idx)
-            if self._enc_cache is not None and self._enc_cache[0] == version:
-                _, enc_payload, decoded = self._enc_cache
+                version = int(self.args.round_idx)
+                if self._enc_cache is not None and self._enc_cache[0] == version:
+                    _, enc_payload, decoded = self._enc_cache
+                else:
+                    enc_payload = WireCodec.encode_model(
+                        global_model,
+                        "bf16" if self._wire_spec.kind == "bf16" else "int8")
+                    decoded = WireCodec.decode_model(enc_payload)
+                    self._enc_cache = (version, enc_payload, decoded)
+                self._note_round_ref(decoded, raw=global_model)
             else:
-                enc_payload = WireCodec.encode_model(
-                    global_model,
-                    "bf16" if self._wire_spec.kind == "bf16" else "int8")
-                decoded = WireCodec.decode_model(enc_payload)
-                self._enc_cache = (version, enc_payload, decoded)
-            self._note_round_ref(decoded, raw=global_model)
-        else:
-            self._note_round_ref(global_model)
-        with flight_recorder.phase("comm", program="server/broadcast"):
-            for i, rank in enumerate(
-                    self._ranks_for(self.client_id_list_in_this_round)):
-                if only is not None and rank not in only:
-                    continue
-                use_codec = enc_payload is not None and self._link_codec(rank)
-                msg = Message(mtype, self.get_sender_id(), rank)
-                msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
-                               enc_payload if use_codec else global_model)
-                if use_codec:
-                    msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_ENCODED, True)
-                    msg.add_params(MyMessage.MSG_ARG_KEY_WIRE_CODEC,
-                                   str(getattr(self.args, "wire_compression")))
-                nbytes = estimate_nbytes(
-                    enc_payload if use_codec else global_model)
-                _wire_bytes.labels(
-                    run_id=self._run_label, direction="down",
-                    codec=(self._wire_spec.kind if use_codec
-                           else "raw")).inc(nbytes)
-                flight_recorder.note_transfer("comm", nbytes)
-                ledger.event("server", "solicit",
-                             round_idx=int(self.args.round_idx),
-                             client=rank, nbytes=int(nbytes),
-                             codec=(self._wire_spec.kind if use_codec
-                                    else "raw"))
-                msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
-                               self.client_id_list_in_this_round[i])
-                msg.add_params(MyMessage.MSG_ARG_KEY_ROUND,
-                               self.args.round_idx)
-                if self._round_span is not None:
-                    msg.add_params(MyMessage.MSG_ARG_KEY_TRACE_CTX,
-                                   tracing.inject(self._round_span.ctx))
-                self.send_message(msg)
+                self._note_round_ref(global_model)
+            with flight_recorder.phase("comm", program="server/broadcast"):
+                for i, rank in enumerate(
+                        self._ranks_for(self.client_id_list_in_this_round)):
+                    if only is not None and rank not in only:
+                        continue
+                    use_codec = enc_payload is not None and self._link_codec(rank)
+                    msg = Message(mtype, self.get_sender_id(), rank)
+                    msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                                   enc_payload if use_codec else global_model)
+                    if use_codec:
+                        msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_ENCODED, True)
+                        msg.add_params(MyMessage.MSG_ARG_KEY_WIRE_CODEC,
+                                       str(getattr(self.args, "wire_compression")))
+                    nbytes = estimate_nbytes(
+                        enc_payload if use_codec else global_model)
+                    _wire_bytes.labels(
+                        run_id=self._run_label, direction="down",
+                        codec=(self._wire_spec.kind if use_codec
+                               else "raw")).inc(nbytes)
+                    flight_recorder.note_transfer("comm", nbytes)
+                    ledger.event("server", "solicit",
+                                 round_idx=int(self.args.round_idx),
+                                 client=rank, nbytes=int(nbytes),
+                                 codec=(self._wire_spec.kind if use_codec
+                                        else "raw"))
+                    msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
+                                   self.client_id_list_in_this_round[i])
+                    msg.add_params(MyMessage.MSG_ARG_KEY_ROUND,
+                                   self.args.round_idx)
+                    if self._round_span is not None:
+                        msg.add_params(MyMessage.MSG_ARG_KEY_TRACE_CTX,
+                                       tracing.inject(self._round_span.ctx))
+                    self.send_message(msg)
 
     # -- elastic round timeout ----------------------------------------------
     def _arm_round_timer(self) -> None:
@@ -633,9 +640,10 @@ class FedMLServerManager(FedMLCommManager):
         """True when this rank's uploads were quarantined this round AND
         its re-solicit budget is spent — nothing further is expected from
         it until the next round.  Caller holds ``_round_lock``."""
-        return ((rank - 1) in self.aggregator.quarantined_this_round
-                and self._quarantine_resolicits.get(rank, 0)
-                >= self._resolicit_max)
+        with self._round_lock:
+            return ((rank - 1) in self.aggregator.quarantined_this_round
+                    and self._quarantine_resolicits.get(rank, 0)
+                    >= self._resolicit_max)
 
     # -- deadline-paced rounds (straggler tolerance) -------------------------
     def _arm_deadline_timer(self, delay_s: Optional[float] = None) -> None:
@@ -799,23 +807,24 @@ class FedMLServerManager(FedMLCommManager):
         quarantined past its re-solicit budget is given up on for the
         round (its uploads will keep being rejected), so it must not hold
         the round open.  Caller holds ``_round_lock``."""
-        if (self.round_timeout_s <= 0 and self._hb_interval <= 0
-                and self.round_deadline_s <= 0
-                and not self.aggregator.admission_control):
-            return
-        ranks = set(self._ranks_for(self.client_id_list_in_this_round))
-        online = {r for r in ranks if self.client_online_status.get(r)
-                  and not self._quarantine_exhausted(r)}
-        if (online
-                and all(self.aggregator.has_received(r - 1) for r in online)
-                and self.aggregator.receive_count()
-                >= max(self.min_clients, self.min_agg_clients)):
-            logging.info(
-                "server: round %d — all %d online participants reported; "
-                "completing without waiting for %d offline",
-                self.args.round_idx, len(online), len(ranks - online))
-            self._round_close_reason = "early"
-            self._complete_round()
+        with self._round_lock:
+            if (self.round_timeout_s <= 0 and self._hb_interval <= 0
+                    and self.round_deadline_s <= 0
+                    and not self.aggregator.admission_control):
+                return
+            ranks = set(self._ranks_for(self.client_id_list_in_this_round))
+            online = {r for r in ranks if self.client_online_status.get(r)
+                      and not self._quarantine_exhausted(r)}
+            if (online
+                    and all(self.aggregator.has_received(r - 1) for r in online)
+                    and self.aggregator.receive_count()
+                    >= max(self.min_clients, self.min_agg_clients)):
+                logging.info(
+                    "server: round %d — all %d online participants reported; "
+                    "completing without waiting for %d offline",
+                    self.args.round_idx, len(online), len(ranks - online))
+                self._round_close_reason = "early"
+                self._complete_round()
 
     def _drain_requested(self) -> bool:
         """True once a pod drain signal (file or SIGUSR1) has been seen —
@@ -830,94 +839,95 @@ class FedMLServerManager(FedMLCommManager):
     def _complete_round(self) -> None:
         """Aggregate (possibly a partial set), test, advance or finish.
         Caller must hold ``_round_lock``."""
-        if self._round_timer is not None:
-            self._round_timer.cancel()
-        if self._deadline_timer is not None:
-            self._deadline_timer.cancel()
-        closed = getattr(self, "_round_close_reason", None) or "full"
-        self._round_close_reason = None
-        mlops.event("server.wait", False, self.args.round_idx)
-        n_reported = self.aggregator.receive_count()
-        # aggregation + eval run UNDER the round span's context so the
-        # aggregator's own spans nest into this round's trace subtree
-        with tracing.use_ctx(
-                self._round_span.ctx if self._round_span else None):
-            self.aggregator.aggregate()
-            freq = int(getattr(self.args, "frequency_of_the_test", 1) or 1)
-            if (self.args.round_idx % freq == 0
-                    or self.args.round_idx == self.round_num - 1):
-                self.aggregator.test_on_server_for_all_clients(
-                    self.args.round_idx)
-        _clients_reported.labels(run_id=self._run_label).set(n_reported)
-        _rounds_total.labels(run_id=self._run_label).inc()
-        losses = [m.get("train_loss")
-                  for m in self._round_train_metrics.values()
-                  if isinstance(m.get("train_loss"), (int, float))]
-        self._round_train_metrics = {}
-        if self._round_span is not None:
-            if losses:
-                self._round_span.set_attr(
-                    "mean_client_train_loss", sum(losses) / len(losses))
-            self._round_span.set_attr("clients_reported", n_reported)
-            _round_seconds.labels(run_id=self._run_label).observe(
-                self._round_span.end())
-            self._round_span = None
-        ledger.event("server", "round_close",
-                     round_idx=int(self.args.round_idx), closed=closed,
-                     reported=int(n_reported),
-                     expected=len(self.client_id_list_in_this_round))
-        slo.check_round_boundary(int(self.args.round_idx))
+        with self._round_lock:
+            if self._round_timer is not None:
+                self._round_timer.cancel()
+            if self._deadline_timer is not None:
+                self._deadline_timer.cancel()
+            closed = getattr(self, "_round_close_reason", None) or "full"
+            self._round_close_reason = None
+            mlops.event("server.wait", False, self.args.round_idx)
+            n_reported = self.aggregator.receive_count()
+            # aggregation + eval run UNDER the round span's context so the
+            # aggregator's own spans nest into this round's trace subtree
+            with tracing.use_ctx(
+                    self._round_span.ctx if self._round_span else None):
+                self.aggregator.aggregate()
+                freq = int(getattr(self.args, "frequency_of_the_test", 1) or 1)
+                if (self.args.round_idx % freq == 0
+                        or self.args.round_idx == self.round_num - 1):
+                    self.aggregator.test_on_server_for_all_clients(
+                        self.args.round_idx)
+            _clients_reported.labels(run_id=self._run_label).set(n_reported)
+            _rounds_total.labels(run_id=self._run_label).inc()
+            losses = [m.get("train_loss")
+                      for m in self._round_train_metrics.values()
+                      if isinstance(m.get("train_loss"), (int, float))]
+            self._round_train_metrics = {}
+            if self._round_span is not None:
+                if losses:
+                    self._round_span.set_attr(
+                        "mean_client_train_loss", sum(losses) / len(losses))
+                self._round_span.set_attr("clients_reported", n_reported)
+                _round_seconds.labels(run_id=self._run_label).observe(
+                    self._round_span.end())
+                self._round_span = None
+            ledger.event("server", "round_close",
+                         round_idx=int(self.args.round_idx), closed=closed,
+                         reported=int(n_reported),
+                         expected=len(self.client_id_list_in_this_round))
+            slo.check_round_boundary(int(self.args.round_idx))
 
-        self.args.round_idx += 1
-        # boundary checkpoint: next round index + freshly aggregated global
-        # params, received set cleared by aggregate()
-        self._persist_round_state()
-        if self.args.round_idx >= self.round_num:
-            ledger.event("server", "run_finish",
-                         round_idx=int(self.args.round_idx),
-                         rounds=int(self.round_num))
-            self.send_finish_to_all()
-            mlops.log_aggregation_status("FINISHED")
-            if self._run_span is not None:
-                self._run_span.end()
-                self._run_span = None
-            self.finish()
-            return
-        if self._drain_requested():
-            # preempted at this boundary: the round_idx checkpoint is
-            # queued on the writer and finish() drains it before exit, so
-            # the requeued dispatch resumes exactly here — no lost round,
-            # and the aggregator's received set is empty (no upload can
-            # be double-counted).  Clients get FINISH so the process tree
-            # winds down cleanly; resume re-launches the full cohort.
-            logging.info("################ DRAIN at round boundary %d — "
-                         "preempting (checkpoint saved)",
-                         self.args.round_idx)
-            self.args.preempted_at_round = int(self.args.round_idx)
-            _preempted_round.labels(run_id=self._run_label).set(
-                int(self.args.round_idx))
-            ledger.event("server", "preempt",
-                         round_idx=int(self.args.round_idx))
-            self.send_finish_to_all()
-            mlops.log_aggregation_status("PREEMPTED")
-            if self._run_span is not None:
-                self._run_span.set_attr(
-                    "preempted_at_round", int(self.args.round_idx))
-                self._run_span.end()
-                self._run_span = None
-            self.finish()
-            return
-        # next round
-        self._caught_up_this_round = set()
-        self._quarantine_resolicits = {}
-        self.client_id_list_in_this_round = self.aggregator.client_sampling(
-            self.args.round_idx, int(self.args.client_num_in_total),
-            self._cohort_size())
-        mlops.event("server.wait", True, self.args.round_idx)
-        self._open_round_span()
-        self._broadcast_round()
-        self._arm_round_timer()
-        self._arm_deadline_timer()
+            self.args.round_idx += 1
+            # boundary checkpoint: next round index + freshly aggregated global
+            # params, received set cleared by aggregate()
+            self._persist_round_state()
+            if self.args.round_idx >= self.round_num:
+                ledger.event("server", "run_finish",
+                             round_idx=int(self.args.round_idx),
+                             rounds=int(self.round_num))
+                self.send_finish_to_all()
+                mlops.log_aggregation_status("FINISHED")
+                if self._run_span is not None:
+                    self._run_span.end()
+                    self._run_span = None
+                self.finish()
+                return
+            if self._drain_requested():
+                # preempted at this boundary: the round_idx checkpoint is
+                # queued on the writer and finish() drains it before exit, so
+                # the requeued dispatch resumes exactly here — no lost round,
+                # and the aggregator's received set is empty (no upload can
+                # be double-counted).  Clients get FINISH so the process tree
+                # winds down cleanly; resume re-launches the full cohort.
+                logging.info("################ DRAIN at round boundary %d — "
+                             "preempting (checkpoint saved)",
+                             self.args.round_idx)
+                self.args.preempted_at_round = int(self.args.round_idx)
+                _preempted_round.labels(run_id=self._run_label).set(
+                    int(self.args.round_idx))
+                ledger.event("server", "preempt",
+                             round_idx=int(self.args.round_idx))
+                self.send_finish_to_all()
+                mlops.log_aggregation_status("PREEMPTED")
+                if self._run_span is not None:
+                    self._run_span.set_attr(
+                        "preempted_at_round", int(self.args.round_idx))
+                    self._run_span.end()
+                    self._run_span = None
+                self.finish()
+                return
+            # next round
+            self._caught_up_this_round = set()
+            self._quarantine_resolicits = {}
+            self.client_id_list_in_this_round = self.aggregator.client_sampling(
+                self.args.round_idx, int(self.args.client_num_in_total),
+                self._cohort_size())
+            mlops.event("server.wait", True, self.args.round_idx)
+            self._open_round_span()
+            self._broadcast_round()
+            self._arm_round_timer()
+            self._arm_deadline_timer()
 
     def send_finish_to_all(self) -> None:
         for rank in range(1, self.client_num + 1):

@@ -37,6 +37,7 @@ from ..core.distributed.communication.mqtt_s3.mqtt_s3_comm_manager import (
 from ..core.distributed.communication.mqtt_s3.remote_storage import (
     create_store,
 )
+from ..core.mlops.lock_profiler import named_lock
 from . import local_launcher
 
 
@@ -131,11 +132,11 @@ class SlaveAgent:
         # guards _cancelled/_job_threads/_procs: the broker callback
         # thread (_on_start/_on_stop) races every _run_job thread's
         # check-then-act on them (CONC001)
-        self._state_lock = threading.Lock()
+        self._state_lock = named_lock("SlaveAgent._state_lock")
         # OTA state (reference client_runner.py:852 OTA upgrade + :1436
         # message replay after upgrade); _ota_lock serializes the
         # buffered-vs-replay decision against concurrent _on_start calls
-        self._ota_lock = threading.Lock()
+        self._ota_lock = named_lock("SlaveAgent._ota_lock")
         self._upgrading = False
         self._replay_buffer: List[bytes] = []
         self.version = self._load_version()
@@ -165,7 +166,9 @@ class SlaveAgent:
 
     def stop(self) -> None:
         self._stop.set()
-        for run_id in list(self._procs):
+        with self._state_lock:
+            run_ids = list(self._procs)
+        for run_id in run_ids:
             self._kill_run(run_id)
         # release subscriptions so a stopped agent never picks up work and a
         # restarted one doesn't double-execute
@@ -179,7 +182,9 @@ class SlaveAgent:
         # and the heartbeat too, which now reads the db per tick
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=self.heartbeat_s + 5.0)
-        for t in list(self._job_threads.values()):
+        with self._state_lock:
+            job_threads = list(self._job_threads.values())
+        for t in job_threads:
             t.join(timeout=15.0)
         self.resources.close()
 
@@ -441,7 +446,8 @@ class SlaveAgent:
         self._kill_run(run_id)
 
     def _kill_run(self, run_id: str) -> None:
-        proc = self._procs.get(run_id)
+        with self._state_lock:
+            proc = self._procs.get(run_id)
         if proc is not None and proc.poll() is None:
             self._report(run_id, ClientConstants.STATUS_STOPPING)
             import signal
@@ -464,7 +470,7 @@ class MasterAgent:
         self._status: Dict[str, Dict[str, Dict[str, Any]]] = {}
         self._events: Dict[str, threading.Event] = {}
         self._edges: Dict[str, List[str]] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("MasterAgent._lock")
         #: fleet registry built from the shared active stream — the
         #: backend-side resource matcher's view of the world
         self._fleet: Dict[str, Dict[str, Any]] = {}

@@ -17,6 +17,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ...core.mlops.lock_profiler import named_lock
 from .jobspec import JobSpec, JobState
 
 _COLUMNS = (
@@ -46,7 +47,7 @@ class JobQueue:
         self._conn = sqlite3.connect(self.path, check_same_thread=False,
                                      isolation_level=None, timeout=10.0)
         self._conn.execute("PRAGMA journal_mode=WAL")
-        self._lock = threading.Lock()
+        self._lock = named_lock("JobQueue._lock")
         with self._lock:
             self._conn.execute(
                 "CREATE TABLE IF NOT EXISTS jobs ("

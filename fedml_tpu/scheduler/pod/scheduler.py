@@ -31,6 +31,7 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 from ...core.mlops import ledger, metrics
+from ...core.mlops.lock_profiler import named_lock
 from ..resource_db import ComputeResourceDB
 from .allocator import GangAllocator
 from .jobspec import PREEMPTED_EXIT_CODE, JobState
@@ -73,7 +74,7 @@ class PodScheduler:
         self.drain_grace_s = float(drain_grace_s)
         self.serving_scaler = serving_scaler
         self.aot_cache_dir = os.path.join(queue.root, "aot_cache")
-        self._lock = threading.Lock()
+        self._lock = named_lock("PodScheduler._lock")
         self._handles: Dict[str, Any] = {}
         self._reservations: Dict[str, int] = {}
         self._drain_started: Dict[str, float] = {}

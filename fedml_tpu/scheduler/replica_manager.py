@@ -26,6 +26,8 @@ import time
 import urllib.request
 from typing import Any, Dict, List, Optional
 
+from ..core.mlops.lock_profiler import named_rlock
+
 
 class _Replica:
     def __init__(self, proc: subprocess.Popen, port: int) -> None:
@@ -48,8 +50,8 @@ class ReplicaProcessManager:
         self.monitor_interval_s = float(monitor_interval_s)
         self.replicas: List[Optional[_Replica]] = []
         self._rr = 0
-        self._lock = threading.RLock()       # replica-list access (fast)
-        self._scale_lock = threading.RLock()  # lifecycle ops (slow)
+        self._lock = named_rlock("ReplicaProcessManager._lock")       # replica-list access (fast)
+        self._scale_lock = named_rlock("ReplicaProcessManager._scale_lock")  # lifecycle ops (slow)
         self._stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
 
@@ -130,10 +132,13 @@ class ReplicaProcessManager:
         return self.live_count()
 
     def _first_free_slot(self) -> int:
-        for i, r in enumerate(self.replicas):
-            if r is None:
-                return i
-        return len(self.replicas)
+        # _lock is an RLock: scale_to calls this with it already held,
+        # and taking it here keeps the scan safe for any future caller
+        with self._lock:
+            for i, r in enumerate(self.replicas):
+                if r is None:
+                    return i
+            return len(self.replicas)
 
     @staticmethod
     def _kill(rep: _Replica) -> None:
@@ -145,8 +150,11 @@ class ReplicaProcessManager:
                 rep.proc.kill()
 
     def live_count(self) -> int:
-        return sum(1 for r in self.replicas
-                   if r is not None and r.proc.poll() is None)
+        # snapshot under the gateway lock: the monitor and scale threads
+        # mutate the slot list concurrently
+        with self._lock:
+            return sum(1 for r in self.replicas
+                       if r is not None and r.proc.poll() is None)
 
     def rolling_restart(self) -> None:
         """Restart replicas ONE AT A TIME (version rollout/rollback: each
@@ -243,10 +251,11 @@ class ReplicaProcessManager:
         raise RuntimeError("predict failed on all tried replicas")
 
     def stats(self) -> Dict[str, Any]:
-        return {"live": self.live_count(),
-                "slots": len(self.replicas),
-                "restarts": sum(r.restarts for r in self.replicas
-                                if r is not None)}
+        with self._lock:
+            return {"live": self.live_count(),
+                    "slots": len(self.replicas),
+                    "restarts": sum(r.restarts for r in self.replicas
+                                    if r is not None)}
 
     def shutdown(self) -> None:
         self._stop.set()

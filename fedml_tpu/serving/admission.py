@@ -28,6 +28,8 @@ import threading
 import time
 from typing import Optional, Tuple
 
+from ..core.mlops.lock_profiler import named_lock
+
 
 class ShedError(RuntimeError):
     """A request refused admission by the serving admission policy.
@@ -64,7 +66,7 @@ class ServingAdmissionController:
         self.ttft_budget_s = None if ttft_budget_s is None \
             else float(ttft_budget_s)
         self.window_s = float(window_s)
-        self._lock = threading.Lock()
+        self._lock = named_lock("AdmissionController._lock")
         self._finish_ts: "collections.deque[float]" = collections.deque(
             maxlen=1024)
         self._shed = 0

@@ -28,6 +28,7 @@ import numpy as np
 
 from ..core.mlops import flight_recorder, ledger, tracing
 from ..core.mlops import metrics as _metrics
+from ..core.mlops.lock_profiler import named_lock
 from .admission import ServingAdmissionController, ShedError
 
 #: request-id stream (one per process): every request carries ``rid``
@@ -110,7 +111,7 @@ class _EngineMetrics:
             "KV-cache positions in use across active slots, sampled on "
             "the engine loop", labels=("engine",)).labels(
                 engine=engine_label)
-        self._decode_lock = threading.Lock()
+        self._decode_lock = named_lock("_EngineMetrics._decode_lock")
         self._decode_steps = 0
         self._decode_secs = 0.0
 
@@ -350,7 +351,7 @@ class BatchedLLMEngine:
         self._metrics = _EngineMetrics("batched")
         #: guards loop-mutated counters that stats() snapshots from other
         #: threads (the autoscaler + load report read while the loop writes)
-        self._state_lock = threading.Lock()
+        self._state_lock = named_lock("BatchedLLMEngine._state_lock")
         self._tokens_done = 0
         self._t_start = time.monotonic()
 
@@ -676,7 +677,7 @@ class KVCacheLLMEngine:
         self._rng_key = jax.random.PRNGKey(13)
         #: guards loop-mutated counters that stats() snapshots from other
         #: threads (the autoscaler + load report read while the loop writes)
-        self._state_lock = threading.Lock()
+        self._state_lock = named_lock("KVCacheLLMEngine._state_lock")
         self._tokens_done = 0
         self._t_start = time.monotonic()
         self._metrics = _EngineMetrics("kv")

@@ -46,6 +46,7 @@ from ...constants import (
 )
 from ...core import mlops
 from ...core.mlops import flight_recorder
+from ...core.mlops.lock_profiler import named_lock
 from ...ml.aggregator.agg_operator import agg_stacked
 from ...ml.aggregator.robust import parse_robust_agg, robust_agg_stacked
 from ...ops import epilogue as _epilogue
@@ -433,6 +434,10 @@ class ParrotAPI:
         #: warm pool (compile-ahead): {tag: {hit, seconds}} per executable
         #: precompiled/cache-loaded in the background; empty until started
         self._compile_ahead_thread: Optional[threading.Thread] = None
+        #: guards compile_ahead_report and the start-once check-then-act:
+        #: the warm-pool worker fills the report while the main thread
+        #: reads it (and two concurrent starters must not spawn two pools)
+        self._ca_lock = named_lock("ParrotAPI._ca_lock")
         self.compile_ahead_report: Dict[str, Any] = {}
         if self.compile_ahead_enabled():
             self.start_compile_ahead()
@@ -1002,36 +1007,59 @@ class ParrotAPI:
 
         Returns ``compile_ahead_report`` — ``{tag: {hit, seconds}}``,
         fully populated once the worker finishes (``wait=True`` blocks)."""
-        t = self._compile_ahead_thread
-        if t is not None:
-            if wait:
-                t.join()
-            return self.compile_ahead_report
-        t = threading.Thread(target=self._compile_ahead_worker,
-                             name="parrot-compile-ahead", daemon=True)
-        self._compile_ahead_thread = t
-        t.start()
+        with self._ca_lock:
+            # start-once under the lock: two concurrent starters (e.g. an
+            # eager __init__ and an explicit warm-up call) must not spawn
+            # two pools compiling the same executables
+            t = self._compile_ahead_thread
+            if t is None:
+                t = threading.Thread(target=self._compile_ahead_worker,
+                                     name="parrot-compile-ahead",
+                                     daemon=True)
+                self._compile_ahead_thread = t
+                t.start()
         if wait:
             t.join()
-        return self.compile_ahead_report
+        with self._ca_lock:
+            # snapshot: the worker may still be appending to the live dict
+            return dict(self.compile_ahead_report)
+
+    def _note_compile_ahead(self, tag: str, entry: Any) -> None:
+        with self._ca_lock:
+            self.compile_ahead_report[tag] = entry
+
+    def join_compile_ahead(self, timeout: Optional[float] = None) -> None:
+        """Wait out the warm pool (no-op when never started).  Called on
+        every train() exit path so the compile thread cannot outlive the
+        run — a daemon thread killed at interpreter exit can die mid
+        AOT-cache write and leave a torn cache entry for the next
+        process to load."""
+        t = self._compile_ahead_thread
+        if t is None or not t.is_alive():
+            return
+        t.join(timeout=timeout)
+        if t.is_alive():
+            logging.warning(
+                "parrot: compile-ahead worker still running after %ss — "
+                "continuing without it", timeout)
 
     def _compile_ahead_worker(self) -> None:
-        rep = self.compile_ahead_report
         try:
-            rep["brs" if self.n_buckets > 1 else "rs"] = \
-                self._warm_step("brs" if self.n_buckets > 1 else "rs")
+            tag = "brs" if self.n_buckets > 1 else "rs"
+            self._note_compile_ahead(tag, self._warm_step(tag))
             t0 = time.perf_counter()
             with flight_recorder.phase("compile_ahead",
                                        program="parrot/fused_round_scan"):
                 self._build_or_load_multi_round_step()
-            rep["mrs"] = {"hit": bool(self.aot_cache_hit),
-                          "seconds": round(time.perf_counter() - t0, 3)}
+            self._note_compile_ahead(
+                "mrs", {"hit": bool(self.aot_cache_hit),
+                        "seconds": round(time.perf_counter() - t0, 3)})
             if self.program_costs is None and not self._fused_is_plain_jit:
                 self.program_costs = flight_recorder.note_program(
                     "parrot/fused_round_scan", self.multi_round_step,
                     chunk_rounds=self.FUSED_CHUNK_ROUNDS)
         except Exception as e:  # warm pool must never take the run down
-            rep["error"] = str(e)
+            self._note_compile_ahead("error", str(e))
             logging.warning("parrot: compile-ahead worker failed (%s)", e)
 
     def _warm_step(self, tag: str) -> Dict[str, Any]:
@@ -1209,8 +1237,14 @@ class ParrotAPI:
                                 replace=False).astype(np.int32)
 
     def train(self) -> Dict[str, Any]:
-        if getattr(self.args, "fused_rounds", False):
-            return self._train_fused()
+        try:
+            if getattr(self.args, "fused_rounds", False):
+                return self._train_fused()
+            return self._train_rounds()
+        finally:
+            self.join_compile_ahead(timeout=60.0)
+
+    def _train_rounds(self) -> Dict[str, Any]:
         comm_rounds = int(self.args.comm_round)
         rng = jax.random.PRNGKey(
             int(getattr(self.args, "random_seed", 0) or 0) + 17)

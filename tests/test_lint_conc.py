@@ -1,0 +1,327 @@
+"""fedml lint --conc: the concurrency tier (CONC002-CONC006), its
+noqa/fingerprint/baseline integration, and the lock-order ratchet."""
+
+from __future__ import annotations
+
+import json
+import textwrap
+
+from fedml_tpu.analysis import run_cli, run_lint
+from fedml_tpu.analysis.baseline import load_baseline
+from fedml_tpu.analysis.conc.lockorder import (collect_edges, load_order,
+                                               order_path, write_order)
+from fedml_tpu.analysis.findings import fingerprints
+
+
+def _write(tmp_path, relpath: str, source: str):
+    f = tmp_path / relpath
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(source))
+    return f
+
+
+def _lint(tmp_path, rules):
+    return run_lint(root=tmp_path, rule_ids=rules)
+
+
+def _ids(result):
+    return [f.rule_id for f in result.findings]
+
+
+# -- CONC002: lockset inference ----------------------------------------------
+
+CONC002_RACY = """\
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+            self._t = threading.Thread(target=self._loop, daemon=True)
+            self._t.start()
+
+        def _loop(self):
+            while True:
+                with self._lock:
+                    self.count += 1
+
+        def bump(self):
+            with self._lock:
+                self.count += 1
+
+        def peek(self):
+            return self.count
+"""
+
+
+def test_conc002_fires_on_unguarded_access(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", CONC002_RACY)
+    res = _lint(tmp_path, ["CONC002"])
+    assert _ids(res) == ["CONC002"]
+    msg = res.findings[0].message
+    assert "Counter._lock" in msg and "peek" in msg
+
+
+def test_conc002_silent_when_every_access_locked(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", CONC002_RACY.replace(
+        "        def peek(self):\n            return self.count",
+        "        def peek(self):\n            with self._lock:\n"
+        "                return self.count"))
+    assert _ids(_lint(tmp_path, ["CONC002"])) == []
+
+
+def test_conc002_silent_for_init_only_fields(tmp_path):
+    # a field only ever STORED in __init__ (config knob) cannot race —
+    # concurrent reads of construction-time state are safe
+    _write(tmp_path, "fedml_tpu/mod.py", """\
+        import threading
+
+        class Svc:
+            def __init__(self, rank):
+                self._lock = threading.Lock()
+                self.rank = rank
+                self._t = threading.Thread(target=self._loop)
+
+            def _loop(self):
+                with self._lock:
+                    a = self.rank
+                with self._lock:
+                    b = self.rank
+                return a + b
+
+            def who(self):
+                return self.rank
+    """)
+    assert _ids(_lint(tmp_path, ["CONC002"])) == []
+
+
+# -- CONC003: lock-order graph + ratchet -------------------------------------
+
+NESTED = """\
+    import threading
+
+    class Pair:
+        def __init__(self):
+            self.a = threading.Lock()
+            self.b = threading.Lock()
+
+        def both(self):
+            with self.a:
+                with self.b:
+                    pass
+"""
+
+
+def test_conc003_new_edge_flagged_until_committed(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", NESTED)
+    res = _lint(tmp_path, ["CONC003"])
+    assert _ids(res) == ["CONC003"]
+    assert "Pair.a' -> 'Pair.b" in res.findings[0].message
+    assert any("no committed lock-order DAG" in n for n in res.notes)
+    # commit the reviewed edge: the ratchet file silences it
+    write_order(tmp_path, collect_edges(tmp_path))
+    assert order_path(tmp_path).is_file()
+    assert load_order(tmp_path) == {"Pair.a -> Pair.b": {
+        "site": "fedml_tpu/mod.py", "via": ["Pair.both"]}}
+    assert _ids(_lint(tmp_path, ["CONC003"])) == []
+
+
+def test_conc003_stale_committed_edge_noted(tmp_path):
+    f = _write(tmp_path, "fedml_tpu/mod.py", NESTED)
+    write_order(tmp_path, collect_edges(tmp_path))
+    # drop the nesting: the committed edge goes stale and the ratchet
+    # asks to be tightened (a note, not a finding)
+    f.write_text(textwrap.dedent(NESTED).replace(
+        "            with self.b:\n                pass", "            pass"))
+    res = _lint(tmp_path, ["CONC003"])
+    assert _ids(res) == []
+    assert any("no longer observed" in n for n in res.notes)
+
+
+def test_conc003_cycle_is_error_even_when_committed(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", NESTED + """\
+
+        def reverse(self):
+            with self.b:
+                with self.a:
+                    pass
+""")
+    write_order(tmp_path, collect_edges(tmp_path))
+    res = _lint(tmp_path, ["CONC003"])
+    assert res.findings, res.notes
+    assert all(f.rule_id == "CONC003" and f.severity == "error"
+               for f in res.findings)
+    assert "deadlock" in res.findings[0].message
+
+
+# -- CONC004: blocking call under a lock -------------------------------------
+
+def test_conc004_fires_on_sleep_under_lock(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", """\
+        import threading
+        import time
+
+        class Writer:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def put(self, x):
+                with self._lock:
+                    time.sleep(0.1)
+    """)
+    res = _lint(tmp_path, ["CONC004"])
+    assert _ids(res) == ["CONC004"]
+    assert "time.sleep()" in res.findings[0].message
+
+
+def test_conc004_dedicated_serializer_exempt(tmp_path):
+    # a lock whose critical sections are ALL the same sqlite calls IS
+    # that connection's serializer — not a smell
+    _write(tmp_path, "fedml_tpu/mod.py", """\
+        import threading
+
+        class DB:
+            def __init__(self, conn):
+                self._lock = threading.Lock()
+                self.conn = conn
+
+            def put(self, x):
+                with self._lock:
+                    self.conn.execute("insert", (x,))
+
+            def drop(self, x):
+                with self._lock:
+                    self.conn.execute("delete", (x,))
+
+            def flush(self):
+                with self._lock:
+                    self.conn.commit()
+    """)
+    assert _ids(_lint(tmp_path, ["CONC004"])) == []
+
+
+# -- CONC005: condition-variable misuse --------------------------------------
+
+def test_conc005_wait_outside_while_and_naked_notify(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", """\
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self.ready = False
+
+            def bad_wait(self):
+                with self._cv:
+                    self._cv.wait()
+
+            def good_wait(self):
+                with self._cv:
+                    while not self.ready:
+                        self._cv.wait()
+
+            def bad_notify(self):
+                self._cv.notify()
+
+            def good_notify(self):
+                with self._cv:
+                    self._cv.notify_all()
+    """)
+    res = _lint(tmp_path, ["CONC005"])
+    assert _ids(res) == ["CONC005", "CONC005"]
+    msgs = "\n".join(f.message for f in res.findings)
+    assert "while-predicate" in msgs and "without holding" in msgs
+
+
+# -- CONC006: timeout-less shutdown wait -------------------------------------
+
+CONC006_HANG = """\
+    import threading
+
+    class Svc:
+        def __init__(self):
+            self._t = threading.Thread(target=self._loop)
+
+        def _loop(self):
+            pass
+
+        def stop(self):
+            self._t.join(){noqa}
+"""
+
+
+def test_conc006_fires_and_timeout_fixes(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", CONC006_HANG.format(noqa=""))
+    res = _lint(tmp_path, ["CONC006"])
+    assert _ids(res) == ["CONC006"]
+    assert "Svc.stop" in res.findings[0].message
+    _write(tmp_path, "fedml_tpu/mod.py", CONC006_HANG.format(
+        noqa="").replace(".join()", ".join(timeout=5.0)"))
+    assert _ids(_lint(tmp_path, ["CONC006"])) == []
+
+
+def test_conc006_noqa_suppresses(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", CONC006_HANG.format(
+        noqa="  # fedml: noqa[CONC006] — joined at exit, wedge impossible"))
+    res = _lint(tmp_path, ["CONC006"])
+    assert _ids(res) == []
+    assert res.suppressed == 1
+
+
+# -- engine integration -------------------------------------------------------
+
+def test_conc_fingerprints_stable_under_line_drift(tmp_path):
+    f = _write(tmp_path, "fedml_tpu/mod.py", CONC002_RACY)
+    before = {fp: fi.rule_id for fi, fp in
+              fingerprints(_lint(tmp_path, ["CONC002"]).findings)}
+    assert before
+    f.write_text("# a new header comment\n\n" + f.read_text())
+    after = {fp: fi.rule_id for fi, fp in
+             fingerprints(_lint(tmp_path, ["CONC002"]).findings)}
+    assert before == after
+
+
+def test_update_baseline_covers_all_five_tiers(tmp_path):
+    # --update-baseline must sweep EVERY tier (file + whole-program +
+    # perf + mesh + conc): a baseline written from a partial scan would
+    # let the missing tier's findings land as "new" on main
+    _write(tmp_path, "fedml_tpu/mod.py", CONC006_HANG.format(noqa=""))
+    _write(tmp_path, "fedml_tpu/jaxy.py", """\
+        import jax
+
+        def train(fn, xs):
+            for x in xs:
+                f = jax.jit(fn)
+                f(x)
+    """)
+    assert run_cli(root=str(tmp_path), update_baseline=True,
+                   echo=lambda *_: None) == 0
+    entries = load_baseline(tmp_path / ".fedml-lint-baseline.json")
+    rules = {e["rule"] for e in entries.values()}
+    assert {"JAX001", "CONC006"} <= rules
+    # the ratcheted run is clean, and the conc tier stays covered
+    assert run_cli(root=str(tmp_path), conc=True,
+                   echo=lambda *_: None) == 0
+
+
+def test_conc_rule_id_filter_enables_the_pass(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", CONC006_HANG.format(noqa=""))
+    lines = []
+    code = run_cli(root=str(tmp_path), rule_ids=["CONC006"], fmt="json",
+                   echo=lines.append)
+    assert code == 1
+    report = json.loads("\n".join(lines))
+    assert [f["rule"] for f in report["findings"]] == ["CONC006"]
+
+
+def test_list_rules_prints_five_tier_catalog(tmp_path):
+    lines = []
+    assert run_cli(root=str(tmp_path), list_rules=True, fmt="json",
+                   echo=lines.append) == 0
+    catalog = json.loads("\n".join(lines))
+    tiers = [t["tier"] for t in catalog["tiers"]]
+    assert tiers == ["file", "program", "perf", "mesh", "conc"]
+    assert all(t["doc"] for t in catalog["tiers"])
+    ids = {r["id"] for t in catalog["tiers"] for r in t["rules"]}
+    assert {"JAX001", "PROTO002", "PERF001", "SHARD002",
+            "CONC002", "CONC003", "CONC006"} <= ids

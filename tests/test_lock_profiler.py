@@ -1,0 +1,194 @@
+"""Runtime lock profiler: opt-in factory, edge recording, the
+observed-vs-committed DAG gate, and the chaos soak's overhead budget."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from fedml_tpu.core.mlops import lock_profiler
+
+
+@pytest.fixture
+def armed():
+    lock_profiler.arm(True)
+    try:
+        yield
+    finally:
+        lock_profiler.arm(False)
+        lock_profiler._armed = None   # back to the env toggle
+
+
+def test_disarmed_factory_returns_plain_primitives():
+    lock_profiler.arm(False)
+    try:
+        lock = lock_profiler.named_lock("X._lock")
+        rlock = lock_profiler.named_rlock("X._rlock")
+        # the hot path carries ZERO wrapper frames when off
+        assert type(lock) is type(threading.Lock())
+        assert type(rlock) is type(threading.RLock())
+        with lock:
+            pass
+        assert not lock_profiler.snapshot()["locks"]
+    finally:
+        lock_profiler._armed = None
+
+
+def test_armed_records_acquisitions_and_order_edges(armed):
+    a = lock_profiler.named_lock("A._lock")
+    b = lock_profiler.named_lock("B._lock")
+    with a:
+        with b:
+            pass
+    with a:
+        pass
+    snap = lock_profiler.snapshot()
+    assert snap["locks"]["A._lock"]["acquisitions"] == 2
+    assert snap["locks"]["B._lock"]["acquisitions"] == 1
+    assert lock_profiler.observed_edges(snap) == {("A._lock", "B._lock")}
+    # the edge count rides along
+    assert snap["edges"] == [["A._lock", "B._lock", 1]]
+
+
+def test_rlock_records_outermost_acquire_only(armed):
+    r = lock_profiler.named_rlock("R._lock")
+    inner = lock_profiler.named_lock("R._inner")
+    with r:
+        with r:                      # reentrant — not a second acquisition
+            with inner:
+                pass
+    snap = lock_profiler.snapshot()
+    assert snap["locks"]["R._lock"]["acquisitions"] == 1
+    # the edge comes from the OUTERMOST hold, never "R._lock -> R._lock"
+    assert lock_profiler.observed_edges(snap) == {("R._lock", "R._inner")}
+
+
+def test_contention_and_wait_accounting(armed):
+    lock = lock_profiler.named_lock("C._lock")
+    started = threading.Event()
+
+    def holder():
+        with lock:
+            started.set()
+            time.sleep(0.05)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    started.wait(timeout=5.0)
+    with lock:                       # must wait out the holder
+        pass
+    t.join(timeout=5.0)
+    rec = lock_profiler.snapshot()["locks"]["C._lock"]
+    assert rec["acquisitions"] == 2
+    assert rec["contended"] >= 1
+    assert rec["wait_s"] > 0.0
+    assert rec["hold_s"] > 0.04
+
+
+def test_check_observed_edges_flags_extras(armed):
+    observed = {("A", "B"), ("B", "C")}
+    committed = {("A", "B")}
+    assert lock_profiler.check_observed_edges(observed, committed) \
+        == [("B", "C")]
+    assert lock_profiler.check_observed_edges({("A", "B")}, committed) == []
+
+
+def test_dump_roundtrip_and_report_render(armed, tmp_path):
+    a = lock_profiler.named_lock("A._lock")
+    b = lock_profiler.named_lock("B._lock")
+    with a:
+        with b:
+            pass
+    path = lock_profiler.dump(str(tmp_path / "lockprof.json"))
+    snap = json.loads(open(path).read())
+    assert lock_profiler.observed_edges(snap) == {("A._lock", "B._lock")}
+    ok = lock_profiler.render_report(snap, extra_edges=[])
+    assert "observed edges ⊆ committed static DAG: OK" in ok
+    bad = lock_profiler.render_report(
+        snap, extra_edges=[("A._lock", "B._lock")])
+    assert "OUTSIDE THE COMMITTED STATIC DAG" in bad
+
+
+def test_conc_report_cli_gates_on_dag_and_overhead(armed, tmp_path):
+    from click.testing import CliRunner
+
+    from fedml_tpu.cli.cli import cli
+
+    a = lock_profiler.named_lock(
+        "ReplicaProcessManager._scale_lock")
+    b = lock_profiler.named_lock("ReplicaProcessManager._lock")
+    with a:
+        with b:
+            pass
+    path = lock_profiler.dump(str(tmp_path / "lockprof.json"))
+    # the committed repo DAG contains exactly this edge — the gate passes
+    res = CliRunner().invoke(cli, ["conc", "report", "--snapshot", path,
+                                   "--check-dag", "--max-overhead", "0.02"])
+    assert res.exit_code == 0, res.output
+    assert "OK" in res.output
+    # an edge the static pass never saw fails the gate
+    lock_profiler.reset()
+    x = lock_profiler.named_lock("Rogue._x")
+    y = lock_profiler.named_lock("Rogue._y")
+    with x:
+        with y:
+            pass
+    path = lock_profiler.dump(str(tmp_path / "rogue.json"))
+    res = CliRunner().invoke(cli, ["conc", "report", "--snapshot", path,
+                                   "--check-dag"])
+    assert res.exit_code == 1, res.output
+    assert "Rogue._x -> Rogue._y" in res.output
+
+
+def test_chaos_soak_observed_subset_of_committed_under_budget(armed):
+    """The CI soak in miniature: hammer the replica manager's two locks
+    from scale/monitor/gateway-shaped threads in the committed order and
+    assert (a) every observed edge is in the committed static DAG and
+    (b) the profiler's self-measured bookkeeping stays under 2%."""
+    from fedml_tpu.analysis.conc.lockorder import committed_pairs
+    from fedml_tpu.analysis.engine import default_root
+
+    committed = committed_pairs(default_root())
+    assert committed, "benchmarks/lock_order.json must be committed"
+
+    scale = lock_profiler.named_lock("ReplicaProcessManager._scale_lock")
+    gateway = lock_profiler.named_lock("ReplicaProcessManager._lock")
+    stop = threading.Event()
+
+    sink = []
+
+    def scaler():
+        # lifecycle ticks: a lifecycle op nests the gateway lock, with
+        # real (if tiny) work inside the critical section — the budget
+        # is against a control-plane profile, not a lock-churn micro
+        while not stop.is_set():
+            with scale:
+                with gateway:
+                    sink.append(sum(range(200)))
+            stop.wait(0.001)
+
+    def monitor():
+        while not stop.is_set():
+            with gateway:
+                sink.append(sum(range(200)))
+            stop.wait(0.001)
+
+    threads = [threading.Thread(target=scaler) for _ in range(2)] \
+        + [threading.Thread(target=monitor) for _ in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5.0)
+
+    snap = lock_profiler.snapshot()
+    extras = lock_profiler.check_observed_edges(
+        lock_profiler.observed_edges(snap), committed)
+    assert extras == [], extras
+    total = sum(r["acquisitions"] for r in snap["locks"].values())
+    assert total > 100, snap["locks"]
+    assert snap["overhead_frac"] < 0.02, snap["overhead_frac"]
