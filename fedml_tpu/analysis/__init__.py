@@ -71,11 +71,13 @@ TIER_DOCS = {
              "docs/STATIC_ANALYSIS.md#mesh-tier"),
     "conc": ("concurrency", "--conc",
              "docs/STATIC_ANALYSIS.md#concurrency-tier"),
+    "taint": ("privacy-taint", "--taint",
+              "docs/STATIC_ANALYSIS.md#privacy-taint-tier"),
 }
 
 
 def render_rule_list(fmt: str = "text") -> str:
-    """The five-tier rule catalog behind ``fedml lint --list-rules``."""
+    """The six-tier rule catalog behind ``fedml lint --list-rules``."""
     cat = rule_catalog()
     if fmt == "json":
         by_tier: dict = {}
@@ -112,9 +114,11 @@ def run_cli(root: Optional[str] = None,
             perf: bool = False,
             mesh: bool = False,
             conc: bool = False,
+            taint: bool = False,
             perf_registry=None,
             graph: Optional[str] = None,
             list_rules: bool = False,
+            sarif: Optional[str] = None,
             echo=print) -> int:
     """Body of ``fedml lint``; returns the process exit code."""
     try:
@@ -156,17 +160,19 @@ def run_cli(root: Optional[str] = None,
             return EXIT_INTERNAL_ERROR
         if update_baseline:
             # the baseline file is SHARED by the per-file, whole-program,
-            # perf, mesh and conc CI gates; rewriting it from a partial
-            # scan would drop every baselined entry of the skipped tiers,
-            # so always take the fullest scan when rewriting
+            # perf, mesh, conc and taint CI gates; rewriting it from a
+            # partial scan would drop every baselined entry of the
+            # skipped tiers, so always take the fullest scan when
+            # rewriting
             whole_program = True
             perf = True
             mesh = True
             conc = True
+            taint = True
         root_p = Path(root) if root else default_root()
         result = run_lint(root_p, paths=paths or None, rule_ids=rule_ids,
                           whole_program=whole_program, perf=perf,
-                          mesh=mesh, conc=conc,
+                          mesh=mesh, conc=conc, taint=taint,
                           perf_registry=perf_registry)
         baseline_p = (Path(baseline) if baseline
                       else root_p / DEFAULT_BASELINE_NAME)
@@ -193,6 +199,12 @@ def run_cli(root: Optional[str] = None,
             return EXIT_CLEAN
         known = load_baseline(baseline_p) if baseline_p.is_file() else {}
         new, old = partition(result.findings, known)
+        if sarif:
+            from .sarif import write_sarif
+
+            n = write_sarif(Path(sarif), new, old)
+            echo(f"fedml lint: SARIF report written to {sarif} "
+                 f"({n} results)")
         if fmt == "json":
             echo(json.dumps(_json_report(result, new, old), indent=2))
         else:

@@ -135,11 +135,13 @@ def run_lint(root: Optional[Path] = None,
              perf: bool = False,
              mesh: bool = False,
              conc: bool = False,
+             taint: bool = False,
              perf_registry=None) -> LintResult:
     from .conc import conc_rule_ids
     from .mesh.rules import mesh_rule_ids
     from .perf.rules import perf_rule_ids
     from .rules import make_program_rules, make_rules
+    from .taint import taint_rule_ids
 
     t0 = time.monotonic()
     root = Path(root) if root else default_root()
@@ -152,9 +154,10 @@ def run_lint(root: Optional[Path] = None,
     perf_ids = {r.upper() for r in perf_rule_ids()} | {"PERF000"}
     mesh_ids = {r.upper() for r in mesh_rule_ids()} | {"SHARD000"}
     conc_ids = {r.upper() for r in conc_rule_ids()} | {"CONC000"}
+    taint_ids = {r.upper() for r in taint_rule_ids()} | {"PRIV000"}
     if wanted is not None:
         known = ({r.id.upper() for r in all_rules} | prog_ids | perf_ids
-                 | mesh_ids | conc_ids)
+                 | mesh_ids | conc_ids | taint_ids)
         unknown = sorted(wanted - known)
         if unknown:
             raise ValueError(f"unknown rule id(s) {unknown}; "
@@ -168,6 +171,7 @@ def run_lint(root: Optional[Path] = None,
         perf = bool(wanted & perf_ids)
         mesh = bool(wanted & mesh_ids)
         conc = bool(wanted & conc_ids)
+        taint = bool(wanted & taint_ids)
     rules = [r for r in all_rules
              if wanted is None or r.id.upper() in wanted]
     prog_rules = ([r for r in all_prog_rules
@@ -287,6 +291,17 @@ def run_lint(root: Optional[Path] = None,
                              if f.path in subset_paths]
         _emit_project(conc_findings)
         notes.extend(conc_notes)
+    if taint:
+        from .taint import run_taint_pass
+
+        taint_findings, taint_notes = run_taint_pass(
+            root, rule_ids=rule_ids)
+        if paths:
+            subset_paths = {c.path for c in contexts}
+            taint_findings = [f for f in taint_findings
+                              if f.path in subset_paths]
+        _emit_project(taint_findings)
+        notes.extend(taint_notes)
     findings.sort(key=Finding.sort_key)
     return LintResult(findings, n_files, suppressed,
                       time.monotonic() - t0, notes)

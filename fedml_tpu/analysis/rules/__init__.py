@@ -62,11 +62,13 @@ def make_program_rules() -> List[ProgramRule]:
 
 def rule_catalog() -> List[dict]:
     """Every rule of every tier (``fedml lint --list-rules`` renders
-    this).  ``tier`` ∈ file|program|perf|mesh|conc; the pass-failure
-    channels (PERF000/SHARD000/CONC000) are listed with their tier."""
+    this).  ``tier`` ∈ file|program|perf|mesh|conc|taint; the
+    pass-failure channels (PERF000/SHARD000/CONC000/PRIV000) are listed
+    with their tier."""
     from ..conc import conc_catalog
     from ..mesh.rules import make_mesh_rules
     from ..perf.rules import make_perf_rules
+    from ..taint import taint_catalog
 
     cat = ([{"id": r.id, "severity": r.severity, "title": r.title,
              "whole_program": False, "tier": "file"}
@@ -89,5 +91,9 @@ def rule_catalog() -> List[dict]:
            + [{"id": c["id"], "severity": c["severity"],
                "title": c["title"], "whole_program": True,
                "conc": True, "tier": "conc", "reads": c["reads"]}
-              for c in conc_catalog()])
+              for c in conc_catalog()]
+           + [{"id": c["id"], "severity": c["severity"],
+               "title": c["title"], "whole_program": True,
+               "taint": True, "tier": "taint", "reads": c["reads"]}
+              for c in taint_catalog()])
     return cat

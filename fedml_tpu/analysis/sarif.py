@@ -1,0 +1,86 @@
+"""SARIF 2.1.0 output for ``fedml lint --sarif <path>``.
+
+One run, one driver ("fedml-lint"), every rule of every tier that
+produced a result.  Baselined findings are carried with
+``baselineState: "unchanged"`` so a CI annotator can show them dimmed
+instead of dropping them; new findings are ``"new"``.  Severity maps
+error→error, warning→warning, info→note.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import List, Tuple
+
+from .findings import Finding
+
+_LEVELS = {"error": "error", "warning": "warning", "info": "note"}
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def _result(f: Finding, fingerprint: str, baselined: bool) -> dict:
+    return {
+        "ruleId": f.rule_id,
+        "level": _LEVELS.get(f.severity, "warning"),
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.path,
+                                     "uriBaseId": "SRCROOT"},
+                "region": {"startLine": max(f.line, 1),
+                           "startColumn": max(f.col, 0) + 1},
+            },
+        }],
+        "partialFingerprints": {"fedmlLint/v1": fingerprint},
+        "baselineState": "unchanged" if baselined else "new",
+    }
+
+
+def render_sarif(new: List[Tuple[Finding, str]],
+                 old: List[Tuple[Finding, str]]) -> str:
+    from .rules import rule_catalog
+
+    cat = rule_catalog()
+    used = ({f.rule_id for f, _ in new} | {f.rule_id for f, _ in old}
+            | {"LINT001"})
+    rules = [{
+        "id": e["id"],
+        "shortDescription": {"text": e["title"]},
+        "properties": {"tier": e.get("tier", "file"),
+                       "severity": e["severity"]},
+    } for e in cat if e["id"] in used]
+    results = ([_result(f, fp, False) for f, fp in new]
+               + [_result(f, fp, True) for f, fp in old])
+    results.sort(key=lambda r: (
+        r["locations"][0]["physicalLocation"]["artifactLocation"]["uri"],
+        r["locations"][0]["physicalLocation"]["region"]["startLine"],
+        r["ruleId"]))
+    doc = {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "fedml-lint",
+                "informationUri":
+                    "docs/STATIC_ANALYSIS.md",
+                "rules": rules,
+            }},
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=2)
+
+
+def write_sarif(path: Path, new: List[Tuple[Finding, str]],
+                old: List[Tuple[Finding, str]]) -> int:
+    """Write the report; returns the number of results."""
+    path = Path(path)
+    if path.parent != Path("."):
+        path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(render_sarif(new, old) + "\n", encoding="utf-8")
+    return len(new) + len(old)

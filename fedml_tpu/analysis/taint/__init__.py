@@ -1,0 +1,100 @@
+"""Privacy-taint tier (``fedml lint --taint``) — the sixth lint tier.
+
+Statically proves the data-minimization invariant of the federated
+contract: raw client examples, per-client identifiers, PRNG/mask
+secrets and (on SecAgg paths) unmasked update trees never reach an
+emission surface — Message payloads, logs, metrics, the run ledger,
+trace spans, HTTP responses, checkpoints — except through the declared
+declassifier catalog (local-epoch training, wire codecs, aggregate
+reductions, the SecAgg mask funnel).  The same pass derives the wire
+contract (``benchmarks/wire_contract.json``) that PRIV006 ratchets and
+the runtime wire audit (``core.mlops.wire_audit``) enforces.
+
+Shares the engine's noqa/fingerprint/baseline machinery; a pass-level
+failure is a PRIV000 finding, so taint coverage can never shrink
+silently.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import List, Optional, Sequence, Tuple
+
+from ..findings import SEV_ERROR, Finding
+
+TAINT_RULE_IDS = ("PRIV001", "PRIV002", "PRIV003", "PRIV004", "PRIV005",
+                  "PRIV006")
+
+
+def taint_rule_ids() -> List[str]:
+    return list(TAINT_RULE_IDS)
+
+
+def taint_catalog() -> List[dict]:
+    from .rules import CATALOG
+
+    return [{"id": rid, "severity": sev, "title": title, "reads": reads}
+            for rid, sev, title, reads in CATALOG]
+
+
+def run_taint_pass(root, rule_ids: Optional[Sequence[str]] = None
+                   ) -> Tuple[List[Finding], List[str]]:
+    """Run the taint tier over the WHOLE package rooted at ``root``.
+    Returns (findings, notes); the engine handles noqa/subset/baseline.
+    Never raises — a pass-level failure becomes a PRIV000 finding."""
+    notes: List[str] = []
+    try:
+        from ..engine import parse_contexts
+        from ..wholeprogram import build_index
+        from . import rules as _rules
+        from .engine import build_taint_model
+        from .wirecontract import (
+            collect_sites,
+            derive_contract,
+            load_contract,
+        )
+
+        contexts, parse_errors = parse_contexts(Path(root), None)
+        if parse_errors:
+            # dataflow over a partial package would miss flows through
+            # the unparsed file — skip loudly, same policy as the
+            # whole-program tier (the full scan's LINT001 fails anyway)
+            notes.append(
+                f"taint pass skipped: {len(parse_errors)} file(s) "
+                f"cannot be parsed (see LINT001) — escape verdicts "
+                f"would be guesses")
+            return ([Finding(
+                "PRIV000", SEV_ERROR, rel,
+                getattr(exc, "lineno", 1) or 1, 0,
+                "taint pass skipped: file cannot be parsed")
+                for rel, exc in parse_errors], notes)
+        wanted = ({r.strip().upper() for r in rule_ids}
+                  if rule_ids else None)
+        index = build_index(contexts)
+        hits = build_taint_model(contexts, index)
+        findings: List[Finding] = []
+        if wanted is None or "PRIV001" in wanted:
+            findings.extend(_rules.priv001(hits))
+        if wanted is None or "PRIV002" in wanted:
+            findings.extend(_rules.priv002(hits))
+        if wanted is None or "PRIV003" in wanted:
+            findings.extend(_rules.priv003(hits))
+        if wanted is None or "PRIV004" in wanted:
+            findings.extend(_rules.priv004(hits))
+        if wanted is None or "PRIV005" in wanted:
+            findings.extend(_rules.priv005(hits))
+        if wanted is None or "PRIV006" in wanted:
+            sites = collect_sites(contexts, index)
+            derived = derive_contract(contexts, index)
+            f6, n6 = _rules.priv006(derived, load_contract(root), sites)
+            findings.extend(f6)
+            notes.extend(n6)
+        return findings, notes
+    except Exception as exc:  # noqa: BLE001 — the pass must never take
+        # down the whole lint run; PRIV000 carries the failure instead
+        notes.append(f"taint pass failed: {exc.__class__.__name__}: "
+                     f"{exc}")
+        return ([Finding(
+            "PRIV000", SEV_ERROR, "fedml_tpu", 1, 0,
+            f"taint pass failed: {exc.__class__.__name__} — privacy "
+            f"escape coverage is OFF until this is fixed")], notes)

@@ -1,0 +1,189 @@
+"""Source / sink / declassifier catalog for the privacy-taint tier.
+
+The catalog is DATA, not code: name patterns that mark a value as
+carrying client data, the emission surfaces that count as escapes, and
+the sanctioned transformations that cleanse a flow.  Keeping it in one
+module means the docs table (docs/STATIC_ANALYSIS.md#privacy-taint-tier)
+and the engine cannot drift apart silently — the doc test renders this
+module.
+
+Taint kinds
+-----------
+``example``   raw client rows / batches / per-client label tensors
+``client-id`` unbounded per-client identifiers (virtual client ids, not
+              bounded comm ranks)
+``secret``    PRNG keys and seeds, SecAgg self-mask seeds, DH secret
+              keys, mask/key shares
+``params``    model update trees (only a privacy problem on SecAgg
+              client paths or as tensor reprs in logs)
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, FrozenSet, Tuple
+
+EXAMPLE = "example"
+CLIENT_ID = "client-id"
+SECRET = "secret"
+PARAMS = "params"
+
+ALL_KINDS = (EXAMPLE, CLIENT_ID, SECRET, PARAMS)
+
+#: variable/attribute base-name patterns → taint kind, applied at USE
+#: time (so a tainted NAME taints every expression it appears in, even
+#: after flowing through an unknown helper).  Anchored full-match.
+NAME_PATTERNS: Dict[str, Tuple[re.Pattern, ...]] = {
+    EXAMPLE: tuple(re.compile(p) for p in (
+        r"batch(es)?", r"train_batch", r"eval_batch", r"example[s]?",
+        r"client_rows", r"raw_rows", r"local_data(set)?", r"train_data",
+        r"label_tensor[s]?",
+    )),
+    CLIENT_ID: tuple(re.compile(p) for p in (
+        r"client_id[s]?", r"client_idx", r"client_index",
+        r"virtual_client_id[s]?",
+    )),
+    SECRET: tuple(re.compile(p) for p in (
+        r"(prng|rng)_key[s]?", r"b_seed", r"shared_seeds?", r"seed",
+        r"master_seed", r"secret_key", r"priv(ate)?_key",
+        r"local_mask", r"agg_mask", r"mask_share[s]?",
+        r"sk_shares?", r"b_shares?", r"shares?",
+    )),
+    PARAMS: tuple(re.compile(p) for p in (
+        r"weights", r"model_params", r"global_model", r"params",
+        r"grads?", r"gradients", r"update_tree", r"local_update",
+        r"state_dict", r"model_update",
+    )),
+}
+
+#: call names (trailing dotted segment) that CREATE taint — the return
+#: value carries the kind no matter what it is assigned to.
+SOURCE_CALLS: Dict[str, str] = {
+    "rows": EXAMPLE,             # ClientPopulation.rows / dataset rows
+    "sample_batch": EXAMPLE,
+    "next_batch": EXAMPLE,
+    "get_batch": EXAMPLE,
+    "load_population": EXAMPLE,
+    "philox_generator": SECRET,  # data.population per-client PRNG
+    "PRNGKey": SECRET,
+    "fold_in": SECRET,
+}
+
+#: call names whose RESULT is clean regardless of argument taint — the
+#: sanctioned escapes.  Aggregates/metadata (shape-level facts), hashes,
+#: the wire codecs (encode side), and the SecAgg mask funnels.
+DECLASSIFIER_CALLS: FrozenSet[str] = frozenset({
+    # builtin / numeric reductions — scalars, never rows
+    "len", "int", "float", "bool", "abs", "round", "hash",
+    "sum", "min", "max", "sorted",
+    # numpy/jax aggregate + histogram reductions
+    "mean", "std", "var", "norm", "median", "percentile", "quantile",
+    "histogram", "bincount", "count_nonzero", "size_hist", "zipf_sizes",
+    # metadata summaries — shape/dtype/nbytes/param counts, never values
+    "estimate_nbytes", "summarize_payload", "tree_nbytes",
+    "count_trainable", "count_params",
+    # admission verdicts: short reason enums DERIVED from, not
+    # containing, the screened update
+    "admission_check", "add_local_trained_result",
+    # content hashes / digests
+    "sha256", "md5", "blake2b", "hexdigest", "digest", "crc32",
+    # wire codecs: params → opaque encoded bytes (the sanctioned
+    # compression path; decode re-materializes on the OTHER role)
+    "encode", "encode_update", "compress", "pack",
+    # SecAgg mask funnels: the ONLY sanctioned params→wire route on an
+    # armed client (sa_utils.mask_upload / lsa_utils.mask_field_vector)
+    "mask_upload", "mask_field_vector",
+})
+
+#: call names that TRANSFORM taint: the local-epoch update funnel —
+#: ``trainer.train(batch)`` consumes raw examples and returns a model
+#: update tree (params kind), the first sanctioned reduction of client
+#: data.
+TRANSFORMER_CALLS: Dict[str, FrozenSet[str]] = {
+    "train": frozenset({PARAMS}),
+    # per-epoch / per-round jitted funnels: consume batches + PRNG keys,
+    # return the updated model tree — the same reduction at other
+    # granularities (simulation round steps, model init from a key)
+    "train_epoch": frozenset({PARAMS}),
+    "_train_epoch": frozenset({PARAMS}),
+    "round_step": frozenset({PARAMS}),
+    "bucketed_round_step": frozenset({PARAMS}),
+    "multi_round_step": frozenset({PARAMS}),
+    "init_variables": frozenset({PARAMS}),
+}
+
+#: attribute accesses that declassify (shape-level metadata, not values)
+META_ATTRS: FrozenSet[str] = frozenset({
+    "shape", "dtype", "nbytes", "ndim", "size", "itemsize",
+})
+
+#: wire payload keys (by WIRE VALUE) whose message-side values are
+#: tensor payloads — reading them back via ``msg.get(...)`` re-taints
+#: as params.
+TENSOR_PAYLOAD_KEYS: FrozenSet[str] = frozenset({
+    "model_params", "wire_update", "compressed_update", "masked_vector",
+    "model_wq",
+})
+
+#: wire keys (by WIRE VALUE) forming the sanctioned peer-share channel:
+#: secret-kind values MAY travel on exactly these keys (Shamir/LCC
+#: shares and DH public material), nowhere else — PRIV003 otherwise.
+SHARE_CHANNEL_KEYS: FrozenSet[str] = frozenset({
+    "share_of_b", "share_of_sk", "b_shares", "sk_shares",
+    "mask_share", "public_key", "public_keys",
+})
+
+#: module path prefixes that constitute the wire path — PRIV005 (tensor
+#: repr in logs) only fires here, where a stray repr lands in hot-path
+#: round logs shipped off-device.
+WIRE_PATH_PREFIXES: Tuple[str, ...] = (
+    "fedml_tpu/core/distributed/",
+    "fedml_tpu/cross_silo/",
+    "fedml_tpu/cross_device/",
+    "fedml_tpu/serving/",
+    "fedml_tpu/fa/",
+)
+
+#: module path fragments where SecAgg is armed — PRIV004 scope.
+SECAGG_PATH_FRAGMENTS: Tuple[str, ...] = (
+    "/secagg/", "/lightsecagg/",
+)
+
+#: sink identifiers (the engine's Hit.sink field)
+SINK_WIRE = "wire"            # Message.add_params / Message.add
+SINK_LOG = "log"              # logging.* / log.* / logger.* calls
+SINK_METRICS_LABEL = "metrics-label"   # .labels(**kw) label VALUES
+SINK_METRICS_VALUE = "metrics-value"   # .observe/.inc/.set values
+SINK_LEDGER = "ledger"        # ledger.event(...) attrs
+SINK_TRACE = "trace"          # mlops span()/event() values
+SINK_HTTP = "http"            # http_json.reply / openai_api._json
+SINK_CHECKPOINT = "checkpoint"  # CheckpointManager.save attrs
+
+SINK_LABELS = {
+    SINK_WIRE: "Message payload",
+    SINK_LOG: "log call",
+    SINK_METRICS_LABEL: "metrics label value",
+    SINK_METRICS_VALUE: "metrics sample value",
+    SINK_LEDGER: "run-ledger attr",
+    SINK_TRACE: "trace span value",
+    SINK_HTTP: "HTTP response body",
+    SINK_CHECKPOINT: "checkpoint attr",
+}
+
+#: sinks that are sanctioned per-client surfaces: client-id kind is
+#: LEGAL here (bounded retention, not a cardinality explosion).  The
+#: wire itself must carry client_idx for routing.
+CLIENT_ID_SANCTIONED_SINKS: FrozenSet[str] = frozenset({
+    SINK_WIRE, SINK_LEDGER, SINK_TRACE, SINK_CHECKPOINT, SINK_HTTP,
+    SINK_METRICS_VALUE, SINK_LOG,
+})
+
+
+def name_kinds(name: str) -> FrozenSet[str]:
+    """Taint kinds a bare name/attribute carries by pattern."""
+    out = set()
+    low = name.lower()
+    for kind, pats in NAME_PATTERNS.items():
+        if any(p.fullmatch(low) for p in pats):
+            out.add(kind)
+    return frozenset(out)

@@ -1,0 +1,511 @@
+"""Interprocedural taint dataflow over the fedml_tpu package.
+
+Per-function forward dataflow (variables → taint-kind sets) with
+
+* name-pattern taint applied at USE time (catalog.NAME_PATTERNS), so a
+  tainted NAME stays tainted through helpers the analysis cannot see,
+* source calls (``population.rows(...)``, ``philox_generator(...)``),
+* declassifier calls as the only cleansing operations,
+* container/pytree propagation (dict/list/tuple/f-string/BinOp union),
+* class-attribute flow (``self.x`` entries unioned across methods),
+* one-level call-through: every function gets a summary (which params
+  reach which sinks, what the return value carries); call sites bind
+  argument taint against the callee summary.
+
+Emission is a flat list of :class:`Hit` records — the rules module maps
+hits to PRIV findings, the engine itself knows nothing about rule ids.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from .. import astutil
+from ..wholeprogram.index import PackageIndex, resolve_type_expr
+from . import catalog as C
+
+#: symbolic taint kind carried by a function parameter until a call site
+#: binds it — ``param:batch`` in ``def helper(batch): log.info(batch)``
+SYM_PREFIX = "param:"
+
+_LOG_METHODS = frozenset({
+    "debug", "info", "warning", "warn", "error", "exception", "critical",
+})
+_LOG_RECEIVERS = frozenset({"log", "logger", "logging"})
+
+_MSGISH = re.compile(r"msg|message|reply|request|ack")
+
+
+def _msgish(recv_name: str) -> bool:
+    """``.add(k, v)`` counts as a wire sink only on a message-looking
+    receiver — ``acc.add(a, b)`` on a homomorphic codec is arithmetic."""
+    return bool(_MSGISH.search(recv_name.lower()))
+
+
+def real_kinds(kinds: FrozenSet[str]) -> FrozenSet[str]:
+    return frozenset(k for k in kinds if not k.startswith(SYM_PREFIX))
+
+
+@dataclasses.dataclass(frozen=True)
+class Hit:
+    """One tainted value reaching one sink."""
+    sink: str                 # catalog.SINK_*
+    kinds: FrozenSet[str]     # taint kinds (real + symbolic param:NAME)
+    path: str
+    line: int
+    col: int
+    func: str                 # qualname ("Cls.method" or "fn")
+    owner_class: str          # "" for module-level functions
+    key: str = ""             # wire key value / label name / attr name
+    via: str = ""             # "" direct, else the callee a call-through
+                              # walked into
+
+
+@dataclasses.dataclass
+class _FuncAnalysis:
+    qualname: str
+    params: List[str]
+    return_kinds: Set[str] = dataclasses.field(default_factory=set)
+    self_env: Dict[str, Set[str]] = dataclasses.field(default_factory=dict)
+    hits: List[Hit] = dataclasses.field(default_factory=list)
+    #: (callee key, {param → real arg kinds}, line, col)
+    callsites: List[Tuple[Tuple[str, str], Dict[str, FrozenSet[str]],
+                          int, int]] = dataclasses.field(
+        default_factory=list)
+
+
+class _Walker:
+    """One pass over one function body.  Monotone env (taint only grows);
+    the driver runs the body twice env-only for loop-carried taint, then
+    once with ``emit=True``."""
+
+    def __init__(self, path: str, modinfo, index: PackageIndex,
+                 func_node: ast.AST, qualname: str, owner_class: str,
+                 env: Dict[str, Set[str]],
+                 summaries: Dict[Tuple[str, str], "_FuncAnalysis"]):
+        self.path = path
+        self.modinfo = modinfo
+        self.index = index
+        self.node = func_node
+        self.qualname = qualname
+        self.owner_class = owner_class
+        self.env = env
+        self.summaries = summaries
+        self.emit = False
+        self.analysis = _FuncAnalysis(qualname, _param_names(func_node))
+
+    # -- env ------------------------------------------------------------
+
+    def _get(self, name: str) -> Set[str]:
+        return set(self.env.get(name, ())) | set(C.name_kinds(name))
+
+    def _bind(self, tgt: ast.AST, kinds: Set[str]) -> None:
+        if not kinds:
+            return
+        if isinstance(tgt, ast.Name):
+            self.env.setdefault(tgt.id, set()).update(kinds)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                self._bind(e, kinds)
+        elif isinstance(tgt, ast.Starred):
+            self._bind(tgt.value, kinds)
+        elif (isinstance(tgt, ast.Attribute)
+              and isinstance(tgt.value, ast.Name)
+              and tgt.value.id == "self"):
+            self.env.setdefault("self." + tgt.attr, set()).update(kinds)
+        elif isinstance(tgt, ast.Subscript):
+            # d[k] = tainted → the container is tainted; unwrap the
+            # subscript layers and re-bind the container target itself
+            # (Name or self.attr), not its base object
+            base = tgt.value
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            if isinstance(base, (ast.Name, ast.Attribute)):
+                self._bind(base, kinds)
+
+    # -- expressions -----------------------------------------------------
+
+    def eval(self, node: Optional[ast.AST]) -> Set[str]:
+        if node is None:
+            return set()
+        if isinstance(node, ast.Constant):
+            return set()
+        if isinstance(node, ast.Name):
+            return self._get(node.id)
+        if isinstance(node, ast.Attribute):
+            if node.attr in C.META_ATTRS:
+                self.eval(node.value)
+                return set()
+            if (isinstance(node.value, ast.Name)
+                    and node.value.id == "self"):
+                return (set(self.env.get("self." + node.attr, ()))
+                        | set(C.name_kinds(node.attr)))
+            return self.eval(node.value) | set(C.name_kinds(node.attr))
+        if isinstance(node, ast.Call):
+            return self._call(node)
+        if isinstance(node, ast.BinOp):
+            return self.eval(node.left) | self.eval(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.eval(node.operand)
+        if isinstance(node, ast.BoolOp):
+            out: Set[str] = set()
+            for v in node.values:
+                out |= self.eval(v)
+            return out
+        if isinstance(node, ast.Compare):
+            # a comparison yields a bool — declassified, but still walk
+            # the operands for sink calls nested inside
+            self.eval(node.left)
+            for cmp in node.comparators:
+                self.eval(cmp)
+            return set()
+        if isinstance(node, ast.Subscript):
+            self.eval(node.slice)
+            return self.eval(node.value)
+        if isinstance(node, ast.Dict):
+            out = set()
+            for k in node.keys:
+                out |= self.eval(k)
+            for v in node.values:
+                out |= self.eval(v)
+            return out
+        if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+            out = set()
+            for e in node.elts:
+                out |= self.eval(e)
+            return out
+        if isinstance(node, ast.JoinedStr):
+            out = set()
+            for v in node.values:
+                out |= self.eval(v)
+            return out
+        if isinstance(node, ast.FormattedValue):
+            return self.eval(node.value)
+        if isinstance(node, ast.IfExp):
+            self.eval(node.test)
+            return self.eval(node.body) | self.eval(node.orelse)
+        if isinstance(node, ast.Lambda):
+            return set()
+        if isinstance(node, ast.Await):
+            return self.eval(node.value)
+        if isinstance(node, ast.Starred):
+            return self.eval(node.value)
+        if isinstance(node, ast.NamedExpr):
+            kinds = self.eval(node.value)
+            self._bind(node.target, kinds)
+            return kinds
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            for gen in node.generators:
+                self._bind(gen.target, self.eval(gen.iter))
+                for cond in gen.ifs:
+                    self.eval(cond)
+            if isinstance(node, ast.DictComp):
+                return self.eval(node.key) | self.eval(node.value)
+            return self.eval(node.elt)
+        # conservative fallback: union of child expressions
+        out = set()
+        for child in ast.iter_child_nodes(node):
+            out |= self.eval(child)
+        return out
+
+    # -- calls (sources, sinks, declassifiers, call-through) -------------
+
+    def _resolve_key(self, node: ast.AST) -> str:
+        values, syms = resolve_type_expr(
+            node, self.index, self.modinfo, method_node=self.node,
+            params=self.analysis.params)
+        if values:
+            return "|".join(sorted(values))
+        return "?"
+
+    def _hit(self, sink: str, kinds: Set[str], node: ast.AST,
+             key: str = "") -> None:
+        if self.emit and kinds:
+            self.analysis.hits.append(Hit(
+                sink, frozenset(kinds), self.path, node.lineno,
+                node.col_offset, self.qualname, self.owner_class, key))
+
+    def _call(self, node: ast.Call) -> Set[str]:
+        dn = astutil.dotted_name(node.func) or ""
+        tail = dn.rsplit(".", 1)[-1] if dn else ""
+        recv = (node.func.value
+                if isinstance(node.func, ast.Attribute) else None)
+        recv_name = ""
+        if recv is not None:
+            rdn = astutil.dotted_name(recv) or ""
+            recv_name = rdn.rsplit(".", 1)[-1]
+        recv_kinds = self.eval(recv) if recv is not None else set()
+        arg_kinds = [self.eval(a) for a in node.args]
+        kw_kinds = {kw.arg: self.eval(kw.value) for kw in node.keywords}
+        all_args: Set[str] = set().union(*arg_kinds) if arg_kinds else set()
+        for v in kw_kinds.values():
+            all_args |= v
+
+        # ---- sinks ----
+        if len(node.args) == 2 and (
+                tail == "add_params"
+                or (tail == "add" and _msgish(recv_name))):
+            key = self._resolve_key(node.args[0])
+            self._hit(C.SINK_WIRE, arg_kinds[1], node, key)
+            return set()
+        if (tail in _LOG_METHODS
+                and (recv_name in _LOG_RECEIVERS
+                     or dn.startswith("logging."))):
+            self._hit(C.SINK_LOG, all_args, node)
+            return set()
+        if tail == "labels" and node.keywords:
+            for kw, kinds in kw_kinds.items():
+                self._hit(C.SINK_METRICS_LABEL, kinds, node, kw or "")
+            return set()
+        if (tail in ("observe", "inc", "set", "dec") and node.args
+                and recv is not None):
+            self._hit(C.SINK_METRICS_VALUE, arg_kinds[0], node)
+            return set()
+        if tail == "event" and (recv_name == "ledger"
+                                or "ledger" in dn.split(".")[:-1]):
+            for kw, kinds in kw_kinds.items():
+                self._hit(C.SINK_LEDGER, kinds, node, kw or "")
+            self._hit(C.SINK_LEDGER, all_args - set().union(
+                *kw_kinds.values()) if kw_kinds else all_args, node)
+            return set()
+        if tail == "span" and (len(node.args) >= 2 or "value" in kw_kinds):
+            val = (arg_kinds[1] if len(node.args) >= 2
+                   else kw_kinds.get("value", set()))
+            self._hit(C.SINK_TRACE, val, node)
+            return set()
+        if tail in ("reply", "_json") and len(node.args) >= 2:
+            self._hit(C.SINK_HTTP, arg_kinds[1], node)
+            return set()
+        if dn.endswith("wfile.write") and node.args:
+            self._hit(C.SINK_HTTP, arg_kinds[0], node)
+            return set()
+        if tail == "save" and ("checkpoint" in recv_name.lower()
+                               or "ckpt" in recv_name.lower()):
+            self._hit(C.SINK_CHECKPOINT, all_args, node)
+            return set()
+
+        # ---- taint algebra ----
+        if tail in C.SOURCE_CALLS:
+            return {C.SOURCE_CALLS[tail]}
+        if tail in C.TRANSFORMER_CALLS:
+            return set(C.TRANSFORMER_CALLS[tail])
+        if tail in C.DECLASSIFIER_CALLS:
+            return set()
+        if tail == "get" and len(node.args) >= 1 and recv is not None:
+            # msg.get(ARG_MODEL_PARAMS) re-materializes a tensor payload
+            key = self._resolve_key(node.args[0])
+            if key in C.TENSOR_PAYLOAD_KEYS:
+                return {C.PARAMS}
+            return recv_kinds | all_args
+
+        # local call-through: bind argument taint to the callee summary
+        callee_key = None
+        if isinstance(node.func, ast.Name):
+            callee_key = (self.path, node.func.id)
+        elif (recv is not None and isinstance(recv, ast.Name)
+              and recv.id == "self" and self.owner_class):
+            callee_key = (self.path, f"{self.owner_class}.{tail}")
+        if callee_key is not None and callee_key in self.summaries:
+            summ = self.summaries[callee_key]
+            argmap: Dict[str, FrozenSet[str]] = {}
+            for i, kinds in enumerate(arg_kinds):
+                if i < len(summ.params):
+                    rk = real_kinds(frozenset(kinds))
+                    if rk:
+                        argmap[summ.params[i]] = rk
+            for kw, kinds in kw_kinds.items():
+                rk = real_kinds(frozenset(kinds))
+                if kw and rk and kw in summ.params:
+                    argmap[kw] = rk
+            if self.emit and argmap:
+                self.analysis.callsites.append(
+                    (callee_key, argmap, node.lineno, node.col_offset))
+            out: Set[str] = set()
+            for k in summ.return_kinds:
+                if k.startswith(SYM_PREFIX):
+                    out |= argmap.get(k[len(SYM_PREFIX):], frozenset())
+                else:
+                    out.add(k)
+            return out
+
+        # unknown call: conservative — taint in, taint out
+        return recv_kinds | all_args
+
+    # -- statements ------------------------------------------------------
+
+    def walk(self, body: Sequence[ast.stmt]) -> None:
+        for st in body:
+            self._stmt(st)
+
+    def _stmt(self, st: ast.stmt) -> None:
+        if isinstance(st, ast.Assign):
+            kinds = self.eval(st.value)
+            for t in st.targets:
+                self._bind(t, kinds)
+        elif isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                self._bind(st.target, self.eval(st.value))
+        elif isinstance(st, ast.AugAssign):
+            self._bind(st.target, self.eval(st.value))
+        elif isinstance(st, ast.Expr):
+            self.eval(st.value)
+        elif isinstance(st, ast.Return):
+            self.analysis.return_kinds |= self.eval(st.value)
+        elif isinstance(st, ast.If):
+            self.eval(st.test)
+            self.walk(st.body)
+            self.walk(st.orelse)
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            self._bind(st.target, self.eval(st.iter))
+            self.walk(st.body)
+            self.walk(st.orelse)
+        elif isinstance(st, ast.While):
+            self.eval(st.test)
+            self.walk(st.body)
+            self.walk(st.orelse)
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                kinds = self.eval(item.context_expr)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars, kinds)
+            self.walk(st.body)
+        elif isinstance(st, ast.Try):
+            self.walk(st.body)
+            for h in st.handlers:
+                self.walk(h.body)
+            self.walk(st.orelse)
+            self.walk(st.finalbody)
+        elif isinstance(st, ast.Raise):
+            self.eval(st.exc)
+            self.eval(st.cause)
+        elif isinstance(st, ast.Assert):
+            self.eval(st.test)
+            self.eval(st.msg)
+        elif isinstance(st, getattr(ast, "Match", ())):
+            self.eval(st.subject)
+            for case in st.cases:
+                self.walk(case.body)
+        # nested defs/classes analyzed as their own functions; imports,
+        # pass/break/continue/global carry no dataflow
+
+    def run(self, emit: bool) -> _FuncAnalysis:
+        body = getattr(self.node, "body", [])
+        self.emit = False
+        self.walk(body)           # pass 1: seed env
+        self.walk(body)           # pass 2: loop-carried taint
+        self.emit = emit
+        if emit:
+            self.walk(body)       # pass 3: emission against the fixpoint
+        # everything assigned to self.* is this function's contribution
+        # to the class attribute environment
+        self.analysis.self_env = {
+            k: set(v) for k, v in self.env.items()
+            if k.startswith("self.")}
+        return self.analysis
+
+
+def _param_names(node: ast.AST) -> List[str]:
+    args = getattr(node, "args", None)
+    if args is None:
+        return []
+    names = [a.arg for a in getattr(args, "posonlyargs", []) + args.args]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    names += [a.arg for a in args.kwonlyargs]
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return [n for n in names if n not in ("self", "cls")]
+
+
+def _functions(tree: ast.AST):
+    """(node, qualname, owner_class) for every top-level function and
+    every method of every top-level class."""
+    for st in tree.body:
+        if isinstance(st, astutil.FUNC_NODES):
+            yield st, st.name, ""
+        elif isinstance(st, ast.ClassDef):
+            for sub in st.body:
+                if isinstance(sub, astutil.FUNC_NODES):
+                    yield sub, f"{st.name}.{sub.name}", st.name
+
+
+def _seed_env(node: ast.AST,
+              class_env: Dict[str, Set[str]]) -> Dict[str, Set[str]]:
+    env: Dict[str, Set[str]] = {k: set(v) for k, v in class_env.items()}
+    for p in _param_names(node):
+        env.setdefault(p, set()).add(SYM_PREFIX + p)
+    return env
+
+
+def build_taint_model(contexts, index: PackageIndex) -> List[Hit]:
+    """Full two-phase analysis; returns the deduplicated flat hit list
+    (real-kind direct hits plus one-level call-through hits)."""
+    funcs = []
+    for ctx in contexts:
+        modinfo = index.modules.get(ctx.path)
+        if modinfo is None:
+            continue
+        for node, qualname, owner in _functions(ctx.tree):
+            funcs.append((ctx, modinfo, node, qualname, owner))
+
+    # phase 1: summaries (param names + return kinds + self-attr flow)
+    summaries: Dict[Tuple[str, str], _FuncAnalysis] = {}
+    for ctx, modinfo, node, qualname, owner in funcs:
+        w = _Walker(ctx.path, modinfo, index, node, qualname, owner,
+                    _seed_env(node, {}), summaries)
+        summaries[(ctx.path, qualname)] = w.run(emit=False)
+
+    # class attribute env: union of every method's self.* contributions
+    class_envs: Dict[Tuple[str, str], Dict[str, Set[str]]] = {}
+    for (path, qualname), a in summaries.items():
+        if "." not in qualname:
+            continue
+        cls = qualname.split(".", 1)[0]
+        env = class_envs.setdefault((path, cls), {})
+        for k, v in a.self_env.items():
+            env.setdefault(k, set()).update(real_kinds(frozenset(v)))
+
+    # phase 2: emission with the class env seeded
+    analyses: Dict[Tuple[str, str], _FuncAnalysis] = {}
+    for ctx, modinfo, node, qualname, owner in funcs:
+        env = _seed_env(node, class_envs.get((ctx.path, owner), {}))
+        w = _Walker(ctx.path, modinfo, index, node, qualname, owner,
+                    env, summaries)
+        analyses[(ctx.path, qualname)] = w.run(emit=True)
+
+    # phase 3: direct hits + one-level call-through
+    hits: List[Hit] = []
+    for (path, qualname), a in analyses.items():
+        for h in a.hits:
+            if real_kinds(h.kinds):
+                hits.append(dataclasses.replace(
+                    h, kinds=real_kinds(h.kinds)))
+        for callee_key, argmap, line, col in a.callsites:
+            callee = analyses.get(callee_key)
+            if callee is None:
+                continue
+            for h in callee.hits:
+                mapped: Set[str] = set()
+                for k in h.kinds:
+                    if k.startswith(SYM_PREFIX):
+                        mapped |= argmap.get(k[len(SYM_PREFIX):],
+                                             frozenset())
+                if mapped:
+                    hits.append(Hit(
+                        h.sink, frozenset(mapped), path, line, col,
+                        qualname, a.qualname.split(".", 1)[0]
+                        if "." in a.qualname else "",
+                        h.key, via=callee.qualname))
+    seen = set()
+    out = []
+    for h in sorted(hits, key=lambda h: (h.path, h.line, h.col, h.sink,
+                                         h.key, sorted(h.kinds))):
+        sig = (h.sink, h.path, h.line, h.col, h.key, h.kinds)
+        if sig not in seen:
+            seen.add(sig)
+            out.append(h)
+    return out

@@ -1,0 +1,187 @@
+"""PRIV rules: map engine hits (tainted value → sink) to findings.
+
+Messages are line-free and name the FIX, not just the smell, so the
+fingerprint survives unrelated edits and a finding reads as a work item.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..findings import SEV_ERROR, SEV_WARNING, Finding
+from . import catalog as C
+from .engine import Hit
+from .wirecontract import WILDCARD_TYPE, flatten
+
+CATALOG = [
+    ("PRIV000", SEV_ERROR, "privacy-taint pass could not run",
+     "pass-level failure finding so taint coverage can never shrink "
+     "silently"),
+    ("PRIV001", SEV_ERROR,
+     "raw client example escapes to an emission sink",
+     "interprocedural source→sink dataflow: dataset rows / per-client "
+     "batches / label tensors reaching wire, log, metrics, ledger, "
+     "trace, HTTP or checkpoint surfaces without a declassifier"),
+    ("PRIV002", SEV_WARNING,
+     "per-client identifier used as a metrics label value",
+     "client-id taint into .labels(...) values — unbounded cardinality; "
+     "the run ledger is the sanctioned per-client surface"),
+    ("PRIV003", SEV_ERROR,
+     "secret material escapes beyond the peer-share channel",
+     "PRNG keys/seeds, self-mask seeds and Shamir/LCC shares reaching "
+     "any sink except the sanctioned share-channel wire keys"),
+    ("PRIV004", SEV_ERROR,
+     "SecAgg bypass: unmasked update tree on the wire",
+     "params taint reaching Message payloads inside the secagg/"
+     "lightsecagg client roles without the mask funnel "
+     "(mask_upload / mask_field_vector)"),
+    ("PRIV005", SEV_WARNING,
+     "tensor-payload repr in a wire-path log call",
+     "params/tensor taint into log.* on distributed/cross_silo/serving "
+     "paths — log summarize_payload(...) (shape/dtype/nbytes), never "
+     "values"),
+    ("PRIV006", SEV_WARNING,
+     "wire payload key is not in the committed contract",
+     "derived per-manager key set ratcheted against "
+     "benchmarks/wire_contract.json; unresolvable keys always report"),
+]
+
+
+def _label(sink: str) -> str:
+    return C.SINK_LABELS.get(sink, sink)
+
+
+def _where(h: Hit) -> str:
+    return f"{h.func}()" + (f" (via {h.via}())" if h.via else "")
+
+
+def priv001(hits: List[Hit]) -> List[Finding]:
+    out = []
+    for h in hits:
+        if C.EXAMPLE not in h.kinds:
+            continue
+        out.append(Finding(
+            "PRIV001", SEV_ERROR, h.path, h.line, h.col,
+            f"raw client example reaches the {_label(h.sink)} in "
+            f"{_where(h)} — raw rows must never leave the client: "
+            f"reduce through the local-epoch update "
+            f"(trainer.train) or summarize with "
+            f"utils.redact.summarize_payload before emission"))
+    return out
+
+
+def priv002(hits: List[Hit]) -> List[Finding]:
+    out = []
+    for h in hits:
+        if h.sink != C.SINK_METRICS_LABEL or C.CLIENT_ID not in h.kinds:
+            continue
+        out.append(Finding(
+            "PRIV002", SEV_WARNING, h.path, h.line, h.col,
+            f"per-client identifier used as metrics label value "
+            f"'{h.key}' in {_where(h)} — unbounded label cardinality; "
+            f"record per-client detail on the run ledger "
+            f"(core.mlops.ledger) and key metrics by bounded "
+            f"run/silo/rank labels"))
+    return out
+
+
+def priv003(hits: List[Hit]) -> List[Finding]:
+    out = []
+    for h in hits:
+        if C.SECRET not in h.kinds:
+            continue
+        if h.sink == C.SINK_WIRE and h.key in C.SHARE_CHANNEL_KEYS:
+            continue   # the sanctioned Shamir/LCC peer-share channel
+        out.append(Finding(
+            "PRIV003", SEV_ERROR, h.path, h.line, h.col,
+            f"secret material (PRNG seed/key or mask share) reaches "
+            f"the {_label(h.sink)} in {_where(h)} — secrets travel "
+            f"only on the peer-share wire keys "
+            f"({', '.join(sorted(C.SHARE_CHANNEL_KEYS))}); emit a "
+            f"digest or drop the value"))
+    return out
+
+
+def priv004(hits: List[Hit]) -> List[Finding]:
+    out = []
+    for h in hits:
+        if h.sink != C.SINK_WIRE or C.PARAMS not in h.kinds:
+            continue
+        if not any(f in h.path for f in C.SECAGG_PATH_FRAGMENTS):
+            continue
+        if "client" not in h.owner_class.lower():
+            continue   # the server broadcasts the AGGREGATE — sanctioned
+        out.append(Finding(
+            "PRIV004", SEV_ERROR, h.path, h.line, h.col,
+            f"model update tree put on the wire without the SecAgg "
+            f"mask funnel in {_where(h)} — an armed client may only "
+            f"emit masked vectors; route the update through "
+            f"mask_upload / mask_field_vector first"))
+    return out
+
+
+def priv005(hits: List[Hit]) -> List[Finding]:
+    out = []
+    for h in hits:
+        if h.sink != C.SINK_LOG or C.PARAMS not in h.kinds:
+            continue
+        if C.EXAMPLE in h.kinds:
+            continue   # PRIV001 already owns the stronger verdict
+        if not h.path.startswith(C.WIRE_PATH_PREFIXES):
+            continue
+        out.append(Finding(
+            "PRIV005", SEV_WARNING, h.path, h.line, h.col,
+            f"tensor payload interpolated into a log call in "
+            f"{_where(h)} — hot-path round logs ship off-device; log "
+            f"utils.redact.summarize_payload(...) "
+            f"(shape/dtype/nbytes), never values"))
+    return out
+
+
+def priv006(derived: Dict[str, Any],
+            committed: Optional[Dict[str, Any]],
+            sites) -> Tuple[List[Finding], List[str]]:
+    """Ratchet the derived contract against the committed file.  New
+    (owner, type, key) triple → finding; unresolvable key → finding
+    always; committed triple no longer derivable → advisory note."""
+    out: List[Finding] = []
+    notes: List[str] = []
+    have = flatten(committed) if committed is not None else set()
+    want = flatten(derived)
+    new = want - have
+    site_index: Dict[Tuple[str, str, str], Tuple[str, int]] = {}
+    for label, t, key, path, line in sites:
+        site_index.setdefault((label, t, key), (path, line))
+        if key == "?":
+            out.append(Finding(
+                "PRIV006", SEV_WARNING, path, line, 0,
+                f"payload key of a {label} message cannot be resolved "
+                f"to a wire value — an unreviewable wire surface; use "
+                f"a message_define constant or a string literal"))
+    for owner, t, key in sorted(new):
+        path, line = site_index.get(
+            (owner, t, key),
+            ("fedml_tpu/core/distributed/communication", 1))
+        shown = key if t == WILDCARD_TYPE else f"{key} [{t}]"
+        out.append(Finding(
+            "PRIV006", SEV_WARNING, path, line, 0,
+            f"wire key '{shown}' of {owner} is not in the committed "
+            f"contract — review the payload for data-minimization, "
+            f"then commit it with "
+            f"`python -m fedml_tpu.analysis.taint.wirecontract`"))
+    if committed is None:
+        notes.append(
+            "hint: taint: no committed wire contract (benchmarks/"
+            "wire_contract.json) — every key reports as new; generate "
+            "it with `python -m fedml_tpu.analysis.taint.wirecontract`")
+    else:
+        stale = sorted(have - want)
+        if stale:
+            sample = ", ".join(
+                f"{o}:{k}" for o, _t, k in stale[:4])
+            notes.append(
+                f"hint: taint: {len(stale)} committed wire-contract "
+                f"entr{'y is' if len(stale) == 1 else 'ies are'} no "
+                f"longer derived from source ({sample}) — regenerate "
+                f"benchmarks/wire_contract.json to shrink the surface")
+    return out, notes

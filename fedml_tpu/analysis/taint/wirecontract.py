@@ -1,0 +1,237 @@
+"""The committed wire contract (PRIV006's ratchet — the lock_order idiom).
+
+``benchmarks/wire_contract.json`` commits, per comm-manager class and
+per message type (by WIRE value), the set of payload keys that class is
+allowed to put on the wire, plus the ``envelope`` section: keys the
+transport planes (reliable delivery, the Message ctor itself) stamp on
+EVERY message.  The taint pass derives the same structure from source
+and compares: a NEW key is a finding until a human reviews the payload
+for data-minimization and commits it; a key the pass cannot resolve is
+always a finding (an unreviewable payload surface).  Regenerate after a
+DELIBERATE protocol change with::
+
+    python -m fedml_tpu.analysis.taint.wirecontract
+
+which rewrites the file from the current source (the diff is the review
+artifact — a new wire field can never land silently).  The SAME file is
+the runtime gate: with ``FEDML_TPU_WIRE_AUDIT=1`` the comm-manager base
+counts every OBSERVED outbound payload key outside this contract into
+``fedml_wire_contract_violations_total`` and
+``fedml taint report --check-contract`` fails the soak on any of them.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from .. import astutil
+from ..wholeprogram.index import resolve_type_expr
+
+CONTRACT_FILE = "benchmarks/wire_contract.json"
+
+#: transport planes whose keys ride on every message regardless of type
+ENVELOPE_PATH_PREFIX = "fedml_tpu/core/distributed/communication/"
+
+#: keys the Message constructor itself stamps
+CTOR_KEYS = ("msg_type", "sender", "receiver")
+
+_DOC = ("committed wire contract: per comm-manager class and message "
+        "type (by wire value), the payload keys it may emit; 'envelope' "
+        "keys are stamped by the transport planes on every message.  "
+        "PRIV006 ratchets the static derivation against this file; the "
+        "runtime wire audit (FEDML_TPU_WIRE_AUDIT=1) counts observed "
+        "keys outside it into fedml_wire_contract_violations_total.  "
+        "Regenerate deliberately with "
+        "`python -m fedml_tpu.analysis.taint.wirecontract`.")
+
+#: an add-site whose message variable cannot be traced to a typed ctor
+WILDCARD_TYPE = "*"
+
+
+def contract_path(root) -> Path:
+    return Path(root) / CONTRACT_FILE
+
+
+def load_contract(root) -> Optional[Dict[str, Any]]:
+    """The committed contract, or None when the file is missing."""
+    p = contract_path(root)
+    if not p.is_file():
+        return None
+    data = json.loads(p.read_text(encoding="utf-8"))
+    return {"envelope": data.get("envelope", []),
+            "managers": data.get("managers", {})}
+
+
+def legal_keys(contract: Dict[str, Any], manager: str,
+               msg_type: Optional[str]) -> Set[str]:
+    """The key set a runtime observation is checked against.  Unknown
+    managers fall back to the union of every manager's keys — the audit
+    must not false-positive on a subclass the static pass named
+    differently, only on keys NO reviewed surface emits."""
+    env = set(contract.get("envelope", ()))
+    managers = contract.get("managers", {})
+    if manager in managers:
+        by_type = managers[manager]
+        out = set(env)
+        out.update(by_type.get(WILDCARD_TYPE, ()))
+        if msg_type is not None:
+            out.update(by_type.get(msg_type, ()))
+        return out
+    out = set(env)
+    for by_type in managers.values():
+        for keys in by_type.values():
+            out.update(keys)
+    return out
+
+
+#: derivation site: (owner label, msg type or "*", key or "?", path, line)
+Site = Tuple[str, str, str, str, int]
+
+
+def _msg_types_for(recv: ast.AST, func_node: ast.AST, index, modinfo,
+                   params) -> List[str]:
+    """Resolve the receiver message variable to ctor wire type values;
+    ``["*"]`` when the variable is a parameter / handler argument or the
+    ctor type does not resolve."""
+    if not isinstance(recv, ast.Name):
+        return [WILDCARD_TYPE]
+    name = recv.id
+    types: Set[str] = set()
+    for stmt in ast.walk(func_node):
+        if not (isinstance(stmt, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == name
+                        for t in stmt.targets)):
+            continue
+        v = stmt.value
+        if not isinstance(v, ast.Call):
+            continue
+        dn = astutil.dotted_name(v.func) or ""
+        if dn.rsplit(".", 1)[-1] != "Message":
+            continue
+        type_node = None
+        if v.args:
+            type_node = v.args[0]
+        else:
+            for kw in v.keywords:
+                if kw.arg == "type":
+                    type_node = kw.value
+        if type_node is not None:
+            values, _syms = resolve_type_expr(
+                type_node, index, modinfo, method_node=func_node,
+                params=params)
+            types |= values
+    return sorted(types) if types else [WILDCARD_TYPE]
+
+
+def collect_sites(contexts, index) -> List[Site]:
+    """Every ``msg.add_params(key, value)`` / ``msg.add(key, value)``
+    site in the package, with owner class, resolved message type(s) and
+    resolved key wire value ("?" when unresolvable)."""
+    sites: List[Site] = []
+    for ctx in contexts:
+        modinfo = index.modules.get(ctx.path)
+        if modinfo is None:
+            continue
+        parents = ctx.parents
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and len(node.args) == 2):
+                continue
+            if node.func.attr != "add_params":
+                if node.func.attr != "add":
+                    continue
+                rdn = astutil.dotted_name(node.func.value) or ""
+                from .engine import _msgish
+                if not _msgish(rdn.rsplit(".", 1)[-1]):
+                    continue
+            func_node = astutil.enclosing_function(node, parents)
+            if func_node is None:
+                continue
+            owner = ""
+            for anc in astutil.ancestors(node, parents):
+                if isinstance(anc, ast.ClassDef):
+                    owner = anc.name
+                    break
+            label = owner or f"{func_node.name}()"
+            params = [a.arg for a in func_node.args.args]
+            values, _syms = resolve_type_expr(
+                node.args[0], index, modinfo, method_node=func_node,
+                params=params)
+            key = "|".join(sorted(values)) if values else "?"
+            if "|" in key:
+                key = "?"   # ambiguous resolution is unreviewable too
+            for t in _msg_types_for(node.func.value, func_node, index,
+                                    modinfo, params):
+                sites.append((label, t, key, ctx.path, node.lineno))
+    return sites
+
+
+def derive_contract(contexts, index) -> Dict[str, Any]:
+    """The contract structure the ratchet compares and ``main`` writes.
+    Unresolvable keys ("?") are EXCLUDED — they are PRIV006 findings,
+    never committable."""
+    envelope: Set[str] = set(CTOR_KEYS)
+    managers: Dict[str, Dict[str, Set[str]]] = {}
+    for label, t, key, path, _line in collect_sites(contexts, index):
+        if key == "?":
+            continue
+        if path.startswith(ENVELOPE_PATH_PREFIX):
+            envelope.add(key)
+        else:
+            managers.setdefault(label, {}).setdefault(t, set()).add(key)
+    return {
+        "envelope": sorted(envelope),
+        "managers": {m: {t: sorted(keys)
+                         for t, keys in sorted(by_type.items())}
+                     for m, by_type in sorted(managers.items())},
+    }
+
+
+def flatten(contract: Dict[str, Any]) -> Set[Tuple[str, str, str]]:
+    """(owner, type, key) triples; envelope keys own the pseudo-owner
+    ``envelope`` so the ratchet diff is one flat set."""
+    out = {("envelope", WILDCARD_TYPE, k)
+           for k in contract.get("envelope", ())}
+    for m, by_type in contract.get("managers", {}).items():
+        for t, keys in by_type.items():
+            for k in keys:
+                out.add((m, t, k))
+    return out
+
+
+def write_contract(root, contract: Dict[str, Any]) -> Path:
+    p = contract_path(root)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    payload = {"_doc": _DOC,
+               "envelope": contract["envelope"],
+               "managers": contract["managers"]}
+    p.write_text(json.dumps(payload, indent=2, sort_keys=False) + "\n",
+                 encoding="utf-8")
+    return p
+
+
+def main() -> int:
+    from ..engine import default_root, parse_contexts
+    from ..wholeprogram import build_index
+
+    root = default_root()
+    contexts, errors = parse_contexts(root, None)
+    if errors:
+        raise RuntimeError(
+            f"{len(errors)} file(s) cannot be parsed; fix them first "
+            f"(the committed contract must come from a full scan)")
+    index = build_index(contexts)
+    contract = derive_contract(contexts, index)
+    p = write_contract(root, contract)
+    n = len(flatten(contract))
+    print(f"wrote {p} ({n} contract entries, "
+          f"{len(contract['managers'])} managers)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -586,11 +586,22 @@ def jobs_pod(pod_dir: str, slots: int, tick_s: float,
                    "blocking-call-under-lock, CONC005 condition-variable "
                    "misuse, CONC006 timeout-less shutdown waits); auto-on "
                    "when a CONC00[2-6] rule id is requested")
+@click.option("--taint", "taint", is_flag=True,
+              help="also run the privacy-taint pass: interprocedural "
+                   "source→sink dataflow proving raw client data never "
+                   "escapes (PRIV001 example escape, PRIV002 client-id "
+                   "metrics labels, PRIV003 secret escape, PRIV004 "
+                   "SecAgg bypass, PRIV005 tensor reprs in wire-path "
+                   "logs, PRIV006 wire-contract ratchet); auto-on when "
+                   "a PRIV rule id is requested")
+@click.option("--sarif", default=None, type=click.Path(), metavar="PATH",
+              help="also write the findings as SARIF 2.1.0 to PATH "
+                   "(CI annotation upload)")
 @click.option("--graph", default=None,
               type=click.Choice(["dot", "json"]),
               help="emit the send/handle graph instead of linting")
 @click.option("--list-rules", "list_rules", is_flag=True,
-              help="print the full five-tier rule catalog (ids, "
+              help="print the full six-tier rule catalog (ids, "
                    "severities, titles, doc anchors) and exit; "
                    "--format json for machine-readable output")
 @click.option("--root", default=None, type=click.Path(exists=True),
@@ -598,7 +609,8 @@ def jobs_pod(pod_dir: str, slots: int, tick_s: float,
                    "fedml_tpu package)")
 def lint(fmt: str, baseline: str, update_baseline: bool, paths,
          rules: str, whole_program: bool, perf: bool, mesh: bool,
-         conc: bool, graph: str, list_rules: bool, root: str) -> None:
+         conc: bool, taint: bool, sarif: str, graph: str,
+         list_rules: bool, root: str) -> None:
     """JAX-aware static analysis with a CI ratchet (docs/STATIC_ANALYSIS.md).
 
     Exit codes: 0 clean, 1 new (unbaselined) findings, 2 internal error."""
@@ -610,7 +622,8 @@ def lint(fmt: str, baseline: str, update_baseline: bool, paths,
         root=root, paths=list(paths) or None, fmt=fmt, baseline=baseline,
         update_baseline=update_baseline, rule_ids=rule_ids,
         whole_program=whole_program, perf=perf, mesh=mesh, conc=conc,
-        graph=graph, list_rules=list_rules, echo=click.echo))
+        taint=taint, graph=graph, list_rules=list_rules, sarif=sarif,
+        echo=click.echo))
 
 
 @cli.command()
@@ -725,6 +738,59 @@ def conc_report(snapshot_path: str, check_dag: bool,
         if frac > max_overhead:
             click.echo(f"fedml conc: recorder overhead {frac:.4f} exceeds "
                        f"budget {max_overhead:.4f}")
+            failed = True
+    raise SystemExit(1 if failed else 0)
+
+
+@cli.group()
+def taint() -> None:
+    """Wire-audit utilities over a snapshot produced by the opt-in
+    runtime recorder (FEDML_TPU_WIRE_AUDIT=1, docs/STATIC_ANALYSIS.md
+    "Privacy-taint tier")."""
+
+
+@taint.command("report")
+@click.option("--snapshot", "snapshot_path", required=True,
+              type=click.Path(exists=True),
+              help="wire-audit snapshot JSON (wire_audit.dump() output)")
+@click.option("--check-contract", is_flag=True,
+              help="fail (exit 1) when an observed payload key is "
+                   "missing from the committed wire contract "
+                   "(benchmarks/wire_contract.json)")
+@click.option("--max-overhead", default=None, type=float, metavar="FRAC",
+              help="fail (exit 1) when the recorder's self-measured "
+                   "overhead fraction exceeds FRAC (CI uses 0.02)")
+@click.option("--root", default=None, type=click.Path(exists=True),
+              help="checkout root holding benchmarks/wire_contract.json "
+                   "(default: the directory containing the fedml_tpu "
+                   "package)")
+def taint_report(snapshot_path: str, check_contract: bool,
+                 max_overhead: float, root: str) -> None:
+    """Per-manager observed wire keys from a runtime wire-audit
+    snapshot; --check-contract gates observed keys against the taint
+    tier's committed wire contract."""
+    from ..analysis.engine import default_root
+    from ..analysis.taint import wirecontract
+    from ..core.mlops import wire_audit
+
+    with open(snapshot_path, "r", encoding="utf-8") as fh:
+        snap = json.load(fh)
+    failed = False
+    extras = None
+    if check_contract:
+        contract = wirecontract.load_contract(root or default_root())
+        if contract is None:
+            raise click.ClickException(
+                "no committed wire contract — run "
+                "`python -m fedml_tpu.analysis.taint.wirecontract` first")
+        extras = wire_audit.check_contract(snap, contract)
+        failed = failed or bool(extras)
+    click.echo(wire_audit.render_report(snap, extras=extras))
+    if max_overhead is not None:
+        frac = float(snap.get("overhead_frac") or 0.0)
+        if frac > max_overhead:
+            click.echo(f"fedml taint: recorder overhead {frac:.4f} "
+                       f"exceeds budget {max_overhead:.4f}")
             failed = True
     raise SystemExit(1 if failed else 0)
 

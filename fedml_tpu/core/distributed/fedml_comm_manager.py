@@ -20,6 +20,7 @@ import threading
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional
 
+from ..mlops import wire_audit
 from .communication.base_com_manager import BaseCommunicationManager
 from .communication.message import Message
 from .communication.observer import Observer
@@ -96,6 +97,11 @@ class FedMLCommManager(Observer):
         return self.rank
 
     def send_message(self, message: Message) -> None:
+        # opt-in wire-contract audit (FEDML_TPU_WIRE_AUDIT=1): record the
+        # payload keys this manager puts on the wire BEFORE any wrapper
+        # stamps its envelope — one enabled() check when disarmed
+        if wire_audit.enabled():
+            wire_audit.observe(type(self).__name__, message)
         self.com_manager.send_message(message)
 
     def register_message_receive_handler(self, msg_type: Any,

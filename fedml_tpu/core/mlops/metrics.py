@@ -29,6 +29,19 @@ from typing import Any, Dict, Iterable, Optional, Sequence, Tuple
 DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
                    2.5, 5.0, 10.0)
 
+#: per-metric cap on distinct label sets.  A label fed from an unbounded
+#: domain (a per-client id, a request id) would otherwise grow the
+#: exporter without limit — the cardinality explosion PRIV002 hunts
+#: statically; this is the runtime backstop.  Writes past the cap land in
+#: a shared overflow child (never exported) and count into
+#: ``fedml_metrics_dropped_labels_total{metric=...}``.
+MAX_LABEL_SETS = 512
+
+#: the drop counter is exempt from the cap (its own label domain is the
+#: set of metric NAMES, bounded) — exempting it also breaks the
+#: would-be recursion of a drop incrementing the drop counter.
+DROPPED_METRIC = "fedml_metrics_dropped_labels_total"
+
 
 def _fmt(v: float) -> str:
     """Prometheus sample value: integers render bare, +Inf as +Inf."""
@@ -151,6 +164,11 @@ class _Metric:
         self.buckets = tuple(sorted(float(b) for b in buckets))
         self._lock = threading.Lock()
         self._children: Dict[Tuple[str, ...], _Child] = {}
+        #: shared sink for label sets past MAX_LABEL_SETS: absorbs writes
+        #: (callers keep working) but is never exported
+        self._overflow: Optional[_Child] = None
+        #: owning registry, for routing drop counts (set by _get_or_create)
+        self._registry: Optional["MetricsRegistry"] = None
 
     def labels(self, **labels: Any) -> Any:
         if set(labels) != set(self.label_names):
@@ -158,10 +176,30 @@ class _Metric:
                 f"{self.name}: expected labels {self.label_names}, "
                 f"got {tuple(labels)}")
         key = tuple(str(labels[n]) for n in self.label_names)
+        dropped = False
         with self._lock:
             child = self._children.get(key)
             if child is None:
-                child = self._children[key] = _CHILD_TYPES[self.kind](self)
+                if (self.name != DROPPED_METRIC
+                        and len(self._children) >= MAX_LABEL_SETS):
+                    if self._overflow is None:
+                        self._overflow = _CHILD_TYPES[self.kind](self)
+                    child = self._overflow
+                    dropped = True
+                else:
+                    child = self._children[key] = \
+                        _CHILD_TYPES[self.kind](self)
+        if dropped:
+            # incremented AFTER releasing this metric's lock: the drop
+            # counter is a sibling metric with its own lock — nesting the
+            # two would add a metric→metric edge to the lock-order DAG
+            reg = self._registry
+            if reg is not None:
+                reg.counter(
+                    DROPPED_METRIC,
+                    "Label-set writes dropped by the per-metric "
+                    "cardinality cap (MAX_LABEL_SETS)",
+                    labels=("metric",)).labels(metric=self.name).inc()
         return child
 
     def children(self) -> Dict[Tuple[str, ...], _Child]:
@@ -242,6 +280,7 @@ class MetricsRegistry:
                         f"{m.label_names}")
                 return m
             m = _Metric(name, help, kind, labels, buckets)
+            m._registry = self
             self._metrics[name] = m
             return m
 

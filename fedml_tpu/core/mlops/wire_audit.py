@@ -1,0 +1,248 @@
+"""Opt-in runtime wire-contract audit — the dynamic half of the taint tier.
+
+The static side (``analysis/taint``) derives the wire contract — per
+comm-manager class and message type, the payload keys it may put on the
+wire — and PRIV006 ratchets the derivation against the committed
+``benchmarks/wire_contract.json``.  This module records what the running
+control plane actually SENDS: every ``FedMLCommManager.send_message``
+call reports its payload keys here, and keys outside the committed
+contract count into ``fedml_wire_contract_violations_total``.  ``fedml
+taint report`` renders a snapshot and can gate observed keys against the
+contract — the CI wire-audit soak asserts observed ⊆ committed, so a
+code path that smuggles a new payload key onto the wire fails the build
+instead of exfiltrating in production.
+
+The idiom is the lock profiler's, exactly:
+
+* **opt-in** — ``FEDML_TPU_WIRE_AUDIT=1`` (or ``arm()`` from tests);
+* **free when off** — the send-path hook is one ``enabled()`` check;
+* **self-measuring** — bookkeeping time accumulates into
+  ``overhead_s``; the CI budget is <2%;
+* **bounded** — the recording dicts grow with distinct (manager class,
+  message type, payload key) triples, which are static identifiers.
+
+Legality memoizes per (manager, msg_type): the armed per-message cost is
+one dict hit plus a set-difference over that message's keys.  Observation
+happens BEFORE the reliability wrapper stamps its envelope, so ``rel_*``
+keys never reach the recorder (they are contract envelope keys anyway).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, FrozenSet, List, Optional, Tuple
+
+from . import metrics as _metrics
+
+#: armed override: None → follow the env toggle; True/False → forced
+#: (tests / the soak harness call ``arm()`` instead of mutating environ)
+_armed: Optional[bool] = None
+
+_state_lock = threading.Lock()
+_state: Dict[str, Any] = {
+    "t0": time.monotonic(),
+    "overhead_s": 0.0,
+    "messages": 0,
+    # (manager, msg_type) → {key → count}
+    "observed": {},
+    # (manager, msg_type, key) → count, for keys OUTSIDE the contract
+    "violations": {},
+}
+#: committed contract, loaded lazily on first armed observe.  The
+#: sentinel False means "not loaded yet"; None means "loaded, absent".
+_contract: Any = False
+#: (manager, msg_type) → legal key set (None when no contract committed)
+_legal_memo: Dict[Tuple[str, str], Optional[FrozenSet[str]]] = {}
+#: violation counts already pushed onto the metrics counter (snapshot
+#: pushes DELTAS so the counter stays monotone across snapshots)
+_pushed: Dict[Tuple[str, str, str], int] = {}
+
+
+def enabled() -> bool:
+    if _armed is not None:
+        return _armed
+    return os.environ.get("FEDML_TPU_WIRE_AUDIT", "").lower() in (
+        "1", "true", "yes", "on")
+
+
+def arm(on: bool = True) -> None:
+    """Programmatic arm/disarm (tests, the CI soak).  Resets the
+    recording state and re-reads the committed contract."""
+    global _armed
+    _armed = bool(on)
+    reset()
+
+
+def reset() -> None:
+    global _contract
+    with _state_lock:
+        _state["t0"] = time.monotonic()
+        _state["overhead_s"] = 0.0
+        _state["messages"] = 0
+        _state["observed"] = {}
+        _state["violations"] = {}
+        _contract = False
+        _legal_memo.clear()
+        _pushed.clear()
+
+
+def _legal_for(manager: str, msg_type: str) -> Optional[FrozenSet[str]]:
+    """Memoized legal key set; None when no contract is committed
+    (observation still records, violation counting is off)."""
+    global _contract
+    key = (manager, msg_type)
+    hit = _legal_memo.get(key)
+    if hit is not None or key in _legal_memo:
+        return hit
+    if _contract is False:
+        from ...analysis.taint import wirecontract
+
+        _contract = wirecontract.load_contract(_find_root())
+    if _contract is None:
+        _legal_memo[key] = None
+        return None
+    from ...analysis.taint import wirecontract
+
+    legal = frozenset(wirecontract.legal_keys(_contract, manager, msg_type))
+    _legal_memo[key] = legal
+    return legal
+
+
+def _find_root() -> str:
+    """Checkout root holding benchmarks/wire_contract.json — the parent
+    of the fedml_tpu package (matches analysis.engine.default_root)."""
+    here = os.path.dirname(os.path.abspath(__file__))   # core/mlops
+    return os.path.dirname(os.path.dirname(os.path.dirname(here)))
+
+
+def observe(manager: str, message: Any) -> None:
+    """Record one outbound message's payload keys.  Called from
+    ``FedMLCommManager.send_message`` when armed; ``manager`` is the
+    concrete comm-manager class name (the contract's owner id)."""
+    # the legality lookup is OUTSIDE the timed region: its first call
+    # parses the committed contract (one-time setup, not per-message
+    # bookkeeping — the analogue of the lock profiler excluding wait
+    # time); every later call is a memo-dict hit
+    legal = _legal_for(manager, str(message.get_type()))
+    t0 = time.perf_counter()
+    keys = tuple(message.get_params())
+    msg_type = str(message.get_type())
+    bad = () if legal is None else tuple(k for k in keys if k not in legal)
+    with _state_lock:
+        _state["messages"] += 1
+        rec = _state["observed"].setdefault((manager, msg_type), {})
+        for k in keys:
+            rec[k] = rec.get(k, 0) + 1
+        for k in bad:
+            vk = (manager, msg_type, k)
+            _state["violations"][vk] = _state["violations"].get(vk, 0) + 1
+        _state["overhead_s"] += time.perf_counter() - t0
+
+
+# -- snapshot / report --------------------------------------------------------
+
+def snapshot() -> Dict[str, Any]:
+    """Copy the recording state and push violation DELTAS onto the
+    ``fedml_wire_contract_violations_total`` counter (registry updates
+    happen HERE, not per-send, so the armed hot path stays dict-cheap)."""
+    with _state_lock:
+        elapsed = max(time.monotonic() - _state["t0"], 1e-9)
+        messages = _state["messages"]
+        observed = {k: dict(v) for k, v in _state["observed"].items()}
+        violations = dict(_state["violations"])
+        overhead = _state["overhead_s"]
+    ctr = _metrics.counter(
+        "fedml_wire_contract_violations_total",
+        "Outbound payload keys outside the committed wire contract "
+        "(FEDML_TPU_WIRE_AUDIT=1)",
+        labels=("manager", "msg_type", "key"))
+    for (mgr, mt, key), n in violations.items():
+        delta = n - _pushed.get((mgr, mt, key), 0)
+        if delta > 0:
+            ctr.labels(manager=mgr, msg_type=mt, key=key).inc(delta)
+            _pushed[(mgr, mt, key)] = n
+    _metrics.gauge(
+        "fedml_wire_audit_overhead_frac",
+        "Self-measured wire-audit bookkeeping time / elapsed").set(
+        overhead / elapsed)
+    return {
+        "armed": enabled(),
+        "contract_loaded": _contract not in (False, None),
+        "elapsed_s": round(elapsed, 6),
+        "overhead_s": round(overhead, 6),
+        "overhead_frac": overhead / elapsed,
+        "messages": messages,
+        "observed": [
+            {"manager": mgr, "msg_type": mt,
+             "keys": {k: n for k, n in sorted(keys.items())}}
+            for (mgr, mt), keys in sorted(observed.items())],
+        "violations": [
+            [mgr, mt, key, n]
+            for (mgr, mt, key), n in sorted(violations.items())],
+    }
+
+
+def dump(path: str) -> str:
+    """Write ``snapshot()`` as JSON — the artifact ``fedml taint
+    report`` consumes offline (the soak's equivalent of metrics.prom)."""
+    snap = snapshot()
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(snap, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def check_contract(snap: Dict[str, Any],
+                   contract: Optional[Dict[str, Any]] = None
+                   ) -> List[Tuple[str, str, str]]:
+    """(manager, msg_type, key) triples the runtime sent that the
+    committed contract does not allow — empty means observed ⊆ committed
+    (the soak gate).  Re-checks the OBSERVED table against ``contract``
+    when given, so a snapshot taken before the contract was committed
+    can still be gated offline."""
+    if contract is None:
+        return [tuple(v[:3]) for v in snap.get("violations", [])]
+    from ...analysis.taint import wirecontract
+
+    out = []
+    for rec in snap.get("observed", []):
+        legal = wirecontract.legal_keys(
+            contract, rec["manager"], rec["msg_type"])
+        for key in rec.get("keys", {}):
+            if key not in legal:
+                out.append((rec["manager"], rec["msg_type"], key))
+    return sorted(set(out))
+
+
+def render_report(snap: Dict[str, Any],
+                  extras: Optional[List[Tuple[str, str, str]]] = None
+                  ) -> str:
+    """The ``fedml taint report`` text view: per-manager observed wire
+    keys and any keys outside the committed contract."""
+    out = [f"wire audit: armed={snap.get('armed')}  "
+           f"messages {snap.get('messages', 0)}  "
+           f"elapsed {snap.get('elapsed_s', 0.0):.2f}s  "
+           f"overhead {snap.get('overhead_frac', 0.0):.3%}"]
+    observed = snap.get("observed") or []
+    if not observed:
+        out.append("(no observed sends — arm with FEDML_TPU_WIRE_AUDIT=1 "
+                   "and run traffic through FedMLCommManager)")
+    for rec in observed:
+        keys = rec.get("keys", {})
+        out.append(f"  {rec['manager']}  [{rec['msg_type']}]  "
+                   f"keys: {', '.join(sorted(keys))}")
+    if extras is not None:
+        if extras:
+            out.append("KEYS OUTSIDE THE COMMITTED WIRE CONTRACT "
+                       "(benchmarks/wire_contract.json):")
+            for mgr, mt, key in extras:
+                out.append(f"  {mgr}  [{mt}]  {key}")
+        else:
+            out.append("observed keys ⊆ committed wire contract: OK")
+    return "\n".join(out)

@@ -155,6 +155,13 @@ class ClientMasterManager(FedMLCommManager):
                 weights, n_samples = self.trainer_dist_adapter.train(
                     self.round_idx)
                 mlops.event("train", False, self.round_idx)
+        if logging.getLogger().isEnabledFor(logging.DEBUG):
+            # structure-only summary (shapes/dtypes/bytes, never values):
+            # the sanctioned way to log a payload
+            from ...utils.redact import summarize_payload
+
+            logging.debug("client %d: round %d upload: %s", self.rank,
+                          self.round_idx, summarize_payload(weights))
         msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
                       self.get_sender_id(), 0)
         msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)

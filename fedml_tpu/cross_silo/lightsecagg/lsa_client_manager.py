@@ -129,7 +129,7 @@ class LSAClientManager(FedMLCommManager):
         up = Message(LSAMessage.MSG_TYPE_C2S_MASKED_MODEL,
                      self.get_sender_id(), 0)
         up.add_params(LSAMessage.ARG_MASKED_VECTOR, masked)
-        up.add_params(LSAMessage.ARG_NUM_SAMPLES, n_samples)
+        up.add_params(LSAMessage.ARG_NUM_SAMPLES, int(n_samples))
         self.send_message(up)
 
     def handle_share(self, msg: Message) -> None:

@@ -161,7 +161,7 @@ class SAClientManager(FedMLCommManager):
         up = Message(SAMessage.MSG_TYPE_C2S_MASKED_MODEL,
                      self.get_sender_id(), 0)
         up.add_params(SAMessage.ARG_MASKED_VECTOR, y)
-        up.add_params(SAMessage.ARG_NUM_SAMPLES, n_samples)
+        up.add_params(SAMessage.ARG_NUM_SAMPLES, int(n_samples))
         up.add_params(SAMessage.ARG_ROUND, rnd)
         self.send_message(up)
 

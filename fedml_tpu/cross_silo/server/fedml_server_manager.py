@@ -752,6 +752,14 @@ class FedMLServerManager(FedMLCommManager):
                     compressed, tree_spec(global_model))
                 model_params = jax.tree_util.tree_map(
                     lambda g, d: g + d, global_model, delta)
+            if logging.getLogger().isEnabledFor(logging.DEBUG):
+                # structure-only summary (shapes/dtypes/bytes, never
+                # values): the sanctioned way to log a payload
+                from ...utils.redact import summarize_payload
+
+                logging.debug("server: round %d upload from client %d: %s",
+                              int(self.args.round_idx), sender,
+                              summarize_payload(model_params))
             train_metrics = msg.get(MyMessage.MSG_ARG_KEY_TRAIN_METRICS)
             if isinstance(train_metrics, dict) and train_metrics:
                 self._round_train_metrics[sender] = train_metrics

@@ -203,7 +203,7 @@ class _EngineMetrics:
             req.span.set_attr("tokens", req.n_generated)
             req.span.end(None if outcome == "finish" else outcome)
 
-    def note_decode(self, dt: float, batch: int) -> None:
+    def note_decode(self, dt: float, batch_size: int) -> None:
         """Sampled run-ledger attribution for the decode loop: one
         ``decode_batch`` event per DECODE_LEDGER_EVERY dispatches."""
         if not ledger.enabled():
@@ -217,7 +217,7 @@ class _EngineMetrics:
             self._decode_steps = 0
             self._decode_secs = 0.0
         ledger.event("serving", "decode_batch", engine=self.label,
-                     steps=steps, secs=round(secs, 6), batch=batch)
+                     steps=steps, secs=round(secs, 6), batch=batch_size)
 
 
 _scatter_cache_row_jit = None

@@ -1,0 +1,80 @@
+"""Value-free payload summaries for logs and diagnostics.
+
+``repr()`` of a model update or a training batch in a log line ships the
+raw numbers off-device — exactly the escape PRIV005 hunts.  But the wire
+path still needs payload observability ("what did this sync carry?").
+``summarize_payload`` is the sanctioned form: STRUCTURE ONLY — leaf
+paths, shapes, dtypes and byte counts — never element values.  It is a
+registered declassifier in the taint catalog
+(``analysis/taint/catalog.py``), so flows through it are clean by
+construction; logging anything else tensor-shaped on the wire path is a
+finding.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+from .serialization import estimate_nbytes
+
+#: leaf descriptors shown before eliding — keeps log lines bounded even
+#: for thousand-leaf LLM trees
+MAX_LEAVES_SHOWN = 8
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024.0
+    return f"{n}B"
+
+
+def _leaf_desc(obj: Any) -> str:
+    shape = getattr(obj, "shape", None)
+    dtype = getattr(obj, "dtype", None)
+    if shape is not None:
+        dims = "x".join(str(d) for d in tuple(shape)) or "scalar"
+        return f"{dims}:{dtype}" if dtype is not None else dims
+    if isinstance(obj, bool):
+        return "bool"
+    if isinstance(obj, int):
+        return "int"
+    if isinstance(obj, float):
+        return "float"
+    if isinstance(obj, str):
+        return f"str[{len(obj)}]"
+    if isinstance(obj, (bytes, bytearray)):
+        return f"bytes[{len(obj)}]"
+    if obj is None:
+        return "none"
+    return type(obj).__name__
+
+
+def _walk(obj: Any, path: str, out: List[Tuple[str, str]]) -> None:
+    if isinstance(obj, dict):
+        for k in obj:
+            _walk(obj[k], f"{path}.{k}" if path else str(k), out)
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            _walk(v, f"{path}[{i}]", out)
+    else:
+        out.append((path or "<root>", _leaf_desc(obj)))
+
+
+def summarize_payload(obj: Any, max_leaves: int = MAX_LEAVES_SHOWN) -> str:
+    """Shape/dtype/nbytes summary of a payload pytree — NEVER values.
+
+    ``summarize_payload({"w": np.zeros((3, 4)), "n": 7})`` →
+    ``"2 leaves, 104B: n=int, w=3x4:float64"``.  Safe on any object: an
+    unrecognized leaf renders as its type name.
+    """
+    leaves: List[Tuple[str, str]] = []
+    _walk(obj, "", leaves)
+    nbytes = estimate_nbytes(obj)
+    shown = sorted(leaves)[:max_leaves]
+    parts = [f"{p}={d}" for p, d in shown]
+    if len(leaves) > max_leaves:
+        parts.append(f"... +{len(leaves) - max_leaves} more")
+    head = f"{len(leaves)} leaves, {_fmt_bytes(nbytes)}"
+    return f"{head}: {', '.join(parts)}" if parts else head

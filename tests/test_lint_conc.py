@@ -281,10 +281,10 @@ def test_conc_fingerprints_stable_under_line_drift(tmp_path):
     assert before == after
 
 
-def test_update_baseline_covers_all_five_tiers(tmp_path):
+def test_update_baseline_covers_all_six_tiers(tmp_path):
     # --update-baseline must sweep EVERY tier (file + whole-program +
-    # perf + mesh + conc): a baseline written from a partial scan would
-    # let the missing tier's findings land as "new" on main
+    # perf + mesh + conc + taint): a baseline written from a partial
+    # scan would let the missing tier's findings land as "new" on main
     _write(tmp_path, "fedml_tpu/mod.py", CONC006_HANG.format(noqa=""))
     _write(tmp_path, "fedml_tpu/jaxy.py", """\
         import jax
@@ -314,14 +314,14 @@ def test_conc_rule_id_filter_enables_the_pass(tmp_path):
     assert [f["rule"] for f in report["findings"]] == ["CONC006"]
 
 
-def test_list_rules_prints_five_tier_catalog(tmp_path):
+def test_list_rules_prints_six_tier_catalog(tmp_path):
     lines = []
     assert run_cli(root=str(tmp_path), list_rules=True, fmt="json",
                    echo=lines.append) == 0
     catalog = json.loads("\n".join(lines))
     tiers = [t["tier"] for t in catalog["tiers"]]
-    assert tiers == ["file", "program", "perf", "mesh", "conc"]
+    assert tiers == ["file", "program", "perf", "mesh", "conc", "taint"]
     assert all(t["doc"] for t in catalog["tiers"])
     ids = {r["id"] for t in catalog["tiers"] for r in t["rules"]}
     assert {"JAX001", "PROTO002", "PERF001", "SHARD002",
-            "CONC002", "CONC003", "CONC006"} <= ids
+            "CONC002", "CONC003", "CONC006", "PRIV001", "PRIV006"} <= ids

@@ -1,0 +1,363 @@
+"""fedml lint --taint: the privacy-taint tier (PRIV001-PRIV006), its
+noqa/fingerprint/baseline integration, and the wire-contract ratchet."""
+
+from __future__ import annotations
+
+import json
+import textwrap
+import time
+from pathlib import Path
+
+from fedml_tpu.analysis import run_cli, run_lint
+from fedml_tpu.analysis.engine import parse_contexts
+from fedml_tpu.analysis.taint import run_taint_pass
+from fedml_tpu.analysis.taint.wirecontract import (
+    derive_contract,
+    legal_keys,
+    load_contract,
+    write_contract,
+)
+from fedml_tpu.analysis.wholeprogram import build_index
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def _write(tmp_path, relpath: str, source: str):
+    f = tmp_path / relpath
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(source))
+    return f
+
+
+def _lint(tmp_path, rules):
+    return run_lint(root=tmp_path, rule_ids=rules)
+
+
+def _ids(result):
+    return [f.rule_id for f in result.findings]
+
+
+# -- PRIV001: raw example escape ----------------------------------------------
+
+PRIV001_LEAK = """\
+    import logging
+
+    def debug_round(loader):
+        batch = loader.next_batch()
+        logging.info("first batch %s", batch){noqa}
+"""
+
+
+def test_priv001_fires_on_logged_batch(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", PRIV001_LEAK.format(noqa=""))
+    res = _lint(tmp_path, ["PRIV001"])
+    assert _ids(res) == ["PRIV001"]
+    assert "raw client example" in res.findings[0].message
+    assert "summarize_payload" in res.findings[0].message
+
+
+def test_priv001_fixed_by_declassifier(tmp_path):
+    # len()/summarize_payload() are declassifiers: shape-level facts out
+    _write(tmp_path, "fedml_tpu/mod.py", """\
+        import logging
+
+        def debug_round(loader):
+            batch = loader.next_batch()
+            logging.info("batch of %d", len(batch))
+            logging.info("batch %s", summarize_payload(batch))
+    """)
+    assert _ids(_lint(tmp_path, ["PRIV001"])) == []
+
+
+def test_priv001_noqa_suppresses(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py",
+           PRIV001_LEAK.format(noqa="  # fedml: noqa[PRIV001]"))
+    assert _ids(_lint(tmp_path, ["PRIV001"])) == []
+
+
+def test_priv001_flows_through_unknown_helper(tmp_path):
+    # taint survives an unknown call: pretty(batch) is NOT a declassifier
+    _write(tmp_path, "fedml_tpu/mod.py", """\
+        import logging
+
+        def debug_round(loader):
+            batch = loader.next_batch()
+            text = pretty(batch)
+            logging.info("rows %s", text)
+    """)
+    assert _ids(_lint(tmp_path, ["PRIV001"])) == ["PRIV001"]
+
+
+def test_priv001_wire_sink(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", """\
+        def upload(msg, train_data):
+            msg.add_params("debug_rows", train_data)
+    """)
+    res = _lint(tmp_path, ["PRIV001"])
+    assert _ids(res) == ["PRIV001"]
+    assert "Message payload" in res.findings[0].message
+
+
+# -- PRIV002: client-id in metrics labels -------------------------------------
+
+PRIV002_LEAK = """\
+    from fedml_tpu.core.mlops import metrics
+
+    def record(client_id, dt):
+        h = metrics.histogram("t", "t", labels=("client",))
+        h.labels(client=client_id).observe(dt)
+"""
+
+
+def test_priv002_fires_on_client_id_label(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", PRIV002_LEAK)
+    res = _lint(tmp_path, ["PRIV002"])
+    assert _ids(res) == ["PRIV002"]
+    assert "cardinality" in res.findings[0].message
+
+
+def test_priv002_fixed_by_bounded_label(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", PRIV002_LEAK.replace(
+        "client=client_id", 'client="all"'))
+    assert _ids(_lint(tmp_path, ["PRIV002"])) == []
+
+
+def test_priv002_ledger_is_sanctioned(tmp_path):
+    # the run ledger is the per-client surface — client_id is legal there
+    _write(tmp_path, "fedml_tpu/mod.py", """\
+        def record(ledger, client_id, dt):
+            ledger.event("server", "train", client=client_id, dt=dt)
+    """)
+    assert _ids(_lint(tmp_path, ["PRIV002"])) == []
+
+
+# -- PRIV003: secret escape ---------------------------------------------------
+
+
+def test_priv003_fires_on_logged_seed(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", """\
+        import logging
+
+        def keygen(rng):
+            prng_key = rng.PRNGKey(0)
+            logging.info("key %s", prng_key)
+    """)
+    res = _lint(tmp_path, ["PRIV003"])
+    assert _ids(res) == ["PRIV003"]
+    assert "secret material" in res.findings[0].message
+
+
+def test_priv003_share_channel_keys_sanctioned(tmp_path):
+    # Shamir shares MAY travel on the named share-channel wire keys —
+    # any other key is an escape
+    _write(tmp_path, "fedml_tpu/mod.py", """\
+        def distribute(msg, b_shares):
+            msg.add_params("b_shares", b_shares)
+
+        def leak(msg, b_shares):
+            msg.add_params("debug_blob", b_shares)
+    """)
+    res = _lint(tmp_path, ["PRIV003"])
+    assert len(res.findings) == 1
+    assert res.findings[0].rule_id == "PRIV003"
+    assert "leak" in res.findings[0].message
+
+
+def test_priv003_digest_fixes(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", """\
+        import logging
+
+        def keygen(rng):
+            prng_key = rng.PRNGKey(0)
+            logging.info("key fp %s", hash(prng_key))
+    """)
+    assert _ids(_lint(tmp_path, ["PRIV003"])) == []
+
+
+# -- PRIV004: SecAgg bypass ---------------------------------------------------
+
+PRIV004_BYPASS = """\
+    class EagerClientManager:
+        def upload(self, msg, adapter):
+            weights, n = adapter.train(0)
+            msg.add_params("model_params", weights)
+            msg.add_params("num_samples", int(n))
+"""
+
+
+def test_priv004_fires_on_unmasked_upload(tmp_path):
+    _write(tmp_path, "fedml_tpu/cross_silo/secagg/mgr.py", PRIV004_BYPASS)
+    res = _lint(tmp_path, ["PRIV004"])
+    assert _ids(res) == ["PRIV004"]
+    assert "mask funnel" in res.findings[0].message
+
+
+def test_priv004_mask_funnel_fixes(tmp_path):
+    _write(tmp_path, "fedml_tpu/cross_silo/secagg/mgr.py", """\
+        class MaskedClientManager:
+            def upload(self, msg, adapter, peers, seeds):
+                weights, n = adapter.train(0)
+                y = mask_upload(weights, 7, 1, peers, seeds)
+                msg.add_params("masked_vector", y)
+                msg.add_params("num_samples", int(n))
+    """)
+    assert _ids(_lint(tmp_path, ["PRIV004"])) == []
+
+
+def test_priv004_scoped_to_secagg_client_paths(tmp_path):
+    # same code OUTSIDE secagg/ (plain FedAvg) is not a bypass, and the
+    # secagg SERVER broadcasting the aggregate is sanctioned
+    _write(tmp_path, "fedml_tpu/cross_silo/client/mgr.py", PRIV004_BYPASS)
+    _write(tmp_path, "fedml_tpu/cross_silo/secagg/srv.py",
+           PRIV004_BYPASS.replace("EagerClientManager",
+                                  "AggServerManager"))
+    assert _ids(_lint(tmp_path, ["PRIV004"])) == []
+
+
+# -- PRIV005: tensor repr in wire-path logs -----------------------------------
+
+PRIV005_LEAK = """\
+    import logging
+
+    def sync(weights):
+        logging.debug("global model %s", weights)
+"""
+
+
+def test_priv005_fires_on_wire_path_only(tmp_path):
+    _write(tmp_path, "fedml_tpu/cross_silo/mod.py", PRIV005_LEAK)
+    _write(tmp_path, "fedml_tpu/train/mod.py", PRIV005_LEAK)
+    res = _lint(tmp_path, ["PRIV005"])
+    assert _ids(res) == ["PRIV005"]
+    assert res.findings[0].path == "fedml_tpu/cross_silo/mod.py"
+    assert "summarize_payload" in res.findings[0].message
+
+
+def test_priv005_summary_fixes(tmp_path):
+    _write(tmp_path, "fedml_tpu/cross_silo/mod.py", """\
+        import logging
+
+        def sync(weights):
+            logging.debug("global model %s", summarize_payload(weights))
+    """)
+    assert _ids(_lint(tmp_path, ["PRIV005"])) == []
+
+
+# -- PRIV006: the wire-contract ratchet ---------------------------------------
+
+MANAGER = """\
+    class FooManager:
+        def send(self):
+            msg = Message("SYNC", 0, 1)
+            msg.add_params("custom_key", 1)
+            return msg
+"""
+
+
+def _derived(tmp_path):
+    contexts, errors = parse_contexts(Path(tmp_path), None)
+    assert not errors
+    return derive_contract(contexts, build_index(contexts))
+
+
+def test_priv006_new_key_flagged_until_committed(tmp_path):
+    _write(tmp_path, "fedml_tpu/mgr.py", MANAGER)
+    res = _lint(tmp_path, ["PRIV006"])
+    assert "PRIV006" in _ids(res)
+    assert any("custom_key" in f.message and "[SYNC]" in f.message
+               for f in res.findings)
+    assert any("no committed wire contract" in n for n in res.notes)
+    # commit the derived contract → the ratchet goes quiet
+    write_contract(tmp_path, _derived(tmp_path))
+    res = _lint(tmp_path, ["PRIV006"])
+    assert _ids(res) == []
+    assert res.notes == []
+
+
+def test_priv006_unresolvable_key_always_reports(tmp_path):
+    _write(tmp_path, "fedml_tpu/mgr.py", """\
+        class FooManager:
+            def send(self, key):
+                msg = Message("SYNC", 0, 1)
+                msg.add_params(key, 1)
+                return msg
+    """)
+    write_contract(tmp_path, _derived(tmp_path))
+    res = _lint(tmp_path, ["PRIV006"])
+    assert _ids(res) == ["PRIV006"]
+    assert "cannot be resolved" in res.findings[0].message
+
+
+def test_priv006_stale_committed_entry_noted(tmp_path):
+    _write(tmp_path, "fedml_tpu/mgr.py", MANAGER)
+    contract = _derived(tmp_path)
+    contract["managers"]["FooManager"]["SYNC"].append("gone_key")
+    write_contract(tmp_path, contract)
+    res = _lint(tmp_path, ["PRIV006"])
+    assert _ids(res) == []
+    assert any("no longer derived" in n and "gone_key" in n
+               for n in res.notes)
+
+
+def test_legal_keys_unknown_manager_falls_back_to_union(tmp_path):
+    _write(tmp_path, "fedml_tpu/mgr.py", MANAGER)
+    write_contract(tmp_path, _derived(tmp_path))
+    contract = load_contract(tmp_path)
+    assert "custom_key" in legal_keys(contract, "FooManager", "SYNC")
+    assert "custom_key" not in legal_keys(contract, "FooManager", "OTHER")
+    # subclass the static pass never saw: union fallback, no false alarm
+    assert "custom_key" in legal_keys(contract, "SubFooManager", "SYNC")
+    assert "nope" not in legal_keys(contract, "SubFooManager", "SYNC")
+
+
+# -- tier integration ---------------------------------------------------------
+
+
+def test_taint_flag_enables_the_tier(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", PRIV001_LEAK.format(noqa=""))
+    lines = []
+    code = run_cli(root=str(tmp_path), taint=True, fmt="json",
+                   echo=lines.append)
+    assert code == 1
+    report = json.loads("\n".join(lines))
+    assert "PRIV001" in {f["rule"] for f in report["findings"]}
+    # without the flag (and no PRIV rule filter) the tier stays off
+    assert run_cli(root=str(tmp_path), echo=lambda *_: None) == 0
+
+
+def test_sarif_export_renders_findings(tmp_path):
+    _write(tmp_path, "fedml_tpu/mod.py", PRIV001_LEAK.format(noqa=""))
+    sarif_path = tmp_path / "lint.sarif"
+    code = run_cli(root=str(tmp_path), taint=True, sarif=str(sarif_path),
+                   echo=lambda *_: None)
+    assert code == 1
+    doc = json.loads(sarif_path.read_text())
+    assert doc["version"] == "2.1.0"
+    (run,) = doc["runs"]
+    assert run["tool"]["driver"]["name"] == "fedml-lint"
+    results = run["results"]
+    assert any(r["ruleId"] == "PRIV001" for r in results)
+    (r,) = [r for r in results if r["ruleId"] == "PRIV001"]
+    assert r["baselineState"] == "new"
+    loc = r["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "fedml_tpu/mod.py"
+    assert r["partialFingerprints"]["fedmlLint/v1"]
+
+
+def test_priv000_on_parse_error(tmp_path):
+    _write(tmp_path, "fedml_tpu/bad.py", "def broken(:\n")
+    findings, notes = run_taint_pass(tmp_path)
+    assert [f.rule_id for f in findings] == ["PRIV000"]
+    assert any("skipped" in n for n in notes)
+
+
+def test_repo_is_taint_clean():
+    # the tier landed by FIXING its findings: the real package must scan
+    # clean against the committed contract, and fast (<60s)
+    t0 = time.monotonic()
+    findings, notes = run_taint_pass(REPO_ROOT)
+    dt = time.monotonic() - t0
+    assert findings == [], [f"{f.rule_id} {f.path}:{f.line}"
+                            for f in findings[:10]]
+    assert not [n for n in notes if not n.startswith("hint:")]
+    assert dt < 60, f"taint pass took {dt:.1f}s"

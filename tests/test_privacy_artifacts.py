@@ -1,0 +1,149 @@
+"""Privacy of the observability artifacts: a chaos INPROC federation's
+ledger.jsonl and metrics exposition must carry round anatomy — never
+array payloads, raw examples or secrets.  The dynamic complement of the
+taint tier's static PRIV001/PRIV003 verdicts, plus the wire-audit soak:
+every key the run put on the wire is in the committed contract."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from fedml_tpu.core.distributed.communication.chaos import ChaosCommManager
+from fedml_tpu.core.distributed.communication.inprocess import (
+    InProcCommManager,
+)
+from fedml_tpu.core.mlops import ledger, metrics, wire_audit
+
+#: longest numeric list a ledger attr may carry: round anatomy is
+#: scalars and short id lists, a payload leaf is thousands of floats
+MAX_NUMERIC_LIST = 8
+MAX_STR_VALUE = 512
+
+
+def _assert_value_free(value, where):
+    if isinstance(value, dict):
+        for k, v in value.items():
+            _assert_value_free(v, f"{where}.{k}")
+        return
+    if isinstance(value, (list, tuple)):
+        numeric = [v for v in value if isinstance(v, (int, float))]
+        assert not (len(numeric) > MAX_NUMERIC_LIST
+                    and len(numeric) == len(value)), (
+            f"{where}: numeric array of {len(value)} elements looks like "
+            f"a tensor payload")
+        for i, v in enumerate(value):
+            _assert_value_free(v, f"{where}[{i}]")
+        return
+    if isinstance(value, str):
+        assert len(value) <= MAX_STR_VALUE, (
+            f"{where}: {len(value)}-char string value looks like a "
+            f"serialized payload")
+        assert "array(" not in value, f"{where}: ndarray repr in artifact"
+
+
+def test_label_cardinality_cap_under_racing_observes():
+    """A hostile or unbounded label value (client-controlled strings) must
+    not grow the exposition past MAX_LABEL_SETS per metric: overflow
+    writes land in a never-exported child and are counted in
+    fedml_metrics_dropped_labels_total."""
+    reg = metrics.MetricsRegistry()
+    ctr = reg.counter("fedml_test_cap_total", "cap test", labels=("who",))
+    n_threads, per_thread = 8, 200   # 1600 distinct label sets >> 512
+    barrier = threading.Barrier(n_threads)
+
+    def worker(t):
+        barrier.wait()
+        for i in range(per_thread):
+            ctr.labels(who=f"t{t}-v{i}").inc()
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    assert len(ctr.children()) == metrics.MAX_LABEL_SETS
+    expected_dropped = n_threads * per_thread - metrics.MAX_LABEL_SETS
+    dropped = reg.collect()[metrics.DROPPED_METRIC]
+    (child,) = dropped.children().values()
+    assert child.value == expected_dropped
+    # overflow absorbed every dropped write but is never exported
+    assert ctr._overflow is not None
+    assert ctr._overflow.value == expected_dropped
+    rendered = reg.render_prometheus()
+    assert rendered.count("fedml_test_cap_total{") == metrics.MAX_LABEL_SETS
+
+
+def test_chaos_run_artifacts_carry_no_payloads(args_factory, tmp_path):
+    import fedml_tpu
+    from fedml_tpu.core.distributed.fedml_comm_manager import (
+        register_comm_backend,
+    )
+    from fedml_tpu.cross_silo.runner import init_client, init_server
+
+    def factory(args, rank=0, size=0):
+        return ChaosCommManager(
+            InProcCommManager(rank, size, str(args.run_id)),
+            drop_p=0.15, dup_p=0.1, delay_p=0.2, max_delay_s=0.03,
+            seed=900 + rank)
+
+    register_comm_backend("CHAOS_PRIV", factory)
+    wire_audit.arm(True)
+    try:
+        args = fedml_tpu.init(args_factory(
+            training_type="cross_silo", client_num_in_total=2,
+            client_num_per_round=2, comm_round=2, data_scale=0.2,
+            learning_rate=0.1, frequency_of_the_test=1,
+            run_id="priv_artifacts", run_ledger=True,
+            log_file_dir=str(tmp_path), reliable=True,
+            reliable_retx_initial_s=0.05, reliable_retx_max_s=0.5))
+        dataset = fedml_tpu.data.load(args)
+        bundle = fedml_tpu.model.create(args, dataset[-1])
+        server = init_server(args, dataset, bundle, backend="CHAOS_PRIV")
+        clients = [init_client(args, dataset, bundle, rank,
+                               backend="CHAOS_PRIV") for rank in (1, 2)]
+        threads = [threading.Thread(target=c.run, daemon=True)
+                   for c in clients]
+        for t in threads:
+            t.start()
+        t0 = time.monotonic()
+        server.run()
+        elapsed = max(time.monotonic() - t0, 1e-9)
+        for t in threads:
+            t.join(timeout=15)
+        assert int(args.round_idx) == 2
+        snap = wire_audit.snapshot()
+    finally:
+        wire_audit.arm(False)
+        wire_audit._armed = None
+        ledger.reset()   # flush + close the jsonl
+
+    # -- the wire-audit soak: observed keys ⊆ committed contract, and the
+    # recorder's self-measured bookkeeping stays under the 2% CI budget
+    assert snap["contract_loaded"], "benchmarks/wire_contract.json missing"
+    assert snap["messages"] > 0
+    assert snap["violations"] == [], snap["violations"]
+    assert snap["overhead_s"] / elapsed < 0.02
+
+    # -- ledger.jsonl: structured round anatomy, no tensor payloads
+    ledger_file = tmp_path / "ledger.jsonl"
+    assert ledger_file.is_file()
+    raw = ledger_file.read_bytes()
+    assert b"array(" not in raw
+    records = [json.loads(line) for line in raw.splitlines() if line]
+    assert records, "chaos run produced an empty ledger"
+    for i, rec in enumerate(records):
+        _assert_value_free(rec, f"ledger[{i}]")
+
+    # -- metrics exposition: bounded label values, no payload-shaped text
+    prom = metrics.render_prometheus()
+    (tmp_path / "metrics.prom").write_text(prom)
+    assert "array(" not in prom
+    for line in prom.splitlines():
+        if line.startswith("#"):
+            continue
+        assert len(line) <= 1024, f"metrics line too long: {line[:120]}"
+        assert "[[" not in line, f"nested array in metrics line: {line}"
