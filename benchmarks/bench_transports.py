@@ -23,6 +23,13 @@ must cut total bytes-on-wire ≥4x at equal accuracy — both checked by
 ``--guard`` (exit 2 on regression; the CI async-soak step runs
 ``--quick --guard``).
 
+The hierarchy soak (acceptance for the geo-distributed tier): 3 regions
+× 5 silos vs a flat 15-silo federation, both over ``wan-lossy``.  The
+hierarchy ships one pre-reduced int8 delta per region per segment, so
+its bytes-on-WAN (``fedml_wan_bytes_total``) must land ≤ 1/3 of the
+flat run's total wire bytes at equal accuracy (same ``--guard``), and
+the result lands as a provenance-stamped ``perf_history.jsonl`` row.
+
 Usage:
     python benchmarks/bench_transports.py --quick --guard \
         --out benchmarks/bench_transports_quick.json
@@ -250,7 +257,117 @@ def run_straggler_soak(rounds: int = 12) -> Dict[str, Any]:
     }
 
 
-def check_guard(cells: List[Dict], soak: Dict) -> List[str]:
+def _wan_bytes(run_id: str) -> Dict[str, float]:
+    """Bytes that crossed the WAN tier of the aggregation hierarchy
+    (``fedml_wan_bytes_total`` — regional folds up, segment broadcasts
+    down; LAN silo traffic excluded by construction)."""
+    m = metrics.REGISTRY.collect().get("fedml_wan_bytes_total")
+    out: Dict[str, float] = {"up": 0.0, "down": 0.0}
+    if m is None:
+        return out
+    for key, child in list(m._children.items()):
+        rid, direction = key
+        if rid == run_id and direction in out:
+            out[direction] += child.value
+    out["total"] = out["up"] + out["down"]
+    return out
+
+
+def run_hierarchy_soak(rounds: int = 3,
+                       timeout_s: float = 300.0) -> Dict[str, Any]:
+    """Hierarchy acceptance: 3 regions x 5 silos vs a flat 15-silo
+    federation, both crossing a wan-lossy WAN.
+
+    Flat pays the WAN for every silo (15 uploads + 15 broadcasts per
+    round); the hierarchy folds each region's silos on its clean LAN and
+    ships ONE pre-reduced int8 delta per region per segment, so its
+    bytes-on-WAN must land at <= 1/3 of the flat run's at equal accuracy
+    (``--guard``; fan-in alone gives ~5x, the delta codec ~4x more)."""
+    from fedml_tpu.cross_silo.hierarchical.message_define import HierMessage
+    from fedml_tpu.cross_silo.runner import build_cross_silo_runner
+
+    n, n_regions = 15, 3
+    common = dict(client_num_in_total=n, client_num_per_round=n,
+                  comm_round=rounds, data_scale=0.1,
+                  frequency_of_the_test=rounds, reliable=True,
+                  reliable_retx_initial_s=0.2, reliable_retx_max_s=1.0)
+
+    # -- flat: every silo crosses the lossy WAN ------------------------------
+    _register_profile_backend("BT_HIER_FLAT", "inproc", "wan-lossy")
+    flat_args = _base_args("bt_hier_flat", round_timeout_s=20.0,
+                           min_clients_per_round=n - 3, **common)
+    box: Dict[str, Any] = {}
+
+    def _flat_worker():
+        try:
+            box["flat"] = _federate(flat_args, "BT_HIER_FLAT", n)
+        except Exception as e:  # noqa: BLE001 — report, don't kill the bench
+            box["err"] = f"flat: {type(e).__name__}: {e}"
+
+    t = threading.Thread(target=_flat_worker, daemon=True)
+    t.start()
+    t.join(timeout=timeout_s)
+    if t.is_alive() or "err" in box:
+        return {"skipped": box.get(
+            "err", f"flat cell timeout after {timeout_s:.0f}s")}
+    flat = box["flat"]
+    flat_wan = _wire_bytes("bt_hier_flat")  # flat: ALL wire bytes are WAN
+
+    # -- hierarchy: only the 3 regional uplinks cross that same WAN ----------
+    def hier_wan_factory(args, rank=0, size=0):
+        from fedml_tpu.core.distributed.communication.inprocess import (
+            InProcCommManager,
+        )
+
+        return chaos_from_profile(
+            InProcCommManager(rank, size, str(args.run_id)), "wan-lossy",
+            seed=2000 + rank,
+            protect_types={HierMessage.MSG_TYPE_G2R_FINISH})
+
+    register_comm_backend("BT_HIER_WAN", hier_wan_factory)
+    hier_args = _base_args(
+        "bt_hier_tree", backend="INPROC", hier_regions=n_regions,
+        hier_wan_backend="BT_HIER_WAN", hier_wan_reliable=True,
+        hier_wan_compression="int8", min_regions=2,
+        hier_round_deadline_s=30.0, **common)
+    dataset = fedml_tpu.data.load(hier_args)
+    bundle = fedml_tpu.model.create(hier_args, dataset[-1])
+    runner = build_cross_silo_runner(hier_args, None, dataset, bundle)
+    t0 = time.monotonic()
+    runner.launch()
+    final = runner.wait(timeout=timeout_s)
+    hier_wall = time.monotonic() - t0
+    if runner._global_thread.is_alive():
+        return {"skipped": f"hier run timeout after {timeout_s:.0f}s"}
+    hier_wan = _wan_bytes("bt_hier_tree")
+    hist = runner.global_manager.aggregator.metrics_history
+
+    flat_rate = rounds / max(flat["wall_s"], 1e-9)
+    hier_rate = rounds / max(hier_wall, 1e-9)
+    ratio = flat_wan["total"] / max(hier_wan["total"], 1e-9)
+    return {
+        "silos": n, "regions": n_regions, "rounds": rounds,
+        "profile": "wan-lossy",
+        "flat": {"wall_s": flat["wall_s"],
+                 "rounds_per_s": round(flat_rate, 3),
+                 "wan_bytes": flat_wan["total"],
+                 "test_acc": flat["final"].get("test_acc"),
+                 "acc_at_round": flat["acc_at_round"]},
+        "hier": {"wall_s": round(hier_wall, 3),
+                 "rounds_per_s": round(hier_rate, 3),
+                 "wan_bytes": hier_wan["total"],
+                 "wan_bytes_up": hier_wan["up"],
+                 "wan_bytes_down": hier_wan["down"],
+                 "test_acc": final.get("test_acc"),
+                 "acc_at_round": [
+                     {"round": h.get("round"), "test_acc": h.get("test_acc")}
+                     for h in hist]},
+        "wan_bytes_ratio": round(ratio, 2),
+    }
+
+
+def check_guard(cells: List[Dict], soak: Dict,
+                hier: Optional[Dict] = None) -> List[str]:
     """Bytes-on-wire + straggler regression guard (CI async-soak step).
     Returns a list of violations (empty = pass)."""
     bad: List[str] = []
@@ -279,6 +396,16 @@ def check_guard(cells: List[Dict], soak: Dict) -> List[str]:
         ca, aa = soak.get("clean_acc"), soak["async"].get("test_acc")
         if ca is not None and aa is not None and abs(ca - aa) > 0.15:
             bad.append(f"soak: async acc {aa:.3f} vs clean {ca:.3f}")
+    if hier and "skipped" not in hier:
+        if hier["wan_bytes_ratio"] < 3.0:
+            bad.append(f"hierarchy: WAN bytes flat/hier ratio "
+                       f"{hier['wan_bytes_ratio']}x < 3x — the pre-reduced "
+                       f"regional fold is not earning its tier")
+        fa = hier["flat"].get("test_acc")
+        ha = hier["hier"].get("test_acc")
+        if fa is not None and ha is not None and abs(fa - ha) > 0.15:
+            bad.append(f"hierarchy: hier acc {ha:.3f} vs flat {fa:.3f} "
+                       f"(> 0.15 apart)")
     return bad
 
 
@@ -290,6 +417,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="exit 2 when the bytes/straggler guard fails")
     p.add_argument("--rounds", type=int, default=3)
     p.add_argument("--no-soak", action="store_true")
+    p.add_argument("--no-hier", action="store_true",
+                   help="skip the 3x5-vs-flat-15 hierarchy soak")
     p.add_argument("--out", default=None, help="write JSON here")
     a = p.parse_args(argv)
 
@@ -311,7 +440,36 @@ def main(argv: Optional[List[str]] = None) -> int:
                                           a.rounds))
 
     soak = {} if a.no_soak else run_straggler_soak()
-    violations = check_guard(cells, soak)
+    if a.no_hier:
+        hier = {}
+    else:
+        print("[bench_transports] hierarchy 3x5-vs-flat-15 / wan-lossy ...",
+              flush=True)
+        hier = run_hierarchy_soak(rounds=a.rounds)
+    violations = check_guard(cells, soak, hier)
+    if hier and "skipped" not in hier:
+        # provenance-stamped headline so `fedml perf history` carries the
+        # hierarchy's WAN-byte win and round rate forward (hier_* keys are
+        # deliberately NOT in HEADLINE_METRICS — they must not be compared
+        # against the flat-plane rounds_per_s series)
+        try:
+            import jax
+
+            from fedml_tpu.core.mlops import perf_history
+
+            perf_history.append_entry(
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "perf_history.jsonl"),
+                platform=jax.default_backend(),
+                source="bench_transports.py",
+                label="hier_3x5_vs_flat15_wanlossy", measured=True,
+                notes=(f"WAN bytes flat/hier {hier['wan_bytes_ratio']}x, "
+                       f"hier acc {hier['hier'].get('test_acc')}"),
+                metrics={
+                    "hier_wan_bytes_ratio": hier["wan_bytes_ratio"],
+                    "hier_rounds_per_s": hier["hier"]["rounds_per_s"]})
+        except Exception:  # noqa: BLE001 — bookkeeping never fails the bench
+            pass
     report = {
         "bench": "transports",
         "quick": bool(a.quick),
@@ -320,6 +478,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                    "codecs": {k: v or "raw" for k, v in CODECS.items()}},
         "cells": cells,
         "straggler_soak": soak,
+        "hierarchy_soak": hier,
         "guard_violations": violations,
     }
     out = json.dumps(report, indent=2, default=float)

@@ -392,10 +392,31 @@ def _agg_mesh_variant():
 # not in HBM; the jnp fallback keeps the same math for bitwise parity
 _EPILOGUE_WIDEN_OK = ("fedml_tpu/ops/epilogue.py",)
 
+def _region_fold():
+    """The hierarchical regional aggregator's fold: silo updates stacked
+    in the region's FedBuff buffer reduce under the regional robust op
+    (default trimmed_mean:0.2) with staleness-decayed weights — the
+    device kernel behind one WAN-shipped delta per round segment."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...ml.aggregator.robust import parse_robust_agg, robust_agg_stacked
+
+    spec = parse_robust_agg("trimmed_mean:0.2")
+
+    def fold(stacked, weights):
+        return robust_agg_stacked(spec, stacked, weights)
+
+    return jax.jit(fold), (
+        _stacked_tree(), jax.ShapeDtypeStruct((8,), jnp.float32))
+
+
 register_jit_entrypoint("agg/robust_trimmed_mean", _robust_agg,
                         mesh_variants=(_agg_mesh_variant(),))
 register_jit_entrypoint("agg/stacked_weighted_mean", _agg_stacked,
                         meta={"widen_allow": _EPILOGUE_WIDEN_OK},
+                        mesh_variants=(_agg_mesh_variant(),))
+register_jit_entrypoint("hier/region_fold", _region_fold,
                         mesh_variants=(_agg_mesh_variant(),))
 
 

@@ -95,6 +95,13 @@ class InProcCommManager(BaseCommunicationManager):
         while self._running:
             msg = q.get()
             if msg is _STOP:
+                if self._running:
+                    # stale sentinel from a PRIOR incarnation of this rank:
+                    # a hard-killed node whose loop was mid-dispatch when
+                    # stopped exits via the while-check without draining
+                    # its _STOP, and a restarted node (same rank, same
+                    # channel — the crash-resume path) must not die on it
+                    continue
                 break
             for obs in list(self._observers):
                 obs.receive_message(msg.get_type(), msg)

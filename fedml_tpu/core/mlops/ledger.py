@@ -288,7 +288,7 @@ def round_anatomy(ledger_records: List[Dict[str, Any]],
         return rounds.setdefault(int(idx), {
             "t0": None, "t_close": None, "wall_s": None, "closed": None,
             "reported": None, "expected": None,
-            "clients": {}, "events": [], "quarantined": 0,
+            "clients": {}, "regions": {}, "events": [], "quarantined": 0,
             "retransmits": 0, "deadline_dropped": 0})
 
     for rec in anchored:
@@ -374,6 +374,54 @@ def round_anatomy(ledger_records: List[Dict[str, Any]],
                 c["late_join"] = True
             elif ev in ("expired", "park", "duplicate"):
                 c["outcome"] = ev
+        # hierarchical tier: "hier" events carry region= (never client=),
+        # so they build a regions sub-anatomy instead of polluting the
+        # clients view
+        for rec in sorted(r["events"], key=lambda e: e.get("ts_mono", 0.0)):
+            attrs = rec.get("attrs") or {}
+            region = attrs.get("region")
+            if rec.get("actor") != "hier" or region is None:
+                continue
+            g = r["regions"].setdefault(str(region), {
+                "solicited_t": None, "fold_t": None, "ship_t": None,
+                "receive_t": None, "n_silos": None, "expected": None,
+                "fold_s": None, "nbytes": None, "codec": None,
+                "staleness": None, "outcome": None, "dropped": None,
+                "rejoined": False, "silos_expired": 0})
+            t = round(float(rec.get("ts_mono", t0)) - t0, 3)
+            ev = rec.get("event")
+            if ev == "segment_solicit" and g["solicited_t"] is None:
+                g["solicited_t"] = t
+            elif ev == "region_fold":
+                g["fold_t"] = t
+                g["n_silos"] = attrs.get("n_silos")
+                g["expected"] = attrs.get("expected")
+                g["fold_s"] = attrs.get("fold_s")
+            elif ev == "region_ship":
+                g["ship_t"] = t
+                g["nbytes"] = attrs.get("nbytes")
+                g["codec"] = attrs.get("codec")
+                if g["n_silos"] is None:
+                    g["n_silos"] = attrs.get("n_silos")
+                if g["expected"] is None:
+                    g["expected"] = attrs.get("expected")
+            elif ev == "fold_receive":
+                g["receive_t"] = t
+                g["outcome"] = "folded"
+                if attrs.get("staleness"):
+                    g["staleness"] = attrs["staleness"]
+            elif ev == "fold_duplicate":
+                g["outcome"] = g["outcome"] or "duplicate"
+            elif ev == "fold_expired":
+                g["outcome"] = g["outcome"] or "expired"
+            elif ev == "fold_quarantined":
+                g["outcome"] = "quarantined"
+            elif ev == "region_drop":
+                g["dropped"] = attrs.get("cause") or "?"
+            elif ev == "region_rejoin":
+                g["rejoined"] = True
+            elif ev == "silo_expired":
+                g["silos_expired"] += 1
         r["quarantined"] = sum(1 for c in r["clients"].values()
                                if c["verdict"] == "quarantined")
         if r["reported"] is None:
@@ -472,6 +520,54 @@ def _fmt_client_line(rank: int, c: Dict[str, Any]) -> str:
     return f"  client {rank}: " + ", ".join(bits)
 
 
+def _fmt_nbytes(n: Any) -> str:
+    n = float(n)
+    if n >= 1 << 20:
+        return f"{n / (1 << 20):.1f}MB"
+    if n >= 1 << 10:
+        return f"{n / (1 << 10):.1f}KB"
+    return f"{int(n)}B"
+
+
+def _fmt_region_line(name: str, g: Dict[str, Any]) -> str:
+    bits = []
+    if g["n_silos"] is not None and g["expected"] is not None:
+        bits.append(f"{g['n_silos']}/{g['expected']} silos")
+    if g["fold_t"] is not None:
+        fold = f"folded at t+{g['fold_t']:.1f}s"
+        if g["fold_s"]:
+            fold += f" ({g['fold_s']:.2f}s fold)"
+        bits.append(fold)
+    if g["nbytes"] is not None:
+        bits.append(f"WAN delta {_fmt_nbytes(g['nbytes'])} "
+                    f"{g['codec'] or 'raw'}")
+    if g["receive_t"] is not None:
+        adm = f"folded globally at t+{g['receive_t']:.1f}s"
+        st = g.get("staleness")
+        if st not in (None, 0):
+            adm += f" (staleness {st})"
+        bits.append(adm)
+    elif g["outcome"] == "duplicate":
+        bits.append("duplicate fold suppressed")
+    elif g["outcome"] == "expired":
+        bits.append("fold expired stale")
+    elif g["outcome"] == "quarantined":
+        bits.append("fold QUARANTINED")
+    elif g["ship_t"] is not None:
+        bits.append("fold in flight")
+    elif g["fold_t"] is None and g["dropped"] is None:
+        bits.append("no fold")
+    if g["silos_expired"]:
+        bits.append(f"{g['silos_expired']} silo upload"
+                    + ("s" if g["silos_expired"] != 1 else "")
+                    + " expired")
+    if g["dropped"]:
+        bits.append(f"DROPPED ({g['dropped']})")
+    if g["rejoined"]:
+        bits.append("rejoined")
+    return f"  region {name}: " + ", ".join(bits)
+
+
 def render_timeline(anatomy: Dict[str, Any],
                     round_idx: Optional[int] = None) -> str:
     """The per-round per-client anatomy view: one block per round, one
@@ -488,11 +584,15 @@ def render_timeline(anatomy: Dict[str, Any],
             out.append(f"round {idx}: (not in ledger)")
             continue
         out.append(_fmt_round_header(idx, r))
+        for name in sorted(r.get("regions") or {}):
+            out.append(_fmt_region_line(name, r["regions"][name]))
         for rank in sorted(r["clients"]):
             out.append(_fmt_client_line(rank, r["clients"][rank]))
         other = [e for e in r["events"]
                  if _client_of(e) is None and e.get("event")
-                 not in ("round_start", "round_close")]
+                 not in ("round_start", "round_close")
+                 and not (e.get("actor") == "hier"
+                          and (e.get("attrs") or {}).get("region"))]
         for rec in sorted(other, key=lambda e: e.get("ts_mono", 0.0)):
             t = float(rec.get("ts_mono", 0.0)) - (r["t0"] or 0.0)
             attrs = rec.get("attrs") or {}

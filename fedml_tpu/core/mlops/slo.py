@@ -222,6 +222,18 @@ class SLOContext:
         buckets = sorted(merged.items())
         return _metrics.histogram_quantile(q, buckets)
 
+    def hist_count(self, name: str, **match: str) -> Optional[float]:
+        entry = self.scrape.get(name)
+        if not entry or entry.get("type") != "histogram":
+            return None
+        total = 0.0
+        found = False
+        for ser in entry.get("series", []):
+            if all(ser["labels"].get(k) == v for k, v in match.items()):
+                total += ser["count"]
+                found = True
+        return total if found else None
+
     def hist_sum(self, name: str, **match: str) -> Optional[float]:
         entry = self.scrape.get(name)
         if not entry or entry.get("type") != "histogram":
@@ -337,6 +349,76 @@ def _ind_serving_shed_rate(ctx: SLOContext,
     return ctx.ledger_event_count("serving", "shed") / submits
 
 
+# -- hierarchical (per-tier) indicators --------------------------------------
+
+def _ind_region_fold_p95(ctx: SLOContext, rule: SLORule) -> Optional[float]:
+    """p95 of a regional aggregator's fold time (segment open → robust
+    fold), over all regions."""
+    q = float(rule.params.get("quantile", 0.95))
+    v = ctx.quantile("fedml_region_fold_seconds", q)
+    if v is not None:
+        return v
+    folds = sorted(float((r.get("attrs") or {}).get("fold_s") or 0.0)
+                   for r in (ctx.ledger_records or [])
+                   if r.get("actor") == "hier"
+                   and r.get("event") == "region_fold")
+    if not folds:
+        return None
+    return folds[min(len(folds) - 1, int(q * len(folds)))]
+
+
+def _hier_rounds(ctx: SLOContext) -> Optional[float]:
+    """Global rounds completed — the regional managers never emit
+    round_close/fedml_round_seconds (their segments end in a WAN ship),
+    so both sources count the global tier only."""
+    n = ctx.hist_count("fedml_round_seconds")
+    if n:
+        return float(n)
+    n = ctx.ledger_event_count("server", "round_close")
+    return float(n) if n else None
+
+
+def _ind_wan_bytes_per_round(ctx: SLOContext,
+                             rule: SLORule) -> Optional[float]:
+    wan = ctx.counter_sum("fedml_wan_bytes_total")
+    if wan is None:
+        # ledger fallback: sum nbytes over the WAN-crossing hier events
+        total = 0.0
+        found = False
+        for r in (ctx.ledger_records or []):
+            if r.get("actor") != "hier":
+                continue
+            if r.get("event") in ("region_ship", "segment_solicit"):
+                total += float((r.get("attrs") or {}).get("nbytes") or 0.0)
+                found = True
+        if not found:
+            return None
+        wan = total
+    rounds = _hier_rounds(ctx)
+    if not rounds:
+        return None
+    return wan / rounds
+
+
+def _ind_region_dropout_rate(ctx: SLOContext,
+                             rule: SLORule) -> Optional[float]:
+    """Region-tier fault-domain verdicts (heartbeat-dead or
+    deadline-dropped regions) per global round."""
+    drops = ctx.counter_sum("fedml_region_dropouts_total")
+    if drops is None:
+        # the dropout counter only materializes on a drop; distinguish
+        # "no drops in a hier run" (0.0) from "no hier plane" (skip)
+        hier_ran = (ctx.ledger_event_count("hier", "fold_receive")
+                    + ctx.ledger_event_count("hier", "region_fold")) > 0
+        if not hier_ran:
+            return None
+        drops = ctx.ledger_event_count("hier", "region_drop")
+    rounds = _hier_rounds(ctx)
+    if not rounds:
+        return None
+    return drops / rounds
+
+
 INDICATORS = {
     "round_time_p95": _ind_round_time_p95,
     "quarantine_rate": _ind_quarantine_rate,
@@ -347,6 +429,9 @@ INDICATORS = {
     "queue_wait_p99": _ind_queue_wait_p99,
     "decode_tbt_p99": _ind_decode_tbt_p99,
     "serving_shed_rate": _ind_serving_shed_rate,
+    "region_fold_p95": _ind_region_fold_p95,
+    "wan_bytes_per_round": _ind_wan_bytes_per_round,
+    "region_dropout_rate": _ind_region_dropout_rate,
 }
 
 

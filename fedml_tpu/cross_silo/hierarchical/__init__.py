@@ -1,0 +1,25 @@
+"""Geo-distributed aggregation hierarchy: edge → region → global.
+
+Regional aggregators fold their silos locally (regional staleness +
+robust op), ship ONE pre-reduced codec-compressed delta per round
+segment over the WAN, and the global server composes the robustness
+stack again over REGIONS — per-tier fault domains with the PR-4
+heartbeat/deadline machinery, (region, silo, round) dedup, and
+round-boundary crash-resume at every tier.  See docs/ROBUSTNESS.md
+"Hierarchical aggregation".
+"""
+
+from .global_server_manager import GlobalServerManager
+from .message_define import HierMessage
+from .regional_manager import RegionalAggregatorManager, RegionUplink
+from .runner import HierarchicalFederationRunner, RegionNode, hier_layout
+
+__all__ = [
+    "GlobalServerManager",
+    "HierMessage",
+    "HierarchicalFederationRunner",
+    "RegionNode",
+    "RegionalAggregatorManager",
+    "RegionUplink",
+    "hier_layout",
+]

@@ -1,0 +1,408 @@
+"""Global server of the geo-distributed aggregation hierarchy.
+
+A ``FedMLServerManager`` whose "clients" are REGIONS: the PR-4 heartbeat
+failure detector, elastic round timeout, deadline pacer, late-join
+catch-up, quarantine re-solicitation and round-boundary checkpointing
+all run unchanged over region ranks — a region dead or partitioned is
+dropped from the round exactly like a dead silo, the global round closes
+on a ``--min-regions`` quorum, and a rejoining region is re-admitted
+with a frontier catch-up broadcast.
+
+What changes is the wire and the dedup domain:
+
+* broadcasts go out as ``G2R_INIT_CONFIG`` / ``G2R_SYNC_MODEL`` (one per
+  region, codec-negotiated per WAN link) and uploads arrive as
+  ``R2G_REGION_FOLD`` — ONE pre-reduced delta per region per round
+  segment, so uplink WAN bytes drop by ~silo-fanout before codecs apply;
+* the robustness composition repeats at this tier, in the same strict
+  order as every other ingest path (docs/ROBUSTNESS.md): **dedup**
+  (keep-first on ``(region, fold_round)`` PLUS a ``(region, silo,
+  round)`` triple audit — a retransmitted or re-computed regional fold
+  can never double-count any silo upload), **staleness** (global decay
+  on region arrival round, cutoff → expired + frontier re-sync),
+  **admission** (the same quarantine screen, fold-level), **robust
+  aggregation** (``--hier-global-robust-agg``, default ``median`` over
+  regions — a whole byzantine region is one outlier among R).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import OrderedDict
+from typing import Any, Dict, Optional
+
+from ...core.mlops import flight_recorder, ledger, metrics, tracing
+from ...core.distributed.communication.message import Message
+from ...ml.aggregator.staleness import parse_staleness, staleness_weight
+from ...utils.compression import WIRE_BYTES as _wire_bytes
+from ..message_define import MyMessage
+from ..server.fedml_aggregator import FedMLAggregator
+from ..server.fedml_server_manager import FedMLServerManager
+from .message_define import HierMessage
+
+_region_folds = metrics.counter(
+    "fedml_region_folds_total",
+    "Regional folds handled by the global server, by outcome (folded | "
+    "duplicate | expired | quarantined)", labels=("run_id", "outcome"))
+_region_dropouts = metrics.counter(
+    "fedml_region_dropouts_total",
+    "Regions dropped from a global round by a fault-domain verdict "
+    "(heartbeat | deadline)", labels=("run_id", "cause"))
+_wan_bytes = metrics.counter(
+    "fedml_wan_bytes_total",
+    "Bytes crossing the WAN tier of the aggregation hierarchy (broadcast "
+    "segments down, regional folds up) — LAN silo traffic excluded",
+    labels=("run_id", "direction"))
+
+#: bound on the (region, fold_round) / (region, silo, round) audit windows
+_FOLD_DEDUP_WINDOW = 4096
+
+#: a fold delta whose trained-against global reference is no longer held
+_MISSING_REF = object()
+
+
+class GlobalServerManager(FedMLServerManager):
+    def __init__(self, args: Any, aggregator: FedMLAggregator, comm=None,
+                 rank: int = 0, client_num: int = 0,
+                 backend: str = "INPROC") -> None:
+        #: WAN rank → region name (learned from R2G_REGION_STATUS)
+        self._region_names: Dict[int, str] = {}
+        #: WAN rank → silo count the region expects per segment (its LAN
+        #: fleet size) — partial folds (n_silos < this) are visible in
+        #: the round anatomy
+        self._region_expected: Dict[int, int] = {}
+        #: keep-first dedup over (region rank, fold_round)
+        self._seen_folds: "OrderedDict" = OrderedDict()
+        #: every (region rank, silo rank, silo round) triple already
+        #: counted into SOME global round — the cross-tier dedup key: a
+        #: re-computed fold overlapping a counted triple is rejected whole
+        self._counted_triples: "OrderedDict" = OrderedDict()
+        #: round → (decoded ref, raw ref) for decoding stale fold deltas
+        self._version_refs: "OrderedDict" = OrderedDict()
+        super().__init__(args, aggregator, comm, rank, client_num, backend)
+        self._staleness_spec = parse_staleness(
+            getattr(args, "hier_global_staleness", None))
+        self._staleness_cutoff = int(
+            getattr(args, "hier_staleness_cutoff", 3) or 3)
+        # --min-regions is the quorum floor for BOTH pacers: a global
+        # round never closes below it, and init force-starts at it
+        min_regions = int(getattr(args, "min_regions", 0) or 0)
+        if min_regions:
+            self.min_clients = max(self.min_clients, min_regions)
+            self.min_agg_clients = max(self.min_agg_clients, min_regions)
+
+    def _region_name(self, rank: int) -> str:
+        return self._region_names.get(rank, f"region{rank}")
+
+    # -- protocol ------------------------------------------------------------
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            HierMessage.MSG_TYPE_R2G_REGION_STATUS,
+            self.handle_message_region_status)
+        self.register_message_receive_handler(
+            HierMessage.MSG_TYPE_R2G_REGION_FOLD,
+            self.handle_message_region_fold)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_HEARTBEAT, self.handle_message_heartbeat)
+
+    def handle_message_region_status(self, msg: Message) -> None:
+        sender = msg.get_sender_id()
+        region = msg.get(HierMessage.MSG_ARG_KEY_REGION)
+        caps = msg.get(MyMessage.MSG_ARG_KEY_WIRE_CAPS)
+        expected = msg.get(HierMessage.MSG_ARG_KEY_EXPECTED_SILOS)
+        with self._round_lock:
+            if region:
+                self._region_names[sender] = str(region)
+            if expected:
+                self._region_expected[sender] = int(expected)
+            if caps:
+                self._peer_caps[sender] = tuple(str(c) for c in caps)
+            self._mark_alive(sender, announce=True)
+            n_online = sum(self.client_online_status.values())
+        logging.info("global: region %s (rank %d) online (%d/%d regions)",
+                     self._region_name(sender), sender, n_online,
+                     self.client_num)
+
+    def _mark_alive(self, sender: int, announce: bool = False) -> None:
+        with self._round_lock:
+            if self.client_online_status.get(sender) is False:
+                ledger.event("hier", "region_rejoin",
+                             round_idx=int(self.args.round_idx),
+                             region=self._region_name(sender))
+            super()._mark_alive(sender, announce)
+
+    def _note_peers_dead(self, ranks, cause: str) -> None:
+        """Fault-domain verdict at the REGION tier: heartbeat-dead or
+        deadline-dropped regions are per-tier telemetry, not just a
+        shrunken cohort."""
+        for rank in ranks:
+            _region_dropouts.labels(run_id=self._run_label,
+                                    cause=cause).inc()
+            ledger.event("hier", "region_drop",
+                         round_idx=int(self.args.round_idx),
+                         region=self._region_name(rank), cause=cause)
+
+    # -- broadcast (G2R wire, one segment per region) ------------------------
+    def _note_round_ref(self, ref: Any, raw: Optional[Any] = None) -> None:
+        """Version the delta references like the async manager: a fold for
+        segment t decodes against ref[t], not the frontier."""
+        super()._note_round_ref(ref, raw)
+        version = int(self.args.round_idx)
+        self._version_refs[version] = (ref, ref if raw is None else raw)
+        while len(self._version_refs) > self._staleness_cutoff + 2:
+            self._version_refs.popitem(last=False)
+
+    def _ref_for(self, fold_round: int, raw: bool = False) -> Any:
+        pair = self._version_refs.get(int(fold_round))
+        if pair is not None:
+            return pair[1] if raw else pair[0]
+        return None
+
+    def _broadcast_round(self, only_rank=None) -> None:
+        """Ship the current round segment to every region (or just the
+        re-solicited/rejoining ones).  Same shape as the flat broadcast —
+        per-link codec negotiation, one full-model encode per round via
+        ``_enc_cache`` — but on the G2R wire, with the region's name in
+        place of a client index.  Caller holds ``_round_lock``."""
+        with self._round_lock:
+            from ...utils.serialization import estimate_nbytes
+
+            only = (None if only_rank is None
+                    else {only_rank} if isinstance(only_rank, int)
+                    else set(only_rank))
+            mtype = (HierMessage.MSG_TYPE_G2R_SYNC_MODEL
+                     if self.args.round_idx else
+                     HierMessage.MSG_TYPE_G2R_INIT_CONFIG)
+            global_model = self.aggregator.get_global_model_params()
+            enc_payload = None
+            if self._wire_spec is not None:
+                from ...utils.compression import WireCodec
+
+                version = int(self.args.round_idx)
+                if (self._enc_cache is not None
+                        and self._enc_cache[0] == version):
+                    _, enc_payload, decoded = self._enc_cache
+                else:
+                    enc_payload = WireCodec.encode_model(
+                        global_model,
+                        "bf16" if self._wire_spec.kind == "bf16" else "int8")
+                    decoded = WireCodec.decode_model(enc_payload)
+                    self._enc_cache = (version, enc_payload, decoded)
+                self._note_round_ref(decoded, raw=global_model)
+            else:
+                self._note_round_ref(global_model)
+            with flight_recorder.phase("comm",
+                                       program="hier/global_broadcast"):
+                for rank in range(1, self.client_num + 1):
+                    if only is not None and rank not in only:
+                        continue
+                    use_codec = (enc_payload is not None
+                                 and self._link_codec(rank))
+                    msg = Message(mtype, self.get_sender_id(), rank)
+                    msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                                   enc_payload if use_codec else global_model)
+                    if use_codec:
+                        msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_ENCODED,
+                                       True)
+                        msg.add_params(
+                            MyMessage.MSG_ARG_KEY_WIRE_CODEC,
+                            str(getattr(self.args, "wire_compression")))
+                    msg.add_params(HierMessage.MSG_ARG_KEY_REGION,
+                                   self._region_name(rank))
+                    msg.add_params(MyMessage.MSG_ARG_KEY_ROUND,
+                                   self.args.round_idx)
+                    if self._round_span is not None:
+                        msg.add_params(MyMessage.MSG_ARG_KEY_TRACE_CTX,
+                                       tracing.inject(self._round_span.ctx))
+                    nbytes = estimate_nbytes(
+                        enc_payload if use_codec else global_model)
+                    codec = self._wire_spec.kind if use_codec else "raw"
+                    _wire_bytes.labels(run_id=self._run_label,
+                                       direction="down", codec=codec).inc(
+                        nbytes)
+                    _wan_bytes.labels(run_id=self._run_label,
+                                      direction="down").inc(nbytes)
+                    flight_recorder.note_transfer("comm", nbytes)
+                    ledger.event("hier", "segment_solicit",
+                                 round_idx=int(self.args.round_idx),
+                                 region=self._region_name(rank),
+                                 nbytes=int(nbytes), codec=codec)
+                    self.send_message(msg)
+
+    # -- the fold ingest path ------------------------------------------------
+    def handle_message_region_fold(self, msg: Message) -> None:
+        """One pre-reduced regional delta.  Composition order is strict:
+        dedup → staleness cutoff → admission → robust aggregation (via the
+        aggregator funnel at round close)."""
+        sender = msg.get_sender_id()
+        with self._round_lock:
+            if self._finishing or not self.is_initialized:
+                return
+            version = int(self.args.round_idx)
+            region = str(msg.get(HierMessage.MSG_ARG_KEY_REGION)
+                         or self._region_name(sender))
+            self._region_names.setdefault(sender, region)
+            fold_round = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND, version))
+            self._last_seen[sender] = time.monotonic()
+            was_online = self.client_online_status.get(sender)
+            self.client_online_status[sender] = True
+            if was_online is False:
+                ledger.event("hier", "region_rejoin", round_idx=version,
+                             region=region)
+            n_silos = int(msg.get(HierMessage.MSG_ARG_KEY_N_SILOS, 0) or 0)
+            expected = int(
+                msg.get(HierMessage.MSG_ARG_KEY_EXPECTED_SILOS, 0)
+                or self._region_expected.get(sender, 0) or 0)
+            pairs = msg.get(HierMessage.MSG_ARG_KEY_SILO_ROUNDS) or []
+            triples = {(sender, int(r), int(t)) for r, t in pairs}
+            key = (sender, fold_round)
+            retransmit = key in self._seen_folds
+            if retransmit or any(
+                    t in self._counted_triples for t in triples):
+                # keep-first: a retransmitted fold, or a re-computed one
+                # (post-crash regional re-fold) overlapping ANY silo
+                # upload already counted into some global round, never
+                # folds twice — the (region, silo, round) triples are the
+                # cross-tier dedup key
+                _region_folds.labels(run_id=self._run_label,
+                                     outcome="duplicate").inc()
+                ledger.event("hier", "fold_duplicate", round_idx=version,
+                             region=region, fold_round=fold_round)
+                logging.info(
+                    "global: duplicate fold from region %s for round %d "
+                    "— dropped (keep-first)", region, fold_round)
+                if not retransmit:
+                    # triple overlap under a FRESH (region, fold_round)
+                    # key: a re-computed fold, not a wire retransmit —
+                    # this round has NO usable fold from the region yet,
+                    # so re-solicit the segment (bounded, like the
+                    # quarantine path) for a re-fold from fresh uploads
+                    self._seen_folds[key] = True
+                    self._trim_windows()
+                    n_prev = self._quarantine_resolicits.get(sender, 0)
+                    if n_prev < self._resolicit_max:
+                        self._quarantine_resolicits[sender] = n_prev + 1
+                        self._broadcast_round(only_rank=sender)
+                return
+            staleness = version - fold_round
+            if staleness < 0:
+                logging.warning(
+                    "global: fold from region %s claims FUTURE round %d "
+                    "(now %d) — dropped", region, fold_round, version)
+                return
+            if staleness > self._staleness_cutoff:
+                # lateness, not hostility: the fold expired past the
+                # staleness cutoff — drop it and re-sync the region to
+                # the frontier so its next segment counts
+                self._seen_folds[key] = True
+                self._trim_windows()
+                _region_folds.labels(run_id=self._run_label,
+                                     outcome="expired").inc()
+                ledger.event("hier", "fold_expired", round_idx=version,
+                             region=region, staleness=staleness)
+                logging.warning(
+                    "global: EXPIRED fold from region %s (segment %d, now "
+                    "%d > cutoff %d) — dropped, re-syncing to frontier",
+                    region, fold_round, version, self._staleness_cutoff)
+                self._broadcast_round(only_rank=sender)
+                return
+            model = self._decode_fold(msg, fold_round)
+            if model is None or model is _MISSING_REF:
+                self._seen_folds[key] = True
+                self._trim_windows()
+                _region_folds.labels(run_id=self._run_label,
+                                     outcome="expired").inc()
+                ledger.event("hier", "fold_expired", round_idx=version,
+                             region=region, staleness=staleness,
+                             reason="missing_ref")
+                logging.warning(
+                    "global: fold from region %s is a delta against "
+                    "segment %d whose reference is no longer held — "
+                    "dropped as expired, re-syncing", region, fold_round)
+                self._broadcast_round(only_rank=sender)
+                return
+            n_samples = float(
+                msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 1.0) or 1.0)
+            weight = n_samples * staleness_weight(self._staleness_spec,
+                                                  float(staleness))
+            reason = self.aggregator.add_local_trained_result(
+                sender - 1, model, weight)
+            if reason is not None:
+                # the whole fold failed admission (a fold-level quarantine
+                # is a REGION-level fault): bounded re-solicit like the
+                # flat path, then the quorum pacers complete without it
+                _region_folds.labels(run_id=self._run_label,
+                                     outcome="quarantined").inc()
+                ledger.event("hier", "fold_quarantined", round_idx=version,
+                             region=region, reason=reason)
+                n_prev = self._quarantine_resolicits.get(sender, 0)
+                if n_prev < self._resolicit_max:
+                    self._quarantine_resolicits[sender] = n_prev + 1
+                    logging.warning(
+                        "global: QUARANTINED fold from region %s (%s) — "
+                        "re-soliciting the segment (attempt %d/%d)",
+                        region, reason, n_prev + 1, self._resolicit_max)
+                    self._broadcast_round(only_rank=sender)
+                else:
+                    self._maybe_complete_early()
+                return
+            self._seen_folds[key] = True
+            for t in triples:
+                self._counted_triples[t] = True
+            self._trim_windows()
+            _region_folds.labels(run_id=self._run_label,
+                                 outcome="folded").inc()
+            ledger.event("hier", "fold_receive", round_idx=version,
+                         region=region, fold_round=fold_round,
+                         n_silos=n_silos, expected=expected,
+                         staleness=staleness,
+                         weight=round(weight, 6))
+            self._persist_round_state()
+            if self.aggregator.check_whether_all_receive():
+                self._complete_round()
+                return
+            self._maybe_complete_early()
+
+    def _decode_fold(self, msg: Message, fold_round: int) -> Any:
+        """Raw | codec-delta fold payload → model tree, or ``_MISSING_REF``
+        when the delta's trained-against segment reference is gone (e.g.
+        it predates a crash-resume).  Caller holds ``_round_lock``."""
+        model = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        if model is not None:
+            return model
+        wire_update = msg.get(MyMessage.MSG_ARG_KEY_WIRE_UPDATE)
+        if wire_update is not None:
+            from ...utils.compression import decode_delta
+
+            ref = self._ref_for(fold_round)
+            if ref is None:
+                return _MISSING_REF
+            return decode_delta(wire_update, ref)
+        return None
+
+    def _trim_windows(self) -> None:
+        while len(self._seen_folds) > _FOLD_DEDUP_WINDOW:
+            self._seen_folds.popitem(last=False)
+        while len(self._counted_triples) > _FOLD_DEDUP_WINDOW:
+            self._counted_triples.popitem(last=False)
+
+    def run(self) -> None:
+        try:
+            super().run()
+        finally:
+            with self._round_lock:
+                stranded = not self._finishing
+                self._finishing = True
+            if stranded:
+                # abnormal receive-loop exit (a handler raised past the
+                # dispatch guard): release the regions before tearing
+                # down, or every regional node blocks on G2R_FINISH
+                self.send_finish_to_all()
+            self.finish()
+
+    def send_finish_to_all(self) -> None:
+        for rank in range(1, self.client_num + 1):
+            msg = Message(HierMessage.MSG_TYPE_G2R_FINISH,
+                          self.get_sender_id(), rank)
+            self.send_message(msg)

@@ -1,0 +1,45 @@
+"""Wire schema for the geo-distributed aggregation hierarchy.
+
+Two planes, two schemas:
+
+* the LAN plane (regional aggregator ↔ its silos) speaks the UNMODIFIED
+  flat cross-silo protocol (``..message_define.MyMessage``) — silos run
+  stock ``ClientMasterManager``s and cannot tell a regional aggregator
+  from a flat server;
+* the WAN plane (global server ↔ regional aggregators) speaks this
+  schema: a region announces itself (``R2G_REGION_STATUS``), receives
+  round segments (``G2R_INIT_CONFIG`` / ``G2R_SYNC_MODEL``), and ships
+  exactly ONE pre-reduced, codec-compressed fold per segment
+  (``R2G_REGION_FOLD``).  Heartbeats reuse the flat plane's
+  ``C2S_HEARTBEAT`` wire value — the global server IS a
+  ``FedMLServerManager`` underneath and its failure detector is
+  type-compatible by construction.
+
+The fold payload carries the ``(silo rank, silo round)`` pairs that went
+into it: together with the sending region they form the
+``(region, silo, round)`` dedup triples the global server audits, so a
+retransmitted or re-folded regional delta can never double-count any
+silo's upload.
+"""
+
+
+class HierMessage:
+    # region handshake (WAN analog of C2S_CLIENT_STATUS)
+    MSG_TYPE_R2G_REGION_STATUS = "R2G_REGION_STATUS"
+    #: ONE pre-reduced regional delta per round segment
+    MSG_TYPE_R2G_REGION_FOLD = "R2G_REGION_FOLD"
+
+    # global → region round segments (WAN analog of S2C init/sync/finish)
+    MSG_TYPE_G2R_INIT_CONFIG = "G2R_INIT_CONFIG"
+    MSG_TYPE_G2R_SYNC_MODEL = "G2R_SYNC_MODEL"
+    MSG_TYPE_G2R_FINISH = "G2R_FINISH"
+
+    # payload keys (model/round/codec keys are shared with MyMessage so
+    # the wire codecs and tracing ride both planes unchanged)
+    MSG_ARG_KEY_REGION = "region"
+    #: silo uploads folded into this regional delta
+    MSG_ARG_KEY_N_SILOS = "n_silos"
+    #: silos the region solicited for the segment (fold may be partial)
+    MSG_ARG_KEY_EXPECTED_SILOS = "expected_silos"
+    #: ``[[silo rank, silo round], ...]`` — the fold's dedup triples
+    MSG_ARG_KEY_SILO_ROUNDS = "silo_rounds"

@@ -1,0 +1,654 @@
+"""Regional aggregator of the geo-distributed hierarchy.
+
+Two cooperating roles per region:
+
+* ``RegionalAggregatorManager`` — the LAN face: a ``FedMLServerManager``
+  the region's silos cannot tell from a flat server (stock
+  ``ClientMasterManager``s, the unmodified S2C/C2S wire).  It does NOT
+  own the round clock: a round segment opens when the global server's
+  sync arrives through the uplink, the silo uploads fold locally with
+  the fused epilogue (regional FedBuff-style partial buffer = the
+  per-round received set, regional staleness decay on silo upload age,
+  regional robust op, default ``trimmed_mean``), and the fold — ONE
+  pre-reduced model — is handed to the uplink's fold sink.  The round
+  index never self-advances: only the next G2R sync does.  Crash-resume
+  rides ``RoundCheckpointer`` unchanged, extended with the fold marker
+  and the per-silo round map, so a SIGKILLed regional aggregator
+  re-enters its segment and re-solicits ONLY its missing silos (the
+  base late-join catch-up re-solicits each silo on its first
+  post-restart heartbeat).
+
+* ``RegionUplink`` — the WAN face: announces the region, receives round
+  segments, ships the fold (codec-compressed delta against the decoded
+  segment broadcast) with the ``(silo rank, silo round)`` pairs that
+  the global server audits as ``(region, silo, round)`` dedup triples,
+  and heartbeats into the global failure detector.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...core import mlops
+from ...core.mlops import ledger, metrics, slo, tracing
+from ...core.distributed.communication.message import Message
+from ...core.distributed.communication.reliable import ARG_VOLATILE
+from ...core.distributed.fedml_comm_manager import FedMLCommManager
+from ...ml.aggregator.staleness import parse_staleness, staleness_weight
+from ...utils.compression import WIRE_BYTES as _wire_bytes
+from ..message_define import MyMessage
+from ..server.fedml_aggregator import FedMLAggregator
+from ..server.fedml_server_manager import FedMLServerManager
+from .message_define import HierMessage
+
+_region_fold_seconds = metrics.histogram(
+    "fedml_region_fold_seconds",
+    "Wall-clock duration of a regional round segment (segment open to "
+    "local fold)", labels=("run_id", "region"),
+    buckets=(0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 300.0))
+_silo_uploads = metrics.counter(
+    "fedml_region_silo_uploads_total",
+    "Silo uploads handled by a regional aggregator, by outcome (folded | "
+    "expired | quarantined)", labels=("run_id", "region", "outcome"))
+
+#: a silo delta whose trained-against segment reference is gone
+_MISSING_REF = object()
+
+#: bound on the cross-segment (silo, round) keep-first audit window
+_SILO_DEDUP_WINDOW = 4096
+
+
+class RegionalAggregatorManager(FedMLServerManager):
+    def __init__(self, args: Any, aggregator: FedMLAggregator, region: str,
+                 silo_indices: List[int], comm=None, rank: int = 0,
+                 client_num: int = 0, backend: str = "INPROC") -> None:
+        # subclass state FIRST: the base __init__ may run _try_resume,
+        # which this class overrides and which reads these fields
+        self._region = str(region)
+        self._silo_indices = list(silo_indices)
+        #: silo rank → the silo round its folded upload trained (becomes
+        #: the (region, silo, round) dedup triples on the WAN)
+        self._silo_rounds: Dict[int, int] = {}
+        #: every (silo rank, silo round) already folded into a SHIPPED
+        #: segment — keep-first across segments: a retransmitted or
+        #: re-trained duplicate (e.g. a crash-resume catch-up made the
+        #: silo train the same round twice) must never enter a second
+        #: fold, or the global triple audit rejects that fold whole
+        self._folded_silo_rounds: "OrderedDict" = OrderedDict()
+        #: True once the in-flight segment's fold was computed (and, on
+        #: the happy path, shipped) — a resume from this state must NOT
+        #: re-open the segment locally; the global server drives
+        self._segment_folded = False
+        self._fold_sink: Optional[Callable] = None
+        # pending segment held as SPLIT fields (index apart from model
+        # payload) so the round index never rides a tensor container
+        self._pending_round: Optional[int] = None
+        self._pending_model: Any = None
+        self._silos_ready = False
+        self._segment_t0: Optional[float] = None
+        #: segment → (decoded ref, raw ref) for decoding stale silo uploads
+        self._version_refs: "OrderedDict" = OrderedDict()
+        self._region_staleness_spec = parse_staleness(
+            getattr(args, "hier_region_staleness", None))
+        self._region_staleness_cutoff = int(
+            getattr(args, "hier_region_staleness_cutoff", 2) or 2)
+        super().__init__(args, aggregator, comm, rank, client_num, backend)
+
+    def set_fold_sink(self, sink: Callable) -> None:
+        """``sink(segment, fold, n_silos, expected, silo_rounds, weight)``
+        — the uplink's ship-one-delta-over-the-WAN entrypoint."""
+        self._fold_sink = sink
+
+    # -- segment lifecycle (the global server owns the round clock) ----------
+    def _start_training(self) -> None:
+        """All silos online.  Unlike the flat server there is nothing to
+        broadcast yet — the segment opens when the global sync arrives."""
+        with self._round_lock:
+            self._silos_ready = True
+            if self._pending_round is not None and not self.is_initialized:
+                seg = self._pending_round
+                model = self._pending_model
+                self._pending_round = None
+                self._pending_model = None
+                self._begin_segment(seg, model)
+
+    def start_global_round(self, round_idx: int, global_model: Any) -> None:
+        """Uplink hand-off: the global server opened (or re-solicited)
+        round ``round_idx`` for this region."""
+        with self._round_lock:
+            if self._finishing:
+                return
+            if self.is_initialized and int(round_idx) == int(
+                    self.args.round_idx):
+                # re-solicited segment already in flight (the global's
+                # deadline pacer re-sent it): keep folding, don't restart
+                return
+            self._pending_round = int(round_idx)
+            self._pending_model = global_model
+            if self._silos_ready or self.is_initialized:
+                seg = self._pending_round
+                model = self._pending_model
+                self._pending_round = None
+                self._pending_model = None
+                self._begin_segment(seg, model)
+
+    def _begin_segment(self, round_idx: int, global_model: Any) -> None:
+        """Open round segment ``round_idx``: adopt the global model,
+        broadcast to the region's silos, arm the pacers.  Caller holds
+        ``_round_lock``."""
+        with self._round_lock:
+            if self._finishing:
+                return
+            abandoned = self.aggregator.receive_count()
+            if abandoned:
+                # a newer segment supersedes an uncompleted one (our fold
+                # for it was lost, or the quorum closed without us): its
+                # partial uploads must not leak into the new fold
+                logging.warning(
+                    "region %s: abandoning segment %d with %d partial "
+                    "uploads — global moved to %d", self._region,
+                    self.args.round_idx, abandoned, round_idx)
+                self.aggregator.reset_round_state()
+            if self._run_span is None:
+                mlops.log_aggregation_status("RUNNING")
+                self._run_span = tracing.start_span(
+                    "region_run", run_id=self._run_label,
+                    region=self._region)
+            self.aggregator.set_global_model_params(global_model)
+            self.args.round_idx = int(round_idx)
+            self._segment_folded = False
+            self._segment_t0 = time.monotonic()
+            self._silo_rounds = {}
+            self._caught_up_this_round = set()
+            self._quarantine_resolicits = {}
+            self._round_train_metrics = {}
+            self.is_initialized = True
+            # the cohort IS the region's silo slice — global data-silo
+            # indexes, fixed per region, never resampled
+            self.client_id_list_in_this_round = list(self._silo_indices)
+            self.data_silo_index_of_client = list(self._silo_indices)
+            self._open_round_span()
+            self._broadcast_round()
+            self._arm_round_timer()
+            self._arm_deadline_timer()
+            self._persist_round_state()
+
+    # -- versioned delta references (stale silo uploads still decode) --------
+    def _note_round_ref(self, ref: Any, raw: Optional[Any] = None) -> None:
+        super()._note_round_ref(ref, raw)
+        version = int(self.args.round_idx)
+        self._version_refs[version] = (ref, ref if raw is None else raw)
+        while len(self._version_refs) > self._region_staleness_cutoff + 2:
+            self._version_refs.popitem(last=False)
+
+    def _ref_for(self, upload_round: int, raw: bool = False) -> Any:
+        pair = self._version_refs.get(int(upload_round))
+        if pair is not None:
+            return pair[1] if raw else pair[0]
+        return None
+
+    # -- silo upload ingest (dedup → staleness → admission) ------------------
+    def handle_message_receive_model_from_client(self, msg: Message) -> None:
+        sender = msg.get_sender_id()
+        n_samples = msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
+        with self._round_lock:
+            if self._finishing:
+                return
+            if not self.is_initialized:
+                # no segment open (fold already shipped, or waiting for
+                # the first sync): a late upload cannot enter a closed
+                # fold — the silo rejoins on the next segment broadcast
+                logging.debug(
+                    "region %s: dropping upload from silo %d outside an "
+                    "open segment", self._region, sender)
+                return
+            seg = int(self.args.round_idx)
+            upload_round = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND, seg))
+            self._last_seen[sender] = time.monotonic()
+            self.client_online_status[sender] = True
+            if (sender, upload_round) in self._folded_silo_rounds:
+                # dedup FIRST (before staleness/admission): this exact
+                # silo upload is already inside a shipped fold
+                _silo_uploads.labels(run_id=self._run_label,
+                                     region=self._region,
+                                     outcome="duplicate").inc()
+                ledger.event("hier", "silo_duplicate", round_idx=seg,
+                             client=sender, region=self._region,
+                             upload_round=upload_round)
+                logging.info(
+                    "region %s: duplicate upload from silo %d for round "
+                    "%d — already folded, dropped (keep-first)",
+                    self._region, sender, upload_round)
+                return
+            staleness = seg - upload_round
+            if staleness < 0:
+                logging.warning(
+                    "region %s: upload from silo %d claims FUTURE round "
+                    "%d (segment %d) — dropped", self._region, sender,
+                    upload_round, seg)
+                return
+            if staleness > self._region_staleness_cutoff:
+                self._note_expired_upload(sender, staleness, "stale")
+                return
+            model = self._decode_upload(msg, upload_round)
+            if model is None or model is _MISSING_REF:
+                self._note_expired_upload(sender, staleness, "missing_ref")
+                return
+            train_metrics = msg.get(MyMessage.MSG_ARG_KEY_TRAIN_METRICS)
+            if isinstance(train_metrics, dict) and train_metrics:
+                self._round_train_metrics[sender] = train_metrics
+            ledger.event("server", "receive", round_idx=seg, client=sender,
+                         samples=n_samples, region=self._region)
+            # regional staleness decay folds into the sample weight the
+            # fused epilogue consumes — an old-but-admitted silo update
+            # counts, just less
+            weight = float(n_samples or 1.0) * staleness_weight(
+                self._region_staleness_spec, float(staleness))
+            reason = self.aggregator.add_local_trained_result(
+                sender - 1, model, weight)
+            if reason is not None:
+                _silo_uploads.labels(run_id=self._run_label,
+                                     region=self._region,
+                                     outcome="quarantined").inc()
+                n_prev = self._quarantine_resolicits.get(sender, 0)
+                if n_prev < self._resolicit_max:
+                    self._quarantine_resolicits[sender] = n_prev + 1
+                    logging.warning(
+                        "region %s: re-soliciting silo %d after "
+                        "quarantined upload (%s, attempt %d/%d)",
+                        self._region, sender, reason, n_prev + 1,
+                        self._resolicit_max)
+                    ledger.event("server", "resolicit", round_idx=seg,
+                                 client=sender, reason=reason,
+                                 attempt=n_prev + 1)
+                    self._broadcast_round(only_rank=sender)
+                else:
+                    self._maybe_complete_early()
+                return
+            self._silo_rounds[sender] = upload_round
+            _silo_uploads.labels(run_id=self._run_label,
+                                 region=self._region, outcome="folded").inc()
+            self._persist_round_state()
+            if self.aggregator.check_whether_all_receive():
+                self._complete_round()
+                return
+            self._maybe_complete_early()
+
+    def _note_expired_upload(self, sender: int, staleness: int,
+                             reason: str) -> None:
+        """Expired silo upload: lateness, never quarantined.  Hand the
+        silo the CURRENT segment (once per segment) so its next upload
+        counts.  Caller holds ``_round_lock``."""
+        _silo_uploads.labels(run_id=self._run_label, region=self._region,
+                             outcome="expired").inc()
+        ledger.event("hier", "silo_expired",
+                     round_idx=int(self.args.round_idx), client=sender,
+                     region=self._region, staleness=int(staleness),
+                     reason=reason)
+        logging.warning(
+            "region %s: EXPIRED upload from silo %d (staleness %d, %s) — "
+            "dropped, re-syncing to the segment", self._region, sender,
+            staleness, reason)
+        if sender not in self._caught_up_this_round:
+            self._caught_up_this_round.add(sender)
+            self._broadcast_round(only_rank=sender)
+
+    def _decode_upload(self, msg: Message, upload_round: int) -> Any:
+        """Raw | wire-codec | legacy TopK silo payload → model tree, or
+        ``_MISSING_REF`` when the delta reference for ``upload_round`` is
+        gone.  Caller holds ``_round_lock``."""
+        model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        if model_params is not None:
+            return model_params
+        wire_update = msg.get(MyMessage.MSG_ARG_KEY_WIRE_UPDATE)
+        if wire_update is not None:
+            from ...utils.compression import decode_delta
+
+            ref = self._ref_for(upload_round)
+            if ref is None:
+                return _MISSING_REF
+            return decode_delta(wire_update, ref)
+        compressed = msg.get(MyMessage.MSG_ARG_KEY_COMPRESSED_UPDATE)
+        if compressed is not None:
+            import jax
+
+            from ...utils.compression import TopKCompressor, tree_spec
+
+            ref = self._ref_for(upload_round, raw=True)
+            if ref is None:
+                return _MISSING_REF
+            delta = TopKCompressor().decompress(compressed, tree_spec(ref))
+            return jax.tree_util.tree_map(lambda g, d: g + d, ref, delta)
+        return None
+
+    # -- the fold (regional round close) -------------------------------------
+    def _complete_round(self) -> None:
+        """Close the segment LOCALLY: fold the received silo set through
+        the aggregator funnel (regional robust op) and hand the result to
+        the uplink.  The round index does NOT advance — the next G2R sync
+        is the only thing that opens a new segment.  Caller holds
+        ``_round_lock``."""
+        sink = None
+        shipment = None
+        with self._round_lock:
+            if self._round_timer is not None:
+                self._round_timer.cancel()
+            if self._deadline_timer is not None:
+                self._deadline_timer.cancel()
+            closed = getattr(self, "_round_close_reason", None) or "full"
+            self._round_close_reason = None
+            if not self.is_initialized or self._segment_folded:
+                return
+            seg = int(self.args.round_idx)
+            n_silos = self.aggregator.receive_count()
+            if n_silos == 0:
+                return
+            expected = len(self.client_id_list_in_this_round)
+            silo_rounds = dict(self._silo_rounds)
+            total_weight = sum(
+                float(self.aggregator.sample_num_dict[i])
+                for i in range(self.client_num)
+                if self.aggregator.has_received(i))
+            with tracing.use_ctx(
+                    self._round_span.ctx if self._round_span else None):
+                fold = self.aggregator.aggregate()
+            fold_s = (time.monotonic() - self._segment_t0
+                      if self._segment_t0 else 0.0)
+            _region_fold_seconds.labels(run_id=self._run_label,
+                                        region=self._region).observe(fold_s)
+            ledger.event("hier", "region_fold", round_idx=seg,
+                         region=self._region, n_silos=int(n_silos),
+                         expected=int(expected), closed=closed,
+                         fold_s=round(fold_s, 3))
+            slo.check_round_boundary(seg)
+            if self._round_span is not None:
+                self._round_span.set_attr("region", self._region)
+                self._round_span.set_attr("clients_reported", n_silos)
+                self._round_span.end()
+                self._round_span = None
+            self._segment_folded = True
+            self.is_initialized = False
+            for rank, trained in silo_rounds.items():
+                self._folded_silo_rounds[(int(rank), int(trained))] = True
+            while len(self._folded_silo_rounds) > _SILO_DEDUP_WINDOW:
+                self._folded_silo_rounds.popitem(last=False)
+            self._silo_rounds = {}
+            # boundary checkpoint carries the fold marker: a resume from
+            # here waits for the global to drive instead of re-training
+            self._persist_round_state()
+            sink = self._fold_sink
+            shipment = (seg, fold, int(n_silos), int(expected), silo_rounds,
+                        float(total_weight))
+            logging.info(
+                "region %s: folded segment %d (%d/%d silos, %s close, "
+                "%.2fs)", self._region, seg, n_silos, expected, closed,
+                fold_s)
+        if sink is not None and shipment is not None:
+            # ship OUTSIDE the lock: the WAN send may block (chaos
+            # latency/bandwidth shaping) and must not stall silo ingest
+            sink(*shipment)
+
+    # -- crash-resume (RoundCheckpointer, fold-marker aware) -----------------
+    def _persist_round_state(self) -> None:
+        if self._ckpt is None or self._ckpt_writer is None:
+            return
+        state = {
+            "round_idx": int(self.args.round_idx),
+            "global_model": self.aggregator.get_global_model_params(),
+            "hier_folded": np.asarray(1 if self._segment_folded else 0),
+        }
+        if self._silo_rounds:
+            state["hier_silo_rounds"] = {
+                str(k): np.asarray(int(v))
+                for k, v in self._silo_rounds.items()}
+        if self._folded_silo_rounds:
+            state["hier_folded_pairs"] = np.asarray(
+                [[r, t] for r, t in self._folded_silo_rounds], dtype=np.int64)
+        state.update(self.aggregator.export_round_state())
+        self._ckpt_writer.submit(
+            self._write_round_state, int(self.args.round_idx), state)
+
+    def _try_resume(self, resume: Any) -> None:
+        if resume is True or str(resume).strip().lower() in (
+                "latest", "true", "yes"):
+            step = None
+        else:
+            step = int(resume)
+        state = self._ckpt.restore(step)
+        if state is None:
+            logging.warning(
+                "region %s: resume_from=%r but no usable checkpoint in %s "
+                "— starting fresh", self._region, resume, self._ckpt.dir)
+            return
+        self.args.round_idx = int(np.asarray(state["round_idx"]))
+        self.aggregator.set_global_model_params(state["global_model"])
+        self.aggregator.restore_round_state(state)
+        self._segment_folded = bool(
+            int(np.asarray(state.get("hier_folded", 0))))
+        self._silo_rounds = {
+            int(k): int(np.asarray(v))
+            for k, v in (state.get("hier_silo_rounds") or {}).items()}
+        pairs = state.get("hier_folded_pairs")
+        if pairs is not None:
+            for rank, trained in np.asarray(pairs).reshape(-1, 2):
+                self._folded_silo_rounds[(int(rank), int(trained))] = True
+        self._resumed = True
+        logging.warning(
+            "region %s: resumed at segment %d with %d/%d silo results "
+            "(folded=%s)", self._region, self.args.round_idx,
+            self.aggregator.receive_count(), self.client_num,
+            self._segment_folded)
+
+    def _resume_training(self) -> None:
+        """Re-enter the checkpointed segment.  Two cases:
+
+        * fold already computed before the crash → nothing to redo
+          locally; wait for the global server to drive (its dedup absorbs
+          a duplicate fold if ours landed; its deadline re-solicit
+          re-opens the segment if it never did);
+        * mid-segment crash → re-open the segment and re-solicit ONLY the
+          missing silos: each surviving silo's first post-restart
+          heartbeat is an unseen-rank sighting, and the base late-join
+          catch-up re-sends the segment to exactly the ranks whose
+          uploads aren't in the restored received set."""
+        with self._round_lock:
+            seg = int(self.args.round_idx)
+            # the silos announced ONLINE to the PREVIOUS incarnation and
+            # will only heartbeat from here — without this, the segment
+            # after the resumed one parks in _pending forever waiting for
+            # announces that never come
+            self._silos_ready = True
+            if self._segment_folded:
+                logging.warning(
+                    "region %s: segment %d was already folded before the "
+                    "crash — waiting for the global server to drive",
+                    self._region, seg)
+                return
+            mlops.log_aggregation_status("RUNNING")
+            self._run_span = tracing.start_span(
+                "region_run", run_id=self._run_label, region=self._region,
+                resumed_at=seg)
+            self.is_initialized = True
+            self._segment_t0 = time.monotonic()
+            self.client_id_list_in_this_round = list(self._silo_indices)
+            self.data_silo_index_of_client = list(self._silo_indices)
+            self._open_round_span()
+            # re-register the restored global as the segment's delta
+            # reference; silos re-solicited via catch-up get a fresh
+            # broadcast (and a fresh ref) anyway
+            self._note_round_ref(self.aggregator.get_global_model_params())
+            self._arm_round_timer()
+            self._arm_deadline_timer()
+            if self.aggregator.check_whether_all_receive():
+                logging.warning(
+                    "region %s: resumed segment %d already has every silo "
+                    "— folding immediately", self._region, seg)
+                self._complete_round()
+
+    def region_finish(self) -> None:
+        """G2R FINISH relay: wind down the region's silos and this node."""
+        with self._round_lock:
+            if self._finishing:
+                return
+        logging.info("region %s: finish", self._region)
+        self.send_finish_to_all()
+        mlops.log_aggregation_status("FINISHED")
+        if self._run_span is not None:
+            self._run_span.end()
+            self._run_span = None
+        self.finish()
+
+
+class RegionUplink(FedMLCommManager):
+    """The region's WAN face (rank = region index on the WAN plane)."""
+
+    def __init__(self, args: Any, region: str,
+                 region_manager: RegionalAggregatorManager, comm=None,
+                 rank: int = 0, size: int = 0,
+                 backend: str = "INPROC") -> None:
+        self._region = str(region)
+        self._region_mgr = region_manager
+        self._wire_codec = None
+        self._wire_codec_spec = ""
+        #: segment → decoded global broadcast (the fold's delta reference)
+        self._segment_refs: "OrderedDict" = OrderedDict()
+        self._hb_stop = threading.Event()
+        super().__init__(args, comm, rank, size, backend)
+        # the fold sink reference wires the LAN fold into the WAN send —
+        # the one emission that lets every global round reach FINISH
+        region_manager.set_fold_sink(self.send_fold)
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            HierMessage.MSG_TYPE_G2R_INIT_CONFIG,
+            self.handle_message_global_segment)
+        self.register_message_receive_handler(
+            HierMessage.MSG_TYPE_G2R_SYNC_MODEL,
+            self.handle_message_global_segment)
+        self.register_message_receive_handler(
+            HierMessage.MSG_TYPE_G2R_FINISH, self.handle_message_finish)
+
+    def run(self) -> None:
+        self.register_message_receive_handlers()
+        self.send_region_status()
+        self._start_heartbeat()
+        self.com_manager.handle_receive_message()
+
+    def finish(self) -> None:
+        self._hb_stop.set()
+        super().finish()
+
+    # -- liveness (the global failure detector judges REGIONS) ---------------
+    def _start_heartbeat(self) -> None:
+        interval = float(getattr(self.args, "heartbeat_interval_s", 0) or 0)
+        if interval <= 0:
+            return
+
+        def _loop() -> None:
+            while not self._hb_stop.wait(interval):
+                try:
+                    msg = Message(MyMessage.MSG_TYPE_HEARTBEAT,
+                                  self.get_sender_id(), 0)
+                    msg.add_params(MyMessage.MSG_ARG_KEY_HEARTBEAT_TS,
+                                   time.time())
+                    msg.add_params(ARG_VOLATILE, True)
+                    self.send_message(msg)
+                except Exception:  # noqa: BLE001 — a failed beat is a
+                    # missed beat, nothing to escalate from here
+                    logging.debug("region %s: heartbeat send failed",
+                                  self._region, exc_info=True)
+
+        threading.Thread(target=_loop, daemon=True,
+                         name=f"hier-heartbeat-{self._region}").start()
+
+    # -- protocol ------------------------------------------------------------
+    def send_region_status(self) -> None:
+        from ...utils.compression import WIRE_CAPS
+
+        msg = Message(HierMessage.MSG_TYPE_R2G_REGION_STATUS,
+                      self.get_sender_id(), 0)
+        msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_STATUS,
+                       MyMessage.CLIENT_STATUS_ONLINE)
+        msg.add_params(HierMessage.MSG_ARG_KEY_REGION, self._region)
+        msg.add_params(HierMessage.MSG_ARG_KEY_EXPECTED_SILOS,
+                       int(self._region_mgr.client_num))
+        msg.add_params(MyMessage.MSG_ARG_KEY_WIRE_CAPS, list(WIRE_CAPS))
+        self.send_message(msg)
+
+    def handle_message_global_segment(self, msg: Message) -> None:
+        """G2R segment broadcast: decode (mirroring the silo client's
+        broadcast unpack), remember the delta reference, hand the segment
+        to the regional aggregator."""
+        global_model = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        if msg.get(MyMessage.MSG_ARG_KEY_MODEL_ENCODED):
+            from ...utils.compression import WireCodec
+
+            global_model = WireCodec.decode_model(global_model)
+        codec_spec = msg.get(MyMessage.MSG_ARG_KEY_WIRE_CODEC)
+        if codec_spec and str(codec_spec) != self._wire_codec_spec:
+            from ...utils.compression import WireCodec
+
+            self._wire_codec = WireCodec(str(codec_spec))
+            self._wire_codec_spec = str(codec_spec)
+        elif not codec_spec:
+            self._wire_codec = None
+            self._wire_codec_spec = ""
+        round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND, 0))
+        self._segment_refs[round_idx] = global_model
+        while len(self._segment_refs) > 8:
+            self._segment_refs.popitem(last=False)
+        self._region_mgr.start_global_round(round_idx, global_model)
+
+    def send_fold(self, segment: int, fold: Any, n_silos: int,
+                  expected: int, silo_rounds: Dict[int, int],
+                  total_weight: float) -> None:
+        """Ship the region's ONE pre-reduced delta for ``segment`` over
+        the WAN — codec-compressed against the decoded segment broadcast
+        when a wire codec was negotiated."""
+        from ...utils.serialization import estimate_nbytes
+
+        msg = Message(HierMessage.MSG_TYPE_R2G_REGION_FOLD,
+                      self.get_sender_id(), 0)
+        msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, int(segment))
+        msg.add_params(HierMessage.MSG_ARG_KEY_REGION, self._region)
+        msg.add_params(HierMessage.MSG_ARG_KEY_N_SILOS, int(n_silos))
+        msg.add_params(HierMessage.MSG_ARG_KEY_EXPECTED_SILOS, int(expected))
+        msg.add_params(HierMessage.MSG_ARG_KEY_SILO_ROUNDS,
+                       [[int(r), int(t)]
+                        for r, t in sorted(silo_rounds.items())])
+        msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES,
+                       float(total_weight))
+        ref = self._segment_refs.get(int(segment))
+        if self._wire_codec is not None and ref is not None:
+            payload = self._wire_codec.encode_delta(fold, ref)
+            msg.add_params(MyMessage.MSG_ARG_KEY_WIRE_UPDATE, payload)
+            codec = self._wire_codec.spec.kind
+            nbytes = estimate_nbytes(payload)
+        else:
+            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, fold)
+            codec = "raw"
+            nbytes = estimate_nbytes(fold)
+        run_label = str(getattr(self.args, "run_id", "0"))
+        _wire_bytes.labels(run_id=run_label, direction="up",
+                           codec=codec).inc(nbytes)
+        from .global_server_manager import _wan_bytes
+
+        _wan_bytes.labels(run_id=run_label, direction="up").inc(nbytes)
+        ledger.event("hier", "region_ship", round_idx=int(segment),
+                     region=self._region, nbytes=int(nbytes), codec=codec,
+                     n_silos=int(n_silos), expected=int(expected))
+        logging.info(
+            "region %s: shipping fold for segment %d over the WAN "
+            "(%d/%d silos, %d bytes, %s)", self._region, segment, n_silos,
+            expected, nbytes, codec)
+        self.send_message(msg)
+
+    def handle_message_finish(self, msg: Message) -> None:
+        self._region_mgr.region_finish()
+        self.finish()

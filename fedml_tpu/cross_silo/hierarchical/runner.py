@@ -1,0 +1,314 @@
+"""Hierarchical federation runner: edge → region → global in one process.
+
+Topology derivation: ``--hier-regions R`` splits the
+``client_num_in_total`` silos into R contiguous slices, one regional
+aggregator + WAN uplink per slice.  Two comm planes share the INPROC
+hub by run_id:
+
+* the WAN plane (global rank 0 + uplink ranks 1..R) runs on the base
+  ``run_id`` — so ``fedml_wire_bytes_total{run_id=<base>}`` counts ONLY
+  bytes that cross the WAN, the quantity the hierarchy exists to shrink;
+* each region's LAN plane runs on ``<run_id>/lan-<region>`` with the
+  regional manager at rank 0 and STOCK silo clients at ranks 1..k.
+
+Per-tier knobs (all optional, ``getattr`` with defaults): the WAN tier
+reads ``min_regions`` (quorum floor, default all regions),
+``hier_global_robust_agg`` (default ``median``),
+``hier_global_staleness`` / ``hier_staleness_cutoff``,
+``hier_round_timeout_s`` / ``hier_round_deadline_s`` /
+``hier_heartbeat_interval_s`` (default: the flat-tier values) and
+``hier_wan_compression`` / ``hier_wan_reliable`` (default: the flat
+wire settings).  The region tier reads ``hier_region_robust_agg``
+(default ``trimmed_mean:0.2``), ``hier_region_staleness`` /
+``hier_region_staleness_cutoff`` and ``hier_min_silos``.
+
+``RegionNode.hard_kill()`` is the SIGKILL analog for the in-process
+plane (receive loops stopped with no protocol goodbye), and
+``HierarchicalFederationRunner.restart_region()`` rebuilds the region's
+manager + uplink resuming from its round-boundary checkpoint — the
+chaos soak's crash lever.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ...ml.trainer.default_trainer import DefaultServerAggregator
+from ..server.fedml_aggregator import FedMLAggregator
+from .global_server_manager import GlobalServerManager
+from .regional_manager import RegionalAggregatorManager, RegionUplink
+
+
+def _clone_args(args: Any, **overrides: Any) -> Any:
+    from ...arguments import Config
+
+    base = (args.to_dict() if hasattr(args, "to_dict")
+            else dict(vars(args)))
+    base.update(overrides)
+    return Config(**base)
+
+
+def hier_layout(args: Any) -> List[Tuple[str, List[int]]]:
+    """``(region name, global silo indices)`` per region — contiguous
+    slices of the silo population, remainder spread over the first
+    regions."""
+    n_regions = int(getattr(args, "hier_regions", 0) or 0)
+    if n_regions < 2:
+        raise ValueError(
+            f"hier_regions={n_regions}: the hierarchy needs >= 2 regions "
+            "(use the flat runner for one)")
+    total = int(args.client_num_in_total)
+    if total < n_regions:
+        raise ValueError(
+            f"client_num_in_total={total} < hier_regions={n_regions}: "
+            "every region needs at least one silo")
+    names = getattr(args, "hier_region_names", None)
+    names = ([str(x) for x in names] if names
+             else [f"r{i}" for i in range(n_regions)])
+    if len(names) != n_regions:
+        raise ValueError(
+            f"hier_region_names has {len(names)} entries for "
+            f"{n_regions} regions")
+    base, rem = divmod(total, n_regions)
+    layout, start = [], 0
+    for i in range(n_regions):
+        count = base + (1 if i < rem else 0)
+        layout.append((names[i], list(range(start, start + count))))
+        start += count
+    return layout
+
+
+class RegionNode:
+    """One region's aggregation pair: LAN manager + WAN uplink.  Its silo
+    clients are NOT part of the node — they are separate (surviving)
+    processes in spirit, so a hard-killed region leaves them running and
+    the resumed manager re-solicits only the missing ones."""
+
+    def __init__(self, name: str, region_rank: int, silo_indices: List[int],
+                 region_args: Any, uplink_args: Any, dataset: Tuple,
+                 bundle: Any, n_regions: int, lan_backend: str,
+                 wan_backend: str) -> None:
+        self.name = name
+        self.region_rank = int(region_rank)
+        impl = DefaultServerAggregator(bundle, region_args)
+        agg = FedMLAggregator(region_args, impl, dataset[3])
+        self.manager = RegionalAggregatorManager(
+            region_args, agg, name, silo_indices, rank=0,
+            client_num=len(silo_indices), backend=lan_backend)
+        self.uplink = RegionUplink(
+            uplink_args, name, self.manager, rank=region_rank,
+            size=n_regions + 1, backend=wan_backend)
+        self.threads: List[threading.Thread] = []
+
+    def start(self) -> None:
+        self.threads = [self.manager.run_async(), self.uplink.run_async()]
+
+    def hard_kill(self) -> None:
+        """SIGKILL analog for the in-process plane: silence the node with
+        NO protocol goodbye — receive loops stop, timers and heartbeats
+        die, nothing is broadcast.  Queued round-boundary checkpoint
+        writes are drained first (the write-first-delete-after layout
+        makes a torn write unreadable anyway; draining keeps the test
+        lever deterministic)."""
+        with self.manager._round_lock:
+            self.manager._finishing = True
+            for timer in (self.manager._round_timer,
+                          self.manager._init_timer,
+                          self.manager._deadline_timer):
+                if timer is not None:
+                    timer.cancel()
+        self.manager._hb_stop.set()
+        self.uplink._hb_stop.set()
+        for node in (self.manager, self.uplink):
+            try:
+                node.com_manager.stop_receive_message()
+            except Exception:  # noqa: BLE001 — a dead node stays dead
+                logging.debug("region %s: hard-kill stop failed",
+                              self.name, exc_info=True)
+        if self.manager._ckpt_writer is not None:
+            self.manager._ckpt_writer.shutdown(wait=True)
+            self.manager._ckpt_writer = None
+        logging.warning("region %s: HARD-KILLED (no goodbye)", self.name)
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        for t in self.threads:
+            t.join(timeout=timeout)
+
+
+class HierarchicalFederationRunner:
+    """Global server + R (regional aggregator, uplink) pairs + stock silo
+    clients over INPROC threads; ``train()`` blocks until the global run
+    finishes and returns its final eval metrics."""
+
+    JOIN_TIMEOUT_S = 30.0
+
+    def __init__(self, args: Any, device: Any, dataset: Tuple, bundle: Any,
+                 client_trainer: Optional[Any] = None,
+                 server_aggregator: Optional[Any] = None) -> None:
+        self.args = args
+        self.dataset = dataset
+        self.bundle = bundle
+        self.client_trainer = client_trainer
+        self.server_aggregator = server_aggregator
+        self.layout = hier_layout(args)
+        self.n_regions = len(self.layout)
+        backend = str(getattr(args, "backend", "INPROC")).upper()
+        self.wan_backend = str(
+            getattr(args, "hier_wan_backend", backend) or backend).upper()
+        self.lan_backend = str(
+            getattr(args, "hier_lan_backend", backend) or backend).upper()
+        self.global_manager: Optional[GlobalServerManager] = None
+        self.regions: Dict[str, RegionNode] = {}
+        self._region_args: Dict[str, Any] = {}
+        self._silo_threads: List[threading.Thread] = []
+        self._global_thread: Optional[threading.Thread] = None
+
+    # -- per-tier argument derivation ----------------------------------------
+    def _ckpt_subdir(self, leaf: str) -> Optional[str]:
+        root = getattr(self.args, "checkpoint_dir", None)
+        return os.path.join(str(root), leaf) if root else None
+
+    def _wan_args(self, **overrides: Any) -> Any:
+        a = self.args
+        min_regions = (int(getattr(a, "min_regions", 0) or 0)
+                       or self.n_regions)
+        return _clone_args(
+            a,
+            client_num_in_total=self.n_regions,
+            client_num_per_round=self.n_regions,
+            over_provision=0,
+            min_clients_per_round=min_regions,
+            min_aggregation_clients=min_regions,
+            min_regions=min_regions,
+            robust_agg=str(getattr(a, "hier_global_robust_agg", "median")
+                           or "median"),
+            wire_compression=getattr(
+                a, "hier_wan_compression",
+                getattr(a, "wire_compression", None)),
+            reliable=bool(getattr(a, "hier_wan_reliable",
+                                  getattr(a, "reliable", False))),
+            heartbeat_interval_s=float(getattr(
+                a, "hier_heartbeat_interval_s",
+                getattr(a, "heartbeat_interval_s", 0) or 0) or 0),
+            round_timeout_s=float(getattr(
+                a, "hier_round_timeout_s",
+                getattr(a, "round_timeout_s", 0) or 0) or 0),
+            round_deadline_s=float(getattr(
+                a, "hier_round_deadline_s",
+                getattr(a, "round_deadline_s", 0) or 0) or 0),
+            checkpoint_dir=self._ckpt_subdir("global"),
+            **overrides)
+
+    def region_args_for(self, name: str, n_silos: int,
+                        resume: Any = None) -> Any:
+        a = self.args
+        min_silos = int(getattr(a, "hier_min_silos", 1) or 1)
+        return _clone_args(
+            a,
+            run_id=f"{getattr(a, 'run_id', '0')}/lan-{name}",
+            client_num_in_total=n_silos,
+            client_num_per_round=n_silos,
+            over_provision=0,
+            min_clients_per_round=min_silos,
+            min_aggregation_clients=min_silos,
+            robust_agg=str(getattr(a, "hier_region_robust_agg",
+                                   "trimmed_mean:0.2")
+                           or "trimmed_mean:0.2"),
+            checkpoint_dir=self._ckpt_subdir(f"region-{name}"),
+            resume_from=resume if resume is not None
+            else getattr(a, "resume_from", None))
+
+    # -- construction --------------------------------------------------------
+    def _build_global(self) -> GlobalServerManager:
+        import jax
+
+        wan_args = self._wan_args()
+        impl = (self.server_aggregator
+                or DefaultServerAggregator(self.bundle, wan_args))
+        if impl.get_model_params() is None:
+            rng = jax.random.PRNGKey(
+                int(getattr(wan_args, "random_seed", 0) or 0))
+            impl.set_model_params(self.bundle.init_variables(rng))
+        agg = FedMLAggregator(wan_args, impl, self.dataset[3])
+        return GlobalServerManager(wan_args, agg, rank=0,
+                                   client_num=self.n_regions,
+                                   backend=self.wan_backend)
+
+    def _build_region(self, name: str, region_rank: int,
+                      silo_indices: List[int],
+                      resume: Any = None) -> RegionNode:
+        region_args = self.region_args_for(name, len(silo_indices), resume)
+        self._region_args[name] = region_args
+        uplink_args = self._wan_args()
+        return RegionNode(name, region_rank, silo_indices, region_args,
+                          uplink_args, self.dataset, self.bundle,
+                          self.n_regions, self.lan_backend,
+                          self.wan_backend)
+
+    def _trainer_for(self, rank: int):
+        """``rank`` is the FLAT silo rank (global silo index + 1) so a
+        callable trainer targets the same client it would in the flat
+        runner, independent of the region layout."""
+        if callable(self.client_trainer) and not hasattr(
+                self.client_trainer, "train"):
+            return self.client_trainer(rank)
+        return self.client_trainer
+
+    # -- lifecycle -----------------------------------------------------------
+    def launch(self) -> "HierarchicalFederationRunner":
+        from ..runner import init_client
+
+        self.global_manager = self._build_global()
+        for region_rank, (name, silos) in enumerate(self.layout, start=1):
+            node = self._build_region(name, region_rank, silos)
+            self.regions[name] = node
+        # stock silo clients per region LAN plane (ranks 1..k) — they are
+        # deliberately NOT owned by the RegionNode: a killed region leaves
+        # its silos running, like real silo hosts surviving an aggregator
+        # crash
+        for name, silos in self.layout:
+            region_args = self._region_args[name]
+            for local_rank in range(1, len(silos) + 1):
+                client = init_client(region_args, self.dataset, self.bundle,
+                                     local_rank,
+                                     self._trainer_for(
+                                         silos[local_rank - 1] + 1),
+                                     backend=self.lan_backend)
+                t = threading.Thread(target=client.run, daemon=True,
+                                     name=f"silo-{name}-{local_rank}")
+                t.start()
+                self._silo_threads.append(t)
+        for node in self.regions.values():
+            node.start()
+        self._global_thread = self.global_manager.run_async()
+        return self
+
+    def restart_region(self, name: str) -> RegionNode:
+        """Rebuild a (hard-killed) region's manager + uplink, resuming
+        from its round-boundary checkpoint.  Its silos kept running — the
+        resumed manager re-solicits only the ones missing from the
+        restored received set."""
+        old = self.regions[name]
+        silos = dict(self.layout)[name]
+        node = self._build_region(name, old.region_rank, silos,
+                                  resume="latest")
+        self.regions[name] = node
+        node.start()
+        logging.warning("region %s: RESTARTED from checkpoint", name)
+        return node
+
+    def wait(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+        self._global_thread.join(timeout=timeout)
+        for node in self.regions.values():
+            node.join(timeout=self.JOIN_TIMEOUT_S)
+        for t in self._silo_threads:
+            t.join(timeout=self.JOIN_TIMEOUT_S)
+        hist = self.global_manager.aggregator.metrics_history
+        return hist[-1] if hist else {}
+
+    def train(self) -> Dict[str, Any]:
+        self.launch()
+        return self.wait()

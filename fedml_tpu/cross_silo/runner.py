@@ -157,6 +157,19 @@ def build_cross_silo_runner(args: Any, device: Any, dataset: Tuple,
                             bundle: Any, client_trainer=None,
                             server_aggregator=None):
     backend = str(getattr(args, "backend", "INPROC")).upper()
+    if int(getattr(args, "hier_regions", 0) or 0) >= 2:
+        # geo-distributed hierarchy: regional aggregators fold their silos
+        # locally and ship one pre-reduced delta per round segment over
+        # the WAN plane to the global server (per-tier fault domains)
+        if backend != "INPROC":
+            raise NotImplementedError(
+                "hier_regions over a non-INPROC backend: launch the "
+                "global/region/silo roles per host instead (see "
+                "docs/ROBUSTNESS.md, Hierarchical aggregation)")
+        from .hierarchical.runner import HierarchicalFederationRunner
+        return HierarchicalFederationRunner(args, device, dataset, bundle,
+                                            client_trainer,
+                                            server_aggregator)
     if backend == "INPROC":
         # the in-process bus cannot cross OS processes, so a single-role
         # run over INPROC can never federate — it would block forever

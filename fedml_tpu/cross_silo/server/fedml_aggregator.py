@@ -211,6 +211,14 @@ class FedMLAggregator:
     def check_whether_all_receive(self) -> bool:
         return len(self._received_this_round) >= self.client_num
 
+    def reset_round_state(self) -> None:
+        """Abandon the in-flight round's received set WITHOUT aggregating
+        (hierarchical regional segments: a newer global segment supersedes
+        an uncompleted one — its partial uploads must not leak into the
+        new segment's fold)."""
+        self._received_this_round = set()
+        self.quarantined_this_round = {}
+
     # -- crash-resume state (PR 4: RoundCheckpointer wiring) -----------------
     def export_round_state(self) -> Dict[str, Any]:
         """The in-flight round's received results, keyed by stringified

@@ -348,8 +348,15 @@ class FedMLServerManager(FedMLCommManager):
                         "server: clients %s silent for > %d heartbeat "
                         "intervals — declared dead, dropped from round %d",
                         dead, self._hb_miss_threshold, self.args.round_idx)
+                    self._note_peers_dead(dead, "heartbeat")
                     if self.is_initialized:
                         self._maybe_complete_early()
+
+    def _note_peers_dead(self, ranks, cause: str) -> None:
+        """Hook: a fault-domain verdict (heartbeat detector or deadline
+        pacer) dropped ``ranks`` from the round.  The base emits nothing
+        extra; tier subclasses (the hierarchical global server) add
+        per-tier telemetry here.  Caller holds ``_round_lock``."""
 
     def handle_message_heartbeat(self, msg: Message) -> None:
         sent_at = msg.get(MyMessage.MSG_ARG_KEY_HEARTBEAT_TS)
@@ -701,6 +708,8 @@ class FedMLServerManager(FedMLCommManager):
                 _stragglers_dropped.labels(run_id=self._run_label).inc()
                 ledger.event("server", "deadline_drop",
                              round_idx=int(round_idx), client=rank)
+            if stragglers:
+                self._note_peers_dead(stragglers, "deadline")
             logging.warning(
                 "server: round %d deadline — aggregating %d/%d results, "
                 "dropping stragglers %s (quarantined, not stragglers: %s)",

@@ -1,0 +1,459 @@
+"""Geo-distributed aggregation hierarchy: edge → region → global.
+
+Covers the topology derivation (``hier_layout``), runner dispatch, the
+two-plane INPROC federation end-to-end, the per-tier robustness
+composition (regional trimmed-mean quarantining a sign-flip silo; global
+median surviving a WHOLE byzantine region), the cross-tier
+``(region, silo, round)`` fold dedup, WAN delta codecs, the SIGKILLed
+regional aggregator's crash-resume, and the ISSUE acceptance chaos soak
+(3 regions x 5 silos on a wan-lossy WAN with one region partitioned
+mid-round and one regional aggregator hard-killed).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fedml_tpu.core.mlops import ledger, metrics
+
+
+# --------------------------------------------------------------- helpers
+def _launch_hier(args_factory, run_id, *, n, regions, comm_round=2,
+                 adversaries=None, **kw):
+    """Build (but do not start) a hierarchical federation runner.
+    ``adversaries`` maps FLAT silo rank (global silo index + 1) → a
+    chaos_trainer spec, independent of the region layout."""
+    import fedml_tpu
+    from fedml_tpu.core.distributed.communication.chaos import chaos_trainer
+    from fedml_tpu.cross_silo.runner import build_cross_silo_runner
+    from fedml_tpu.ml.trainer.default_trainer import DefaultClientTrainer
+
+    cfg = dict(training_type="cross_silo", backend="INPROC",
+               client_num_in_total=n, client_num_per_round=n,
+               comm_round=comm_round, data_scale=0.3, learning_rate=0.1,
+               frequency_of_the_test=1, run_id=run_id, hier_regions=regions)
+    cfg.update(kw)
+    args = fedml_tpu.init(args_factory(**cfg))
+    dataset = fedml_tpu.data.load(args)
+    bundle = fedml_tpu.model.create(args, dataset[-1])
+    trainer = None
+    if adversaries:
+        adv = dict(adversaries)
+
+        def trainer(rank):
+            t = DefaultClientTrainer(bundle, args)
+            return chaos_trainer(t, adv[rank]) if rank in adv else t
+
+    runner = build_cross_silo_runner(args, None, dataset, bundle,
+                                     client_trainer=trainer)
+    return args, runner
+
+
+def _chaos_of(com_manager):
+    """Walk a ReliableCommManager/ChaosCommManager ``.inner`` chain down
+    to the chaos layer — the partition lever."""
+    from fedml_tpu.core.distributed.communication.chaos import (
+        ChaosCommManager,
+    )
+
+    m = com_manager
+    while m is not None and not isinstance(m, ChaosCommManager):
+        m = getattr(m, "inner", None)
+    assert m is not None, "no ChaosCommManager in the chain"
+    return m
+
+
+def _register_hier_wan_backend(name, drop_p=0.0, dup_p=0.0,
+                               base_latency_s=0.0):
+    """A chaos WAN plane for the hierarchy: every WAN node (global rank 0
+    and the region uplinks) sends through a ChaosCommManager over the
+    base-run_id INPROC channel.  FINISH is protected — termination fate
+    belongs to the reliability layer under test, not the link."""
+    from fedml_tpu.core.distributed.communication.chaos import (
+        ChaosCommManager,
+    )
+    from fedml_tpu.core.distributed.communication.inprocess import (
+        InProcCommManager,
+    )
+    from fedml_tpu.core.distributed.fedml_comm_manager import (
+        register_comm_backend,
+    )
+    from fedml_tpu.cross_silo.hierarchical.message_define import HierMessage
+
+    def factory(args, rank=0, size=0):
+        inner = InProcCommManager(rank, size, str(args.run_id))
+        return ChaosCommManager(
+            inner, drop_p=drop_p, dup_p=dup_p,
+            base_latency_s=base_latency_s, seed=300 + rank,
+            protect_types={HierMessage.MSG_TYPE_G2R_FINISH})
+
+    register_comm_backend(name, factory)
+
+
+def _counter(name, **labels):
+    return metrics.REGISTRY.collect()[name].labels(**labels).value
+
+
+# ------------------------------------------------- layout and dispatch
+def test_hier_layout_and_dispatch(args_factory):
+    from fedml_tpu.cross_silo.hierarchical.runner import (
+        HierarchicalFederationRunner,
+        hier_layout,
+    )
+    from fedml_tpu.cross_silo.runner import build_cross_silo_runner
+
+    # contiguous slices, remainder spread over the FIRST regions
+    layout = hier_layout(args_factory(client_num_in_total=7, hier_regions=3))
+    assert [name for name, _ in layout] == ["r0", "r1", "r2"]
+    assert [silos for _, silos in layout] == [[0, 1, 2], [3, 4], [5, 6]]
+    named = hier_layout(args_factory(client_num_in_total=4, hier_regions=2,
+                                     hier_region_names=["eu", "us"]))
+    assert [name for name, _ in named] == ["eu", "us"]
+    assert [silos for _, silos in named] == [[0, 1], [2, 3]]
+    with pytest.raises(ValueError):
+        hier_layout(args_factory(hier_regions=1))
+    with pytest.raises(ValueError):
+        hier_layout(args_factory(client_num_in_total=2, hier_regions=3))
+    with pytest.raises(ValueError):
+        hier_layout(args_factory(client_num_in_total=4, hier_regions=2,
+                                 hier_region_names=["only_one"]))
+
+    # hier_regions >= 2 dispatches to the hierarchy (INPROC only)
+    runner = build_cross_silo_runner(
+        args_factory(training_type="cross_silo", client_num_in_total=4,
+                     hier_regions=2, backend="INPROC"),
+        None, (None,) * 4, None)
+    assert isinstance(runner, HierarchicalFederationRunner)
+    assert runner.n_regions == 2
+    with pytest.raises(NotImplementedError):
+        build_cross_silo_runner(
+            args_factory(training_type="cross_silo", client_num_in_total=4,
+                         hier_regions=2, backend="GRPC"),
+            None, (None,) * 4, None)
+
+
+# ------------------------------------------- cross-tier fold dedup unit
+def test_global_fold_dedup_keeps_first_and_audits_triples(args_factory):
+    """The global ingest path's dedup domain: keep-first on
+    ``(region, fold_round)``, PLUS the ``(region, silo, round)`` triple
+    audit — a re-computed fold (post-crash regional re-fold under a NEW
+    fold_round) overlapping ANY already-counted silo upload is rejected
+    whole, so a silo upload is never double-counted into the global
+    model."""
+    import fedml_tpu
+    from fedml_tpu.core.distributed.communication.message import Message
+    from fedml_tpu.cross_silo.hierarchical.global_server_manager import (
+        GlobalServerManager,
+    )
+    from fedml_tpu.cross_silo.hierarchical.message_define import HierMessage
+    from fedml_tpu.cross_silo.message_define import MyMessage
+    from fedml_tpu.cross_silo.server.fedml_aggregator import FedMLAggregator
+    from fedml_tpu.ml.trainer.default_trainer import DefaultServerAggregator
+
+    import jax
+
+    args = fedml_tpu.init(args_factory(
+        training_type="cross_silo", client_num_in_total=3,
+        client_num_per_round=3, min_aggregation_clients=3,
+        run_id="hier_dedup_unit"))
+    dataset = fedml_tpu.data.load(args)
+    bundle = fedml_tpu.model.create(args, dataset[-1])
+    impl = DefaultServerAggregator(bundle, args)
+    impl.set_model_params(bundle.init_variables(jax.random.PRNGKey(0)))
+    agg = FedMLAggregator(args, impl, dataset[3])
+    gm = GlobalServerManager(args, agg, rank=0, client_num=3,
+                             backend="INPROC")
+    gm.is_initialized = True
+    model = impl.get_model_params()
+
+    def fold(sender, fold_round, pairs):
+        msg = Message(HierMessage.MSG_TYPE_R2G_REGION_FOLD, sender, 0)
+        msg.add_params(HierMessage.MSG_ARG_KEY_REGION, f"r{sender}")
+        msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, model)
+        msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, int(fold_round))
+        msg.add_params(HierMessage.MSG_ARG_KEY_N_SILOS, len(pairs))
+        msg.add_params(HierMessage.MSG_ARG_KEY_SILO_ROUNDS,
+                       [[int(r), int(t)] for r, t in pairs])
+        msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 8.0)
+        return msg
+
+    dup0 = _counter("fedml_region_folds_total", run_id="hier_dedup_unit",
+                    outcome="duplicate")
+    # first fold from region 1 folds
+    gm.handle_message_region_fold(fold(1, 0, [(1, 0), (2, 0)]))
+    assert agg.receive_count() == 1
+    # exact retransmit: keep-first on (region, fold_round)
+    gm.handle_message_region_fold(fold(1, 0, [(1, 0), (2, 0)]))
+    assert agg.receive_count() == 1
+    # re-computed fold under a NEW fold_round but overlapping the
+    # already-counted (region 1, silo 1, round 0) triple: rejected whole
+    args.round_idx = 1
+    gm.handle_message_region_fold(fold(1, 1, [(1, 0), (2, 1)]))
+    assert agg.receive_count() == 1
+    assert _counter("fedml_region_folds_total", run_id="hier_dedup_unit",
+                    outcome="duplicate") == dup0 + 2
+    args.round_idx = 0
+    # the same silo rounds from a DIFFERENT region are a different domain
+    gm.handle_message_region_fold(fold(2, 0, [(1, 0), (2, 0)]))
+    assert agg.receive_count() == 2
+    # a fold claiming a FUTURE segment is dropped outright
+    gm.handle_message_region_fold(fold(3, 7, [(1, 7)]))
+    assert agg.receive_count() == 2
+    # past the staleness cutoff: expired, never folded
+    exp0 = _counter("fedml_region_folds_total", run_id="hier_dedup_unit",
+                    outcome="expired")
+    args.round_idx = gm._staleness_cutoff + 5
+    gm.handle_message_region_fold(
+        fold(3, 1, [(1, 1)]))
+    assert agg.receive_count() == 2
+    assert _counter("fedml_region_folds_total", run_id="hier_dedup_unit",
+                    outcome="expired") == exp0 + 1
+    gm.finish()
+
+
+# ------------------------------------------------ end-to-end federation
+def test_hier_two_tier_federation_converges(args_factory, tmp_path):
+    """2 regions x 2 silos: every global round closes on one pre-reduced
+    fold per region, the WAN byte plane (base run_id) is separate from
+    the LAN planes, and the ledger's per-tier round anatomy renders the
+    region tree."""
+    from fedml_tpu.cli.cli import cli
+    from click.testing import CliRunner
+
+    args, runner = _launch_hier(
+        args_factory, "hier_basic", n=4, regions=2, comm_round=2,
+        run_ledger=True, log_file_dir=str(tmp_path))
+    m = runner.train()
+    assert np.isfinite(m["test_loss"])
+    hist = runner.global_manager.aggregator.metrics_history
+    assert len(hist) == 2
+    assert all(np.isfinite(r["test_loss"]) for r in hist)
+
+    # exactly one fold per region per round, none duplicate-counted
+    assert _counter("fedml_region_folds_total", run_id="hier_basic",
+                    outcome="folded") == 2 * 2
+    assert _counter("fedml_region_folds_total", run_id="hier_basic",
+                    outcome="duplicate") == 0
+    assert runner.global_manager.aggregator.duplicate_uploads == 0
+
+    # WAN accounting: the base run_id carries ONLY the WAN plane — one
+    # fold per region per round up, one segment per region per round
+    # down — while silo traffic lands on the per-region LAN run_ids
+    wan_up = _counter("fedml_wan_bytes_total", run_id="hier_basic",
+                      direction="up")
+    wan_down = _counter("fedml_wan_bytes_total", run_id="hier_basic",
+                        direction="down")
+    assert wan_up > 0 and wan_down > 0
+    lan_up = sum(
+        _counter("fedml_wire_bytes_total", run_id=f"hier_basic/lan-r{i}",
+                 direction="up", codec="raw")
+        for i in range(2))
+    assert lan_up > 0
+    # the hierarchy's reason to exist: 2 pre-reduced folds cross the WAN
+    # per round where 4 silo uploads crossed the LAN
+    assert wan_up < lan_up
+
+    # per-tier round anatomy: the regions sub-tree, and the timeline line
+    anatomy = ledger.load_anatomy(str(tmp_path))
+    r0 = anatomy["rounds"][0]
+    assert set(r0["regions"]) == {"r0", "r1"}
+    for g in r0["regions"].values():
+        assert g["n_silos"] == 2
+        assert g["expected"] == 2
+        assert g["outcome"] == "folded"
+        assert g["nbytes"] > 0
+    res = CliRunner().invoke(
+        cli, ["rounds", "timeline", "--log-dir", str(tmp_path),
+              "--round", "0"])
+    assert res.exit_code == 0, res.output
+    assert "region r0: 2/2 silos" in res.output
+    assert "WAN delta" in res.output
+
+    # the per-tier SLO indicators evaluate from the same artifacts
+    res = CliRunner().invoke(
+        cli, ["slo", "check", "--rules", "examples/slo_hierarchy.yaml",
+              "--log-dir", str(tmp_path)])
+    assert res.exit_code == 0, res.output
+
+
+def test_hier_wan_codec_folds_as_delta(args_factory):
+    """--hier-wan-compression int8: segments broadcast encoded, folds
+    ship as int8 deltas against the decoded segment reference, and the
+    run still converges."""
+    args, runner = _launch_hier(
+        args_factory, "hier_codec", n=4, regions=2, comm_round=2,
+        hier_wan_compression="int8")
+    m = runner.train()
+    assert np.isfinite(m["test_loss"])
+    assert len(runner.global_manager.aggregator.metrics_history) == 2
+    assert _counter("fedml_region_folds_total", run_id="hier_codec",
+                    outcome="folded") == 2 * 2
+    # WAN wire bytes on the base run_id carry the codec label both ways
+    up = _counter("fedml_wire_bytes_total", run_id="hier_codec",
+                  direction="up", codec="int8")
+    down = _counter("fedml_wire_bytes_total", run_id="hier_codec",
+                    direction="down", codec="int8")
+    assert up > 0 and down > 0
+    # int8 fold deltas are materially smaller than the raw folds the
+    # uncompressed run ships (codec test reuses the raw run's geometry)
+    raw_up = _counter("fedml_wan_bytes_total", run_id="hier_basic",
+                      direction="up")
+    if raw_up > 0:
+        assert up < raw_up
+
+
+# ------------------------------------- per-tier robustness composition
+@pytest.mark.slow
+def test_regional_trimmed_mean_quarantines_sign_flip_silo(args_factory):
+    """Region tier: with 3 silos per region and trimmed_mean:0.34 (one
+    trim per side), a sign-flipping silo is trimmed INSIDE its region —
+    the fold that crosses the WAN is already clean, and the run lands
+    within 10% of the clean hierarchical baseline."""
+    _, clean = _launch_hier(args_factory, "hier_tm_clean", n=6, regions=2,
+                            comm_round=4)
+    clean_loss = clean.train()["test_loss"]
+    assert np.isfinite(clean_loss)
+
+    _, runner = _launch_hier(
+        args_factory, "hier_tm_adv", n=6, regions=2, comm_round=4,
+        adversaries={1: "sign_flip"},
+        hier_region_robust_agg="trimmed_mean:0.34")
+    loss = runner.train()["test_loss"]
+    hist = runner.global_manager.aggregator.metrics_history
+    assert len(hist) == 4
+    assert all(np.isfinite(r["test_loss"]) for r in hist)
+    assert loss <= 1.1 * clean_loss, (loss, clean_loss)
+
+
+@pytest.mark.slow
+def test_global_median_survives_whole_byzantine_region(args_factory):
+    """Global tier: when EVERY silo of one region sign-flips, the
+    regional robust op cannot help (the fold itself is poisoned) — but
+    the poisoned region is one outlier among 3 at the global median, and
+    the run stays within 10% of the clean hierarchical baseline."""
+    _, clean = _launch_hier(args_factory, "hier_md_clean", n=6, regions=3,
+                            comm_round=4)
+    clean_loss = clean.train()["test_loss"]
+    assert np.isfinite(clean_loss)
+
+    # region r0 = flat silos 1 and 2 — the whole region is byzantine
+    _, runner = _launch_hier(
+        args_factory, "hier_md_adv", n=6, regions=3, comm_round=4,
+        adversaries={1: "sign_flip", 2: "sign_flip"},
+        hier_global_robust_agg="median")
+    loss = runner.train()["test_loss"]
+    hist = runner.global_manager.aggregator.metrics_history
+    assert len(hist) == 4
+    assert all(np.isfinite(r["test_loss"]) for r in hist)
+    assert loss <= 1.1 * clean_loss, (loss, clean_loss)
+
+
+# ----------------------------------------------- region fault domains
+@pytest.mark.slow
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_regional_aggregator_crash_resume_no_double_count(args_factory,
+                                                          tmp_path):
+    """A SIGKILLed regional aggregator (hard_kill: no goodbye, timers and
+    heartbeats die) resumes from its round-boundary checkpoint: its silos
+    kept running, the restarted manager re-solicits only what is missing,
+    the global round closes normally, and NO silo upload is ever counted
+    twice."""
+    args, runner = _launch_hier(
+        args_factory, "hier_crash", n=4, regions=2, comm_round=3,
+        adversaries={3: "slow:1.5"},  # r1's first silo delays r1's fold
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        heartbeat_interval_s=0.2, heartbeat_miss_threshold=5)
+    runner.launch()
+    gm = runner.global_manager
+    # wait for r0's round-0 fold — r1 is still mid-segment behind its
+    # slow silo when the crash lands
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and gm.aggregator.receive_count() < 1:
+        time.sleep(0.05)
+    assert gm.aggregator.receive_count() >= 1, "r0 never folded"
+
+    runner.regions["r1"].hard_kill()
+    runner.restart_region("r1")
+
+    m = runner.wait(timeout=120)
+    assert not runner._global_thread.is_alive(), "global run did not finish"
+    hist = gm.aggregator.metrics_history
+    assert len(hist) == 3, f"lost rounds: {len(hist)}/3"
+    assert all(np.isfinite(r["test_loss"]) for r in hist)
+    assert np.isfinite(m["test_loss"])
+    # the crash-resumed region never double-counted: at most one counted
+    # fold per (region, round), and no fold reached the aggregator twice
+    assert gm.aggregator.duplicate_uploads == 0
+    assert _counter("fedml_region_folds_total", run_id="hier_crash",
+                    outcome="folded") <= 2 * 3
+
+
+@pytest.mark.slow
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_hier_chaos_soak_partition_and_crash(args_factory, tmp_path):
+    """ISSUE acceptance soak: 3 regions x 5 silos over a wan-lossy WAN
+    plane (drop + dup + latency, reliable retransmits on).  Mid-run one
+    region is PARTITIONED (its uplink drops everything; the global
+    failure detector declares it dead and rounds close on the
+    --min-regions quorum), one regional aggregator is hard-killed and
+    restarted from its checkpoint, and the partition heals (rejoin +
+    frontier catch-up).  The run converges with zero lost rounds and
+    zero duplicate-counted uploads."""
+    _register_hier_wan_backend("HIER_WAN_LOSSY", drop_p=0.03, dup_p=0.01,
+                               base_latency_s=0.02)
+    ROUNDS = 4
+    args, runner = _launch_hier(
+        args_factory, "hier_soak", n=15, regions=3, comm_round=ROUNDS,
+        data_scale=0.2,
+        hier_wan_backend="HIER_WAN_LOSSY", hier_wan_reliable=True,
+        reliable_retx_initial_s=0.1, reliable_retx_max_s=1.0,
+        min_regions=2, hier_round_deadline_s=8.0,
+        round_deadline_grace_s=1.0,
+        heartbeat_interval_s=0.25, heartbeat_miss_threshold=4,
+        checkpoint_dir=str(tmp_path / "ckpt"))
+    runner.launch()
+    gm = runner.global_manager
+
+    # let round 0 complete so every region is known-good first
+    deadline = time.monotonic() + 90
+    while (time.monotonic() < deadline
+           and len(gm.aggregator.metrics_history) < 1):
+        time.sleep(0.1)
+    assert gm.aggregator.metrics_history, "round 0 never closed"
+
+    # partition r2: its uplink's chaos layer drops EVERYTHING (folds,
+    # heartbeats, retransmits) — the global detector must declare the
+    # region dead and close rounds on the 2-of-3 quorum
+    chaos = _chaos_of(runner.regions["r2"].uplink.com_manager)
+    chaos.drop_p, chaos.dup_p = 1.0, 0.0
+    time.sleep(2.5)  # > miss_threshold * interval: verdict lands
+
+    # crash r1's regional aggregator and restart it from its checkpoint
+    runner.regions["r1"].hard_kill()
+    runner.restart_region("r1")
+
+    # heal the partition: r2 heartbeats again → rejoin + catch-up
+    chaos.drop_p = 0.03
+
+    m = runner.wait(timeout=240)
+    assert not runner._global_thread.is_alive(), "global run did not finish"
+    hist = gm.aggregator.metrics_history
+    assert len(hist) == ROUNDS, f"lost rounds: {len(hist)}/{ROUNDS}"
+    assert all(np.isfinite(r["test_loss"]) for r in hist)
+    assert np.isfinite(m["test_loss"])
+    # zero duplicate-counted uploads: the lossy/duplicating WAN plus the
+    # crash-resumed region produced retransmits and possibly re-computed
+    # folds, but none reached the aggregator twice
+    assert gm.aggregator.duplicate_uploads == 0
+    assert _counter("fedml_region_folds_total", run_id="hier_soak",
+                    outcome="folded") <= 3 * ROUNDS
+    # the partitioned region was dropped by a fault-domain verdict
+    dropped = sum(
+        _counter("fedml_region_dropouts_total", run_id="hier_soak",
+                 cause=cause)
+        for cause in ("heartbeat", "deadline"))
+    assert dropped >= 1, "the partitioned region was never dropped"

@@ -900,6 +900,132 @@ def test_graph_orphans_mirror_proto002_conservatism(tmp_path):
     assert g["orphan_sends"] == []  # matches the withheld PROTO002 verdict
 
 
+# -- two-tier (hierarchical) message shape ------------------------------------
+#
+# The geo-distributed hierarchy's protocol tree: silo → region fold →
+# WAN flush → global, FINISH flowing back down global → region → silo.
+# The regional flush (send_fold) is only reachable through the
+# ``set_fold_sink(self.send_fold)`` reference in ``__init__`` — exactly
+# the shape the real RegionUplink uses.
+
+HIER_DEFINE = """\
+    class HierMsg:
+        MSG_TYPE_G2R_SYNC = "G2R_SYNC"
+        MSG_TYPE_G2R_FINISH = "G2R_FINISH"
+        MSG_TYPE_R2G_FOLD = "R2G_FOLD"
+        MSG_TYPE_S2C_SYNC = "S2C_SYNC"
+        MSG_TYPE_S2C_FINISH = "S2C_FINISH"
+        MSG_TYPE_C2S_UPLOAD = "C2S_UPLOAD"
+"""
+
+HIER_GLOBAL = """\
+    from .base import BaseCommManager, Message
+    from .hier_define import HierMsg
+
+    class GlobalServer(BaseCommManager):
+        def register_message_receive_handlers(self):
+            self.register_message_receive_handler(
+                HierMsg.MSG_TYPE_R2G_FOLD, self.on_fold)
+
+        def run(self):
+            self.register_message_receive_handlers()
+            self.send_message(Message(HierMsg.MSG_TYPE_G2R_SYNC, 0, 1))
+
+        def on_fold(self, msg):
+            self.send_message(Message(HierMsg.MSG_TYPE_G2R_FINISH, 0, 1))
+            self.finish()
+"""
+
+HIER_REGION = """\
+    from .base import BaseCommManager, Message
+    from .hier_define import HierMsg
+
+    class RegionNode(BaseCommManager):
+        def __init__(self):
+            super().__init__()
+            self.set_fold_sink(self.send_fold)
+
+        def set_fold_sink(self, sink):
+            self._sink = sink
+
+        def register_message_receive_handlers(self):
+            self.register_message_receive_handler(
+                HierMsg.MSG_TYPE_G2R_SYNC, self.on_sync)
+            self.register_message_receive_handler(
+                HierMsg.MSG_TYPE_C2S_UPLOAD, self.on_upload)
+            self.register_message_receive_handler(
+                HierMsg.MSG_TYPE_G2R_FINISH, self.on_finish)
+
+        def run(self):
+            self.register_message_receive_handlers()
+
+        def on_sync(self, msg):
+            self.send_message(Message(HierMsg.MSG_TYPE_S2C_SYNC, 0, 1))
+
+        def on_upload(self, msg):
+            self._sink(0)
+
+        def send_fold(self, segment):
+            self.send_message(Message(HierMsg.MSG_TYPE_R2G_FOLD, 1, 0))
+
+        def on_finish(self, msg):
+            self.send_message(Message(HierMsg.MSG_TYPE_S2C_FINISH, 0, 1))
+            self.finish()
+"""
+
+HIER_SILO = """\
+    from .base import BaseCommManager, Message
+    from .hier_define import HierMsg
+
+    class SiloClient(BaseCommManager):
+        def register_message_receive_handlers(self):
+            self.register_message_receive_handler(
+                HierMsg.MSG_TYPE_S2C_SYNC, self.on_sync)
+            self.register_message_receive_handler(
+                HierMsg.MSG_TYPE_S2C_FINISH, self.on_finish)
+
+        def run(self):
+            self.register_message_receive_handlers()
+
+        def on_sync(self, msg):
+            self.send_message(Message(HierMsg.MSG_TYPE_C2S_UPLOAD, 1, 0))
+
+        def on_finish(self, msg):
+            self.finish()
+"""
+
+
+def _write_hier(tmp_path, region=HIER_REGION):
+    _write(tmp_path, "fedml_tpu/proto/__init__.py", "")
+    _write(tmp_path, "fedml_tpu/proto/base.py", BASE_GUARDED)
+    _write(tmp_path, "fedml_tpu/proto/hier_define.py", HIER_DEFINE)
+    _write(tmp_path, "fedml_tpu/proto/hier_global.py", HIER_GLOBAL)
+    _write(tmp_path, "fedml_tpu/proto/hier_region.py", region)
+    _write(tmp_path, "fedml_tpu/proto/hier_silo.py", HIER_SILO)
+
+
+def test_two_tier_fold_chain_is_live_and_orphan_free(tmp_path):
+    # the clean tree reaches FINISH on every tier: G2R_SYNC → S2C_SYNC →
+    # C2S_UPLOAD → regional fold → R2G_FOLD over the WAN → G2R_FINISH →
+    # S2C_FINISH; every type sent has a handler and vice versa
+    _write_hier(tmp_path)
+    assert _lint(tmp_path, ["PROTO002", "FLOW001", "RES001"]) == []
+
+
+def test_two_tier_unreachable_regional_flush_stalls_rounds(tmp_path):
+    # sever the sink hookup: send_fold still exists textually (so no
+    # PROTO002 orphan) but is unreachable from any init handshake — the
+    # WAN fold can never flush and the terminal waits on both lower
+    # tiers are dead
+    region = HIER_REGION.replace(
+        "self.set_fold_sink(self.send_fold)", "pass")
+    _write_hier(tmp_path, region=region)
+    found = _lint(tmp_path, ["FLOW001"])
+    msgs = " | ".join(f.message for f in found)
+    assert "rounds can never finish" in msgs
+    assert _lint(tmp_path, ["PROTO002"]) == []
+
+
 def test_graph_cli_modes(tmp_path):
     _write_protocol(tmp_path)
     lines = []
