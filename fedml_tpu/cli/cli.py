@@ -393,10 +393,18 @@ def job_logs(run_id: str, tail: int) -> None:
 
 def _job_brief(row: dict) -> dict:
     """The list/status projection of a queue row (drop bulky fields)."""
-    return {k: row[k] for k in
-            ("job_id", "name", "tenant", "kind", "priority", "n_slots",
-             "state", "resume", "preempt_count", "run_id", "returncode",
-             "submitted_ts", "dispatched_ts", "finished_ts", "log_dir")}
+    brief = {k: row[k] for k in
+             ("job_id", "name", "tenant", "kind", "priority", "n_slots",
+              "state", "resume", "preempt_count", "run_id", "returncode",
+              "submitted_ts", "dispatched_ts", "finished_ts", "log_dir")}
+    if row.get("elastic"):
+        brief["elastic"] = {"min_slots": row["min_slots"],
+                            "max_slots": row["max_slots"]}
+    if int(row.get("resize_requested") or 0):
+        brief["resize_requested"] = row["resize_requested"]
+    if row.get("last_resize"):
+        brief["last_resize"] = row["last_resize"]
+    return brief
 
 
 @cli.group()
@@ -482,6 +490,30 @@ def jobs_preempt(job_id: str, pod_dir: str) -> None:
         raise SystemExit(1)
 
 
+@jobs.command("resize")
+@click.argument("job_id")
+@click.argument("slots", type=int)
+@click.option("--pod-dir", default=None)
+def jobs_resize(job_id: str, slots: int, pod_dir: str) -> None:
+    """Resize a job's gang.  QUEUED jobs resize immediately; a RUNNING
+    job must be elastic (job.yaml ``elastic: {min_slots, max_slots}``) —
+    the scheduler then re-meshes it IN PLACE at its next round boundary,
+    falling back to preempt/resume if the re-mesh fails.  The target is
+    clamped to the declared elastic range."""
+    from ..scheduler.pod import JobQueue
+
+    queue = JobQueue(pod_dir)
+    try:
+        target = queue.request_resize(job_id, slots)
+    finally:
+        queue.close()
+    click.echo(json.dumps({"job_id": job_id,
+                           "resize_requested": target is not None,
+                           "target_slots": target}))
+    if target is None:
+        raise SystemExit(1)
+
+
 @jobs.command("cancel")
 @click.argument("job_id")
 @click.option("--pod-dir", default=None)
@@ -507,13 +539,17 @@ def jobs_cancel(job_id: str, pod_dir: str) -> None:
 @click.option("--drain-grace-s", default=60.0, type=float,
               help="seconds a PREEMPTING job may keep running before a "
                    "hard kill (still requeued with resume)")
+@click.option("--resize-grace-s", default=60.0, type=float,
+              help="seconds an announced resize may wait for the "
+                   "workload's ack before falling back to preempt")
 @click.option("--tenant-weight", "tenant_weights", multiple=True,
               metavar="TENANT=W",
               help="fair-share weight override (repeatable)")
 @click.option("--once", is_flag=True,
               help="run a single scheduling pass and exit (cron mode)")
 def jobs_pod(pod_dir: str, slots: int, tick_s: float,
-             drain_grace_s: float, tenant_weights, once: bool) -> None:
+             drain_grace_s: float, resize_grace_s: float,
+             tenant_weights, once: bool) -> None:
     """Run the pod scheduler daemon: gang dispatch over the shared
     resource db with weighted fair-share, priority eviction and
     round-boundary preemption."""
@@ -533,6 +569,7 @@ def jobs_pod(pod_dir: str, slots: int, tick_s: float,
     resources.reclaim_stale()  # free slots orphaned by a dead daemon
     sched = PodScheduler(queue, resources, tenant_weights=weights or None,
                          tick_s=tick_s, drain_grace_s=drain_grace_s,
+                         resize_grace_s=resize_grace_s,
                          serving_scaler=ServingReplicaScaler(queue))
     if once:
         click.echo(json.dumps(sched.step()))

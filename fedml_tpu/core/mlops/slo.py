@@ -419,6 +419,26 @@ def _ind_region_dropout_rate(ctx: SLOContext,
     return drops / rounds
 
 
+def _ind_resize_downtime_p95(ctx: SLOContext,
+                             rule: SLORule) -> Optional[float]:
+    """p95 of the in-place elastic-resize pause (announce latched →
+    re-meshed and acked), over every resize in the window.  Fallback-
+    preempted resizes carry no downtime sample — the preempt/resume
+    cost is round_time_p95's to judge."""
+    q = float(rule.params.get("quantile", 0.95))
+    v = ctx.quantile("fedml_resize_downtime_seconds", q)
+    if v is not None:
+        return v
+    pauses = sorted(
+        float((r.get("attrs") or {}).get("downtime_s") or 0.0)
+        for r in (ctx.ledger_records or [])
+        if r.get("event") == "resize"
+        and (r.get("attrs") or {}).get("outcome") == "ok")
+    if not pauses:
+        return None
+    return pauses[min(len(pauses) - 1, int(q * len(pauses)))]
+
+
 INDICATORS = {
     "round_time_p95": _ind_round_time_p95,
     "quarantine_rate": _ind_quarantine_rate,
@@ -432,6 +452,7 @@ INDICATORS = {
     "region_fold_p95": _ind_region_fold_p95,
     "wan_bytes_per_round": _ind_wan_bytes_per_round,
     "region_dropout_rate": _ind_region_dropout_rate,
+    "resize_downtime_p95": _ind_resize_downtime_p95,
 }
 
 

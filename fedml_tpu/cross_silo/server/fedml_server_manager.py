@@ -203,6 +203,22 @@ class FedMLServerManager(FedMLCommManager):
                           lambda *_: self._drain_event.set())
         except ValueError:
             pass  # not the main thread (in-process jobs poll the file)
+        # elastic resize (pod scheduler contract, beside the drain file):
+        # FEDML_TPU_RESIZE_FILE announces a new gang size; the next round
+        # boundary checkpoints, re-meshes IN PLACE and acks — no requeue
+        # round-trip.  A failed re-mesh degrades to the drain path above,
+        # so a resize can never end worse than a preemption.
+        self._resize_file = (os.environ.get("FEDML_TPU_RESIZE_FILE")
+                             or getattr(args, "resize_file", None))
+        self._resize_acked: Optional[Dict] = None
+        #: monotonic deadline before which heartbeat/deadline verdicts
+        #: are suppressed — the announced-resize pause must not read as
+        #: client death (the ``_finishing``-guard idiom)
+        self._resize_guard_until = 0.0
+        slots_env = os.environ.get("FEDML_TPU_SLOTS", "")
+        self._mesh_slots: Optional[int] = (
+            len([s for s in slots_env.split(",") if s != ""])
+            or None)
 
     def run(self) -> None:
         self._start_hb_monitor()
@@ -332,6 +348,10 @@ class FedMLServerManager(FedMLCommManager):
         deadline = self._hb_miss_threshold * self._hb_interval
         while not self._hb_stop.wait(self._hb_interval):
             now = time.monotonic()
+            if now < self._resize_guard_until:
+                # announced re-mesh in progress: the pause is the
+                # server's, so no liveness verdicts until it lifts
+                continue
             with self._round_lock:
                 dead = [rank for rank, last in self._last_seen.items()
                         if rank in self._hb_peers
@@ -676,6 +696,12 @@ class FedMLServerManager(FedMLCommManager):
         with self._round_lock:
             if self.args.round_idx != round_idx or self._finishing:
                 return  # round already completed normally
+            if time.monotonic() < self._resize_guard_until:
+                # announced re-mesh in progress: the stall is the
+                # server's, not the clients' — re-arm instead of
+                # dropping anyone as a straggler
+                self._arm_deadline_timer(self.deadline_grace_s)
+                return
             got = self.aggregator.receive_count()
             ranks = set(self._ranks_for(self.client_id_list_in_this_round))
             # quarantined ranks DID report on time — their uploads were
@@ -853,6 +879,106 @@ class FedMLServerManager(FedMLCommManager):
             return True
         return False
 
+    # -- elastic resize (round-boundary re-mesh) -----------------------------
+    def _resize_requested(self) -> Optional[int]:
+        """The announced new gang size, or None.  Latches per announce:
+        a request this server already acked is ignored until the
+        scheduler clears the file (fast rounds can complete before the
+        next scheduler tick collects the ack)."""
+        if not self._resize_file:
+            return None
+        from ...scheduler.pod.runners import read_resize
+
+        req = read_resize(self._resize_file)
+        if req is None or req == self._resize_acked:
+            return None
+        return int(req["slots"])
+
+    def _perform_resize(self, target: int) -> bool:
+        """Re-mesh in place at the round boundary: the boundary
+        checkpoint is already queued (`_persist_round_state` ran first),
+        so re-building device state at the new slot count and restoring
+        onto it loses nothing.  The aggregator owns its device layout —
+        it re-meshes through its ``remesh(n_slots)`` hook when it has
+        one; a host-funnel aggregator (the CPU-proxy data-parallel case)
+        has no device mesh to rebuild and resizes for free.  Returns
+        False when the re-mesh failed — the caller degrades to the
+        preempt ladder.  Caller holds ``_round_lock``."""
+        from ...scheduler.pod.runners import ack_resize, read_resize
+
+        t0 = time.monotonic()
+        prev = self._mesh_slots
+        hb_deadline = self._hb_miss_threshold * self._hb_interval
+        self._resize_guard_until = t0 + max(30.0, 2 * hb_deadline)
+        try:
+            remesh = getattr(self.aggregator, "remesh", None)
+            if callable(remesh):
+                remesh(int(target))
+            self._mesh_slots = int(target)
+            now = time.monotonic()
+            downtime = now - t0
+            # the pause is ours, not the clients': refresh every liveness
+            # stamp so the detector never bills it to them
+            for rank in list(self._last_seen):
+                self._last_seen[rank] = now
+            self._resize_guard_until = now
+            self._resize_acked = read_resize(self._resize_file)
+            ack_resize(self._resize_file, "ok", int(target),
+                       downtime_s=round(downtime, 6),
+                       round=int(self.args.round_idx))
+            ledger.event("server", "resize",
+                         round_idx=int(self.args.round_idx),
+                         outcome="ok", downtime_s=round(downtime, 6),
+                         **{"from": prev, "to": int(target)})
+            logging.info(
+                "server: re-meshed %s -> %d slots in place at round "
+                "boundary %d (%.3fs pause)", prev, target,
+                self.args.round_idx, downtime)
+            return True
+        except Exception:  # noqa: BLE001 — a failed re-mesh must degrade
+            # to the preempt ladder, never take the run down mid-round
+            logging.exception(
+                "server: in-place resize to %d slots failed — falling "
+                "back to preempt", target)
+            self._resize_guard_until = 0.0
+            try:
+                ack_resize(self._resize_file, "failed", int(target),
+                           round=int(self.args.round_idx))
+            except OSError:
+                pass
+            ledger.event("server", "resize",
+                         round_idx=int(self.args.round_idx),
+                         outcome="failed", downtime_s=None,
+                         **{"from": prev, "to": int(target)})
+            return False
+
+    def _preempt_at_boundary(self) -> None:
+        """Preempted at this boundary: the round_idx checkpoint is
+        queued on the writer and finish() drains it before exit, so the
+        requeued dispatch resumes exactly here — no lost round, and the
+        aggregator's received set is empty (no upload can be
+        double-counted).  Clients get FINISH so the process tree winds
+        down cleanly; resume re-launches the full cohort.  Callers hold
+        ``_round_lock``; re-taking the RLock keeps the span handoff
+        guarded even so."""
+        logging.info("################ DRAIN at round boundary %d — "
+                     "preempting (checkpoint saved)",
+                     self.args.round_idx)
+        self.args.preempted_at_round = int(self.args.round_idx)
+        _preempted_round.labels(run_id=self._run_label).set(
+            int(self.args.round_idx))
+        ledger.event("server", "preempt",
+                     round_idx=int(self.args.round_idx))
+        self.send_finish_to_all()
+        mlops.log_aggregation_status("PREEMPTED")
+        with self._round_lock:
+            if self._run_span is not None:
+                self._run_span.set_attr(
+                    "preempted_at_round", int(self.args.round_idx))
+                self._run_span.end()
+                self._run_span = None
+        self.finish()
+
     def _complete_round(self) -> None:
         """Aggregate (possibly a partial set), test, advance or finish.
         Caller must hold ``_round_lock``."""
@@ -911,28 +1037,14 @@ class FedMLServerManager(FedMLCommManager):
                 self.finish()
                 return
             if self._drain_requested():
-                # preempted at this boundary: the round_idx checkpoint is
-                # queued on the writer and finish() drains it before exit, so
-                # the requeued dispatch resumes exactly here — no lost round,
-                # and the aggregator's received set is empty (no upload can
-                # be double-counted).  Clients get FINISH so the process tree
-                # winds down cleanly; resume re-launches the full cohort.
-                logging.info("################ DRAIN at round boundary %d — "
-                             "preempting (checkpoint saved)",
-                             self.args.round_idx)
-                self.args.preempted_at_round = int(self.args.round_idx)
-                _preempted_round.labels(run_id=self._run_label).set(
-                    int(self.args.round_idx))
-                ledger.event("server", "preempt",
-                             round_idx=int(self.args.round_idx))
-                self.send_finish_to_all()
-                mlops.log_aggregation_status("PREEMPTED")
-                if self._run_span is not None:
-                    self._run_span.set_attr(
-                        "preempted_at_round", int(self.args.round_idx))
-                    self._run_span.end()
-                    self._run_span = None
-                self.finish()
+                self._preempt_at_boundary()
+                return
+            target = self._resize_requested()
+            if target is not None and not self._perform_resize(target):
+                # fallback ladder rung two: the in-place re-mesh failed,
+                # so degrade to the drain path — the boundary checkpoint
+                # is already saved and the scheduler requeues with resume
+                self._preempt_at_boundary()
                 return
             # next round
             self._caught_up_this_round = set()

@@ -35,6 +35,7 @@ pod-only plane may pass ``master=None``):
   POST /api/v1/pod/jobs       {job_name, kind, tenant, slots, command, ...}
   POST /api/v1/pod/jobs/<id>/preempt
   POST /api/v1/pod/jobs/<id>/cancel
+  POST /api/v1/pod/jobs/<id>/resize  {slots}
 """
 
 from __future__ import annotations
@@ -53,7 +54,7 @@ from .agents import MasterAgent
 
 _RUN_PATH = re.compile(r"^/api/v1/runs/([0-9a-f]+)(/(wait|stop))?$")
 _POD_JOB_PATH = re.compile(
-    r"^/api/v1/pod/jobs/([0-9a-f]+)(/(preempt|cancel))?$")
+    r"^/api/v1/pod/jobs/([0-9a-f]+)(/(preempt|cancel|resize))?$")
 
 
 class ControlPlaneServer:
@@ -167,6 +168,19 @@ class ControlPlaneServer:
                     return self._reply(200 if ok else 409,
                                        {"job_id": m.group(1),
                                         "cancel_requested": ok})
+                if m and m.group(3) == "resize":
+                    try:
+                        slots = int(body["slots"])
+                    except (KeyError, TypeError, ValueError):
+                        return self._reply(400,
+                                           {"error": "slots required"})
+                    target = plane.pod_queue.request_resize(
+                        m.group(1), slots)
+                    return self._reply(200 if target is not None else 409,
+                                       {"job_id": m.group(1),
+                                        "resize_requested":
+                                            target is not None,
+                                        "target_slots": target})
                 return self._reply(404, {"error": "not found"})
 
             def do_POST(self) -> None:  # noqa: N802
@@ -332,6 +346,18 @@ class ControlPlaneClient:
     def pod_cancel(self, job_id: str) -> bool:
         return self._call("POST", f"/api/v1/pod/jobs/{job_id}/cancel",
                           {})["cancel_requested"]
+
+    def pod_resize(self, job_id: str, slots: int) -> Optional[int]:
+        """Clamped target slot count, or None when the job can't resize
+        (not found, finished, or RUNNING without an elastic range)."""
+        try:
+            return self._call(
+                "POST", f"/api/v1/pod/jobs/{job_id}/resize",
+                {"slots": int(slots)})["target_slots"]
+        except RuntimeError as e:
+            if "409" in str(e) or "404" in str(e):
+                return None
+            raise
 
     def pod_stats(self) -> Dict[str, int]:
         return self._call("GET", "/api/v1/pod/stats")

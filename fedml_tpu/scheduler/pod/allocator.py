@@ -13,19 +13,37 @@ queue snapshot plus the free-slot count and acts on the returned plan:
   block smaller jobs behind it (utilization first), because…
 * **priority eviction** — …a strictly higher-priority job that cannot fit
   instead selects preemptible lower-priority victims to drain, so large
-  high-priority gangs cannot be starved by a stream of small jobs.
+  high-priority gangs cannot be starved by a stream of small jobs;
+* **elastic shrink over evict** — a lower-priority victim that declared
+  an elastic range is *shrunk* toward its ``min_slots`` (a round-boundary
+  in-place resize — it keeps running) instead of drained whole; whole-job
+  eviction is reserved for inelastic victims and for the slack an elastic
+  shrink can't cover;
+* **grow-back** — when slots free up and nothing is blocked, elastic
+  RUNNING jobs are grown back toward ``max_slots`` (priority first), so
+  borrowed slots return as soon as the pressure passes.
 
-Eviction is asynchronous (victims drain at their next round boundary), so
-the plan carries a **reservation**: the scheduler holds the pledged slots
-for the evicting job across ticks — without it, a backfill dispatch on
-the next pass would steal the slots the drain just freed and the eviction
-would loop forever.
+Eviction AND shrink are asynchronous (victims drain or re-mesh at their
+next round boundary), so the plan carries a **reservation**: the
+scheduler holds the pledged slots for the claiming job across ticks —
+without it, a backfill dispatch on the next pass would steal the slots
+the drain/shrink just freed and the eviction would loop forever.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def elastic_floor(job: Dict[str, Any]) -> int:
+    """The smallest gang an elastic job may be shrunk to (its own size
+    when the job declared no elastic range)."""
+    return int(job.get("min_slots") or 0) or int(job["n_slots"])
+
+
+def elastic_ceiling(job: Dict[str, Any]) -> int:
+    return int(job.get("max_slots") or 0) or int(job["n_slots"])
 
 
 @dataclasses.dataclass
@@ -34,8 +52,16 @@ class PlacementPlan:
 
     dispatch: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
     evict: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    #: (running job, new smaller gang size) — in-place round-boundary
+    #: shrink of an elastic victim instead of a whole-job eviction
+    shrink: List[Tuple[Dict[str, Any], int]] = dataclasses.field(
+        default_factory=list)
+    #: (running job, new larger gang size) — grow an elastic job back
+    #: toward max_slots out of the uncontended free pool
+    grow: List[Tuple[Dict[str, Any], int]] = dataclasses.field(
+        default_factory=list)
     #: job_id → slot count to hold until that job dispatches (set when
-    #: this pass pledged an eviction on its behalf)
+    #: this pass pledged an eviction/shrink on its behalf)
     reserve: Dict[str, int] = dataclasses.field(default_factory=dict)
     blocked: List[str] = dataclasses.field(default_factory=list)
 
@@ -76,12 +102,13 @@ class GangAllocator:
         free = int(free_slots)
         reserved = dict(reserved or {})
         # evictable pool: preemptible RUNNING jobs (drains already in
-        # flight are spoken for), cheapest first — lowest priority, then
-        # most recently dispatched (least round progress to redo after
-        # the boundary checkpoint)
+        # flight are spoken for, and so are jobs mid-resize), cheapest
+        # first — lowest priority, then most recently dispatched (least
+        # round progress to redo after the boundary checkpoint)
         evictable = sorted(
             [j for j in running
-             if j["preemptible"] and j["state"] == "RUNNING"],
+             if j["preemptible"] and j["state"] == "RUNNING"
+             and not int(j.get("resize_requested") or 0)],
             key=lambda j: (int(j["priority"]),
                            -float(j["dispatched_ts"] or 0.0)))
         for job in self.order(queued, running):
@@ -98,23 +125,63 @@ class GangAllocator:
             plan.blocked.append(jid)
             if mine:
                 continue  # victims already draining for this job
-            # eviction only ever trades UP in priority: victims must be
-            # strictly lower-priority preemptible jobs
-            victims, victim_slots = [], 0
+            # victims must be strictly lower-priority preemptible jobs —
+            # the claim only ever trades UP in priority.  An elastic
+            # victim is shrunk toward min_slots (it keeps running at a
+            # smaller gang); a whole-job eviction is the fallback for
+            # inelastic victims
+            victims, shrinks, victim_slots = [], [], 0
             for cand in evictable:
                 if int(cand["priority"]) >= int(job["priority"]):
                     break
-                victims.append(cand)
-                victim_slots += int(cand["n_slots"])
+                floor = elastic_floor(cand)
+                cur = int(cand["n_slots"])
+                if floor < cur:
+                    short = need - (avail + victim_slots)
+                    new = max(floor, cur - short)
+                    shrinks.append((cand, new))
+                    victim_slots += cur - new
+                else:
+                    victims.append(cand)
+                    victim_slots += cur
                 if avail + victim_slots >= need:
                     break
-            if victims and avail + victim_slots >= need:
+            if (victims or shrinks) and avail + victim_slots >= need:
                 plan.evict.extend(victims)
-                for v in victims:
+                plan.shrink.extend(shrinks)
+                for v in victims + [c for c, _ in shrinks]:
                     evictable.remove(v)
                 # the full gang is reserved against the future free pool
                 # (current free + what the victims release); backfill
                 # behind the pledge sees it through the reserved sum
                 plan.reserve[jid] = need
                 reserved[jid] = need
+        # grow-back: whatever free pool remains after every dispatch and
+        # pledge goes to elastic RUNNING jobs below their ceiling —
+        # priority first, then the fair-share order.  Blocked queued jobs
+        # always outrank grow-back: ANY blocked job suppresses it (even
+        # an equal-priority one the eviction rule can't help — growing
+        # past it would starve it of the slots it's waiting on), and a
+        # job mid-resize or mid-drain is left alone.
+        spare = free - sum(reserved.values())
+        if spare > 0 and not plan.blocked:
+            consumed = ({j["job_id"] for j in plan.evict}
+                        | {j["job_id"] for j, _ in plan.shrink})
+            growable = sorted(
+                [j for j in running
+                 if j["state"] == "RUNNING"
+                 and j["job_id"] not in consumed
+                 and not int(j.get("resize_requested") or 0)
+                 and elastic_ceiling(j) > int(j["n_slots"])],
+                key=lambda j: (
+                    -int(j["priority"]),
+                    held.get(j["tenant"], 0.0) / self._weight(j["tenant"]),
+                    float(j["dispatched_ts"] or 0.0)))
+            for job in growable:
+                if spare <= 0:
+                    break
+                give = min(elastic_ceiling(job) - int(job["n_slots"]),
+                           spare)
+                plan.grow.append((job, int(job["n_slots"]) + give))
+                spare -= give
         return plan

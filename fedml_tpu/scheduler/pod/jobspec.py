@@ -14,6 +14,9 @@ slots: 4                      # gang size — device slots held while running
 command: fedml run --cf fedml_config.yaml {resume}
 workdir: .                    # resolved relative to the YAML file
 preemptible: true             # may be drained for higher-priority work
+elastic:                      # optional: round-boundary resizable gang
+  min_slots: 2                # never shrunk below this
+  max_slots: 8                # never grown past this
 fedml_env:                    # extra environment for the dispatch
   FEDML_TPU_FLIGHT_RECORDER: "1"
 ```
@@ -22,6 +25,13 @@ fedml_env:                    # extra environment for the dispatch
 is re-dispatched after a round-boundary preemption, and to the empty
 string on the first dispatch — the job script stays a single line either
 way.
+
+An **elastic** job declares a slot range instead of a fixed gang: the
+allocator may shrink it toward ``min_slots`` under pressure (instead of
+evicting it) and grow it back toward ``max_slots`` when slots free up,
+both at round boundaries via the resize file (docs/SCHEDULER.md
+"Elastic resize").  A job without an ``elastic`` block keeps the fixed
+gang contract: it is never resized, only preempted whole.
 """
 
 from __future__ import annotations
@@ -71,8 +81,15 @@ class JobSpec:
     workdir: str = "."
     env: Dict[str, str] = dataclasses.field(default_factory=dict)
     preemptible: bool = True
+    #: elastic slot range — both 0 means "not elastic" (fixed gang)
+    min_slots: int = 0
+    max_slots: int = 0
     job_id: str = dataclasses.field(
         default_factory=lambda: uuid.uuid4().hex[:12])
+
+    @property
+    def elastic(self) -> bool:
+        return int(self.min_slots) > 0 or int(self.max_slots) > 0
 
     def validate(self) -> "JobSpec":
         if self.kind not in JOB_KINDS:
@@ -82,6 +99,18 @@ class JobSpec:
             raise ValueError(f"slots must be >= 1, got {self.n_slots}")
         if not self.name:
             raise ValueError("job_name is required")
+        if self.elastic:
+            lo, hi = int(self.min_slots), int(self.max_slots)
+            if lo < 1:
+                raise ValueError(
+                    f"elastic.min_slots must be >= 1, got {lo}")
+            if hi < lo:
+                raise ValueError(
+                    f"elastic.max_slots {hi} < min_slots {lo}")
+            if not lo <= int(self.n_slots) <= hi:
+                raise ValueError(
+                    f"slots {self.n_slots} outside the elastic range "
+                    f"[{lo}, {hi}]")
         return self
 
     @classmethod
@@ -91,19 +120,34 @@ class JobSpec:
         if base_dir is not None:
             workdir = os.path.normpath(os.path.join(base_dir, workdir))
         slots = raw.get("slots", raw.get("n_slots"))
+        elastic = raw.get("elastic") or {}
+        if not isinstance(elastic, dict):
+            raise ValueError(
+                "elastic must be a mapping with min_slots/max_slots, "
+                f"got {elastic!r}")
+        n_slots = int(1 if slots is None else slots)
+        min_slots = int(elastic.get("min_slots", 0) or 0)
+        max_slots = int(elastic.get("max_slots", 0) or 0)
+        if elastic:
+            # a bare `elastic: {}` (or a one-sided range) defaults the
+            # missing bound to the declared gang size
+            min_slots = min_slots or n_slots
+            max_slots = max_slots or n_slots
         return cls(
             name=str(raw.get("job_name", "")
                      or f"job_{uuid.uuid4().hex[:8]}"),
             kind=str(raw.get("kind", KIND_CROSS_SILO)),
             tenant=str(raw.get("tenant", "default") or "default"),
             priority=int(raw.get("priority", 0) or 0),
-            n_slots=int(1 if slots is None else slots),
+            n_slots=n_slots,
             command=str(raw.get("command", raw.get("job", "")) or ""),
             workdir=workdir,
             env={k: str(v) for k, v in
                  dict(raw.get("fedml_env", raw.get("env", {})) or {}
                       ).items()},
             preemptible=bool(raw.get("preemptible", True)),
+            min_slots=min_slots,
+            max_slots=max_slots,
         ).validate()
 
     @classmethod

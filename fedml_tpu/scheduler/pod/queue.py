@@ -25,7 +25,20 @@ _COLUMNS = (
     "workdir", "env", "preemptible", "state", "resume",
     "preempt_requested", "cancel_requested", "preempt_count",
     "submitted_ts", "dispatched_ts", "finished_ts", "run_id",
-    "returncode", "log_dir", "slots")
+    "returncode", "log_dir", "slots", "min_slots", "max_slots",
+    "resize_requested", "last_resize")
+
+#: columns added after the first pod release — opening an older queue.db
+#: migrates it in place (the `ComputeResourceDB` pid-column idiom)
+_MIGRATIONS = (
+    ("min_slots", "INTEGER DEFAULT 0"),
+    ("max_slots", "INTEGER DEFAULT 0"),
+    # target slot count of an in-flight RESIZE control request; 0 = none
+    ("resize_requested", "INTEGER DEFAULT 0"),
+    # JSON {"from", "to", "outcome", "downtime_s", "ts"} of the last
+    # completed (or fallen-back) resize — the list/status projection
+    ("last_resize", "TEXT"),
+)
 
 
 def pod_root(root: Optional[str] = None) -> str:
@@ -58,7 +71,16 @@ class JobQueue:
                 "preempt_requested INTEGER, cancel_requested INTEGER, "
                 "preempt_count INTEGER, submitted_ts REAL, "
                 "dispatched_ts REAL, finished_ts REAL, run_id TEXT, "
-                "returncode INTEGER, log_dir TEXT, slots TEXT)")
+                "returncode INTEGER, log_dir TEXT, slots TEXT, "
+                "min_slots INTEGER DEFAULT 0, "
+                "max_slots INTEGER DEFAULT 0, "
+                "resize_requested INTEGER DEFAULT 0, last_resize TEXT)")
+            cols = {r[1] for r in self._conn.execute(
+                "PRAGMA table_info(jobs)").fetchall()}
+            for name, decl in _MIGRATIONS:
+                if name not in cols:
+                    self._conn.execute(
+                        f"ALTER TABLE jobs ADD COLUMN {name} {decl}")
 
     def close(self) -> None:
         with self._lock:
@@ -70,12 +92,13 @@ class JobQueue:
         with self._lock:
             self._conn.execute(
                 "INSERT INTO jobs VALUES "
-                "(?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                "(?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
                 (spec.job_id, spec.name, spec.tenant, spec.kind,
                  int(spec.priority), int(spec.n_slots), spec.command,
                  spec.workdir, json.dumps(spec.env),
                  int(spec.preemptible), JobState.QUEUED, 0, 0, 0, 0,
-                 time.time(), None, None, None, None, None, None))
+                 time.time(), None, None, None, None, None, None,
+                 int(spec.min_slots), int(spec.max_slots), 0, None))
         return spec.job_id
 
     # -- reads ----------------------------------------------------------------
@@ -84,6 +107,11 @@ class JobQueue:
         d = dict(zip(_COLUMNS, row))
         d["env"] = json.loads(d["env"] or "{}")
         d["slots"] = json.loads(d["slots"] or "[]")
+        d["last_resize"] = (json.loads(d["last_resize"])
+                            if d.get("last_resize") else None)
+        for key in ("min_slots", "max_slots", "resize_requested"):
+            d[key] = int(d[key] or 0)
+        d["elastic"] = d["min_slots"] > 0 or d["max_slots"] > 0
         for key in ("preemptible", "resume", "preempt_requested",
                     "cancel_requested"):
             d[key] = bool(d[key])
@@ -170,13 +198,68 @@ class JobQueue:
 
     def update_slots(self, job_id: str, n_slots: int) -> bool:
         """Resize a QUEUED job's gang demand (the serving scaler's knob —
-        a RUNNING job must be preempted first; its requeued row can then
-        be resized before re-dispatch)."""
+        a RUNNING job takes the `request_resize` path instead, or is
+        preempted first when it isn't elastic)."""
         with self._lock:
             cur = self._conn.execute(
                 "UPDATE jobs SET n_slots=? WHERE job_id=? AND state=?",
                 (max(1, int(n_slots)), job_id, JobState.QUEUED))
         return cur.rowcount > 0
+
+    @staticmethod
+    def clamp_elastic(job: Dict[str, Any], n_slots: int) -> int:
+        """Clamp a resize target into the job's declared elastic range."""
+        lo = int(job["min_slots"]) or int(job["n_slots"])
+        hi = int(job["max_slots"]) or int(job["n_slots"])
+        return max(lo, min(hi, int(n_slots)))
+
+    def request_resize(self, job_id: str, n_slots: int) -> Optional[int]:
+        """Ask the scheduler to resize a job's gang at its next round
+        boundary.  QUEUED jobs are resized directly; a RUNNING *elastic*
+        job gets the flag (clamped into [min_slots, max_slots]) and the
+        scheduler performs the in-place resize.  Returns the clamped
+        target, or None when the job can't be resized (not found,
+        inelastic while RUNNING, or draining)."""
+        job = self.get(job_id)
+        if job is None:
+            return None
+        if job["state"] == JobState.QUEUED:
+            target = (self.clamp_elastic(job, n_slots)
+                      if job["elastic"] else max(1, int(n_slots)))
+            return target if self.update_slots(job_id, target) else None
+        if job["state"] != JobState.RUNNING or not job["elastic"]:
+            return None
+        target = self.clamp_elastic(job, n_slots)
+        with self._lock:
+            cur = self._conn.execute(
+                "UPDATE jobs SET resize_requested=? "
+                "WHERE job_id=? AND state=?",
+                (target, job_id, JobState.RUNNING))
+        return target if cur.rowcount > 0 else None
+
+    def record_resize(self, job_id: str, from_slots: int, to_slots: int,
+                      outcome: str,
+                      downtime_s: Optional[float] = None,
+                      slots: Optional[List[int]] = None) -> None:
+        """Scheduler-owned: land a finished resize attempt on the row —
+        the new gang size + slot list when it completed in place, and the
+        `last_resize` audit blob either way."""
+        blob = json.dumps({"from": int(from_slots), "to": int(to_slots),
+                           "outcome": str(outcome),
+                           "downtime_s": downtime_s, "ts": time.time()})
+        with self._lock:
+            if outcome == "ok":
+                self._conn.execute(
+                    "UPDATE jobs SET n_slots=?, slots=?, "
+                    "resize_requested=0, last_resize=? WHERE job_id=?",
+                    (int(to_slots),
+                     json.dumps(list(slots)) if slots is not None
+                     else None,
+                     blob, job_id))
+            else:
+                self._conn.execute(
+                    "UPDATE jobs SET resize_requested=0, last_resize=? "
+                    "WHERE job_id=?", (blob, job_id))
 
     # -- scheduler-owned transitions ------------------------------------------
     def mark_dispatched(self, job_id: str, run_id: str, slots: List[int],
@@ -184,7 +267,8 @@ class JobQueue:
         with self._lock:
             self._conn.execute(
                 "UPDATE jobs SET state=?, run_id=?, slots=?, log_dir=?, "
-                "dispatched_ts=?, preempt_requested=0 WHERE job_id=?",
+                "dispatched_ts=?, preempt_requested=0, "
+                "resize_requested=0 WHERE job_id=?",
                 (JobState.RUNNING, run_id, json.dumps(list(slots)),
                  log_dir, time.time(), job_id))
 
@@ -213,8 +297,8 @@ class JobQueue:
                 self._conn.execute(
                     "UPDATE jobs SET state=?, resume=1, "
                     "preempt_count=preempt_count+1, returncode=?, "
-                    "run_id=NULL, slots=NULL, preempt_requested=0 "
-                    "WHERE job_id=?",
+                    "run_id=NULL, slots=NULL, preempt_requested=0, "
+                    "resize_requested=0 WHERE job_id=?",
                     (JobState.QUEUED, returncode, job_id))
                 self._conn.execute("COMMIT")
             except sqlite3.OperationalError:

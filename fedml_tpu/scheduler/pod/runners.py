@@ -24,6 +24,7 @@ Two interchangeable backends behind one handle contract
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import signal
@@ -36,11 +37,12 @@ from .jobspec import PREEMPTED_EXIT_CODE
 
 class JobContext:
     """What a dispatched workload sees: identity, its mesh slice, the
-    pod-contract environment, and the drain channel."""
+    pod-contract environment, and the drain + resize channels."""
 
     def __init__(self, job_id: str, run_id: str, slots: List[int],
                  env: Dict[str, str], resume: bool,
-                 drain_path: str, log_dir: str) -> None:
+                 drain_path: str, log_dir: str,
+                 resize_path: Optional[str] = None) -> None:
         self.job_id = job_id
         self.run_id = run_id
         self.slots = list(slots)
@@ -48,9 +50,25 @@ class JobContext:
         self.resume = resume
         self.drain_path = drain_path
         self.log_dir = log_dir
+        self.resize_path = resize_path
 
     def drain_requested(self) -> bool:
         return os.path.exists(self.drain_path)
+
+    def resize_requested(self) -> Optional[int]:
+        """The announced new gang size, or None when no resize is
+        pending (in-process workloads poll this at round boundaries —
+        the file-based twin of `_resize_requested` in the server)."""
+        if not self.resize_path:
+            return None
+        req = read_resize(self.resize_path)
+        return None if req is None else int(req["slots"])
+
+    def ack_resize(self, outcome: str, to_slots: int,
+                   downtime_s: Optional[float] = None, **attrs) -> None:
+        if self.resize_path:
+            ack_resize(self.resize_path, outcome=outcome,
+                       to_slots=to_slots, downtime_s=downtime_s, **attrs)
 
 
 def signal_drain(drain_path: str) -> None:
@@ -59,6 +77,54 @@ def signal_drain(drain_path: str) -> None:
     os.makedirs(os.path.dirname(drain_path), exist_ok=True)
     with open(drain_path, "w") as f:
         f.write("drain\n")
+
+
+def _write_json_atomic(path: str, payload: Dict[str, Any]) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)  # readers never see a torn file
+
+
+def signal_resize(resize_path: str, new_slots: int,
+                  from_slots: int) -> None:
+    """Announce a round-boundary resize: the workload latches the target
+    at its next `_complete_round`, checkpoints, re-meshes in place and
+    writes the ack (docs/SCHEDULER.md "Elastic resize")."""
+    _write_json_atomic(resize_path, {"slots": int(new_slots),
+                                     "from": int(from_slots)})
+
+
+def read_resize(resize_path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(resize_path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def ack_resize(resize_path: str, outcome: str, to_slots: int,
+               downtime_s: Optional[float] = None, **attrs) -> None:
+    """Workload side: report how the announced resize ended — ``ok``
+    (re-meshed in place, running at ``to_slots``) or ``failed`` (the
+    scheduler falls back to the preempt/resume ladder)."""
+    payload = {"outcome": str(outcome), "to": int(to_slots),
+               "downtime_s": downtime_s}
+    payload.update(attrs)
+    _write_json_atomic(resize_path + ".ack", payload)
+
+
+def read_resize_ack(resize_path: str) -> Optional[Dict[str, Any]]:
+    return read_resize(resize_path + ".ack")
+
+
+def clear_resize(resize_path: str) -> None:
+    for p in (resize_path, resize_path + ".ack"):
+        try:
+            os.remove(p)
+        except OSError:
+            pass
 
 
 class SubprocessJobHandle:
@@ -176,5 +242,6 @@ class CallableJobRunner:
 __all__ = [
     "JobContext", "SubprocessJobRunner", "SubprocessJobHandle",
     "CallableJobRunner", "CallableJobHandle", "signal_drain",
-    "PREEMPTED_EXIT_CODE",
+    "signal_resize", "read_resize", "ack_resize", "read_resize_ack",
+    "clear_resize", "PREEMPTED_EXIT_CODE",
 ]

@@ -16,9 +16,25 @@ The drained server force-saves its `RoundCheckpointer` state at the next
 round boundary before exiting, so the requeued dispatch's
 ``--resume-from latest`` loses zero rounds and re-counts zero uploads.
 
+Elastic resize rides the same round-boundary machinery without the
+requeue round-trip (docs/SCHEDULER.md "Elastic resize"):
+
+    RUNNING ──resize file──► workload checkpoints, re-meshes IN PLACE
+       │                         │
+       │                         ├─ack ok──► still RUNNING at new size
+       │                         └─ack failed / grace / death──►
+       │                              fallback: drain → exit 75 → requeue
+       └─(the fallback ladder: resize → preempt → kill)
+
+A grow pre-allocates the extra slots in the resource db under the job's
+run_id before the announce, so backfill can't steal them mid-resize; a
+shrink releases the excess only after the workload acks — the slots stay
+pinned until the re-mesh is real.
+
 Queue metrics exported from here: ``fedml_job_queue_wait_seconds``,
-``fedml_pod_slot_utilization``, ``fedml_jobs_preempted_total`` plus depth
-/running/eviction series.
+``fedml_pod_slot_utilization``, ``fedml_jobs_preempted_total``,
+``fedml_pod_resizes_total``, ``fedml_resize_downtime_seconds`` plus
+depth/running/eviction series.
 """
 
 from __future__ import annotations
@@ -36,7 +52,8 @@ from ..resource_db import ComputeResourceDB
 from .allocator import GangAllocator
 from .jobspec import PREEMPTED_EXIT_CODE, JobState
 from .queue import JobQueue
-from .runners import JobContext, SubprocessJobRunner
+from .runners import (JobContext, SubprocessJobRunner, clear_resize,
+                      read_resize_ack, signal_resize)
 
 _queue_wait = metrics.histogram(
     "fedml_job_queue_wait_seconds",
@@ -58,6 +75,15 @@ _queue_depth = metrics.gauge(
     "fedml_pod_queue_depth", "Jobs waiting in the QUEUED state")
 _jobs_running = metrics.gauge(
     "fedml_pod_jobs_running", "Jobs currently dispatched on the pod")
+_resizes_total = metrics.counter(
+    "fedml_pod_resizes_total",
+    "Round-boundary gang resizes by direction and outcome "
+    "(ok = completed in place, fallback = degraded to preempt/resume)",
+    labels=("direction", "outcome"))
+_resize_downtime = metrics.histogram(
+    "fedml_resize_downtime_seconds",
+    "Checkpoint -> re-mesh -> resume pause of an in-place resize",
+    buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0))
 
 
 class PodScheduler:
@@ -65,6 +91,7 @@ class PodScheduler:
                  runner: Optional[Any] = None,
                  tenant_weights: Optional[Dict[str, float]] = None,
                  tick_s: float = 0.5, drain_grace_s: float = 60.0,
+                 resize_grace_s: float = 60.0,
                  serving_scaler: Optional[Any] = None) -> None:
         self.queue = queue
         self.resources = resources
@@ -72,15 +99,20 @@ class PodScheduler:
         self.allocator = GangAllocator(tenant_weights)
         self.tick_s = float(tick_s)
         self.drain_grace_s = float(drain_grace_s)
+        self.resize_grace_s = float(resize_grace_s)
         self.serving_scaler = serving_scaler
         self.aot_cache_dir = os.path.join(queue.root, "aot_cache")
         self._lock = named_lock("PodScheduler._lock")
         self._handles: Dict[str, Any] = {}
         self._reservations: Dict[str, int] = {}
         self._drain_started: Dict[str, float] = {}
+        #: job_id → in-flight resize state ({"t0", "from", "to",
+        #: "run_id", "path", "slots_after", "extra"})
+        self._resizes: Dict[str, Dict[str, Any]] = {}
         self._busy_slot_seconds = 0.0
         self._t0: Optional[float] = None
         self._last_tick: Optional[float] = None
+        self._last_in_use = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -125,8 +157,15 @@ class PodScheduler:
             if self._t0 is None:
                 self._t0 = now
             elif self._last_tick is not None:
-                self._busy_slot_seconds += in_use * (now - self._last_tick)
+                # integrate the PREVIOUS interval at the slot count that
+                # was actually held over it (`self._last_in_use`, sampled
+                # at the end of the last pass) — using the fresh `in_use`
+                # here would attribute this tick's resizes/releases
+                # retroactively over the interval before they happened
+                self._busy_slot_seconds += (
+                    self._last_in_use * (now - self._last_tick))
             self._last_tick = now
+            self._last_in_use = int(in_use)
 
     # -- one scheduling pass --------------------------------------------------
     def step(self, now: Optional[float] = None) -> Dict[str, Any]:
@@ -134,9 +173,11 @@ class PodScheduler:
         report = self.resources.report()
         self._integrate_busy(now, int(report["in_use"]))
         summary: Dict[str, Any] = {"reaped": [], "dispatched": [],
-                                   "draining": [], "evicted": []}
+                                   "draining": [], "evicted": [],
+                                   "resizing": [], "resized": []}
         self._reap(summary)
         self._apply_control_requests(now, summary)
+        self._poll_resizes(now, summary)
         self._enforce_drain_grace(now)
         self._place(now, summary)
         if self.serving_scaler is not None:
@@ -150,6 +191,9 @@ class PodScheduler:
         _queue_depth.set(len(self.queue.queued()))
         with self._lock:
             _jobs_running.set(len(self._handles))
+            # what this pass allocated/released holds until the next
+            # tick — that's the value the busy integral must carry
+            self._last_in_use = int(report["in_use"])
         summary["free_slots"] = int(report["free"])
         return summary
 
@@ -164,6 +208,17 @@ class PodScheduler:
             job = self.queue.get(job_id)
             tenant = job["tenant"] if job else "default"
             draining = bool(job and job["state"] == JobState.PREEMPTING)
+            with self._lock:
+                resize = self._resizes.pop(job_id, None)
+            if resize is not None:
+                # died (or was killed) with a resize in flight: the
+                # boundary checkpoint still exists, so the fallback
+                # ladder degrades this to a clean preempt-resume — the
+                # resize can never be worse than a preemption
+                self._finish_resize(job or {"job_id": job_id,
+                                            "tenant": tenant},
+                                    resize, "fallback_preempt", None)
+                draining = draining or rc != 0
             if job is None:
                 pass
             elif job["cancel_requested"]:
@@ -192,6 +247,8 @@ class PodScheduler:
                 os.remove(handle.ctx.drain_path)
             except OSError:
                 pass
+            clear_resize(getattr(handle.ctx, "resize_path", None)
+                         or self._resize_path(handle.ctx.run_id))
             summary["reaped"].append((job_id, rc))
 
     def _apply_control_requests(self, now: float,
@@ -207,6 +264,13 @@ class PodScheduler:
             elif (job["state"] == JobState.RUNNING
                   and job["preempt_requested"]):
                 self._drain(job, handle, now, summary)
+            elif (job["state"] == JobState.RUNNING
+                  and job["resize_requested"]):
+                with self._lock:
+                    started = job["job_id"] in self._resizes
+                if not started:
+                    self._start_resize(job, int(job["resize_requested"]),
+                                       now, summary)
 
     def _drain(self, job: Dict[str, Any], handle: Any, now: float,
                summary: Dict[str, Any]) -> None:
@@ -217,6 +281,107 @@ class PodScheduler:
         with self._lock:
             self._drain_started.setdefault(job["job_id"], now)
         summary["draining"].append(job["job_id"])
+
+    # -- elastic resize -------------------------------------------------------
+    def _resize_path(self, run_id: str) -> str:
+        return os.path.join(self.queue.root, "resize", f"{run_id}.resize")
+
+    def _start_resize(self, job: Dict[str, Any], target: int, now: float,
+                      summary: Dict[str, Any]) -> None:
+        """Announce a round-boundary resize to a RUNNING elastic job.
+        A grow pre-allocates the extra slots under the job's run_id
+        FIRST (no announce if the pod can't deliver them — the flag
+        stays set and retries when slots free up); a shrink keeps every
+        slot pinned until the workload acks the re-mesh."""
+        job_id, run_id = job["job_id"], job["run_id"]
+        with self._lock:
+            if job_id in self._resizes:
+                return  # one resize in flight at a time
+        cur = int(job["n_slots"])
+        target = self.queue.clamp_elastic(job, target)
+        if target == cur or not run_id:
+            self.queue.record_resize(job_id, cur, cur, "noop", 0.0,
+                                     slots=job["slots"])
+            return
+        extra: List[int] = []
+        if target > cur:
+            extra = self.resources.allocate_extra(run_id, target - cur)
+            if not extra:
+                return  # not enough free slots yet — retry next tick
+            slots_after = list(job["slots"]) + extra
+        else:
+            slots_after = list(job["slots"])[:target]
+        path = self._resize_path(run_id)
+        signal_resize(path, target, cur)
+        with self._lock:
+            self._resizes[job_id] = {
+                "t0": now, "from": cur, "to": target, "run_id": run_id,
+                "path": path, "slots_after": slots_after, "extra": extra}
+        ledger.event("scheduler", "resize_start", job_id=job_id,
+                     tenant=str(job["tenant"]),
+                     **{"from": cur, "to": target})
+        summary["resizing"].append(job_id)
+
+    def _poll_resizes(self, now: float, summary: Dict[str, Any]) -> None:
+        with self._lock:
+            resizes = dict(self._resizes)
+        for job_id, st in resizes.items():
+            job = self.queue.get(job_id)
+            if job is None or job["state"] != JobState.RUNNING:
+                continue  # death/cancel paths settle it in _reap
+            ack = read_resize_ack(st["path"])
+            if ack is not None and ack.get("outcome") == "ok":
+                if st["to"] < st["from"]:
+                    freed = [s for s in job["slots"]
+                             if s not in st["slots_after"]]
+                    self.resources.release_slots(st["run_id"], freed)
+                with self._lock:
+                    self._resizes.pop(job_id, None)
+                self._finish_resize(job, st, "ok",
+                                    ack.get("downtime_s"))
+                summary["resized"].append((job_id, st["to"]))
+            elif ack is not None:
+                self._resize_fallback(job, st, now, summary)
+            elif now - st["t0"] > self.resize_grace_s:
+                logging.warning(
+                    "pod: job %s resize %d->%d exceeded grace (%.0fs) — "
+                    "falling back to preempt", job_id, st["from"],
+                    st["to"], self.resize_grace_s)
+                self._resize_fallback(job, st, now, summary)
+
+    def _resize_fallback(self, job: Dict[str, Any], st: Dict[str, Any],
+                         now: float, summary: Dict[str, Any]) -> None:
+        """The ladder's middle rung: the in-place re-mesh didn't land, so
+        degrade to the PR-11 preempt path — drain at the next boundary,
+        requeue with resume.  Pre-allocated grow slots go back first."""
+        with self._lock:
+            self._resizes.pop(job["job_id"], None)
+        if st["extra"]:
+            self.resources.release_slots(st["run_id"], st["extra"])
+        self._finish_resize(job, st, "fallback_preempt", None)
+        with self._lock:
+            handle = self._handles.get(job["job_id"])
+        if handle is not None:
+            self._drain(job, handle, now, summary)
+
+    def _finish_resize(self, job: Dict[str, Any], st: Dict[str, Any],
+                       outcome: str,
+                       downtime_s: Optional[float]) -> None:
+        clear_resize(st["path"])
+        self.queue.record_resize(
+            job["job_id"], st["from"], st["to"], outcome,
+            downtime_s, slots=st["slots_after"] if outcome == "ok"
+            else None)
+        direction = "grow" if st["to"] > st["from"] else "shrink"
+        _resizes_total.labels(
+            direction=direction,
+            outcome="ok" if outcome == "ok" else "fallback").inc()
+        if downtime_s is not None:
+            _resize_downtime.observe(float(downtime_s))
+        ledger.event("scheduler", "resize", job_id=job["job_id"],
+                     tenant=str(job.get("tenant", "default")),
+                     outcome=outcome, downtime_s=downtime_s,
+                     **{"from": st["from"], "to": st["to"]})
 
     def _enforce_drain_grace(self, now: float) -> None:
         with self._lock:
@@ -253,6 +418,20 @@ class PodScheduler:
                 self._drain(victim, handle, now, summary)
                 _evictions_total.labels(tenant=victim["tenant"]).inc()
                 summary["evicted"].append(victim["job_id"])
+        # elastic decisions: land the flag on the queue row (the same
+        # path `fedml jobs resize` takes) and announce immediately —
+        # the pledge in plan.reserve holds the freed slots for the
+        # blocked job across the ticks the re-mesh needs
+        for victim, new in plan.shrink:
+            target = self.queue.request_resize(victim["job_id"], new)
+            if target is not None:
+                self._start_resize(self.queue.get(victim["job_id"]),
+                                   target, now, summary)
+        for job, new in plan.grow:
+            target = self.queue.request_resize(job["job_id"], new)
+            if target is not None:
+                self._start_resize(self.queue.get(job["job_id"]),
+                                   target, now, summary)
         with self._lock:
             self._reservations.update(plan.reserve)
         for job in plan.dispatch:
@@ -267,9 +446,11 @@ class PodScheduler:
         job_id = job["job_id"]
         drain_path = os.path.join(self.queue.root, "drain",
                                   f"{run_id}.drain")
+        resize_path = self._resize_path(run_id)
         log_dir = os.path.join(self.queue.root, "logs", job_id, run_id)
         env = {
             "FEDML_TPU_DRAIN_FILE": drain_path,
+            "FEDML_TPU_RESIZE_FILE": resize_path,
             "FEDML_TPU_LOG_DIR": log_dir,
             "FEDML_TPU_AOT_CACHE_DIR": self.aot_cache_dir,
             "FEDML_CURRENT_RUN_ID": run_id,
@@ -280,7 +461,8 @@ class PodScheduler:
         env.update(job["env"])
         ctx = JobContext(job_id, run_id, slots, env,
                          resume=bool(job["resume"]),
-                         drain_path=drain_path, log_dir=log_dir)
+                         drain_path=drain_path, log_dir=log_dir,
+                         resize_path=resize_path)
         command = str(job["command"]).replace(
             "{resume}",
             "--resume-from latest" if job["resume"] else "").strip()

@@ -7,9 +7,12 @@ breach, shrink slowly with cooldown).  Each pod *serving* job gets its
 own autoscaler; the decision lands on the job queue:
 
 * job still QUEUED → ``update_slots`` resizes the gang before dispatch;
-* job RUNNING with the wrong slot count → ``request_preempt`` so the
-  scheduler drains it at a safe boundary and the requeued row is resized
-  before its next dispatch.
+* job RUNNING and **elastic** → ``request_resize`` so the scheduler
+  re-meshes it IN PLACE at the next round boundary (no requeue
+  round-trip, no warm-state loss);
+* job RUNNING and inelastic → ``request_preempt`` so the scheduler
+  drains it at a safe boundary and the requeued row is resized before
+  its next dispatch.
 
 No threads of its own — `PodScheduler.step()` ticks it, so all metric
 reads and queue writes happen on the scheduler's pass.
@@ -112,10 +115,17 @@ class ServingReplicaScaler:
             if job["state"] == JobState.QUEUED:
                 self.queue.update_slots(job["job_id"], want)
             elif job["state"] == JobState.RUNNING:
-                # resize via the safe path: drain at a boundary, then
-                # apply the new gang size to the requeued row above
-                self.queue.request_preempt(job["job_id"])
-                self._pending_resize[job["job_id"]] = want
+                if job.get("elastic"):
+                    # in-place path: latch a round-boundary re-mesh (the
+                    # queue clamps to the declared min/max range); a
+                    # request already in flight is left alone
+                    if not int(job.get("resize_requested") or 0):
+                        self.queue.request_resize(job["job_id"], want)
+                else:
+                    # inelastic: drain at a boundary, then apply the new
+                    # gang size to the requeued row above
+                    self.queue.request_preempt(job["job_id"])
+                    self._pending_resize[job["job_id"]] = want
         return decisions
 
 

@@ -132,6 +132,27 @@ class ComputeResourceDB:
                 return []
         return slots
 
+    def allocate_extra(self, run_id: str, n_slots: int,
+                       pid: Optional[int] = None) -> List[int]:
+        """Grow an existing run's gang: claim ``n_slots`` MORE free slots
+        under the same run_id (all-or-nothing, same BEGIN IMMEDIATE
+        discipline as `allocate`).  Returns the newly claimed slots, or
+        [] when not enough are free — the caller keeps the old gang."""
+        return self.allocate(run_id, n_slots, pid)
+
+    def release_slots(self, run_id: str, slots: List[int]) -> int:
+        """Shrink an existing run's gang: free exactly these slots (they
+        must belong to ``run_id`` — foreign slots are left untouched)."""
+        freed = 0
+        with _LOCK, self.conn:
+            for s in slots:
+                cur = self.conn.execute(
+                    "UPDATE devices SET run_id=NULL, allocated_ts=NULL, "
+                    "pid=NULL WHERE slot=? AND run_id=?",
+                    (int(s), str(run_id)))
+                freed += cur.rowcount
+        return freed
+
     def set_pid(self, run_id: str, pid: Optional[int]) -> int:
         """Record (or update) the owner pid after the job process exists
         — allocation happens before the spawn, so the dispatcher calls

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import os
 import threading
 import time
 from functools import partial
@@ -45,7 +46,7 @@ from ...constants import (
     FED_OPT_SCAFFOLD,
 )
 from ...core import mlops
-from ...core.mlops import flight_recorder
+from ...core.mlops import flight_recorder, ledger
 from ...core.mlops.lock_profiler import named_lock
 from ...ml.aggregator.agg_operator import agg_stacked
 from ...ml.aggregator.robust import parse_robust_agg, robust_agg_stacked
@@ -439,6 +440,16 @@ class ParrotAPI:
         #: reads it (and two concurrent starters must not spawn two pools)
         self._ca_lock = named_lock("ParrotAPI._ca_lock")
         self.compile_ahead_report: Dict[str, Any] = {}
+        #: resize warm pool: {mesh axis size: compiled step} precompiled
+        #: for the ±1-step slot ladder (half/double of the current gang)
+        #: so an announced re-mesh installs a ready executable instead of
+        #: paying a fresh compile inside the downtime window
+        self._resize_warm: Dict[int, Any] = {}
+        self._resize_warm_thread: Optional[threading.Thread] = None
+        #: last resize announce this process acked — a fast next boundary
+        #: must not re-latch the same request before the scheduler
+        #: collects the ack and clears the file
+        self._resize_acked: Optional[Dict[str, Any]] = None
         if self.compile_ahead_enabled():
             self.start_compile_ahead()
         if flight_recorder.enabled():
@@ -579,15 +590,19 @@ class ParrotAPI:
                 "mask": mask.reshape((mask.shape[0], nb_b, bs))}
 
     # ------------------------------------------------------------------
-    def _grid_sharding(self, k_b: int) -> Optional[NamedSharding]:
-        return grid_sharding(self.mesh, k_b, self.bs)
+    def _grid_sharding(self, k_b: int, mesh: Any = None
+                       ) -> Optional[NamedSharding]:
+        return grid_sharding(mesh if mesh is not None else self.mesh,
+                             k_b, self.bs)
 
-    def _build_round_step(self):
+    def _build_round_step(self, mesh: Any = None):
         # the client axis shards over EVERY mesh axis (clients is parrot's
         # only parallel dimension, so a DCN axis extends it across slices
         # rather than replicating the round); a quota smaller than the
-        # mesh shards the intra-batch axis instead (see _grid_sharding)
-        clients_sharding = self._grid_sharding(self.k)
+        # mesh shards the intra-batch axis instead (see _grid_sharding).
+        # ``mesh`` overrides self.mesh so the resize warm pool can build
+        # steps for candidate slot counts without touching the live mesh
+        clients_sharding = self._grid_sharding(self.k, mesh=mesh)
 
         per_client_algo_state = self._per_client_algo_state
         in_axes_algo = self._in_axes_algo()
@@ -621,7 +636,7 @@ class ParrotAPI:
         return build_aggregate(self.args, self.algo, self.n_total,
                                server_tx=getattr(self, "server_tx", None))
 
-    def _build_bucketed_round_step(self):
+    def _build_bucketed_round_step(self, mesh: Any = None):
         """One round over size strata: each bucket vmaps its own quota of
         clients at its own batch capacity (one compile total — the python
         loop over buckets unrolls into one jit graph), then all buckets'
@@ -635,7 +650,8 @@ class ParrotAPI:
         buckets = self.buckets
         # per-bucket sharding chosen from the bucket's own quota (mesh
         # path: the round-2 bucketed step never sharded — VERDICT weak #1)
-        bucket_shardings = [self._grid_sharding(b["k"]) for b in buckets]
+        bucket_shardings = [self._grid_sharding(b["k"], mesh=mesh)
+                            for b in buckets]
 
         # capped buckets draw a third key for the rotating window; the
         # uncapped layout keeps the historical 2-key stream so existing
@@ -1119,6 +1135,188 @@ class ParrotAPI:
 
         return call
 
+    # ---- elastic resize (pod scheduler contract) ----------------------
+
+    def _resize_file(self) -> Optional[str]:
+        return (os.environ.get("FEDML_TPU_RESIZE_FILE")
+                or getattr(self.args, "resize_file", None))
+
+    def _mesh_axis_for(self, n_slots: int) -> int:
+        """Clients-axis size for a gang of ``n_slots`` devices.  Unlike
+        __init__'s default-shape heuristic this does NOT clamp to the
+        client quota — an explicit mesh wider than ``k`` is legal (the
+        intra-batch axis shards instead), and clamping would turn a
+        grow-back to 8 slots into a silent 4-wide mesh."""
+        return max(min(int(n_slots), len(jax.devices())), 1)
+
+    def _step_arg_spec(self, tag: str):
+        """Shape/dtype-only specs (NO shardings, unlike `_aot_arg_spec`):
+        a resize candidate compiles against a mesh the live arrays aren't
+        on yet, and a pinned committed sharding would be rejected as an
+        incompatible device set.  The uncommitted-arg layout the compiler
+        picks here is exactly what the post-remesh call binds with."""
+        if tag == "brs":
+            tree = (self.device_data, self.global_vars, self.server_state,
+                    jax.random.PRNGKey(0))
+        else:
+            tree = (self.device_data, self.global_vars, self.server_state,
+                    jnp.zeros((self.k,), jnp.int32), jax.random.PRNGKey(0))
+        return jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
+
+    def prewarm_resize(self, around: int) -> None:
+        """Warm the resize ladder: precompile the per-round step for the
+        ±1-step slot counts (half and double of ``around``) in the
+        background, so the executable an announced re-mesh will need is
+        already sitting in ``_resize_warm`` when the round boundary
+        latches it.  Arg shapes don't change with the gang size — only
+        the shardings do — so one spec serves every candidate."""
+        if not self.use_mesh or self.mesh is None:
+            return
+        if dict(getattr(self.args, "dcn_mesh_shape", None) or {}):
+            return  # hybrid meshes don't resize (see remesh)
+        cands = sorted({self._mesh_axis_for(max(int(around) // 2, 1)),
+                        self._mesh_axis_for(int(around) * 2)}
+                       - {self._mesh_axis_for(int(around))})
+        if not cands:
+            return
+        with self._ca_lock:
+            t = self._resize_warm_thread
+            if t is not None and t.is_alive():
+                return
+            t = threading.Thread(target=self._prewarm_resize_worker,
+                                 args=(cands,), daemon=True,
+                                 name="parrot-resize-warm")
+            self._resize_warm_thread = t
+            t.start()
+
+    def _compile_resize_candidate(self, axis: int, tag: str) -> None:
+        mesh = build_mesh({AXIS_CLIENTS: axis})
+        fn = (self._build_bucketed_round_step(mesh=mesh)
+              if tag == "brs" else self._build_round_step(mesh=mesh))
+        t0 = time.perf_counter()
+        with flight_recorder.phase(
+                "compile_ahead",
+                program=f"parrot/round_step_{tag}_slots{axis}"):
+            compiled = (jax.jit(fn, donate_argnums=(1, 2))
+                        .trace(*self._step_arg_spec(tag))
+                        .lower().compile())
+        with self._ca_lock:
+            self._resize_warm[axis] = compiled
+        self._note_compile_ahead(
+            f"{tag}_slots{axis}",
+            {"hit": False,
+             "seconds": round(time.perf_counter() - t0, 3)})
+
+    def _prewarm_resize_worker(self, axis_sizes: List[int]) -> None:
+        tag = "brs" if self.n_buckets > 1 else "rs"
+        for axis in axis_sizes:
+            with self._ca_lock:
+                if axis in self._resize_warm:
+                    continue
+            try:
+                self._compile_resize_candidate(axis, tag)
+            except Exception as e:  # noqa: BLE001 — warm pool must never
+                # take the run down; a cold resize just compiles inline
+                logging.warning(
+                    "parrot: resize prewarm for %d slots failed (%s)",
+                    axis, e)
+
+    def remesh(self, n_slots: int) -> None:
+        """Rebuild the device mesh at ``n_slots`` and re-install the
+        round executables — the in-place half of the elastic resize
+        contract (docs/SCHEDULER.md "Elastic resize").  State crosses
+        through host memory (device_get → device_put), so the restored
+        values are bitwise-identical and only the sharding changes.
+        Raises on any failure; the caller degrades to the preempt
+        ladder."""
+        if not self.use_mesh or self.mesh is None:
+            return  # mesh-free layout: a gang resize changes nothing
+        if dict(getattr(self.args, "dcn_mesh_shape", None) or {}):
+            raise RuntimeError(
+                "elastic resize over a hybrid (DCN) mesh is not "
+                "supported — fall back to preempt/resume")
+        axis = self._mesh_axis_for(n_slots)
+        gv = jax.device_get(self.global_vars)
+        ss = jax.device_get(self.server_state)
+        self.mesh = build_mesh({AXIS_CLIENTS: axis})
+        self.global_vars = jax.device_put(gv)
+        self.server_state = jax.device_put(ss)
+        with self._ca_lock:
+            warm = self._resize_warm.get(axis)
+        tag = "brs" if self.n_buckets > 1 else "rs"
+        self.round_step = jax.jit(self._build_round_step(),
+                                  donate_argnums=(1, 2))
+        if self.n_buckets > 1:
+            jit_fn = jax.jit(self._build_bucketed_round_step(),
+                             donate_argnums=(1, 2))
+            self.bucketed_round_step = (
+                self._wrap_step_with_fallback(warm, jit_fn, tag)
+                if warm is not None else jit_fn)
+        elif warm is not None:
+            self.round_step = self._wrap_step_with_fallback(
+                warm, self.round_step, tag)
+        # the fused scan re-lowers lazily at the new layout; its AOT
+        # digest keys on the mesh, so the old artifact stays valid for
+        # the old size
+        self.multi_round_step = None
+        self._fused_is_plain_jit = False
+
+    def _maybe_resize(self, ckpt: Any, round_idx: int) -> None:
+        """Round-boundary resize latch (the parrot twin of the cross-silo
+        server's `_resize_requested`/`_perform_resize`): checkpoint
+        first, re-mesh in place, ack — a failed re-mesh acks ``failed``
+        (the scheduler walks the resize → preempt → kill ladder) and
+        training continues at the old gang until the drain arrives."""
+        path = self._resize_file()
+        if not path:
+            return
+        from ...scheduler.pod.runners import ack_resize, read_resize
+
+        req = read_resize(path)
+        if req is None or req == self._resize_acked:
+            return
+        target = int(req["slots"])
+        prev = (int(self.mesh.devices.size)
+                if self.mesh is not None else None)
+        t0 = time.perf_counter()
+        try:
+            if ckpt is not None:
+                # boundary checkpoint BEFORE touching the mesh: whatever
+                # happens next, this round is never lost (force=True —
+                # the periodic save may already hold this round)
+                ckpt.save(round_idx, {
+                    "round_idx": round_idx,
+                    "global_vars": self.global_vars,
+                    "server_state": self.server_state,
+                }, force=True)
+            self.remesh(target)
+            downtime = round(time.perf_counter() - t0, 6)
+            self._resize_acked = req
+            ack_resize(path, "ok", target, downtime_s=downtime,
+                       round=int(round_idx))
+            ledger.event("parrot", "resize", round_idx=int(round_idx),
+                         outcome="ok", downtime_s=downtime,
+                         **{"from": prev, "to": target})
+            logging.info(
+                "parrot: re-meshed %s -> %d slots in place at round "
+                "boundary %d (%.3fs pause)", prev, target, round_idx,
+                downtime)
+            self.prewarm_resize(target)  # warm the new ladder neighbours
+        except Exception:  # noqa: BLE001 — a failed re-mesh must degrade
+            # to the preempt ladder, never take the run down mid-round
+            logging.exception(
+                "parrot: in-place resize to %d slots failed — acking "
+                "failed (scheduler falls back to preempt)", target)
+            self._resize_acked = req
+            try:
+                ack_resize(path, "failed", target, round=int(round_idx))
+            except OSError:
+                pass
+            ledger.event("parrot", "resize", round_idx=int(round_idx),
+                         outcome="failed", downtime_s=None,
+                         **{"from": prev, "to": target})
+
     #: rounds per fused call — the scan ALWAYS runs this many iterations
     #: and a traced ``n_active`` masks the tail, so exactly ONE compiled
     #: program (and one AOT-cache artifact) serves every total round
@@ -1268,10 +1466,17 @@ class ParrotAPI:
                     self.server_state = state["server_state"]
                 logging.info("resumed from round %d", start_round - 1)
 
-        ctx = (self.mesh if self.mesh is not None
-               else contextlib.nullcontext())
-        with ctx:
-            for round_idx in range(start_round, comm_rounds):
+        if self._resize_file() and self.compile_ahead_enabled() \
+                and self.mesh is not None:
+            # elastic job under the pod scheduler: warm the ±1-step slot
+            # ladder now so an announced re-mesh finds its executable hot
+            self.prewarm_resize(int(self.mesh.devices.size))
+        for round_idx in range(start_round, comm_rounds):
+            # the mesh context re-enters per round (not once around the
+            # loop) because a round-boundary resize swaps self.mesh
+            ctx = (self.mesh if self.mesh is not None
+                   else contextlib.nullcontext())
+            with ctx:
                 t0 = time.time()
                 rng, sub = jax.random.split(rng)
                 with flight_recorder.record_round(
@@ -1300,7 +1505,8 @@ class ParrotAPI:
                                 self.server_state, client_ids, sub)
                             if flight_recorder.enabled():
                                 rm = jax.block_until_ready(rm)
-                freq = int(getattr(self.args, "frequency_of_the_test", 5) or 5)
+                freq = int(getattr(self.args, "frequency_of_the_test", 5)
+                           or 5)
                 if round_idx % freq == 0 or round_idx == comm_rounds - 1:
                     out = self.eval_step(self.global_vars, test_batches)
                     n = max(float(out["n"]), 1.0)
@@ -1318,6 +1524,9 @@ class ParrotAPI:
                         "global_vars": self.global_vars,
                         "server_state": self.server_state,
                     })
+            # round boundary, outside the (old) mesh context: latch any
+            # announced resize — checkpoint, re-mesh in place, ack
+            self._maybe_resize(ckpt, round_idx)
         return final_metrics
 
 

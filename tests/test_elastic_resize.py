@@ -1,0 +1,760 @@
+"""Elastic pod: live round-boundary mesh resize (docs/SCHEDULER.md
+"Elastic resize") — jobs shrink instead of die.
+
+Covers every layer of the resize ladder: elastic JobSpec ranges, queue
+RESIZE control requests, the allocator's shrink-over-evict decision
+table with cross-tick reservations and grow-back, the scheduler's
+announce → ack → release orchestration with the fallback-to-preempt
+rungs, the 8→4→8 chaos soak with a mid-resize death (zero lost rounds,
+ledger-asserted), the parrot runtime's in-place re-mesh with trajectory
+parity, the cross-silo server's round-boundary resize, and the resize
+observability surface (CLI, control-plane route, SLO indicator)."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+from click.testing import CliRunner
+
+import fedml_tpu
+from conftest import make_args
+from fedml_tpu.core.mlops import ledger, metrics
+from fedml_tpu.scheduler.pod import (
+    PREEMPTED_EXIT_CODE,
+    CallableJobRunner,
+    GangAllocator,
+    JobQueue,
+    JobSpec,
+    JobState,
+    PodScheduler,
+)
+from fedml_tpu.scheduler.pod.runners import (
+    clear_resize,
+    read_resize_ack,
+    signal_resize,
+)
+from fedml_tpu.scheduler.resource_db import ComputeResourceDB
+
+
+# --------------------------------------------------------------- job specs
+def test_jobspec_elastic_yaml_and_validation(tmp_path):
+    y = tmp_path / "job.yaml"
+    y.write_text(
+        "job_name: elastic-sim\n"
+        "kind: parrot\n"
+        "slots: 4\n"
+        "command: fedml run --cf cfg.yaml {resume}\n"
+        "elastic:\n  min_slots: 2\n  max_slots: 8\n")
+    spec = JobSpec.from_yaml(str(y))
+    assert spec.elastic
+    assert (spec.min_slots, spec.max_slots) == (2, 8)
+    # one-sided range defaults the missing bound to the declared gang
+    half = JobSpec.from_dict({"job_name": "h", "kind": "parrot",
+                              "slots": 4, "elastic": {"min_slots": 2}})
+    assert (half.min_slots, half.max_slots) == (2, 4)
+    # a job without the block keeps the fixed-gang contract
+    fixed = JobSpec.from_dict({"job_name": "f", "kind": "parrot",
+                               "slots": 4})
+    assert not fixed.elastic
+    with pytest.raises(ValueError, match="min_slots"):
+        JobSpec(name="x", kind="parrot", n_slots=4, min_slots=0,
+                max_slots=8).validate()
+    with pytest.raises(ValueError, match="max_slots"):
+        JobSpec(name="x", kind="parrot", n_slots=4, min_slots=4,
+                max_slots=2).validate()
+    with pytest.raises(ValueError, match="outside the elastic range"):
+        JobSpec(name="x", kind="parrot", n_slots=9, min_slots=2,
+                max_slots=8).validate()
+    with pytest.raises(ValueError, match="elastic must be a mapping"):
+        JobSpec.from_dict({"job_name": "x", "kind": "parrot",
+                           "slots": 4, "elastic": True})
+
+
+# --------------------------------------------------------------- job queue
+def test_queue_resize_request_clamp_and_record(tmp_path):
+    q = JobQueue(str(tmp_path))
+    jid = q.submit(JobSpec(name="el", kind="parrot", n_slots=4,
+                           min_slots=2, max_slots=8, command="c"))
+    # QUEUED: resize lands directly, clamped into the declared range
+    assert q.request_resize(jid, 32) == 8
+    assert q.get(jid)["n_slots"] == 8
+    # RUNNING + elastic: the flag latches (clamped), scheduler performs
+    q.mark_dispatched(jid, "run1", list(range(8)), "/tmp/l")
+    assert q.request_resize(jid, 1) == 2
+    row = q.get(jid)
+    assert row["resize_requested"] == 2 and row["n_slots"] == 8
+    # scheduler lands the completed attempt: new gang + audit blob
+    q.record_resize(jid, 8, 2, "ok", downtime_s=0.02, slots=[0, 1])
+    row = q.get(jid)
+    assert row["n_slots"] == 2 and row["slots"] == [0, 1]
+    assert row["resize_requested"] == 0
+    assert row["last_resize"]["from"] == 8
+    assert row["last_resize"]["to"] == 2
+    assert row["last_resize"]["outcome"] == "ok"
+    # a failed attempt records the audit blob but keeps the old gang
+    assert q.request_resize(jid, 8) == 8
+    q.record_resize(jid, 2, 8, "fallback_preempt")
+    row = q.get(jid)
+    assert row["n_slots"] == 2 and row["resize_requested"] == 0
+    assert row["last_resize"]["outcome"] == "fallback_preempt"
+    # RUNNING + inelastic: refused
+    j2 = q.submit(JobSpec(name="fix", kind="parrot", n_slots=2,
+                          command="c"))
+    q.mark_dispatched(j2, "run2", [8, 9], "/tmp/l2")
+    assert q.request_resize(j2, 4) is None
+    # requeue clears any in-flight resize flag
+    assert q.request_resize(jid, 4) == 4
+    q.requeue_preempted(jid, PREEMPTED_EXIT_CODE)
+    assert q.get(jid)["resize_requested"] == 0
+    q.close()
+
+
+# ------------------------------------------- allocator decision table
+def _job(jid, slots, priority=0, tenant="t", state="RUNNING",
+         preemptible=True, submitted=0.0, dispatched=0.0,
+         min_slots=0, max_slots=0, resize_requested=0):
+    return {"job_id": jid, "n_slots": slots, "priority": priority,
+            "tenant": tenant, "state": state, "preemptible": preemptible,
+            "submitted_ts": submitted, "dispatched_ts": dispatched,
+            "min_slots": min_slots, "max_slots": max_slots,
+            "resize_requested": resize_requested}
+
+
+def test_allocator_shrinks_elastic_victim_instead_of_evicting():
+    alloc = GangAllocator()
+    running = [_job("el", 8, priority=0, min_slots=2, max_slots=8)]
+    queued = [_job("hp", 6, priority=10, state="QUEUED")]
+    plan = alloc.plan(queued, running, free_slots=0)
+    # the elastic victim keeps running at its floor — no whole-job evict
+    assert plan.shrink == [(running[0], 2)]
+    assert not plan.evict
+    assert plan.reserve == {"hp": 6} and plan.blocked == ["hp"]
+    # partial pressure shrinks only as far as needed, not to the floor
+    plan2 = alloc.plan(queued, running, free_slots=4)
+    assert plan2.shrink == [(running[0], 6)]
+
+
+def test_allocator_mixes_shrink_and_evict_never_below_floor():
+    alloc = GangAllocator()
+    el = _job("el", 4, priority=0, min_slots=2, max_slots=8)
+    fixed = _job("fix", 4, priority=1, dispatched=1)
+    queued = [_job("hp", 8, priority=10, state="QUEUED")]
+    plan = alloc.plan(queued, [el, fixed], free_slots=2)
+    # the elastic victim shrinks to exactly min_slots (never below);
+    # the inelastic one covers the rest by draining whole
+    assert plan.shrink == [(el, 2)]
+    assert plan.evict == [fixed]
+    assert plan.reserve == {"hp": 8}
+
+
+def test_allocator_never_shrinks_equal_or_higher_priority():
+    alloc = GangAllocator()
+    running = [_job("el", 8, priority=5, min_slots=2, max_slots=8)]
+    queued = [_job("hp", 6, priority=5, state="QUEUED")]
+    plan = alloc.plan(queued, running, free_slots=0)
+    assert not plan.shrink and not plan.evict
+    assert plan.blocked == ["hp"]
+    # a victim already mid-resize is spoken for — never picked again
+    busy = [_job("el", 8, priority=0, min_slots=2, max_slots=8,
+                 resize_requested=2)]
+    plan2 = alloc.plan([_job("hp", 6, priority=10, state="QUEUED")],
+                       busy, free_slots=0)
+    assert not plan2.shrink and not plan2.evict
+
+
+def test_allocator_shrink_reservation_survives_backfill():
+    alloc = GangAllocator()
+    queued = [_job("hp", 6, priority=10, state="QUEUED"),
+              _job("bf", 4, priority=0, tenant="u", state="QUEUED",
+                   submitted=1)]
+    # while the shrink is in flight nothing fits and nothing re-pledges
+    mid = [_job("el", 8, priority=0, min_slots=2, max_slots=8,
+                resize_requested=2)]
+    plan = alloc.plan(queued, mid, free_slots=0, reserved={"hp": 6})
+    assert not plan.dispatch and not plan.shrink and not plan.evict
+    # the re-mesh landed: 6 slots free, only the pledge owner spends them
+    after = [_job("el", 2, priority=0, min_slots=2, max_slots=8)]
+    plan2 = alloc.plan(queued, after, free_slots=6, reserved={"hp": 6})
+    assert [j["job_id"] for j in plan2.dispatch] == ["hp"]
+    assert "bf" in plan2.blocked
+
+
+def test_allocator_grow_back_toward_ceiling_and_blocked_suppression():
+    alloc = GangAllocator()
+    a = _job("a", 2, priority=5, min_slots=2, max_slots=6)
+    b = _job("b", 2, priority=0, tenant="u", min_slots=2, max_slots=8)
+    # spare pool goes priority-first, each capped at its ceiling
+    plan = alloc.plan([], [a, b], free_slots=6)
+    assert plan.grow == [(a, 6), (b, 4)]
+    # ANY blocked queued job suppresses grow-back entirely
+    plan2 = alloc.plan([_job("big", 12, priority=5, state="QUEUED")],
+                       [a, b], free_slots=6)
+    assert not plan2.grow and plan2.blocked == ["big"]
+    # a job mid-resize or at its ceiling is left alone
+    c = _job("c", 4, priority=0, min_slots=2, max_slots=8,
+             resize_requested=8)
+    d = _job("d", 4, priority=0, min_slots=2, max_slots=4)
+    plan3 = alloc.plan([], [c, d], free_slots=4)
+    assert not plan3.grow
+
+
+# ------------------------------------------- scheduler orchestration
+def _mk_sched(tmp_path, workloads, total_slots=8, **kw):
+    queue = JobQueue(str(tmp_path / "pod"))
+    resources = ComputeResourceDB(str(tmp_path / "res"),
+                                  total_slots=total_slots)
+    sched = PodScheduler(queue, resources,
+                         runner=CallableJobRunner(workloads), **kw)
+    return sched, queue, resources
+
+
+def _step_until(sched, pred, timeout_s=60.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        sched.step()
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _sim_workload(duration_s):
+    def fn(ctx):
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < duration_s:
+            if ctx.drain_requested():
+                return PREEMPTED_EXIT_CODE
+            time.sleep(0.01)
+        return 0
+    return fn
+
+
+def test_busy_integral_attributes_interval_to_held_slots(tmp_path):
+    """Mid-job slot changes and the utilization integral: each interval
+    is charged at the slot count actually held OVER it (sampled at the
+    end of the previous pass), never retroactively at the count the
+    current pass just switched to."""
+    sched, q, _ = _mk_sched(tmp_path, {})
+    sched._integrate_busy(0.0, 4)      # t0; nothing accrues yet
+    sched._integrate_busy(10.0, 8)     # [0,10) ran at 4, not 8
+    assert sched._busy_slot_seconds == pytest.approx(40.0)
+    sched._integrate_busy(20.0, 2)     # [10,20) ran at 8
+    assert sched._busy_slot_seconds == pytest.approx(120.0)
+    sched._integrate_busy(30.0, 0)     # [20,30) ran at 2
+    assert sched._busy_slot_seconds == pytest.approx(140.0)
+    # 140 busy slot-seconds over 8 slots x 30 s
+    assert sched.aggregate_utilization() == pytest.approx(140 / 240)
+    q.close()
+
+
+def _elastic_trainer(rounds, total, envs=None, resize_log=None,
+                     chaos=None, round_s=0.02):
+    """A round-loop workload honouring the full pod contract: drain at
+    boundaries, latch + ack resizes, and (for the chaos soak) die without
+    acking when `chaos` arms a mid-resize kill.  `rounds` is the
+    persistent cross-dispatch cursor — the stand-in for the boundary
+    checkpoint a real server resumes from."""
+    def fn(ctx):
+        if envs is not None:
+            envs.append(dict(ctx.env))
+        acked = None
+        while len(rounds) < total:
+            time.sleep(round_s)
+            if ctx.drain_requested():
+                return PREEMPTED_EXIT_CODE
+            tgt = ctx.resize_requested()
+            if tgt is None:
+                acked = None             # scheduler cleared the last one
+            elif tgt != acked:
+                if chaos is not None and chaos.pop("die_on_resize", None):
+                    return 1             # killed mid-re-mesh: no ack
+                ctx.ack_resize("ok", tgt, downtime_s=0.004)
+                acked = tgt
+                if resize_log is not None:
+                    resize_log.append(tgt)
+            rounds.append(len(rounds))
+        return 0
+    return fn
+
+
+def test_scheduler_shrink_over_evict_growback_e2e(tmp_path):
+    """The headline elastic soak: a priority burst arrives on a full pod
+    and the elastic trainer SHRINKS to seat it (no preemption, no lost
+    warm state), then grows back to its ceiling when the burst passes.
+    The pod stays ≥89% utilized across the whole episode."""
+    rounds, resize_log, envs = [], [], []
+    TOTAL = 120
+    sched, q, res = _mk_sched(
+        tmp_path,
+        {"trainer": _elastic_trainer(rounds, TOTAL, envs=envs,
+                                     resize_log=resize_log),
+         "burst": _sim_workload(0.8)})
+    jid = q.submit(JobSpec(name="trainer", kind="parrot",
+                           tenant="research", n_slots=8, min_slots=2,
+                           max_slots=8, command="t"))
+    assert _step_until(
+        sched, lambda: q.get(jid)["state"] == JobState.RUNNING)
+    # the dispatch env carries the resize channel next to the drain file
+    assert envs[0]["FEDML_TPU_RESIZE_FILE"].endswith(".resize")
+    assert _step_until(sched, lambda: len(rounds) >= 10)
+    hp = q.submit(JobSpec(name="burst", kind="parrot", tenant="prod",
+                          priority=10, preemptible=False, n_slots=6,
+                          command="b"))
+    # the allocator shrinks the trainer to its floor and seats the burst
+    # on the freed slots — the trainer was never drained
+    assert _step_until(
+        sched, lambda: q.get(hp)["state"] == JobState.RUNNING,
+        timeout_s=120.0)
+    row = q.get(jid)
+    assert row["state"] == JobState.RUNNING and row["n_slots"] == 2
+    assert row["preempt_count"] == 0
+    assert len(row["slots"]) == 2
+    assert row["last_resize"]["outcome"] == "ok"
+    assert row["last_resize"]["to"] == 2
+    assert res.report()["free"] == 0          # 2 + 6: the pod is full
+    # burst done → the spare pool grows the trainer back to its ceiling
+    assert _step_until(
+        sched, lambda: q.get(hp)["state"] == JobState.FINISHED,
+        timeout_s=120.0)
+    assert _step_until(sched, lambda: q.get(jid)["n_slots"] == 8,
+                       timeout_s=120.0)
+    assert q.get(jid)["last_resize"]["to"] == 8
+    assert _step_until(
+        sched, lambda: q.get(jid)["state"] == JobState.FINISHED,
+        timeout_s=120.0)
+    final = q.get(jid)
+    assert final["returncode"] == 0 and final["preempt_count"] == 0
+    assert resize_log[:2] == [2, 8]           # shrink, then grow-back
+    assert rounds == list(range(TOTAL))       # zero lost rounds
+    util = sched.aggregate_utilization()
+    assert util >= 0.89, f"pod utilization {util:.3f} < 0.89"
+    expo = metrics.render_prometheus()
+    assert 'fedml_pod_resizes_total{direction="shrink",outcome="ok"}' \
+        in expo
+    assert 'fedml_pod_resizes_total{direction="grow",outcome="ok"}' \
+        in expo
+    assert "fedml_resize_downtime_seconds_count" in expo
+    q.close()
+
+
+def test_scheduler_resize_grace_falls_back_to_preempt(tmp_path):
+    """Fallback ladder rung 2: a workload that never acks the announce
+    exceeds the resize grace and degrades to the PR-11 preempt path —
+    drained at a boundary, requeued with resume, redispatched whole."""
+    dispatches = []
+
+    def stubborn(ctx):
+        dispatches.append(ctx.resume)
+        if ctx.resume:
+            return 0
+        while not ctx.drain_requested():
+            time.sleep(0.02)             # ignores the resize announce
+        return PREEMPTED_EXIT_CODE
+
+    sched, q, res = _mk_sched(tmp_path, {"stubborn": stubborn},
+                              resize_grace_s=0.3)
+    jid = q.submit(JobSpec(name="stubborn", kind="parrot", n_slots=4,
+                           min_slots=2, max_slots=4, command="s"))
+    assert _step_until(
+        sched, lambda: q.get(jid)["state"] == JobState.RUNNING)
+    assert q.request_resize(jid, 2) == 2
+    assert _step_until(
+        sched, lambda: q.get(jid)["state"] == JobState.FINISHED,
+        timeout_s=120.0)
+    row = q.get(jid)
+    assert row["preempt_count"] == 1 and row["resume"]
+    assert row["last_resize"]["outcome"] == "fallback_preempt"
+    assert dispatches == [False, True]
+    assert res.report()["free"] == 8
+    expo = metrics.render_prometheus()
+    assert 'fedml_pod_resizes_total{direction="shrink",' \
+        'outcome="fallback"}' in expo
+    q.close()
+
+
+def test_chaos_soak_midresize_death_zero_lost_rounds(tmp_path):
+    """Acceptance chaos soak: 8→4→8 with a kill mid-resize.  The first
+    shrink announce catches a workload that dies before acking; the
+    scheduler degrades it to preempt/resume (the resize is never worse
+    than a preemption), the resumed dispatch picks up at the boundary
+    cursor, the retried shrink lands in place and the grow-back returns
+    the pod to full width.  Every round runs exactly once — zero lost,
+    zero duplicated — and the whole episode is ledger-auditable."""
+    led_dir = str(tmp_path / "led")
+    ledger.enable(True, log_dir=led_dir, run_id="chaos-soak")
+    rounds, resize_log = [], []
+    chaos = {"die_on_resize": True}
+    TOTAL = 80
+    try:
+        sched, q, res = _mk_sched(
+            tmp_path, {"trainer": _elastic_trainer(
+                rounds, TOTAL, resize_log=resize_log, chaos=chaos)})
+        jid = q.submit(JobSpec(name="trainer", kind="parrot",
+                               tenant="research", n_slots=8, min_slots=2,
+                               max_slots=8, command="t"))
+        assert _step_until(sched, lambda: len(rounds) >= 5)
+        # shrink #1: the workload dies mid-re-mesh (announce, no ack)
+        assert q.request_resize(jid, 4) == 4
+        assert _step_until(
+            sched, lambda: q.get(jid)["preempt_count"] == 1,
+            timeout_s=120.0)
+        row = q.get(jid)
+        assert row["resume"]
+        assert row["last_resize"]["outcome"] == "fallback_preempt"
+        # the requeued job redispatches whole and resumes at the cursor
+        assert _step_until(
+            sched, lambda: q.get(jid)["state"] == JobState.RUNNING,
+            timeout_s=120.0)
+        resumed_at = len(rounds)
+        assert _step_until(sched,
+                           lambda: len(rounds) >= resumed_at + 5)
+        # shrink #2 lands in place; the idle spare then grows it back
+        assert q.request_resize(jid, 4) == 4
+        assert _step_until(
+            sched,
+            lambda: (q.get(jid)["last_resize"]["to"] == 8
+                     and q.get(jid)["last_resize"]["outcome"] == "ok"),
+            timeout_s=120.0)
+        assert _step_until(
+            sched, lambda: q.get(jid)["state"] == JobState.FINISHED,
+            timeout_s=120.0)
+        assert q.get(jid)["returncode"] == 0
+    finally:
+        ledger.reset()
+    # zero lost rounds, zero duplicates, across the death and both resizes
+    assert rounds == list(range(TOTAL))
+    assert resize_log == [4, 8]
+    recs = ledger.load_ledger(led_dir)
+    resizes = [r for r in recs if r["actor"] == "scheduler"
+               and r["event"] == "resize"]
+    outcomes = [r["attrs"]["outcome"] for r in resizes]
+    assert outcomes.count("fallback_preempt") == 1
+    assert outcomes.count("ok") == 2
+    spans = {(r["attrs"]["from"], r["attrs"]["to"]) for r in resizes}
+    assert (8, 4) in spans and (4, 8) in spans
+    assert sum(1 for r in recs if r["event"] == "requeue") == 1
+    dispatches = [r for r in recs if r["event"] == "dispatch"]
+    assert len(dispatches) == 2
+    assert dispatches[-1]["attrs"]["resume"] is True
+
+
+# ------------------------------------------- serving scaler (in place)
+def test_serving_scaler_requests_inplace_resize_for_elastic_job(tmp_path):
+    from fedml_tpu.scheduler.autoscaler import AutoscalePolicy
+    from fedml_tpu.scheduler.pod.serving_scaler import (
+        DECODE_METRIC,
+        ServingReplicaScaler,
+    )
+
+    reg = metrics.MetricsRegistry()
+    hist = reg.histogram(DECODE_METRIC, labels=("model",))
+    q = JobQueue(str(tmp_path))
+    jid = q.submit(JobSpec(name="svc", kind="serving", n_slots=2,
+                           min_slots=1, max_slots=8, command="serve"))
+    q.mark_dispatched(jid, "runS", [0, 1], "/tmp/l")
+    clock = {"t": 0.0}
+    scaler = ServingReplicaScaler(
+        q, policy=AutoscalePolicy(min_replicas=1, max_replicas=8,
+                                  target_latency_s=0.05,
+                                  target_qps_per_replica=5.0),
+        registry=reg, clock=lambda: clock["t"])
+    assert scaler.tick() == {}               # baseline window
+    for _ in range(200):
+        hist.labels(model="m").observe(0.5)
+    clock["t"] = 1.0
+    decisions = scaler.tick()
+    assert decisions[jid] == 8
+    row = q.get(jid)
+    # elastic + RUNNING → in-place resize request, NOT a drain
+    assert row["state"] == JobState.RUNNING
+    assert not row["preempt_requested"]
+    assert row["resize_requested"] == 8
+    # a request already in flight is left alone on the next breach
+    for _ in range(200):
+        hist.labels(model="m").observe(0.5)
+    clock["t"] = 2.0
+    scaler.tick()
+    assert q.get(jid)["resize_requested"] == 8
+    q.close()
+
+
+# ------------------------------------------- parrot runtime (in place)
+def _make_parrot(args):
+    from fedml_tpu.simulation.parrot.parrot_api import ParrotAPI
+
+    args = fedml_tpu.init(args)
+    device = fedml_tpu.device.get_device(args)
+    dataset = fedml_tpu.data.load(args)
+    bundle = fedml_tpu.model.create(args, dataset[-1])
+    return ParrotAPI(args, device, dataset, bundle, use_mesh=True)
+
+
+def _parrot_kw(**kw):
+    base = dict(backend="mesh", comm_round=4, client_num_in_total=8,
+                client_num_per_round=4, data_scale=0.3,
+                mesh_shape={"clients": 8})
+    base.update(kw)
+    return base
+
+
+def test_parrot_inplace_resize_shrink_parity(tmp_path):
+    """Acceptance: an in-place 8→4 re-mesh at a round boundary resumes
+    from host-round-tripped state and reproduces the no-resize
+    trajectory within tolerance (bit-identical on the CPU proxy — the
+    re-mesh moves values, never math)."""
+    rp = str(tmp_path / "job.resize")
+    signal_resize(rp, 4, 8)                  # latches after round 0
+    api = _make_parrot(make_args(
+        checkpoint_dir=str(tmp_path / "ckpt"), resize_file=rp,
+        **_parrot_kw()))
+    m = api.train()
+    ack = read_resize_ack(rp)
+    assert ack and ack["outcome"] == "ok" and ack["to"] == 4, ack
+    assert int(api.mesh.devices.size) == 4
+    assert np.isfinite(m["test_loss"])
+    # the boundary checkpoint exists (re-mesh failure falls back to it)
+    assert os.listdir(str(tmp_path / "ckpt"))
+    # trajectory parity vs the same seed without any resize
+    api2 = _make_parrot(make_args(**_parrot_kw()))
+    m2 = api2.train()
+    np.testing.assert_allclose(m["test_loss"], m2["test_loss"],
+                               atol=2e-4)
+    np.testing.assert_allclose(m["test_acc"], m2["test_acc"], atol=1e-6)
+
+
+def test_parrot_resize_grow_back_roundtrip(tmp_path):
+    """Shrink 8→4 then grow back 4→8 across round boundaries (the
+    scheduler clears the channel between announces), then train to
+    completion on the re-grown mesh."""
+    rp = str(tmp_path / "job.resize")
+    api = _make_parrot(make_args(resize_file=rp,
+                                 **_parrot_kw(comm_round=6)))
+    signal_resize(rp, 4, 8)
+    api._maybe_resize(None, 0)
+    a1 = read_resize_ack(rp)
+    assert a1["outcome"] == "ok" and int(api.mesh.devices.size) == 4
+    clear_resize(rp)
+    signal_resize(rp, 8, 4)
+    api._maybe_resize(None, 2)
+    a2 = read_resize_ack(rp)
+    assert a2["outcome"] == "ok" and int(api.mesh.devices.size) == 8
+    assert a2.get("downtime_s") is not None
+    clear_resize(rp)
+    m = api.train()
+    assert np.isfinite(m["test_loss"])
+
+
+# ------------------------------------------- cross-silo server (in place)
+def _build_cross_silo(args):
+    import jax
+
+    from fedml_tpu.cross_silo.runner import init_client
+    from fedml_tpu.cross_silo.server.fedml_aggregator import FedMLAggregator
+    from fedml_tpu.cross_silo.server.fedml_server_manager import (
+        FedMLServerManager,
+    )
+    from fedml_tpu.ml.trainer.default_trainer import DefaultServerAggregator
+
+    n = int(args.client_num_in_total)
+    dataset = fedml_tpu.data.load(args)
+    bundle = fedml_tpu.model.create(args, dataset[-1])
+    impl = DefaultServerAggregator(bundle, args)
+    if impl.get_model_params() is None:
+        impl.set_model_params(bundle.init_variables(jax.random.PRNGKey(0)))
+    agg = FedMLAggregator(args, impl, dataset[3])
+    server = FedMLServerManager(args, agg, rank=0, client_num=n,
+                                backend="INPROC")
+    clients = [init_client(args, dataset, bundle, rank, backend="INPROC")
+               for rank in range(1, n + 1)]
+    return server, clients
+
+
+def _run_cross_silo(server, clients):
+    threads = [threading.Thread(target=c.run, daemon=True)
+               for c in clients]
+    for t in threads:
+        t.start()
+    server.run()
+    for t in threads:
+        t.join(timeout=30)
+
+
+def test_cross_silo_server_inplace_resize(tmp_path):
+    """The server latches a resize at `_complete_round` AFTER the round
+    state persisted, re-meshes in the same process, acks, and finishes
+    every round — no preemption, no duplicate uploads, the resize
+    audit-trailed in the run ledger."""
+    N, ROUNDS = 3, 5
+    rp = str(tmp_path / "job.resize")
+    signal_resize(rp, 4, 8)                  # latches at the 1st boundary
+    args = fedml_tpu.init(make_args(
+        training_type="cross_silo", client_num_in_total=N,
+        client_num_per_round=N, comm_round=ROUNDS, data_scale=0.3,
+        frequency_of_the_test=1, run_id="resize_srv", resize_file=rp))
+    server, clients = _build_cross_silo(args)
+    ledger.enable(True, log_dir=str(tmp_path), run_id="resize_srv")
+    try:
+        _run_cross_silo(server, clients)
+    finally:
+        ledger.reset()
+    ack = read_resize_ack(rp)
+    assert ack and ack["outcome"] == "ok" and ack["to"] == 4, ack
+    assert int(args.round_idx) == ROUNDS
+    assert args.preempted_at_round is None
+    assert len(server.aggregator.metrics_history) == ROUNDS
+    assert server.aggregator.duplicate_uploads == 0
+    assert np.isfinite(server.aggregator.metrics_history[-1]["test_loss"])
+    recs = ledger.load_ledger(str(tmp_path))
+    evs = [r for r in recs if r["actor"] == "server"
+           and r["event"] == "resize"]
+    assert evs and evs[0]["attrs"]["outcome"] == "ok"
+    assert evs[0]["attrs"]["to"] == 4
+    assert evs[0]["attrs"]["downtime_s"] is not None
+
+
+def test_cross_silo_server_resize_failure_preempts_at_boundary(tmp_path):
+    """Fallback ladder rung 1 inside the runtime: a re-mesh that raises
+    acks `failed` and degrades to the boundary preempt — exit 75 with the
+    checkpoint saved, never a crash."""
+    N, ROUNDS = 2, 4
+    rp = str(tmp_path / "job.resize")
+    signal_resize(rp, 1, 2)
+    args = fedml_tpu.init(make_args(
+        training_type="cross_silo", client_num_in_total=N,
+        client_num_per_round=N, comm_round=ROUNDS, data_scale=0.3,
+        frequency_of_the_test=1, run_id="resize_fail",
+        checkpoint_dir=str(tmp_path / "ckpt"), resize_file=rp))
+    server, clients = _build_cross_silo(args)
+
+    def _boom(n_slots):
+        raise RuntimeError("re-mesh blew up")
+
+    server.aggregator.remesh = _boom
+    _run_cross_silo(server, clients)
+    ack = read_resize_ack(rp)
+    assert ack and ack["outcome"] == "failed", ack
+    assert args.preempted_at_round is not None
+    # completed rounds were checkpointed before the preempt
+    assert os.listdir(str(tmp_path / "ckpt"))
+
+
+# ------------------------------------------- observability surfaces
+def test_resize_downtime_slo_indicator(tmp_path):
+    from fedml_tpu.core.mlops import slo as slo_mod
+
+    # ledger fallback: p95 over ok-resize downtimes only
+    recs = [{"actor": "scheduler", "event": "resize", "ts_mono": float(i),
+             "attrs": {"outcome": "ok", "downtime_s": 0.1 * (i + 1),
+                       "from": 8, "to": 4}}
+            for i in range(5)]
+    recs.append({"actor": "scheduler", "event": "resize", "ts_mono": 9.0,
+                 "attrs": {"outcome": "fallback_preempt",
+                           "downtime_s": None, "from": 4, "to": 8}})
+    (tmp_path / "ledger.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in recs))
+    ctx = slo_mod.SLOContext.from_artifacts(log_dir=str(tmp_path))
+    rule = slo_mod.SLORule(name="rd", indicator="resize_downtime_p95",
+                           max=10.0)
+    assert slo_mod.INDICATORS["resize_downtime_p95"](ctx, rule) \
+        == pytest.approx(0.5)
+    results = slo_mod.evaluate([rule], ctx)
+    assert results[0]["ok"] is True
+    # metrics-first: the live histogram wins when populated
+    metrics.histogram(
+        "fedml_resize_downtime_seconds",
+        "Checkpoint -> re-mesh -> resume pause of an in-place resize"
+    ).observe(0.2)
+    live = slo_mod.INDICATORS["resize_downtime_p95"](
+        slo_mod.SLOContext.live(), rule)
+    assert live is not None and live > 0
+
+
+def test_slo_pod_rules_gate_recorded_soak(tmp_path):
+    """`fedml slo check --rules examples/slo_pod.yaml` gates a recorded
+    elastic soak offline — the CI chaos-soak step's exact invocation."""
+    from fedml_tpu.cli.cli import cli
+
+    out = tmp_path / "soak"
+    out.mkdir()
+    (out / "ledger.jsonl").write_text(json.dumps(
+        {"actor": "scheduler", "event": "resize", "ts_mono": 1.0,
+         "attrs": {"outcome": "ok", "downtime_s": 0.02,
+                   "from": 8, "to": 4}}) + "\n")
+    rules = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "examples", "slo_pod.yaml")
+    res = CliRunner().invoke(cli, ["slo", "check", "--rules", rules,
+                                   "--log-dir", str(out)])
+    assert res.exit_code == 0, res.output
+    assert "resize_downtime_p95" in res.output
+
+
+def test_cli_jobs_resize_and_elastic_projection(tmp_path):
+    from fedml_tpu.cli.cli import cli
+
+    pod = str(tmp_path / "pod")
+    q = JobQueue(pod)
+    jid = q.submit(JobSpec(name="el", kind="parrot", n_slots=4,
+                           min_slots=2, max_slots=8, command="c"))
+    # QUEUED: the CLI resize lands immediately, clamped to the range
+    res = CliRunner().invoke(cli, ["jobs", "resize", jid, "32",
+                                   "--pod-dir", pod])
+    assert res.exit_code == 0, res.output
+    payload = json.loads(res.output)
+    assert payload["resize_requested"] and payload["target_slots"] == 8
+    assert q.get(jid)["n_slots"] == 8
+    # RUNNING elastic: flag latched for the scheduler, list/status
+    # project the range + in-flight target + audit blob
+    q.mark_dispatched(jid, "r1", list(range(8)), "/tmp/l")
+    res2 = CliRunner().invoke(cli, ["jobs", "resize", jid, "4",
+                                    "--pod-dir", pod])
+    assert res2.exit_code == 0 and \
+        json.loads(res2.output)["target_slots"] == 4
+    rows = [json.loads(line) for line in CliRunner().invoke(
+        cli, ["jobs", "list", "--pod-dir", pod]).output.splitlines()]
+    brief = next(r for r in rows if r["job_id"] == jid)
+    assert brief["elastic"] == {"min_slots": 2, "max_slots": 8}
+    assert brief["resize_requested"] == 4
+    q.record_resize(jid, 8, 4, "ok", downtime_s=0.02,
+                    slots=[0, 1, 2, 3])
+    res3 = CliRunner().invoke(cli, ["jobs", "status", jid,
+                                    "--pod-dir", pod])
+    row = json.loads(res3.output)
+    assert row["n_slots"] == 4
+    assert row["last_resize"]["outcome"] == "ok"
+    # a RUNNING inelastic job refuses the resize (exit 1)
+    j2 = q.submit(JobSpec(name="fix", kind="parrot", n_slots=2,
+                          command="c"))
+    q.mark_dispatched(j2, "r2", [8, 9], "/tmp/l2")
+    res4 = CliRunner().invoke(cli, ["jobs", "resize", j2, "4",
+                                    "--pod-dir", pod])
+    assert res4.exit_code == 1
+    assert json.loads(res4.output)["target_slots"] is None
+    q.close()
+
+
+def test_control_plane_resize_route(tmp_path):
+    from fedml_tpu.scheduler.control_plane import (
+        ControlPlaneClient,
+        ControlPlaneServer,
+    )
+
+    q = JobQueue(str(tmp_path))
+    jid = q.submit(JobSpec(name="el", kind="parrot", n_slots=4,
+                           min_slots=2, max_slots=8, command="c"))
+    q.mark_dispatched(jid, "r1", [0, 1, 2, 3], "/tmp/l")
+    srv = ControlPlaneServer(master=None, pod_queue=q).start()
+    try:
+        client = ControlPlaneClient(srv.url)
+        assert client.pod_resize(jid, 2) == 2
+        assert q.get(jid)["resize_requested"] == 2
+        # inelastic RUNNING job → 409 → None
+        j2 = q.submit(JobSpec(name="fix", kind="parrot", n_slots=2,
+                              command="c"))
+        q.mark_dispatched(j2, "r2", [4, 5], "/tmp/l2")
+        assert client.pod_resize(j2, 4) is None
+    finally:
+        srv.stop()
+        q.close()

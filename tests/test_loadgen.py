@@ -591,3 +591,45 @@ def test_cli_load_curve_finds_knee(tmp_path):
     assert past, "sweep never exceeded the knee"
     assert any(p["shed_rate"] > 0 for p in past)          # shedding engaged
     assert all(p["ttft_p99"] <= 1.0 for p in past)        # bounded p99
+
+
+def test_serving_scaler_scales_up_under_open_loop_burst(tmp_path):
+    """Policy loop under the load plane (docs/SCHEDULER.md "Elastic
+    resize"): an MMPP burst through the open-loop driver feeds the real
+    decode-step histogram the engine exports, and the replica scaler
+    answers with an IN-PLACE resize request on the elastic RUNNING
+    serving job — no drain, no preemption."""
+    from fedml_tpu.scheduler.autoscaler import AutoscalePolicy
+    from fedml_tpu.scheduler.pod import JobQueue, JobSpec, JobState
+    from fedml_tpu.scheduler.pod.serving_scaler import ServingReplicaScaler
+    from fedml_tpu.serving.loadgen import (LengthSampler,
+                                           MarkovModulatedProcess,
+                                           OpenLoopDriver)
+
+    q = JobQueue(str(tmp_path / "pod"))
+    jid = q.submit(JobSpec(name="svc", kind="serving", n_slots=2,
+                           min_slots=1, max_slots=8, command="serve"))
+    q.mark_dispatched(jid, "runS", [0, 1], "/tmp/l")
+    scaler = ServingReplicaScaler(
+        q, policy=AutoscalePolicy(min_replicas=1, max_replicas=8,
+                                  target_latency_s=1e-6,
+                                  target_qps_per_replica=1.0))
+    assert scaler.tick() == {}               # baseline decode window
+    eng = _stub_engine(max_batch=2)
+    try:
+        driver = OpenLoopDriver(
+            eng, MarkovModulatedProcess(5.0, 80.0, switch_p=0.02, seed=7),
+            LengthSampler.fixed(4, 6), duration_s=1.5, vocab=10,
+            gauge_period_s=0.2, seed=7)
+        result = driver.run(drain_timeout_s=120.0)
+    finally:
+        eng.stop()
+    assert result.offered > 0
+    decisions = scaler.tick()                # window saw the burst
+    assert decisions.get(jid, 2) > 2
+    row = q.get(jid)
+    # elastic + RUNNING → the scaler latched an in-place resize
+    assert row["state"] == JobState.RUNNING
+    assert not row["preempt_requested"]
+    assert row["resize_requested"] == decisions[jid]
+    q.close()
